@@ -1,0 +1,26 @@
+# Build the native core runtime (csrc/ -> horovod_tpu/lib/libhvdtpu_core.so).
+# Reference analog: horovod's CMake-driven per-framework extensions
+# (setup.py + CMakeLists.txt). Ours is a single framework-agnostic .so
+# loaded via ctypes (horovod_tpu/common/basics.py).
+
+CXX      ?= g++
+CXXFLAGS ?= -O2 -g -std=c++17 -fPIC -Wall -Wextra -Wno-unused-parameter -pthread
+LDFLAGS  ?= -shared -pthread
+
+SRC := $(wildcard csrc/*.cc)
+HDR := $(wildcard csrc/*.h)
+OUT := horovod_tpu/lib/libhvdtpu_core.so
+
+.PHONY: core clean test
+
+core: $(OUT)
+
+$(OUT): $(SRC) $(HDR)
+	@mkdir -p horovod_tpu/lib
+	$(CXX) $(CXXFLAGS) $(SRC) $(LDFLAGS) -o $(OUT)
+
+clean:
+	rm -rf horovod_tpu/lib build
+
+test: core
+	python -m pytest tests/ -x -q

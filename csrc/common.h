@@ -1,0 +1,149 @@
+// Core shared types for the hvdtpu native runtime.
+// Reference analog: horovod/common/common.h (Status, DataType,
+// TensorTableEntry, framework enums). Rebuilt from scratch for a
+// framework-agnostic ctypes ABI: tensors are raw host pointers; the TPU
+// data plane lives in XLA programs above this layer.
+
+#ifndef HVDTPU_COMMON_H
+#define HVDTPU_COMMON_H
+
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class DataType : int32_t {
+  HVDTPU_UINT8 = 0,
+  HVDTPU_INT8 = 1,
+  HVDTPU_INT32 = 2,
+  HVDTPU_INT64 = 3,
+  HVDTPU_FLOAT16 = 4,
+  HVDTPU_BFLOAT16 = 5,
+  HVDTPU_FLOAT32 = 6,
+  HVDTPU_FLOAT64 = 7,
+  HVDTPU_BOOL = 8,
+  HVDTPU_UINT16 = 9,
+};
+
+inline int64_t DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::HVDTPU_UINT8:
+    case DataType::HVDTPU_INT8:
+    case DataType::HVDTPU_BOOL:
+      return 1;
+    case DataType::HVDTPU_FLOAT16:
+    case DataType::HVDTPU_BFLOAT16:
+    case DataType::HVDTPU_UINT16:
+      return 2;
+    case DataType::HVDTPU_INT32:
+    case DataType::HVDTPU_FLOAT32:
+      return 4;
+    case DataType::HVDTPU_INT64:
+    case DataType::HVDTPU_FLOAT64:
+      return 8;
+  }
+  return 0;
+}
+
+inline const char* DataTypeName(DataType dt) {
+  switch (dt) {
+    case DataType::HVDTPU_UINT8: return "uint8";
+    case DataType::HVDTPU_INT8: return "int8";
+    case DataType::HVDTPU_INT32: return "int32";
+    case DataType::HVDTPU_INT64: return "int64";
+    case DataType::HVDTPU_FLOAT16: return "float16";
+    case DataType::HVDTPU_BFLOAT16: return "bfloat16";
+    case DataType::HVDTPU_FLOAT32: return "float32";
+    case DataType::HVDTPU_FLOAT64: return "float64";
+    case DataType::HVDTPU_BOOL: return "bool";
+    case DataType::HVDTPU_UINT16: return "uint16";
+  }
+  return "unknown";
+}
+
+// Reduction op for allreduce/reducescatter.
+// Reference analog: horovod ReduceOp (Average/Sum/Adasum/Min/Max/Product).
+enum class ReduceOp : int32_t {
+  AVERAGE = 0,
+  SUM = 1,
+  MIN = 2,
+  MAX = 3,
+  PRODUCT = 4,
+  ADASUM = 5,
+};
+
+enum class StatusType : int32_t {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+class Status {
+ public:
+  Status() = default;
+  static Status OK() { return Status(); }
+  static Status Error(const std::string& msg) {
+    return Status(StatusType::UNKNOWN_ERROR, msg);
+  }
+  static Status PreconditionError(const std::string& msg) {
+    return Status(StatusType::PRECONDITION_ERROR, msg);
+  }
+  static Status InvalidArgument(const std::string& msg) {
+    return Status(StatusType::INVALID_ARGUMENT, msg);
+  }
+  static Status Aborted(const std::string& msg) {
+    return Status(StatusType::ABORTED, msg);
+  }
+  bool ok() const { return type_ == StatusType::OK; }
+  StatusType type() const { return type_; }
+  const std::string& reason() const { return reason_; }
+
+ private:
+  Status(StatusType type, std::string reason)
+      : type_(type), reason_(std::move(reason)) {}
+  StatusType type_ = StatusType::OK;
+  std::string reason_;
+};
+
+// A pending collective on this rank.
+// Reference analog: horovod/common/common.h TensorTableEntry — but tensors
+// are raw host buffers (the Python binding pins them until completion).
+struct TensorTableEntry {
+  std::string name;
+  int32_t handle = -1;
+  const void* input = nullptr;   // caller-owned input buffer
+  void* output = nullptr;        // caller-owned output buffer (allreduce)
+  std::vector<int64_t> shape;
+  DataType dtype = DataType::HVDTPU_FLOAT32;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  int32_t root_rank = 0;         // broadcast
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  int32_t process_set_id = 0;
+  // alltoall: number of elements sent to each rank (first-dim splits).
+  std::vector<int64_t> splits;
+  // Output managed by the core for ops whose size is known only after
+  // negotiation (allgather/alltoall). Copied out via the handle API.
+  std::vector<uint8_t> managed_output;
+  std::vector<int64_t> output_shape;
+  // received splits for alltoall
+  std::vector<int64_t> recv_splits;
+
+  int64_t NumElements() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  int64_t SizeBytes() const { return NumElements() * DataTypeSize(dtype); }
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_COMMON_H

@@ -1,0 +1,331 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <sstream>
+
+#include "logging.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// Hello exchanged at bootstrap: rank + data-plane listen address.
+struct Hello {
+  int32_t rank;
+  char addr[64];
+  int32_t port;
+};
+
+bool ShapesMatch(const std::vector<int64_t>& a, const std::vector<int64_t>& b,
+                 bool ignore_first_dim) {
+  if (a.size() != b.size()) return false;
+  for (size_t i = ignore_first_dim ? 1 : 0; i < a.size(); i++) {
+    if (a[i] != b[i]) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+Controller::Controller(ControllerConfig cfg) : cfg_(std::move(cfg)) {
+  shutdown_flags_.assign(cfg_.size, false);
+  last_stall_check_ = std::chrono::steady_clock::now();
+}
+
+Controller::~Controller() {
+  for (int fd : control_fds_) TcpClose(fd);
+}
+
+Status Controller::Initialize() {
+  const int rank = cfg_.rank, size = cfg_.size;
+  if (size == 1) {
+    data_plane_ = std::make_unique<DataPlane>(0, 1, std::vector<int>{-1});
+    return Status::OK();
+  }
+
+  // 1) Data-plane listen socket (ephemeral port).
+  int data_port = 0;
+  int data_listen = TcpListen(&data_port);
+  if (data_listen < 0) return Status::Error("failed to open data-plane port");
+  std::string my_addr = LocalAddress();
+
+  // 2) Control-plane rendezvous + address-book broadcast.
+  std::vector<Hello> book(size);
+  if (rank == 0) {
+    int port = cfg_.controller_port;
+    int lfd = TcpListen(&port);
+    if (lfd < 0) {
+      return Status::Error("coordinator failed to listen on port " +
+                           std::to_string(cfg_.controller_port));
+    }
+    control_fds_.assign(size, -1);
+    Hello mine{0, {0}, data_port};
+    snprintf(mine.addr, sizeof(mine.addr), "%s", my_addr.c_str());
+    book[0] = mine;
+    for (int i = 1; i < size; i++) {
+      int fd = TcpAccept(lfd);
+      if (fd < 0) return Status::Error("coordinator accept failed");
+      Hello h{};
+      Status s = RecvAll(fd, &h, sizeof(h));
+      if (!s.ok()) return s;
+      if (h.rank < 1 || h.rank >= size) {
+        return Status::Error("bad hello rank");
+      }
+      control_fds_[h.rank] = fd;
+      book[h.rank] = h;
+    }
+    TcpClose(lfd);
+    for (int i = 1; i < size; i++) {
+      Status s = SendAll(control_fds_[i], book.data(), sizeof(Hello) * size);
+      if (!s.ok()) return s;
+    }
+  } else {
+    int fd = TcpConnect(cfg_.controller_addr, cfg_.controller_port, 60000);
+    if (fd < 0) {
+      return Status::Error("worker failed to reach coordinator at " +
+                           cfg_.controller_addr + ":" +
+                           std::to_string(cfg_.controller_port));
+    }
+    Hello mine{(int32_t)rank, {0}, data_port};
+    snprintf(mine.addr, sizeof(mine.addr), "%s", my_addr.c_str());
+    Status s = SendAll(fd, &mine, sizeof(mine));
+    if (!s.ok()) return s;
+    s = RecvAll(fd, book.data(), sizeof(Hello) * size);
+    if (!s.ok()) return s;
+    control_fds_.assign(1, fd);
+  }
+
+  // 3) Full-mesh data plane: rank i accepts from all j > i, connects to all
+  // j < i. Each connection is identified by a rank hello byte pair.
+  std::vector<int> peers(size, -1);
+  for (int j = 0; j < rank; j++) {
+    int fd = TcpConnect(book[j].addr, book[j].port, 60000);
+    if (fd < 0) {
+      return Status::Error("data-plane connect to rank " + std::to_string(j) +
+                           " failed");
+    }
+    int32_t me = rank;
+    Status s = SendAll(fd, &me, sizeof(me));
+    if (!s.ok()) return s;
+    peers[j] = fd;
+  }
+  for (int j = rank + 1; j < size; j++) {
+    int fd = TcpAccept(data_listen);
+    if (fd < 0) return Status::Error("data-plane accept failed");
+    int32_t who = -1;
+    Status s = RecvAll(fd, &who, sizeof(who));
+    if (!s.ok()) return s;
+    if (who <= rank || who >= size || peers[who] != -1) {
+      return Status::Error("bad data-plane hello");
+    }
+    peers[who] = fd;
+  }
+  TcpClose(data_listen);
+  data_plane_ = std::make_unique<DataPlane>(rank, size, std::move(peers));
+  LOG_DEBUG("rank %d: control+data planes up (size=%d)", rank, size);
+  return Status::OK();
+}
+
+void Controller::HandleRequestList(const RequestList& list, int from_rank) {
+  if (list.shutdown) shutdown_flags_[from_rank] = true;
+  for (const auto& req : list.requests) {
+    auto& pt = message_table_[req.tensor_name];
+    if (pt.ranks_seen.empty()) {
+      pt.first_seen = std::chrono::steady_clock::now();
+    }
+    if (pt.ranks_seen.count(req.request_rank)) continue;  // duplicate
+    pt.ranks_seen.insert(req.request_rank);
+    pt.requests.push_back(req);
+    if ((int)pt.ranks_seen.size() == cfg_.size) {
+      ready_queue_.push_back(req.tensor_name);
+    }
+  }
+}
+
+Response Controller::BuildResponse(const std::string& name) {
+  auto& pt = message_table_[name];
+  Response res;
+  res.tensor_names = {name};
+  const Request& first = pt.requests.front();
+  res.tensor_type = first.tensor_type;
+
+  // Cross-rank validation.
+  // Reference analog: Controller::ConstructResponse error paths.
+  std::string err;
+  for (const auto& req : pt.requests) {
+    if (req.request_type != first.request_type) {
+      err = "mismatched collective types across ranks";
+    } else if (req.tensor_type != first.tensor_type) {
+      err = "mismatched tensor dtypes across ranks";
+    } else if (req.request_type == RequestType::ALLREDUCE ||
+               req.request_type == RequestType::BROADCAST ||
+               req.request_type == RequestType::REDUCESCATTER) {
+      if (!ShapesMatch(req.tensor_shape, first.tensor_shape, false)) {
+        err = "mismatched tensor shapes across ranks";
+      }
+      if (req.request_type == RequestType::BROADCAST &&
+          req.root_rank != first.root_rank) {
+        err = "mismatched broadcast root ranks";
+      }
+    } else if (req.request_type == RequestType::ALLGATHER ||
+               req.request_type == RequestType::ALLTOALL) {
+      if (!ShapesMatch(req.tensor_shape, first.tensor_shape, true)) {
+        err = "mismatched tensor shapes (non-first dims) across ranks";
+      }
+    }
+    if (!err.empty()) break;
+  }
+  if (!err.empty()) {
+    res.response_type = Response::ResponseType::ERROR;
+    res.error_message = "tensor " + name + ": " + err;
+    return res;
+  }
+
+  switch (first.request_type) {
+    case RequestType::ALLREDUCE:
+      res.response_type = Response::ResponseType::ALLREDUCE;
+      break;
+    case RequestType::ALLGATHER: {
+      res.response_type = Response::ResponseType::ALLGATHER;
+      // Per-rank first-dim sizes in rank order.
+      res.tensor_sizes.assign(cfg_.size, 0);
+      for (const auto& req : pt.requests) {
+        res.tensor_sizes[req.request_rank] =
+            req.tensor_shape.empty() ? 1 : req.tensor_shape[0];
+      }
+      break;
+    }
+    case RequestType::BROADCAST:
+      res.response_type = Response::ResponseType::BROADCAST;
+      break;
+    case RequestType::ALLTOALL:
+      res.response_type = Response::ResponseType::ALLTOALL;
+      break;
+    case RequestType::REDUCESCATTER:
+      res.response_type = Response::ResponseType::REDUCESCATTER;
+      break;
+    case RequestType::BARRIER:
+      res.response_type = Response::ResponseType::BARRIER;
+      break;
+    case RequestType::JOIN:
+      res.response_type = Response::ResponseType::JOIN;
+      break;
+  }
+  return res;
+}
+
+ResponseList Controller::FuseResponses() {
+  ResponseList list;
+  while (!ready_queue_.empty()) {
+    std::string name = ready_queue_.front();
+    ready_queue_.pop_front();
+    Response res = BuildResponse(name);
+    const Request& first = message_table_[name].requests.front();
+    int64_t bytes = 1;
+    for (auto d : first.tensor_shape) bytes *= d;
+    bytes *= DataTypeSize(first.tensor_type);
+    // Tensor fusion: keep folding subsequent ready ALLREDUCEs of the same
+    // dtype/process-set into this response while under the threshold.
+    // Reference analog: Controller::FuseResponses + fusion_buffer_manager.
+    if (res.response_type == Response::ResponseType::ALLREDUCE) {
+      while (!ready_queue_.empty() && bytes < cfg_.fusion_threshold_bytes) {
+        const std::string& next = ready_queue_.front();
+        auto& npt = message_table_[next];
+        const Request& nreq = npt.requests.front();
+        if (nreq.request_type != RequestType::ALLREDUCE ||
+            nreq.tensor_type != first.tensor_type ||
+            nreq.process_set_id != first.process_set_id ||
+            nreq.reduce_op != first.reduce_op) {
+          break;
+        }
+        Response nres = BuildResponse(next);
+        if (nres.response_type == Response::ResponseType::ERROR) break;
+        int64_t nbytes = 1;
+        for (auto d : nreq.tensor_shape) nbytes *= d;
+        nbytes *= DataTypeSize(nreq.tensor_type);
+        if (bytes + nbytes > cfg_.fusion_threshold_bytes) break;
+        res.tensor_names.push_back(next);
+        bytes += nbytes;
+        message_table_.erase(next);
+        ready_queue_.pop_front();
+      }
+    }
+    message_table_.erase(name);
+    list.responses.push_back(std::move(res));
+  }
+  return list;
+}
+
+void Controller::CheckForStalledTensors() {
+  if (!cfg_.stall_check_enabled) return;
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_stall_check_).count() < 10.0) {
+    return;
+  }
+  last_stall_check_ = now;
+  for (auto& kv : message_table_) {
+    double waited =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (waited > cfg_.stall_warning_secs) {
+      std::ostringstream missing;
+      for (int r = 0; r < cfg_.size; r++) {
+        if (!kv.second.ranks_seen.count(r)) missing << r << " ";
+      }
+      LOG_WARN(
+          "Stall detected: tensor %s has waited %.0fs; missing ranks: %s"
+          " (one or more ranks did not submit this collective)",
+          kv.first.c_str(), waited, missing.str().c_str());
+    }
+  }
+}
+
+Status Controller::ComputeResponseList(std::vector<Request> requests,
+                                       bool should_shutdown,
+                                       ResponseList* out) {
+  RequestList my_list;
+  my_list.requests = std::move(requests);
+  my_list.shutdown = should_shutdown;
+
+  if (cfg_.size == 1) {
+    HandleRequestList(my_list, 0);
+    *out = FuseResponses();
+    out->shutdown = should_shutdown;
+    return Status::OK();
+  }
+
+  if (cfg_.rank == 0) {
+    HandleRequestList(my_list, 0);
+    for (int r = 1; r < cfg_.size; r++) {
+      std::string frame;
+      Status s = RecvFrame(control_fds_[r], &frame);
+      if (!s.ok()) return s;
+      RequestList rl;
+      s = ParseRequestList(frame, &rl);
+      if (!s.ok()) return s;
+      HandleRequestList(rl, r);
+    }
+    CheckForStalledTensors();
+    ResponseList list = FuseResponses();
+    list.shutdown = std::all_of(shutdown_flags_.begin(), shutdown_flags_.end(),
+                                [](bool b) { return b; });
+    std::string payload = SerializeResponseList(list);
+    for (int r = 1; r < cfg_.size; r++) {
+      Status s = SendFrame(control_fds_[r], payload);
+      if (!s.ok()) return s;
+    }
+    *out = std::move(list);
+    return Status::OK();
+  }
+
+  // Worker: one send + one receive per cycle (the gather/bcast round).
+  Status s = SendFrame(control_fds_[0], SerializeRequestList(my_list));
+  if (!s.ok()) return s;
+  std::string frame;
+  s = RecvFrame(control_fds_[0], &frame);
+  if (!s.ok()) return s;
+  return ParseResponseList(frame, out);
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,85 @@
+// Coordination protocol: rank 0 is the coordinator; every cycle all workers
+// send a RequestList (tensors newly ready on that rank), the coordinator
+// waits until a tensor is ready on ALL ranks, fuses ready tensors into
+// Responses, and broadcasts an ordered ResponseList that every rank executes
+// identically.
+// Reference analog: horovod/common/controller.h (Controller::
+// ComputeResponseList, FuseResponses) + mpi_controller / gloo_controller for
+// the transport. Rebuilt over the TCP control plane in wire.h; the reference's
+// MPI_Gatherv round becomes a frame gather over per-worker sockets.
+
+#ifndef HVDTPU_CONTROLLER_H
+#define HVDTPU_CONTROLLER_H
+
+#include <chrono>
+#include <deque>
+#include <memory>
+#include <unordered_map>
+#include <unordered_set>
+
+#include "message.h"
+#include "ring_ops.h"
+
+namespace hvdtpu {
+
+struct ControllerConfig {
+  int rank = 0;
+  int size = 1;
+  std::string controller_addr = "127.0.0.1";
+  int controller_port = 0;
+  int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
+  double stall_warning_secs = 60.0;
+  bool stall_check_enabled = true;
+};
+
+class Controller {
+ public:
+  explicit Controller(ControllerConfig cfg);
+  ~Controller();
+
+  // Rendezvous with the coordinator, exchange data-plane addresses, and
+  // build the full-mesh data-plane sockets. Blocking; collective.
+  Status Initialize();
+
+  // One negotiation round (blocking, collective): submit this rank's new
+  // requests, get back the globally-agreed ResponseList.
+  // `should_shutdown`: this rank wants to shut down (sticky at coordinator;
+  // the returned list has .shutdown once ALL ranks have asked).
+  Status ComputeResponseList(std::vector<Request> requests,
+                             bool should_shutdown, ResponseList* out);
+
+  DataPlane* data_plane() { return data_plane_.get(); }
+  int rank() const { return cfg_.rank; }
+  int size() const { return cfg_.size; }
+
+ private:
+  // Coordinator side: fold one rank's RequestList into the message table,
+  // tracking newly all-ready tensors in arrival order.
+  void HandleRequestList(const RequestList& list, int from_rank);
+  // Coordinator side: build fused responses from the ready queue.
+  // Reference analog: Controller::FuseResponses.
+  ResponseList FuseResponses();
+  Response BuildResponse(const std::string& name);
+  void CheckForStalledTensors();  // reference: common/stall_inspector.cc
+
+  ControllerConfig cfg_;
+  std::unique_ptr<DataPlane> data_plane_;
+  // Worker: control_fds_[0] = socket to coordinator.
+  // Coordinator: control_fds_[r] = socket to worker r (r >= 1).
+  std::vector<int> control_fds_;
+
+  // --- Coordinator state (rank 0 only) ---
+  struct PendingTensor {
+    std::vector<Request> requests;          // one per reporting rank
+    std::unordered_set<int32_t> ranks_seen;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  std::unordered_map<std::string, PendingTensor> message_table_;
+  std::deque<std::string> ready_queue_;  // all-ranks-ready, FIFO order
+  std::vector<bool> shutdown_flags_;
+  std::chrono::steady_clock::time_point last_stall_check_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_CONTROLLER_H

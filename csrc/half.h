@@ -1,0 +1,94 @@
+// CPU float16 / bfloat16 conversion for reductions on the host data plane.
+// Reference analog: horovod/common/half.h (HalfBits2Float / Float2HalfBits),
+// used so MPI/Gloo CPU paths can sum fp16 tensors. Rewritten: bit-twiddling
+// fp16<->fp32, and trivial bf16 (truncation with round-to-nearest-even).
+
+#ifndef HVDTPU_HALF_H
+#define HVDTPU_HALF_H
+
+#include <cstdint>
+#include <cstring>
+
+namespace hvdtpu {
+
+inline float HalfBitsToFloat(uint16_t h) {
+  uint32_t sign = (uint32_t)(h >> 15) & 1u;
+  uint32_t exp = (uint32_t)(h >> 10) & 0x1Fu;
+  uint32_t mant = (uint32_t)h & 0x3FFu;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign << 31;  // +-0
+    } else {
+      // subnormal: normalize
+      int e = -1;
+      uint32_t m = mant;
+      do {
+        e++;
+        m <<= 1;
+      } while ((m & 0x400u) == 0);
+      f = (sign << 31) | ((uint32_t)(127 - 15 - e) << 23) |
+          ((m & 0x3FFu) << 13);
+    }
+  } else if (exp == 0x1F) {
+    f = (sign << 31) | 0x7F800000u | (mant << 13);  // inf/nan
+  } else {
+    f = (sign << 31) | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToHalfBits(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  uint32_t sign = (f >> 31) & 1u;
+  int32_t exp = (int32_t)((f >> 23) & 0xFFu) - 127 + 15;
+  uint32_t mant = f & 0x7FFFFFu;
+  if (((f >> 23) & 0xFFu) == 0xFFu) {  // inf/nan
+    return (uint16_t)((sign << 15) | 0x7C00u | (mant ? 0x200u : 0));
+  }
+  if (exp >= 0x1F) {  // overflow -> inf
+    return (uint16_t)((sign << 15) | 0x7C00u);
+  }
+  if (exp <= 0) {  // subnormal or zero
+    if (exp < -10) return (uint16_t)(sign << 15);
+    mant |= 0x800000u;
+    int shift = 14 - exp;
+    uint32_t sub = mant >> shift;
+    // round to nearest even
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t half = 1u << (shift - 1);
+    if (rem > half || (rem == half && (sub & 1u))) sub++;
+    return (uint16_t)((sign << 15) | sub);
+  }
+  uint16_t out = (uint16_t)((sign << 15) | ((uint32_t)exp << 10) | (mant >> 13));
+  // round to nearest even on the dropped 13 bits
+  uint32_t rem = mant & 0x1FFFu;
+  if (rem > 0x1000u || (rem == 0x1000u && (out & 1u))) out++;
+  return out;
+}
+
+inline float BF16BitsToFloat(uint16_t b) {
+  uint32_t f = (uint32_t)b << 16;
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToBF16Bits(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  if ((f & 0x7F800000u) == 0x7F800000u && (f & 0x7FFFFFu)) {
+    return (uint16_t)((f >> 16) | 0x40u);  // quiet nan
+  }
+  // round to nearest even
+  uint32_t lsb = (f >> 16) & 1u;
+  f += 0x7FFFu + lsb;
+  return (uint16_t)(f >> 16);
+}
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_HALF_H

@@ -1,0 +1,62 @@
+// Leveled stderr logging.
+// Reference analog: horovod/common/logging.h LOG(level) macros controlled by
+// HOROVOD_LOG_LEVEL / HOROVOD_LOG_TIMESTAMP.
+
+#ifndef HVDTPU_LOGGING_H
+#define HVDTPU_LOGGING_H
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+namespace hvdtpu {
+
+enum class LogLevel : int { TRACE = 0, DEBUG = 1, INFO = 2, WARN = 3, ERROR = 4, NONE = 5 };
+
+inline LogLevel GlobalLogLevel() {
+  static LogLevel level = [] {
+    const char* env = std::getenv("HOROVOD_LOG_LEVEL");
+    if (!env) return LogLevel::WARN;
+    if (!strcasecmp(env, "trace")) return LogLevel::TRACE;
+    if (!strcasecmp(env, "debug")) return LogLevel::DEBUG;
+    if (!strcasecmp(env, "info")) return LogLevel::INFO;
+    if (!strcasecmp(env, "warning") || !strcasecmp(env, "warn"))
+      return LogLevel::WARN;
+    if (!strcasecmp(env, "error")) return LogLevel::ERROR;
+    if (!strcasecmp(env, "none")) return LogLevel::NONE;
+    return LogLevel::WARN;
+  }();
+  return level;
+}
+
+inline void LogWrite(LogLevel lvl, const char* tag, const char* fmt, ...) {
+  if ((int)lvl < (int)GlobalLogLevel()) return;
+  char msg[2048];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(msg, sizeof(msg), fmt, ap);
+  va_end(ap);
+  const char* ts_env = std::getenv("HOROVOD_LOG_TIMESTAMP");
+  if (ts_env && ts_env[0] == '1') {
+    time_t t = time(nullptr);
+    struct tm tmv;
+    localtime_r(&t, &tmv);
+    char ts[32];
+    strftime(ts, sizeof(ts), "%F %T", &tmv);
+    fprintf(stderr, "[%s] [hvdtpu %s] %s\n", ts, tag, msg);
+  } else {
+    fprintf(stderr, "[hvdtpu %s] %s\n", tag, msg);
+  }
+}
+
+#define LOG_TRACE(...) ::hvdtpu::LogWrite(::hvdtpu::LogLevel::TRACE, "TRACE", __VA_ARGS__)
+#define LOG_DEBUG(...) ::hvdtpu::LogWrite(::hvdtpu::LogLevel::DEBUG, "DEBUG", __VA_ARGS__)
+#define LOG_INFO(...) ::hvdtpu::LogWrite(::hvdtpu::LogLevel::INFO, "INFO", __VA_ARGS__)
+#define LOG_WARN(...) ::hvdtpu::LogWrite(::hvdtpu::LogLevel::WARN, "WARN", __VA_ARGS__)
+#define LOG_ERROR(...) ::hvdtpu::LogWrite(::hvdtpu::LogLevel::ERROR, "ERROR", __VA_ARGS__)
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_LOGGING_H

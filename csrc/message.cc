@@ -1,0 +1,193 @@
+#include "message.h"
+
+#include <cstring>
+
+namespace hvdtpu {
+
+const char* RequestTypeName(RequestType t) {
+  switch (t) {
+    case RequestType::ALLREDUCE: return "ALLREDUCE";
+    case RequestType::ALLGATHER: return "ALLGATHER";
+    case RequestType::BROADCAST: return "BROADCAST";
+    case RequestType::ALLTOALL: return "ALLTOALL";
+    case RequestType::REDUCESCATTER: return "REDUCESCATTER";
+    case RequestType::JOIN: return "JOIN";
+    case RequestType::BARRIER: return "BARRIER";
+  }
+  return "UNKNOWN";
+}
+
+namespace {
+
+// Minimal binary writer/reader (little-endian host assumed; all ranks run the
+// same architecture, matching the reference's same-arch custom format).
+class Writer {
+ public:
+  template <typename T>
+  void Put(T v) {
+    size_t off = buf_.size();
+    buf_.resize(off + sizeof(T));
+    std::memcpy(buf_.data() + off, &v, sizeof(T));
+  }
+  void PutString(const std::string& s) {
+    Put<uint32_t>((uint32_t)s.size());
+    buf_.append(s);
+  }
+  void PutI64Vec(const std::vector<int64_t>& v) {
+    Put<uint32_t>((uint32_t)v.size());
+    for (int64_t x : v) Put<int64_t>(x);
+  }
+  std::string Take() { return std::move(buf_); }
+
+ private:
+  std::string buf_;
+};
+
+class Reader {
+ public:
+  explicit Reader(const std::string& buf) : buf_(buf) {}
+  template <typename T>
+  bool Get(T* v) {
+    if (pos_ + sizeof(T) > buf_.size()) return false;
+    std::memcpy(v, buf_.data() + pos_, sizeof(T));
+    pos_ += sizeof(T);
+    return true;
+  }
+  bool GetString(std::string* s) {
+    uint32_t n;
+    if (!Get(&n) || pos_ + n > buf_.size()) return false;
+    s->assign(buf_.data() + pos_, n);
+    pos_ += n;
+    return true;
+  }
+  bool GetI64Vec(std::vector<int64_t>* v) {
+    uint32_t n;
+    if (!Get(&n)) return false;
+    v->resize(n);
+    for (uint32_t i = 0; i < n; i++) {
+      if (!Get(&(*v)[i])) return false;
+    }
+    return true;
+  }
+
+ private:
+  const std::string& buf_;
+  size_t pos_ = 0;
+};
+
+void WriteRequest(Writer& w, const Request& r) {
+  w.Put<int32_t>(r.request_rank);
+  w.Put<int32_t>((int32_t)r.request_type);
+  w.Put<int32_t>((int32_t)r.tensor_type);
+  w.PutString(r.tensor_name);
+  w.Put<int32_t>(r.root_rank);
+  w.Put<int32_t>((int32_t)r.reduce_op);
+  w.Put<double>(r.prescale_factor);
+  w.Put<double>(r.postscale_factor);
+  w.PutI64Vec(r.tensor_shape);
+  w.Put<int32_t>(r.process_set_id);
+  w.Put<int32_t>(r.group_id);
+  w.PutI64Vec(r.splits);
+}
+
+bool ReadRequest(Reader& rd, Request* r) {
+  int32_t t = 0;
+  bool ok = rd.Get(&r->request_rank);
+  ok = ok && rd.Get(&t);
+  r->request_type = (RequestType)t;
+  ok = ok && rd.Get(&t);
+  r->tensor_type = (DataType)t;
+  ok = ok && rd.GetString(&r->tensor_name);
+  ok = ok && rd.Get(&r->root_rank);
+  ok = ok && rd.Get(&t);
+  r->reduce_op = (ReduceOp)t;
+  ok = ok && rd.Get(&r->prescale_factor);
+  ok = ok && rd.Get(&r->postscale_factor);
+  ok = ok && rd.GetI64Vec(&r->tensor_shape);
+  ok = ok && rd.Get(&r->process_set_id);
+  ok = ok && rd.Get(&r->group_id);
+  ok = ok && rd.GetI64Vec(&r->splits);
+  return ok;
+}
+
+void WriteResponse(Writer& w, const Response& r) {
+  w.Put<int32_t>((int32_t)r.response_type);
+  w.Put<uint32_t>((uint32_t)r.tensor_names.size());
+  for (auto& n : r.tensor_names) w.PutString(n);
+  w.PutString(r.error_message);
+  w.Put<int32_t>((int32_t)r.tensor_type);
+  w.PutI64Vec(r.tensor_sizes);
+  w.Put<int32_t>(r.last_joined_rank);
+}
+
+bool ReadResponse(Reader& rd, Response* r) {
+  int32_t t = 0;
+  bool ok = rd.Get(&t);
+  r->response_type = (Response::ResponseType)t;
+  uint32_t n = 0;
+  ok = ok && rd.Get(&n);
+  r->tensor_names.resize(n);
+  for (uint32_t i = 0; ok && i < n; i++) ok = rd.GetString(&r->tensor_names[i]);
+  ok = ok && rd.GetString(&r->error_message);
+  ok = ok && rd.Get(&t);
+  r->tensor_type = (DataType)t;
+  ok = ok && rd.GetI64Vec(&r->tensor_sizes);
+  ok = ok && rd.Get(&r->last_joined_rank);
+  return ok;
+}
+
+}  // namespace
+
+std::string SerializeRequestList(const RequestList& list) {
+  Writer w;
+  w.Put<uint8_t>(list.shutdown ? 1 : 0);
+  w.PutI64Vec(list.cache_hits);
+  w.Put<uint32_t>((uint32_t)list.requests.size());
+  for (auto& r : list.requests) WriteRequest(w, r);
+  return w.Take();
+}
+
+Status ParseRequestList(const std::string& buf, RequestList* list) {
+  Reader rd(buf);
+  uint8_t shutdown;
+  if (!rd.Get(&shutdown)) return Status::Error("truncated RequestList");
+  list->shutdown = shutdown != 0;
+  if (!rd.GetI64Vec(&list->cache_hits)) {
+    return Status::Error("truncated RequestList");
+  }
+  uint32_t n;
+  if (!rd.Get(&n)) return Status::Error("truncated RequestList");
+  list->requests.resize(n);
+  for (uint32_t i = 0; i < n; i++) {
+    if (!ReadRequest(rd, &list->requests[i])) {
+      return Status::Error("truncated Request");
+    }
+  }
+  return Status::OK();
+}
+
+std::string SerializeResponseList(const ResponseList& list) {
+  Writer w;
+  w.Put<uint8_t>(list.shutdown ? 1 : 0);
+  w.Put<uint32_t>((uint32_t)list.responses.size());
+  for (auto& r : list.responses) WriteResponse(w, r);
+  return w.Take();
+}
+
+Status ParseResponseList(const std::string& buf, ResponseList* list) {
+  Reader rd(buf);
+  uint8_t shutdown;
+  if (!rd.Get(&shutdown)) return Status::Error("truncated ResponseList");
+  list->shutdown = shutdown != 0;
+  uint32_t n;
+  if (!rd.Get(&n)) return Status::Error("truncated ResponseList");
+  list->responses.resize(n);
+  for (uint32_t i = 0; i < n; i++) {
+    if (!ReadResponse(rd, &list->responses[i])) {
+      return Status::Error("truncated Response");
+    }
+  }
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,94 @@
+// Negotiation wire protocol: Request / Response (+ lists) and their binary
+// serialization.
+// Reference analog: horovod/common/message.h (Request, Response,
+// RequestList, ResponseList, SerializeToString/ParseFromBytes). Rebuilt with
+// a simple custom LE binary format (the reference dropped flatbuffers for a
+// custom format too).
+
+#ifndef HVDTPU_MESSAGE_H
+#define HVDTPU_MESSAGE_H
+
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+enum class RequestType : int32_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  REDUCESCATTER = 4,
+  JOIN = 5,
+  BARRIER = 6,
+};
+
+const char* RequestTypeName(RequestType t);
+
+// One rank announcing one tensor is ready.
+struct Request {
+  int32_t request_rank = 0;
+  RequestType request_type = RequestType::ALLREDUCE;
+  DataType tensor_type = DataType::HVDTPU_FLOAT32;
+  std::string tensor_name;
+  int32_t root_rank = 0;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  std::vector<int64_t> tensor_shape;
+  int32_t process_set_id = 0;
+  int32_t group_id = -1;  // grouped allreduce: negotiate atomically
+  std::vector<int64_t> splits;  // alltoall send splits
+};
+
+// Coordinator verdict: a (possibly fused) set of tensors to execute, or an
+// error.
+struct Response {
+  enum class ResponseType : int32_t {
+    ALLREDUCE = 0,
+    ALLGATHER = 1,
+    BROADCAST = 2,
+    ALLTOALL = 3,
+    REDUCESCATTER = 4,
+    JOIN = 5,
+    BARRIER = 6,
+    ERROR = 7,
+  };
+  ResponseType response_type = ResponseType::ALLREDUCE;
+  std::vector<std::string> tensor_names;  // >1 => fused
+  std::string error_message;
+  DataType tensor_type = DataType::HVDTPU_FLOAT32;
+  // Allgather/reducescatter: per tensor, per rank first-dimension sizes, laid
+  // out [tensor0_rank0, tensor0_rank1, ..., tensor1_rank0, ...].
+  std::vector<int64_t> tensor_sizes;
+  // Alltoall: recv splits for this... (rank-specific data goes via exchange);
+  // kept empty in broadcasted responses.
+  int32_t last_joined_rank = -1;
+};
+
+// Everything one worker sends the coordinator in one cycle.
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+  // Response-cache bitvector: positions (in the rank's cache order) of
+  // cache-hit tensors ready this cycle. Reference analog:
+  // horovod/common/response_cache.cc CacheCoordinator bit vectors.
+  std::vector<int64_t> cache_hits;
+};
+
+// Everything the coordinator broadcasts back in one cycle.
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+};
+
+std::string SerializeRequestList(const RequestList& list);
+Status ParseRequestList(const std::string& buf, RequestList* list);
+std::string SerializeResponseList(const ResponseList& list);
+Status ParseResponseList(const std::string& buf, ResponseList* list);
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_MESSAGE_H

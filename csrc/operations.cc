@@ -1,0 +1,684 @@
+// Global state + background coordination loop + C API implementation.
+// Reference analog: horovod/common/operations.cc (InitializeHorovodOnce,
+// BackgroundThreadLoop, RunLoopOnce, EnqueueTensorAllreduce, horovod_init,
+// ...) and horovod/common/global_state.h (HorovodGlobalState).
+
+#include "operations.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "controller.h"
+#include "logging.h"
+#include "message.h"
+#include "ring_ops.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+#include "wire.h"
+
+namespace hvdtpu {
+namespace {
+
+int64_t EnvInt64(const char* name, int64_t dflt) {
+  const char* v = std::getenv(name);
+  return v ? strtoll(v, nullptr, 10) : dflt;
+}
+
+double EnvDouble(const char* name, double dflt) {
+  const char* v = std::getenv(name);
+  return v ? strtod(v, nullptr) : dflt;
+}
+
+std::string EnvStr(const char* name, const std::string& dflt) {
+  const char* v = std::getenv(name);
+  return v ? std::string(v) : dflt;
+}
+
+// Completed-op records, polled from Python by integer handle.
+// Reference analog: horovod/torch/handle_manager.cc.
+class HandleManager {
+ public:
+  int Allocate() {
+    std::lock_guard<std::mutex> lk(mutex_);
+    int h = next_++;
+    records_[h];  // default: in-flight
+    return h;
+  }
+  void MarkDone(int handle, const Status& status, TensorTableEntry* entry) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    auto it = records_.find(handle);
+    if (it == records_.end()) return;
+    it->second.done = true;
+    it->second.status = status;
+    if (entry != nullptr) {
+      it->second.managed_output = std::move(entry->managed_output);
+      it->second.output_shape = std::move(entry->output_shape);
+    }
+    cv_.notify_all();
+  }
+  bool Poll(int handle, bool* done) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    auto it = records_.find(handle);
+    if (it == records_.end()) return false;
+    *done = it->second.done;
+    return true;
+  }
+  bool Wait(int handle, Status* status) {
+    std::unique_lock<std::mutex> lk(mutex_);
+    auto it = records_.find(handle);
+    if (it == records_.end()) return false;
+    // Pointer, not iterator: unordered_map rehash (concurrent Allocate)
+    // invalidates iterators but element addresses are stable.
+    Record* rec = &it->second;
+    cv_.wait(lk, [rec] { return rec->done; });
+    *status = rec->status;
+    return true;
+  }
+  struct Record {
+    bool done = false;
+    Status status;
+    std::vector<uint8_t> managed_output;
+    std::vector<int64_t> output_shape;
+  };
+  Record* GetLocked(int handle) {  // caller must hold lock via WithRecord
+    auto it = records_.find(handle);
+    return it == records_.end() ? nullptr : &it->second;
+  }
+  template <typename F>
+  auto WithRecord(int handle, F&& f) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    return f(GetLocked(handle));
+  }
+  void Release(int handle) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    records_.erase(handle);
+  }
+
+ private:
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::unordered_map<int, Record> records_;
+  int next_ = 0;
+};
+
+struct GlobalState {
+  std::unique_ptr<Controller> controller;
+  TensorQueue tensor_queue;
+  HandleManager handles;
+  Timeline timeline;
+  std::thread background_thread;
+  std::atomic<bool> initialized{false};
+  std::atomic<bool> shutdown_requested{false};
+  std::atomic<bool> loop_exited{false};
+  int rank = 0, size = 1, local_rank = 0, local_size = 1;
+  int cross_rank = 0, cross_size = 1;
+  std::atomic<int64_t> fusion_threshold{64 * 1024 * 1024};
+  std::atomic<double> cycle_time_ms{1.0};
+  std::vector<uint8_t> fusion_buffer;  // reference: fusion_buffer_manager.cc
+};
+
+GlobalState* g_state = nullptr;
+std::mutex g_init_mutex;
+
+DataType ToDataType(int dtype) { return (DataType)dtype; }
+
+void ApplyPostOp(TensorTableEntry& e, void* buf, int64_t count, int size) {
+  double post = e.postscale_factor;
+  if (e.reduce_op == ReduceOp::AVERAGE) post /= (double)size;
+  ScaleBuffer(buf, count, e.dtype, post);
+}
+
+Status ExecuteAllreduce(GlobalState& st, std::vector<TensorTableEntry>& entries) {
+  auto* dp = st.controller->data_plane();
+  if (entries.size() == 1) {
+    auto& e = entries[0];
+    if (e.output != e.input) {
+      std::memcpy(e.output, e.input, (size_t)e.SizeBytes());
+    }
+    ScaleBuffer(e.output, e.NumElements(), e.dtype, e.prescale_factor);
+    st.timeline.ActivityStart(e.name, "RING_ALLREDUCE");
+    Status s = dp->Allreduce(e.output, e.NumElements(), e.dtype, e.reduce_op);
+    st.timeline.ActivityEnd(e.name);
+    if (!s.ok()) return s;
+    ApplyPostOp(e, e.output, e.NumElements(), st.size);
+    return Status::OK();
+  }
+  // Fused path: pack into the fusion buffer, one ring allreduce, unpack.
+  // Reference analog: MemcpyInFusionBuffer / MemcpyOutFusionBuffer
+  // (ops/collective_operations.cc); on GPU a batched CUDA kernel, here memcpy.
+  int64_t total = 0;
+  for (auto& e : entries) total += e.SizeBytes();
+  if ((int64_t)st.fusion_buffer.size() < total) st.fusion_buffer.resize(total);
+  uint8_t* base = st.fusion_buffer.data();
+  int64_t off = 0;
+  for (auto& e : entries) {
+    st.timeline.ActivityStart(e.name, "MEMCPY_IN_FUSION_BUFFER");
+    std::memcpy(base + off, e.input, (size_t)e.SizeBytes());
+    ScaleBuffer(base + off, e.NumElements(), e.dtype, e.prescale_factor);
+    st.timeline.ActivityEnd(e.name);
+    off += e.SizeBytes();
+  }
+  DataType dt = entries[0].dtype;
+  int64_t count = total / DataTypeSize(dt);
+  for (auto& e : entries) st.timeline.ActivityStart(e.name, "RING_ALLREDUCE");
+  Status s = dp->Allreduce(base, count, dt, entries[0].reduce_op);
+  for (auto& e : entries) st.timeline.ActivityEnd(e.name);
+  if (!s.ok()) return s;
+  off = 0;
+  for (auto& e : entries) {
+    st.timeline.ActivityStart(e.name, "MEMCPY_OUT_FUSION_BUFFER");
+    ApplyPostOp(e, base + off, e.NumElements(), st.size);
+    std::memcpy(e.output, base + off, (size_t)e.SizeBytes());
+    st.timeline.ActivityEnd(e.name);
+    off += e.SizeBytes();
+  }
+  return Status::OK();
+}
+
+Status ExecuteEntry(GlobalState& st, const Response& response,
+                    TensorTableEntry& e) {
+  auto* dp = st.controller->data_plane();
+  switch (response.response_type) {
+    case Response::ResponseType::ALLGATHER: {
+      int64_t row_elems = 1;
+      for (size_t i = 1; i < e.shape.size(); i++) row_elems *= e.shape[i];
+      int64_t row_bytes = row_elems * DataTypeSize(e.dtype);
+      std::vector<int64_t> bytes_per_rank(st.size);
+      int64_t total = 0, total_rows = 0;
+      for (int r = 0; r < st.size; r++) {
+        bytes_per_rank[r] = response.tensor_sizes[r] * row_bytes;
+        total += bytes_per_rank[r];
+        total_rows += response.tensor_sizes[r];
+      }
+      e.managed_output.resize((size_t)total);
+      st.timeline.ActivityStart(e.name, "RING_ALLGATHER");
+      Status s = dp->Allgatherv(e.input, e.managed_output.data(),
+                                bytes_per_rank);
+      st.timeline.ActivityEnd(e.name);
+      if (!s.ok()) return s;
+      e.output_shape = e.shape;
+      if (e.output_shape.empty()) {
+        e.output_shape = {total_rows};
+      } else {
+        e.output_shape[0] = total_rows;
+      }
+      return Status::OK();
+    }
+    case Response::ResponseType::BROADCAST: {
+      st.timeline.ActivityStart(e.name, "RING_BCAST");
+      Status s = dp->Broadcast(e.output, e.SizeBytes(), e.root_rank);
+      st.timeline.ActivityEnd(e.name);
+      return s;
+    }
+    case Response::ResponseType::ALLTOALL: {
+      int64_t row_elems = 1;
+      for (size_t i = 1; i < e.shape.size(); i++) row_elems *= e.shape[i];
+      int64_t row_bytes = row_elems * DataTypeSize(e.dtype);
+      std::vector<int64_t> splits = e.splits;
+      if (splits.empty()) {
+        int64_t first = e.shape.empty() ? 0 : e.shape[0];
+        if (first % st.size != 0) {
+          return Status::InvalidArgument(
+              "alltoall without splits requires first dim divisible by size");
+        }
+        splits.assign(st.size, first / st.size);
+      }
+      // Exchange splits so each rank learns its receive layout.
+      // Reference analog: alltoall recvsplits exchange in the op layer.
+      std::vector<int64_t> ones(st.size, sizeof(int64_t));
+      e.recv_splits.assign(st.size, 0);
+      Status s = dp->Alltoallv(splits.data(), ones, e.recv_splits.data(), ones);
+      if (!s.ok()) return s;
+      std::vector<int64_t> send_bytes(st.size), recv_bytes(st.size);
+      int64_t total_recv_rows = 0, total_recv_bytes = 0;
+      for (int r = 0; r < st.size; r++) {
+        send_bytes[r] = splits[r] * row_bytes;
+        recv_bytes[r] = e.recv_splits[r] * row_bytes;
+        total_recv_rows += e.recv_splits[r];
+        total_recv_bytes += recv_bytes[r];
+      }
+      e.managed_output.resize((size_t)total_recv_bytes);
+      st.timeline.ActivityStart(e.name, "ALLTOALL");
+      s = dp->Alltoallv(e.input, send_bytes, e.managed_output.data(),
+                        recv_bytes);
+      st.timeline.ActivityEnd(e.name);
+      if (!s.ok()) return s;
+      e.output_shape = e.shape;
+      if (e.output_shape.empty()) {
+        e.output_shape = {total_recv_rows};
+      } else {
+        e.output_shape[0] = total_recv_rows;
+      }
+      return Status::OK();
+    }
+    case Response::ResponseType::REDUCESCATTER: {
+      // First dim split as evenly as possible, remainder to lower ranks.
+      // Reference analog: horovod reducescatter semantics.
+      int64_t first = e.shape.empty() ? 1 : e.shape[0];
+      int64_t row_elems = 1;
+      for (size_t i = 1; i < e.shape.size(); i++) row_elems *= e.shape[i];
+      std::vector<int64_t> elems_per_rank(st.size);
+      int64_t q = first / st.size, rem = first % st.size;
+      std::vector<int64_t> rows(st.size);
+      for (int r = 0; r < st.size; r++) {
+        rows[r] = q + (r < rem ? 1 : 0);
+        elems_per_rank[r] = rows[r] * row_elems;
+      }
+      e.managed_output.resize(
+          (size_t)(elems_per_rank[st.rank] * DataTypeSize(e.dtype)));
+      // Prescale on a copy to keep caller input pristine.
+      std::vector<uint8_t> scaled;
+      const void* in = e.input;
+      if (e.prescale_factor != 1.0) {
+        scaled.assign((const uint8_t*)e.input,
+                      (const uint8_t*)e.input + e.SizeBytes());
+        ScaleBuffer(scaled.data(), e.NumElements(), e.dtype,
+                    e.prescale_factor);
+        in = scaled.data();
+      }
+      st.timeline.ActivityStart(e.name, "RING_REDUCESCATTER");
+      Status s = dp->ReduceScatterv(in, e.managed_output.data(),
+                                    elems_per_rank, e.dtype, e.reduce_op);
+      st.timeline.ActivityEnd(e.name);
+      if (!s.ok()) return s;
+      ApplyPostOp(e, e.managed_output.data(), elems_per_rank[st.rank],
+                  st.size);
+      e.output_shape = e.shape;
+      if (e.output_shape.empty()) {
+        e.output_shape = {rows[st.rank]};
+      } else {
+        e.output_shape[0] = rows[st.rank];
+      }
+      return Status::OK();
+    }
+    case Response::ResponseType::BARRIER:
+      return dp->Barrier();
+    default:
+      return Status::Error("unsupported response type");
+  }
+}
+
+void ExecuteResponse(GlobalState& st, const Response& response) {
+  auto entries = st.tensor_queue.GetTensorEntriesFromResponse(response);
+  Status status = Status::OK();
+  if (response.response_type == Response::ResponseType::ERROR) {
+    status = Status::PreconditionError(response.error_message);
+  } else if (response.response_type == Response::ResponseType::ALLREDUCE) {
+    status = ExecuteAllreduce(st, entries);
+  } else {
+    for (auto& e : entries) {
+      status = ExecuteEntry(st, response, e);
+      if (!status.ok()) break;
+    }
+  }
+  for (auto& e : entries) {
+    st.timeline.EntryDone(e.name);
+    st.handles.MarkDone(e.handle, status, &e);
+  }
+}
+
+void BackgroundThreadLoop(GlobalState& st) {
+  // Reference analog: operations.cc BackgroundThreadLoop / RunLoopOnce —
+  // one coordination thread per process; each cycle drains the queue,
+  // negotiates, executes, and sleeps out the remainder of the cycle time.
+  while (true) {
+    auto cycle_start = std::chrono::steady_clock::now();
+    std::vector<Request> requests = st.tensor_queue.PopMessages();
+    for (auto& r : requests) st.timeline.NegotiateStart(r.tensor_name);
+    ResponseList response_list;
+    Status s = st.controller->ComputeResponseList(
+        std::move(requests), st.shutdown_requested.load(), &response_list);
+    if (!s.ok()) {
+      LOG_ERROR("control plane failure: %s", s.reason().c_str());
+      auto orphans = st.tensor_queue.RemoveAllEntries();
+      for (auto& e : orphans) st.handles.MarkDone(e.handle, s, nullptr);
+      break;
+    }
+    for (auto& response : response_list.responses) {
+      for (auto& n : response.tensor_names) st.timeline.NegotiateEnd(n);
+      ExecuteResponse(st, response);
+    }
+    if (response_list.shutdown) break;
+    auto elapsed = std::chrono::steady_clock::now() - cycle_start;
+    auto cycle =
+        std::chrono::duration<double, std::milli>(st.cycle_time_ms.load());
+    if (elapsed < cycle) {
+      std::this_thread::sleep_for(cycle - elapsed);
+    }
+  }
+  // Fail anything still pending.
+  auto orphans = st.tensor_queue.RemoveAllEntries();
+  for (auto& e : orphans) {
+    st.handles.MarkDone(e.handle, Status::Aborted("Horovod is shut down"),
+                        nullptr);
+  }
+  st.loop_exited = true;
+}
+
+int EnqueueEntry(TensorTableEntry entry, Request message) {
+  GlobalState& st = *g_state;
+  if (!st.initialized.load() || st.loop_exited.load()) return -1;
+  int handle = st.handles.Allocate();
+  entry.handle = handle;
+  message.request_rank = st.rank;
+  st.timeline.EntryQueued(entry.name);
+  Status s = st.tensor_queue.AddToTensorQueue(std::move(entry),
+                                              std::move(message));
+  if (!s.ok()) {
+    st.handles.MarkDone(handle, s, nullptr);
+  }
+  return handle;
+}
+
+}  // namespace
+}  // namespace hvdtpu
+
+using namespace hvdtpu;
+
+extern "C" {
+
+int hvdtpu_init() {
+  std::lock_guard<std::mutex> lk(g_init_mutex);
+  if (g_state && g_state->initialized.load()) return 0;
+  // Allocated once and never freed: API threads may still be inside blocking
+  // calls (hvdtpu_wait releases the GIL) when shutdown runs, so the state
+  // object must outlive them. Re-init (elastic reset) reuses it.
+  if (g_state == nullptr) g_state = new GlobalState();
+  GlobalState* st = g_state;
+  st->shutdown_requested = false;
+  st->loop_exited = false;
+  st->rank = (int)EnvInt64("HOROVOD_RANK", 0);
+  st->size = (int)EnvInt64("HOROVOD_SIZE", 1);
+  st->local_rank = (int)EnvInt64("HOROVOD_LOCAL_RANK", st->rank);
+  st->local_size = (int)EnvInt64("HOROVOD_LOCAL_SIZE", st->size);
+  st->cross_rank = (int)EnvInt64("HOROVOD_CROSS_RANK", 0);
+  st->cross_size = (int)EnvInt64("HOROVOD_CROSS_SIZE", 1);
+  st->fusion_threshold =
+      EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  st->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
+
+  ControllerConfig cfg;
+  cfg.rank = st->rank;
+  cfg.size = st->size;
+  cfg.controller_addr = EnvStr("HOROVOD_CONTROLLER_ADDR", "127.0.0.1");
+  cfg.controller_port = (int)EnvInt64("HOROVOD_CONTROLLER_PORT", 29500);
+  cfg.fusion_threshold_bytes = st->fusion_threshold;
+  cfg.stall_warning_secs = EnvDouble("HOROVOD_STALL_CHECK_TIME", 60.0);
+  cfg.stall_check_enabled =
+      EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) == 0;
+  st->controller = std::make_unique<Controller>(cfg);
+  Status s = st->controller->Initialize();
+  if (!s.ok()) {
+    LOG_ERROR("init failed: %s", s.reason().c_str());
+    st->controller.reset();
+    return -1;
+  }
+  std::string timeline_path = EnvStr("HOROVOD_TIMELINE", "");
+  if (!timeline_path.empty()) {
+    st->timeline.Initialize(timeline_path, st->rank);
+  }
+  st->initialized = true;
+  st->background_thread = std::thread(BackgroundThreadLoop, std::ref(*st));
+  LOG_INFO("initialized rank %d/%d", st->rank, st->size);
+  return 0;
+}
+
+int hvdtpu_shutdown() {
+  std::lock_guard<std::mutex> lk(g_init_mutex);
+  if (!g_state || !g_state->initialized.load()) return 0;
+  g_state->shutdown_requested = true;
+  if (g_state->background_thread.joinable()) {
+    g_state->background_thread.join();
+  }
+  g_state->timeline.Shutdown();
+  g_state->controller.reset();  // closes control/data sockets
+  g_state->initialized = false;
+  return 0;
+}
+
+int hvdtpu_is_initialized() {
+  return g_state && g_state->initialized.load() ? 1 : 0;
+}
+
+#define CHECK_INIT(ret) \
+  if (!g_state || !g_state->initialized.load()) return ret;
+
+int hvdtpu_rank() { CHECK_INIT(-1) return g_state->rank; }
+int hvdtpu_size() { CHECK_INIT(-1) return g_state->size; }
+int hvdtpu_local_rank() { CHECK_INIT(-1) return g_state->local_rank; }
+int hvdtpu_local_size() { CHECK_INIT(-1) return g_state->local_size; }
+int hvdtpu_cross_rank() { CHECK_INIT(-1) return g_state->cross_rank; }
+int hvdtpu_cross_size() { CHECK_INIT(-1) return g_state->cross_size; }
+
+int hvdtpu_enqueue_allreduce(const char* name, const void* input, void* output,
+                             int ndim, const int64_t* shape, int dtype,
+                             int reduce_op, double prescale, double postscale,
+                             int process_set_id) {
+  CHECK_INIT(-1)
+  TensorTableEntry e;
+  e.name = name;
+  e.input = input;
+  e.output = output;
+  e.shape.assign(shape, shape + ndim);
+  e.dtype = ToDataType(dtype);
+  e.reduce_op = (ReduceOp)reduce_op;
+  e.prescale_factor = prescale;
+  e.postscale_factor = postscale;
+  e.process_set_id = process_set_id;
+  Request m;
+  m.request_type = RequestType::ALLREDUCE;
+  m.tensor_name = e.name;
+  m.tensor_type = e.dtype;
+  m.tensor_shape = e.shape;
+  m.reduce_op = e.reduce_op;
+  m.prescale_factor = prescale;
+  m.postscale_factor = postscale;
+  m.process_set_id = process_set_id;
+  return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_enqueue_grouped_allreduce(int num_tensors, const char** names,
+                                     const void** inputs, void** outputs,
+                                     const int* ndims, const int64_t** shapes,
+                                     int dtype, int reduce_op, double prescale,
+                                     double postscale, int process_set_id,
+                                     int* handles_out) {
+  CHECK_INIT(-1)
+  // v1: grouped == individual enqueues (they fuse in negotiation anyway).
+  // Reference analog: group_table.cc enforces atomic negotiation; the
+  // controller-side group barrier lands with the response cache milestone.
+  for (int i = 0; i < num_tensors; i++) {
+    handles_out[i] = hvdtpu_enqueue_allreduce(
+        names[i], inputs[i], outputs[i], ndims[i], shapes[i], dtype, reduce_op,
+        prescale, postscale, process_set_id);
+    if (handles_out[i] < 0) return -1;
+  }
+  return 0;
+}
+
+int hvdtpu_enqueue_allgather(const char* name, const void* input, int ndim,
+                             const int64_t* shape, int dtype,
+                             int process_set_id) {
+  CHECK_INIT(-1)
+  TensorTableEntry e;
+  e.name = name;
+  e.input = input;
+  e.shape.assign(shape, shape + ndim);
+  e.dtype = ToDataType(dtype);
+  e.process_set_id = process_set_id;
+  Request m;
+  m.request_type = RequestType::ALLGATHER;
+  m.tensor_name = e.name;
+  m.tensor_type = e.dtype;
+  m.tensor_shape = e.shape;
+  m.process_set_id = process_set_id;
+  return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_enqueue_broadcast(const char* name, void* buffer, int ndim,
+                             const int64_t* shape, int dtype, int root_rank,
+                             int process_set_id) {
+  CHECK_INIT(-1)
+  TensorTableEntry e;
+  e.name = name;
+  e.input = buffer;
+  e.output = buffer;  // in-place
+  e.shape.assign(shape, shape + ndim);
+  e.dtype = ToDataType(dtype);
+  e.root_rank = root_rank;
+  e.process_set_id = process_set_id;
+  Request m;
+  m.request_type = RequestType::BROADCAST;
+  m.tensor_name = e.name;
+  m.tensor_type = e.dtype;
+  m.tensor_shape = e.shape;
+  m.root_rank = root_rank;
+  m.process_set_id = process_set_id;
+  return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_enqueue_alltoall(const char* name, const void* input, int ndim,
+                            const int64_t* shape, int dtype,
+                            const int64_t* splits, int process_set_id) {
+  CHECK_INIT(-1)
+  TensorTableEntry e;
+  e.name = name;
+  e.input = input;
+  e.shape.assign(shape, shape + ndim);
+  e.dtype = ToDataType(dtype);
+  e.process_set_id = process_set_id;
+  if (splits != nullptr) {
+    e.splits.assign(splits, splits + g_state->size);
+  }
+  Request m;
+  m.request_type = RequestType::ALLTOALL;
+  m.tensor_name = e.name;
+  m.tensor_type = e.dtype;
+  m.tensor_shape = e.shape;
+  m.splits = e.splits;
+  m.process_set_id = process_set_id;
+  return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
+                                 const int64_t* shape, int dtype,
+                                 int reduce_op, double prescale,
+                                 double postscale, int process_set_id) {
+  CHECK_INIT(-1)
+  TensorTableEntry e;
+  e.name = name;
+  e.input = input;
+  e.shape.assign(shape, shape + ndim);
+  e.dtype = ToDataType(dtype);
+  e.reduce_op = (ReduceOp)reduce_op;
+  e.prescale_factor = prescale;
+  e.postscale_factor = postscale;
+  e.process_set_id = process_set_id;
+  Request m;
+  m.request_type = RequestType::REDUCESCATTER;
+  m.tensor_name = e.name;
+  m.tensor_type = e.dtype;
+  m.tensor_shape = e.shape;
+  m.reduce_op = e.reduce_op;
+  m.process_set_id = process_set_id;
+  return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_enqueue_barrier(int process_set_id) {
+  CHECK_INIT(-1)
+  static std::atomic<int64_t> barrier_counter{0};
+  TensorTableEntry e;
+  e.name = "__barrier__." + std::to_string(barrier_counter++);
+  e.process_set_id = process_set_id;
+  Request m;
+  m.request_type = RequestType::BARRIER;
+  m.tensor_name = e.name;
+  m.process_set_id = process_set_id;
+  return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_poll(int handle) {
+  CHECK_INIT(-1)
+  bool done = false;
+  if (!g_state->handles.Poll(handle, &done)) return -1;
+  return done ? 1 : 0;
+}
+
+int hvdtpu_wait(int handle) {
+  CHECK_INIT(-1)
+  Status s;
+  if (!g_state->handles.Wait(handle, &s)) return -1;
+  return s.ok() ? 0 : -(int)s.type();
+}
+
+const char* hvdtpu_error_string(int handle) {
+  CHECK_INIT(nullptr)
+  return g_state->handles.WithRecord(handle, [](auto* rec) -> const char* {
+    if (!rec || rec->status.ok()) return nullptr;
+    return rec->status.reason().c_str();
+  });
+}
+
+int hvdtpu_result_ndim(int handle) {
+  CHECK_INIT(-1)
+  return g_state->handles.WithRecord(handle, [](auto* rec) {
+    return rec ? (int)rec->output_shape.size() : -1;
+  });
+}
+
+int hvdtpu_result_shape(int handle, int64_t* shape_out) {
+  CHECK_INIT(-1)
+  return g_state->handles.WithRecord(handle, [&](auto* rec) {
+    if (!rec) return -1;
+    for (size_t i = 0; i < rec->output_shape.size(); i++) {
+      shape_out[i] = rec->output_shape[i];
+    }
+    return 0;
+  });
+}
+
+int64_t hvdtpu_result_size_bytes(int handle) {
+  CHECK_INIT(-1)
+  return g_state->handles.WithRecord(handle, [](auto* rec) -> int64_t {
+    return rec ? (int64_t)rec->managed_output.size() : -1;
+  });
+}
+
+int hvdtpu_result_copy(int handle, void* dst, int64_t nbytes) {
+  CHECK_INIT(-1)
+  return g_state->handles.WithRecord(handle, [&](auto* rec) {
+    if (!rec || (int64_t)rec->managed_output.size() > nbytes) return -1;
+    std::memcpy(dst, rec->managed_output.data(), rec->managed_output.size());
+    return 0;
+  });
+}
+
+int hvdtpu_release(int handle) {
+  CHECK_INIT(-1)
+  g_state->handles.Release(handle);
+  return 0;
+}
+
+int64_t hvdtpu_fusion_threshold_bytes() {
+  CHECK_INIT(-1)
+  return g_state->fusion_threshold.load();
+}
+
+double hvdtpu_cycle_time_ms() {
+  CHECK_INIT(-1)
+  return g_state->cycle_time_ms.load();
+}
+
+void hvdtpu_set_fusion_threshold_bytes(int64_t v) {
+  if (g_state) g_state->fusion_threshold = v;
+}
+
+void hvdtpu_set_cycle_time_ms(double v) {
+  if (g_state) g_state->cycle_time_ms = v;
+}
+
+}  // extern "C"

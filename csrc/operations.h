@@ -1,0 +1,71 @@
+// Public C API of the native core, loaded from Python via ctypes.
+// Reference analog: horovod/common/operations.h (horovod_init,
+// EnqueueTensorAllreduce, ...) + the torch binding's integer-handle pattern
+// (horovod/torch/handle_manager.h) — chosen here as the universal ABI so no
+// per-framework C extension is needed.
+
+#ifndef HVDTPU_OPERATIONS_H
+#define HVDTPU_OPERATIONS_H
+
+#include <cstdint>
+
+extern "C" {
+
+// Initialization / identity. Reads HOROVOD_RANK/SIZE/... env (set by
+// horovodrun). Returns 0 on success, <0 on failure.
+int hvdtpu_init();
+int hvdtpu_shutdown();
+int hvdtpu_is_initialized();
+int hvdtpu_rank();
+int hvdtpu_size();
+int hvdtpu_local_rank();
+int hvdtpu_local_size();
+int hvdtpu_cross_rank();
+int hvdtpu_cross_size();
+
+// Async collective enqueue: returns a handle (>= 0) or <0 on error.
+// Buffers must stay alive until the handle completes.
+int hvdtpu_enqueue_allreduce(const char* name, const void* input, void* output,
+                             int ndim, const int64_t* shape, int dtype,
+                             int reduce_op, double prescale, double postscale,
+                             int process_set_id);
+int hvdtpu_enqueue_grouped_allreduce(int num_tensors, const char** names,
+                                     const void** inputs, void** outputs,
+                                     const int* ndims, const int64_t** shapes,
+                                     int dtype, int reduce_op, double prescale,
+                                     double postscale, int process_set_id,
+                                     int* handles_out);
+int hvdtpu_enqueue_allgather(const char* name, const void* input, int ndim,
+                             const int64_t* shape, int dtype,
+                             int process_set_id);
+int hvdtpu_enqueue_broadcast(const char* name, void* buffer, int ndim,
+                             const int64_t* shape, int dtype, int root_rank,
+                             int process_set_id);
+int hvdtpu_enqueue_alltoall(const char* name, const void* input, int ndim,
+                            const int64_t* shape, int dtype,
+                            const int64_t* splits, int process_set_id);
+int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
+                                 const int64_t* shape, int dtype,
+                                 int reduce_op, double prescale,
+                                 double postscale, int process_set_id);
+int hvdtpu_enqueue_barrier(int process_set_id);
+
+// Handle API (reference analog: horovod/torch/handle_manager.h).
+int hvdtpu_poll(int handle);                  // 1 done, 0 in flight, <0 bad
+int hvdtpu_wait(int handle);                  // 0 ok, <0 error
+const char* hvdtpu_error_string(int handle);  // valid until release
+// Managed results (allgather/alltoall/reducescatter outputs):
+int hvdtpu_result_ndim(int handle);
+int hvdtpu_result_shape(int handle, int64_t* shape_out);
+int64_t hvdtpu_result_size_bytes(int handle);
+int hvdtpu_result_copy(int handle, void* dst, int64_t nbytes);
+int hvdtpu_release(int handle);
+
+// Runtime knobs (reference: HOROVOD_FUSION_THRESHOLD / HOROVOD_CYCLE_TIME).
+int64_t hvdtpu_fusion_threshold_bytes();
+double hvdtpu_cycle_time_ms();
+void hvdtpu_set_fusion_threshold_bytes(int64_t v);
+void hvdtpu_set_cycle_time_ms(double v);
+}
+
+#endif  // HVDTPU_OPERATIONS_H

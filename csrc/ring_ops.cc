@@ -1,0 +1,332 @@
+#include "ring_ops.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "half.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+namespace {
+
+template <typename T, typename Acc = T>
+void ReduceTyped(T* dst, const T* src, int64_t count, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::AVERAGE:  // accumulate as sum; caller scales
+    case ReduceOp::SUM:
+    case ReduceOp::ADASUM:  // Adasum blending handled above this layer
+      for (int64_t i = 0; i < count; i++) {
+        dst[i] = (T)((Acc)dst[i] + (Acc)src[i]);
+      }
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < count; i++) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < count; i++) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case ReduceOp::PRODUCT:
+      for (int64_t i = 0; i < count; i++) {
+        dst[i] = (T)((Acc)dst[i] * (Acc)src[i]);
+      }
+      break;
+  }
+}
+
+template <uint16_t (*ToBits)(float), float (*FromBits)(uint16_t)>
+void ReduceHalfLike(uint16_t* dst, const uint16_t* src, int64_t count,
+                    ReduceOp op) {
+  for (int64_t i = 0; i < count; i++) {
+    float a = FromBits(dst[i]);
+    float b = FromBits(src[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::MIN: r = std::min(a, b); break;
+      case ReduceOp::MAX: r = std::max(a, b); break;
+      case ReduceOp::PRODUCT: r = a * b; break;
+      default: r = a + b; break;
+    }
+    dst[i] = ToBits(r);
+  }
+}
+
+}  // namespace
+
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dt,
+                ReduceOp op) {
+  switch (dt) {
+    case DataType::HVDTPU_UINT8:
+      ReduceTyped((uint8_t*)dst, (const uint8_t*)src, count, op);
+      break;
+    case DataType::HVDTPU_INT8:
+      ReduceTyped((int8_t*)dst, (const int8_t*)src, count, op);
+      break;
+    case DataType::HVDTPU_INT32:
+      ReduceTyped((int32_t*)dst, (const int32_t*)src, count, op);
+      break;
+    case DataType::HVDTPU_INT64:
+      ReduceTyped((int64_t*)dst, (const int64_t*)src, count, op);
+      break;
+    case DataType::HVDTPU_FLOAT16:
+      ReduceHalfLike<FloatToHalfBits, HalfBitsToFloat>(
+          (uint16_t*)dst, (const uint16_t*)src, count, op);
+      break;
+    case DataType::HVDTPU_BFLOAT16:
+      ReduceHalfLike<FloatToBF16Bits, BF16BitsToFloat>(
+          (uint16_t*)dst, (const uint16_t*)src, count, op);
+      break;
+    case DataType::HVDTPU_FLOAT32:
+      ReduceTyped((float*)dst, (const float*)src, count, op);
+      break;
+    case DataType::HVDTPU_FLOAT64:
+      ReduceTyped((double*)dst, (const double*)src, count, op);
+      break;
+    case DataType::HVDTPU_BOOL: {
+      // bool: SUM/PRODUCT behave as OR/AND (matches logical expectations).
+      auto* d = (uint8_t*)dst;
+      auto* s = (const uint8_t*)src;
+      for (int64_t i = 0; i < count; i++) {
+        switch (op) {
+          case ReduceOp::MIN:
+          case ReduceOp::PRODUCT: d[i] = d[i] && s[i]; break;
+          default: d[i] = d[i] || s[i]; break;
+        }
+      }
+      break;
+    }
+    case DataType::HVDTPU_UINT16:
+      ReduceTyped((uint16_t*)dst, (const uint16_t*)src, count, op);
+      break;
+  }
+}
+
+void ScaleBuffer(void* buf, int64_t count, DataType dt, double factor) {
+  if (factor == 1.0) return;
+  switch (dt) {
+    case DataType::HVDTPU_FLOAT32: {
+      auto* p = (float*)buf;
+      for (int64_t i = 0; i < count; i++) p[i] = (float)(p[i] * factor);
+      break;
+    }
+    case DataType::HVDTPU_FLOAT64: {
+      auto* p = (double*)buf;
+      for (int64_t i = 0; i < count; i++) p[i] *= factor;
+      break;
+    }
+    case DataType::HVDTPU_FLOAT16: {
+      auto* p = (uint16_t*)buf;
+      for (int64_t i = 0; i < count; i++) {
+        p[i] = FloatToHalfBits((float)(HalfBitsToFloat(p[i]) * factor));
+      }
+      break;
+    }
+    case DataType::HVDTPU_BFLOAT16: {
+      auto* p = (uint16_t*)buf;
+      for (int64_t i = 0; i < count; i++) {
+        p[i] = FloatToBF16Bits((float)(BF16BitsToFloat(p[i]) * factor));
+      }
+      break;
+    }
+    case DataType::HVDTPU_INT32: {
+      auto* p = (int32_t*)buf;
+      for (int64_t i = 0; i < count; i++) p[i] = (int32_t)(p[i] * factor);
+      break;
+    }
+    case DataType::HVDTPU_INT64: {
+      auto* p = (int64_t*)buf;
+      for (int64_t i = 0; i < count; i++) p[i] = (int64_t)(p[i] * factor);
+      break;
+    }
+    default:
+      break;  // scaling integral small types is not meaningful
+  }
+}
+
+DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds)
+    : rank_(rank), size_(size), peer_fds_(std::move(peer_fds)) {}
+
+DataPlane::~DataPlane() {
+  for (int fd : peer_fds_) TcpClose(fd);
+}
+
+Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
+                            ReduceOp op) {
+  if (size_ == 1 || count == 0) return Status::OK();
+  const int64_t elem = DataTypeSize(dt);
+  auto* base = (uint8_t*)buf;
+  // Segment the buffer into `size_` near-equal chunks.
+  std::vector<int64_t> seg_count(size_), seg_off(size_);
+  int64_t q = count / size_, r = count % size_, off = 0;
+  for (int i = 0; i < size_; i++) {
+    seg_count[i] = q + (i < r ? 1 : 0);
+    seg_off[i] = off;
+    off += seg_count[i];
+  }
+  int64_t max_seg_bytes = (q + (r ? 1 : 0)) * elem;
+  if ((int64_t)scratch_.size() < max_seg_bytes) scratch_.resize(max_seg_bytes);
+
+  // Phase 1: ring reduce-scatter.
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_seg = (rank_ - step + size_) % size_;
+    int recv_seg = (rank_ - step - 1 + size_) % size_;
+    Status s = DuplexTransfer(
+        right_fd(), base + seg_off[send_seg] * elem, seg_count[send_seg] * elem,
+        left_fd(), scratch_.data(), seg_count[recv_seg] * elem);
+    if (!s.ok()) return s;
+    ReduceInto(base + seg_off[recv_seg] * elem, scratch_.data(),
+               seg_count[recv_seg], dt, op);
+  }
+  // Phase 2: ring allgather of the reduced segments.
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_seg = (rank_ - step + 1 + size_) % size_;
+    int recv_seg = (rank_ - step + size_) % size_;
+    Status s = DuplexTransfer(
+        right_fd(), base + seg_off[send_seg] * elem, seg_count[send_seg] * elem,
+        left_fd(), base + seg_off[recv_seg] * elem, seg_count[recv_seg] * elem);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Allgatherv(const void* input, void* output,
+                             const std::vector<int64_t>& bytes_per_rank) {
+  auto* out = (uint8_t*)output;
+  std::vector<int64_t> offs(size_);
+  int64_t off = 0;
+  for (int i = 0; i < size_; i++) {
+    offs[i] = off;
+    off += bytes_per_rank[i];
+  }
+  std::memcpy(out + offs[rank_], input, (size_t)bytes_per_rank[rank_]);
+  if (size_ == 1) return Status::OK();
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_blk = (rank_ - step + size_) % size_;
+    int recv_blk = (rank_ - step - 1 + size_) % size_;
+    Status s = DuplexTransfer(right_fd(), out + offs[send_blk],
+                              (size_t)bytes_per_rank[send_blk], left_fd(),
+                              out + offs[recv_blk],
+                              (size_t)bytes_per_rank[recv_blk]);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
+  if (size_ == 1 || bytes == 0) return Status::OK();
+  // Pipelined ring from root: each rank receives from the left and forwards
+  // to the right (unless the right neighbor is the root). Chunked so the
+  // pipeline overlaps recv(i) with forward(i-1) via the duplex primitive.
+  const int64_t CHUNK = 1 << 20;
+  auto* base = (uint8_t*)buf;
+  int right = (rank_ + 1) % size_;
+  bool is_root = rank_ == root;
+  bool forwards = !is_root && right != root;
+  int64_t nchunks = (bytes + CHUNK - 1) / CHUNK;
+  auto chunk_span = [&](int64_t i, int64_t* off, int64_t* len) {
+    *off = i * CHUNK;
+    *len = std::min(CHUNK, bytes - *off);
+  };
+  if (is_root) {
+    return SendAll(right_fd(), base, (size_t)bytes);
+  }
+  for (int64_t i = 0; i < nchunks; i++) {
+    int64_t off, len;
+    chunk_span(i, &off, &len);
+    if (forwards && i > 0) {
+      int64_t poff, plen;
+      chunk_span(i - 1, &poff, &plen);
+      Status s = DuplexTransfer(right_fd(), base + poff, (size_t)plen,
+                                left_fd(), base + off, (size_t)len);
+      if (!s.ok()) return s;
+    } else {
+      Status s = RecvAll(left_fd(), base + off, (size_t)len);
+      if (!s.ok()) return s;
+    }
+  }
+  if (forwards) {
+    int64_t off, len;
+    chunk_span(nchunks - 1, &off, &len);
+    return SendAll(right_fd(), base + off, (size_t)len);
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Alltoallv(const void* input,
+                            const std::vector<int64_t>& send_bytes,
+                            void* output,
+                            const std::vector<int64_t>& recv_bytes) {
+  auto* in = (const uint8_t*)input;
+  auto* out = (uint8_t*)output;
+  std::vector<int64_t> send_off(size_), recv_off(size_);
+  int64_t so = 0, ro = 0;
+  for (int i = 0; i < size_; i++) {
+    send_off[i] = so;
+    so += send_bytes[i];
+    recv_off[i] = ro;
+    ro += recv_bytes[i];
+  }
+  std::memcpy(out + recv_off[rank_], in + send_off[rank_],
+              (size_t)send_bytes[rank_]);
+  // Symmetric pairing: in round r, rank i partners with (r - i) mod size —
+  // an involution, so each unordered pair {i, j} exchanges exactly once, in
+  // round (i + j) mod size.
+  for (int round = 0; round < size_; round++) {
+    int partner = (round - rank_ + size_) % size_;
+    if (partner == rank_) continue;
+    int fd = peer_fds_[partner];
+    Status s = DuplexTransfer(fd, in + send_off[partner],
+                              (size_t)send_bytes[partner], fd,
+                              out + recv_off[partner],
+                              (size_t)recv_bytes[partner]);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::ReduceScatterv(const void* input, void* output,
+                                 const std::vector<int64_t>& elems_per_rank,
+                                 DataType dt, ReduceOp op) {
+  const int64_t elem = DataTypeSize(dt);
+  if (size_ == 1) {
+    std::memcpy(output, input, (size_t)(elems_per_rank[0] * elem));
+    return Status::OK();
+  }
+  std::vector<int64_t> seg_off(size_);
+  int64_t off = 0, max_seg = 0;
+  for (int i = 0; i < size_; i++) {
+    seg_off[i] = off;
+    off += elems_per_rank[i];
+    max_seg = std::max(max_seg, elems_per_rank[i]);
+  }
+  // Work in a private copy so the caller's input is untouched.
+  std::vector<uint8_t> work((size_t)(off * elem));
+  std::memcpy(work.data(), input, work.size());
+  if ((int64_t)scratch_.size() < max_seg * elem) {
+    scratch_.resize((size_t)(max_seg * elem));
+  }
+  auto* base = work.data();
+  // Segment rotation offset of -1: after size-1 steps the segment that has
+  // accumulated all `size` contributions at rank r is exactly segment r.
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_seg = (rank_ - step - 1 + 2 * size_) % size_;
+    int recv_seg = (rank_ - step - 2 + 2 * size_) % size_;
+    Status s = DuplexTransfer(
+        right_fd(), base + seg_off[send_seg] * elem,
+        (size_t)(elems_per_rank[send_seg] * elem), left_fd(), scratch_.data(),
+        (size_t)(elems_per_rank[recv_seg] * elem));
+    if (!s.ok()) return s;
+    ReduceInto(base + seg_off[recv_seg] * elem, scratch_.data(),
+               elems_per_rank[recv_seg], dt, op);
+  }
+  std::memcpy(output, base + seg_off[rank_] * elem,
+              (size_t)(elems_per_rank[rank_] * elem));
+  return Status::OK();
+}
+
+Status DataPlane::Barrier() {
+  uint8_t token = 1;
+  return Allreduce(&token, 1, DataType::HVDTPU_UINT8, ReduceOp::SUM);
+}
+
+}  // namespace hvdtpu

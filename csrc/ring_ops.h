@@ -1,0 +1,69 @@
+// Host data plane: bandwidth-optimal ring collectives over TCP.
+// Reference analog: horovod/common/ops/gloo_operations.cc +
+// mpi_operations.cc (the CPU backends) — and the ring-allreduce algorithm of
+// the Horovod paper (arXiv:1802.05799 §3: reduce-scatter + allgather,
+// 2(N-1)/N bandwidth factor). Rebuilt on the wire.h duplex primitive; on TPU
+// the analogous data plane is XLA collectives over ICI (horovod_tpu/parallel).
+
+#ifndef HVDTPU_RING_OPS_H
+#define HVDTPU_RING_OPS_H
+
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// Elementwise dst = dst OP src for `count` elements (host buffers).
+// fp16/bf16 accumulate in fp32 (reference: half.h CPU fp16 math for MPI sum).
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dt,
+                ReduceOp op);
+
+// Multiply `count` elements in-place by `factor` (pre/postscale).
+void ScaleBuffer(void* buf, int64_t count, DataType dt, double factor);
+
+class DataPlane {
+ public:
+  // peer_fds[r] = connected socket to rank r (-1 at index `rank`).
+  DataPlane(int rank, int size, std::vector<int> peer_fds);
+  ~DataPlane();
+
+  // In-place ring allreduce over a contiguous buffer.
+  Status Allreduce(void* buf, int64_t count, DataType dt, ReduceOp op);
+
+  // Variable allgather: rank r contributes bytes_per_rank[r] bytes; output is
+  // the rank-order concatenation on every rank.
+  Status Allgatherv(const void* input, void* output,
+                    const std::vector<int64_t>& bytes_per_rank);
+
+  // Pipelined ring broadcast, in-place.
+  Status Broadcast(void* buf, int64_t bytes, int root);
+
+  // Pairwise-exchange all-to-all with per-rank byte splits.
+  Status Alltoallv(const void* input, const std::vector<int64_t>& send_bytes,
+                   void* output, const std::vector<int64_t>& recv_bytes);
+
+  // Ring reduce-scatter: every rank holds the full `input`; rank r's output
+  // is its reduced segment of elems_per_rank[r] elements.
+  Status ReduceScatterv(const void* input, void* output,
+                        const std::vector<int64_t>& elems_per_rank,
+                        DataType dt, ReduceOp op);
+
+  Status Barrier();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+ private:
+  int rank_;
+  int size_;
+  std::vector<int> peer_fds_;
+  std::vector<uint8_t> scratch_;
+
+  int right_fd() const { return peer_fds_[(rank_ + 1) % size_]; }
+  int left_fd() const { return peer_fds_[(rank_ - 1 + size_) % size_]; }
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_RING_OPS_H

@@ -1,0 +1,54 @@
+#include "tensor_queue.h"
+
+namespace hvdtpu {
+
+Status TensorQueue::AddToTensorQueue(TensorTableEntry entry, Request message) {
+  std::lock_guard<std::mutex> lk(mutex_);
+  if (tensor_table_.count(entry.name)) {
+    return Status::PreconditionError(
+        "Duplicate tensor name in queue: " + entry.name +
+        " (a collective with this name is already in flight)");
+  }
+  tensor_table_.emplace(entry.name, std::move(entry));
+  message_queue_.push_back(std::move(message));
+  return Status::OK();
+}
+
+std::vector<Request> TensorQueue::PopMessages() {
+  std::lock_guard<std::mutex> lk(mutex_);
+  std::vector<Request> out(message_queue_.begin(), message_queue_.end());
+  message_queue_.clear();
+  return out;
+}
+
+std::vector<TensorTableEntry> TensorQueue::GetTensorEntriesFromResponse(
+    const Response& response) {
+  std::lock_guard<std::mutex> lk(mutex_);
+  std::vector<TensorTableEntry> entries;
+  entries.reserve(response.tensor_names.size());
+  for (auto& name : response.tensor_names) {
+    auto it = tensor_table_.find(name);
+    if (it != tensor_table_.end()) {
+      entries.push_back(std::move(it->second));
+      tensor_table_.erase(it);
+    }
+  }
+  return entries;
+}
+
+std::vector<TensorTableEntry> TensorQueue::RemoveAllEntries() {
+  std::lock_guard<std::mutex> lk(mutex_);
+  std::vector<TensorTableEntry> entries;
+  entries.reserve(tensor_table_.size());
+  for (auto& kv : tensor_table_) entries.push_back(std::move(kv.second));
+  tensor_table_.clear();
+  message_queue_.clear();
+  return entries;
+}
+
+size_t TensorQueue::Size() {
+  std::lock_guard<std::mutex> lk(mutex_);
+  return tensor_table_.size();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,45 @@
+// Thread-safe pending-tensor table between the enqueue API and the
+// background coordination thread.
+// Reference analog: horovod/common/tensor_queue.h (TensorQueue,
+// AddToTensorQueue, GetTensorEntriesFromResponse).
+
+#ifndef HVDTPU_TENSOR_QUEUE_H
+#define HVDTPU_TENSOR_QUEUE_H
+
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+
+#include "common.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+class TensorQueue {
+ public:
+  // Returns PRECONDITION_ERROR if a tensor of the same name is already
+  // pending (names must be unique among in-flight ops, as in the reference).
+  Status AddToTensorQueue(TensorTableEntry entry, Request message);
+
+  // Drain all requests queued since the last cycle.
+  std::vector<Request> PopMessages();
+
+  // Remove + return the entries named in a response (they are about to
+  // execute).
+  std::vector<TensorTableEntry> GetTensorEntriesFromResponse(
+      const Response& response);
+
+  // Abort every pending entry with `status` (elastic reset / shutdown).
+  std::vector<TensorTableEntry> RemoveAllEntries();
+
+  size_t Size();
+
+ private:
+  std::mutex mutex_;
+  std::unordered_map<std::string, TensorTableEntry> tensor_table_;
+  std::deque<Request> message_queue_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_TENSOR_QUEUE_H

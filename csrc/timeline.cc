@@ -1,0 +1,109 @@
+#include "timeline.h"
+
+#include <chrono>
+#include <cstdio>
+
+namespace hvdtpu {
+
+namespace {
+int64_t NowMicros() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Escape a string for embedding in JSON.
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(c);
+    } else if ((unsigned char)c < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out.push_back(c);
+    }
+  }
+  return out;
+}
+}  // namespace
+
+Timeline::~Timeline() { Shutdown(); }
+
+void Timeline::Initialize(const std::string& path, int rank) {
+  file_ = fopen(path.c_str(), "w");
+  if (!file_) return;
+  rank_ = rank;
+  start_us_ = NowMicros();
+  fputs("[\n", file_);
+  enabled_ = true;
+  stop_ = false;
+  writer_ = std::thread(&Timeline::WriterLoop, this);
+}
+
+void Timeline::Shutdown() {
+  if (!enabled_.exchange(false)) return;
+  {
+    std::lock_guard<std::mutex> lk(mutex_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  if (file_) {
+    fputs("{}]\n", file_);
+    fclose(file_);
+    file_ = nullptr;
+  }
+}
+
+void Timeline::WriterLoop() {
+  // Async writer thread so trace IO never blocks the coordination loop.
+  // Reference analog: horovod/common/timeline.cc TimelineWriter.
+  std::unique_lock<std::mutex> lk(mutex_);
+  while (true) {
+    cv_.wait(lk, [&] { return stop_ || !queue_.empty(); });
+    while (!queue_.empty()) {
+      std::string ev = std::move(queue_.front());
+      queue_.pop_front();
+      lk.unlock();
+      fputs(ev.c_str(), file_);
+      lk.lock();
+    }
+    if (stop_) break;
+  }
+  fflush(file_);
+}
+
+void Timeline::Emit(const std::string& tensor, char phase,
+                    const std::string& label) {
+  if (!enabled_.load()) return;
+  char buf[512];
+  // tid: stable per-tensor lane so each tensor renders as one row.
+  size_t tid = std::hash<std::string>{}(tensor) % 997;
+  snprintf(buf, sizeof(buf),
+           "{\"name\": \"%s\", \"ph\": \"%c\", \"ts\": %lld, \"pid\": %d, "
+           "\"tid\": %zu, \"args\": {\"tensor\": \"%s\"}},\n",
+           JsonEscape(label).c_str(), phase,
+           (long long)(NowMicros() - start_us_), rank_, tid,
+           JsonEscape(tensor).c_str());
+  {
+    std::lock_guard<std::mutex> lk(mutex_);
+    queue_.emplace_back(buf);
+  }
+  cv_.notify_one();
+}
+
+void Timeline::NegotiateStart(const std::string& t) { Emit(t, 'B', "NEGOTIATE"); }
+void Timeline::NegotiateEnd(const std::string& t) { Emit(t, 'E', "NEGOTIATE"); }
+void Timeline::EntryQueued(const std::string& t) { Emit(t, 'i', "QUEUED"); }
+void Timeline::ActivityStart(const std::string& t, const std::string& a) {
+  Emit(t, 'B', a);
+}
+void Timeline::ActivityEnd(const std::string& t) { Emit(t, 'E', "ACTIVITY"); }
+void Timeline::EntryDone(const std::string& t) { Emit(t, 'i', "DONE"); }
+
+}  // namespace hvdtpu

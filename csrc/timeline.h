@@ -1,0 +1,50 @@
+// Chrome-trace (chrome://tracing) timeline of per-tensor lifecycle.
+// Reference analog: horovod/common/timeline.h (Timeline, TimelineWriter with
+// its async writer thread). Activated by HOROVOD_TIMELINE=/path.json; events
+// cover NEGOTIATE -> QUEUE -> MEMCPY_IN_FUSION_BUFFER -> RING_ALLREDUCE ->
+// MEMCPY_OUT_FUSION_BUFFER per tensor.
+
+#ifndef HVDTPU_TIMELINE_H
+#define HVDTPU_TIMELINE_H
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  ~Timeline();
+  void Initialize(const std::string& path, int rank);
+  void Shutdown();
+  bool Enabled() const { return enabled_.load(); }
+
+  void NegotiateStart(const std::string& tensor);
+  void NegotiateEnd(const std::string& tensor);
+  void EntryQueued(const std::string& tensor);
+  void ActivityStart(const std::string& tensor, const std::string& activity);
+  void ActivityEnd(const std::string& tensor);
+  void EntryDone(const std::string& tensor);
+
+ private:
+  void Emit(const std::string& tensor, char phase, const std::string& label);
+  void WriterLoop();
+
+  std::atomic<bool> enabled_{false};
+  std::atomic<bool> stop_{false};
+  int rank_ = 0;
+  FILE* file_ = nullptr;
+  int64_t start_us_ = 0;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  std::thread writer_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_TIMELINE_H

@@ -1,0 +1,219 @@
+#include "wire.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <ifaddrs.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace hvdtpu {
+
+static void SetSockOpts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+int TcpListen(int* port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons((uint16_t)*port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (listen(fd, 128) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &len);
+  *port = ntohs(addr.sin_port);
+  return fd;
+}
+
+int TcpAccept(int listen_fd) {
+  int fd = accept(listen_fd, nullptr, nullptr);
+  if (fd >= 0) SetSockOpts(fd);
+  return fd;
+}
+
+int TcpConnect(const std::string& host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  while (true) {
+    addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) == 0 && res) {
+      int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0) {
+        if (connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+          freeaddrinfo(res);
+          SetSockOpts(fd);
+          return fd;
+        }
+        close(fd);
+      }
+      freeaddrinfo(res);
+    }
+    if (std::chrono::steady_clock::now() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void TcpClose(int fd) {
+  if (fd >= 0) close(fd);
+}
+
+Status SendAll(int fd, const void* buf, size_t len) {
+  const char* p = (const char*)buf;
+  while (len > 0) {
+    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("send failed: ") + strerror(errno));
+    }
+    p += n;
+    len -= (size_t)n;
+  }
+  return Status::OK();
+}
+
+Status RecvAll(int fd, void* buf, size_t len) {
+  char* p = (char*)buf;
+  while (len > 0) {
+    ssize_t n = recv(fd, p, len, 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("recv failed: ") + strerror(errno));
+    }
+    if (n == 0) return Status::Aborted("peer closed connection");
+    p += n;
+    len -= (size_t)n;
+  }
+  return Status::OK();
+}
+
+Status SendFrame(int fd, const std::string& payload) {
+  uint64_t len = payload.size();
+  Status s = SendAll(fd, &len, sizeof(len));
+  if (!s.ok()) return s;
+  return SendAll(fd, payload.data(), payload.size());
+}
+
+Status RecvFrame(int fd, std::string* payload) {
+  uint64_t len = 0;
+  Status s = RecvAll(fd, &len, sizeof(len));
+  if (!s.ok()) return s;
+  payload->resize(len);
+  if (len == 0) return Status::OK();
+  return RecvAll(fd, payload->data(), len);
+}
+
+namespace {
+// Make fds non-blocking for the duration of a duplex transfer; restore after.
+// Without this, a blocking send() of a large segment can fill the kernel
+// buffer and stall every rank in the ring simultaneously (circular deadlock),
+// since nobody would be draining its recv side meanwhile.
+class ScopedNonblock {
+ public:
+  ScopedNonblock(int fd1, int fd2) : fd1_(fd1), fd2_(fd2) {
+    flags1_ = fcntl(fd1_, F_GETFL, 0);
+    fcntl(fd1_, F_SETFL, flags1_ | O_NONBLOCK);
+    if (fd2_ != fd1_) {
+      flags2_ = fcntl(fd2_, F_GETFL, 0);
+      fcntl(fd2_, F_SETFL, flags2_ | O_NONBLOCK);
+    }
+  }
+  ~ScopedNonblock() {
+    fcntl(fd1_, F_SETFL, flags1_);
+    if (fd2_ != fd1_) fcntl(fd2_, F_SETFL, flags2_);
+  }
+
+ private:
+  int fd1_, fd2_, flags1_ = 0, flags2_ = 0;
+};
+}  // namespace
+
+Status DuplexTransfer(int send_fd, const void* send_buf, size_t send_len,
+                      int recv_fd, void* recv_buf, size_t recv_len) {
+  ScopedNonblock nb(send_fd, recv_fd);
+  const char* sp = (const char*)send_buf;
+  char* rp = (char*)recv_buf;
+  size_t sent = 0, recvd = 0;
+  while (sent < send_len || recvd < recv_len) {
+    pollfd fds[2];
+    int n = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (sent < send_len) {
+      fds[n].fd = send_fd;
+      fds[n].events = POLLOUT;
+      send_idx = n++;
+    }
+    if (recvd < recv_len) {
+      fds[n].fd = recv_fd;
+      fds[n].events = POLLIN;
+      recv_idx = n++;
+    }
+    int rc = poll(fds, (nfds_t)n, 60000);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) return Status::Error("duplex transfer timed out (60s)");
+    if (send_idx >= 0 && (fds[send_idx].revents & (POLLOUT | POLLERR))) {
+      ssize_t k = send(send_fd, sp + sent, send_len - sent, MSG_NOSIGNAL);
+      if (k < 0 && errno != EINTR && errno != EAGAIN) {
+        return Status::Error(std::string("send failed: ") + strerror(errno));
+      }
+      if (k > 0) sent += (size_t)k;
+    }
+    if (recv_idx >= 0 && (fds[recv_idx].revents & (POLLIN | POLLHUP))) {
+      ssize_t k = recv(recv_fd, rp + recvd, recv_len - recvd, 0);
+      if (k == 0) return Status::Aborted("peer closed connection");
+      if (k < 0 && errno != EINTR && errno != EAGAIN) {
+        return Status::Error(std::string("recv failed: ") + strerror(errno));
+      }
+      if (k > 0) recvd += (size_t)k;
+    }
+  }
+  return Status::OK();
+}
+
+std::string LocalAddress() {
+  ifaddrs* ifs = nullptr;
+  std::string best = "127.0.0.1";
+  if (getifaddrs(&ifs) == 0) {
+    for (ifaddrs* it = ifs; it; it = it->ifa_next) {
+      if (!it->ifa_addr || it->ifa_addr->sa_family != AF_INET) continue;
+      char buf[INET_ADDRSTRLEN];
+      auto* sin = (sockaddr_in*)it->ifa_addr;
+      inet_ntop(AF_INET, &sin->sin_addr, buf, sizeof(buf));
+      std::string a(buf);
+      if (a != "127.0.0.1") {
+        best = a;
+        break;
+      }
+    }
+    freeifaddrs(ifs);
+  }
+  return best;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,50 @@
+// TCP socket plumbing for the control and data planes.
+// Reference analog: horovod vendors Gloo (third_party/gloo) for its MPI-free
+// transport and rendezvouses via an HTTP KVStore. Rebuilt: a minimal
+// self-contained TCP layer — length-framed messages for the control plane,
+// poll()-driven full-duplex transfers for the ring data plane.
+
+#ifndef HVDTPU_WIRE_H
+#define HVDTPU_WIRE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// Create a listening socket on `port` (0 = ephemeral). Returns fd; writes the
+// bound port back to `port`.
+int TcpListen(int* port);
+
+// Accept one connection (blocking). Returns fd.
+int TcpAccept(int listen_fd);
+
+// Connect to host:port, retrying for up to `timeout_ms` (rendezvous races are
+// expected at launch). Returns fd or -1.
+int TcpConnect(const std::string& host, int port, int timeout_ms = 30000);
+
+void TcpClose(int fd);
+
+// Blocking exact-length send/recv. Return OK or an error Status.
+Status SendAll(int fd, const void* buf, size_t len);
+Status RecvAll(int fd, void* buf, size_t len);
+
+// Length-framed messages (uint64 LE length + payload) for the control plane.
+Status SendFrame(int fd, const std::string& payload);
+Status RecvFrame(int fd, std::string* payload);
+
+// Full-duplex transfer: simultaneously send `send_len` bytes to `send_fd` and
+// receive `recv_len` bytes from `recv_fd`, multiplexed with poll() so the
+// ring pipeline cannot deadlock on TCP buffer backpressure.
+Status DuplexTransfer(int send_fd, const void* send_buf, size_t send_len,
+                      int recv_fd, void* recv_buf, size_t recv_len);
+
+// Best local IP for peers to reach us (first non-loopback, else 127.0.0.1).
+std::string LocalAddress();
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_WIRE_H

@@ -1,0 +1,154 @@
+"""ctypes binding to the native core runtime.
+
+Reference analog: ``horovod/common/basics.py`` (HorovodBasics loads the
+per-framework ``.so`` and exposes init/shutdown/rank/size/...). Ours binds one
+framework-agnostic core library; the async-collective handle pattern follows
+``horovod/torch/handle_manager.h``.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB_NAME = "libhvdtpu_core.so"
+
+
+def _lib_path():
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "lib", _LIB_NAME)
+
+
+def _repo_root():
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+_load_lock = threading.Lock()
+_lib = None
+
+
+def load_library():
+    """Load (building on demand if needed) the native core."""
+    global _lib
+    with _load_lock:
+        if _lib is not None:
+            return _lib
+        path = _lib_path()
+        if not os.path.exists(path):
+            # Dev-tree convenience: build via make (reference: setup.py+CMake).
+            makefile = os.path.join(_repo_root(), "Makefile")
+            if os.path.exists(makefile):
+                subprocess.run(["make", "-s", "core"], cwd=_repo_root(),
+                               check=True)
+        lib = ctypes.CDLL(path)
+
+        i64 = ctypes.c_int64
+        i32 = ctypes.c_int
+        dbl = ctypes.c_double
+        p = ctypes.c_void_p
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        cstr = ctypes.c_char_p
+
+        lib.hvdtpu_init.restype = i32
+        lib.hvdtpu_shutdown.restype = i32
+        lib.hvdtpu_is_initialized.restype = i32
+        for fn in ("rank", "size", "local_rank", "local_size", "cross_rank",
+                   "cross_size"):
+            getattr(lib, f"hvdtpu_{fn}").restype = i32
+
+        lib.hvdtpu_enqueue_allreduce.restype = i32
+        lib.hvdtpu_enqueue_allreduce.argtypes = [
+            cstr, p, p, i32, i64p, i32, i32, dbl, dbl, i32]
+        lib.hvdtpu_enqueue_allgather.restype = i32
+        lib.hvdtpu_enqueue_allgather.argtypes = [cstr, p, i32, i64p, i32, i32]
+        lib.hvdtpu_enqueue_broadcast.restype = i32
+        lib.hvdtpu_enqueue_broadcast.argtypes = [cstr, p, i32, i64p, i32, i32,
+                                                 i32]
+        lib.hvdtpu_enqueue_alltoall.restype = i32
+        lib.hvdtpu_enqueue_alltoall.argtypes = [cstr, p, i32, i64p, i32, i64p,
+                                                i32]
+        lib.hvdtpu_enqueue_reducescatter.restype = i32
+        lib.hvdtpu_enqueue_reducescatter.argtypes = [
+            cstr, p, i32, i64p, i32, i32, dbl, dbl, i32]
+        lib.hvdtpu_enqueue_barrier.restype = i32
+        lib.hvdtpu_enqueue_barrier.argtypes = [i32]
+
+        lib.hvdtpu_poll.restype = i32
+        lib.hvdtpu_poll.argtypes = [i32]
+        lib.hvdtpu_wait.restype = i32
+        lib.hvdtpu_wait.argtypes = [i32]
+        lib.hvdtpu_error_string.restype = cstr
+        lib.hvdtpu_error_string.argtypes = [i32]
+        lib.hvdtpu_result_ndim.restype = i32
+        lib.hvdtpu_result_ndim.argtypes = [i32]
+        lib.hvdtpu_result_shape.restype = i32
+        lib.hvdtpu_result_shape.argtypes = [i32, i64p]
+        lib.hvdtpu_result_size_bytes.restype = i64
+        lib.hvdtpu_result_size_bytes.argtypes = [i32]
+        lib.hvdtpu_result_copy.restype = i32
+        lib.hvdtpu_result_copy.argtypes = [i32, p, i64]
+        lib.hvdtpu_release.restype = i32
+        lib.hvdtpu_release.argtypes = [i32]
+
+        lib.hvdtpu_fusion_threshold_bytes.restype = i64
+        lib.hvdtpu_cycle_time_ms.restype = dbl
+        lib.hvdtpu_set_fusion_threshold_bytes.argtypes = [i64]
+        lib.hvdtpu_set_cycle_time_ms.argtypes = [dbl]
+
+        _lib = lib
+        return _lib
+
+
+class HorovodBasics:
+    """Python surface of the core C API, shared by every frontend.
+
+    Reference analog: horovod/common/basics.py HorovodBasics.
+    """
+
+    def __init__(self):
+        self._lib = None
+
+    @property
+    def lib(self):
+        if self._lib is None:
+            self._lib = load_library()
+        return self._lib
+
+    def init(self):
+        if self.lib.hvdtpu_init() != 0:
+            raise RuntimeError(
+                "Horovod initialization failed (see stderr log)")
+
+    def shutdown(self):
+        self.lib.hvdtpu_shutdown()
+
+    def is_initialized(self):
+        return bool(self.lib.hvdtpu_is_initialized())
+
+    def _checked(self, value, what):
+        if value < 0:
+            raise ValueError(
+                f"hvd.{what}() called before hvd.init(); call hvd.init() first")
+        return value
+
+    def rank(self):
+        return self._checked(self.lib.hvdtpu_rank(), "rank")
+
+    def size(self):
+        return self._checked(self.lib.hvdtpu_size(), "size")
+
+    def local_rank(self):
+        return self._checked(self.lib.hvdtpu_local_rank(), "local_rank")
+
+    def local_size(self):
+        return self._checked(self.lib.hvdtpu_local_size(), "local_size")
+
+    def cross_rank(self):
+        return self._checked(self.lib.hvdtpu_cross_rank(), "cross_rank")
+
+    def cross_size(self):
+        return self._checked(self.lib.hvdtpu_cross_size(), "cross_size")
+
+    def is_homogeneous(self):
+        return True

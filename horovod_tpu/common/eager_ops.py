@@ -1,0 +1,209 @@
+"""Eager host-side collective ops over numpy buffers — the shared engine
+behind every frontend's ``allreduce_async`` / ``synchronize`` pair.
+
+Reference analog: the per-framework C bindings
+(``horovod/torch/mpi_ops_v2.cc``, ``horovod/tensorflow/mpi_ops.cc``) that
+adapt framework tensors onto ``EnqueueTensorAllreduce``/... Ours adapts any
+array exposing the buffer protocol (numpy; jax/torch frontends convert).
+"""
+
+import ctypes
+
+import numpy as np
+
+from horovod_tpu.common.basics import HorovodBasics
+
+_basics = HorovodBasics()
+
+# Must match csrc/common.h DataType.
+_DTYPE_TO_ENUM = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.float16): 4,
+    # bfloat16 registered lazily below (ml_dtypes ships with jax).
+    np.dtype(np.float32): 6,
+    np.dtype(np.float64): 7,
+    np.dtype(np.bool_): 8,
+    np.dtype(np.uint16): 9,
+}
+
+try:
+    import ml_dtypes
+
+    _DTYPE_TO_ENUM[np.dtype(ml_dtypes.bfloat16)] = 5
+except ImportError:  # pragma: no cover
+    pass
+
+
+class ReduceOp:
+    """Reduction ops. Reference analog: horovod ReduceOp / hvd.Sum etc."""
+
+    AVERAGE = 0
+    SUM = 1
+    MIN = 2
+    MAX = 3
+    PRODUCT = 4
+    ADASUM = 5
+
+
+def _dtype_enum(dtype):
+    try:
+        return _DTYPE_TO_ENUM[np.dtype(dtype)]
+    except KeyError:
+        raise ValueError(f"Unsupported dtype for hvdtpu collectives: {dtype}")
+
+
+def _shape_array(shape):
+    return (ctypes.c_int64 * max(len(shape), 1))(*shape)
+
+
+def _as_contig(array):
+    arr = np.ascontiguousarray(array)
+    return arr
+
+
+class Handle:
+    """An in-flight collective. Keeps the host buffers alive until done.
+
+    Reference analog: the integer handles of horovod/torch/mpi_ops.py
+    (``synchronize``/``poll``).
+    """
+
+    def __init__(self, raw, inputs, output, gathered, dtype):
+        self._raw = raw
+        self._inputs = inputs        # pinned until completion
+        self._output = output        # allreduce/broadcast result buffer
+        self._gathered = gathered    # True => fetch managed output
+        self._dtype = dtype
+        self._done = False
+
+    @property
+    def raw(self):
+        return self._raw
+
+    def poll(self):
+        lib = _basics.lib
+        rc = lib.hvdtpu_poll(self._raw)
+        if rc < 0:
+            raise ValueError(f"invalid Horovod handle {self._raw}")
+        return rc == 1
+
+    def synchronize(self):
+        if self._done:
+            raise ValueError("handle already synchronized")
+        lib = _basics.lib
+        rc = lib.hvdtpu_wait(self._raw)
+        if rc != 0:
+            err = lib.hvdtpu_error_string(self._raw)
+            msg = err.decode() if err else "unknown error"
+            lib.hvdtpu_release(self._raw)
+            self._done = True
+            raise HorovodInternalError(msg)
+        if self._gathered:
+            ndim = lib.hvdtpu_result_ndim(self._raw)
+            shape_buf = (ctypes.c_int64 * max(ndim, 1))()
+            lib.hvdtpu_result_shape(self._raw, shape_buf)
+            shape = tuple(shape_buf[i] for i in range(ndim))
+            out = np.empty(shape, dtype=self._dtype)
+            nbytes = out.nbytes
+            if nbytes:
+                lib.hvdtpu_result_copy(
+                    self._raw, out.ctypes.data_as(ctypes.c_void_p), nbytes)
+            else:
+                out = np.empty(shape, dtype=self._dtype)
+            result = out
+        else:
+            result = self._output
+        lib.hvdtpu_release(self._raw)
+        self._done = True
+        self._inputs = None
+        return result
+
+
+class HorovodInternalError(RuntimeError):
+    """A collective failed (peer died / shape mismatch / shutdown).
+
+    Reference analog: horovod.common.exceptions.HorovodInternalError — the
+    exception elastic mode catches to trigger state restore.
+    """
+
+
+class HorovodVersionMismatchError(RuntimeError):
+    pass
+
+
+def _check_handle(h, name):
+    if h < 0:
+        raise RuntimeError(
+            f"Failed to enqueue {name} (is Horovod initialized and running?)")
+    return h
+
+
+def allreduce_async(array, name, op=ReduceOp.SUM, prescale_factor=1.0,
+                    postscale_factor=1.0, process_set_id=0):
+    arr = _as_contig(array)
+    out = np.empty_like(arr)
+    lib = _basics.lib
+    h = lib.hvdtpu_enqueue_allreduce(
+        name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p), arr.ndim,
+        _shape_array(arr.shape), _dtype_enum(arr.dtype), int(op),
+        float(prescale_factor), float(postscale_factor), int(process_set_id))
+    return Handle(_check_handle(h, "allreduce"), (arr,), out, False, arr.dtype)
+
+
+def allgather_async(array, name, process_set_id=0):
+    arr = _as_contig(array)
+    lib = _basics.lib
+    h = lib.hvdtpu_enqueue_allgather(
+        name.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.ndim,
+        _shape_array(arr.shape), _dtype_enum(arr.dtype), int(process_set_id))
+    return Handle(_check_handle(h, "allgather"), (arr,), None, True, arr.dtype)
+
+
+def broadcast_async(array, root_rank, name, process_set_id=0):
+    # In-place on a private copy; synchronize() returns the broadcast value.
+    arr = np.array(array, copy=True, order="C")
+    lib = _basics.lib
+    h = lib.hvdtpu_enqueue_broadcast(
+        name.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.ndim,
+        _shape_array(arr.shape), _dtype_enum(arr.dtype), int(root_rank),
+        int(process_set_id))
+    return Handle(_check_handle(h, "broadcast"), (arr,), arr, False, arr.dtype)
+
+
+def alltoall_async(array, splits, name, process_set_id=0):
+    arr = _as_contig(array)
+    lib = _basics.lib
+    if splits is not None:
+        splits_arr = np.ascontiguousarray(np.asarray(splits, dtype=np.int64))
+        splits_ptr = splits_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    else:
+        splits_arr = None
+        splits_ptr = None
+    h = lib.hvdtpu_enqueue_alltoall(
+        name.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.ndim,
+        _shape_array(arr.shape), _dtype_enum(arr.dtype), splits_ptr,
+        int(process_set_id))
+    return Handle(_check_handle(h, "alltoall"), (arr, splits_arr), None, True,
+                  arr.dtype)
+
+
+def reducescatter_async(array, name, op=ReduceOp.SUM, prescale_factor=1.0,
+                        postscale_factor=1.0, process_set_id=0):
+    arr = _as_contig(array)
+    lib = _basics.lib
+    h = lib.hvdtpu_enqueue_reducescatter(
+        name.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.ndim,
+        _shape_array(arr.shape), _dtype_enum(arr.dtype), int(op),
+        float(prescale_factor), float(postscale_factor), int(process_set_id))
+    return Handle(_check_handle(h, "reducescatter"), (arr,), None, True,
+                  arr.dtype)
+
+
+def barrier(process_set_id=0):
+    lib = _basics.lib
+    h = lib.hvdtpu_enqueue_barrier(int(process_set_id))
+    Handle(_check_handle(h, "barrier"), (), None, False, None).synchronize()

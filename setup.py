@@ -1,0 +1,28 @@
+"""Install horovod_tpu. Builds the native core via make.
+
+Reference analog: horovod's setup.py drives CMake to build per-framework
+extensions (horovod setup.py + CMakeLists.txt). We build one
+framework-agnostic core .so, loaded via ctypes.
+"""
+
+import subprocess
+
+from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithCore(build_py):
+    def run(self):
+        subprocess.run(["make", "core"], check=True)
+        super().run()
+
+
+setup(
+    name="horovod_tpu",
+    version="0.1.0",
+    description="TPU-native distributed training framework (Horovod-compatible API)",
+    packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
+    package_data={"horovod_tpu": ["lib/*.so"]},
+    python_requires=">=3.10",
+    cmdclass={"build_py": BuildWithCore},
+)

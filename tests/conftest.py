@@ -1,0 +1,42 @@
+"""Shared pytest config.
+
+Mirrors the reference's test substrate choice (SURVEY.md §4): everything is
+testable with a handful of local CPU processes / virtual devices. We force
+JAX onto the CPU platform with 8 virtual devices so mesh/sharding tests
+(`jax.sharding.Mesh` over dp/tp/sp axes) run without TPU hardware — the same
+code path the driver's `dryrun_multichip` validates.
+"""
+
+import os
+import subprocess
+import sys
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ensure_core_built():
+    """Build the native core (csrc/ -> horovod_tpu/lib/) if missing/stale."""
+    subprocess.run(
+        ["make", "-s", "core"], cwd=REPO_ROOT, check=True,
+        stdout=subprocess.DEVNULL,
+    )
+
+
+def pytest_configure(config):
+    _ensure_core_built()
+
+
+def pytest_collection_modifyitems(config, items):
+    # Keep deterministic ordering: single-process unit tests first.
+    items.sort(key=lambda it: ("parallel" in str(it.fspath), str(it.fspath)))
+
+
+sys.path.insert(0, REPO_ROOT)

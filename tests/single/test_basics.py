@@ -1,0 +1,83 @@
+"""Single-process sanity of the native core: init/shutdown lifecycle, size-1
+collectives (identity semantics), runtime knobs.
+
+Reference analog: the parts of test/parallel/test_torch.py that are
+meaningful at size 1, plus basics lifecycle checks.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def hvd_core(monkeypatch):
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+              "HOROVOD_LOCAL_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    from horovod_tpu.common import basics
+    b = basics.HorovodBasics()
+    b.init()
+    yield b
+    b.shutdown()
+
+
+def test_identity_and_knobs(hvd_core):
+    from horovod_tpu.common import eager_ops as ops
+    assert hvd_core.rank() == 0
+    assert hvd_core.size() == 1
+    assert hvd_core.local_rank() == 0
+    assert hvd_core.is_initialized()
+
+    lib = hvd_core.lib
+    assert lib.hvdtpu_fusion_threshold_bytes() == 64 * 1024 * 1024
+    lib.hvdtpu_set_fusion_threshold_bytes(1 << 20)
+    assert lib.hvdtpu_fusion_threshold_bytes() == 1 << 20
+    assert lib.hvdtpu_cycle_time_ms() == pytest.approx(1.0)
+
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = ops.allreduce_async(x, "id")
+    np.testing.assert_array_equal(h.synchronize(), x)
+
+    # average at size 1 is identity
+    h = ops.allreduce_async(x, "avg", op=ops.ReduceOp.AVERAGE)
+    np.testing.assert_array_equal(h.synchronize(), x)
+
+    h = ops.allgather_async(x, "ag")
+    np.testing.assert_array_equal(h.synchronize(), x)
+
+    h = ops.broadcast_async(x, 0, "bc")
+    np.testing.assert_array_equal(h.synchronize(), x)
+
+    h = ops.reducescatter_async(x, "rs")
+    np.testing.assert_array_equal(h.synchronize(), x)
+
+    ops.barrier()
+
+
+def test_duplicate_name_rejected(hvd_core):
+    from horovod_tpu.common import eager_ops as ops
+    # Stall the loop briefly by enqueueing two ops with the same name quickly;
+    # the second must fail with a precondition error, not corrupt state.
+    lib = hvd_core.lib
+    lib.hvdtpu_set_cycle_time_ms(50.0)
+    try:
+        x = np.zeros(4, np.float32)
+        h1 = ops.allreduce_async(x, "dup")
+        h2 = ops.allreduce_async(x, "dup")
+        r1 = h1.synchronize()
+        np.testing.assert_array_equal(r1, x)
+        with pytest.raises(ops.HorovodInternalError,
+                           match="[Dd]uplicate"):
+            h2.synchronize()
+    finally:
+        lib.hvdtpu_set_cycle_time_ms(1.0)
+
+
+def test_uninitialized_rank_raises():
+    # A fresh basics object in a process where init happened is fine; this
+    # asserts the error path shape only when the lib reports -1.
+    from horovod_tpu.common.basics import HorovodBasics
+    b = HorovodBasics()
+    if not b.is_initialized():
+        with pytest.raises(ValueError):
+            b.rank()
