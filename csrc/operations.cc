@@ -491,13 +491,26 @@ int hvdtpu_enqueue_grouped_allreduce(int num_tensors, const char** names,
   // v1: grouped == individual enqueues (they fuse in negotiation anyway).
   // Reference analog: group_table.cc enforces atomic negotiation; the
   // controller-side group barrier lands with the response cache milestone.
+  //
+  // Returns the number of tensors successfully enqueued (== num_tensors on
+  // full success). On partial failure the caller still owns live handles
+  // for the first `return value` tensors and must drain them before
+  // releasing the underlying buffers.
   for (int i = 0; i < num_tensors; i++) {
+    if (names[i] == nullptr || inputs[i] == nullptr ||
+        outputs[i] == nullptr || shapes[i] == nullptr) {
+      for (int j = i; j < num_tensors; j++) handles_out[j] = -1;
+      return i;
+    }
     handles_out[i] = hvdtpu_enqueue_allreduce(
         names[i], inputs[i], outputs[i], ndims[i], shapes[i], dtype, reduce_op,
         prescale, postscale, process_set_id);
-    if (handles_out[i] < 0) return -1;
+    if (handles_out[i] < 0) {
+      for (int j = i + 1; j < num_tensors; j++) handles_out[j] = -1;
+      return i;
+    }
   }
-  return 0;
+  return num_tensors;
 }
 
 int hvdtpu_enqueue_allgather(const char* name, const void* input, int ndim,

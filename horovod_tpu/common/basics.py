@@ -60,6 +60,11 @@ def load_library():
         lib.hvdtpu_enqueue_allreduce.restype = i32
         lib.hvdtpu_enqueue_allreduce.argtypes = [
             cstr, p, p, i32, i64p, i32, i32, dbl, dbl, i32]
+        lib.hvdtpu_enqueue_grouped_allreduce.restype = i32
+        lib.hvdtpu_enqueue_grouped_allreduce.argtypes = [
+            i32, ctypes.POINTER(cstr), ctypes.POINTER(p), ctypes.POINTER(p),
+            ctypes.POINTER(i32), ctypes.POINTER(i64p), i32, i32, dbl, dbl,
+            i32, ctypes.POINTER(i32)]
         lib.hvdtpu_enqueue_allgather.restype = i32
         lib.hvdtpu_enqueue_allgather.argtypes = [cstr, p, i32, i64p, i32, i32]
         lib.hvdtpu_enqueue_broadcast.restype = i32

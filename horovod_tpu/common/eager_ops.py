@@ -11,6 +11,7 @@ import ctypes
 
 import numpy as np
 
+from horovod_tpu.common import exceptions as _exceptions
 from horovod_tpu.common.basics import HorovodBasics
 
 _basics = HorovodBasics()
@@ -122,16 +123,10 @@ class Handle:
         return result
 
 
-class HorovodInternalError(RuntimeError):
-    """A collective failed (peer died / shape mismatch / shutdown).
-
-    Reference analog: horovod.common.exceptions.HorovodInternalError — the
-    exception elastic mode catches to trigger state restore.
-    """
-
-
-class HorovodVersionMismatchError(RuntimeError):
-    pass
+# Canonical definitions live in common/exceptions.py; re-exported here so
+# eager-op callers and elastic-mode catch blocks see the same class.
+HorovodInternalError = _exceptions.HorovodInternalError
+HorovodVersionMismatchError = _exceptions.HorovodVersionMismatchError
 
 
 def _check_handle(h, name):
@@ -152,6 +147,56 @@ def allreduce_async(array, name, op=ReduceOp.SUM, prescale_factor=1.0,
         _shape_array(arr.shape), _dtype_enum(arr.dtype), int(op),
         float(prescale_factor), float(postscale_factor), int(process_set_id))
     return Handle(_check_handle(h, "allreduce"), (arr,), out, False, arr.dtype)
+
+
+def grouped_allreduce_async(arrays, names, op=ReduceOp.SUM,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set_id=0):
+    """Enqueue a list of same-dtype tensors as one atomic negotiation group.
+
+    Reference analog: grouped allreduce via horovod/common/group_table.cc —
+    all tensors in the group negotiate and fuse together.
+    Returns a list of Handles (one per tensor).
+    """
+    n = len(arrays)
+    if n == 0:
+        return []
+    if len(names) != n:
+        raise ValueError(
+            f"grouped_allreduce: {n} arrays but {len(names)} names")
+    arrs = [_as_contig(a) for a in arrays]
+    dtype = arrs[0].dtype
+    if any(a.dtype != dtype for a in arrs):
+        raise ValueError("grouped_allreduce requires a single common dtype")
+    outs = [np.empty_like(a) for a in arrs]
+    c_names = (ctypes.c_char_p * n)(*[s.encode() for s in names])
+    c_inputs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrs])
+    c_outputs = (ctypes.c_void_p * n)(
+        *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs])
+    c_ndims = (ctypes.c_int * n)(*[a.ndim for a in arrs])
+    shape_bufs = [_shape_array(a.shape) for a in arrs]
+    c_shapes = (ctypes.POINTER(ctypes.c_int64) * n)(
+        *[ctypes.cast(b, ctypes.POINTER(ctypes.c_int64)) for b in shape_bufs])
+    c_handles = (ctypes.c_int * n)()
+    lib = _basics.lib
+    rc = lib.hvdtpu_enqueue_grouped_allreduce(
+        n, c_names, c_inputs, c_outputs, c_ndims, c_shapes,
+        _dtype_enum(dtype), int(op), float(prescale_factor),
+        float(postscale_factor), int(process_set_id), c_handles)
+    handles = [Handle(c_handles[i], (arrs[i],), outs[i], False, dtype)
+               for i in range(max(rc, 0))]
+    if rc < n:
+        # Partial failure: drain the in-flight prefix so the core is done
+        # touching our buffers before we raise (and before GC can free them).
+        for h in handles:
+            try:
+                h.synchronize()
+            except HorovodInternalError:
+                pass
+        raise RuntimeError(
+            f"Failed to enqueue grouped allreduce (tensor {max(rc, 0)})")
+    return handles
 
 
 def allgather_async(array, name, process_set_id=0):

@@ -1,0 +1,29 @@
+"""Framework-agnostic exceptions.
+
+Reference analog: ``horovod/common/exceptions.py`` (HorovodInternalError,
+HostsUpdatedInterrupt) — the exceptions elastic mode catches to drive
+restore/re-rendezvous (SURVEY.md §3.4).
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective fails (peer death, shape
+    mismatch, shutdown mid-flight). Elastic mode catches this to roll back
+    to the last committed state."""
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised in elastic mode when the discovery script reports a host
+    topology change; training re-rendezvouses without state rollback.
+
+    ``skip_sync`` mirrors the reference: when True the worker set only
+    grew, so existing ranks keep their state without a broadcast.
+    """
+
+    def __init__(self, skip_sync=False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class HorovodVersionMismatchError(ImportError):
+    """Frontend/core version skew detected at import time."""

@@ -1,0 +1,68 @@
+"""horovod_tpu.jax — the JAX frontend (``import horovod_tpu.jax as hvd``).
+
+Net-new relative to the reference (which has tensorflow/torch/keras/mxnet
+frontends — SURVEY.md §2.3); API shape mirrors ``horovod/torch/__init__.py``
+so a Horovod user finds the familiar surface:
+
+    hvd.init(); hvd.rank(); hvd.size()
+    hvd.allreduce(x) / hvd.allreduce_async / hvd.synchronize
+    hvd.broadcast_parameters(params, root_rank=0)
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+
+Two data planes:
+- eager (this module): host-side fused ring collectives via the native core
+  — works per-process like the reference, any backend.
+- in-graph (``horovod_tpu.parallel``): psum/all_gather over a jax Mesh
+  compiled by XLA onto TPU ICI — the TPU-native fast path.
+"""
+
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.jax.compression import Compression  # noqa: F401
+from horovod_tpu.jax.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from horovod_tpu.jax.mpi_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    cross_rank,
+    cross_size,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    poll,
+    rank,
+    reducescatter,
+    reducescatter_async,
+    shutdown,
+    size,
+    synchronize,
+)
+from horovod_tpu.jax.optimizer import (  # noqa: F401
+    DistributedGradientTransformation,
+    DistributedOptimizer,
+    allreduce_gradients,
+)

@@ -1,0 +1,75 @@
+"""Gradient compression for eager allreduce.
+
+Reference analog: ``horovod/torch/compression.py`` — ``Compression.none`` /
+``Compression.fp16`` compress the payload before allreduce and decompress
+after (2x smaller wire traffic for fp32 grads).
+"""
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface for compressing/decompressing a tensor around allreduce."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context) where context is whatever
+        decompress needs."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast fp32/fp64 to fp16 for the wire; restore original dtype after.
+
+    On TPU prefer bfloat16 (same byte savings, fp32-range exponent):
+    use ``BFloat16Compressor``.
+    """
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != jnp.float16:
+            return tensor.astype(jnp.float16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class BFloat16Compressor(Compressor):
+    """TPU-native 2x compression: bfloat16 keeps fp32 exponent range so
+    gradient overflow handling is unnecessary (net-new vs reference)."""
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != jnp.bfloat16:
+            return tensor.astype(jnp.bfloat16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Option group, mirroring hvd.Compression in the reference."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BFloat16Compressor

@@ -1,0 +1,99 @@
+"""Pytree/object broadcast helpers for the JAX frontend.
+
+Reference analog: ``horovod/torch/functions.py`` (broadcast_parameters,
+broadcast_optimizer_state, broadcast_object) — re-expressed functionally:
+JAX arrays are immutable, so these return the broadcast pytree instead of
+mutating in place.
+"""
+
+import io
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.jax import mpi_ops
+
+
+def broadcast_parameters(params, root_rank=0, prefix="parameters"):
+    """Broadcast a pytree of arrays from root_rank; returns the new pytree.
+
+    Used to synchronize initial model parameters across ranks before
+    training (reference: hvd.broadcast_parameters called after model
+    construction and before the first step).
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    handles = []
+    for i, leaf in enumerate(leaves):
+        handles.append(mpi_ops.broadcast_async(
+            jnp.asarray(leaf), root_rank, name=f"{prefix}.{i}"))
+    out = [h.synchronize() for h in handles]
+    return jax.tree.unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state, root_rank=0):
+    """Broadcast an optax optimizer state pytree from root_rank.
+
+    Array leaves broadcast natively; non-array leaves (step counters are
+    arrays in optax, but schedules may close over python scalars) ride
+    along via broadcast_object.
+    """
+    leaves, treedef = jax.tree.flatten(opt_state)
+    array_ix = [i for i, l in enumerate(leaves)
+                if isinstance(l, (jax.Array, np.ndarray))]
+    array_set = set(array_ix)
+    other_ix = [i for i in range(len(leaves)) if i not in array_set]
+    arrays = broadcast_parameters([leaves[i] for i in array_ix], root_rank,
+                                  prefix="opt_state")
+    others = broadcast_object([leaves[i] for i in other_ix], root_rank,
+                              name="opt_state.pyleaves")
+    out = list(leaves)
+    for i, v in zip(array_ix, arrays):
+        out[i] = v
+    for i, v in zip(other_ix, others):
+        out[i] = v
+    return jax.tree.unflatten(treedef, out)
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Pickle-broadcast an arbitrary python object from root_rank.
+
+    Reference analog: hvd.broadcast_object (horovod/torch/functions.py):
+    length first, then the payload as a byte tensor.
+    """
+    name = name or "broadcast_object"
+    if mpi_ops.rank() == root_rank:
+        buf = io.BytesIO()
+        pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    else:
+        payload = np.zeros(0, dtype=np.uint8)
+
+    nbytes = np.array([payload.size], dtype=np.int64)
+    nbytes = np.asarray(
+        mpi_ops.broadcast(nbytes, root_rank, name=f"{name}.len"))
+    if mpi_ops.rank() != root_rank:
+        payload = np.zeros(int(nbytes[0]), dtype=np.uint8)
+    data = np.asarray(
+        mpi_ops.broadcast(payload, root_rank, name=f"{name}.data"))
+    return pickle.loads(data.tobytes())
+
+
+def allgather_object(obj, name=None):
+    """Gather an arbitrary python object from every rank; returns a list
+    indexed by rank. Reference analog: hvd.allgather_object."""
+    name = name or "allgather_object"
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+
+    sizes = np.asarray(mpi_ops.allgather(
+        np.array([payload.size], dtype=np.int64), name=f"{name}.len"))
+    gathered = np.asarray(mpi_ops.allgather(payload, name=f"{name}.data"))
+    out = []
+    off = 0
+    for s in sizes:
+        out.append(pickle.loads(gathered[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out

@@ -1,0 +1,179 @@
+"""Distributed correctness of the horovod_tpu.jax frontend.
+
+Reference analog: test/parallel/test_torch.py — the frontend-level op,
+optimizer-wrap, and broadcast_parameters tests; expected values are
+analytic closed forms (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from tests.utils_mp import run_ranks
+
+
+def _worker_ops(rank, size):
+    import jax.numpy as jnp
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        assert hvd.rank() == rank and hvd.size() == size
+        assert hvd.is_initialized()
+
+        # allreduce average (the default op, like the reference)
+        r = hvd.allreduce(jnp.full((4, 3), float(rank)), name="ar")
+        np.testing.assert_allclose(np.asarray(r), sum(range(size)) / size)
+
+        # sum + async/poll/synchronize
+        h = hvd.allreduce_async(jnp.full(5, float(rank)), name="ar2",
+                                op=hvd.Sum)
+        while not hvd.poll(h):
+            pass
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   sum(range(size)))
+
+        # grouped allreduce (atomic negotiation)
+        outs = hvd.grouped_allreduce(
+            [jnp.full(3, float(rank + i)) for i in range(4)], op=hvd.Sum)
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(np.asarray(o),
+                                       sum(rk + i for rk in range(size)))
+
+        # allgather / broadcast / alltoall / reducescatter
+        g = hvd.allgather(jnp.full((rank + 1, 2), float(rank)), name="ag")
+        assert np.asarray(g).shape == (sum(range(1, size + 1)), 2)
+
+        b = hvd.broadcast(jnp.full(4, float(rank)), root_rank=size - 1)
+        np.testing.assert_allclose(np.asarray(b), float(size - 1))
+
+        a2a = hvd.alltoall(jnp.arange(size * 2, dtype=jnp.float32)
+                           + 100.0 * rank, splits=[2] * size)
+        exp = np.concatenate(
+            [np.arange(rank * 2, rank * 2 + 2, dtype=np.float32) + 100 * rk
+             for rk in range(size)])
+        np.testing.assert_allclose(np.asarray(a2a), exp)
+
+        rs = hvd.reducescatter(jnp.full((size * 2, 2), float(rank + 1)),
+                               op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(rs), sum(range(1, size + 1)))
+
+        # bfloat16 path (TPU's native dtype)
+        bf = hvd.allreduce(jnp.full(8, float(rank), jnp.bfloat16), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(bf.astype(jnp.float32)),
+                                   sum(range(size)))
+
+        hvd.barrier()
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_jax_ops(size):
+    assert run_ranks(_worker_ops, size) == ["ok"] * size
+
+
+def _worker_broadcast_helpers(rank, size):
+    import jax.numpy as jnp
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        # broadcast_parameters on a nested pytree
+        params = {"dense": {"w": jnp.full((3, 3), float(rank)),
+                            "b": jnp.full(3, float(rank))},
+                  "scale": jnp.asarray(float(rank))}
+        params = hvd.broadcast_parameters(params, root_rank=0)
+        for leaf in (params["dense"]["w"], params["dense"]["b"],
+                     params["scale"]):
+            np.testing.assert_allclose(np.asarray(leaf), 0.0)
+
+        # broadcast_object / allgather_object
+        obj = hvd.broadcast_object({"lr": 0.1 * (rank + 1), "tag": rank},
+                                   root_rank=1)
+        assert obj == {"lr": 0.2, "tag": 1}
+
+        objs = hvd.allgather_object(("rank", rank))
+        assert objs == [("rank", rk) for rk in range(size)]
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_broadcast_helpers():
+    assert run_ranks(_worker_broadcast_helpers, 2) == ["ok"] * 2
+
+
+def _worker_distributed_optimizer(rank, size):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        # Each rank computes a different local grad; after the distributed
+        # update every rank must hold identical params equal to the
+        # all-rank-averaged-gradient update.
+        params = {"w": jnp.ones(4), "b": jnp.zeros(2)}
+        tx = hvd.DistributedOptimizer(optax.sgd(0.5), op=hvd.Average)
+        state = tx.init(params)
+
+        grads = {"w": jnp.full(4, float(rank + 1)),
+                 "b": jnp.full(2, float(rank))}
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+
+        gw = np.mean([rk + 1 for rk in range(size)])
+        gb = np.mean([float(rk) for rk in range(size)])
+        np.testing.assert_allclose(np.asarray(params["w"]), 1 - 0.5 * gw)
+        np.testing.assert_allclose(np.asarray(params["b"]), -0.5 * gb,
+                                   rtol=1e-6)
+
+        # fp16 compression path
+        tx2 = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                       compression=hvd.Compression.fp16)
+        s2 = tx2.init(params)
+        up2, s2 = tx2.update({"w": jnp.full(4, float(rank)),
+                              "b": jnp.zeros(2)}, s2, params)
+        assert jax.tree.leaves(up2)[1].dtype == jnp.float32
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_distributed_optimizer():
+    assert run_ranks(_worker_distributed_optimizer, 2) == ["ok"] * 2
+
+
+def _worker_backward_passes(rank, size):
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        params = {"w": jnp.zeros(3)}
+        tx = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                      backward_passes_per_step=2)
+        state = tx.init(params)
+        # First pass: accumulate only, params unchanged.
+        up, state = tx.update({"w": jnp.full(3, 2.0 * (rank + 1))}, state,
+                              params)
+        params = optax.apply_updates(params, up)
+        np.testing.assert_allclose(np.asarray(params["w"]), 0.0)
+        # Second pass: allreduce of the local mean, then apply.
+        up, state = tx.update({"w": jnp.full(3, 4.0 * (rank + 1))}, state,
+                              params)
+        params = optax.apply_updates(params, up)
+        local_means = [(2.0 * (rk + 1) + 4.0 * (rk + 1)) / 2
+                       for rk in range(size)]
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   -np.mean(local_means))
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_backward_passes_per_step():
+    assert run_ranks(_worker_backward_passes, 2) == ["ok"] * 2
