@@ -1,0 +1,42 @@
+"""horovod_tpu.parallel — the TPU-native in-graph SPMD layer.
+
+Net-new relative to the reference (SURVEY.md §5.7: Horovod is pure data
+parallelism; TP/SP/ring-attention are absent upstream). This package is the
+"xla_ici" data plane of the rebuild: instead of enqueueing host-side
+collectives, training steps are jit-compiled over a ``jax.sharding.Mesh``
+and XLA inserts psum/all-gather/ppermute collectives that ride the TPU ICI.
+
+Axis conventions (the mesh dimension names the rest of the framework uses):
+
+- ``data``   — pure data parallelism (gradient psum; Horovod's DP)
+- ``fsdp``   — data parallelism with sharded params/optimizer (ZeRO-3)
+- ``tensor`` — megatron-style tensor parallelism inside matmuls
+- ``seq``    — sequence/context parallelism (ring attention)
+- ``pipe``   — pipeline stages
+- ``expert`` — MoE expert parallelism
+"""
+
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    create_mesh,
+    local_mesh,
+)
+from horovod_tpu.parallel.ops import (  # noqa: F401
+    all_gather,
+    all_to_all,
+    pbroadcast,
+    pmean,
+    ppermute_ring,
+    psum,
+    reduce_scatter,
+)
+from horovod_tpu.parallel.ring_attention import (  # noqa: F401
+    blockwise_attention,
+    ring_attention,
+    ring_self_attention,
+)
+from horovod_tpu.parallel.sharding import (  # noqa: F401
+    named_sharding,
+    shard_params,
+    with_constraint,
+)

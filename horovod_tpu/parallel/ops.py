@@ -1,0 +1,71 @@
+"""In-graph collectives over named mesh axes.
+
+These are the XLA-native analogs of the core runtime's eager collectives
+(reference: horovod/common/ops/nccl_operations.cc): inside a jit-compiled
+program, ``psum``/``all_gather``/``ppermute`` lower to ICI collectives
+fused and scheduled by XLA — no background thread, no fusion buffer; the
+compiler owns both.
+
+Use under ``jax.shard_map`` (or inside ``jax.jit`` with sharding
+constraints, where XLA inserts them implicitly).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis_name):
+    """Sum across a mesh axis. Horovod analog: hvd.allreduce(op=Sum)."""
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    """Mean across a mesh axis. Horovod analog: hvd.allreduce(op=Average)."""
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    """Gather shards along `axis`. Horovod analog: hvd.allgather."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    """Sum then scatter along `axis`. Horovod analog: hvd.reducescatter."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis):
+    """Transpose shard ownership. Horovod analog: hvd.alltoall."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def pbroadcast(x, axis_name, root=0):
+    """Broadcast root's shard to all members of the axis.
+
+    Horovod analog: hvd.broadcast. Lowered as a masked psum (select +
+    psum), which XLA turns into an efficient one-to-all on ICI.
+    """
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def ppermute_ring(x, axis_name, shift=1):
+    """Rotate shards around the axis ring (device i -> i+shift).
+
+    The building block of ring attention and pipelined collectives;
+    lowers to neighbor exchanges on the ICI torus.
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.axis_size(axis_name)

@@ -11,13 +11,21 @@ import os
 import subprocess
 import sys
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes a backend. Forced (not setdefault):
+# the driver environment exports JAX_PLATFORMS=axon (one real TPU chip)
+# and a sitecustomize re-registers the axon plugin at interpreter start,
+# so we must also override at the jax.config level below — tests want 8
+# virtual CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (after the env setup above, before any backend use)
+
+jax.config.update("jax_platforms", "cpu")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -1,0 +1,137 @@
+"""SPMD layer tests on the 8-virtual-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8 — the driver's dryrun substrate).
+
+Reference analog: none (Horovod has no in-graph SPMD); correctness is
+asserted against single-device closed forms, in the reference's analytic
+spirit (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu import parallel
+from horovod_tpu.parallel import blockwise_attention
+from horovod_tpu.parallel.sharding import apply_sharding
+
+
+def test_mesh_creation():
+    mesh = parallel.create_mesh(data=2, tensor=4)
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["tensor"] == 4
+    assert mesh.shape["pipe"] == 1
+
+    mesh = parallel.create_mesh()  # all devices on data
+    assert mesh.shape["data"] == 8
+
+    with pytest.raises(ValueError):
+        parallel.create_mesh(data=3, tensor=4)  # 12 != 8
+
+
+def test_in_graph_collectives():
+    mesh = parallel.create_mesh(data=8)
+
+    @jax.shard_map(mesh=mesh, in_specs=P("data"), out_specs=P())
+    def summed(x):
+        return parallel.psum(jnp.sum(x, keepdims=True), "data")
+
+    x = jnp.arange(16.0)
+    np.testing.assert_allclose(np.asarray(summed(x))[0], x.sum())
+
+    @jax.shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    def rotated(x):
+        return parallel.ppermute_ring(x, "data", shift=1)
+
+    r = np.asarray(rotated(jnp.arange(8.0)))
+    np.testing.assert_allclose(r, np.roll(np.arange(8.0), 1))
+
+    @jax.shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    def bcast(x):
+        return parallel.pbroadcast(x, "data", root=3)
+
+    np.testing.assert_allclose(np.asarray(bcast(jnp.arange(8.0))), 3.0)
+
+
+def _reference_attention(q, k, v, causal):
+    nrep = q.shape[2] // k.shape[2]
+    k = np.repeat(np.asarray(k), nrep, axis=2)
+    v = np.repeat(np.asarray(v), nrep, axis=2)
+    q, k, v = map(lambda t: np.asarray(t, np.float64), (q, k, v))
+    scale = q.shape[-1] ** -0.5
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = np.arange(tk)[None, :] <= np.arange(tq)[:, None]
+        s = np.where(mask[None, None], s, -np.inf)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_blockwise_attention_matches_reference(causal, kv_heads):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 16, kv_heads, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 16, kv_heads, 8), jnp.float32)
+    out = blockwise_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out),
+                               _reference_attention(q, k, v, causal),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq_size", [4, 8])
+def test_ring_attention_exact(causal, seq_size):
+    mesh = parallel.create_mesh(data=8 // seq_size, seq=seq_size)
+    rng = np.random.RandomState(1)
+    b, t, h, hkv, d = 2, 32, 4, 2, 8
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, hkv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, hkv, d), jnp.float32)
+
+    out = parallel.ring_self_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out),
+                               _reference_attention(q, k, v, causal),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gradients_match():
+    mesh = parallel.create_mesh(data=2, seq=4)
+    rng = np.random.RandomState(2)
+    b, t, h, d = 2, 16, 2, 4
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(parallel.ring_self_attention(q, k, v, mesh) ** 2)
+
+    def loss_plain(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_plain = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+    for gr, gp in zip(g_ring, g_plain):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gp),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_shard_params_rules():
+    mesh = parallel.create_mesh(data=2, tensor=4)
+    params = {"layer0": {"wq": jnp.zeros((8, 8)), "bias": jnp.zeros(8)},
+              "embed": jnp.zeros((16, 8))}
+    rules = [
+        (r"wq", P(None, "tensor")),
+        (r"embed", P("tensor", None)),
+    ]
+    sh = parallel.shard_params(params, mesh, rules)
+    assert sh["layer0"]["wq"].spec == P(None, "tensor")
+    assert sh["layer0"]["bias"].spec == P()
+    assert sh["embed"].spec == P("tensor", None)
+    placed = apply_sharding(params, sh)
+    assert placed["embed"].sharding.spec == P("tensor", None)

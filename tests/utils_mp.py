@@ -36,6 +36,15 @@ def _entry(fn, rank, size, port, q, env):
     })
     os.environ.update(env or {})
     sys.path.insert(0, REPO_ROOT)
+    # The driver image's sitecustomize registers the axon TPU plugin in
+    # every interpreter; force workers onto CPU at the config level too
+    # (env alone is not enough — see tests/conftest.py).
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass
     try:
         result = fn(rank, size)
         q.put((rank, None, result))
