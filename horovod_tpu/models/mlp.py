@@ -1,0 +1,27 @@
+"""Small MLP classifier — the MNIST end-to-end-slice model.
+
+Reference analog: examples/pytorch_mnist.py's Net (the reference's
+minimum end-to-end demo); functional jax instead of nn.Module.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def mlp_init(key, sizes=(784, 128, 64, 10)):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, fan_in, fan_out in zip(keys, sizes[:-1], sizes[1:]):
+        params.append({
+            "w": jax.random.normal(k, (fan_in, fan_out), jnp.float32)
+            * (fan_in ** -0.5),
+            "b": jnp.zeros(fan_out),
+        })
+    return params
+
+
+def mlp_forward(params, x):
+    for layer in params[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    last = params[-1]
+    return x @ last["w"] + last["b"]
