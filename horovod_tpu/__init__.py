@@ -24,3 +24,10 @@ Architecture (see SURVEY.md for the reference analysis):
 """
 
 from horovod_tpu.version import __version__  # noqa: F401
+
+
+def run(*args, **kwargs):
+    """In-python local launcher (reference analog: ``horovod.run``)."""
+    from horovod_tpu.runner import run as _run
+
+    return _run(*args, **kwargs)

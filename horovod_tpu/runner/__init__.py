@@ -1,0 +1,69 @@
+"""horovod_tpu.runner — the launch layer.
+
+Reference analog: ``horovod/runner/`` (horovodrun CLI + the
+``horovod.run`` in-python launcher).
+"""
+
+import multiprocessing
+import os
+
+from horovod_tpu.runner import util
+
+
+def _worker_main(fn, args, kwargs, slot, controller_addr, controller_port,
+                 extra_env, q):
+    env = {
+        "HOROVOD_RANK": str(slot.rank),
+        "HOROVOD_SIZE": str(slot.size),
+        "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+        "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+        "HOROVOD_CONTROLLER_ADDR": controller_addr,
+        "HOROVOD_CONTROLLER_PORT": str(controller_port),
+    }
+    env.update(extra_env or {})
+    os.environ.update(env)
+    try:
+        q.put((slot.rank, None, fn(*args, **(kwargs or {}))))
+    except BaseException as e:  # noqa: BLE001 — report, don't hang the pool
+        import traceback
+
+        traceback.print_exc()
+        q.put((slot.rank, f"{type(e).__name__}: {e}", None))
+
+
+def run(fn, args=(), kwargs=None, np=2, env=None, start_method="spawn",
+        timeout=None):
+    """Run ``fn`` on ``np`` local ranks; returns results ordered by rank.
+
+    Reference analog: ``horovod.run`` (horovod/runner/__init__.py) in
+    local mode — the interactive / notebook launcher. ``fn`` must be
+    picklable (module-level).
+    """
+    ctx = multiprocessing.get_context(start_method)
+    q = ctx.Queue()
+    port = util.free_port()
+    slots = util.get_host_assignments([util.HostInfo("localhost", np)], np)
+    procs = [
+        ctx.Process(target=_worker_main,
+                    args=(fn, args, kwargs, s, "127.0.0.1", port, env, q))
+        for s in slots
+    ]
+    for p in procs:
+        p.start()
+    results, errors = {}, {}
+    try:
+        for _ in range(np):
+            rank, err, res = q.get(timeout=timeout)
+            (errors if err else results)[rank] = err or res
+            if err:
+                results[rank] = None
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    if errors:
+        raise RuntimeError(f"horovod_tpu.run rank failures: {errors}")
+    return [results[r] for r in range(np)]

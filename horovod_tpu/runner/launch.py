@@ -1,0 +1,223 @@
+"""``horovodrun`` — the launcher CLI.
+
+Reference analog: ``horovod/runner/launch.py`` (run_commandline /
+parse_args / _run) + ``gloo_run.py``: compute rank layout from
+-np/-H/--hostfile, export the HOROVOD_* env contract, spawn one process
+per slot (ssh for remote hosts), stream rank-prefixed output, tear the
+job down if any rank fails.
+
+TPU-pod mode (net-new): ``--tpu-pod`` maps one rank per local TPU chip
+and pins each rank to its chip via JAX's PJRT process env so the eager
+control plane coexists with per-chip XLA compute.
+"""
+
+import argparse
+import os
+import shlex
+import sys
+import threading
+
+from horovod_tpu.runner import util
+from horovod_tpu.runner import safe_shell_exec
+from horovod_tpu.version import __version__
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="horovodrun",
+        description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-v", "--version", action="version", version=__version__)
+    p.add_argument("-np", "--num-proc", type=int, dest="np", required=False,
+                   help="total number of processes")
+    p.add_argument("-H", "--hosts", dest="hosts",
+                   help="host1:slots,host2:slots (default: localhost:np)")
+    p.add_argument("--hostfile", help="file with one 'host slots=N' per line")
+    p.add_argument("-p", "--ssh-port", type=int, default=None)
+    p.add_argument("--ssh-identity-file", default=None)
+    p.add_argument("--network-interface", dest="nics", default=None)
+    p.add_argument("--start-timeout", type=int, default=60,
+                   help="seconds to wait for ranks to register")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--tpu-pod", action="store_true",
+                   help="one rank per local TPU chip, chips pinned per rank")
+    # Tuning knobs -> env (reference: config_parser.py set_env_from_args)
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--stall-check-warning-time-seconds", type=float,
+                   default=None)
+    p.add_argument("--log-level", default=None,
+                   choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "FATAL"])
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--config-file", default=None,
+                   help="YAML file of the above knobs")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="program and args to launch on every rank")
+    args = p.parse_args(argv)
+    if args.config_file:
+        _apply_config_file(args)
+    if not args.command:
+        p.error("no command given")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if args.np is None and not args.tpu_pod:
+        p.error("-np is required (or use --tpu-pod)")
+    return args
+
+
+def _apply_config_file(args):
+    """YAML config: CLI takes precedence (reference: config_parser.py)."""
+    import yaml
+
+    with open(args.config_file) as f:
+        cfg = yaml.safe_load(f) or {}
+    for key, value in cfg.items():
+        attr = key.replace("-", "_")
+        if hasattr(args, attr) and getattr(args, attr) in (None, False):
+            setattr(args, attr, value)
+
+
+def env_from_args(args):
+    """The HOROVOD_* tuning env contract (reference keeps CLI/env/YAML in
+    sync — SURVEY.md §5.6)."""
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.timeline_filename:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.no_stall_check:
+        env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.stall_check_warning_time_seconds is not None:
+        env["HOROVOD_STALL_CHECK_TIME"] = str(
+            args.stall_check_warning_time_seconds)
+    if args.log_level:
+        env["HOROVOD_LOG_LEVEL"] = args.log_level
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.nics:
+        env["HOROVOD_GLOO_IFACE"] = args.nics
+    return env
+
+
+def _tpu_pod_np():
+    """Rank count for --tpu-pod: one per local chip."""
+    import jax
+
+    return len(jax.local_devices())
+
+
+def _slot_env(slot, controller_addr, controller_port, tpu_pod):
+    env = {
+        "HOROVOD_RANK": str(slot.rank),
+        "HOROVOD_SIZE": str(slot.size),
+        "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+        "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+        "HOROVOD_CONTROLLER_ADDR": controller_addr,
+        "HOROVOD_CONTROLLER_PORT": str(controller_port),
+        # OpenMPI-compatible aliases many scripts read:
+        "OMPI_COMM_WORLD_RANK": str(slot.rank),
+        "OMPI_COMM_WORLD_SIZE": str(slot.size),
+        "OMPI_COMM_WORLD_LOCAL_RANK": str(slot.local_rank),
+    }
+    if tpu_pod:
+        # One chip per rank: restrict this process's PJRT client to its
+        # chip (rank-per-chip binding, SURVEY.md §7 step 3).
+        env["TPU_VISIBLE_DEVICES"] = str(slot.local_rank)
+        env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        env["JAX_LOCAL_DEVICE_IDS"] = str(slot.local_rank)
+    return env
+
+
+def _ssh_wrap(slot, command_env, command, ssh_port, identity_file):
+    """Build the ssh command line for a remote slot (reference:
+    gloo_run.get_remote_command)."""
+    exports = " ".join(f"{k}={shlex.quote(v)}"
+                       for k, v in sorted(command_env.items()))
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    if identity_file:
+        ssh += ["-i", identity_file]
+    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " \
+             f"{' '.join(shlex.quote(c) for c in command)}"
+    return ssh + [slot.hostname, remote]
+
+
+def run_launcher(args):
+    if args.tpu_pod and args.np is None:
+        args.np = _tpu_pod_np()
+    hosts = (util.parse_hostfile(args.hostfile) if args.hostfile
+             else util.parse_hosts(args.hosts or f"localhost:{args.np}"))
+    slots = util.get_host_assignments(hosts, args.np)
+    controller_addr = util.resolvable_addr_for(hosts)
+    controller_port = util.free_port()
+    knob_env = env_from_args(args)
+
+    if args.verbose:
+        print(f"[horovodrun] np={args.np} hosts="
+              f"{[(h.hostname, h.slots) for h in hosts]} "
+              f"controller={controller_addr}:{controller_port}",
+              file=sys.stderr)
+
+    failure = threading.Event()
+    rcs = [None] * args.np
+
+    def launch_slot(slot):
+        env = dict(os.environ)
+        env.update(knob_env)
+        slot_env = _slot_env(slot, controller_addr, controller_port,
+                             args.tpu_pod)
+        env.update(slot_env)
+        env.setdefault("HOROVOD_START_TIMEOUT", str(args.start_timeout))
+        if util.is_local_host(slot.hostname):
+            cmd = list(args.command)
+        else:
+            cmd = _ssh_wrap(slot, {**knob_env, **slot_env}, args.command,
+                            args.ssh_port, args.ssh_identity_file)
+        rc = safe_shell_exec.execute(
+            cmd, env=env, prefix=f"[{slot.rank}]<out>: ".encode()
+            if args.verbose else b"", events=[failure])
+        rcs[slot.rank] = rc
+        if rc != 0:
+            failure.set()
+
+    threads = [threading.Thread(target=launch_slot, args=(s,), daemon=True)
+               for s in slots]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    bad = [(r, rc) for r, rc in enumerate(rcs) if rc != 0]
+    if bad:
+        print(f"[horovodrun] ranks failed: {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_commandline(argv=None):
+    return run_launcher(parse_args(argv))
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

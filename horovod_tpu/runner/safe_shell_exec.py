@@ -1,0 +1,80 @@
+"""Robust subprocess execution with process-group cleanup.
+
+Reference analog: ``horovod/runner/common/util/safe_shell_exec.py`` —
+fork the child in its own process group, pump stdout/stderr via threads,
+and on termination kill the entire tree so no orphan ranks linger.
+"""
+
+import os
+import signal
+import subprocess
+import threading
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def _pump(stream, sink, prefix=b""):
+    for line in iter(stream.readline, b""):
+        sink.write(prefix + line)
+        sink.flush()
+    stream.close()
+
+
+def execute(command, env=None, stdout=None, stderr=None, prefix=None,
+            events=None):
+    """Run `command` (list or shell string) in its own process group.
+
+    Streams output line-by-line (optionally prefixed, like the
+    reference's `[rank]<stdout>` tagging). Returns the exit code.
+    `events`: optional list of threading.Event; if any fires, the child
+    tree is terminated.
+    """
+    import sys
+
+    shell = isinstance(command, str)
+    proc = subprocess.Popen(
+        command, shell=shell, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, start_new_session=True)
+
+    out_sink = getattr(stdout or sys.stdout, "buffer", stdout or sys.stdout)
+    err_sink = getattr(stderr or sys.stderr, "buffer", stderr or sys.stderr)
+    p = (prefix.encode() if isinstance(prefix, str) else prefix) or b""
+    pumps = [
+        threading.Thread(target=_pump, args=(proc.stdout, out_sink, p),
+                         daemon=True),
+        threading.Thread(target=_pump, args=(proc.stderr, err_sink, p),
+                         daemon=True),
+    ]
+    for t in pumps:
+        t.start()
+
+    watcher = None
+    if events:
+        def watch():
+            while proc.poll() is None:
+                if any(e.wait(0.1) for e in events):
+                    terminate_tree(proc)
+                    return
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+
+    proc.wait()
+    for t in pumps:
+        t.join(timeout=2)
+    return proc.returncode
+
+
+def terminate_tree(proc):
+    """SIGTERM the child's process group; SIGKILL after a grace period."""
+    try:
+        pgid = os.getpgid(proc.pid)
+    except ProcessLookupError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+        try:
+            proc.wait(timeout=GRACEFUL_TERMINATION_TIME_S)
+        except subprocess.TimeoutExpired:
+            os.killpg(pgid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass

@@ -25,4 +25,9 @@ setup(
     package_data={"horovod_tpu": ["lib/*.so"]},
     python_requires=">=3.10",
     cmdclass={"build_py": BuildWithCore},
+    entry_points={
+        "console_scripts": [
+            "horovodrun = horovod_tpu.runner.launch:main",
+        ],
+    },
 )

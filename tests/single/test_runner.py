@@ -1,0 +1,121 @@
+"""Launcher unit + integration tests.
+
+Reference analog: test/single/test_run.py (arg parsing, host parsing,
+cmdline construction with mocks) plus a real local 2-rank launch as the
+integration probe (SURVEY.md §4).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import launch, util
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_parse_hosts():
+    hosts = util.parse_hosts("a:2,b:4,c")
+    assert [(h.hostname, h.slots) for h in hosts] == [("a", 2), ("b", 4),
+                                                      ("c", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hosts"
+    f.write_text(textwrap.dedent("""\
+        # comment
+        node1 slots=4
+        node2:2
+        node3
+    """))
+    hosts = util.parse_hostfile(str(f))
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("node1", 4), ("node2", 2), ("node3", 1)]
+
+
+def test_host_assignments():
+    slots = util.get_host_assignments(util.parse_hosts("a:2,b:2"), 3)
+    assert [(s.hostname, s.rank, s.local_rank, s.cross_rank)
+            for s in slots] == [("a", 0, 0, 0), ("a", 1, 1, 0),
+                                ("b", 2, 0, 1)]
+    assert all(s.cross_size == 2 for s in slots)
+    assert slots[2].local_size == 1
+
+    with pytest.raises(ValueError):
+        util.get_host_assignments(util.parse_hosts("a:1"), 2)
+
+
+def test_parse_args_and_env():
+    args = launch.parse_args([
+        "-np", "2", "--fusion-threshold-mb", "32", "--cycle-time-ms", "5",
+        "--timeline-filename", "/tmp/t.json", "--no-stall-check",
+        "--log-level", "DEBUG", "python", "train.py"])
+    env = launch.env_from_args(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "5.0"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+    assert env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+    assert env["HOROVOD_LOG_LEVEL"] == "DEBUG"
+    assert args.command == ["python", "train.py"]
+
+
+def test_config_file(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("fusion-threshold-mb: 8\nlog-level: INFO\n")
+    args = launch.parse_args(["-np", "1", "--config-file", str(cfg),
+                              "python", "x.py"])
+    env = launch.env_from_args(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(8 * 1024 * 1024)
+    assert env["HOROVOD_LOG_LEVEL"] == "INFO"
+
+
+def test_ssh_wrap():
+    slot = util.SlotInfo("remotehost", 1, 0, 1, 2, 1, 2)
+    cmd = launch._ssh_wrap(slot, {"HOROVOD_RANK": "1"}, ["python", "t.py"],
+                           2222, "/id_rsa")
+    assert cmd[0] == "ssh"
+    assert "-p" in cmd and "2222" in cmd
+    assert "remotehost" in cmd
+    assert "HOROVOD_RANK=1" in cmd[-1]
+
+
+def test_horovodrun_end_to_end(tmp_path):
+    """Real 2-rank launch through the CLI: each rank allreduces its rank."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""\
+        import numpy as np
+        from horovod_tpu.common.basics import HorovodBasics
+        from horovod_tpu.common import eager_ops
+        b = HorovodBasics(); b.init()
+        h = eager_ops.allreduce_async(
+            np.full(4, float(b.rank()), np.float32), "t")
+        out = h.synchronize()
+        assert out[0] == sum(range(b.size())), out
+        print(f"RANK{b.rank()}-SUM{out[0]:.0f}")
+        b.shutdown()
+    """))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "RANK0-SUM1" in proc.stdout
+    assert "RANK1-SUM1" in proc.stdout
+
+
+def test_horovodrun_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys, os\n"
+                      "sys.exit(3 if os.environ['HOROVOD_RANK']=='1' else 0)")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 1
+    assert "ranks failed" in proc.stderr
