@@ -1,0 +1,60 @@
+"""horovod_tpu.torch — the PyTorch frontend
+(``import horovod_tpu.torch as hvd``).
+
+Reference analog: ``horovod/torch/__init__.py`` — same API: init/rank/
+size, (grouped_)allreduce(_async)(_), allgather, broadcast(_), alltoall,
+reducescatter, DistributedOptimizer with per-param hooks,
+broadcast_parameters / broadcast_optimizer_state / broadcast_object,
+Compression, SyncBatchNorm.
+"""
+
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    cross_rank,
+    cross_size,
+    grouped_allreduce_,
+    grouped_allreduce_async_,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    poll,
+    rank,
+    reducescatter,
+    reducescatter_async,
+    shutdown,
+    size,
+    synchronize,
+)
+from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401

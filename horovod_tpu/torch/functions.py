@@ -1,0 +1,119 @@
+"""Model/optimizer state broadcast helpers (torch flavor).
+
+Reference analog: ``horovod/torch/functions.py``.
+"""
+
+import io
+import pickle
+
+import numpy as np
+import torch
+
+from horovod_tpu.torch import mpi_ops
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast model parameters in place.
+
+    `params` is either a ``state_dict()`` (name->tensor) or an iterable of
+    (name, tensor) pairs / module.named_parameters().
+    """
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if not torch.is_tensor(p):
+            continue
+        handles.append(mpi_ops.broadcast_async_(
+            p.data, root_rank, name=f"broadcast.param.{name}"))
+    for h in handles:
+        h.synchronize()
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcast an optimizer's state dict from root_rank, in place.
+
+    Tensor state broadcasts natively; scalars ride via broadcast_object
+    (reference does the same dance with an identity-mapped state dict).
+    """
+    state = optimizer.state_dict()
+    # Non-tensor payload (param_groups + scalar state) by pickle:
+    scalar_blob = broadcast_object(
+        _strip_tensors(state), root_rank, name="opt_state.scalars")
+    if mpi_ops.rank() != root_rank:
+        _merge_scalars(state, scalar_blob)
+    handles = []
+    for sid, pstate in sorted(state.get("state", {}).items(),
+                              key=lambda kv: str(kv[0])):
+        for key, val in sorted(pstate.items()):
+            if torch.is_tensor(val):
+                handles.append(mpi_ops.broadcast_async_(
+                    val, root_rank, name=f"opt.{sid}.{key}"))
+    for h in handles:
+        h.synchronize()
+    optimizer.load_state_dict(state)
+
+
+def _strip_tensors(obj):
+    if torch.is_tensor(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _strip_tensors(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_strip_tensors(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _merge_scalars(dst, src):
+    if isinstance(dst, dict):
+        for k, v in dst.items():
+            if torch.is_tensor(v):
+                continue
+            if isinstance(v, (dict, list)):
+                _merge_scalars(v, src[k] if isinstance(src, dict) else None)
+            elif isinstance(src, dict) and k in src and src[k] is not None:
+                dst[k] = src[k]
+    elif isinstance(dst, list) and isinstance(src, (list, tuple)):
+        for i, v in enumerate(dst):
+            if isinstance(v, (dict, list)):
+                _merge_scalars(v, src[i])
+            elif not torch.is_tensor(v) and src[i] is not None:
+                dst[i] = src[i]
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Pickle-broadcast an arbitrary object (reference:
+    hvd.broadcast_object)."""
+    name = name or "broadcast_object"
+    if mpi_ops.rank() == root_rank:
+        buf = io.BytesIO()
+        pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = torch.from_numpy(
+            np.frombuffer(buf.getvalue(), dtype=np.uint8).copy())
+    else:
+        payload = torch.zeros(0, dtype=torch.uint8)
+    sz = torch.tensor([payload.numel()], dtype=torch.int64)
+    sz = mpi_ops.broadcast(sz, root_rank, name=f"{name}.len")
+    if mpi_ops.rank() != root_rank:
+        payload = torch.zeros(int(sz[0]), dtype=torch.uint8)
+    payload = mpi_ops.broadcast(payload, root_rank, name=f"{name}.data")
+    return pickle.loads(payload.numpy().tobytes())
+
+
+def allgather_object(obj, name=None):
+    name = name or "allgather_object"
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = torch.from_numpy(
+        np.frombuffer(buf.getvalue(), dtype=np.uint8).copy())
+    sizes = mpi_ops.allgather(torch.tensor([payload.numel()]),
+                              name=f"{name}.len")
+    data = mpi_ops.allgather(payload, name=f"{name}.data")
+    out, off = [], 0
+    for s in sizes.tolist():
+        out.append(pickle.loads(data[off:off + s].numpy().tobytes()))
+        off += s
+    return out

@@ -1,0 +1,218 @@
+"""Torch eager collective ops.
+
+Reference analog: ``horovod/torch/mpi_ops.py`` + ``mpi_ops_v2.cc`` — here
+no C extension is needed: CPU torch tensors expose their storage through
+numpy views, so the core's ctypes enqueue writes results straight into
+tensor memory (the in-place ``allreduce_``/``broadcast_`` semantics).
+"""
+
+import threading
+
+import numpy as np
+import torch
+
+from horovod_tpu.common import eager_ops
+from horovod_tpu.common.eager_ops import ReduceOp
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+Adasum = ReduceOp.ADASUM
+
+_basics = eager_ops._basics
+
+init = _basics.init
+shutdown = _basics.shutdown
+is_initialized = _basics.is_initialized
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+cross_rank = _basics.cross_rank
+cross_size = _basics.cross_size
+
+_name_lock = threading.Lock()
+_name_counters = {}
+
+
+def _auto_name(kind):
+    with _name_lock:
+        n = _name_counters.get(kind, 0)
+        _name_counters[kind] = n + 1
+    return f"{kind}.noname.{n}"
+
+
+def _np_view(tensor):
+    """Contiguous numpy view sharing the CPU tensor's storage."""
+    if tensor.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.torch eager ops require CPU tensors (XLA/TPU "
+            "tensors go through the in-graph path)")
+    t = tensor.detach()
+    if not t.is_contiguous():
+        raise ValueError("tensor must be contiguous for in-place collectives")
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+class Handle:
+    """In-flight op; synchronize() returns the torch result tensor."""
+
+    def __init__(self, inner, output_tensor=None, like=None):
+        self._inner = inner
+        self._output_tensor = output_tensor
+        self._like = like if like is not None else output_tensor
+
+    def poll(self):
+        return self._inner.poll()
+
+    def synchronize(self):
+        out = self._inner.synchronize()
+        if self._output_tensor is not None:
+            return self._output_tensor
+        np_out = np.asarray(out)
+        if self._like is not None and self._like.dtype == torch.bfloat16:
+            import ml_dtypes
+
+            return torch.from_numpy(
+                np_out.view(np.uint16).copy()).view(torch.bfloat16)
+        return torch.from_numpy(np.array(np_out, copy=True))
+
+
+def allreduce_async_(tensor, name=None, op=Average, prescale_factor=1.0,
+                     postscale_factor=1.0, process_set_id=0):
+    """In-place async allreduce; result lands in `tensor`'s storage."""
+    view = _np_view(tensor)
+    inp = np.array(view, copy=True)  # input snapshot; output aliases tensor
+    lib = eager_ops._basics.lib
+    import ctypes
+
+    h = lib.hvdtpu_enqueue_allreduce(
+        (name or _auto_name("allreduce")).encode(),
+        inp.ctypes.data_as(ctypes.c_void_p),
+        view.ctypes.data_as(ctypes.c_void_p), view.ndim,
+        eager_ops._shape_array(view.shape),
+        eager_ops._dtype_enum(view.dtype), int(op), float(prescale_factor),
+        float(postscale_factor), int(process_set_id))
+    inner = eager_ops.Handle(eager_ops._check_handle(h, "allreduce"),
+                             (inp, view, tensor), view, False, view.dtype)
+    return Handle(inner, output_tensor=tensor)
+
+
+def allreduce_async(tensor, name=None, op=Average, prescale_factor=1.0,
+                    postscale_factor=1.0, process_set_id=0):
+    out = tensor.detach().clone()
+    return allreduce_async_(out, name, op, prescale_factor, postscale_factor,
+                            process_set_id)
+
+
+def allreduce(tensor, name=None, op=Average, prescale_factor=1.0,
+              postscale_factor=1.0, process_set_id=0):
+    return allreduce_async(tensor, name, op, prescale_factor,
+                           postscale_factor, process_set_id).synchronize()
+
+
+def allreduce_(tensor, name=None, op=Average, prescale_factor=1.0,
+               postscale_factor=1.0, process_set_id=0):
+    return allreduce_async_(tensor, name, op, prescale_factor,
+                            postscale_factor, process_set_id).synchronize()
+
+
+def grouped_allreduce_async_(tensors, names=None, op=Average,
+                             process_set_id=0):
+    if names is None:
+        base = _auto_name("grouped_allreduce")
+        names = [f"{base}.{i}" for i in range(len(tensors))]
+    return [allreduce_async_(t, n, op, process_set_id=process_set_id)
+            for t, n in zip(tensors, names)]
+
+
+def grouped_allreduce_(tensors, names=None, op=Average, process_set_id=0):
+    hs = grouped_allreduce_async_(tensors, names, op, process_set_id)
+    return [h.synchronize() for h in hs]
+
+
+def allgather_async(tensor, name=None, process_set_id=0):
+    view = _np_view(tensor)
+    inner = eager_ops.allgather_async(
+        np.array(view, copy=True), name or _auto_name("allgather"),
+        process_set_id=process_set_id)
+    return Handle(inner, like=tensor)
+
+
+def allgather(tensor, name=None, process_set_id=0):
+    return allgather_async(tensor, name, process_set_id).synchronize()
+
+
+def broadcast_async_(tensor, root_rank, name=None, process_set_id=0):
+    view = _np_view(tensor)
+    import ctypes
+
+    lib = eager_ops._basics.lib
+    h = lib.hvdtpu_enqueue_broadcast(
+        (name or _auto_name("broadcast")).encode(),
+        view.ctypes.data_as(ctypes.c_void_p), view.ndim,
+        eager_ops._shape_array(view.shape),
+        eager_ops._dtype_enum(view.dtype), int(root_rank),
+        int(process_set_id))
+    inner = eager_ops.Handle(eager_ops._check_handle(h, "broadcast"),
+                             (view, tensor), view, False, view.dtype)
+    return Handle(inner, output_tensor=tensor)
+
+
+def broadcast_async(tensor, root_rank, name=None, process_set_id=0):
+    out = tensor.detach().clone()
+    return broadcast_async_(out, root_rank, name, process_set_id)
+
+
+def broadcast(tensor, root_rank, name=None, process_set_id=0):
+    return broadcast_async(tensor, root_rank, name,
+                           process_set_id).synchronize()
+
+
+def broadcast_(tensor, root_rank, name=None, process_set_id=0):
+    return broadcast_async_(tensor, root_rank, name,
+                            process_set_id).synchronize()
+
+
+def alltoall_async(tensor, splits=None, name=None, process_set_id=0):
+    view = _np_view(tensor)
+    inner = eager_ops.alltoall_async(
+        np.array(view, copy=True),
+        None if splits is None else np.asarray(splits),
+        name or _auto_name("alltoall"), process_set_id=process_set_id)
+    return Handle(inner, like=tensor)
+
+
+def alltoall(tensor, splits=None, name=None, process_set_id=0):
+    return alltoall_async(tensor, splits, name, process_set_id).synchronize()
+
+
+def reducescatter_async(tensor, name=None, op=Average, process_set_id=0):
+    view = _np_view(tensor)
+    inner = eager_ops.reducescatter_async(
+        np.array(view, copy=True), name or _auto_name("reducescatter"),
+        op=op, process_set_id=process_set_id)
+    return Handle(inner, like=tensor)
+
+
+def reducescatter(tensor, name=None, op=Average, process_set_id=0):
+    return reducescatter_async(tensor, name, op,
+                               process_set_id).synchronize()
+
+
+def synchronize(handle):
+    return handle.synchronize()
+
+
+def poll(handle):
+    return handle.poll()
+
+
+def barrier(process_set_id=0):
+    eager_ops.barrier(process_set_id=process_set_id)

@@ -1,0 +1,126 @@
+"""DistributedOptimizer for torch: async per-parameter gradient allreduce.
+
+Reference analog: ``horovod/torch/optimizer.py`` ``_DistributedOptimizer``
+— per-param hooks fire an async allreduce the moment a gradient is
+accumulated (overlapping communication with the rest of backward);
+``step()`` synchronizes every handle, writes the averaged gradients back
+and runs the wrapped optimizer. Local gradient aggregation
+(``backward_passes_per_step``) and wire compression are supported.
+
+Mechanically we subclass the wrapped optimizer's class at runtime (the
+reference's trick) so isinstance checks and schedulers keep working.
+"""
+
+import contextlib
+
+import torch
+
+from horovod_tpu.torch import mpi_ops
+from horovod_tpu.torch.compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step, op, process_set_id):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._op = op
+        self._process_set_id = process_set_id
+        self.backward_passes_per_step = backward_passes_per_step
+        self._handles = {}       # param -> (Handle, ctx)
+        self._allreduce_delay = {}
+        self._should_synchronize = True
+        self._hook_handles = []
+
+        if named_parameters is not None:
+            self._param_names = {p: name for name, p in named_parameters}
+        else:
+            self._param_names = {
+                p: f"param.{gi}.{pi}"
+                for gi, group in enumerate(self.param_groups)
+                for pi, p in enumerate(group["params"])}
+
+        if mpi_ops.size() > 1:
+            self._register_hooks()
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook()))
+
+    def _make_hook(self):
+        def hook(p):
+            if p not in self._allreduce_delay:
+                return
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = f"allreduce.{self._param_names.get(p, 'noname')}"
+        grad = p.grad
+        if self.backward_passes_per_step > 1:
+            grad = grad / self.backward_passes_per_step
+        compressed, ctx = self._compression.compress(grad.contiguous())
+        handle = mpi_ops.allreduce_async(
+            compressed, name=name, op=self._op,
+            process_set_id=self._process_set_id)
+        self._handles[p] = (handle, ctx)
+
+    def synchronize(self):
+        """Wait for all outstanding allreduces; write averaged grads back."""
+        # Params whose countdown has not fired (e.g. user stepped early)
+        # are flushed now, like the reference's missing-handle path.
+        for p, delay in self._allreduce_delay.items():
+            if 0 < delay < self.backward_passes_per_step \
+                    and p not in self._handles and p.grad is not None:
+                self._allreduce_grad_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            out = handle.synchronize()
+            p.grad.copy_(self._compression.decompress(out, ctx)
+                         .view_as(p.grad))
+            self._allreduce_delay[p] = self.backward_passes_per_step
+        self._handles.clear()
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """For the clip-grad pattern: synchronize() manually, clip, then
+        ``with optimizer.skip_synchronize(): optimizer.step()``."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize and mpi_ops.size() > 1:
+            self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad called with allreduces in flight; call "
+                "optimizer.step() or optimizer.synchronize() first")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1, op=mpi_ops.Average,
+                         process_set_id=0):
+    """Wrap a torch optimizer for data-parallel training.
+
+    Reference analog: hvd.DistributedOptimizer (horovod/torch/optimizer.py).
+    """
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    if named_parameters is not None:
+        named_parameters = list(named_parameters)
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, process_set_id)

@@ -1,0 +1,100 @@
+"""Cross-rank synchronized BatchNorm.
+
+Reference analog: ``horovod/torch/sync_batch_norm.py`` — batch statistics
+are allreduce-averaged across ranks in forward, and the two gradient sums
+are allreduced in backward, so the layer behaves as if the global batch
+were on one device. Assumes equal per-rank batch sizes (the reference's
+common case; it gathers counts — we keep the fast equal-size path).
+"""
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from horovod_tpu.torch import mpi_ops
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, running_mean, running_var, eps,
+                momentum, training, name):
+        c = x.size(1)
+        dims = [0] + list(range(2, x.dim()))
+        shape = [1, c] + [1] * (x.dim() - 2)
+
+        if training:
+            local_count = x.numel() // c
+            mean = x.mean(dims)
+            sqmean = (x * x).mean(dims)
+            stats = mpi_ops.allreduce(torch.cat([mean, sqmean]),
+                                      op=mpi_ops.Average,
+                                      name=f"sync_bn.{name}.fwd")
+            mean, sqmean = stats[:c], stats[c:]
+            var = (sqmean - mean * mean).clamp_(min=0)
+            world = mpi_ops.size()
+            total = local_count * world
+            if running_mean is not None:
+                unbiased = var * total / max(total - 1, 1)
+                running_mean.mul_(1 - momentum).add_(mean, alpha=momentum)
+                running_var.mul_(1 - momentum).add_(unbiased,
+                                                    alpha=momentum)
+        else:
+            mean, var = running_mean, running_var
+
+        invstd = torch.rsqrt(var + eps)
+        xhat = (x - mean.view(shape)) * invstd.view(shape)
+        out = xhat * weight.view(shape) + bias.view(shape)
+        ctx.save_for_backward(xhat, weight, invstd)
+        ctx.dims = dims
+        ctx.shape = shape
+        ctx.training = training
+        ctx.name = name
+        return out
+
+    @staticmethod
+    def backward(ctx, dy):
+        xhat, weight, invstd = ctx.saved_tensors
+        dims, shape = ctx.dims, ctx.shape
+        c = xhat.size(1)
+        n = xhat.numel() // c
+
+        grad_weight = (dy * xhat).sum(dims)
+        grad_bias = dy.sum(dims)
+
+        if ctx.training:
+            stats = mpi_ops.allreduce(
+                torch.cat([grad_bias, grad_weight]) / n,
+                op=mpi_ops.Average, name=f"sync_bn.{ctx.name}.bwd")
+            mean_dy, mean_dy_xhat = stats[:c], stats[c:]
+            dx = (weight * invstd).view(shape) * (
+                dy - mean_dy.view(shape) - xhat * mean_dy_xhat.view(shape))
+        else:
+            dx = (weight * invstd).view(shape) * dy
+        return dx, grad_weight, grad_bias, None, None, None, None, None, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in replacement for BatchNorm1d/2d/3d with cross-rank stats."""
+
+    _counter = 0
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._name = f"bn{SyncBatchNorm._counter}"
+        SyncBatchNorm._counter += 1
+
+    def _check_input_dim(self, x):
+        if x.dim() < 2:
+            raise ValueError(f"expected at least 2D input, got {x.dim()}D")
+
+    def forward(self, x):
+        self._check_input_dim(x)
+        training = self.training or not self.track_running_stats
+        if not training or mpi_ops.size() == 1:
+            return torch.nn.functional.batch_norm(
+                x, self.running_mean, self.running_var, self.weight,
+                self.bias, training, self.momentum, self.eps)
+        if self.track_running_stats:
+            self.num_batches_tracked.add_(1)
+        return _SyncBatchNormFn.apply(
+            x, self.weight, self.bias, self.running_mean, self.running_var,
+            self.eps, self.momentum, training, self._name)
