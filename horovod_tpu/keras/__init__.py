@@ -1,0 +1,92 @@
+"""horovod_tpu.keras — the Keras frontend
+(``import horovod_tpu.keras as hvd``).
+
+Reference analog: ``horovod/keras/__init__.py`` + ``horovod/_keras/`` —
+``DistributedOptimizer`` that averages gradients before apply, plus the
+canonical callbacks (broadcast, metric averaging, LR warmup/schedule).
+"""
+
+import tensorflow as tf
+
+from horovod_tpu.tensorflow import (  # noqa: F401
+    Adasum,
+    Average,
+    Compression,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    broadcast_variables,
+    cross_rank,
+    cross_size,
+    grouped_allreduce,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    reducescatter,
+    shutdown,
+    size,
+)
+from horovod_tpu.keras import callbacks  # noqa: F401
+
+
+class DistributedOptimizer:
+    """Wrap a keras optimizer: gradients are allreduce-averaged across
+    ranks before ``apply_gradients``.
+
+    Reference analog: hvd.DistributedOptimizer
+    (horovod/_keras/__init__.py create_distributed_optimizer). Wrapping
+    is by composition + delegation so it works across keras optimizer API
+    generations.
+    """
+
+    def __init__(self, optimizer, compression=Compression.none, op=Average,
+                 backward_passes_per_step=1):
+        if backward_passes_per_step != 1:
+            raise NotImplementedError(
+                "backward_passes_per_step > 1 for keras lands with the "
+                "gradient-aggregation helper")
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def _allreduce(self, grads):
+        from horovod_tpu.tensorflow import mpi_ops
+
+        compressed, ctxs = [], []
+        for g in grads:
+            if isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            c, ctx = self._compression.compress(g)
+            compressed.append(c)
+            ctxs.append(ctx)
+        reduced = mpi_ops.grouped_allreduce(
+            compressed, names=[f"keras.grad.{i}"
+                               for i in range(len(compressed))],
+            op=self._op)
+        return [self._compression.decompress(r, ctx)
+                for r, ctx in zip(reduced, ctxs)]
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        grads_and_vars = list(grads_and_vars)
+        grads = self._allreduce([g for g, _ in grads_and_vars])
+        return self._opt.apply_gradients(
+            zip(grads, [v for _, v in grads_and_vars]), **kwargs)
+
+    # keras 3 calls optimizer.apply(grads, vars)
+    def apply(self, grads, variables=None, **kwargs):
+        grads = self._allreduce(list(grads))
+        if variables is None:
+            return self._opt.apply(grads, **kwargs)
+        return self._opt.apply(grads, variables, **kwargs)

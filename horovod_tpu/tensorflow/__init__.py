@@ -1,0 +1,95 @@
+"""horovod_tpu.tensorflow — the TensorFlow frontend
+(``import horovod_tpu.tensorflow as hvd``).
+
+Reference analog: ``horovod/tensorflow/__init__.py`` — init/rank/size,
+collectives, ``DistributedGradientTape``, ``broadcast_variables``,
+``Compression``.
+"""
+
+import tensorflow as tf
+
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
+from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    broadcast_variables,
+    cross_rank,
+    cross_size,
+    grouped_allreduce,
+    init,
+    is_initialized,
+    join,
+    local_rank,
+    local_size,
+    rank,
+    reducescatter,
+    shutdown,
+    size,
+)
+
+
+class DistributedGradientTape:
+    """Wrap a ``tf.GradientTape`` so ``gradient()`` returns allreduce-
+    averaged gradients.
+
+    Reference analog: hvd.DistributedGradientTape
+    (horovod/tensorflow/__init__.py _DistributedGradientTape).
+    """
+
+    def __init__(self, tape, compression=Compression.none, op=Average,
+                 process_set_id=0):
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+        self._process_set_id = process_set_id
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients)
+        return self._allreduce_grads(grads)
+
+    def _allreduce_grads(self, grads):
+        flat = tf.nest.flatten(grads)
+        compressed, ctxs, live_ix = [], [], []
+        for i, g in enumerate(flat):
+            if g is None:
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            c, ctx = self._compression.compress(g)
+            compressed.append(c)
+            ctxs.append(ctx)
+            live_ix.append(i)
+        from horovod_tpu.tensorflow import mpi_ops
+
+        reduced = mpi_ops.grouped_allreduce(
+            compressed, names=[f"tape.grad.{i}" for i in live_ix],
+            op=self._op, process_set_id=self._process_set_id)
+        out = list(flat)
+        for i, r, ctx in zip(live_ix, reduced, ctxs):
+            out[i] = self._compression.decompress(r, ctx)
+        return tf.nest.pack_sequence_as(grads, out)

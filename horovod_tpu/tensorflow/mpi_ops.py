@@ -1,0 +1,152 @@
+"""TensorFlow eager collective ops.
+
+Reference analog: ``horovod/tensorflow/mpi_ops.py`` + ``mpi_ops.cc``. The
+reference registers TF custom C ops; here eager tensors round-trip
+through the shared numpy engine (``common/eager_ops``) and graph-mode use
+goes through ``tf.py_function`` — on TPU the in-graph path is
+``horovod_tpu.parallel`` (XLA collectives), mirroring how upstream's
+``xla_mpi_ops.cc`` bridges into XLA programs.
+"""
+
+import threading
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu.common import eager_ops
+from horovod_tpu.common.eager_ops import ReduceOp
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+Adasum = ReduceOp.ADASUM
+
+_basics = eager_ops._basics
+
+init = _basics.init
+shutdown = _basics.shutdown
+is_initialized = _basics.is_initialized
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+cross_rank = _basics.cross_rank
+cross_size = _basics.cross_size
+
+_name_lock = threading.Lock()
+_name_counters = {}
+
+
+def _auto_name(kind):
+    with _name_lock:
+        n = _name_counters.get(kind, 0)
+        _name_counters[kind] = n + 1
+    return f"{kind}.noname.{n}"
+
+
+def _to_np(tensor):
+    if isinstance(tensor, tf.Tensor) or isinstance(tensor, tf.Variable):
+        arr = tensor.numpy()
+    else:
+        arr = np.asarray(tensor)
+    return np.ascontiguousarray(arr)
+
+
+def _run_numpy(fn, tensor, out_dtype=None):
+    """Run a host collective on an eager or graph tensor."""
+    if tf.executing_eagerly() and not isinstance(tensor,
+                                                 tf.__internal__.FuncGraph):
+        return tf.convert_to_tensor(fn(_to_np(tensor)))
+    return tf.py_function(lambda t: fn(t.numpy()), [tensor],
+                          Tout=out_dtype or tensor.dtype)
+
+
+def allreduce(tensor, name=None, op=Average, prescale_factor=1.0,
+              postscale_factor=1.0, process_set_id=0):
+    nm = name or _auto_name("allreduce")
+
+    def _fn(arr):
+        return eager_ops.allreduce_async(
+            arr, nm, op=op, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set_id=process_set_id).synchronize()
+
+    return _run_numpy(_fn, tensor)
+
+
+def grouped_allreduce(tensors, names=None, op=Average, process_set_id=0):
+    if names is None:
+        base = _auto_name("grouped_allreduce")
+        names = [f"{base}.{i}" for i in range(len(tensors))]
+    arrs = [_to_np(t) for t in tensors]
+    if arrs and all(a.dtype == arrs[0].dtype for a in arrs):
+        handles = eager_ops.grouped_allreduce_async(
+            arrs, names, op=op, process_set_id=process_set_id)
+        return [tf.convert_to_tensor(h.synchronize()) for h in handles]
+    return [allreduce(t, n, op=op, process_set_id=process_set_id)
+            for t, n in zip(tensors, names)]
+
+
+def allgather(tensor, name=None, process_set_id=0):
+    nm = name or _auto_name("allgather")
+
+    def _fn(arr):
+        return eager_ops.allgather_async(
+            arr, nm, process_set_id=process_set_id).synchronize()
+
+    return _run_numpy(_fn, tensor)
+
+
+def broadcast(tensor, root_rank, name=None, process_set_id=0):
+    nm = name or _auto_name("broadcast")
+
+    def _fn(arr):
+        return eager_ops.broadcast_async(
+            arr, root_rank, nm,
+            process_set_id=process_set_id).synchronize()
+
+    return _run_numpy(_fn, tensor)
+
+
+def alltoall(tensor, splits=None, name=None, process_set_id=0):
+    nm = name or _auto_name("alltoall")
+
+    def _fn(arr):
+        return eager_ops.alltoall_async(
+            arr, None if splits is None else np.asarray(splits), nm,
+            process_set_id=process_set_id).synchronize()
+
+    return _run_numpy(_fn, tensor)
+
+
+def reducescatter(tensor, name=None, op=Average, process_set_id=0):
+    nm = name or _auto_name("reducescatter")
+
+    def _fn(arr):
+        return eager_ops.reducescatter_async(
+            arr, nm, op=op, process_set_id=process_set_id).synchronize()
+
+    return _run_numpy(_fn, tensor)
+
+
+def broadcast_variables(variables, root_rank=0, prefix="var"):
+    """Assign every variable its root-rank value (reference:
+    hvd.broadcast_variables)."""
+    handles = []
+    for i, v in enumerate(variables):
+        arr = _to_np(v)
+        handles.append((v, eager_ops.broadcast_async(
+            arr, root_rank, f"broadcast.{prefix}.{i}")))
+    for v, h in handles:
+        v.assign(h.synchronize())
+
+
+def join():
+    raise NotImplementedError(
+        "hvd.join is not implemented yet (planned: core-level join op)")
+
+
+def barrier(process_set_id=0):
+    eager_ops.barrier(process_set_id=process_set_id)

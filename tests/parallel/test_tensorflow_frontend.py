@@ -1,0 +1,128 @@
+"""Distributed correctness of the TF/Keras frontends.
+
+Reference analog: test/parallel/test_tensorflow.py +
+test_tensorflow2_keras.py (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from tests.utils_mp import run_ranks
+
+_TF_ENV = {"TF_CPP_MIN_LOG_LEVEL": "3", "CUDA_VISIBLE_DEVICES": ""}
+
+
+def _worker_tf_ops(rank, size):
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    try:
+        assert hvd.rank() == rank and hvd.size() == size
+
+        r = hvd.allreduce(tf.fill([4, 3], float(rank)), op=hvd.Sum)
+        np.testing.assert_allclose(r.numpy(), sum(range(size)))
+
+        avg = hvd.allreduce(tf.fill([5], float(rank)))
+        np.testing.assert_allclose(avg.numpy(), sum(range(size)) / size)
+
+        g = hvd.allgather(tf.fill([rank + 1, 2], float(rank)))
+        assert g.shape == (sum(range(1, size + 1)), 2)
+
+        b = hvd.broadcast(tf.fill([3], float(rank)), root_rank=size - 1)
+        np.testing.assert_allclose(b.numpy(), float(size - 1))
+
+        outs = hvd.grouped_allreduce(
+            [tf.fill([2], float(rank + i)) for i in range(3)], op=hvd.Sum)
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o.numpy(),
+                                       sum(rk + i for rk in range(size)))
+
+        # broadcast_variables
+        v = tf.Variable(tf.fill([4], float(rank)))
+        hvd.broadcast_variables([v], root_rank=0)
+        np.testing.assert_allclose(v.numpy(), 0.0)
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+@pytest.mark.parametrize("size", [2])
+def test_tf_ops(size):
+    assert run_ranks(_worker_tf_ops, size, env=_TF_ENV, timeout=180) \
+        == ["ok"] * size
+
+
+def _worker_gradient_tape(rank, size):
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    try:
+        w = tf.Variable([[1.0], [2.0]])
+        x = tf.constant([[float(rank + 1), 0.0]])
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            y = tf.reduce_sum(tf.matmul(x, w))
+        (gw,) = tape.gradient(y, [w])
+        # dy/dw = x^T; averaged across ranks
+        exp = np.array([[np.mean([rk + 1 for rk in range(size)])], [0.0]])
+        np.testing.assert_allclose(gw.numpy(), exp)
+
+        # fp16 compression path
+        with hvd.DistributedGradientTape(tf.GradientTape(),
+                                         compression=hvd.Compression.fp16) \
+                as tape2:
+            y2 = tf.reduce_sum(tf.matmul(x, w))
+        (gw2,) = tape2.gradient(y2, [w])
+        assert gw2.dtype == tf.float32
+        np.testing.assert_allclose(gw2.numpy(), exp, rtol=1e-3)
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_distributed_gradient_tape():
+    assert run_ranks(_worker_gradient_tape, 2, env=_TF_ENV, timeout=180) \
+        == ["ok"] * 2
+
+
+def _worker_keras(rank, size):
+    import tensorflow as tf
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    try:
+        tf.keras.utils.set_random_seed(42 + rank)
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(4, input_shape=(8,)),
+             tf.keras.layers.Dense(1)])
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+
+        # broadcast weights from rank 0 (diverged seeds above)
+        hvd.broadcast_variables(model.variables, root_rank=0,
+                                prefix="model")
+
+        x = tf.random.stateless_uniform([4, 8], seed=[rank, 1])
+        y = tf.random.stateless_uniform([4, 1], seed=[rank, 2])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((model(x) - y) ** 2)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+        # all ranks converge to identical weights
+        import horovod_tpu.tensorflow as hvdtf
+
+        for i, v in enumerate(model.trainable_variables):
+            gathered = hvdtf.allgather(
+                tf.reshape(v, [1, -1]), name=f"check.{i}")
+            arr = gathered.numpy()
+            for row in arr[1:]:
+                np.testing.assert_allclose(row, arr[0], rtol=1e-5,
+                                           atol=1e-6)
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_keras_optimizer():
+    assert run_ranks(_worker_keras, 2, env=_TF_ENV, timeout=240) == ["ok"] * 2
