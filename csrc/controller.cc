@@ -128,9 +128,31 @@ Status Controller::Initialize() {
   return Status::OK();
 }
 
+void Controller::MaybePromote(const std::string& name, PendingTensor& pt) {
+  if (pt.queued) return;
+  int covered = (int)pt.ranks_seen.size();
+  for (int32_t r : joined_ranks_) {
+    if (!pt.ranks_seen.count(r)) covered++;
+  }
+  if (covered == cfg_.size) {
+    pt.queued = true;
+    ready_queue_.push_back(name);
+  }
+}
+
 void Controller::HandleRequestList(const RequestList& list, int from_rank) {
   if (list.shutdown) shutdown_flags_[from_rank] = true;
+  bool new_join = false;
   for (const auto& req : list.requests) {
+    if (req.request_type == RequestType::JOIN) {
+      // Reference analog: controller.cc join accounting (EnqueueJoin).
+      if (!joined_ranks_.count(req.request_rank)) {
+        joined_ranks_.insert(req.request_rank);
+        last_joined_rank_ = req.request_rank;
+        new_join = true;
+      }
+      continue;
+    }
     auto& pt = message_table_[req.tensor_name];
     if (pt.ranks_seen.empty()) {
       pt.first_seen = std::chrono::steady_clock::now();
@@ -138,9 +160,11 @@ void Controller::HandleRequestList(const RequestList& list, int from_rank) {
     if (pt.ranks_seen.count(req.request_rank)) continue;  // duplicate
     pt.ranks_seen.insert(req.request_rank);
     pt.requests.push_back(req);
-    if ((int)pt.ranks_seen.size() == cfg_.size) {
-      ready_queue_.push_back(req.tensor_name);
-    }
+    MaybePromote(req.tensor_name, pt);
+  }
+  if (new_join) {
+    // A new join can complete readiness for any pending tensor.
+    for (auto& kv : message_table_) MaybePromote(kv.first, kv.second);
   }
 }
 
@@ -150,6 +174,21 @@ Response Controller::BuildResponse(const std::string& name) {
   res.tensor_names = {name};
   const Request& first = pt.requests.front();
   res.tensor_type = first.tensor_type;
+  res.reduce_op = first.reduce_op;
+  res.root_rank = first.root_rank;
+  res.process_set_id = first.process_set_id;
+  res.tensor_shapes.push_back((int64_t)first.tensor_shape.size());
+  res.tensor_shapes.insert(res.tensor_shapes.end(),
+                           first.tensor_shape.begin(),
+                           first.tensor_shape.end());
+  if (!joined_ranks_.empty() &&
+      (int)pt.ranks_seen.size() < cfg_.size &&
+      first.request_type == RequestType::ALLTOALL) {
+    res.response_type = Response::ResponseType::ERROR;
+    res.error_message =
+        "tensor " + name + ": alltoall is not supported with joined ranks";
+    return res;
+  }
 
   // Cross-rank validation.
   // Reference analog: Controller::ConstructResponse error paths.
@@ -210,7 +249,10 @@ Response Controller::BuildResponse(const std::string& name) {
       res.response_type = Response::ResponseType::BARRIER;
       break;
     case RequestType::JOIN:
-      res.response_type = Response::ResponseType::JOIN;
+      // JOIN never reaches BuildResponse: HandleRequestList diverts it to
+      // joined_ranks_ and FuseResponses emits the JOIN response directly.
+      res.response_type = Response::ResponseType::ERROR;
+      res.error_message = "internal: JOIN request in BuildResponse";
       break;
   }
   return res;
@@ -247,6 +289,10 @@ ResponseList Controller::FuseResponses() {
         nbytes *= DataTypeSize(nreq.tensor_type);
         if (bytes + nbytes > cfg_.fusion_threshold_bytes) break;
         res.tensor_names.push_back(next);
+        res.tensor_shapes.push_back((int64_t)nreq.tensor_shape.size());
+        res.tensor_shapes.insert(res.tensor_shapes.end(),
+                                 nreq.tensor_shape.begin(),
+                                 nreq.tensor_shape.end());
         bytes += nbytes;
         message_table_.erase(next);
         ready_queue_.pop_front();
@@ -254,6 +300,17 @@ ResponseList Controller::FuseResponses() {
     }
     message_table_.erase(name);
     list.responses.push_back(std::move(res));
+  }
+  // All ranks joined: complete every rank's pending join.
+  // Reference analog: controller.cc join completion (last_joined_rank).
+  if ((int)joined_ranks_.size() == cfg_.size) {
+    Response join;
+    join.response_type = Response::ResponseType::JOIN;
+    join.tensor_names = {"__join__"};
+    join.last_joined_rank = last_joined_rank_;
+    list.responses.push_back(std::move(join));
+    joined_ranks_.clear();
+    last_joined_rank_ = -1;
   }
   return list;
 }
@@ -271,7 +328,9 @@ void Controller::CheckForStalledTensors() {
     if (waited > cfg_.stall_warning_secs) {
       std::ostringstream missing;
       for (int r = 0; r < cfg_.size; r++) {
-        if (!kv.second.ranks_seen.count(r)) missing << r << " ";
+        if (!kv.second.ranks_seen.count(r) && !joined_ranks_.count(r)) {
+          missing << r << " ";
+        }
       }
       LOG_WARN(
           "Stall detected: tensor %s has waited %.0fs; missing ranks: %s"

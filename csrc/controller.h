@@ -73,10 +73,17 @@ class Controller {
     std::vector<Request> requests;          // one per reporting rank
     std::unordered_set<int32_t> ranks_seen;
     std::chrono::steady_clock::time_point first_seen;
+    bool queued = false;  // already pushed on ready_queue_
   };
+  // A tensor is ready once every rank has either requested it or joined.
+  // Reference analog: controller.cc join handling (joined ranks count as
+  // ready for every tensor).
+  void MaybePromote(const std::string& name, PendingTensor& pt);
   std::unordered_map<std::string, PendingTensor> message_table_;
   std::deque<std::string> ready_queue_;  // all-ranks-ready, FIFO order
   std::vector<bool> shutdown_flags_;
+  std::unordered_set<int32_t> joined_ranks_;
+  int32_t last_joined_rank_ = -1;
   std::chrono::steady_clock::time_point last_stall_check_;
 };
 

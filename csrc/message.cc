@@ -117,6 +117,10 @@ void WriteResponse(Writer& w, const Response& r) {
   w.PutString(r.error_message);
   w.Put<int32_t>((int32_t)r.tensor_type);
   w.PutI64Vec(r.tensor_sizes);
+  w.PutI64Vec(r.tensor_shapes);
+  w.Put<int32_t>((int32_t)r.reduce_op);
+  w.Put<int32_t>(r.root_rank);
+  w.Put<int32_t>(r.process_set_id);
   w.Put<int32_t>(r.last_joined_rank);
 }
 
@@ -132,6 +136,11 @@ bool ReadResponse(Reader& rd, Response* r) {
   ok = ok && rd.Get(&t);
   r->tensor_type = (DataType)t;
   ok = ok && rd.GetI64Vec(&r->tensor_sizes);
+  ok = ok && rd.GetI64Vec(&r->tensor_shapes);
+  ok = ok && rd.Get(&t);
+  r->reduce_op = (ReduceOp)t;
+  ok = ok && rd.Get(&r->root_rank);
+  ok = ok && rd.Get(&r->process_set_id);
   ok = ok && rd.Get(&r->last_joined_rank);
   return ok;
 }

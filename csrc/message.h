@@ -63,8 +63,13 @@ struct Response {
   // Allgather/reducescatter: per tensor, per rank first-dimension sizes, laid
   // out [tensor0_rank0, tensor0_rank1, ..., tensor1_rank0, ...].
   std::vector<int64_t> tensor_sizes;
-  // Alltoall: recv splits for this... (rank-specific data goes via exchange);
-  // kept empty in broadcasted responses.
+  // Per-tensor full shapes, flattened [ndim0, dims0..., ndim1, dims1...].
+  // Lets a joined rank synthesize a zero contribution for a tensor it never
+  // enqueued (reference analog: Response::tensor_sizes use in join path).
+  std::vector<int64_t> tensor_shapes;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  int32_t root_rank = 0;  // broadcast: joined ranks need it to synthesize
+  int32_t process_set_id = 0;
   int32_t last_joined_rank = -1;
 };
 

@@ -119,6 +119,14 @@ struct GlobalState {
   std::atomic<int64_t> fusion_threshold{64 * 1024 * 1024};
   std::atomic<double> cycle_time_ms{1.0};
   std::vector<uint8_t> fusion_buffer;  // reference: fusion_buffer_manager.cc
+  // Join state: set once this rank's JOIN request is in flight; while set,
+  // the bg thread synthesizes zero contributions for collectives this rank
+  // never enqueued. Reference analog: global_state.h joined flag.
+  std::atomic<bool> joined{false};
+  std::atomic<int> last_joined_rank{-1};
+  // Barrier sequence number; must stay aligned across ranks, including
+  // barriers a joined rank participated in only via synthesis.
+  std::atomic<int64_t> barrier_counter{0};
 };
 
 GlobalState* g_state = nullptr;
@@ -302,8 +310,87 @@ Status ExecuteEntry(GlobalState& st, const Response& response,
   }
 }
 
+// A joined rank participates in collectives it never enqueued by
+// contributing zeros of the negotiated shape/dtype. The synthesized entry
+// has handle = -1 (no caller waits on it).
+// Reference analog: join support in operations.cc (zero-filled tensors).
+void SynthesizeJoinedEntries(GlobalState& st, const Response& response,
+                             std::vector<TensorTableEntry>* entries,
+                             std::vector<std::vector<uint8_t>>* zero_bufs) {
+  // Decode flattened [ndim, dims...] per tensor.
+  std::vector<std::vector<int64_t>> shapes;
+  size_t pos = 0;
+  while (pos < response.tensor_shapes.size()) {
+    int64_t ndim = response.tensor_shapes[pos++];
+    std::vector<int64_t> shape(response.tensor_shapes.begin() + pos,
+                               response.tensor_shapes.begin() + pos + ndim);
+    pos += ndim;
+    shapes.push_back(std::move(shape));
+  }
+  std::vector<TensorTableEntry> ordered;
+  ordered.reserve(response.tensor_names.size());
+  for (size_t i = 0; i < response.tensor_names.size(); i++) {
+    const std::string& name = response.tensor_names[i];
+    bool found = false;
+    for (auto& e : *entries) {
+      if (e.name == name) {
+        ordered.push_back(std::move(e));
+        found = true;
+        break;
+      }
+    }
+    if (found) continue;
+    TensorTableEntry e;
+    e.name = name;
+    e.handle = -1;
+    e.dtype = response.tensor_type;
+    e.reduce_op = response.reduce_op;
+    e.root_rank = response.root_rank;
+    e.process_set_id = response.process_set_id;
+    e.shape = i < shapes.size() ? shapes[i] : std::vector<int64_t>{};
+    if (response.response_type == Response::ResponseType::ALLGATHER) {
+      // This rank contributes zero rows.
+      if (!e.shape.empty()) e.shape[0] = 0;
+    }
+    if (response.response_type == Response::ResponseType::BARRIER) {
+      // Keep the local barrier sequence aligned with the ranks that
+      // actually enqueued "__barrier__.N" (else every post-join barrier
+      // would negotiate under mismatched names and hang).
+      size_t dot = name.rfind('.');
+      if (dot != std::string::npos) {
+        int64_t n = strtoll(name.c_str() + dot + 1, nullptr, 10);
+        int64_t cur = st.barrier_counter.load();
+        while (cur < n + 1 &&
+               !st.barrier_counter.compare_exchange_weak(cur, n + 1)) {
+        }
+      }
+    }
+    zero_bufs->emplace_back((size_t)e.SizeBytes(), 0);
+    e.input = zero_bufs->back().data();
+    e.output = zero_bufs->back().data();
+    ordered.push_back(std::move(e));
+  }
+  *entries = std::move(ordered);
+}
+
 void ExecuteResponse(GlobalState& st, const Response& response) {
   auto entries = st.tensor_queue.GetTensorEntriesFromResponse(response);
+  if (response.response_type == Response::ResponseType::JOIN) {
+    st.last_joined_rank = response.last_joined_rank;
+    st.joined = false;
+    Status ok = Status::OK();
+    for (auto& e : entries) {
+      st.timeline.EntryDone(e.name);
+      st.handles.MarkDone(e.handle, ok, &e);
+    }
+    return;
+  }
+  std::vector<std::vector<uint8_t>> zero_bufs;
+  if (st.joined.load() &&
+      entries.size() < response.tensor_names.size() &&
+      response.response_type != Response::ResponseType::ERROR) {
+    SynthesizeJoinedEntries(st, response, &entries, &zero_bufs);
+  }
   Status status = Status::OK();
   if (response.response_type == Response::ResponseType::ERROR) {
     status = Status::PreconditionError(response.error_message);
@@ -391,6 +478,8 @@ int hvdtpu_init() {
   GlobalState* st = g_state;
   st->shutdown_requested = false;
   st->loop_exited = false;
+  st->joined = false;
+  st->barrier_counter = 0;  // elastic re-init: new workers start at 0
   st->rank = (int)EnvInt64("HOROVOD_RANK", 0);
   st->size = (int)EnvInt64("HOROVOD_SIZE", 1);
   st->local_rank = (int)EnvInt64("HOROVOD_LOCAL_RANK", st->rank);
@@ -601,11 +690,29 @@ int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
   return EnqueueEntry(std::move(e), std::move(m));
 }
 
+int hvdtpu_enqueue_join() {
+  CHECK_INIT(-1)
+  // Reference analog: horovod_join / EnqueueJoin (operations.cc). The rank
+  // stops contributing data; until every rank joins, the bg loop fills in
+  // zero contributions for negotiated collectives.
+  g_state->joined = true;
+  TensorTableEntry e;
+  e.name = "__join__";
+  Request m;
+  m.request_type = RequestType::JOIN;
+  m.tensor_name = e.name;
+  return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_last_joined_rank() {
+  CHECK_INIT(-1)
+  return g_state->last_joined_rank.load();
+}
+
 int hvdtpu_enqueue_barrier(int process_set_id) {
   CHECK_INIT(-1)
-  static std::atomic<int64_t> barrier_counter{0};
   TensorTableEntry e;
-  e.name = "__barrier__." + std::to_string(barrier_counter++);
+  e.name = "__barrier__." + std::to_string(g_state->barrier_counter++);
   e.process_set_id = process_set_id;
   Request m;
   m.request_type = RequestType::BARRIER;

@@ -49,6 +49,11 @@ int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
                                  int reduce_op, double prescale,
                                  double postscale, int process_set_id);
 int hvdtpu_enqueue_barrier(int process_set_id);
+// Join: this rank is out of data; returns a handle that completes once every
+// rank has joined. After completion, hvdtpu_last_joined_rank() gives the
+// last rank to join. Reference analog: horovod_join (operations.cc).
+int hvdtpu_enqueue_join();
+int hvdtpu_last_joined_rank();
 
 // Handle API (reference analog: horovod/torch/handle_manager.h).
 int hvdtpu_poll(int handle);                  // 1 done, 0 in flight, <0 bad

@@ -78,6 +78,10 @@ def load_library():
             cstr, p, i32, i64p, i32, i32, dbl, dbl, i32]
         lib.hvdtpu_enqueue_barrier.restype = i32
         lib.hvdtpu_enqueue_barrier.argtypes = [i32]
+        lib.hvdtpu_enqueue_join.restype = i32
+        lib.hvdtpu_enqueue_join.argtypes = []
+        lib.hvdtpu_last_joined_rank.restype = i32
+        lib.hvdtpu_last_joined_rank.argtypes = []
 
         lib.hvdtpu_poll.restype = i32
         lib.hvdtpu_poll.argtypes = [i32]

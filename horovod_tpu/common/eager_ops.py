@@ -252,3 +252,16 @@ def barrier(process_set_id=0):
     lib = _basics.lib
     h = lib.hvdtpu_enqueue_barrier(int(process_set_id))
     Handle(_check_handle(h, "barrier"), (), None, False, None).synchronize()
+
+
+def join():
+    """This rank is out of data: contribute zeros to other ranks' collectives
+    until every rank joins. Blocks; returns the last rank to join.
+
+    Reference analog: ``hvd.join`` (horovod/torch/mpi_ops.py: join →
+    horovod_join in operations.cc).
+    """
+    lib = _basics.lib
+    h = lib.hvdtpu_enqueue_join()
+    Handle(_check_handle(h, "join"), (), None, False, None).synchronize()
+    return int(lib.hvdtpu_last_joined_rank())

@@ -42,6 +42,7 @@ from horovod_tpu.jax.mpi_ops import (  # noqa: F401
     alltoall,
     alltoall_async,
     barrier,
+    join,
     broadcast,
     broadcast_async,
     cross_rank,

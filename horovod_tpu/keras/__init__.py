@@ -21,6 +21,7 @@ from horovod_tpu.tensorflow import (  # noqa: F401
     allreduce,
     alltoall,
     barrier,
+    join,
     broadcast,
     broadcast_variables,
     cross_rank,

@@ -144,8 +144,12 @@ def broadcast_variables(variables, root_rank=0, prefix="var"):
 
 
 def join():
-    raise NotImplementedError(
-        "hvd.join is not implemented yet (planned: core-level join op)")
+    """Block until every rank has joined; contribute zeros meanwhile.
+
+    Reference analog: ``hvd.join`` (horovod/tensorflow/__init__.py).
+    Returns the last rank to join.
+    """
+    return eager_ops.join()
 
 
 def barrier(process_set_id=0):

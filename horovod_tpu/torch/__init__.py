@@ -36,6 +36,7 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     alltoall,
     alltoall_async,
     barrier,
+    join,
     broadcast,
     broadcast_,
     broadcast_async,

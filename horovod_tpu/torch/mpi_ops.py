@@ -216,3 +216,12 @@ def poll(handle):
 
 def barrier(process_set_id=0):
     eager_ops.barrier(process_set_id=process_set_id)
+
+
+def join():
+    """Block until every rank has joined; contribute zeros meanwhile.
+
+    Reference analog: ``hvd.join`` (horovod/torch/mpi_ops.py).
+    Returns the last rank to join.
+    """
+    return eager_ops.join()

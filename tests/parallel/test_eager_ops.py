@@ -152,3 +152,73 @@ def _worker_large_fused(rank, size):
 
 def test_large_tensor():
     assert run_ranks(_worker_large_fused, 2) == ["ok"] * 2
+
+
+def _worker_join(rank, size):
+    b = _init(rank)
+    ops = _ops()
+    try:
+        # Uneven workloads: rank r performs (r + 1) * 2 allreduces, then
+        # joins. Joined ranks must contribute zeros, so step i's expected sum
+        # covers only ranks still active at step i.
+        steps = (rank + 1) * 2
+        results = []
+        for i in range(steps):
+            h = ops.allreduce_async(np.full(4, float(rank + 1), np.float32),
+                                    f"join.ar.{i}")
+            results.append(h.synchronize())
+        last = ops.join()
+        for i, r in enumerate(results):
+            active = [rk for rk in range(size) if (rk + 1) * 2 > i]
+            np.testing.assert_allclose(r, sum(rk + 1 for rk in active))
+        # Every rank joined; the last to join did the most steps.
+        assert last == size - 1, f"last_joined_rank={last}"
+
+        # allgather with a joined rank: joined ranks contribute zero rows.
+        if rank > 0:
+            h = ops.allgather_async(
+                np.full((2, 3), float(rank), np.float32), "join.ag")
+            r = h.synchronize()
+            exp = np.concatenate([np.full((2, 3), float(rk), np.float32)
+                                  for rk in range(1, size)])
+            np.testing.assert_allclose(r, exp)
+            ops.join()
+        else:
+            ops.join()
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_join(size):
+    assert run_ranks(_worker_join, size) == ["ok"] * size
+
+
+def _worker_join_broadcast_barrier(rank, size):
+    b = _init(rank)
+    ops = _ops()
+    try:
+        # Rank 0 joins first; the others broadcast from the LAST rank and run
+        # a barrier. Rank 0's synthesized participation must honor the real
+        # root (regression: default root 0 corrupted the ring) and keep its
+        # local barrier counter aligned for the post-join barrier.
+        if rank == 0:
+            ops.join()
+        else:
+            h = ops.broadcast_async(np.full(4, float(rank), np.float64),
+                                    size - 1, "jb.bc")
+            np.testing.assert_allclose(h.synchronize(), float(size - 1))
+            ops.barrier()
+            ops.join()
+        # Everybody active again: this barrier hangs if counters diverged.
+        ops.barrier()
+        h = ops.allreduce_async(np.full(2, 1.0, np.float32), "jb.final")
+        np.testing.assert_allclose(h.synchronize(), float(size))
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+def test_join_broadcast_and_barrier():
+    assert run_ranks(_worker_join_broadcast_barrier, 3) == ["ok"] * 3
