@@ -1,0 +1,134 @@
+// Adasum: adaptive-summation allreduce (scale-invariant gradient combine).
+// Reference analog: horovod/common/ops/adasum/adasum.h (templated
+// Adasum::DispatchFusedAllreduce) + adasum_mpi_operations.cc — there a
+// recursive vector-halving distance-doubling over MPI; here full-vector
+// recursive doubling over the TCP data plane (correctness-first; segments
+// are host-memory bound, not wire bound, at test scale).
+//
+// Pairwise combine (Maleki et al., "Scaling Distributed Training with
+// Adaptive Summation"): given partner gradients a, b,
+//   adasum(a, b) = (1 - a.b / (2|a|^2)) a + (1 - a.b / (2|b|^2)) b
+// which sums orthogonal gradients and averages parallel ones.
+
+#include <cstring>
+#include <vector>
+
+#include "half.h"
+#include "ring_ops.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+namespace {
+
+template <typename T>
+void AdasumCombine(T* a, const T* b, int64_t count) {
+  double dot = 0, na = 0, nb = 0;
+  for (int64_t i = 0; i < count; i++) {
+    double da = (double)a[i], db = (double)b[i];
+    dot += da * db;
+    na += da * da;
+    nb += db * db;
+  }
+  // Zero-norm side contributes nothing to the projection: plain add.
+  double ca = na == 0.0 ? 1.0 : 1.0 - dot / (2.0 * na);
+  double cb = nb == 0.0 ? 1.0 : 1.0 - dot / (2.0 * nb);
+  for (int64_t i = 0; i < count; i++) {
+    a[i] = (T)(ca * (double)a[i] + cb * (double)b[i]);
+  }
+}
+
+// f16/bf16 combine in float32 working precision.
+template <uint16_t (*ToBits)(float), float (*FromBits)(uint16_t)>
+void AdasumCombineHalfLike(uint16_t* a, const uint16_t* b, int64_t count) {
+  std::vector<float> fa(count), fb(count);
+  for (int64_t i = 0; i < count; i++) {
+    fa[i] = FromBits(a[i]);
+    fb[i] = FromBits(b[i]);
+  }
+  AdasumCombine(fa.data(), fb.data(), count);
+  for (int64_t i = 0; i < count; i++) a[i] = ToBits(fa[i]);
+}
+
+Status AdasumDispatchCombine(void* a, const void* b, int64_t count,
+                             DataType dt) {
+  switch (dt) {
+    case DataType::HVDTPU_FLOAT32:
+      AdasumCombine((float*)a, (const float*)b, count);
+      return Status::OK();
+    case DataType::HVDTPU_FLOAT64:
+      AdasumCombine((double*)a, (const double*)b, count);
+      return Status::OK();
+    case DataType::HVDTPU_FLOAT16:
+      AdasumCombineHalfLike<FloatToHalfBits, HalfBitsToFloat>(
+          (uint16_t*)a, (const uint16_t*)b, count);
+      return Status::OK();
+    case DataType::HVDTPU_BFLOAT16:
+      AdasumCombineHalfLike<FloatToBF16Bits, BF16BitsToFloat>(
+          (uint16_t*)a, (const uint16_t*)b, count);
+      return Status::OK();
+    default:
+      return Status::InvalidArgument(
+          "Adasum requires a floating-point dtype, got " +
+          std::string(DataTypeName(dt)));
+  }
+}
+
+}  // namespace
+
+Status DataPlane::AdasumAllreduce(void* buf, int64_t count, DataType dt) {
+  // Validate the dtype BEFORE any wire traffic: every rank must make the
+  // same go/no-go decision or the exchange pattern desynchronizes (ranks
+  // that only relay, e.g. the extras fold, would hang on dead partners).
+  switch (dt) {
+    case DataType::HVDTPU_FLOAT16:
+    case DataType::HVDTPU_BFLOAT16:
+    case DataType::HVDTPU_FLOAT32:
+    case DataType::HVDTPU_FLOAT64:
+      break;
+    default:
+      return Status::InvalidArgument(
+          "Adasum requires a floating-point dtype, got " +
+          std::string(DataTypeName(dt)));
+  }
+  if (size_ == 1 || count == 0) return Status::OK();
+  const int64_t bytes = count * DataTypeSize(dt);
+  std::vector<uint8_t> remote((size_t)bytes);
+
+  // p = largest power of two <= size; the `extras` (ranks >= p) fold into
+  // their partner below p first, then receive the final result back.
+  int p = 1;
+  while (p * 2 <= size_) p *= 2;
+  const int extras = size_ - p;
+
+  if (rank_ >= p) {
+    Status s = SendAll(peer_fds_[rank_ - p], buf, (size_t)bytes);
+    if (!s.ok()) return s;
+    return RecvAll(peer_fds_[rank_ - p], buf, (size_t)bytes);
+  }
+  if (rank_ < extras) {
+    Status s = RecvAll(peer_fds_[rank_ + p], remote.data(), (size_t)bytes);
+    if (!s.ok()) return s;
+    s = AdasumDispatchCombine(buf, remote.data(), count, dt);
+    if (!s.ok()) return s;
+  }
+
+  // Recursive doubling among ranks < p. Both partners compute the same
+  // symmetric combine, so no result exchange is needed per level.
+  for (int dist = 1; dist < p; dist *= 2) {
+    int partner = rank_ ^ dist;
+    int fd = peer_fds_[partner];
+    Status s = DuplexTransfer(fd, buf, (size_t)bytes, fd, remote.data(),
+                              (size_t)bytes);
+    if (!s.ok()) return s;
+    s = AdasumDispatchCombine(buf, remote.data(), count, dt);
+    if (!s.ok()) return s;
+  }
+
+  if (rank_ < extras) {
+    return SendAll(peer_fds_[rank_ + p], buf, (size_t)bytes);
+  }
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

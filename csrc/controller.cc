@@ -128,16 +128,45 @@ Status Controller::Initialize() {
   return Status::OK();
 }
 
-void Controller::MaybePromote(const std::string& name, PendingTensor& pt) {
+std::vector<int32_t> Controller::MembersOf(int32_t process_set_id) const {
+  if (process_set_id == 0 || cfg_.process_sets == nullptr) {
+    std::vector<int32_t> all(cfg_.size);
+    for (int i = 0; i < cfg_.size; i++) all[i] = i;
+    return all;
+  }
+  return cfg_.process_sets->Ranks(process_set_id);
+}
+
+void Controller::MaybePromote(const std::string& key, PendingTensor& pt) {
   if (pt.queued) return;
-  int covered = (int)pt.ranks_seen.size();
-  for (int32_t r : joined_ranks_) {
-    if (!pt.ranks_seen.count(r)) covered++;
+  std::vector<int32_t> members =
+      MembersOf(pt.requests.front().process_set_id);
+  // Unknown/removed set, or a submitter outside the set: promote
+  // immediately so BuildResponse can surface an ERROR instead of the
+  // tensor silently pending forever (set members would never cover it).
+  if (!members.empty()) {
+    bool foreign = false;
+    for (int32_t seen : pt.ranks_seen) {
+      bool member = false;
+      for (int32_t r : members) member = member || r == seen;
+      foreign = foreign || !member;
+    }
+    if (!foreign) {
+      for (int32_t r : members) {
+        if (!pt.ranks_seen.count(r) && !joined_ranks_.count(r)) return;
+      }
+    }
   }
-  if (covered == cfg_.size) {
-    pt.queued = true;
-    ready_queue_.push_back(name);
-  }
+  pt.queued = true;
+  ready_queue_.push_back(key);
+}
+
+// Negotiation state is keyed by (process set, name) so disjoint sets can
+// run same-named collectives concurrently — the reference gets this from
+// per-process-set controllers (process_set.h). '\x1f' cannot appear in a
+// Python-supplied tensor name.
+std::string Controller::TableKey(const Request& req) {
+  return req.tensor_name + '\x1f' + std::to_string(req.process_set_id);
 }
 
 void Controller::HandleRequestList(const RequestList& list, int from_rank) {
@@ -153,14 +182,14 @@ void Controller::HandleRequestList(const RequestList& list, int from_rank) {
       }
       continue;
     }
-    auto& pt = message_table_[req.tensor_name];
+    auto& pt = message_table_[TableKey(req)];
     if (pt.ranks_seen.empty()) {
       pt.first_seen = std::chrono::steady_clock::now();
     }
     if (pt.ranks_seen.count(req.request_rank)) continue;  // duplicate
     pt.ranks_seen.insert(req.request_rank);
     pt.requests.push_back(req);
-    MaybePromote(req.tensor_name, pt);
+    MaybePromote(TableKey(req), pt);
   }
   if (new_join) {
     // A new join can complete readiness for any pending tensor.
@@ -168,11 +197,11 @@ void Controller::HandleRequestList(const RequestList& list, int from_rank) {
   }
 }
 
-Response Controller::BuildResponse(const std::string& name) {
-  auto& pt = message_table_[name];
-  Response res;
-  res.tensor_names = {name};
+Response Controller::BuildResponse(const std::string& key) {
+  auto& pt = message_table_[key];
   const Request& first = pt.requests.front();
+  Response res;
+  res.tensor_names = {first.tensor_name};
   res.tensor_type = first.tensor_type;
   res.reduce_op = first.reduce_op;
   res.root_rank = first.root_rank;
@@ -181,12 +210,37 @@ Response Controller::BuildResponse(const std::string& name) {
   res.tensor_shapes.insert(res.tensor_shapes.end(),
                            first.tensor_shape.begin(),
                            first.tensor_shape.end());
-  if (!joined_ranks_.empty() &&
-      (int)pt.ranks_seen.size() < cfg_.size &&
-      first.request_type == RequestType::ALLTOALL) {
+  std::vector<int32_t> members = MembersOf(first.process_set_id);
+  if (members.empty()) {
     res.response_type = Response::ResponseType::ERROR;
     res.error_message =
-        "tensor " + name + ": alltoall is not supported with joined ranks";
+        "tensor " + first.tensor_name + ": unknown process set " +
+        std::to_string(first.process_set_id) +
+        " (add_process_set must complete on every rank first)";
+    return res;
+  }
+  for (const auto& req : pt.requests) {
+    bool member = false;
+    for (int32_t r : members) member = member || r == req.request_rank;
+    if (!member) {
+      res.response_type = Response::ResponseType::ERROR;
+      res.error_message =
+          "tensor " + first.tensor_name + ": rank " +
+          std::to_string(req.request_rank) + " is not a member of process "
+          "set " + std::to_string(first.process_set_id);
+      return res;
+    }
+  }
+  // A member not in ranks_seen can only be covered by a join; alltoall
+  // needs real splits from every member, so that combination is an error.
+  bool member_joined = false;
+  for (int32_t r : members) {
+    if (!pt.ranks_seen.count(r)) member_joined = true;
+  }
+  if (member_joined && first.request_type == RequestType::ALLTOALL) {
+    res.response_type = Response::ResponseType::ERROR;
+    res.error_message = "tensor " + first.tensor_name +
+                        ": alltoall is not supported with joined ranks";
     return res;
   }
 
@@ -198,6 +252,8 @@ Response Controller::BuildResponse(const std::string& name) {
       err = "mismatched collective types across ranks";
     } else if (req.tensor_type != first.tensor_type) {
       err = "mismatched tensor dtypes across ranks";
+    } else if (req.process_set_id != first.process_set_id) {
+      err = "mismatched process sets across ranks";
     } else if (req.request_type == RequestType::ALLREDUCE ||
                req.request_type == RequestType::BROADCAST ||
                req.request_type == RequestType::REDUCESCATTER) {
@@ -218,7 +274,7 @@ Response Controller::BuildResponse(const std::string& name) {
   }
   if (!err.empty()) {
     res.response_type = Response::ResponseType::ERROR;
-    res.error_message = "tensor " + name + ": " + err;
+    res.error_message = "tensor " + first.tensor_name + ": " + err;
     return res;
   }
 
@@ -228,11 +284,16 @@ Response Controller::BuildResponse(const std::string& name) {
       break;
     case RequestType::ALLGATHER: {
       res.response_type = Response::ResponseType::ALLGATHER;
-      // Per-rank first-dim sizes in rank order.
-      res.tensor_sizes.assign(cfg_.size, 0);
+      // Per-member first-dim sizes in set order (joined members stay 0).
+      std::vector<int32_t> members = MembersOf(first.process_set_id);
+      res.tensor_sizes.assign(members.size(), 0);
       for (const auto& req : pt.requests) {
-        res.tensor_sizes[req.request_rank] =
-            req.tensor_shape.empty() ? 1 : req.tensor_shape[0];
+        for (size_t i = 0; i < members.size(); i++) {
+          if (members[i] == req.request_rank) {
+            res.tensor_sizes[i] =
+                req.tensor_shape.empty() ? 1 : req.tensor_shape[0];
+          }
+        }
       }
       break;
     }
@@ -261,20 +322,24 @@ Response Controller::BuildResponse(const std::string& name) {
 ResponseList Controller::FuseResponses() {
   ResponseList list;
   while (!ready_queue_.empty()) {
-    std::string name = ready_queue_.front();
+    std::string key = ready_queue_.front();
     ready_queue_.pop_front();
-    Response res = BuildResponse(name);
-    const Request& first = message_table_[name].requests.front();
+    Response res = BuildResponse(key);
+    const Request& first = message_table_[key].requests.front();
     int64_t bytes = 1;
     for (auto d : first.tensor_shape) bytes *= d;
     bytes *= DataTypeSize(first.tensor_type);
     // Tensor fusion: keep folding subsequent ready ALLREDUCEs of the same
     // dtype/process-set into this response while under the threshold.
     // Reference analog: Controller::FuseResponses + fusion_buffer_manager.
-    if (res.response_type == Response::ResponseType::ALLREDUCE) {
+    // Adasum is per-gradient (the combine normalizes per tensor), so those
+    // responses stay unfused. Reference analog: adasum.h takes per-tensor
+    // counts inside the fused buffer; we keep v1 simpler.
+    if (res.response_type == Response::ResponseType::ALLREDUCE &&
+        first.reduce_op != ReduceOp::ADASUM) {
       while (!ready_queue_.empty() && bytes < cfg_.fusion_threshold_bytes) {
-        const std::string& next = ready_queue_.front();
-        auto& npt = message_table_[next];
+        const std::string& next_key = ready_queue_.front();
+        auto& npt = message_table_[next_key];
         const Request& nreq = npt.requests.front();
         if (nreq.request_type != RequestType::ALLREDUCE ||
             nreq.tensor_type != first.tensor_type ||
@@ -282,23 +347,23 @@ ResponseList Controller::FuseResponses() {
             nreq.reduce_op != first.reduce_op) {
           break;
         }
-        Response nres = BuildResponse(next);
+        Response nres = BuildResponse(next_key);
         if (nres.response_type == Response::ResponseType::ERROR) break;
         int64_t nbytes = 1;
         for (auto d : nreq.tensor_shape) nbytes *= d;
         nbytes *= DataTypeSize(nreq.tensor_type);
         if (bytes + nbytes > cfg_.fusion_threshold_bytes) break;
-        res.tensor_names.push_back(next);
+        res.tensor_names.push_back(nreq.tensor_name);
         res.tensor_shapes.push_back((int64_t)nreq.tensor_shape.size());
         res.tensor_shapes.insert(res.tensor_shapes.end(),
                                  nreq.tensor_shape.begin(),
                                  nreq.tensor_shape.end());
         bytes += nbytes;
-        message_table_.erase(next);
+        message_table_.erase(next_key);
         ready_queue_.pop_front();
       }
     }
-    message_table_.erase(name);
+    message_table_.erase(key);
     list.responses.push_back(std::move(res));
   }
   // All ranks joined: complete every rank's pending join.
@@ -327,7 +392,8 @@ void Controller::CheckForStalledTensors() {
         std::chrono::duration<double>(now - kv.second.first_seen).count();
     if (waited > cfg_.stall_warning_secs) {
       std::ostringstream missing;
-      for (int r = 0; r < cfg_.size; r++) {
+      for (int32_t r :
+           MembersOf(kv.second.requests.front().process_set_id)) {
         if (!kv.second.ranks_seen.count(r) && !joined_ranks_.count(r)) {
           missing << r << " ";
         }
@@ -335,7 +401,8 @@ void Controller::CheckForStalledTensors() {
       LOG_WARN(
           "Stall detected: tensor %s has waited %.0fs; missing ranks: %s"
           " (one or more ranks did not submit this collective)",
-          kv.first.c_str(), waited, missing.str().c_str());
+          kv.second.requests.front().tensor_name.c_str(), waited,
+          missing.str().c_str());
     }
   }
 }
@@ -369,6 +436,8 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     ResponseList list = FuseResponses();
     list.shutdown = std::all_of(shutdown_flags_.begin(), shutdown_flags_.end(),
                                 [](bool b) { return b; });
+    list.fusion_threshold_bytes = bcast_fusion_bytes_;
+    list.cycle_time_ms = bcast_cycle_ms_;
     std::string payload = SerializeResponseList(list);
     for (int r = 1; r < cfg_.size; r++) {
       Status s = SendFrame(control_fds_[r], payload);

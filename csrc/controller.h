@@ -18,6 +18,7 @@
 #include <unordered_set>
 
 #include "message.h"
+#include "process_set.h"
 #include "ring_ops.h"
 
 namespace hvdtpu {
@@ -30,6 +31,9 @@ struct ControllerConfig {
   int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
   double stall_warning_secs = 60.0;
   bool stall_check_enabled = true;
+  // Readiness for a tensor on process set S waits only on S's members.
+  // Not owned; outlives the controller (lives in GlobalState).
+  const ProcessSetTable* process_sets = nullptr;
 };
 
 class Controller {
@@ -51,6 +55,14 @@ class Controller {
   DataPlane* data_plane() { return data_plane_.get(); }
   int rank() const { return cfg_.rank; }
   int size() const { return cfg_.size; }
+
+  // Coordinator only: adopt autotuned knobs locally (fusion decisions are
+  // made here) and piggyback them on every subsequent ResponseList.
+  void SetAutotunedParams(int64_t fusion_bytes, double cycle_ms) {
+    cfg_.fusion_threshold_bytes = fusion_bytes;
+    bcast_fusion_bytes_ = fusion_bytes;
+    bcast_cycle_ms_ = cycle_ms;
+  }
 
  private:
   // Coordinator side: fold one rank's RequestList into the message table,
@@ -75,15 +87,21 @@ class Controller {
     std::chrono::steady_clock::time_point first_seen;
     bool queued = false;  // already pushed on ready_queue_
   };
-  // A tensor is ready once every rank has either requested it or joined.
-  // Reference analog: controller.cc join handling (joined ranks count as
-  // ready for every tensor).
-  void MaybePromote(const std::string& name, PendingTensor& pt);
+  // A tensor is ready once every member of its process set has either
+  // requested it or joined. Reference analog: controller.cc join handling +
+  // per-process-set controller state.
+  void MaybePromote(const std::string& key, PendingTensor& pt);
+  std::vector<int32_t> MembersOf(int32_t process_set_id) const;
+  // message_table_ key: tensor name + '\x1f' + process_set_id (disjoint sets
+  // may negotiate same-named tensors concurrently).
+  static std::string TableKey(const Request& req);
   std::unordered_map<std::string, PendingTensor> message_table_;
   std::deque<std::string> ready_queue_;  // all-ranks-ready, FIFO order
   std::vector<bool> shutdown_flags_;
   std::unordered_set<int32_t> joined_ranks_;
   int32_t last_joined_rank_ = -1;
+  int64_t bcast_fusion_bytes_ = 0;  // 0 = nothing to broadcast
+  double bcast_cycle_ms_ = 0;
   std::chrono::steady_clock::time_point last_stall_check_;
 };
 

@@ -178,6 +178,8 @@ Status ParseRequestList(const std::string& buf, RequestList* list) {
 std::string SerializeResponseList(const ResponseList& list) {
   Writer w;
   w.Put<uint8_t>(list.shutdown ? 1 : 0);
+  w.Put<int64_t>(list.fusion_threshold_bytes);
+  w.Put<double>(list.cycle_time_ms);
   w.Put<uint32_t>((uint32_t)list.responses.size());
   for (auto& r : list.responses) WriteResponse(w, r);
   return w.Take();
@@ -188,6 +190,10 @@ Status ParseResponseList(const std::string& buf, ResponseList* list) {
   uint8_t shutdown;
   if (!rd.Get(&shutdown)) return Status::Error("truncated ResponseList");
   list->shutdown = shutdown != 0;
+  if (!rd.Get(&list->fusion_threshold_bytes) ||
+      !rd.Get(&list->cycle_time_ms)) {
+    return Status::Error("truncated ResponseList");
+  }
   uint32_t n;
   if (!rd.Get(&n)) return Status::Error("truncated ResponseList");
   list->responses.resize(n);

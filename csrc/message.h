@@ -87,6 +87,10 @@ struct RequestList {
 struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
+  // Autotuned runtime knobs, pushed coordinator -> workers (0 = unset).
+  // Reference analog: parameter_manager.cc values synced via the controller.
+  int64_t fusion_threshold_bytes = 0;
+  double cycle_time_ms = 0;
 };
 
 std::string SerializeRequestList(const RequestList& list);

@@ -15,6 +15,8 @@
 #include "controller.h"
 #include "logging.h"
 #include "message.h"
+#include "parameter_manager.h"
+#include "process_set.h"
 #include "ring_ops.h"
 #include "tensor_queue.h"
 #include "timeline.h"
@@ -107,6 +109,9 @@ class HandleManager {
 
 struct GlobalState {
   std::unique_ptr<Controller> controller;
+  std::unique_ptr<ProcessSetTable> process_sets;
+  std::unique_ptr<ParameterManager> param_manager;  // HOROVOD_AUTOTUNE
+  bool timeline_mark_cycles = false;
   TensorQueue tensor_queue;
   HandleManager handles;
   Timeline timeline;
@@ -124,9 +129,22 @@ struct GlobalState {
   // never enqueued. Reference analog: global_state.h joined flag.
   std::atomic<bool> joined{false};
   std::atomic<int> last_joined_rank{-1};
-  // Barrier sequence number; must stay aligned across ranks, including
-  // barriers a joined rank participated in only via synthesis.
-  std::atomic<int64_t> barrier_counter{0};
+  // Barrier sequence numbers, PER process set; must stay aligned across a
+  // set's members, including barriers a joined rank participated in only
+  // via synthesis. A global counter would desync when only a subset of
+  // ranks runs a set-scoped barrier.
+  std::mutex barrier_mutex;
+  std::unordered_map<int32_t, int64_t> barrier_counters;
+
+  int64_t NextBarrierSeq(int32_t ps) {
+    std::lock_guard<std::mutex> lk(barrier_mutex);
+    return barrier_counters[ps]++;
+  }
+  void FastForwardBarrier(int32_t ps, int64_t seen) {
+    std::lock_guard<std::mutex> lk(barrier_mutex);
+    int64_t& c = barrier_counters[ps];
+    if (c < seen + 1) c = seen + 1;
+  }
 };
 
 GlobalState* g_state = nullptr;
@@ -140,8 +158,8 @@ void ApplyPostOp(TensorTableEntry& e, void* buf, int64_t count, int size) {
   ScaleBuffer(buf, count, e.dtype, post);
 }
 
-Status ExecuteAllreduce(GlobalState& st, std::vector<TensorTableEntry>& entries) {
-  auto* dp = st.controller->data_plane();
+Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
+                        std::vector<TensorTableEntry>& entries) {
   if (entries.size() == 1) {
     auto& e = entries[0];
     if (e.output != e.input) {
@@ -152,7 +170,7 @@ Status ExecuteAllreduce(GlobalState& st, std::vector<TensorTableEntry>& entries)
     Status s = dp->Allreduce(e.output, e.NumElements(), e.dtype, e.reduce_op);
     st.timeline.ActivityEnd(e.name);
     if (!s.ok()) return s;
-    ApplyPostOp(e, e.output, e.NumElements(), st.size);
+    ApplyPostOp(e, e.output, e.NumElements(), dp->size());
     return Status::OK();
   }
   // Fused path: pack into the fusion buffer, one ring allreduce, unpack.
@@ -179,7 +197,7 @@ Status ExecuteAllreduce(GlobalState& st, std::vector<TensorTableEntry>& entries)
   off = 0;
   for (auto& e : entries) {
     st.timeline.ActivityStart(e.name, "MEMCPY_OUT_FUSION_BUFFER");
-    ApplyPostOp(e, base + off, e.NumElements(), st.size);
+    ApplyPostOp(e, base + off, e.NumElements(), dp->size());
     std::memcpy(e.output, base + off, (size_t)e.SizeBytes());
     st.timeline.ActivityEnd(e.name);
     off += e.SizeBytes();
@@ -187,17 +205,16 @@ Status ExecuteAllreduce(GlobalState& st, std::vector<TensorTableEntry>& entries)
   return Status::OK();
 }
 
-Status ExecuteEntry(GlobalState& st, const Response& response,
-                    TensorTableEntry& e) {
-  auto* dp = st.controller->data_plane();
+Status ExecuteEntry(GlobalState& st, DataPlane* dp,
+                    const Response& response, TensorTableEntry& e) {
   switch (response.response_type) {
     case Response::ResponseType::ALLGATHER: {
       int64_t row_elems = 1;
       for (size_t i = 1; i < e.shape.size(); i++) row_elems *= e.shape[i];
       int64_t row_bytes = row_elems * DataTypeSize(e.dtype);
-      std::vector<int64_t> bytes_per_rank(st.size);
+      std::vector<int64_t> bytes_per_rank(dp->size());
       int64_t total = 0, total_rows = 0;
-      for (int r = 0; r < st.size; r++) {
+      for (int r = 0; r < dp->size(); r++) {
         bytes_per_rank[r] = response.tensor_sizes[r] * row_bytes;
         total += bytes_per_rank[r];
         total_rows += response.tensor_sizes[r];
@@ -217,8 +234,15 @@ Status ExecuteEntry(GlobalState& st, const Response& response,
       return Status::OK();
     }
     case Response::ResponseType::BROADCAST: {
+      int root = dp->GroupIndexOf(e.root_rank);  // root_rank is global
+      if (root < 0) {
+        return Status::InvalidArgument(
+            "broadcast root rank " + std::to_string(e.root_rank) +
+            " is not a member of process set " +
+            std::to_string(e.process_set_id));
+      }
       st.timeline.ActivityStart(e.name, "RING_BCAST");
-      Status s = dp->Broadcast(e.output, e.SizeBytes(), e.root_rank);
+      Status s = dp->Broadcast(e.output, e.SizeBytes(), root);
       st.timeline.ActivityEnd(e.name);
       return s;
     }
@@ -229,21 +253,21 @@ Status ExecuteEntry(GlobalState& st, const Response& response,
       std::vector<int64_t> splits = e.splits;
       if (splits.empty()) {
         int64_t first = e.shape.empty() ? 0 : e.shape[0];
-        if (first % st.size != 0) {
+        if (first % dp->size() != 0) {
           return Status::InvalidArgument(
               "alltoall without splits requires first dim divisible by size");
         }
-        splits.assign(st.size, first / st.size);
+        splits.assign(dp->size(), first / dp->size());
       }
       // Exchange splits so each rank learns its receive layout.
       // Reference analog: alltoall recvsplits exchange in the op layer.
-      std::vector<int64_t> ones(st.size, sizeof(int64_t));
-      e.recv_splits.assign(st.size, 0);
+      std::vector<int64_t> ones(dp->size(), sizeof(int64_t));
+      e.recv_splits.assign(dp->size(), 0);
       Status s = dp->Alltoallv(splits.data(), ones, e.recv_splits.data(), ones);
       if (!s.ok()) return s;
-      std::vector<int64_t> send_bytes(st.size), recv_bytes(st.size);
+      std::vector<int64_t> send_bytes(dp->size()), recv_bytes(dp->size());
       int64_t total_recv_rows = 0, total_recv_bytes = 0;
-      for (int r = 0; r < st.size; r++) {
+      for (int r = 0; r < dp->size(); r++) {
         send_bytes[r] = splits[r] * row_bytes;
         recv_bytes[r] = e.recv_splits[r] * row_bytes;
         total_recv_rows += e.recv_splits[r];
@@ -269,15 +293,15 @@ Status ExecuteEntry(GlobalState& st, const Response& response,
       int64_t first = e.shape.empty() ? 1 : e.shape[0];
       int64_t row_elems = 1;
       for (size_t i = 1; i < e.shape.size(); i++) row_elems *= e.shape[i];
-      std::vector<int64_t> elems_per_rank(st.size);
-      int64_t q = first / st.size, rem = first % st.size;
-      std::vector<int64_t> rows(st.size);
-      for (int r = 0; r < st.size; r++) {
+      std::vector<int64_t> elems_per_rank(dp->size());
+      int64_t q = first / dp->size(), rem = first % dp->size();
+      std::vector<int64_t> rows(dp->size());
+      for (int r = 0; r < dp->size(); r++) {
         rows[r] = q + (r < rem ? 1 : 0);
         elems_per_rank[r] = rows[r] * row_elems;
       }
       e.managed_output.resize(
-          (size_t)(elems_per_rank[st.rank] * DataTypeSize(e.dtype)));
+          (size_t)(elems_per_rank[dp->rank()] * DataTypeSize(e.dtype)));
       // Prescale on a copy to keep caller input pristine.
       std::vector<uint8_t> scaled;
       const void* in = e.input;
@@ -293,13 +317,13 @@ Status ExecuteEntry(GlobalState& st, const Response& response,
                                     elems_per_rank, e.dtype, e.reduce_op);
       st.timeline.ActivityEnd(e.name);
       if (!s.ok()) return s;
-      ApplyPostOp(e, e.managed_output.data(), elems_per_rank[st.rank],
-                  st.size);
+      ApplyPostOp(e, e.managed_output.data(), elems_per_rank[dp->rank()],
+                  dp->size());
       e.output_shape = e.shape;
       if (e.output_shape.empty()) {
-        e.output_shape = {rows[st.rank]};
+        e.output_shape = {rows[dp->rank()]};
       } else {
-        e.output_shape[0] = rows[st.rank];
+        e.output_shape[0] = rows[dp->rank()];
       }
       return Status::OK();
     }
@@ -358,11 +382,8 @@ void SynthesizeJoinedEntries(GlobalState& st, const Response& response,
       // would negotiate under mismatched names and hang).
       size_t dot = name.rfind('.');
       if (dot != std::string::npos) {
-        int64_t n = strtoll(name.c_str() + dot + 1, nullptr, 10);
-        int64_t cur = st.barrier_counter.load();
-        while (cur < n + 1 &&
-               !st.barrier_counter.compare_exchange_weak(cur, n + 1)) {
-        }
+        st.FastForwardBarrier(response.process_set_id,
+                              strtoll(name.c_str() + dot + 1, nullptr, 10));
       }
     }
     zero_bufs->emplace_back((size_t)e.SizeBytes(), 0);
@@ -374,17 +395,43 @@ void SynthesizeJoinedEntries(GlobalState& st, const Response& response,
 }
 
 void ExecuteResponse(GlobalState& st, const Response& response) {
-  auto entries = st.tensor_queue.GetTensorEntriesFromResponse(response);
   if (response.response_type == Response::ResponseType::JOIN) {
+    auto join_entries = st.tensor_queue.GetTensorEntriesFromResponse(response);
     st.last_joined_rank = response.last_joined_rank;
     st.joined = false;
     Status ok = Status::OK();
-    for (auto& e : entries) {
+    for (auto& e : join_entries) {
       st.timeline.EntryDone(e.name);
       st.handles.MarkDone(e.handle, ok, &e);
     }
     return;
   }
+  // Resolve the data plane for this response's process set BEFORE touching
+  // the local tensor queue: non-members get the broadcast ResponseList too,
+  // and a same-named tensor of a different set may be in their queue.
+  DataPlane* dp = st.controller->data_plane();
+  DataPlane sub(0, 1, {});
+  Status ps_status = Status::OK();
+  if (response.process_set_id != 0 &&
+      response.response_type != Response::ResponseType::ERROR) {
+    std::vector<int32_t> members =
+        st.process_sets->Ranks(response.process_set_id);
+    if (members.empty()) {
+      ps_status = Status::PreconditionError(
+          "unknown process set " + std::to_string(response.process_set_id) +
+          " (add_process_set must complete on every rank first)");
+    } else {
+      bool member = false;
+      for (int32_t r : members) member = member || r == st.rank;
+      if (!member) {
+        // Not a participant: nothing to execute, nothing to resolve.
+        return;
+      }
+      sub = dp->Subset(members);
+      dp = &sub;
+    }
+  }
+  auto entries = st.tensor_queue.GetTensorEntriesFromResponse(response);
   std::vector<std::vector<uint8_t>> zero_bufs;
   if (st.joined.load() &&
       entries.size() < response.tensor_names.size() &&
@@ -392,13 +439,15 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
     SynthesizeJoinedEntries(st, response, &entries, &zero_bufs);
   }
   Status status = Status::OK();
-  if (response.response_type == Response::ResponseType::ERROR) {
+  if (!ps_status.ok()) {
+    status = ps_status;
+  } else if (response.response_type == Response::ResponseType::ERROR) {
     status = Status::PreconditionError(response.error_message);
   } else if (response.response_type == Response::ResponseType::ALLREDUCE) {
-    status = ExecuteAllreduce(st, entries);
+    status = ExecuteAllreduce(st, dp, entries);
   } else {
     for (auto& e : entries) {
-      status = ExecuteEntry(st, response, e);
+      status = ExecuteEntry(st, dp, response, e);
       if (!status.ok()) break;
     }
   }
@@ -408,12 +457,26 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
   }
 }
 
+// Payload bytes a response moves (autotune scoring input).
+int64_t ResponseBytes(const Response& r) {
+  if (r.response_type != Response::ResponseType::ALLREDUCE) return 0;
+  int64_t total = 0;
+  size_t pos = 0;
+  while (pos < r.tensor_shapes.size()) {
+    int64_t ndim = r.tensor_shapes[pos++], elems = 1;
+    for (int64_t d = 0; d < ndim; d++) elems *= r.tensor_shapes[pos++];
+    total += elems * DataTypeSize(r.tensor_type);
+  }
+  return total;
+}
+
 void BackgroundThreadLoop(GlobalState& st) {
   // Reference analog: operations.cc BackgroundThreadLoop / RunLoopOnce —
   // one coordination thread per process; each cycle drains the queue,
   // negotiates, executes, and sleeps out the remainder of the cycle time.
   while (true) {
     auto cycle_start = std::chrono::steady_clock::now();
+    if (st.timeline_mark_cycles) st.timeline.MarkCycle();
     std::vector<Request> requests = st.tensor_queue.PopMessages();
     for (auto& r : requests) st.timeline.NegotiateStart(r.tensor_name);
     ResponseList response_list;
@@ -425,9 +488,26 @@ void BackgroundThreadLoop(GlobalState& st) {
       for (auto& e : orphans) st.handles.MarkDone(e.handle, s, nullptr);
       break;
     }
+    // Workers adopt coordinator-autotuned knobs (coordinator already has
+    // them via SetAutotunedParams).
+    if (response_list.fusion_threshold_bytes > 0 && st.rank != 0) {
+      st.fusion_threshold = response_list.fusion_threshold_bytes;
+    }
+    if (response_list.cycle_time_ms > 0 && st.rank != 0) {
+      st.cycle_time_ms = response_list.cycle_time_ms;
+    }
+    int64_t cycle_bytes = 0;
     for (auto& response : response_list.responses) {
       for (auto& n : response.tensor_names) st.timeline.NegotiateEnd(n);
       ExecuteResponse(st, response);
+      cycle_bytes += ResponseBytes(response);
+    }
+    if (st.rank == 0 && st.param_manager &&
+        st.param_manager->Update(cycle_bytes)) {
+      st.fusion_threshold = st.param_manager->fusion_threshold_bytes();
+      st.cycle_time_ms = st.param_manager->cycle_time_ms();
+      st.controller->SetAutotunedParams(st.fusion_threshold.load(),
+                                        st.cycle_time_ms.load());
     }
     if (response_list.shutdown) break;
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
@@ -479,7 +559,11 @@ int hvdtpu_init() {
   st->shutdown_requested = false;
   st->loop_exited = false;
   st->joined = false;
-  st->barrier_counter = 0;  // elastic re-init: new workers start at 0
+  {
+    // Elastic re-init: new workers start at 0, so everyone must.
+    std::lock_guard<std::mutex> lk(st->barrier_mutex);
+    st->barrier_counters.clear();
+  }
   st->rank = (int)EnvInt64("HOROVOD_RANK", 0);
   st->size = (int)EnvInt64("HOROVOD_SIZE", 1);
   st->local_rank = (int)EnvInt64("HOROVOD_LOCAL_RANK", st->rank);
@@ -490,9 +574,12 @@ int hvdtpu_init() {
       EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
   st->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
 
+  st->process_sets = std::make_unique<ProcessSetTable>(st->size);
+
   ControllerConfig cfg;
   cfg.rank = st->rank;
   cfg.size = st->size;
+  cfg.process_sets = st->process_sets.get();
   cfg.controller_addr = EnvStr("HOROVOD_CONTROLLER_ADDR", "127.0.0.1");
   cfg.controller_port = (int)EnvInt64("HOROVOD_CONTROLLER_PORT", 29500);
   cfg.fusion_threshold_bytes = st->fusion_threshold;
@@ -509,6 +596,16 @@ int hvdtpu_init() {
   std::string timeline_path = EnvStr("HOROVOD_TIMELINE", "");
   if (!timeline_path.empty()) {
     st->timeline.Initialize(timeline_path, st->rank);
+  }
+  st->timeline_mark_cycles =
+      EnvInt64("HOROVOD_TIMELINE_MARK_CYCLES", 0) != 0;
+  if (EnvInt64("HOROVOD_AUTOTUNE", 0) != 0) {
+    st->param_manager = std::make_unique<ParameterManager>();
+    st->param_manager->Initialize(st->fusion_threshold.load(),
+                                  st->cycle_time_ms.load(),
+                                  EnvStr("HOROVOD_AUTOTUNE_LOG", ""));
+  } else {
+    st->param_manager.reset();
   }
   st->initialized = true;
   st->background_thread = std::thread(BackgroundThreadLoop, std::ref(*st));
@@ -654,7 +751,11 @@ int hvdtpu_enqueue_alltoall(const char* name, const void* input, int ndim,
   e.dtype = ToDataType(dtype);
   e.process_set_id = process_set_id;
   if (splits != nullptr) {
-    e.splits.assign(splits, splits + g_state->size);
+    int n = process_set_id == 0
+                ? g_state->size
+                : (int)g_state->process_sets->Ranks(process_set_id).size();
+    if (n == 0) return -1;  // unknown process set
+    e.splits.assign(splits, splits + n);
   }
   Request m;
   m.request_type = RequestType::ALLTOALL;
@@ -690,6 +791,37 @@ int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
   return EnqueueEntry(std::move(e), std::move(m));
 }
 
+int hvdtpu_add_process_set(const int32_t* ranks, int nranks) {
+  CHECK_INIT(-1)
+  // Must be called with identical ranks in identical order on EVERY process
+  // (ids are assigned locally; the reference has the same requirement for
+  // hvd.add_process_set). The Python layer runs a global barrier before
+  // first use so no rank races ahead of a lagging registrant.
+  std::vector<int32_t> members(ranks, ranks + nranks);
+  for (int32_t r : members) {
+    if (r < 0 || r >= g_state->size) return -1;
+  }
+  return g_state->process_sets->Add(std::move(members));
+}
+
+int hvdtpu_remove_process_set(int process_set_id) {
+  CHECK_INIT(-1)
+  return g_state->process_sets->Remove(process_set_id) ? 0 : -1;
+}
+
+int hvdtpu_process_set_size(int process_set_id) {
+  CHECK_INIT(-1)
+  if (process_set_id == 0) return g_state->size;
+  int n = (int)g_state->process_sets->Ranks(process_set_id).size();
+  return n == 0 ? -1 : n;
+}
+
+int hvdtpu_process_set_rank(int process_set_id) {
+  CHECK_INIT(-1)
+  if (process_set_id == 0) return g_state->rank;
+  return g_state->process_sets->RankIn(process_set_id, g_state->rank);
+}
+
 int hvdtpu_enqueue_join() {
   CHECK_INIT(-1)
   // Reference analog: horovod_join / EnqueueJoin (operations.cc). The rank
@@ -712,7 +844,8 @@ int hvdtpu_last_joined_rank() {
 int hvdtpu_enqueue_barrier(int process_set_id) {
   CHECK_INIT(-1)
   TensorTableEntry e;
-  e.name = "__barrier__." + std::to_string(g_state->barrier_counter++);
+  e.name = "__barrier__." +
+           std::to_string(g_state->NextBarrierSeq(process_set_id));
   e.process_set_id = process_set_id;
   Request m;
   m.request_type = RequestType::BARRIER;
@@ -799,6 +932,22 @@ void hvdtpu_set_fusion_threshold_bytes(int64_t v) {
 
 void hvdtpu_set_cycle_time_ms(double v) {
   if (g_state) g_state->cycle_time_ms = v;
+}
+
+int hvdtpu_start_timeline(const char* path) {
+  CHECK_INIT(-1)
+  // Reference analog: hvd.start_timeline / horovod_start_timeline
+  // (TimelineController). Restartable: stop + start with a new path works.
+  if (path == nullptr || path[0] == '\0') return -1;
+  g_state->timeline.Shutdown();
+  g_state->timeline.Initialize(path, g_state->rank);
+  return g_state->timeline.Enabled() ? 0 : -1;
+}
+
+int hvdtpu_stop_timeline() {
+  CHECK_INIT(-1)
+  g_state->timeline.Shutdown();
+  return 0;
 }
 
 }  // extern "C"

@@ -55,6 +55,14 @@ int hvdtpu_enqueue_barrier(int process_set_id);
 int hvdtpu_enqueue_join();
 int hvdtpu_last_joined_rank();
 
+// Process sets (reference analog: horovod_add_process_set etc. via
+// horovod/common/process_sets.py). Registration must happen in the same
+// order on every rank; synchronize (e.g. barrier) before first use.
+int hvdtpu_add_process_set(const int32_t* ranks, int nranks);
+int hvdtpu_remove_process_set(int process_set_id);
+int hvdtpu_process_set_size(int process_set_id);
+int hvdtpu_process_set_rank(int process_set_id);
+
 // Handle API (reference analog: horovod/torch/handle_manager.h).
 int hvdtpu_poll(int handle);                  // 1 done, 0 in flight, <0 bad
 int hvdtpu_wait(int handle);                  // 0 ok, <0 error
@@ -67,6 +75,11 @@ int hvdtpu_result_copy(int handle, void* dst, int64_t nbytes);
 int hvdtpu_release(int handle);
 
 // Runtime knobs (reference: HOROVOD_FUSION_THRESHOLD / HOROVOD_CYCLE_TIME).
+// Runtime timeline control (reference analog: hvd.start_timeline /
+// hvd.stop_timeline via TimelineController).
+int hvdtpu_start_timeline(const char* path);
+int hvdtpu_stop_timeline();
+
 int64_t hvdtpu_fusion_threshold_bytes();
 double hvdtpu_cycle_time_ms();
 void hvdtpu_set_fusion_threshold_bytes(int64_t v);

@@ -1,0 +1,150 @@
+#include "parameter_manager.h"
+
+#include <algorithm>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+constexpr int64_t kMinWindowBytes = 1 << 20;   // score only meaningful windows
+constexpr int kMinWindowCycles = 20;
+constexpr double kMaxWindowSecs = 5.0;
+constexpr double kImprovementEps = 1.05;       // 5% better = accept move
+}  // namespace
+
+void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
+                                  const std::string& log_path) {
+  for (int64_t v = 1 << 20; v <= (64 << 20); v *= 2) {
+    fusion_values_.push_back(v);
+  }
+  cycle_values_ = {0.5, 1.0, 2.5, 5.0, 10.0};
+  // Start from the user-provided operating point (snap onto the grids).
+  fusion_idx_ = 0;
+  for (size_t i = 0; i < fusion_values_.size(); i++) {
+    if (fusion_values_[i] <= fusion_bytes) fusion_idx_ = i;
+  }
+  cycle_idx_ = 0;
+  for (size_t i = 0; i < cycle_values_.size(); i++) {
+    if (cycle_values_[i] <= cycle_ms) cycle_idx_ = i;
+  }
+  if (!log_path.empty()) {
+    log_ = fopen(log_path.c_str(), "w");
+    if (log_) {
+      fprintf(log_, "fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n");
+      fflush(log_);
+    }
+  }
+  active_ = true;
+}
+
+ParameterManager::~ParameterManager() {
+  if (log_) fclose(log_);
+}
+
+void ParameterManager::Log(double score) {
+  if (!log_) return;
+  fprintf(log_, "%lld,%.3f,%.0f\n",
+          (long long)fusion_threshold_bytes(), cycle_time_ms(), score);
+  fflush(log_);
+}
+
+bool ParameterManager::Move(int direction) {
+  if (axis_ == 0) {
+    size_t prev = fusion_idx_;
+    fusion_idx_ = (size_t)std::clamp<int64_t>(
+        (int64_t)fusion_idx_ + direction, 0,
+        (int64_t)fusion_values_.size() - 1);
+    return fusion_idx_ != prev;
+  }
+  size_t prev = cycle_idx_;
+  cycle_idx_ = (size_t)std::clamp<int64_t>(
+      (int64_t)cycle_idx_ + direction, 0, (int64_t)cycle_values_.size() - 1);
+  return cycle_idx_ != prev;
+}
+
+void ParameterManager::AdvanceAxis() {
+  axis_ = 1 - axis_;
+  have_baseline_ = false;
+  tries_ = 0;
+  if (axis_ == 0 && --sweeps_left_ <= 0) {
+    done_ = true;
+    LOG_INFO("autotune converged: fusion=%lld bytes, cycle=%.2f ms",
+             (long long)fusion_threshold_bytes(), cycle_time_ms());
+  }
+}
+
+void ParameterManager::TryProbe() {
+  // Place the next probe; a clamped (no-op) Move means the grid edge —
+  // skip straight to the other direction or the next axis, so an "undo"
+  // is only ever applied to a probe that actually moved.
+  while (!done_) {
+    if (Move(direction_)) return;  // probe placed; next window scores it
+    if (++tries_ < 2) {
+      direction_ = -direction_;
+      continue;
+    }
+    AdvanceAxis();
+    return;  // new axis re-baselines on the next window
+  }
+}
+
+void ParameterManager::Score(double bytes_per_sec) {
+  Log(bytes_per_sec);
+  if (done_) return;
+  if (!have_baseline_) {
+    // First scored window at the current point: probe up the active axis.
+    baseline_score_ = bytes_per_sec;
+    have_baseline_ = true;
+    direction_ = +1;
+    tries_ = 0;
+    TryProbe();
+    return;
+  }
+  if (bytes_per_sec > baseline_score_ * kImprovementEps) {
+    // Improvement: adopt the probed point, keep walking this direction.
+    baseline_score_ = bytes_per_sec;
+    tries_ = 0;
+    TryProbe();
+    return;
+  }
+  // Not better: undo the probe (guaranteed to have moved — see TryProbe),
+  // then try the other direction once, else advance to the next axis.
+  Move(-direction_);
+  if (++tries_ < 2) {
+    direction_ = -direction_;
+    TryProbe();
+    return;
+  }
+  AdvanceAxis();
+}
+
+bool ParameterManager::Update(int64_t bytes) {
+  if (!active_ || done_) return false;
+  auto now = std::chrono::steady_clock::now();
+  if (!window_started_) {
+    window_start_ = now;
+    window_started_ = true;
+    window_bytes_ = 0;
+    window_cycles_ = 0;
+  }
+  window_bytes_ += bytes;
+  window_cycles_++;
+  double secs = std::chrono::duration<double>(now - window_start_).count();
+  bool window_full = (window_bytes_ >= kMinWindowBytes &&
+                      window_cycles_ >= kMinWindowCycles) ||
+                     secs >= kMaxWindowSecs;
+  if (!window_full || secs <= 0) return false;
+  int64_t prev_fusion = fusion_threshold_bytes();
+  double prev_cycle = cycle_time_ms();
+  if (warmup_windows_ > 0) {
+    warmup_windows_--;  // discard: startup warmup pollutes the score
+  } else if (window_bytes_ > 0) {
+    Score((double)window_bytes_ / secs);
+  }
+  window_started_ = false;
+  return fusion_threshold_bytes() != prev_fusion ||
+         cycle_time_ms() != prev_cycle;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,69 @@
+// Online autotuning of fusion threshold + cycle time.
+// Reference analog: horovod/common/parameter_manager.h (ParameterManager,
+// driven by HOROVOD_AUTOTUNE) — there Bayesian optimization over warmup
+// samples (common/optim/bayesian_optimization.cc); here deterministic
+// coordinate descent over the same discrete grids, scoring windows by
+// allreduced bytes/sec. Runs on the coordinator only; chosen values ride to
+// workers on every ResponseList.
+
+#ifndef HVDTPU_PARAMETER_MANAGER_H
+#define HVDTPU_PARAMETER_MANAGER_H
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class ParameterManager {
+ public:
+  // log_path empty = no CSV log (HOROVOD_AUTOTUNE_LOG).
+  void Initialize(int64_t fusion_bytes, double cycle_ms,
+                  const std::string& log_path);
+  ~ParameterManager();
+
+  bool active() const { return active_; }
+  int64_t fusion_threshold_bytes() const { return fusion_values_[fusion_idx_]; }
+  double cycle_time_ms() const { return cycle_values_[cycle_idx_]; }
+
+  // Record bytes moved by allreduce responses this cycle; returns true when
+  // a tuning window closed and the recommended parameters may have changed.
+  bool Update(int64_t bytes);
+
+ private:
+  void Score(double bytes_per_sec);
+  bool Move(int direction);  // step the active axis by +-1; false if clamped
+  void TryProbe();           // place next probe, skipping clamped edges
+  void AdvanceAxis();
+  void Log(double score);
+
+  bool active_ = false;
+  bool done_ = false;
+
+  std::vector<int64_t> fusion_values_;
+  std::vector<double> cycle_values_;
+  size_t fusion_idx_ = 0, cycle_idx_ = 0;
+
+  // Coordinate descent: tune fusion axis, then cycle axis, two sweeps.
+  int axis_ = 0;             // 0 = fusion, 1 = cycle
+  int sweeps_left_ = 2;      // full (fusion+cycle) passes remaining
+  int direction_ = +1;       // current probe direction on the axis
+  bool have_baseline_ = false;
+  double baseline_score_ = 0;  // score at current best point
+  int tries_ = 0;            // direction flips tried at this point
+
+  // Window accumulation.
+  int64_t window_bytes_ = 0;
+  int window_cycles_ = 0;
+  int warmup_windows_ = 3;
+  std::chrono::steady_clock::time_point window_start_;
+  bool window_started_ = false;
+
+  FILE* log_ = nullptr;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_PARAMETER_MANAGER_H

@@ -1,0 +1,74 @@
+// Process sets: collectives over subgroups of ranks.
+// Reference analog: horovod/common/process_set.h (ProcessSet,
+// ProcessSetTable) — there each set owns its own communicator + controller
+// state; here a set is a membership list, negotiation is per-set readiness in
+// the (single) controller, and execution runs ring collectives over a
+// non-owning subset view of the global data plane.
+
+#ifndef HVDTPU_PROCESS_SET_H
+#define HVDTPU_PROCESS_SET_H
+
+#include <cstdint>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdtpu {
+
+class ProcessSetTable {
+ public:
+  explicit ProcessSetTable(int world_size) {
+    std::vector<int32_t> all(world_size);
+    for (int i = 0; i < world_size; i++) all[i] = i;
+    sets_[0] = std::move(all);
+  }
+
+  // Register a new set. Must be called in the same order with the same
+  // ranks on every process (ids are assigned locally; the reference has the
+  // same same-order requirement for hvd.add_process_set).
+  int32_t Add(std::vector<int32_t> ranks) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    int32_t id = next_id_++;
+    sets_[id] = std::move(ranks);
+    return id;
+  }
+
+  bool Remove(int32_t id) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    if (id == 0) return false;  // the global set is permanent
+    return sets_.erase(id) > 0;
+  }
+
+  // Copy of the member list (global ranks, registration order), empty if the
+  // id is unknown.
+  std::vector<int32_t> Ranks(int32_t id) const {
+    std::lock_guard<std::mutex> lk(mutex_);
+    auto it = sets_.find(id);
+    return it == sets_.end() ? std::vector<int32_t>{} : it->second;
+  }
+
+  bool Known(int32_t id) const {
+    std::lock_guard<std::mutex> lk(mutex_);
+    return sets_.count(id) > 0;
+  }
+
+  // Index of `rank` within the set, or -1.
+  int32_t RankIn(int32_t id, int32_t rank) const {
+    std::lock_guard<std::mutex> lk(mutex_);
+    auto it = sets_.find(id);
+    if (it == sets_.end()) return -1;
+    for (size_t i = 0; i < it->second.size(); i++) {
+      if (it->second[i] == rank) return (int32_t)i;
+    }
+    return -1;
+  }
+
+ private:
+  mutable std::mutex mutex_;
+  std::unordered_map<int32_t, std::vector<int32_t>> sets_;
+  int32_t next_id_ = 1;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_PROCESS_SET_H

@@ -144,15 +144,43 @@ void ScaleBuffer(void* buf, int64_t count, DataType dt, double factor) {
 }
 
 DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds)
-    : rank_(rank), size_(size), peer_fds_(std::move(peer_fds)) {}
+    : DataPlane(rank, size, std::move(peer_fds), /*owns_fds=*/true) {}
+
+DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds,
+                     bool owns_fds)
+    : rank_(rank), size_(size), peer_fds_(std::move(peer_fds)),
+      owns_fds_(owns_fds) {
+  global_ranks_.resize(size_);
+  for (int i = 0; i < size_; i++) global_ranks_[i] = i;
+}
 
 DataPlane::~DataPlane() {
+  if (!owns_fds_) return;
   for (int fd : peer_fds_) TcpClose(fd);
+}
+
+DataPlane DataPlane::Subset(const std::vector<int32_t>& members) const {
+  std::vector<int> fds(members.size(), -1);
+  int my_idx = -1;
+  for (size_t i = 0; i < members.size(); i++) {
+    if (members[i] == rank_) {
+      my_idx = (int)i;
+    } else {
+      fds[i] = peer_fds_[members[i]];
+    }
+  }
+  // All ring algorithms index peer_fds_ by (group-relative) rank, so a
+  // remapped fd table + group rank/size is a fully working data plane.
+  DataPlane sub(my_idx, (int)members.size(), std::move(fds),
+                /*owns_fds=*/false);
+  sub.global_ranks_ = members;
+  return sub;
 }
 
 Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
                             ReduceOp op) {
   if (size_ == 1 || count == 0) return Status::OK();
+  if (op == ReduceOp::ADASUM) return AdasumAllreduce(buf, count, dt);
   const int64_t elem = DataTypeSize(dt);
   auto* base = (uint8_t*)buf;
   // Segment the buffer into `size_` near-equal chunks.

@@ -28,8 +28,20 @@ class DataPlane {
   DataPlane(int rank, int size, std::vector<int> peer_fds);
   ~DataPlane();
 
-  // In-place ring allreduce over a contiguous buffer.
+  // Non-owning view over a subgroup (global ranks, must contain this rank):
+  // collectives on the view run over only those ranks, with this rank's
+  // position in `members` as its group rank. The view shares the parent's
+  // sockets; destroying it closes nothing.
+  // Reference analog: per-process-set communicators (process_set.h).
+  DataPlane Subset(const std::vector<int32_t>& members) const;
+
+  // In-place ring allreduce over a contiguous buffer. op == ADASUM routes
+  // to AdasumAllreduce.
   Status Allreduce(void* buf, int64_t count, DataType dt, ReduceOp op);
+
+  // Adaptive-summation allreduce (recursive doubling, floats only).
+  // Reference analog: ops/adasum/ (see csrc/adasum.cc).
+  Status AdasumAllreduce(void* buf, int64_t count, DataType dt);
 
   // Variable allgather: rank r contributes bytes_per_rank[r] bytes; output is
   // the rank-order concatenation on every rank.
@@ -54,10 +66,24 @@ class DataPlane {
   int rank() const { return rank_; }
   int size() const { return size_; }
 
+  // Group index of a global rank (identity on the global plane), or -1 if
+  // the rank is not in this (sub)group. Callers must translate global rank
+  // arguments (e.g. broadcast root) before indexing into a subset view.
+  int GroupIndexOf(int global_rank) const {
+    for (size_t i = 0; i < global_ranks_.size(); i++) {
+      if (global_ranks_[i] == global_rank) return (int)i;
+    }
+    return -1;
+  }
+
  private:
+  DataPlane(int rank, int size, std::vector<int> peer_fds, bool owns_fds);
+
   int rank_;
   int size_;
   std::vector<int> peer_fds_;
+  std::vector<int32_t> global_ranks_;  // group index -> global rank
+  bool owns_fds_ = true;
   std::vector<uint8_t> scratch_;
 
   int right_fd() const { return peer_fds_[(rank_ + 1) % size_]; }

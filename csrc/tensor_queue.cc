@@ -28,7 +28,10 @@ std::vector<TensorTableEntry> TensorQueue::GetTensorEntriesFromResponse(
   entries.reserve(response.tensor_names.size());
   for (auto& name : response.tensor_names) {
     auto it = tensor_table_.find(name);
-    if (it != tensor_table_.end()) {
+    // Match the process set too: a same-named tensor pending on a DIFFERENT
+    // set (legal for disjoint sets) must not be consumed by this response.
+    if (it != tensor_table_.end() &&
+        it->second.process_set_id == response.process_set_id) {
       entries.push_back(std::move(it->second));
       tensor_table_.erase(it);
     }

@@ -97,6 +97,7 @@ void Timeline::Emit(const std::string& tensor, char phase,
   cv_.notify_one();
 }
 
+void Timeline::MarkCycle() { Emit("__cycle__", 'i', "CYCLE"); }
 void Timeline::NegotiateStart(const std::string& t) { Emit(t, 'B', "NEGOTIATE"); }
 void Timeline::NegotiateEnd(const std::string& t) { Emit(t, 'E', "NEGOTIATE"); }
 void Timeline::EntryQueued(const std::string& t) { Emit(t, 'i', "QUEUED"); }

@@ -23,6 +23,7 @@ class Timeline {
   void Shutdown();
   bool Enabled() const { return enabled_.load(); }
 
+  void MarkCycle();  // HOROVOD_TIMELINE_MARK_CYCLES instant event
   void NegotiateStart(const std::string& tensor);
   void NegotiateEnd(const std::string& tensor);
   void EntryQueued(const std::string& tensor);

@@ -82,6 +82,13 @@ def load_library():
         lib.hvdtpu_enqueue_join.argtypes = []
         lib.hvdtpu_last_joined_rank.restype = i32
         lib.hvdtpu_last_joined_rank.argtypes = []
+        lib.hvdtpu_add_process_set.restype = i32
+        lib.hvdtpu_add_process_set.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), i32]
+        for fn in ("remove_process_set", "process_set_size",
+                   "process_set_rank"):
+            getattr(lib, f"hvdtpu_{fn}").restype = i32
+            getattr(lib, f"hvdtpu_{fn}").argtypes = [i32]
 
         lib.hvdtpu_poll.restype = i32
         lib.hvdtpu_poll.argtypes = [i32]
@@ -100,6 +107,10 @@ def load_library():
         lib.hvdtpu_release.restype = i32
         lib.hvdtpu_release.argtypes = [i32]
 
+        lib.hvdtpu_start_timeline.restype = i32
+        lib.hvdtpu_start_timeline.argtypes = [cstr]
+        lib.hvdtpu_stop_timeline.restype = i32
+        lib.hvdtpu_stop_timeline.argtypes = []
         lib.hvdtpu_fusion_threshold_bytes.restype = i64
         lib.hvdtpu_cycle_time_ms.restype = dbl
         lib.hvdtpu_set_fusion_threshold_bytes.argtypes = [i64]
@@ -161,3 +172,19 @@ class HorovodBasics:
 
     def is_homogeneous(self):
         return True
+
+    def start_timeline(self, file_path, mark_cycles=False):
+        """Begin recording a Chrome-trace timeline at runtime.
+
+        Reference analog: ``hvd.start_timeline`` (horovod/common/basics.py).
+        """
+        del mark_cycles  # cycle marks are env-controlled at init
+        rc = self.lib.hvdtpu_start_timeline(str(file_path).encode())
+        if rc != 0:
+            raise ValueError(
+                f"could not start timeline at {file_path!r} "
+                "(is Horovod initialized and the path writable?)")
+
+    def stop_timeline(self):
+        """Stop a runtime-started timeline and flush the JSON file."""
+        self.lib.hvdtpu_stop_timeline()

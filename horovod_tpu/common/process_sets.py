@@ -1,0 +1,100 @@
+"""Process sets: collectives over subgroups of ranks.
+
+Reference analog: ``horovod/common/process_sets.py`` (``ProcessSet``,
+``hvd.add_process_set``, ``hvd.remove_process_set``, ``global_process_set``).
+Registration must happen in the same order on every rank; ``add_process_set``
+ends with a global barrier so no rank can use a set before every rank has
+registered it.
+"""
+
+import ctypes
+
+from horovod_tpu.common.basics import HorovodBasics
+
+_basics = HorovodBasics()
+
+
+class ProcessSet:
+    """A subgroup of ranks collectives can run over.
+
+    Pass either to ``add_process_set`` or use the module-level helper with a
+    plain rank list. ``process_set_id`` is assigned at registration.
+    """
+
+    process_set_id = None
+
+    def __init__(self, ranks):
+        self.ranks = None if ranks is None else sorted(int(r) for r in ranks)
+
+    def size(self):
+        """Number of ranks in the set (or None before registration)."""
+        if self.process_set_id is None:
+            return None if self.ranks is None else len(self.ranks)
+        n = _basics.lib.hvdtpu_process_set_size(self.process_set_id)
+        return None if n < 0 else n
+
+    def rank(self):
+        """This process's rank within the set, or None if not included."""
+        if self.process_set_id is None:
+            return None
+        r = _basics.lib.hvdtpu_process_set_rank(self.process_set_id)
+        return None if r < 0 else r
+
+    def included(self):
+        """Whether this process belongs to the set."""
+        return self.rank() is not None
+
+    def __index__(self):  # ops accept ProcessSet wherever an id is expected
+        if self.process_set_id is None:
+            raise ValueError(
+                "ProcessSet is not registered; call hvd.add_process_set first")
+        return self.process_set_id
+
+    def __repr__(self):
+        return (f"ProcessSet(id={self.process_set_id}, "
+                f"ranks={self.ranks})")
+
+
+global_process_set = ProcessSet(None)
+global_process_set.process_set_id = 0
+
+
+def _barrier():
+    from horovod_tpu.common import eager_ops
+
+    eager_ops.barrier()
+
+
+def add_process_set(process_set):
+    """Register a new process set (collective: every rank must call this with
+    the same ranks, in the same order as any other add_process_set calls).
+
+    Accepts a ``ProcessSet`` or a list of ranks; returns the registered
+    ``ProcessSet`` with ``process_set_id`` assigned.
+    """
+    ps = process_set if isinstance(process_set, ProcessSet) \
+        else ProcessSet(process_set)
+    if ps.process_set_id is not None:
+        raise ValueError(f"{ps!r} is already registered")
+    if not ps.ranks:
+        raise ValueError("a process set needs at least one rank")
+    arr = (ctypes.c_int32 * len(ps.ranks))(*ps.ranks)
+    set_id = _basics.lib.hvdtpu_add_process_set(arr, len(ps.ranks))
+    if set_id < 0:
+        raise ValueError(f"invalid process set ranks {ps.ranks}")
+    ps.process_set_id = set_id
+    # No rank may enqueue on the new set before every rank registered it.
+    _barrier()
+    return ps
+
+
+def remove_process_set(process_set):
+    """Deregister a process set (same same-order requirement as add)."""
+    ps_id = int(process_set)
+    if ps_id == 0:
+        raise ValueError("cannot remove the global process set")
+    _barrier()  # drain any in-flight collectives on the set first
+    rc = _basics.lib.hvdtpu_remove_process_set(ps_id)
+    if isinstance(process_set, ProcessSet):
+        process_set.process_set_id = None
+    return rc == 0

@@ -222,3 +222,212 @@ def _worker_join_broadcast_barrier(rank, size):
 
 def test_join_broadcast_and_barrier():
     assert run_ranks(_worker_join_broadcast_barrier, 3) == ["ok"] * 3
+
+
+def _worker_process_sets(rank, size):
+    b = _init(rank)
+    ops = _ops()
+    from horovod_tpu.common import process_sets as psets
+    try:
+        evens = psets.add_process_set([r for r in range(size) if r % 2 == 0])
+        odds = psets.add_process_set([r for r in range(size) if r % 2 == 1])
+        mine = evens if rank % 2 == 0 else odds
+        other = odds if rank % 2 == 0 else evens
+        group = [r for r in range(size) if r % 2 == rank % 2]
+        assert mine.included() and not other.included()
+        assert mine.size() == len(group)
+        assert mine.rank() == group.index(rank)
+
+        # allreduce over the subgroup only.
+        h = ops.allreduce_async(np.full(4, float(rank + 1), np.float32),
+                                "ps.ar", process_set_id=mine)
+        np.testing.assert_allclose(h.synchronize(),
+                                   sum(r + 1 for r in group))
+        # average divides by the SET size.
+        h = ops.allreduce_async(np.full(4, float(rank + 1), np.float32),
+                                "ps.avg", op=ops.ReduceOp.AVERAGE,
+                                process_set_id=mine)
+        np.testing.assert_allclose(
+            h.synchronize(), sum(r + 1 for r in group) / len(group))
+
+        # allgather over the subgroup, unequal first dims.
+        h = ops.allgather_async(np.full((mine.rank() + 1, 2), float(rank),
+                                        np.float32), "ps.ag",
+                                process_set_id=mine)
+        exp = np.concatenate([np.full((i + 1, 2), float(r), np.float32)
+                              for i, r in enumerate(group)])
+        np.testing.assert_allclose(h.synchronize(), exp)
+
+        # broadcast from the set's last member.
+        h = ops.broadcast_async(np.full(3, float(rank), np.float64),
+                                group[-1], "ps.bc", process_set_id=mine)
+        np.testing.assert_allclose(h.synchronize(), float(group[-1]))
+
+        # global collectives still work alongside.
+        h = ops.allreduce_async(np.full(2, 1.0, np.float32), "ps.global")
+        np.testing.assert_allclose(h.synchronize(), float(size))
+
+        psets.remove_process_set(evens)
+        psets.remove_process_set(odds)
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+@pytest.mark.parametrize("size", [3, 4])
+def test_process_sets(size):
+    assert run_ranks(_worker_process_sets, size) == ["ok"] * size
+
+
+def _adasum_expected(vecs):
+    """Replicates csrc/adasum.cc's reduction tree in numpy (float64)."""
+    def combine(a, b):
+        dot, na, nb = float(a @ b), float(a @ a), float(b @ b)
+        ca = 1.0 if na == 0 else 1.0 - dot / (2 * na)
+        cb = 1.0 if nb == 0 else 1.0 - dot / (2 * nb)
+        return ca * a + cb * b
+
+    vecs = [v.astype(np.float64) for v in vecs]
+    n, p = len(vecs), 1
+    while p * 2 <= n:
+        p *= 2
+    for r in range(n - p):
+        vecs[r] = combine(vecs[r], vecs[r + p])
+    dist = 1
+    while dist < p:
+        stage = list(vecs)
+        for r in range(p):
+            vecs[r] = combine(stage[r], stage[r ^ dist])
+        dist *= 2
+    return vecs[0]
+
+
+def _worker_adasum(rank, size):
+    b = _init(rank)
+    ops = _ops()
+    try:
+        rng = np.random.RandomState(17 + rank)
+        v = rng.randn(64)
+        h = ops.allreduce_async(v.copy(), "adasum", op=ops.ReduceOp.ADASUM)
+        r = h.synchronize()
+        exp = _adasum_expected([np.random.RandomState(17 + rk).randn(64)
+                                for rk in range(size)])
+        np.testing.assert_allclose(r, exp, rtol=1e-12)
+
+        # Scale invariance: identical gradients average back to themselves.
+        w = np.arange(8, dtype=np.float64) + 1
+        h = ops.allreduce_async(w.copy(), "adasum.same",
+                                op=ops.ReduceOp.ADASUM)
+        np.testing.assert_allclose(h.synchronize(), w, rtol=1e-12)
+
+        # Integer dtype is rejected cleanly, not a hang.
+        h = ops.allreduce_async(np.ones(4, np.int32), "adasum.int",
+                                op=ops.ReduceOp.ADASUM)
+        try:
+            h.synchronize()
+            return "no-error"
+        except ops.HorovodInternalError as e:
+            assert "floating-point" in str(e)
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+@pytest.mark.parametrize("size", [2, 3, 4])
+def test_adasum(size):
+    assert run_ranks(_worker_adasum, size) == ["ok"] * size
+
+
+def _worker_autotune(rank, size):
+    b = _init(rank)
+    ops = _ops()
+    try:
+        # Correctness must hold while the autotuner walks the knob grid and
+        # broadcasts new values mid-training.
+        for i in range(30):
+            h = ops.allreduce_async(
+                np.full(1024, float(rank + i), np.float32), f"at.{i}")
+            np.testing.assert_allclose(
+                h.synchronize(), sum(rk + i for rk in range(size)))
+        if rank == 0:
+            import os
+            log = os.environ["HOROVOD_AUTOTUNE_LOG"]
+            assert open(log).readline().startswith(
+                "fusion_threshold_bytes,cycle_time_ms")
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+def test_autotune(tmp_path):
+    log = str(tmp_path / "autotune.csv")
+    assert run_ranks(_worker_autotune, 2,
+                     env={"HOROVOD_AUTOTUNE": "1",
+                          "HOROVOD_AUTOTUNE_LOG": log}) == ["ok"] * 2
+
+
+def _worker_runtime_timeline(rank, size):
+    import json
+    import os
+
+    b = _init(rank)
+    ops = _ops()
+    try:
+        path = os.path.join(os.environ["HVDTPU_TEST_TMP"], f"tl.{rank}.json")
+        b.start_timeline(path)
+        h = ops.allreduce_async(np.full(8, float(rank), np.float32), "tl.ar")
+        h.synchronize()
+        ops.barrier()
+        b.stop_timeline()
+        events = json.load(open(path))
+        names = {e.get("name") for e in events if e}
+        assert "RING_ALLREDUCE" in names, names
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+def test_runtime_timeline(tmp_path):
+    assert run_ranks(_worker_runtime_timeline, 2,
+                     env={"HVDTPU_TEST_TMP": str(tmp_path)}) == ["ok"] * 2
+
+
+def _worker_ps_barrier_and_errors(rank, size):
+    b = _init(rank)
+    ops = _ops()
+    from horovod_tpu.common import process_sets as psets
+    try:
+        sub = psets.add_process_set([0, 1])
+        # Set-scoped barrier on a subset, then a global barrier: per-set
+        # sequence numbers must keep the global barrier aligned
+        # (regression: a single global counter desynced and hung here).
+        if rank in (0, 1):
+            ops.barrier(process_set_id=sub)
+        ops.barrier()
+
+        # Unknown process set -> error, not a silent hang.
+        h = ops.allreduce_async(np.ones(3, np.float32), "ps.unknown",
+                                process_set_id=999)
+        try:
+            h.synchronize()
+            return "no-error"
+        except ops.HorovodInternalError as e:
+            assert "process set" in str(e)
+
+        # Non-member submitting on a set -> error surfaced to that rank.
+        if rank == size - 1 and rank not in (0, 1):
+            h = ops.allreduce_async(np.ones(3, np.float32), "ps.foreign",
+                                    process_set_id=sub)
+            try:
+                h.synchronize()
+                return "no-error"
+            except ops.HorovodInternalError as e:
+                assert "not a member" in str(e)
+        ops.barrier()
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+def test_process_set_barrier_and_errors():
+    assert run_ranks(_worker_ps_barrier_and_errors, 3) == ["ok"] * 3
