@@ -1,0 +1,202 @@
+"""Elastic training: the worker-side retry loop and state machinery.
+
+Reference analog: ``horovod/common/elastic.py`` (``State``,
+``ObjectState``, ``run_fn``) + §3.4 of SURVEY.md: training wraps in
+``@hvd.elastic.run``; a failed collective raises ``HorovodInternalError``
+→ restore last commit; a topology change raises ``HostsUpdatedInterrupt``
+→ re-rendezvous without rollback. ``reset()`` tears the core down and
+re-initializes against the driver's rendezvous (new rank/size/epoch).
+"""
+
+import copy
+import os
+import socket
+import uuid
+
+from horovod_tpu.common import eager_ops
+from horovod_tpu.common.basics import HorovodBasics
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+
+_basics = HorovodBasics()
+
+
+def _is_elastic():
+    return bool(os.environ.get("HOROVOD_RDZV_ADDR"))
+
+
+def _worker_id():
+    wid = os.environ.get("HOROVOD_WORKER_ID")
+    if not wid:
+        wid = f"{socket.gethostname()}:{uuid.uuid4().hex[:8]}"
+        os.environ["HOROVOD_WORKER_ID"] = wid
+    return wid
+
+
+def init():
+    """Initialize the core; in elastic mode, first obtain this epoch's rank
+    assignment from the driver's rendezvous server."""
+    if not _is_elastic():
+        _basics.init()
+        return
+    from horovod_tpu.runner.elastic.rendezvous import RendezvousClient
+    from horovod_tpu.runner.elastic.worker import notification_manager
+
+    client = RendezvousClient(os.environ["HOROVOD_RDZV_ADDR"],
+                              os.environ["HOROVOD_RDZV_PORT"])
+    notify_port = notification_manager.init()
+    client.register(_worker_id(), os.environ.get("HOROVOD_HOSTNAME",
+                                                 socket.gethostname()),
+                    int(os.environ.get("HOROVOD_LOCAL_RANK", 0)),
+                    notify_port)
+    timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", 60))
+    last_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", 0))
+    asg = client.poll_assignment(_worker_id(), timeout,
+                                 min_epoch=last_epoch + 1)
+    os.environ["HOROVOD_ELASTIC_EPOCH"] = str(asg["epoch"])
+    os.environ.update({
+        "HOROVOD_RANK": str(asg["rank"]),
+        "HOROVOD_SIZE": str(asg["size"]),
+        "HOROVOD_LOCAL_RANK": str(asg["local_rank"]),
+        "HOROVOD_LOCAL_SIZE": str(asg["local_size"]),
+        "HOROVOD_CROSS_RANK": str(asg["cross_rank"]),
+        "HOROVOD_CROSS_SIZE": str(asg["cross_size"]),
+        "HOROVOD_CONTROLLER_ADDR": asg["controller_addr"],
+        "HOROVOD_CONTROLLER_PORT": str(asg["controller_port"]),
+    })
+    _basics.init()
+
+
+def reset():
+    """Tear down and re-rendezvous (elastic epoch transition)."""
+    _basics.shutdown()
+    init()
+
+
+def _poll_hosts_updated():
+    if not _is_elastic():
+        return False, False
+    from horovod_tpu.runner.elastic.worker import notification_manager
+
+    return notification_manager.poll_hosts_updated()
+
+
+class State:
+    """Base elastic state: commit/restore/sync + reset callbacks.
+
+    Reference analog: horovod/common/elastic.py State.
+    """
+
+    def __init__(self):
+        self._reset_callbacks = []
+
+    def register_reset_callbacks(self, callbacks):
+        """Callbacks run after every re-rendezvous (e.g. rescale the
+        learning rate to the new world size)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self):
+        """Checkpoint to (host) memory and surface any pending topology
+        change as HostsUpdatedInterrupt — the reference's commit contract."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        updated, skip_sync = _poll_hosts_updated()
+        if updated:
+            raise HostsUpdatedInterrupt(skip_sync)
+
+    # Subclass surface:
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+def _broadcast_object(obj, root_rank=0, name="elastic.obj"):
+    """Pickle-broadcast via two eager broadcasts (length, then payload)."""
+    import pickle
+
+    import numpy as np
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    n = eager_ops.broadcast_async(
+        np.array([payload.size], dtype=np.int64), root_rank,
+        f"{name}.len").synchronize()[0]
+    buf = payload if _basics.rank() == root_rank else np.zeros(
+        int(n), dtype=np.uint8)
+    out = eager_ops.broadcast_async(buf, root_rank,
+                                    f"{name}.payload").synchronize()
+    return pickle.loads(out.tobytes())
+
+
+class ObjectState(State):
+    """Elastic state over arbitrary picklable attributes.
+
+    Reference analog: horovod/common/elastic.py ObjectState — attributes
+    set via kwargs are committed/restored/synced as one pickled unit.
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved_state = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def save(self):
+        self._saved_state = {
+            k: copy.deepcopy(getattr(self, k)) for k in self._saved_state}
+
+    def restore(self):
+        for k, v in self._saved_state.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        if _basics.size() == 1:
+            return
+        self._saved_state = _broadcast_object(self._saved_state,
+                                              name="elastic.object_state")
+        self.restore()
+
+
+def run_fn(func):
+    """Wrap an elastic train function: sync → run → recover loop.
+
+    Reference analog: horovod/common/elastic.py run_fn. Usage::
+
+        @hvd.elastic.run
+        def train(state, ...): ...
+    """
+
+    def wrapper(state, *args, **kwargs):
+        skip_sync = False
+        while True:
+            # sync() runs collectives, so it sits INSIDE the recovery
+            # scope: a host lost right after reset must loop, not raise.
+            try:
+                if not skip_sync:
+                    state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                skip_sync = e.skip_sync
+            reset()
+            state.on_reset()
+
+    return wrapper

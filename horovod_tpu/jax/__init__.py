@@ -16,6 +16,12 @@ Two data planes:
   compiled by XLA onto TPU ICI — the TPU-native fast path.
 """
 
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+    remove_process_set,
+)
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError,
     HostsUpdatedInterrupt,
@@ -60,6 +66,8 @@ from horovod_tpu.jax.mpi_ops import (  # noqa: F401
     reducescatter_async,
     shutdown,
     size,
+    start_timeline,
+    stop_timeline,
     synchronize,
 )
 from horovod_tpu.jax.optimizer import (  # noqa: F401
@@ -67,3 +75,5 @@ from horovod_tpu.jax.optimizer import (  # noqa: F401
     DistributedOptimizer,
     allreduce_gradients,
 )
+
+from horovod_tpu.jax import elastic  # noqa: E402,F401

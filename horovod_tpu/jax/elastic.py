@@ -1,0 +1,65 @@
+"""Elastic training for the JAX frontend.
+
+Reference analog: ``horovod/torch/elastic/state.py`` (TorchState) adapted
+to pytrees — the reference has no JAX frontend (SURVEY.md §2.3); the
+commit/restore/sync contract is identical: ``commit()`` snapshots to host
+memory, ``restore()`` rolls back after a failed collective, ``sync()``
+broadcasts rank 0's state after a re-rendezvous.
+
+Usage::
+
+    state = hvd.elastic.JaxState(params=params, opt_state=opt_state, step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < total_steps:
+            ... state.params, state.opt_state = update(...)
+            if state.step % 10 == 0:
+                state.commit()
+            state.step += 1
+"""
+
+import copy
+
+import jax
+import numpy as np
+
+from horovod_tpu.common import elastic as _elastic
+from horovod_tpu.common.elastic import State, _broadcast_object
+
+run = _elastic.run_fn
+init = _elastic.init
+reset = _elastic.reset
+ObjectState = _elastic.ObjectState
+
+
+def _to_host(tree):
+    """Device pytree -> host numpy pytree (the commit snapshot)."""
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+class JaxState(State):
+    """Elastic state over named pytrees / picklable values."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._keys = list(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.save()
+
+    def save(self):
+        self._saved = {k: _to_host(getattr(self, k)) for k in self._keys}
+
+    def restore(self):
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        from horovod_tpu.common.basics import HorovodBasics
+
+        if HorovodBasics().size() == 1:
+            return
+        self.save()
+        self._saved = _broadcast_object(self._saved, name="elastic.jax_state")
+        self.restore()

@@ -1,0 +1,16 @@
+"""Elastic launch: driver, host discovery, rendezvous, notifications.
+
+Reference analog: ``horovod/runner/elastic/`` (ElasticDriver,
+HostDiscoveryScript, ElasticRendezvousServer, WorkerNotificationService —
+SURVEY.md §2.4, §3.4).
+"""
+
+from horovod_tpu.runner.elastic.discovery import (  # noqa: F401
+    HostDiscoveryScript,
+    HostManager,
+)
+from horovod_tpu.runner.elastic.driver import ElasticDriver  # noqa: F401
+from horovod_tpu.runner.elastic.rendezvous import (  # noqa: F401
+    RendezvousClient,
+    RendezvousServer,
+)

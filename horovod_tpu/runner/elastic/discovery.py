@@ -1,0 +1,87 @@
+"""Host discovery for elastic jobs.
+
+Reference analog: ``horovod/runner/elastic/discovery.py``
+(HostDiscoveryScript, HostManager) — a user-supplied executable prints the
+current worker hosts, one ``hostname:slots`` per line; the driver polls it
+and reacts to adds/removes. Hosts that repeatedly fail are blacklisted.
+"""
+
+import subprocess
+import threading
+
+
+class HostDiscoveryScript:
+    """Runs the user's discovery executable and parses host:slots lines."""
+
+    def __init__(self, script, default_slots=1):
+        self.script = script
+        self.default_slots = default_slots
+
+    def find_available_hosts_and_slots(self):
+        out = subprocess.run([self.script], capture_output=True, text=True,
+                             timeout=60, check=True).stdout
+        hosts = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" in line:
+                name, slots = line.rsplit(":", 1)
+                hosts[name] = int(slots)
+            else:
+                hosts[line] = self.default_slots
+        return hosts
+
+
+class FixedHosts:
+    """Static 'discovery' from -H/--hostfile (elastic min/max without a
+    script degenerates to failure recovery over a fixed pool)."""
+
+    def __init__(self, hosts):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self):
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Tracks the live host set and failure blacklist.
+
+    Reference analog: discovery.HostManager (current_hosts, blacklist).
+    """
+
+    def __init__(self, discovery):
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._blacklist = set()
+        self._current = {}
+
+    def update_available_hosts(self):
+        """Re-run discovery; returns (changed, added, removed)."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            found = {h: s for h, s in found.items()
+                     if h not in self._blacklist}
+            added = sorted(set(found) - set(self._current))
+            removed = sorted(set(self._current) - set(found))
+            changed = bool(added or removed) or found != self._current
+            self._current = found
+            return changed, added, removed
+
+    def blacklist(self, host):
+        with self._lock:
+            self._blacklist.add(host)
+            self._current.pop(host, None)
+
+    def is_blacklisted(self, host):
+        with self._lock:
+            return host in self._blacklist
+
+    @property
+    def current_hosts(self):
+        with self._lock:
+            return dict(self._current)
+
+    def slot_count(self):
+        with self._lock:
+            return sum(self._current.values())

@@ -1,0 +1,271 @@
+"""The elastic driver: keeps the worker fleet matched to discovered hosts.
+
+Reference analog: ``horovod/runner/elastic/driver.py`` (ElasticDriver:
+worker registry, host assignments, ``wait_for_available_slots``, the
+discovery thread, respawn of failed slots, host blacklisting).
+
+Lifecycle per epoch:
+  1. reconcile: kill workers on removed hosts, spawn workers for empty
+     slots (capped at max_np), notify surviving workers if topology grew;
+  2. wait until every alive worker has registered with the rendezvous;
+  3. publish epoch assignments (rank/local/cross layout + a fresh
+     controller endpoint); resetting workers pick them up and re-init.
+Worker failure surfaces as process exit: the dead worker's peers hit
+HorovodInternalError organically (broken control plane) and re-enter
+rendezvous; the driver respawns the slot (or proceeds smaller if the host
+is gone, down to min_np).
+"""
+
+import os
+import shlex
+import sys
+import threading
+import time
+import uuid
+
+from horovod_tpu.runner import safe_shell_exec, util
+from horovod_tpu.runner.elastic.discovery import HostManager
+from horovod_tpu.runner.elastic.rendezvous import RendezvousServer
+from horovod_tpu.runner.elastic.worker import notify_worker
+
+_FAILURES_TO_BLACKLIST = 3
+
+
+class _Worker:
+    def __init__(self, worker_id, host, local_index):
+        self.worker_id = worker_id
+        self.host = host
+        self.local_index = local_index  # slot on its host at spawn time
+        self.kill_event = threading.Event()
+        self.thread = None
+        self.exit_code = None
+
+
+class ElasticDriver:
+    def __init__(self, discovery, command, min_np, max_np=None,
+                 poll_interval=2.0, start_timeout=60, env=None, verbose=False):
+        self._manager = HostManager(discovery)
+        self._command = list(command)
+        self._min_np = min_np
+        self._max_np = max_np or 10 ** 9
+        self._poll_interval = poll_interval
+        self._start_timeout = start_timeout
+        self._extra_env = dict(env or {})
+        self._verbose = verbose
+
+        self._rendezvous = RendezvousServer()
+        self._lock = threading.RLock()
+        self._workers = {}           # worker_id -> _Worker (alive)
+        self._host_failures = {}
+        self._shutdown = threading.Event()
+        self._reconcile_needed = threading.Event()
+        self._epoch_cut = threading.Event()
+        self._final_codes = []
+
+    # ---- public API -----------------------------------------------------
+
+    @property
+    def rendezvous(self):
+        return self._rendezvous
+
+    def start(self):
+        self._manager.update_available_hosts()
+        self.wait_for_available_slots(self._min_np)
+        self._reconcile()
+        self._thread = threading.Thread(target=self._monitor, daemon=True)
+        self._thread.start()
+
+    def wait_for_available_slots(self, min_np, timeout=None):
+        """Block until discovery reports at least min_np slots."""
+        deadline = time.monotonic() + (timeout or self._start_timeout)
+        while self._manager.slot_count() < min_np:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {self._manager.slot_count()} slots available "
+                    f"after {self._start_timeout}s; need {min_np}")
+            time.sleep(self._poll_interval / 4)
+            self._manager.update_available_hosts()
+
+    def wait_for_completion(self):
+        """Block until the fleet has exited; returns 0 on success."""
+        while True:
+            with self._lock:
+                if not self._workers and not self._reconcile_needed.is_set():
+                    break
+            if self._shutdown.is_set():
+                break
+            time.sleep(0.25)
+        self._shutdown.set()
+        with self._lock:
+            codes = list(self._final_codes)
+        return 0 if codes and all(c == 0 for c in codes) else 1
+
+    def stop(self):
+        self._shutdown.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.kill_event.set()
+        self._rendezvous.stop()
+
+    # ---- internals ------------------------------------------------------
+
+    def _rdzv_addr(self):
+        hosts = [util.HostInfo(h, s)
+                 for h, s in self._manager.current_hosts.items()]
+        return util.resolvable_addr_for(hosts)
+
+    def _monitor(self):
+        while not self._shutdown.is_set():
+            time.sleep(self._poll_interval)
+            try:
+                changed, added, removed = \
+                    self._manager.update_available_hosts()
+            except Exception as e:  # discovery script hiccup: keep last view
+                if self._verbose:
+                    print(f"[elastic driver] discovery failed: {e}",
+                          file=sys.stderr)
+                continue
+            if changed or self._reconcile_needed.is_set():
+                self._reconcile_needed.clear()
+                self._reconcile(notify=bool(added))
+
+    def _spawn(self, host, local_index):
+        worker_id = f"{host}:{uuid.uuid4().hex[:8]}"
+        w = _Worker(worker_id, host, local_index)
+
+        def run():
+            env = dict(os.environ)
+            env.update(self._extra_env)
+            env.update({
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_WORKER_ID": worker_id,
+                "HOROVOD_HOSTNAME": host,
+                "HOROVOD_RDZV_ADDR": self._rdzv_addr(),
+                "HOROVOD_RDZV_PORT": str(self._rendezvous.port),
+            })
+            if util.is_local_host(host):
+                cmd = list(self._command)
+            else:
+                exports = " ".join(
+                    f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+                    if k.startswith("HOROVOD_"))
+                cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                       f"cd {shlex.quote(os.getcwd())} && env {exports} "
+                       + " ".join(shlex.quote(c) for c in self._command)]
+            rc = safe_shell_exec.execute(
+                cmd, env=env,
+                prefix=f"[{worker_id}]: " if self._verbose else b"",
+                events=[w.kill_event, self._shutdown])
+            self._on_worker_exit(w, rc)
+
+        w.thread = threading.Thread(target=run, daemon=True)
+        with self._lock:
+            self._workers[worker_id] = w
+        w.thread.start()
+        return w
+
+    def _on_worker_exit(self, worker, rc):
+        worker.exit_code = rc
+        with self._lock:
+            self._workers.pop(worker.worker_id, None)
+            self._rendezvous.forget_worker(worker.worker_id)
+            self._final_codes.append(rc)
+        if rc == 0:
+            # Clean finish: the job is completing; let peers finish too.
+            return
+        if self._shutdown.is_set():
+            return
+        n = self._host_failures[worker.host] = \
+            self._host_failures.get(worker.host, 0) + 1
+        if n >= _FAILURES_TO_BLACKLIST:
+            self._manager.blacklist(worker.host)
+        self._reconcile_needed.set()
+
+    def _reconcile(self, notify=False):
+        """Match the fleet to the current host view and cut a new epoch."""
+        with self._lock:
+            hosts = self._manager.current_hosts
+            # Kill workers whose host vanished.
+            for w in list(self._workers.values()):
+                if w.host not in hosts:
+                    w.kill_event.set()
+                    self._workers.pop(w.worker_id, None)
+                    self._rendezvous.forget_worker(w.worker_id)
+            # Spawn to fill empty slots, up to max_np total.
+            per_host = {}
+            for w in self._workers.values():
+                per_host[w.host] = per_host.get(w.host, 0) + 1
+            total = sum(per_host.values())
+            for host, slots in sorted(hosts.items()):
+                for idx in range(per_host.get(host, 0), slots):
+                    if total >= self._max_np:
+                        break
+                    self._spawn(host, idx)
+                    total += 1
+            alive = list(self._workers.values())
+        if total < self._min_np:
+            if self._verbose:
+                print(f"[elastic driver] {total} workers < min_np="
+                      f"{self._min_np}; waiting for discovery",
+                      file=sys.stderr)
+            return
+        if notify:
+            registered = self._rendezvous.registered_workers()
+            for w in alive:
+                info = registered.get(w.worker_id)
+                if info and info.get("notify_port"):
+                    notify_worker(w.host if not util.is_local_host(w.host)
+                                  else "127.0.0.1", info["notify_port"])
+        self._cut_epoch(alive)
+
+    def _cut_epoch(self, workers):
+        """Wait for registrations, then publish rank assignments."""
+        deadline = time.monotonic() + self._start_timeout
+        ids = {w.worker_id for w in workers}
+        while time.monotonic() < deadline:
+            registered = set(self._rendezvous.registered_workers())
+            with self._lock:
+                ids &= set(self._workers)  # drop workers that died meanwhile
+            if ids and ids <= registered:
+                break
+            time.sleep(0.1)
+        else:
+            self._reconcile_needed.set()
+            return
+        with self._lock:
+            workers = [self._workers[i] for i in sorted(ids)
+                       if i in self._workers]
+        if not workers:
+            return
+        # Rank layout: sort by (host, local index) for stable, dense ranks.
+        workers.sort(key=lambda w: (w.host, w.local_index, w.worker_id))
+        by_host = {}
+        for w in workers:
+            by_host.setdefault(w.host, []).append(w)
+        hostnames = sorted(by_host)
+        root_host = workers[0].host
+        controller_addr = ("127.0.0.1" if util.is_local_host(root_host)
+                           else root_host)
+        controller_port = util.free_port()
+        assignments = {}
+        for rank, w in enumerate(workers):
+            local = by_host[w.host]
+            assignments[w.worker_id] = {
+                "rank": rank,
+                "size": len(workers),
+                "local_rank": local.index(w),
+                "local_size": len(local),
+                "cross_rank": hostnames.index(w.host),
+                "cross_size": len(hostnames),
+                "controller_addr": controller_addr,
+                "controller_port": controller_port,
+            }
+        epoch = self._rendezvous.start_epoch(assignments)
+        with self._lock:
+            # Success is judged on the FINAL epoch only: a worker that died
+            # and was recovered from must not fail the whole job.
+            self._final_codes.clear()
+        if self._verbose:
+            print(f"[elastic driver] epoch {epoch}: "
+                  f"{[w.worker_id for w in workers]}", file=sys.stderr)

@@ -1,0 +1,176 @@
+"""HTTP rendezvous: workers register and poll for epoch rank assignments.
+
+Reference analog: ``horovod/runner/http/http_server.py`` (RendezvousServer,
+the KVStore handler) + ``runner/elastic/rendezvous.py``
+(ElasticRendezvousServer). One server per job, driver-side; each elastic
+reset bumps the epoch and re-assigns ranks. Also exposes a generic /kv
+store, as the reference's Gloo rendezvous does.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv = {}
+        # worker_id -> info dict (host, local_rank, notify_port, epoch seen)
+        self.workers = {}
+        self.epoch = 0
+        # epoch -> {worker_id -> assignment dict}
+        self.assignments = {}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state = None  # injected by RendezvousServer
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code, payload=None):
+        body = b"" if payload is None else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def do_PUT(self):
+        if self.path.startswith("/kv/"):
+            with self.state.lock:
+                self.state.kv[self.path[4:]] = self._body()
+            return self._send(200)
+        return self._send(404)
+
+    def do_POST(self):
+        if self.path == "/register":
+            info = self._body()
+            with self.state.lock:
+                self.state.workers[info["worker_id"]] = info
+            return self._send(200)
+        return self._send(404)
+
+    def do_GET(self):
+        if self.path.startswith("/kv/"):
+            with self.state.lock:
+                val = self.state.kv.get(self.path[4:])
+            return self._send(404 if val is None else 200, val)
+        if self.path.startswith("/assignment/"):
+            worker_id = self.path[len("/assignment/"):]
+            with self.state.lock:
+                cur = self.state.assignments.get(self.state.epoch, {})
+                asg = cur.get(worker_id)
+            # 202: registered but this epoch's assignment isn't cut yet.
+            return self._send(202 if asg is None else 200, asg)
+        if self.path == "/workers":
+            with self.state.lock:
+                return self._send(200, self.state.workers)
+        return self._send(404)
+
+
+class RendezvousServer:
+    """Driver-side registry + rank assignment service."""
+
+    def __init__(self, addr="0.0.0.0"):
+        self._state = _State()
+        handler = type("Handler", (_Handler,), {"state": self._state})
+        self._httpd = ThreadingHTTPServer((addr, 0), handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def registered_workers(self):
+        with self._state.lock:
+            return dict(self._state.workers)
+
+    def forget_worker(self, worker_id):
+        with self._state.lock:
+            self._state.workers.pop(worker_id, None)
+
+    def start_epoch(self, assignments):
+        """Publish a new epoch's worker_id -> assignment map; workers polling
+        /assignment see it immediately. Returns the epoch number."""
+        with self._state.lock:
+            self._state.epoch += 1
+            for asg in assignments.values():
+                asg["epoch"] = self._state.epoch
+            self._state.assignments[self._state.epoch] = dict(assignments)
+            return self._state.epoch
+
+    @property
+    def epoch(self):
+        with self._state.lock:
+            return self._state.epoch
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class RendezvousClient:
+    """Worker-side helper for register + assignment polling + KV."""
+
+    def __init__(self, addr, port):
+        self.addr = addr
+        self.port = int(port)
+
+    def _url(self, path):
+        return f"http://{self.addr}:{self.port}{path}"
+
+    def _request(self, method, path, payload=None):
+        import urllib.request
+
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(self._url(path), data=data,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = resp.read()
+                return resp.status, json.loads(body) if body else None
+        except urllib.error.HTTPError as e:  # non-2xx still carries status
+            return e.code, None
+
+    def register(self, worker_id, host, local_rank, notify_port):
+        code, _ = self._request("POST", "/register", {
+            "worker_id": worker_id, "host": host,
+            "local_rank": local_rank, "notify_port": notify_port})
+        if code != 200:
+            raise RuntimeError(f"rendezvous register failed: HTTP {code}")
+
+    def poll_assignment(self, worker_id, timeout, min_epoch=0,
+                        interval=0.25):
+        """Block until this worker's assignment for an epoch >= min_epoch is
+        published; returns the assignment dict.
+
+        min_epoch matters on re-rendezvous: a worker that detected a peer
+        failure before the driver did must NOT re-adopt the still-published
+        old epoch (it references the dead worker and a stale controller
+        endpoint), or it would block forever in controller bootstrap.
+        """
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            code, asg = self._request("GET", f"/assignment/{worker_id}")
+            if code == 200 and asg.get("epoch", 0) >= min_epoch:
+                return asg
+            time.sleep(interval)
+        raise TimeoutError(
+            f"no rendezvous assignment for {worker_id} (epoch >= "
+            f"{min_epoch}) within {timeout}s")
+
+    def kv_put(self, key, value):
+        self._request("PUT", f"/kv/{key}", value)
+
+    def kv_get(self, key):
+        code, val = self._request("GET", f"/kv/{key}")
+        return val if code == 200 else None

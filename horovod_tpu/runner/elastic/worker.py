@@ -1,0 +1,91 @@
+"""Worker-side notification listener for elastic events.
+
+Reference analog: ``horovod/runner/elastic/worker.py``
+(WorkerNotificationService / WorkerNotificationManager) — the driver pings
+each worker over HTTP when the host topology changes; the worker raises
+``HostsUpdatedInterrupt`` at the next commit boundary.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class WorkerNotificationManager:
+    """Singleton per worker process: listens for driver notifications and
+    latches a hosts-updated flag that elastic ``State`` objects consume."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._hosts_updated = False
+        self._skip_sync = False
+
+    def init(self, addr="0.0.0.0"):
+        with self._lock:
+            if self._httpd is not None:
+                return self.port
+        manager = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                if self.path == "/notify":
+                    n = int(self.headers.get("Content-Length", 0))
+                    event = json.loads(self.rfile.read(n) or b"{}")
+                    manager.handle_hosts_updated(
+                        skip_sync=bool(event.get("skip_sync", False)))
+                    self.send_response(200)
+                else:
+                    self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        httpd = ThreadingHTTPServer((addr, 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        with self._lock:
+            self._httpd = httpd
+        return self.port
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def handle_hosts_updated(self, skip_sync=False):
+        with self._lock:
+            self._hosts_updated = True
+            self._skip_sync = skip_sync
+
+    def poll_hosts_updated(self):
+        """Consume the latched flag; returns (updated, skip_sync)."""
+        with self._lock:
+            updated, skip = self._hosts_updated, self._skip_sync
+            self._hosts_updated = False
+            self._skip_sync = False
+            return updated, skip
+
+    def shutdown(self):
+        with self._lock:
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+                self._httpd = None
+
+
+notification_manager = WorkerNotificationManager()
+
+
+def notify_worker(host, port, skip_sync=False, timeout=5):
+    """Driver side: ping one worker's notification service."""
+    import urllib.request
+
+    data = json.dumps({"skip_sync": skip_sync}).encode()
+    req = urllib.request.Request(f"http://{host}:{port}/notify", data=data,
+                                 method="POST")
+    try:
+        urllib.request.urlopen(req, timeout=timeout)
+        return True
+    except OSError:
+        return False

@@ -40,6 +40,19 @@ def parse_args(argv=None):
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--tpu-pod", action="store_true",
                    help="one rank per local TPU chip, chips pinned per rank")
+    # Elastic mode (reference: --min-np/--max-np/--host-discovery-script)
+    p.add_argument("--min-np", type=int, default=None,
+                   help="elastic: keep training while >= this many workers")
+    p.add_argument("--max-np", type=int, default=None,
+                   help="elastic: never run more than this many workers")
+    p.add_argument("--host-discovery-script", default=None,
+                   help="elastic: executable printing current host:slots "
+                        "lines; polled for topology changes")
+    p.add_argument("--slots", type=int, default=1,
+                   help="elastic: default slots per discovered host")
+    p.add_argument("--elastic-poll-interval", type=float, default=2.0,
+                   help="elastic: seconds between discovery polls "
+                        "(HOROVOD_ELASTIC_TIMEOUT analog)")
     # Tuning knobs -> env (reference: config_parser.py set_env_from_args)
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
@@ -65,9 +78,20 @@ def parse_args(argv=None):
         p.error("no command given")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
-    if args.np is None and not args.tpu_pod:
+    if args.min_np or args.max_np or args.host_discovery_script:
+        if args.np is None:
+            args.np = args.min_np
+        if args.min_np is None:
+            args.min_np = args.np
+        if args.np is None:
+            p.error("elastic mode needs -np or --min-np")
+    elif args.np is None and not args.tpu_pod:
         p.error("-np is required (or use --tpu-pod)")
     return args
+
+
+def is_elastic(args):
+    return bool(args.min_np or args.max_np or args.host_discovery_script)
 
 
 def _apply_config_file(args):
@@ -159,7 +183,39 @@ def _ssh_wrap(slot, command_env, command, ssh_port, identity_file):
     return ssh + [slot.hostname, remote]
 
 
+def run_elastic(args):
+    """Elastic launch (reference: launch.py _run_elastic + gloo_run
+    elastic path): driver + discovery + rendezvous instead of a static
+    slot layout."""
+    from horovod_tpu.runner.elastic.discovery import (
+        FixedHosts,
+        HostDiscoveryScript,
+    )
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        default_slots=args.slots)
+    else:
+        hosts = (util.parse_hostfile(args.hostfile) if args.hostfile
+                 else util.parse_hosts(args.hosts or f"localhost:{args.np}"))
+        discovery = FixedHosts({h.hostname: h.slots for h in hosts})
+
+    driver = ElasticDriver(
+        discovery, args.command, min_np=args.min_np,
+        max_np=args.max_np or args.np, poll_interval=args.elastic_poll_interval,
+        start_timeout=args.start_timeout, env=env_from_args(args),
+        verbose=args.verbose)
+    driver.start()
+    try:
+        return driver.wait_for_completion()
+    finally:
+        driver.stop()
+
+
 def run_launcher(args):
+    if is_elastic(args):
+        return run_elastic(args)
     if args.tpu_pod and args.np is None:
         args.np = _tpu_pod_np()
     hosts = (util.parse_hostfile(args.hostfile) if args.hostfile

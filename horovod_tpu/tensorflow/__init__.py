@@ -8,6 +8,12 @@ collectives, ``DistributedGradientTape``, ``broadcast_variables``,
 
 import tensorflow as tf
 
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+    remove_process_set,
+)
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError,
     HostsUpdatedInterrupt,
@@ -39,6 +45,8 @@ from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
     reducescatter,
     shutdown,
     size,
+    start_timeline,
+    stop_timeline,
 )
 
 

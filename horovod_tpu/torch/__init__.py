@@ -8,6 +8,12 @@ broadcast_parameters / broadcast_optimizer_state / broadcast_object,
 Compression, SyncBatchNorm.
 """
 
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+    remove_process_set,
+)
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError,
     HostsUpdatedInterrupt,
@@ -55,7 +61,11 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     reducescatter_async,
     shutdown,
     size,
+    start_timeline,
+    stop_timeline,
     synchronize,
 )
 from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
+
+from horovod_tpu.torch import elastic  # noqa: E402,F401

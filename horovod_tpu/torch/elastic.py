@@ -1,0 +1,77 @@
+"""Elastic training for the PyTorch frontend.
+
+Reference analog: ``horovod/torch/elastic/state.py`` (``TorchState``:
+per-handler commit/restore of model and optimizer state_dicts, rank-0
+broadcast on sync) + ``horovod/torch/elastic/__init__.py`` (``run``).
+"""
+
+import copy
+
+from horovod_tpu.common import elastic as _elastic
+from horovod_tpu.common.elastic import State, _broadcast_object
+
+run = _elastic.run_fn
+init = _elastic.init
+reset = _elastic.reset
+ObjectState = _elastic.ObjectState
+
+
+def _cpu_state_dict(sd):
+    import torch
+
+    def conv(v):
+        if isinstance(v, torch.Tensor):
+            return v.detach().cpu().clone()
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(conv(x) for x in v)
+        return copy.deepcopy(v)
+
+    return conv(sd)
+
+
+class TorchState(State):
+    """Elastic state for a model + optimizer (+ extra picklable attrs).
+
+    Reference analog: hvd.elastic.TorchState.
+    """
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        super().__init__()
+        self.model = model
+        self.optimizer = optimizer
+        self._extra_keys = list(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.save()
+
+    def save(self):
+        self._saved = {
+            "model": _cpu_state_dict(self.model.state_dict())
+            if self.model is not None else None,
+            "optimizer": _cpu_state_dict(self.optimizer.state_dict())
+            if self.optimizer is not None else None,
+            "extra": {k: copy.deepcopy(getattr(self, k))
+                      for k in self._extra_keys},
+        }
+
+    def restore(self):
+        if self.model is not None and self._saved["model"] is not None:
+            self.model.load_state_dict(copy.deepcopy(self._saved["model"]))
+        if self.optimizer is not None and \
+                self._saved["optimizer"] is not None:
+            self.optimizer.load_state_dict(
+                copy.deepcopy(self._saved["optimizer"]))
+        for k, v in self._saved["extra"].items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        from horovod_tpu.common.basics import HorovodBasics
+
+        if HorovodBasics().size() == 1:
+            return
+        self.save()
+        self._saved = _broadcast_object(self._saved,
+                                        name="elastic.torch_state")
+        self.restore()

@@ -23,7 +23,10 @@ Adasum = ReduceOp.ADASUM
 
 _basics = eager_ops._basics
 
-init = _basics.init
+# In elastic mode (HOROVOD_RDZV_ADDR set) init consults the driver's
+# rendezvous for this epoch's rank assignment; static mode unchanged.
+from horovod_tpu.common import elastic as _elastic_init_mod
+init = _elastic_init_mod.init
 shutdown = _basics.shutdown
 is_initialized = _basics.is_initialized
 rank = _basics.rank
@@ -32,6 +35,8 @@ local_rank = _basics.local_rank
 local_size = _basics.local_size
 cross_rank = _basics.cross_rank
 cross_size = _basics.cross_size
+start_timeline = _basics.start_timeline
+stop_timeline = _basics.stop_timeline
 
 _name_lock = threading.Lock()
 _name_counters = {}

@@ -1,0 +1,170 @@
+"""Elastic subsystem unit tests: discovery, rendezvous, driver, state.
+
+Reference analog: test/single/elastic/ (test_driver.py, test_rendezvous.py)
+— fake discovery scripts and thread-fake workers exercise multi-node logic
+without a cluster (SURVEY.md §4).
+"""
+
+import os
+import stat
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import (
+    FixedHosts,
+    HostDiscoveryScript,
+    HostManager,
+)
+from horovod_tpu.runner.elastic.rendezvous import (
+    RendezvousClient,
+    RendezvousServer,
+)
+from horovod_tpu.runner.elastic.worker import (
+    WorkerNotificationManager,
+    notify_worker,
+)
+
+
+def _script(tmp_path, hosts_file):
+    path = tmp_path / "discover.sh"
+    path.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+def test_discovery_script_parsing(tmp_path):
+    hosts_file = tmp_path / "hosts"
+    hosts_file.write_text("node1:4\nnode2:2\n# comment\nnode3\n")
+    disc = HostDiscoveryScript(_script(tmp_path, hosts_file),
+                               default_slots=3)
+    assert disc.find_available_hosts_and_slots() == {
+        "node1": 4, "node2": 2, "node3": 3}
+
+
+def test_host_manager_change_detection_and_blacklist(tmp_path):
+    hosts_file = tmp_path / "hosts"
+    hosts_file.write_text("a:2\n")
+    mgr = HostManager(HostDiscoveryScript(_script(tmp_path, hosts_file)))
+    changed, added, removed = mgr.update_available_hosts()
+    assert changed and added == ["a"] and not removed
+    assert mgr.slot_count() == 2
+
+    hosts_file.write_text("a:2\nb:1\n")
+    changed, added, removed = mgr.update_available_hosts()
+    assert changed and added == ["b"]
+
+    hosts_file.write_text("b:1\n")
+    changed, added, removed = mgr.update_available_hosts()
+    assert changed and removed == ["a"]
+
+    mgr.blacklist("b")
+    mgr.update_available_hosts()
+    assert mgr.current_hosts == {}
+    assert mgr.is_blacklisted("b")
+
+
+def test_rendezvous_assignment_epochs():
+    server = RendezvousServer()
+    try:
+        client = RendezvousClient("127.0.0.1", server.port)
+        client.register("w0", "localhost", 0, None)
+        client.register("w1", "localhost", 1, None)
+        assert set(server.registered_workers()) == {"w0", "w1"}
+
+        # No epoch cut yet -> polling times out.
+        with pytest.raises(TimeoutError):
+            client.poll_assignment("w0", timeout=0.5)
+
+        server.start_epoch({
+            "w0": {"rank": 0, "size": 2},
+            "w1": {"rank": 1, "size": 2},
+        })
+        asg = client.poll_assignment("w0", timeout=5)
+        assert asg["rank"] == 0 and asg["epoch"] == 1
+
+        # A worker that consumed epoch 1 must NOT re-adopt it after a
+        # failure; it waits for epoch 2.
+        with pytest.raises(TimeoutError):
+            client.poll_assignment("w0", timeout=0.5, min_epoch=2)
+        server.start_epoch({"w0": {"rank": 0, "size": 1}})
+        asg = client.poll_assignment("w0", timeout=5, min_epoch=2)
+        assert asg["epoch"] == 2 and asg["size"] == 1
+
+        client.kv_put("k", {"v": 1})
+        assert client.kv_get("k") == {"v": 1}
+        assert client.kv_get("missing") is None
+    finally:
+        server.stop()
+
+
+def test_worker_notification_roundtrip():
+    mgr = WorkerNotificationManager()
+    port = mgr.init()
+    try:
+        assert mgr.poll_hosts_updated() == (False, False)
+        assert notify_worker("127.0.0.1", port, skip_sync=True)
+        deadline = time.monotonic() + 5
+        updated = skip = False
+        while time.monotonic() < deadline and not updated:
+            updated, skip = mgr.poll_hosts_updated()
+        assert updated and skip
+        # Flag is consumed.
+        assert mgr.poll_hosts_updated() == (False, False)
+    finally:
+        mgr.shutdown()
+
+
+def test_driver_spawns_and_cuts_epoch(tmp_path):
+    """Thread-fake workers: the spawned command registers with rendezvous
+    and exits 0; the driver must cut an epoch covering every slot."""
+    marker = tmp_path / "assignments"
+    marker.mkdir()
+    worker_src = tmp_path / "worker.py"
+    worker_src.write_text(f"""
+import json, os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})
+from horovod_tpu.runner.elastic.rendezvous import RendezvousClient
+wid = os.environ["HOROVOD_WORKER_ID"]
+c = RendezvousClient(os.environ["HOROVOD_RDZV_ADDR"],
+                     os.environ["HOROVOD_RDZV_PORT"])
+c.register(wid, os.environ["HOROVOD_HOSTNAME"], 0, None)
+asg = c.poll_assignment(wid, timeout=30)
+open(os.path.join({str(marker)!r}, wid.replace(":", "_")), "w").write(
+    json.dumps(asg))
+""")
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    import sys
+
+    driver = ElasticDriver(FixedHosts({"localhost": 3}),
+                           [sys.executable, str(worker_src)], min_np=3)
+    driver.start()
+    try:
+        rc = driver.wait_for_completion()
+    finally:
+        driver.stop()
+    assert rc == 0
+    import json
+
+    got = sorted(json.loads(p.read_text())["rank"]
+                 for p in marker.iterdir())
+    assert got == [0, 1, 2]
+    sizes = {json.loads(p.read_text())["size"] for p in marker.iterdir()}
+    assert sizes == {3}
+
+
+def test_object_state_commit_restore():
+    from horovod_tpu.common.elastic import ObjectState
+
+    state = ObjectState(step=0, weights=np.zeros(3))
+    state.step = 5
+    state.weights = state.weights + 2
+    state.save()
+    state.step = 9
+    state.weights[:] = 99
+    state.restore()
+    assert state.step == 5
+    np.testing.assert_allclose(state.weights, 2)
