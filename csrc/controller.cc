@@ -27,11 +27,29 @@ bool ShapesMatch(const std::vector<int64_t>& a, const std::vector<int64_t>& b,
   return true;
 }
 
+// Byte size of a cached single-tensor response ([ndim, dims...] layout).
+int64_t CachedEntryBytes(const Response& r) {
+  int64_t elems = 1;
+  if (!r.tensor_shapes.empty()) {
+    int64_t ndim = r.tensor_shapes[0];
+    for (int64_t i = 0; i < ndim; i++) elems *= r.tensor_shapes[1 + i];
+  }
+  return elems * DataTypeSize(r.tensor_type);
+}
+
+// Shared fusion predicate for the cached and freshly-negotiated allreduce
+// paths — one site so the two fusion paths cannot diverge.
+bool FusableAllreducePair(DataType dtype_a, int32_t ps_a, ReduceOp op_a,
+                          DataType dtype_b, int32_t ps_b, ReduceOp op_b) {
+  return dtype_a == dtype_b && ps_a == ps_b && op_a == op_b;
+}
+
 }  // namespace
 
 Controller::Controller(ControllerConfig cfg) : cfg_(std::move(cfg)) {
   shutdown_flags_.assign(cfg_.size, false);
   last_stall_check_ = std::chrono::steady_clock::now();
+  cache_.SetCapacity(cfg_.cache_capacity);
 }
 
 Controller::~Controller() {
@@ -342,9 +360,9 @@ ResponseList Controller::FuseResponses() {
         auto& npt = message_table_[next_key];
         const Request& nreq = npt.requests.front();
         if (nreq.request_type != RequestType::ALLREDUCE ||
-            nreq.tensor_type != first.tensor_type ||
-            nreq.process_set_id != first.process_set_id ||
-            nreq.reduce_op != first.reduce_op) {
+            !FusableAllreducePair(nreq.tensor_type, nreq.process_set_id,
+                                  nreq.reduce_op, first.tensor_type,
+                                  first.process_set_id, first.reduce_op)) {
           break;
         }
         Response nres = BuildResponse(next_key);
@@ -380,6 +398,159 @@ ResponseList Controller::FuseResponses() {
   return list;
 }
 
+RequestList Controller::BuildRequestList(std::vector<Request> requests,
+                                         bool should_shutdown) {
+  RequestList my_list;
+  my_list.shutdown = should_shutdown;
+  if (!resubmit_.empty()) {
+    // Requests whose cached position was evicted mid-flight renegotiate now.
+    requests.insert(requests.begin(),
+                    std::make_move_iterator(resubmit_.begin()),
+                    std::make_move_iterator(resubmit_.end()));
+    resubmit_.clear();
+  }
+  for (auto& req : requests) {
+    if (req.request_type == RequestType::JOIN) {
+      my_list.requests.push_back(std::move(req));
+      continue;
+    }
+    int32_t pos = -1;
+    switch (cache_.Lookup(req, &pos)) {
+      case ResponseCache::LookupResult::HIT:
+        my_list.cache_hits.push_back(pos);
+        inflight_hits_[pos] = std::move(req);
+        break;
+      case ResponseCache::LookupResult::INVALID:
+        my_list.cache_invalid.push_back(pos);
+        my_list.requests.push_back(std::move(req));
+        break;
+      case ResponseCache::LookupResult::MISS:
+        my_list.requests.push_back(std::move(req));
+        break;
+    }
+  }
+  return my_list;
+}
+
+void Controller::HandleCacheBits(const RequestList& list, int from_rank,
+                                 std::vector<int64_t>* evictions) {
+  for (int64_t pos : list.cache_invalid) {
+    if (std::find(evictions->begin(), evictions->end(), pos) ==
+        evictions->end()) {
+      evictions->push_back(pos);
+    }
+    bit_table_.erase((int32_t)pos);
+  }
+  for (int64_t pos : list.cache_hits) {
+    // Stale bits (position evicted this cycle, or by an earlier eviction the
+    // sender raced with) are dropped; the sender resubmits a full request
+    // when it processes the broadcast eviction.
+    if (!cache_.Has((int32_t)pos)) continue;
+    if (std::find(evictions->begin(), evictions->end(), pos) !=
+        evictions->end()) {
+      continue;
+    }
+    auto& pb = bit_table_[(int32_t)pos];
+    if (pb.ranks.empty()) pb.first_seen = std::chrono::steady_clock::now();
+    pb.ranks.insert(from_rank);
+  }
+}
+
+void Controller::CollectCacheHits(ResponseList* list) {
+  if (bit_table_.empty()) return;
+  std::vector<int32_t> pending;
+  pending.reserve(bit_table_.size());
+  for (auto& kv : bit_table_) pending.push_back(kv.first);
+  std::sort(pending.begin(), pending.end());
+  std::vector<int32_t> completed;
+  for (int32_t pos : pending) {
+    const Response& r = cache_.Get(pos);
+    bool done = true;
+    for (int32_t m : MembersOf(r.process_set_id)) {
+      if (!bit_table_[pos].ranks.count(m) && !joined_ranks_.count(m)) {
+        done = false;
+        break;
+      }
+    }
+    if (done) completed.push_back(pos);
+  }
+  // Group consecutive fusable allreduce hits; every rank rebuilds the same
+  // fused Response from the group. Reference analog: cached responses join
+  // the same FuseResponses path (controller.cc); here the coordinator owns
+  // the grouping so the fusion threshold needs no cross-rank sync.
+  size_t i = 0;
+  while (i < completed.size()) {
+    const Response& r0 = cache_.Get(completed[i]);
+    int64_t group = 1;
+    if (r0.response_type == Response::ResponseType::ALLREDUCE) {
+      int64_t bytes = CachedEntryBytes(r0);
+      while (i + group < completed.size()) {
+        const Response& rn = cache_.Get(completed[i + group]);
+        if (rn.response_type != Response::ResponseType::ALLREDUCE ||
+            !FusableAllreducePair(rn.tensor_type, rn.process_set_id,
+                                  rn.reduce_op, r0.tensor_type,
+                                  r0.process_set_id, r0.reduce_op)) {
+          break;
+        }
+        int64_t nb = CachedEntryBytes(rn);
+        if (bytes + nb > cfg_.fusion_threshold_bytes) break;
+        bytes += nb;
+        group++;
+      }
+    }
+    for (int64_t k = 0; k < group; k++) {
+      list->cache_hit_positions.push_back(completed[i + k]);
+      bit_table_.erase(completed[i + k]);
+    }
+    list->cache_hit_group_sizes.push_back(group);
+    i += group;
+  }
+}
+
+void Controller::ApplyCacheVerdicts(ResponseList* out) {
+  for (int64_t pos : out->cache_evictions) {
+    cache_.Evict((int32_t)pos);
+    auto it = inflight_hits_.find((int32_t)pos);
+    if (it != inflight_hits_.end()) {
+      resubmit_.push_back(std::move(it->second));
+      inflight_hits_.erase(it);
+    }
+  }
+  std::vector<Response> hit_responses;
+  size_t idx = 0;
+  for (int64_t gs : out->cache_hit_group_sizes) {
+    if (idx + (size_t)gs > out->cache_hit_positions.size()) break;
+    int32_t pos0 = (int32_t)out->cache_hit_positions[idx];
+    if (!cache_.Has(pos0)) {  // cannot happen with consistent caches
+      idx += gs;
+      continue;
+    }
+    Response merged = cache_.Get(pos0);
+    inflight_hits_.erase(pos0);
+    for (int64_t k = 1; k < gs; k++) {
+      int32_t pos = (int32_t)out->cache_hit_positions[idx + k];
+      const Response& nxt = cache_.Get(pos);
+      merged.tensor_names.push_back(nxt.tensor_names[0]);
+      merged.tensor_shapes.insert(merged.tensor_shapes.end(),
+                                  nxt.tensor_shapes.begin(),
+                                  nxt.tensor_shapes.end());
+      inflight_hits_.erase(pos);
+    }
+    idx += gs;
+    hit_responses.push_back(std::move(merged));
+  }
+  // Fresh negotiated responses become cache entries for the next cycle —
+  // identical insertion order on every rank (driven by the broadcast bytes).
+  cache_.InsertFromResponses(out->responses);
+  if (!hit_responses.empty()) {
+    // Execution order: steady-state hits first, then new negotiations.
+    hit_responses.insert(hit_responses.end(),
+                         std::make_move_iterator(out->responses.begin()),
+                         std::make_move_iterator(out->responses.end()));
+    out->responses = std::move(hit_responses);
+  }
+}
+
 void Controller::CheckForStalledTensors() {
   if (!cfg_.stall_check_enabled) return;
   auto now = std::chrono::steady_clock::now();
@@ -405,23 +576,44 @@ void Controller::CheckForStalledTensors() {
           missing.str().c_str());
     }
   }
+  // Cache-hit bits stall the same way full requests do.
+  for (auto& kv : bit_table_) {
+    double waited =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (waited > cfg_.stall_warning_secs && cache_.Has(kv.first)) {
+      const Response& r = cache_.Get(kv.first);
+      std::ostringstream missing;
+      for (int32_t m : MembersOf(r.process_set_id)) {
+        if (!kv.second.ranks.count(m) && !joined_ranks_.count(m)) {
+          missing << m << " ";
+        }
+      }
+      LOG_WARN(
+          "Stall detected: cached tensor %s has waited %.0fs; missing "
+          "ranks: %s (one or more ranks did not submit this collective)",
+          r.tensor_names[0].c_str(), waited, missing.str().c_str());
+    }
+  }
 }
 
 Status Controller::ComputeResponseList(std::vector<Request> requests,
                                        bool should_shutdown,
                                        ResponseList* out) {
-  RequestList my_list;
-  my_list.requests = std::move(requests);
-  my_list.shutdown = should_shutdown;
-
   if (cfg_.size == 1) {
+    RequestList my_list;
+    my_list.requests = std::move(requests);
+    my_list.shutdown = should_shutdown;
     HandleRequestList(my_list, 0);
     *out = FuseResponses();
     out->shutdown = should_shutdown;
     return Status::OK();
   }
 
+  RequestList my_list = BuildRequestList(std::move(requests), should_shutdown);
+
   if (cfg_.rank == 0) {
+    std::vector<int64_t> evictions;
+    HandleCacheBits(my_list, 0, &evictions);
     HandleRequestList(my_list, 0);
     for (int r = 1; r < cfg_.size; r++) {
       std::string frame;
@@ -430,20 +622,31 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
       RequestList rl;
       s = ParseRequestList(frame, &rl);
       if (!s.ok()) return s;
+      HandleCacheBits(rl, r, &evictions);
       HandleRequestList(rl, r);
     }
     CheckForStalledTensors();
-    ResponseList list = FuseResponses();
+    ResponseList list;
+    list.cache_evictions = std::move(evictions);
+    // Hits must complete BEFORE FuseResponses: the all-ranks-joined cycle
+    // clears joined_ranks_ there, and pending bits rely on join coverage the
+    // same way MaybePromote does for full requests.
+    CollectCacheHits(&list);
+    list.responses = FuseResponses().responses;
     list.shutdown = std::all_of(shutdown_flags_.begin(), shutdown_flags_.end(),
                                 [](bool b) { return b; });
     list.fusion_threshold_bytes = bcast_fusion_bytes_;
     list.cycle_time_ms = bcast_cycle_ms_;
+    // Serialize before ApplyCacheVerdicts: the broadcast carries only
+    // negotiated responses + cache verdicts; every rank (this one included)
+    // then rebuilds hit responses and inserts new entries identically.
     std::string payload = SerializeResponseList(list);
     for (int r = 1; r < cfg_.size; r++) {
       Status s = SendFrame(control_fds_[r], payload);
       if (!s.ok()) return s;
     }
     *out = std::move(list);
+    ApplyCacheVerdicts(out);
     return Status::OK();
   }
 
@@ -453,7 +656,10 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
   std::string frame;
   s = RecvFrame(control_fds_[0], &frame);
   if (!s.ok()) return s;
-  return ParseResponseList(frame, out);
+  s = ParseResponseList(frame, out);
+  if (!s.ok()) return s;
+  ApplyCacheVerdicts(out);
+  return Status::OK();
 }
 
 }  // namespace hvdtpu

@@ -19,6 +19,7 @@
 
 #include "message.h"
 #include "process_set.h"
+#include "response_cache.h"
 #include "ring_ops.h"
 
 namespace hvdtpu {
@@ -29,6 +30,8 @@ struct ControllerConfig {
   std::string controller_addr = "127.0.0.1";
   int controller_port = 0;
   int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
+  // Response cache capacity in entries (HOROVOD_CACHE_CAPACITY; 0 disables).
+  int64_t cache_capacity = 1024;
   double stall_warning_secs = 60.0;
   bool stall_check_enabled = true;
   // Readiness for a tensor on process set S waits only on S's members.
@@ -55,6 +58,7 @@ class Controller {
   DataPlane* data_plane() { return data_plane_.get(); }
   int rank() const { return cfg_.rank; }
   int size() const { return cfg_.size; }
+  const ResponseCache& response_cache() const { return cache_; }
 
   // Coordinator only: adopt autotuned knobs locally (fusion decisions are
   // made here) and piggyback them on every subsequent ResponseList.
@@ -65,6 +69,21 @@ class Controller {
   }
 
  private:
+  // Split this rank's ready requests into cache-hit bits, invalid bits, and
+  // full requests (the outgoing RequestList for this cycle).
+  RequestList BuildRequestList(std::vector<Request> requests,
+                               bool should_shutdown);
+  // Coordinator side: fold one rank's cache bits + evictions into the
+  // pending-bit table; full requests go through HandleRequestList.
+  void HandleCacheBits(const RequestList& list, int from_rank,
+                       std::vector<int64_t>* evictions);
+  // Coordinator side: completed positions (all set members submitted the bit
+  // or joined), in ascending position order, grouped for fusion.
+  void CollectCacheHits(ResponseList* list);
+  // All ranks: apply broadcast evictions (requeuing any in-flight hit of an
+  // evicted position), rebuild hit Responses from the local cache copy, and
+  // insert freshly negotiated responses. `out` gains the hit responses.
+  void ApplyCacheVerdicts(ResponseList* out);
   // Coordinator side: fold one rank's RequestList into the message table,
   // tracking newly all-ready tensors in arrival order.
   void HandleRequestList(const RequestList& list, int from_rank);
@@ -103,6 +122,20 @@ class Controller {
   int64_t bcast_fusion_bytes_ = 0;  // 0 = nothing to broadcast
   double bcast_cycle_ms_ = 0;
   std::chrono::steady_clock::time_point last_stall_check_;
+
+  // --- Response cache (all ranks; state bit-identical by construction) ---
+  ResponseCache cache_;
+  // Bits this rank has submitted but not yet seen complete: pos -> the full
+  // request to resubmit if the position is evicted mid-flight.
+  std::unordered_map<int32_t, Request> inflight_hits_;
+  std::vector<Request> resubmit_;  // queued for next cycle
+  // Coordinator only: pos -> ranks that have submitted the bit, plus when
+  // the first bit arrived (stall reporting).
+  struct PendingBits {
+    std::unordered_set<int32_t> ranks;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  std::unordered_map<int32_t, PendingBits> bit_table_;
 };
 
 }  // namespace hvdtpu

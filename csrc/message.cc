@@ -151,6 +151,7 @@ std::string SerializeRequestList(const RequestList& list) {
   Writer w;
   w.Put<uint8_t>(list.shutdown ? 1 : 0);
   w.PutI64Vec(list.cache_hits);
+  w.PutI64Vec(list.cache_invalid);
   w.Put<uint32_t>((uint32_t)list.requests.size());
   for (auto& r : list.requests) WriteRequest(w, r);
   return w.Take();
@@ -161,7 +162,8 @@ Status ParseRequestList(const std::string& buf, RequestList* list) {
   uint8_t shutdown;
   if (!rd.Get(&shutdown)) return Status::Error("truncated RequestList");
   list->shutdown = shutdown != 0;
-  if (!rd.GetI64Vec(&list->cache_hits)) {
+  if (!rd.GetI64Vec(&list->cache_hits) ||
+      !rd.GetI64Vec(&list->cache_invalid)) {
     return Status::Error("truncated RequestList");
   }
   uint32_t n;
@@ -180,6 +182,9 @@ std::string SerializeResponseList(const ResponseList& list) {
   w.Put<uint8_t>(list.shutdown ? 1 : 0);
   w.Put<int64_t>(list.fusion_threshold_bytes);
   w.Put<double>(list.cycle_time_ms);
+  w.PutI64Vec(list.cache_hit_positions);
+  w.PutI64Vec(list.cache_hit_group_sizes);
+  w.PutI64Vec(list.cache_evictions);
   w.Put<uint32_t>((uint32_t)list.responses.size());
   for (auto& r : list.responses) WriteResponse(w, r);
   return w.Take();
@@ -192,6 +197,11 @@ Status ParseResponseList(const std::string& buf, ResponseList* list) {
   list->shutdown = shutdown != 0;
   if (!rd.Get(&list->fusion_threshold_bytes) ||
       !rd.Get(&list->cycle_time_ms)) {
+    return Status::Error("truncated ResponseList");
+  }
+  if (!rd.GetI64Vec(&list->cache_hit_positions) ||
+      !rd.GetI64Vec(&list->cache_hit_group_sizes) ||
+      !rd.GetI64Vec(&list->cache_evictions)) {
     return Status::Error("truncated ResponseList");
   }
   uint32_t n;

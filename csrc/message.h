@@ -77,10 +77,14 @@ struct Response {
 struct RequestList {
   std::vector<Request> requests;
   bool shutdown = false;
-  // Response-cache bitvector: positions (in the rank's cache order) of
+  // Response-cache bitvector: positions (in the shared cache order) of
   // cache-hit tensors ready this cycle. Reference analog:
   // horovod/common/response_cache.cc CacheCoordinator bit vectors.
   std::vector<int64_t> cache_hits;
+  // Cached positions whose metadata no longer matches (shape/dtype/op
+  // changed): the coordinator broadcasts an eviction and the full request
+  // (also in `requests`) renegotiates.
+  std::vector<int64_t> cache_invalid;
 };
 
 // Everything the coordinator broadcasts back in one cycle.
@@ -91,6 +95,14 @@ struct ResponseList {
   // Reference analog: parameter_manager.cc values synced via the controller.
   int64_t fusion_threshold_bytes = 0;
   double cycle_time_ms = 0;
+  // Response-cache verdicts. Positions ready on every member rank this
+  // cycle, grouped for fusion: group_sizes partitions cache_hit_positions
+  // (e.g. [3,1] = first three fuse into one allreduce, next is alone).
+  // Every rank rebuilds identical Responses from its local cache copy.
+  std::vector<int64_t> cache_hit_positions;
+  std::vector<int64_t> cache_hit_group_sizes;
+  // Positions every rank must evict before processing hits/insertions.
+  std::vector<int64_t> cache_evictions;
 };
 
 std::string SerializeRequestList(const RequestList& list);

@@ -583,6 +583,7 @@ int hvdtpu_init() {
   cfg.controller_addr = EnvStr("HOROVOD_CONTROLLER_ADDR", "127.0.0.1");
   cfg.controller_port = (int)EnvInt64("HOROVOD_CONTROLLER_PORT", 29500);
   cfg.fusion_threshold_bytes = st->fusion_threshold;
+  cfg.cache_capacity = EnvInt64("HOROVOD_CACHE_CAPACITY", 1024);
   cfg.stall_warning_secs = EnvDouble("HOROVOD_STALL_CHECK_TIME", 60.0);
   cfg.stall_check_enabled =
       EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) == 0;
@@ -594,7 +595,13 @@ int hvdtpu_init() {
     return -1;
   }
   std::string timeline_path = EnvStr("HOROVOD_TIMELINE", "");
-  if (!timeline_path.empty()) {
+  // Env-driven timeline records on the coordinator only: every rank shares
+  // the same HOROVOD_TIMELINE path (set once by horovodrun), and concurrent
+  // writers would interleave at stdio buffer boundaries. Reference analog:
+  // the reference's timeline is a rank-0 artifact too. Per-rank runtime
+  // recording is still available via hvd.start_timeline(path) with a
+  // rank-unique path.
+  if (!timeline_path.empty() && st->rank == 0) {
     st->timeline.Initialize(timeline_path, st->rank);
   }
   st->timeline_mark_cycles =
@@ -932,6 +939,21 @@ void hvdtpu_set_fusion_threshold_bytes(int64_t v) {
 
 void hvdtpu_set_cycle_time_ms(double v) {
   if (g_state) g_state->cycle_time_ms = v;
+}
+
+int64_t hvdtpu_response_cache_hits() {
+  CHECK_INIT(-1)
+  return g_state->controller->response_cache().hits();
+}
+
+int64_t hvdtpu_response_cache_misses() {
+  CHECK_INIT(-1)
+  return g_state->controller->response_cache().misses();
+}
+
+int64_t hvdtpu_response_cache_entries() {
+  CHECK_INIT(-1)
+  return g_state->controller->response_cache().entries();
 }
 
 int hvdtpu_start_timeline(const char* path) {

@@ -84,6 +84,13 @@ int64_t hvdtpu_fusion_threshold_bytes();
 double hvdtpu_cycle_time_ms();
 void hvdtpu_set_fusion_threshold_bytes(int64_t v);
 void hvdtpu_set_cycle_time_ms(double v);
+
+// Response-cache introspection (reference analog: the cache stats the
+// timeline/autotune read from response_cache.h). Capacity via
+// HOROVOD_CACHE_CAPACITY (default 1024; 0 disables).
+int64_t hvdtpu_response_cache_hits();
+int64_t hvdtpu_response_cache_misses();
+int64_t hvdtpu_response_cache_entries();
 }
 
 #endif  // HVDTPU_OPERATIONS_H

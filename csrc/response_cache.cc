@@ -1,0 +1,173 @@
+#include "response_cache.h"
+
+#include <algorithm>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// Decode the first tensor's shape from a single-tensor response's flattened
+// [ndim, dims...] layout.
+std::vector<int64_t> FirstShape(const Response& r) {
+  std::vector<int64_t> shape;
+  if (r.tensor_shapes.empty()) return shape;
+  int64_t ndim = r.tensor_shapes[0];
+  for (int64_t i = 0; i < ndim && (size_t)(1 + i) < r.tensor_shapes.size();
+       i++) {
+    shape.push_back(r.tensor_shapes[1 + i]);
+  }
+  return shape;
+}
+
+Response::ResponseType ExpectedType(RequestType t) {
+  switch (t) {
+    case RequestType::ALLREDUCE: return Response::ResponseType::ALLREDUCE;
+    case RequestType::BROADCAST: return Response::ResponseType::BROADCAST;
+    case RequestType::REDUCESCATTER:
+      return Response::ResponseType::REDUCESCATTER;
+    default: return Response::ResponseType::ERROR;  // never cached
+  }
+}
+
+}  // namespace
+
+std::string ResponseCache::KeyOf(const std::string& name,
+                                 int32_t process_set_id) {
+  // Same key scheme as Controller::TableKey ('\x1f' cannot appear in a
+  // Python-supplied tensor name).
+  return name + '\x1f' + std::to_string(process_set_id);
+}
+
+bool ResponseCache::Eligible(const Response& r) {
+  switch (r.response_type) {
+    case Response::ResponseType::ALLREDUCE:
+      // Adasum responses never fuse and carry per-tensor normalization;
+      // keep them on the full negotiation path.
+      return r.reduce_op != ReduceOp::ADASUM;
+    case Response::ResponseType::BROADCAST:
+    case Response::ResponseType::REDUCESCATTER:
+      // Fixed-shape collectives. Allgather/alltoall have data-dependent
+      // first dims / splits, so they renegotiate every time.
+      return true;
+    default:
+      return false;
+  }
+}
+
+ResponseCache::LookupResult ResponseCache::Lookup(const Request& req,
+                                                  int32_t* pos) {
+  if (!enabled()) {
+    misses_++;
+    return LookupResult::MISS;
+  }
+  auto it = index_.find(KeyOf(req.tensor_name, req.process_set_id));
+  if (it == index_.end()) {
+    misses_++;
+    return LookupResult::MISS;
+  }
+  *pos = it->second;
+  const Response& r = slots_[it->second].response;
+  bool match = r.response_type == ExpectedType(req.request_type) &&
+               r.tensor_type == req.tensor_type &&
+               FirstShape(r) == req.tensor_shape;
+  if (match) {
+    switch (r.response_type) {
+      case Response::ResponseType::ALLREDUCE:
+      case Response::ResponseType::REDUCESCATTER:
+        match = r.reduce_op == req.reduce_op;
+        break;
+      case Response::ResponseType::BROADCAST:
+        match = r.root_rank == req.root_rank;
+        break;
+      default:
+        break;
+    }
+  }
+  if (match) {
+    hits_++;
+    return LookupResult::HIT;
+  }
+  // Metadata changed (new shape/dtype/op under an old name): coordinate a
+  // global eviction, then renegotiate via the accompanying full request.
+  misses_++;
+  return LookupResult::INVALID;
+}
+
+void ResponseCache::InsertFromResponses(
+    const std::vector<Response>& responses) {
+  if (!enabled()) return;
+  for (const Response& res : responses) {
+    if (!Eligible(res)) continue;
+    // Split a fused response into per-tensor cache entries.
+    size_t shape_pos = 0;
+    for (size_t i = 0; i < res.tensor_names.size(); i++) {
+      std::vector<int64_t> shape;
+      if (shape_pos < res.tensor_shapes.size()) {
+        int64_t ndim = res.tensor_shapes[shape_pos++];
+        for (int64_t d = 0; d < ndim; d++) {
+          shape.push_back(res.tensor_shapes[shape_pos++]);
+        }
+      }
+      std::string key = KeyOf(res.tensor_names[i], res.process_set_id);
+      if (index_.count(key)) continue;  // already cached (shouldn't happen)
+      int32_t pos;
+      if (!free_positions_.empty()) {
+        pos = free_positions_.front();
+        free_positions_.erase(free_positions_.begin());
+      } else if ((int64_t)slots_.size() < capacity_) {
+        pos = (int32_t)slots_.size();
+        slots_.emplace_back();
+      } else {
+        if (!warned_full_) {
+          warned_full_ = true;
+          LOG_WARN(
+              "response cache full (%lld entries); further tensors take the "
+              "full negotiation path every cycle. Raise "
+              "HOROVOD_CACHE_CAPACITY.",
+              (long long)capacity_);
+        }
+        return;
+      }
+      Slot& slot = slots_[pos];
+      slot.key = key;
+      slot.valid = true;
+      slot.response.response_type = res.response_type;
+      slot.response.tensor_names = {res.tensor_names[i]};
+      slot.response.tensor_type = res.tensor_type;
+      slot.response.tensor_shapes.clear();
+      slot.response.tensor_shapes.push_back((int64_t)shape.size());
+      slot.response.tensor_shapes.insert(slot.response.tensor_shapes.end(),
+                                         shape.begin(), shape.end());
+      slot.response.reduce_op = res.reduce_op;
+      slot.response.root_rank = res.root_rank;
+      slot.response.process_set_id = res.process_set_id;
+      slot.response.tensor_sizes.clear();
+      slot.response.error_message.clear();
+      index_[key] = pos;
+      entries_count_++;
+    }
+  }
+}
+
+void ResponseCache::Evict(int32_t pos) {
+  if (pos < 0 || (size_t)pos >= slots_.size() || !slots_[pos].valid) return;
+  index_.erase(slots_[pos].key);
+  slots_[pos].valid = false;
+  slots_[pos].key.clear();
+  auto it = std::lower_bound(free_positions_.begin(), free_positions_.end(),
+                             pos);
+  free_positions_.insert(it, pos);
+  entries_count_--;
+}
+
+bool ResponseCache::Has(int32_t pos) const {
+  return pos >= 0 && (size_t)pos < slots_.size() && slots_[pos].valid;
+}
+
+const Response& ResponseCache::Get(int32_t pos) const {
+  return slots_[pos].response;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,71 @@
+// Response cache: the steady-state fast path of the negotiation protocol.
+// After a tensor's first full negotiation, every rank caches the resulting
+// single-tensor Response at an agreed bit position; subsequent cycles send a
+// bitvector of positions instead of full Requests, and the coordinator
+// completes a position once every member of its process set has submitted
+// the bit (or joined).
+// Reference analog: horovod/common/response_cache.h (ResponseCache,
+// CacheCoordinator). Rebuilt deterministically over the broadcast
+// ResponseList: insertions and evictions are driven only by bytes every rank
+// sees, so cache state stays bit-identical across ranks with no extra
+// synchronization round.
+
+#ifndef HVDTPU_RESPONSE_CACHE_H
+#define HVDTPU_RESPONSE_CACHE_H
+
+#include <atomic>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "message.h"
+
+namespace hvdtpu {
+
+class ResponseCache {
+ public:
+  enum class LookupResult { MISS, HIT, INVALID };
+
+  void SetCapacity(int64_t cap) { capacity_ = cap; }
+  bool enabled() const { return capacity_ > 0; }
+
+  // Classify an outgoing request against the cache. HIT: *pos is the cached
+  // bit position and the metadata matches, send the bit. INVALID: the key is
+  // cached at *pos but shape/dtype/op changed — send the bit as invalid plus
+  // the full request so the coordinator evicts everywhere and renegotiates.
+  LookupResult Lookup(const Request& req, int32_t* pos);
+
+  // Deterministic insertion of eligible tensors of a freshly negotiated
+  // (broadcast) response list; fused responses are split per tensor. Every
+  // rank calls this with identical bytes in the same cycle.
+  void InsertFromResponses(const std::vector<Response>& responses);
+
+  void Evict(int32_t pos);
+  bool Has(int32_t pos) const;
+  // Single-tensor cached response at pos (valid only when Has(pos)).
+  const Response& Get(int32_t pos) const;
+
+  int64_t hits() const { return hits_.load(); }
+  int64_t misses() const { return misses_.load(); }
+  int64_t entries() const { return entries_count_.load(); }
+
+ private:
+  struct Slot {
+    Response response;
+    std::string key;
+    bool valid = false;
+  };
+  static std::string KeyOf(const std::string& name, int32_t process_set_id);
+  static bool Eligible(const Response& r);
+
+  int64_t capacity_ = 1024;  // HOROVOD_CACHE_CAPACITY; 0 disables
+  std::vector<Slot> slots_;             // index == bit position
+  std::vector<int32_t> free_positions_;  // ascending; reuse smallest first
+  std::unordered_map<std::string, int32_t> index_;
+  std::atomic<int64_t> hits_{0}, misses_{0}, entries_count_{0};
+  bool warned_full_ = false;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_RESPONSE_CACHE_H

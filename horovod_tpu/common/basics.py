@@ -115,6 +115,10 @@ def load_library():
         lib.hvdtpu_cycle_time_ms.restype = dbl
         lib.hvdtpu_set_fusion_threshold_bytes.argtypes = [i64]
         lib.hvdtpu_set_cycle_time_ms.argtypes = [dbl]
+        for fn in ("response_cache_hits", "response_cache_misses",
+                   "response_cache_entries"):
+            getattr(lib, f"hvdtpu_{fn}").restype = i64
+            getattr(lib, f"hvdtpu_{fn}").argtypes = []
 
         _lib = lib
         return _lib
@@ -188,3 +192,13 @@ class HorovodBasics:
     def stop_timeline(self):
         """Stop a runtime-started timeline and flush the JSON file."""
         self.lib.hvdtpu_stop_timeline()
+
+    def response_cache_stats(self):
+        """(hits, misses, entries) of the negotiation response cache.
+
+        Reference analog: horovod/common/response_cache.h — the steady-state
+        bitvector path; hits grow once a training loop reaches steady state.
+        """
+        return (self.lib.hvdtpu_response_cache_hits(),
+                self.lib.hvdtpu_response_cache_misses(),
+                self.lib.hvdtpu_response_cache_entries())

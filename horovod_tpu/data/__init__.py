@@ -1,0 +1,4 @@
+from horovod_tpu.data.data_loader_base import (  # noqa: F401
+    AsyncDataLoaderMixin,
+    BaseDataLoader,
+)

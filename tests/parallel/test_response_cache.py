@@ -1,0 +1,170 @@
+"""Response cache: steady-state bitvector negotiation stays correct.
+
+Reference analog: the reference exercises the cache implicitly by looping
+ops under HOROVOD_CACHE_CAPACITY (test/parallel/test_torch.py) — every
+repeat of a named tensor after the first rides the cache-hit path. We assert
+correctness over many cycles plus the eviction path (metadata change) and
+that the hit counters actually engage (the bits, not full requests, carried
+the steady state).
+"""
+
+import numpy as np
+
+from tests.utils_mp import run_ranks
+
+
+def _init():
+    from horovod_tpu.common import basics
+    b = basics.HorovodBasics()
+    b.init()
+    return b
+
+
+def _ops():
+    from horovod_tpu.common import eager_ops
+    return eager_ops
+
+
+def _worker_steady_state(rank, size):
+    b = _init()
+    ops = _ops()
+    try:
+        # Same tensor names over many steps: first step negotiates fully,
+        # later steps must be pure cache hits.
+        steps, ngrads = 12, 6
+        for step in range(steps):
+            hs = [
+                ops.allreduce_async(
+                    np.full(8, float(rank + step + i), np.float32),
+                    f"grad.{i}")
+                for i in range(ngrads)
+            ]
+            for i, h in enumerate(hs):
+                np.testing.assert_allclose(
+                    h.synchronize(),
+                    sum(rk + step + i for rk in range(size)))
+            # Broadcast and reducescatter are cacheable too.
+            h = ops.broadcast_async(np.full(4, float(rank), np.float64), 0,
+                                    "bcast.w")
+            np.testing.assert_allclose(h.synchronize(), 0.0)
+            h = ops.reducescatter_async(
+                np.full((size * 2, 3), float(rank), np.float32), "rs.w")
+            np.testing.assert_allclose(h.synchronize(),
+                                       sum(range(size)))
+        hits, misses, entries = b.response_cache_stats()
+        assert entries == ngrads + 2, (hits, misses, entries)
+        # Every post-first-step op must be a hit.
+        assert hits >= (steps - 1) * (ngrads + 2), (hits, misses, entries)
+        return hits
+    finally:
+        b.shutdown()
+
+
+def _worker_eviction(rank, size):
+    b = _init()
+    ops = _ops()
+    try:
+        # Warm the cache, then change the shape under the same name: the
+        # coordinator must evict everywhere and renegotiate, and results must
+        # stay correct (reference analog: cache invalidation on metadata
+        # change in response_cache.cc).
+        for shape in ((4,), (4,), (6,), (6,), (2, 3), (4,)):
+            h = ops.allreduce_async(np.full(shape, float(rank), np.float32),
+                                    "mutating")
+            np.testing.assert_allclose(h.synchronize(), sum(range(size)))
+        # Dtype change under the same name.
+        for dt in (np.float32, np.float64, np.float32):
+            h = ops.allreduce_async(np.full(3, rank, dt), "mutdtype")
+            np.testing.assert_allclose(h.synchronize(), sum(range(size)))
+        # Reduce-op change under the same name.
+        h = ops.allreduce_async(np.full(3, float(rank + 1), np.float64),
+                                "mutop", op=ops.ReduceOp.SUM)
+        np.testing.assert_allclose(h.synchronize(),
+                                   sum(range(1, size + 1)))
+        h = ops.allreduce_async(np.full(3, float(rank + 1), np.float64),
+                                "mutop", op=ops.ReduceOp.MAX)
+        np.testing.assert_allclose(h.synchronize(), float(size))
+        return True
+    finally:
+        b.shutdown()
+
+
+def _worker_disabled(rank, size):
+    b = _init()
+    ops = _ops()
+    try:
+        for step in range(4):
+            h = ops.allreduce_async(np.full(5, float(rank), np.float32),
+                                    "nocache")
+            np.testing.assert_allclose(h.synchronize(), sum(range(size)))
+        hits, _, entries = b.response_cache_stats()
+        assert hits == 0 and entries == 0, (hits, entries)
+        return True
+    finally:
+        b.shutdown()
+
+
+def _worker_skewed_arrival(rank, size):
+    b = _init()
+    ops = _ops()
+    try:
+        import time
+        # Ranks reach the cached collective at very different times: bits
+        # must wait in the coordinator's pending table until all ranks set
+        # them (completion spans cycles).
+        for step in range(5):
+            time.sleep(0.02 * rank)
+            h = ops.allreduce_async(np.full(4, float(rank * step),
+                                            np.float32), "skew")
+            np.testing.assert_allclose(h.synchronize(),
+                                       sum(rk * step for rk in range(size)))
+        return True
+    finally:
+        b.shutdown()
+
+
+def _worker_join_covers_pending_bits(rank, size):
+    b = _init()
+    ops = _ops()
+    try:
+        # Warm the cache on all ranks.
+        for step in range(2):
+            h = ops.allreduce_async(np.full(4, float(rank + 1), np.float32),
+                                    "g")
+            np.testing.assert_allclose(h.synchronize(),
+                                       sum(range(1, size + 1)))
+        # Rank != 0 joins immediately; rank 0 rides the cache-hit path once
+        # more. The pending bit must be completed by join coverage (the
+        # joined ranks contribute zeros), exactly like the full-request path.
+        if rank == 0:
+            h = ops.allreduce_async(np.full(4, 7.0, np.float32), "g")
+            np.testing.assert_allclose(h.synchronize(), 7.0)
+        ops.join()  # blocks until every rank has joined
+        return True
+    finally:
+        b.shutdown()
+
+
+def test_cache_steady_state_2ranks():
+    hits = run_ranks(_worker_steady_state, 2)
+    assert all(h > 0 for h in hits)
+
+
+def test_cache_steady_state_4ranks():
+    run_ranks(_worker_steady_state, 4)
+
+
+def test_cache_eviction_on_metadata_change():
+    run_ranks(_worker_eviction, 2)
+
+
+def test_cache_disabled_by_env():
+    run_ranks(_worker_disabled, 2, env={"HOROVOD_CACHE_CAPACITY": "0"})
+
+
+def test_cache_skewed_arrival():
+    run_ranks(_worker_skewed_arrival, 3)
+
+
+def test_cache_join_covers_pending_bits():
+    run_ranks(_worker_join_covers_pending_bits, 2)
