@@ -139,7 +139,37 @@ class HorovodBasics:
             self._lib = load_library()
         return self._lib
 
+    # Launcher-env fallbacks: under mpirun/srun/jsrun the per-rank layout
+    # arrives in the launcher's own variables, not HOROVOD_* (reference
+    # analog: MPIContext owning rank/size; gloo path's env contract).
+    # Ordered HOROVOD_* first so horovodrun's explicit assignment wins.
+    _ENV_FALLBACKS = {
+        "HOROVOD_RANK": ("OMPI_COMM_WORLD_RANK", "PMIX_RANK", "PMI_RANK",
+                         "SLURM_PROCID", "JSM_NAMESPACE_RANK"),
+        "HOROVOD_SIZE": ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "SLURM_NTASKS",
+                         "JSM_NAMESPACE_SIZE"),
+        "HOROVOD_LOCAL_RANK": ("OMPI_COMM_WORLD_LOCAL_RANK", "PMI_LOCAL_RANK",
+                               "SLURM_LOCALID", "JSM_NAMESPACE_LOCAL_RANK"),
+        "HOROVOD_LOCAL_SIZE": ("OMPI_COMM_WORLD_LOCAL_SIZE", "PMI_LOCAL_SIZE",
+                               "SLURM_TASKS_PER_NODE"),
+    }
+
+    @staticmethod
+    def _translate_launcher_env():
+        import os
+
+        for target, sources in HorovodBasics._ENV_FALLBACKS.items():
+            if os.environ.get(target):
+                continue
+            for src in sources:
+                val = os.environ.get(src)
+                if val:
+                    # SLURM_TASKS_PER_NODE can be '4(x2)'; take the number.
+                    os.environ[target] = val.split("(")[0].split(",")[0]
+                    break
+
     def init(self):
+        self._translate_launcher_env()
         if self.lib.hvdtpu_init() != 0:
             raise RuntimeError(
                 "Horovod initialization failed (see stderr log)")

@@ -40,6 +40,17 @@ def parse_args(argv=None):
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--tpu-pod", action="store_true",
                    help="one rank per local TPU chip, chips pinned per rank")
+    # Controller choice (reference: --gloo / --mpi / js autodetect).
+    p.add_argument("--gloo", action="store_true",
+                   help="force the built-in launcher (default)")
+    p.add_argument("--mpi", action="store_true",
+                   help="delegate process management to mpirun")
+    p.add_argument("--mpi-args", default=None,
+                   help="extra arguments appended to the mpirun cmdline")
+    p.add_argument("--js", action="store_true",
+                   help="launch with jsrun (LSF clusters)")
+    p.add_argument("--js-args", default=None,
+                   help="extra arguments appended to the jsrun cmdline")
     # Elastic mode (reference: --min-np/--max-np/--host-discovery-script)
     p.add_argument("--min-np", type=int, default=None,
                    help="elastic: keep training while >= this many workers")
@@ -85,7 +96,9 @@ def parse_args(argv=None):
             args.min_np = args.np
         if args.np is None:
             p.error("elastic mode needs -np or --min-np")
-    elif args.np is None and not args.tpu_pod:
+    elif args.np is None and not args.tpu_pod and not (
+            args.js or "LSB_JOBID" in os.environ):
+        # jsrun mode derives np from the LSF allocation (LSB_MCPU_HOSTS).
         p.error("-np is required (or use --tpu-pod)")
     return args
 
@@ -213,11 +226,47 @@ def run_elastic(args):
         driver.stop()
 
 
-def run_launcher(args):
+def run_controller(args):
+    """Choose the launch backend (reference: launch.py run_controller —
+    explicit flag wins; LSF allocation implies jsrun; default built-in)."""
+    from horovod_tpu.runner.js_run import LSFUtils, js_available
+
+    if args.mpi and args.js:
+        raise ValueError("--mpi and --js are mutually exclusive")
     if is_elastic(args):
-        return run_elastic(args)
+        if args.mpi or args.js:
+            raise ValueError(
+                "elastic mode needs the built-in launcher (worker respawn "
+                "is driven by the elastic driver, not mpirun/jsrun)")
+        return "gloo"
+    if args.mpi:
+        return "mpi"
+    if args.js or (not args.gloo and LSFUtils.using_lsf() and js_available()
+                   and not args.hosts and not args.hostfile):
+        return "js"
+    return "gloo"
+
+
+def run_launcher(args):
     if args.tpu_pod and args.np is None:
         args.np = _tpu_pod_np()
+    controller = run_controller(args)
+    if args.np is None and controller != "js":
+        # parse_args waives -np under LSF expecting the jsrun path to
+        # derive it; any other backend has no allocation to read it from.
+        raise SystemExit(
+            "horovodrun: -np is required (only jsrun mode can derive the "
+            "process count from the LSF allocation)")
+    if controller == "mpi":
+        from horovod_tpu.runner.mpi_run import mpi_run
+
+        return mpi_run(args, env_from_args(args))
+    if controller == "js":
+        from horovod_tpu.runner.js_run import js_run
+
+        return js_run(args, env_from_args(args))
+    if is_elastic(args):
+        return run_elastic(args)
     hosts = (util.parse_hostfile(args.hostfile) if args.hostfile
              else util.parse_hosts(args.hosts or f"localhost:{args.np}"))
     slots = util.get_host_assignments(hosts, args.np)

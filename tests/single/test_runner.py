@@ -119,3 +119,138 @@ def test_horovodrun_propagates_failure(tmp_path):
         capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
     assert proc.returncode == 1
     assert "ranks failed" in proc.stderr
+
+
+# ---- mpi_run / js_run cmdline construction (reference: test_run.py's
+# mpirun cmdline asserts, fully mocked — no MPI needed) ----
+
+def test_build_mpi_command_openmpi():
+    from horovod_tpu.runner.mpi_run import MpiFlavor, build_mpi_command
+
+    hosts = util.parse_hosts("h1:2,h2:2")
+    env = {"HOROVOD_FUSION_THRESHOLD": "1", "PATH": "/bin", "HOME": "/root"}
+    cmd = build_mpi_command(4, hosts, ["python", "train.py"], env,
+                            flavor=MpiFlavor.OPENMPI, ssh_port=2222)
+    assert cmd[0] == "mpirun"
+    assert "-H" in cmd and cmd[cmd.index("-H") + 1] == "h1:2,h2:2"
+    assert cmd[cmd.index("-np") + 1] == "4"
+    assert ["--bind-to", "none"] == cmd[cmd.index("--bind-to"):
+                                        cmd.index("--bind-to") + 2]
+    # env forwarding: HOROVOD_* and PATH yes, HOME no
+    xs = [cmd[i + 1] for i, c in enumerate(cmd) if c == "-x"]
+    assert "HOROVOD_FUSION_THRESHOLD" in xs and "PATH" in xs
+    assert "HOME" not in xs
+    assert "plm_rsh_args" in cmd  # ssh port plumbed
+    assert cmd[-2:] == ["python", "train.py"]
+
+
+def test_build_mpi_command_mpich():
+    from horovod_tpu.runner.mpi_run import MpiFlavor, build_mpi_command
+
+    hosts = util.parse_hosts("h1:2")
+    cmd = build_mpi_command(2, hosts, ["python", "t.py"],
+                            {"HOROVOD_RANK": "0"}, flavor=MpiFlavor.MPICH)
+    assert "-genvlist" in cmd and "-hosts" in cmd
+    assert cmd[-2:] == ["python", "t.py"]
+
+
+def test_detect_mpi_flavor():
+    from horovod_tpu.runner.mpi_run import MpiFlavor, detect_mpi_flavor
+
+    assert detect_mpi_flavor("mpirun (Open MPI) 4.1.4") == MpiFlavor.OPENMPI
+    assert detect_mpi_flavor("HYDRA build details:") == MpiFlavor.MPICH
+    assert detect_mpi_flavor("Intel(R) MPI Library") == MpiFlavor.INTEL
+    assert detect_mpi_flavor("???") == MpiFlavor.UNKNOWN
+
+
+def test_lsf_hosts_parsing():
+    from horovod_tpu.runner.js_run import LSFUtils, build_js_command
+
+    env = {"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "batch 1 c1 4 c2 4"}
+    assert LSFUtils.using_lsf(env)
+    hosts = LSFUtils.get_compute_hosts(env)
+    assert [(h.hostname, h.slots) for h in hosts] == [("c1", 4), ("c2", 4)]
+    assert LSFUtils.get_num_processes(env) == 8
+    # One resource set per host carrying all its ranks (multiple all-CPU
+    # RSes on one host would be an infeasible jsrun geometry).
+    cmd = build_js_command(2, 4, ["python", "t.py"])
+    assert cmd[0] == "jsrun"
+    assert cmd[cmd.index("--nrs") + 1] == "2"
+    assert cmd[cmd.index("--tasks_per_rs") + 1] == "4"
+    assert cmd[cmd.index("--rs_per_host") + 1] == "1"
+
+
+def test_run_controller_choice():
+    args = launch.parse_args(["-np", "2", "--mpi", "--", "python", "t.py"])
+    assert launch.run_controller(args) == "mpi"
+    args = launch.parse_args(["-np", "2", "--", "python", "t.py"])
+    assert launch.run_controller(args) == "gloo"
+    args = launch.parse_args(["-np", "2", "--js", "--", "python", "t.py"])
+    assert launch.run_controller(args) == "js"
+    with pytest.raises(ValueError):
+        args = launch.parse_args(
+            ["-np", "2", "--mpi", "--js", "--", "python", "t.py"])
+        launch.run_controller(args)
+
+
+# ---- driver/task NIC discovery (reference: test_run.py service tests;
+# multi-host faked as threads on loopback, SURVEY.md §4) ----
+
+def test_nic_discovery_roundtrip():
+    from horovod_tpu.runner.task_service import (
+        HorovodRunTaskService,
+        discover_common_interfaces,
+    )
+
+    def spawn(driver):
+        return [HorovodRunTaskService(i, driver.addresses, driver.key)
+                for i in range(3)]
+
+    common = discover_common_interfaces(3, spawn, timeout=30)
+    assert set(common) == {0, 1, 2}
+    # every host is reachable from the others via at least one address
+    for idx, addrs in common.items():
+        assert addrs, f"no common interface found for task {idx}"
+
+
+def test_driver_rejects_bad_hmac():
+    import socket
+
+    from horovod_tpu.runner.driver_service import (
+        HorovodRunDriverService,
+        send_msg,
+    )
+
+    driver = HorovodRunDriverService(1)
+    try:
+        with socket.create_connection(driver.addresses, timeout=5) as s:
+            send_msg(s, {"type": "register", "index": 0, "host": "x",
+                         "addrs": []}, "wrong-key")
+            f = s.makefile("rb")
+            assert f.readline() == b""  # connection dropped, no ack
+        assert driver._registered == {}
+    finally:
+        driver.shutdown()
+
+
+def test_launcher_env_translation(monkeypatch):
+    """Under mpirun/srun the rank layout arrives in OMPI_*/SLURM_* vars;
+    init must translate them to HOROVOD_* (reference: MPIContext)."""
+    from horovod_tpu.common.basics import HorovodBasics
+
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+              "HOROVOD_LOCAL_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "1")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "4(x2)")
+    HorovodBasics._translate_launcher_env()
+    assert os.environ["HOROVOD_RANK"] == "3"
+    assert os.environ["HOROVOD_SIZE"] == "8"
+    assert os.environ["HOROVOD_LOCAL_RANK"] == "1"
+    assert os.environ["HOROVOD_LOCAL_SIZE"] == "4"  # '(x2)' stripped
+    # Explicit HOROVOD_* wins over launcher vars.
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    HorovodBasics._translate_launcher_env()
+    assert os.environ["HOROVOD_RANK"] == "0"
