@@ -1,0 +1,157 @@
+"""MXNet frontend.
+
+Reference analog: ``horovod/mxnet/__init__.py`` + ``mpi_ops.py`` —
+``DistributedOptimizer`` (allreduce inside ``update``), gluon
+``DistributedTrainer`` (allreduce in ``_allreduce_grads``), and
+``broadcast_parameters``. Collectives ride the shared eager core
+(``horovod_tpu.common.eager_ops``) via NDArray's numpy bridge, so the
+negotiation / fusion / response-cache machinery is identical across
+frontends.
+
+MXNet itself is optional: importing this module without mxnet installed
+raises the same "extension not available" ImportError shape the reference
+uses (horovod/mxnet raises on missing extension at import).
+"""
+
+try:
+    import mxnet as mx
+except ImportError as e:  # pragma: no cover - exercised only without mxnet
+    raise ImportError(
+        "horovod_tpu.mxnet requires the 'mxnet' package, which is not "
+        "installed in this environment. The jax/torch/tensorflow frontends "
+        "carry the same API.") from e
+
+from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allreduce,
+    allreduce_,
+    alltoall,
+    barrier,
+    broadcast,
+    broadcast_,
+    cross_rank,
+    cross_size,
+    grouped_allreduce,
+    init,
+    is_initialized,
+    join,
+    local_rank,
+    local_size,
+    rank,
+    reducescatter,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+)
+
+
+def broadcast_parameters(params, root_rank=0, prefix=""):
+    """Broadcast a gluon ``ParameterDict`` / plain dict of NDArrays from
+    ``root_rank`` (reference: horovod/mxnet broadcast_parameters)."""
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    for name, p in items:
+        try:
+            tensor = p.data() if hasattr(p, "data") else p
+        except mx.gluon.parameter.DeferredInitializationError:
+            continue
+        broadcast_(tensor, root_rank, name=f"{prefix}parameter.{name}")
+    if items:
+        mx.nd.waitall()
+
+
+class DistributedOptimizer(mx.optimizer.Optimizer):
+    """Wrap an mxnet Optimizer: allreduce (average) each gradient before
+    the wrapped update (reference: horovod/mxnet DistributedOptimizer)."""
+
+    def __init__(self, optimizer, gradient_predivide_factor=1.0,
+                 num_groups=0, process_set_id=0):
+        self._optimizer = optimizer
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self._num_groups = num_groups
+        self._process_set_id = process_set_id
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _do_allreduce(self, index, grad):
+        if size(self._process_set_id) == 1:
+            return
+        # Predivide splits the averaging around the wire to control fp16
+        # range: Sum with prescale 1/f and postscale f/size nets to an
+        # exact average for any f (reference passes the same pair).
+        f = self._gradient_predivide_factor
+        pre, post = 1.0 / f, f / size(self._process_set_id)
+        if isinstance(index, (tuple, list)):
+            if self._num_groups > 0:
+                names = [f"gradient.{i}" for i in index]
+                grouped_allreduce(grad, names=names, op=Sum,
+                                  prescale_factor=pre, postscale_factor=post,
+                                  process_set_id=self._process_set_id,
+                                  inplace=True)
+            else:
+                for i, g in zip(index, grad):
+                    allreduce_(g, name=f"gradient.{i}", op=Sum,
+                               prescale_factor=pre, postscale_factor=post,
+                               process_set_id=self._process_set_id)
+        else:
+            allreduce_(grad, name=f"gradient.{index}", op=Sum,
+                       prescale_factor=pre, postscale_factor=post,
+                       process_set_id=self._process_set_id)
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+class DistributedTrainer(mx.gluon.Trainer):
+    """Gluon Trainer whose gradient aggregation is the shared eager
+    allreduce (reference: horovod/mxnet DistributedTrainer: overrides
+    ``_allreduce_grads``; scales lr by 1/size so the wrapped optimizer's
+    rescale_grad stays correct under averaging)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 gradient_predivide_factor=1.0, process_set_id=0):
+        if isinstance(optimizer, DistributedOptimizer):
+            optimizer = optimizer._optimizer
+        super().__init__(params, optimizer, optimizer_params, kvstore=None)
+        self._hvd_process_set_id = process_set_id
+        self._gradient_predivide_factor = gradient_predivide_factor
+        # Trainer applies rescale_grad itself: fold the 1/size of the
+        # average there, and run the wire collective as a pre/post-scaled
+        # Sum (net scale 1) so any predivide factor cancels exactly.
+        self._scale /= size(process_set_id)
+
+    def _allreduce_grads(self):
+        if size(self._hvd_process_set_id) == 1:
+            return
+        f = self._gradient_predivide_factor
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                for grad in param.list_grad():
+                    allreduce_(grad, name=f"gradient.{i}.{param.name}",
+                               op=Sum, prescale_factor=1.0 / f,
+                               postscale_factor=f,
+                               process_set_id=self._hvd_process_set_id)

@@ -1,0 +1,147 @@
+"""MXNet eager collective ops over the shared core.
+
+Reference analog: ``horovod/mxnet/mpi_ops.py`` (+ its C extension
+``mpi_ops.cc``). NDArrays bridge through numpy: enqueue copies out,
+completion writes back in-place — same contract as the reference's
+in-place ``allreduce_`` on NDArray.
+"""
+
+import threading
+
+import mxnet as mx
+import numpy as np
+
+from horovod_tpu.common import eager_ops
+from horovod_tpu.common.eager_ops import ReduceOp
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+Adasum = ReduceOp.ADASUM
+
+_basics = eager_ops._basics
+
+from horovod_tpu.common import elastic as _elastic_init_mod  # noqa: E402
+
+init = _elastic_init_mod.init
+shutdown = _basics.shutdown
+is_initialized = _basics.is_initialized
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+cross_rank = _basics.cross_rank
+cross_size = _basics.cross_size
+start_timeline = _basics.start_timeline
+stop_timeline = _basics.stop_timeline
+join = eager_ops.join
+barrier = eager_ops.barrier
+
+_name_lock = threading.Lock()
+_name_counters = {}
+
+
+def _auto_name(kind):
+    with _name_lock:
+        n = _name_counters.get(kind, 0)
+        _name_counters[kind] = n + 1
+    return f"{kind}.noname.{n}"
+
+
+def _to_np(tensor):
+    return tensor.asnumpy()
+
+
+def _write_back(tensor, result):
+    tensor[:] = mx.nd.array(result, ctx=tensor.context, dtype=result.dtype)
+
+
+def allreduce(tensor, name=None, op=Average, prescale_factor=1.0,
+              postscale_factor=1.0, process_set_id=0):
+    h = eager_ops.allreduce_async(
+        _to_np(tensor), name or _auto_name("allreduce"), op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=process_set_id)
+    out = h.synchronize()
+    return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)
+
+
+def allreduce_(tensor, name=None, op=Average, prescale_factor=1.0,
+               postscale_factor=1.0, process_set_id=0):
+    h = eager_ops.allreduce_async(
+        _to_np(tensor), name or _auto_name("allreduce"), op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=process_set_id)
+    _write_back(tensor, h.synchronize())
+    return tensor
+
+
+def grouped_allreduce(tensors, names=None, op=Average, prescale_factor=1.0,
+                      postscale_factor=1.0, process_set_id=0, inplace=False):
+    if names is None:
+        base = _auto_name("grouped_allreduce")
+        names = [f"{base}.{i}" for i in range(len(tensors))]
+    handles = eager_ops.grouped_allreduce_async(
+        [_to_np(t) for t in tensors], names, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=process_set_id)
+    outs = [h.synchronize() for h in handles]
+    if inplace:
+        for t, o in zip(tensors, outs):
+            _write_back(t, o)
+        return tensors
+    return [mx.nd.array(o, ctx=t.context, dtype=o.dtype)
+            for t, o in zip(tensors, outs)]
+
+
+def allgather(tensor, name=None, process_set_id=0):
+    h = eager_ops.allgather_async(
+        _to_np(tensor), name or _auto_name("allgather"),
+        process_set_id=process_set_id)
+    out = h.synchronize()
+    return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)
+
+
+def broadcast(tensor, root_rank, name=None, process_set_id=0):
+    h = eager_ops.broadcast_async(
+        _to_np(tensor), root_rank, name or _auto_name("broadcast"),
+        process_set_id=process_set_id)
+    out = h.synchronize()
+    return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)
+
+
+def broadcast_(tensor, root_rank, name=None, process_set_id=0):
+    h = eager_ops.broadcast_async(
+        _to_np(tensor), root_rank, name or _auto_name("broadcast"),
+        process_set_id=process_set_id)
+    _write_back(tensor, h.synchronize())
+    return tensor
+
+
+def alltoall(tensor, splits=None, name=None, process_set_id=0):
+    arr = _to_np(tensor)
+    if splits is None:
+        n = size(process_set_id)
+        if arr.shape[0] % n != 0:
+            raise ValueError(
+                "alltoall without splits needs dim0 divisible by size")
+        splits_np = np.full(n, arr.shape[0] // n, np.int64)
+    else:
+        splits_np = np.asarray(
+            splits.asnumpy() if isinstance(splits, mx.nd.NDArray) else splits,
+            np.int64)
+    h = eager_ops.alltoall_async(arr, splits_np,
+                                 name or _auto_name("alltoall"),
+                                 process_set_id=process_set_id)
+    out = h.synchronize()
+    return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)
+
+
+def reducescatter(tensor, name=None, op=Average, process_set_id=0):
+    h = eager_ops.reducescatter_async(
+        _to_np(tensor), name or _auto_name("reducescatter"), op=op,
+        process_set_id=process_set_id)
+    out = h.synchronize()
+    return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)

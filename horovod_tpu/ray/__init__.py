@@ -1,0 +1,14 @@
+"""Ray integration.
+
+Reference analog: ``horovod/ray/`` (``RayExecutor`` in runner.py +
+placement-group colocation in strategy.py): workers are Ray actors, one
+per slot, placed by a colocation strategy; the executor wires the
+HOROVOD_* env across them and drives ``execute``/``run`` calls.
+"""
+
+from horovod_tpu.ray.runner import RayExecutor  # noqa: F401
+from horovod_tpu.ray.strategy import (  # noqa: F401
+    ColocationStrategy,
+    PackStrategy,
+    SpreadStrategy,
+)

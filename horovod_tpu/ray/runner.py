@@ -1,0 +1,146 @@
+"""``RayExecutor`` — Horovod workers as Ray actors.
+
+Reference analog: ``horovod/ray/runner.py``: ``start()`` creates a
+placement group per the strategy, spawns one worker actor per slot,
+assigns ranks grouped by host (local_rank = position within host),
+exports the HOROVOD_* env to each actor, and ``run``/``execute`` invoke a
+fn on all workers simultaneously, returning per-rank results.
+"""
+
+import collections
+import socket
+
+
+def _require_ray():
+    try:
+        import ray
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.ray.RayExecutor requires the 'ray' package, which "
+            "is not installed in this environment.") from e
+    return ray
+
+
+def plan_ranks(worker_hosts):
+    """Rank layout from a list of (worker_index, hostname): ranks are
+    contiguous per host (reference: runner.py host grouping). Pure &
+    unit-testable. Returns {worker_index: env_dict}."""
+    by_host = collections.OrderedDict()
+    for idx, host in worker_hosts:
+        by_host.setdefault(host, []).append(idx)
+    size = len(worker_hosts)
+    cross_size = len(by_host)
+    envs = {}
+    rank = 0
+    for cross_rank, (host, members) in enumerate(by_host.items()):
+        for local_rank, idx in enumerate(members):
+            envs[idx] = {
+                "HOROVOD_RANK": str(rank),
+                "HOROVOD_SIZE": str(size),
+                "HOROVOD_LOCAL_RANK": str(local_rank),
+                "HOROVOD_LOCAL_SIZE": str(len(members)),
+                "HOROVOD_CROSS_RANK": str(cross_rank),
+                "HOROVOD_CROSS_SIZE": str(cross_size),
+            }
+            rank += 1
+    return envs
+
+
+class RayExecutor:
+    """Reference-shaped executor: start() / run(fn) / execute(fn) /
+    shutdown()."""
+
+    def __init__(self, strategy=None, num_workers=None, cpus_per_worker=1,
+                 gpus_per_worker=0, env_vars=None, use_current_placement_group
+                 =False):
+        from horovod_tpu.ray.strategy import PackStrategy
+
+        if strategy is None:
+            if num_workers is None:
+                raise ValueError("need strategy= or num_workers=")
+            strategy = PackStrategy(num_workers,
+                                    cpus_per_worker=cpus_per_worker,
+                                    gpus_per_worker=gpus_per_worker)
+        self.strategy = strategy
+        self.env_vars = dict(env_vars or {})
+        self._workers = []
+        self._pg = None
+
+    def start(self):
+        ray = _require_ray()
+        from ray.util.placement_group import placement_group
+
+        self._pg = placement_group(
+            self.strategy.bundles(),
+            strategy=self.strategy.placement_strategy)
+        ray.get(self._pg.ready())
+
+        @ray.remote(num_cpus=self.strategy.cpus_per_worker,
+                    num_gpus=self.strategy.gpus_per_worker)
+        class Worker:
+            def __init__(self, index):
+                self.index = index
+
+            def hostname(self):
+                return socket.gethostname()
+
+            def node_ip(self):
+                import ray
+
+                return ray.util.get_node_ip_address()
+
+            def set_env(self, env):
+                import os
+
+                os.environ.update(env)
+
+            def execute(self, fn, args, kwargs):
+                return fn(*args, **kwargs)
+
+        self._workers = [
+            Worker.options(placement_group=self._pg,
+                           placement_group_bundle_index=i).remote(i)
+            for i in range(self.strategy.num_workers)]
+
+        hosts = ray.get([w.hostname.remote() for w in self._workers])
+        envs = plan_ranks(list(enumerate(hosts)))
+        # Controller bootstrap: rank 0's listen socket binds inside the
+        # rank-0 ACTOR, so the address must be that actor's node IP (not
+        # the Ray driver's).
+        from horovod_tpu.runner import util
+
+        rank0_worker = next(
+            i for i, e in envs.items() if e["HOROVOD_RANK"] == "0")
+        addr = ray.get(self._workers[rank0_worker].node_ip.remote())
+        port = util.free_port()
+        ray.get([
+            w.set_env.remote({**envs[i], **self.env_vars,
+                              "HOROVOD_CONTROLLER_ADDR": addr,
+                              "HOROVOD_CONTROLLER_PORT": str(port)})
+            for i, w in enumerate(self._workers)])
+
+    def run(self, fn, args=None, kwargs=None):
+        """Run fn on every worker simultaneously; list of results by rank."""
+        ray = _require_ray()
+        return ray.get([w.execute.remote(fn, tuple(args or ()),
+                                         dict(kwargs or {}))
+                        for w in self._workers])
+
+    # Reference exposes both names.
+    execute = run
+
+    def run_remote(self, fn, args=None, kwargs=None):
+        """Async variant: returns ray ObjectRefs (reference parity)."""
+        return [w.execute.remote(fn, tuple(args or ()), dict(kwargs or {}))
+                for w in self._workers]
+
+    def shutdown(self):
+        ray = _require_ray()
+        for w in self._workers:
+            ray.kill(w)
+        self._workers = []
+        if self._pg is not None:
+            from ray.util.placement_group import remove_placement_group
+
+            remove_placement_group(self._pg)
+            self._pg = None

@@ -1,0 +1,37 @@
+"""Worker placement strategies for the Ray executor.
+
+Reference analog: ``horovod/ray/strategy.py`` — decide how the
+``num_workers`` actor slots map onto Ray placement-group bundles:
+``pack`` fills hosts (maximizes intra-host locality — on TPU pods this
+keeps ranks next to their chips), ``spread`` balances across hosts.
+The strategy is pure planning (testable without ray); the executor turns
+the plan into an actual placement group.
+"""
+
+
+class ColocationStrategy:
+    def __init__(self, num_workers, cpus_per_worker=1, gpus_per_worker=0,
+                 resources_per_worker=None):
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
+        self.resources_per_worker = dict(resources_per_worker or {})
+
+    @property
+    def placement_strategy(self):
+        raise NotImplementedError()
+
+    def bundles(self):
+        b = {"CPU": self.cpus_per_worker}
+        if self.gpus_per_worker:
+            b["GPU"] = self.gpus_per_worker
+        b.update(self.resources_per_worker)
+        return [dict(b) for _ in range(self.num_workers)]
+
+
+class PackStrategy(ColocationStrategy):
+    placement_strategy = "PACK"
+
+
+class SpreadStrategy(ColocationStrategy):
+    placement_strategy = "SPREAD"

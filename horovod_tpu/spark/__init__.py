@@ -1,0 +1,20 @@
+"""Spark integration.
+
+Reference analog: ``horovod/spark/__init__.py`` — ``horovod.spark.run(fn)``
+executes ``fn`` on ``num_proc`` Spark executors as one barrier-stage job
+with the collective core initialized, and returns each rank's result.
+Estimator-style training (fit a model on a DataFrame) lives in
+``horovod_tpu.spark.keras`` / ``horovod_tpu.spark.torch``; artifact
+persistence in ``horovod_tpu.spark.common.store``.
+
+pyspark is optional at import time: only ``run``/estimator ``fit`` require
+it (reference behaves the same — horovod.spark imports pyspark lazily
+inside run()).
+"""
+
+from horovod_tpu.spark.runner import run, run_elastic  # noqa: F401
+from horovod_tpu.spark.common.store import (  # noqa: F401
+    FilesystemStore,
+    LocalStore,
+    Store,
+)

@@ -1,0 +1,48 @@
+"""Estimator hyper-parameter bag.
+
+Reference analog: ``horovod/spark/common/params.py`` (EstimatorParams —
+a pyspark.ml Params subclass). Ours is a plain attribute bag so it works
+without pyspark; the estimator API surface (feature_cols/label_cols/
+batch_size/epochs/store/...) matches the reference's param names.
+"""
+
+
+class EstimatorParams:
+    _defaults = dict(
+        num_proc=None,
+        model=None,
+        optimizer=None,
+        loss=None,
+        metrics=(),
+        feature_cols=("features",),
+        label_cols=("label",),
+        batch_size=32,
+        epochs=1,
+        validation=None,
+        shuffle_buffer_size=None,
+        verbose=1,
+        store=None,
+        callbacks=(),
+        random_seed=None,
+        run_id=None,
+        train_steps_per_epoch=None,
+        validation_steps_per_epoch=None,
+    )
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - set(self._defaults)
+        if unknown:
+            raise TypeError(f"unknown estimator params: {sorted(unknown)}")
+        for key, default in self._defaults.items():
+            setattr(self, key, kwargs.get(key, default))
+
+    # pyspark.ml-style getters the reference exposes.
+    def __getattr__(self, item):
+        if item.startswith("get"):
+            name = item[3:].lstrip("_")
+            snake = "".join(
+                f"_{c.lower()}" if c.isupper() else c for c in name
+            ).lstrip("_")
+            if snake in self._defaults:
+                return lambda: getattr(self, snake)
+        raise AttributeError(item)

@@ -1,0 +1,126 @@
+"""Artifact stores for estimator training.
+
+Reference analog: ``horovod/spark/common/store.py`` — ``Store`` is where
+estimators persist intermediate train/val data, checkpoints, and logs
+(``LocalStore``/``HDFSStore``/``DBFSLocalStore`` upstream). Ours:
+``FilesystemStore`` covers any fsspec-style mounted path (local disk, NFS,
+GCS via gcsfuse on TPU VMs — the TPU-idiomatic equivalent of HDFS).
+No Spark dependency: usable from plain scripts and tests.
+"""
+
+import contextlib
+import os
+import shutil
+import tempfile
+
+
+class Store:
+    """Abstract artifact store (reference: store.Store)."""
+
+    def get_train_data_path(self, idx=None):
+        raise NotImplementedError()
+
+    def get_val_data_path(self, idx=None):
+        raise NotImplementedError()
+
+    def get_test_data_path(self, idx=None):
+        raise NotImplementedError()
+
+    def get_checkpoint_path(self, run_id):
+        raise NotImplementedError()
+
+    def get_logs_path(self, run_id):
+        raise NotImplementedError()
+
+    def exists(self, path):
+        raise NotImplementedError()
+
+    def read(self, path):
+        raise NotImplementedError()
+
+    def write(self, path, data):
+        raise NotImplementedError()
+
+    def sync_fn(self, run_id):
+        """Return a fn(local_dir) that persists a local run dir into the
+        store (reference: Store.sync_fn used by estimator callbacks)."""
+        raise NotImplementedError()
+
+    @staticmethod
+    def create(prefix_path, *args, **kwargs):
+        """Factory mirroring the reference's Store.create dispatch."""
+        return FilesystemStore(prefix_path, *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Store rooted at a mounted filesystem prefix."""
+
+    def __init__(self, prefix_path, train_path=None, val_path=None,
+                 test_path=None, runs_path=None):
+        self.prefix_path = os.path.abspath(prefix_path)
+        self._train = train_path or os.path.join(self.prefix_path,
+                                                 "intermediate_train_data")
+        self._val = val_path or os.path.join(self.prefix_path,
+                                             "intermediate_val_data")
+        self._test = test_path or os.path.join(self.prefix_path,
+                                               "intermediate_test_data")
+        self._runs = runs_path or os.path.join(self.prefix_path, "runs")
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def _with_idx(self, base, idx):
+        return base if idx is None else f"{base}.{idx}"
+
+    def get_train_data_path(self, idx=None):
+        return self._with_idx(self._train, idx)
+
+    def get_val_data_path(self, idx=None):
+        return self._with_idx(self._val, idx)
+
+    def get_test_data_path(self, idx=None):
+        return self._with_idx(self._test, idx)
+
+    def get_run_path(self, run_id):
+        return os.path.join(self._runs, run_id)
+
+    def get_checkpoint_path(self, run_id):
+        return os.path.join(self.get_run_path(run_id), "checkpoint")
+
+    def get_logs_path(self, run_id):
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def read(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path, data):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic publish (rank-0 writer, many readers)
+
+    def sync_fn(self, run_id):
+        run_path = self.get_run_path(run_id)
+
+        def fn(local_run_path):
+            os.makedirs(run_path, exist_ok=True)
+            shutil.copytree(local_run_path, run_path, dirs_exist_ok=True)
+
+        return fn
+
+    @contextlib.contextmanager
+    def local_run_dir(self, run_id):
+        """Scratch dir that syncs into the store on clean exit."""
+        d = tempfile.mkdtemp(prefix=f"hvdtpu-{run_id}-")
+        try:
+            yield d
+            self.sync_fn(run_id)(d)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+class LocalStore(FilesystemStore):
+    """Reference-compat alias (horovod.spark.common.store.LocalStore)."""

@@ -1,0 +1,151 @@
+"""Spark Keras estimator.
+
+Reference analog: ``horovod/spark/keras/estimator.py`` (KerasEstimator →
+KerasModel): ``fit(df)`` materializes the DataFrame to the store as
+parquet, trains with ``horovod_tpu.spark.run`` (every executor wraps the
+optimizer in ``horovod_tpu.keras.DistributedOptimizer``), and returns a
+model wrapper whose ``transform(df)`` appends predictions.
+
+The petastorm reader of the reference is replaced by a pandas/pyarrow
+parquet path — the store's data is plain parquet either way.
+"""
+
+import os
+
+import numpy as np
+
+from horovod_tpu.spark.common.params import EstimatorParams
+
+
+def _df_to_parquet(df, path, num_proc):
+    df.repartition(max(num_proc or 1, 1)).write.mode("overwrite").parquet(path)
+
+
+def _load_np(path, feature_cols, label_cols, rank, size):
+    import pandas as pd
+
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path)
+        if f.endswith(".parquet"))
+    shard = files[rank::size] or files  # every rank needs >=1 shard
+    frames = [pd.read_parquet(f) for f in shard]
+    df = pd.concat(frames, ignore_index=True)
+    x = np.stack([np.asarray(v, np.float32)
+                  for v in df[list(feature_cols)].to_numpy().tolist()])
+    if x.ndim == 3 and x.shape[1] == 1:
+        x = x[:, 0]
+    y = df[list(label_cols)].to_numpy().astype(np.float32)
+    return x, y
+
+
+class KerasEstimator(EstimatorParams):
+    """fit(df) -> KerasModel. Params mirror the reference estimator."""
+
+    def __init__(self, **kwargs):
+        self.custom_objects = kwargs.pop("custom_objects", None)
+        super().__init__(**kwargs)
+
+    def fit(self, df, spark=None):
+        from horovod_tpu.spark import run as spark_run
+
+        if self.store is None:
+            raise ValueError("KerasEstimator needs a store= to stage data")
+        train_path = self.store.get_train_data_path(self.run_id)
+        _df_to_parquet(df, train_path, self.num_proc)
+
+        # Locals only below: the train closure must not capture self, or
+        # cloudpickle ships the live model/store to executors alongside
+        # the explicit HDF5 bytes (and fails outright on unpicklable
+        # TF internals).
+        model_bytes = _serialize_keras(self.model)
+        custom_objects = self.custom_objects
+        params = dict(
+            train_path=train_path, feature_cols=tuple(self.feature_cols),
+            label_cols=tuple(self.label_cols), batch_size=self.batch_size,
+            epochs=self.epochs, loss=self.loss, metrics=tuple(self.metrics),
+            verbose=self.verbose)
+
+        def train():
+            import horovod_tpu.keras as hvd
+
+            hvd.init()
+            model = _deserialize_keras(model_bytes, custom_objects)
+            x, y = _load_np(params["train_path"], params["feature_cols"],
+                            params["label_cols"], hvd.rank(), hvd.size())
+            opt = hvd.DistributedOptimizer(model.optimizer)
+            model.compile(optimizer=opt, loss=params["loss"] or model.loss,
+                          metrics=list(params["metrics"]))
+            callbacks = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                         hvd.callbacks.MetricAverageCallback()]
+            hist = model.fit(x, y, batch_size=params["batch_size"],
+                             epochs=params["epochs"],
+                             verbose=params["verbose"] if hvd.rank() == 0
+                             else 0, callbacks=callbacks)
+            if hvd.rank() == 0:
+                return _serialize_keras(model), hist.history
+            return None
+
+        results = spark_run(train, num_proc=self.num_proc, spark=spark)
+        trained_bytes, history = next(r for r in results if r is not None)
+        return KerasModel(trained_bytes, self.feature_cols, self.label_cols,
+                          self.custom_objects, history)
+
+
+class KerasModel:
+    """The fitted transformer (reference: KerasModel.transform)."""
+
+    def __init__(self, model_bytes, feature_cols, label_cols, custom_objects,
+                 history=None):
+        self._model_bytes = model_bytes
+        self.feature_cols = tuple(feature_cols)
+        self.label_cols = tuple(label_cols)
+        self.custom_objects = custom_objects
+        self.history = history
+        self._model = None
+
+    def getModel(self):
+        if self._model is None:
+            self._model = _deserialize_keras(self._model_bytes,
+                                             self.custom_objects)
+        return self._model
+
+    def transform(self, df):
+        model_bytes = self._model_bytes
+        feature_cols = self.feature_cols
+        custom_objects = self.custom_objects
+        out_col = self.label_cols[0] + "__output"
+
+        def predict(iterator):
+            model = _deserialize_keras(model_bytes, custom_objects)
+            for pdf in iterator:
+                x = np.stack([np.asarray(v, np.float32) for v in
+                              pdf[list(feature_cols)].to_numpy().tolist()])
+                if x.ndim == 3 and x.shape[1] == 1:
+                    x = x[:, 0]
+                pdf[out_col] = list(model.predict(x, verbose=0))
+                yield pdf
+
+        schema = df.schema.add(out_col, "array<float>")
+        return df.mapInPandas(predict, schema=schema)
+
+
+def _serialize_keras(model):
+    import io
+
+    import h5py
+
+    buf = io.BytesIO()
+    with h5py.File(buf, "w") as f:
+        model.save(f)
+    return buf.getvalue()
+
+
+def _deserialize_keras(blob, custom_objects=None):
+    import io
+
+    import h5py
+    import tensorflow as tf
+
+    with h5py.File(io.BytesIO(blob), "r") as f:
+        return tf.keras.models.load_model(
+            f, custom_objects=dict(custom_objects or {}))

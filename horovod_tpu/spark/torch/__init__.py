@@ -1,0 +1,121 @@
+"""Spark Torch estimator.
+
+Reference analog: ``horovod/spark/torch/estimator.py`` (TorchEstimator →
+TorchModel). Same staging flow as the Keras estimator: DataFrame →
+parquet in the store → ``horovod_tpu.spark.run`` training with the torch
+frontend's ``DistributedOptimizer`` → fitted transformer.
+"""
+
+import io
+
+import numpy as np
+
+from horovod_tpu.spark.common.params import EstimatorParams
+from horovod_tpu.spark.keras import _df_to_parquet, _load_np
+
+
+def _serialize_torch(model):
+    import torch
+
+    buf = io.BytesIO()
+    torch.save(model, buf)
+    return buf.getvalue()
+
+
+def _deserialize_torch(blob):
+    import torch
+
+    return torch.load(io.BytesIO(blob), weights_only=False)
+
+
+class TorchEstimator(EstimatorParams):
+    def __init__(self, **kwargs):
+        self.optimizer_factory = kwargs.pop("optimizer_factory", None)
+        super().__init__(**kwargs)
+
+    def fit(self, df, spark=None):
+        from horovod_tpu.spark import run as spark_run
+
+        if self.store is None:
+            raise ValueError("TorchEstimator needs a store= to stage data")
+        train_path = self.store.get_train_data_path(self.run_id)
+        _df_to_parquet(df, train_path, self.num_proc)
+
+        model_bytes = _serialize_torch(self.model)
+        loss_fn = self.loss
+        opt_factory = self.optimizer_factory
+        params = dict(
+            train_path=train_path, feature_cols=tuple(self.feature_cols),
+            label_cols=tuple(self.label_cols), batch_size=self.batch_size,
+            epochs=self.epochs)
+
+        def train():
+            import torch
+
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            model = _deserialize_torch(model_bytes)
+            x, y = _load_np(params["train_path"], params["feature_cols"],
+                            params["label_cols"], hvd.rank(), hvd.size())
+            x_t = torch.from_numpy(np.ascontiguousarray(x))
+            y_t = torch.from_numpy(np.ascontiguousarray(y))
+            base_opt = (opt_factory(model.parameters()) if opt_factory
+                        else torch.optim.SGD(model.parameters(), lr=0.01))
+            opt = hvd.DistributedOptimizer(
+                base_opt, named_parameters=model.named_parameters())
+            hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+            hvd.broadcast_optimizer_state(base_opt, root_rank=0)
+            criterion = loss_fn or torch.nn.MSELoss()
+            n = x_t.shape[0]
+            bs = params["batch_size"]
+            for _ in range(params["epochs"]):
+                for i in range(0, n, bs):
+                    opt.zero_grad()
+                    out = model(x_t[i:i + bs])
+                    loss = criterion(out, y_t[i:i + bs])
+                    loss.backward()
+                    opt.step()
+            if hvd.rank() == 0:
+                return _serialize_torch(model)
+            return None
+
+        results = spark_run(train, num_proc=self.num_proc, spark=spark)
+        trained = next(r for r in results if r is not None)
+        return TorchModel(trained, self.feature_cols, self.label_cols)
+
+
+class TorchModel:
+    def __init__(self, model_bytes, feature_cols, label_cols):
+        self._model_bytes = model_bytes
+        self.feature_cols = tuple(feature_cols)
+        self.label_cols = tuple(label_cols)
+        self._model = None
+
+    def getModel(self):
+        if self._model is None:
+            self._model = _deserialize_torch(self._model_bytes)
+        return self._model
+
+    def transform(self, df):
+        import torch
+
+        model_bytes = self._model_bytes
+        feature_cols = self.feature_cols
+        out_col = self.label_cols[0] + "__output"
+
+        def predict(iterator):
+            model = _deserialize_torch(model_bytes)
+            model.eval()
+            for pdf in iterator:
+                x = np.stack([np.asarray(v, np.float32) for v in
+                              pdf[list(feature_cols)].to_numpy().tolist()])
+                if x.ndim == 3 and x.shape[1] == 1:
+                    x = x[:, 0]
+                with torch.no_grad():
+                    out = model(torch.from_numpy(x)).numpy()
+                pdf[out_col] = list(out)
+                yield pdf
+
+        schema = df.schema.add(out_col, "array<float>")
+        return df.mapInPandas(predict, schema=schema)

@@ -1,0 +1,88 @@
+"""Spark/Ray integration units — the dependency-free planning pieces.
+
+Reference analog: test/single/test_ray.py + test_spark.py run against
+live local clusters; pyspark/ray aren't in this image, so we test every
+pure component (store, params, rank planning, import gating) and skip
+the cluster paths (the reference skips the same way when deps missing).
+"""
+
+import importlib.util
+
+import pytest
+
+HAS_SPARK = importlib.util.find_spec("pyspark") is not None
+HAS_RAY = importlib.util.find_spec("ray") is not None
+
+
+def test_filesystem_store_roundtrip(tmp_path):
+    from horovod_tpu.spark.common.store import FilesystemStore, Store
+
+    store = Store.create(str(tmp_path / "st"))
+    assert isinstance(store, FilesystemStore)
+    ckpt = store.get_checkpoint_path("run1")
+    store.write(ckpt, b"weights")
+    assert store.exists(ckpt)
+    assert store.read(ckpt) == b"weights"
+    assert store.get_train_data_path(3).endswith("intermediate_train_data.3")
+    assert "run1" in store.get_logs_path("run1")
+
+
+def test_store_sync_fn(tmp_path):
+    from horovod_tpu.spark.common.store import FilesystemStore
+
+    store = FilesystemStore(str(tmp_path / "st"))
+    local = tmp_path / "local"
+    local.mkdir()
+    (local / "ckpt.bin").write_bytes(b"x")
+    store.sync_fn("r1")(str(local))
+    assert (tmp_path / "st" / "runs" / "r1" / "ckpt.bin").read_bytes() == b"x"
+
+
+def test_estimator_params():
+    from horovod_tpu.spark.common.params import EstimatorParams
+
+    p = EstimatorParams(batch_size=64, epochs=3, label_cols=("y",))
+    assert p.batch_size == 64
+    assert p.getBatchSize() == 64          # pyspark.ml-style getter
+    assert p.getEpochs() == 3
+    with pytest.raises(TypeError, match="unknown"):
+        EstimatorParams(bogus=1)
+
+
+def test_ray_rank_planning():
+    from horovod_tpu.ray.runner import plan_ranks
+
+    envs = plan_ranks([(0, "a"), (1, "b"), (2, "a"), (3, "b")])
+    # contiguous ranks per host: a -> ranks 0,1 ; b -> ranks 2,3
+    assert envs[0]["HOROVOD_RANK"] == "0"
+    assert envs[2]["HOROVOD_RANK"] == "1"
+    assert envs[2]["HOROVOD_LOCAL_RANK"] == "1"
+    assert envs[1]["HOROVOD_CROSS_RANK"] == "1"
+    assert all(e["HOROVOD_SIZE"] == "4" for e in envs.values())
+    assert all(e["HOROVOD_LOCAL_SIZE"] == "2" for e in envs.values())
+
+
+def test_ray_strategy_bundles():
+    from horovod_tpu.ray.strategy import PackStrategy, SpreadStrategy
+
+    s = PackStrategy(4, cpus_per_worker=2, gpus_per_worker=1)
+    assert s.placement_strategy == "PACK"
+    assert s.bundles() == [{"CPU": 2, "GPU": 1}] * 4
+    assert SpreadStrategy(2).placement_strategy == "SPREAD"
+
+
+@pytest.mark.skipif(HAS_RAY, reason="ray installed")
+def test_ray_executor_gating():
+    from horovod_tpu.ray import RayExecutor
+
+    ex = RayExecutor(num_workers=2)
+    with pytest.raises(ImportError, match="ray"):
+        ex.start()
+
+
+@pytest.mark.skipif(HAS_SPARK, reason="pyspark installed")
+def test_spark_run_gating():
+    import horovod_tpu.spark as hs
+
+    with pytest.raises(ImportError, match="pyspark"):
+        hs.run(lambda: None, num_proc=2)

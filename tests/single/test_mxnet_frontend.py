@@ -1,0 +1,39 @@
+"""MXNet frontend import gating.
+
+mxnet is not installed in this image, so the testable surface is the
+reference-matching ImportError contract (horovod raises a clear error
+when an extension isn't available) plus compileability of the module
+source. With mxnet present, tests/parallel/test_torch_frontend.py's
+pattern applies unchanged (same eager core underneath).
+"""
+
+import importlib.util
+import pathlib
+import py_compile
+
+import pytest
+
+HAS_MXNET = importlib.util.find_spec("mxnet") is not None
+PKG = pathlib.Path(__file__).resolve().parents[2] / "horovod_tpu" / "mxnet"
+
+
+@pytest.mark.skipif(HAS_MXNET, reason="mxnet installed; gating not hit")
+def test_import_without_mxnet_raises_informative():
+    with pytest.raises(ImportError, match="mxnet"):
+        import horovod_tpu.mxnet  # noqa: F401
+
+
+def test_module_sources_compile():
+    for f in PKG.glob("*.py"):
+        py_compile.compile(str(f), doraise=True)
+
+
+@pytest.mark.skipif(not HAS_MXNET, reason="mxnet not installed")
+def test_single_rank_allreduce():
+    import mxnet as mx
+
+    import horovod_tpu.mxnet as hvd
+
+    hvd.init()
+    out = hvd.allreduce(mx.nd.ones((4,)), name="t", op=hvd.Sum)
+    assert out.asnumpy().tolist() == [1.0] * 4
