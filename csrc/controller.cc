@@ -27,15 +27,8 @@ bool ShapesMatch(const std::vector<int64_t>& a, const std::vector<int64_t>& b,
   return true;
 }
 
-// Byte size of a cached single-tensor response ([ndim, dims...] layout).
-int64_t CachedEntryBytes(const Response& r) {
-  int64_t elems = 1;
-  if (!r.tensor_shapes.empty()) {
-    int64_t ndim = r.tensor_shapes[0];
-    for (int64_t i = 0; i < ndim; i++) elems *= r.tensor_shapes[1 + i];
-  }
-  return elems * DataTypeSize(r.tensor_type);
-}
+// Byte size of a cached single-tensor response.
+int64_t CachedEntryBytes(const Response& r) { return ShapesTotalBytes(r); }
 
 // Shared fusion predicate for the cached and freshly-negotiated allreduce
 // paths — one site so the two fusion paths cannot diverge.

@@ -73,6 +73,33 @@ struct Response {
   int32_t last_joined_rank = -1;
 };
 
+// Decoders for Response::tensor_shapes's flattened [ndim, dims...] layout —
+// the one place that knows it (controller fusion accounting, response-cache
+// shape checks, and autotune scoring all decode through these).
+
+// Shape of the tensor starting at *pos; advances *pos past it.
+inline std::vector<int64_t> DecodeShapeAt(const Response& r, size_t* pos) {
+  std::vector<int64_t> shape;
+  if (*pos >= r.tensor_shapes.size()) return shape;
+  int64_t ndim = r.tensor_shapes[(*pos)++];
+  for (int64_t i = 0; i < ndim && *pos < r.tensor_shapes.size(); i++) {
+    shape.push_back(r.tensor_shapes[(*pos)++]);
+  }
+  return shape;
+}
+
+// Total payload bytes across every tensor encoded in the response.
+inline int64_t ShapesTotalBytes(const Response& r) {
+  int64_t total = 0;
+  size_t pos = 0;
+  while (pos < r.tensor_shapes.size()) {
+    int64_t elems = 1;
+    for (int64_t d : DecodeShapeAt(r, &pos)) elems *= d;
+    total += elems * DataTypeSize(r.tensor_type);
+  }
+  return total;
+}
+
 // Everything one worker sends the coordinator in one cycle.
 struct RequestList {
   std::vector<Request> requests;

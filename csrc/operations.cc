@@ -460,14 +460,7 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
 // Payload bytes a response moves (autotune scoring input).
 int64_t ResponseBytes(const Response& r) {
   if (r.response_type != Response::ResponseType::ALLREDUCE) return 0;
-  int64_t total = 0;
-  size_t pos = 0;
-  while (pos < r.tensor_shapes.size()) {
-    int64_t ndim = r.tensor_shapes[pos++], elems = 1;
-    for (int64_t d = 0; d < ndim; d++) elems *= r.tensor_shapes[pos++];
-    total += elems * DataTypeSize(r.tensor_type);
-  }
-  return total;
+  return ShapesTotalBytes(r);
 }
 
 void BackgroundThreadLoop(GlobalState& st) {

@@ -8,17 +8,10 @@ namespace hvdtpu {
 
 namespace {
 
-// Decode the first tensor's shape from a single-tensor response's flattened
-// [ndim, dims...] layout.
+// First tensor's shape in a single-tensor response.
 std::vector<int64_t> FirstShape(const Response& r) {
-  std::vector<int64_t> shape;
-  if (r.tensor_shapes.empty()) return shape;
-  int64_t ndim = r.tensor_shapes[0];
-  for (int64_t i = 0; i < ndim && (size_t)(1 + i) < r.tensor_shapes.size();
-       i++) {
-    shape.push_back(r.tensor_shapes[1 + i]);
-  }
-  return shape;
+  size_t pos = 0;
+  return DecodeShapeAt(r, &pos);
 }
 
 Response::ResponseType ExpectedType(RequestType t) {
@@ -103,13 +96,7 @@ void ResponseCache::InsertFromResponses(
     // Split a fused response into per-tensor cache entries.
     size_t shape_pos = 0;
     for (size_t i = 0; i < res.tensor_names.size(); i++) {
-      std::vector<int64_t> shape;
-      if (shape_pos < res.tensor_shapes.size()) {
-        int64_t ndim = res.tensor_shapes[shape_pos++];
-        for (int64_t d = 0; d < ndim; d++) {
-          shape.push_back(res.tensor_shapes[shape_pos++]);
-        }
-      }
+      std::vector<int64_t> shape = DecodeShapeAt(res, &shape_pos);
       std::string key = KeyOf(res.tensor_names[i], res.process_set_id);
       if (index_.count(key)) continue;  // already cached (shouldn't happen)
       int32_t pos;

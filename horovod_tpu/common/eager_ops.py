@@ -61,7 +61,12 @@ def _shape_array(shape):
 
 
 def _as_contig(array):
-    arr = np.ascontiguousarray(array)
+    # NOT np.ascontiguousarray: that promotes 0-d arrays to 1-d, breaking
+    # scalar allreduce round-trip shape (hvd.allreduce(scalar) must return
+    # a scalar, as the reference does).
+    arr = np.asarray(array)
+    if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
     return arr
 
 

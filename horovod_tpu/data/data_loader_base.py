@@ -72,11 +72,22 @@ class AsyncDataLoaderMixin:
         # q/shutdown are THIS epoch's objects: a zombie from a timed-out
         # close cannot observe the next epoch's state.
         try:
+            interrupted = False
             for batch in super()._iterate():
                 if shutdown.is_set():
-                    return
+                    interrupted = True
+                    break
                 q.put((batch, None))
-            q.put((None, StopIteration()))
+            if not interrupted:
+                q.put((None, StopIteration()))
+            else:
+                # Best-effort sentinel after an early shutdown: a consumer
+                # resumed post-close still terminates via its timed get
+                # even if the queue was full here.
+                try:
+                    q.put_nowait((None, StopIteration()))
+                except queue.Full:
+                    pass
         except Exception as e:  # noqa: BLE001 — surface in the consumer
             q.put((None, e))
 
@@ -93,7 +104,15 @@ class AsyncDataLoaderMixin:
         thread.start()
         try:
             while True:
-                batch, err = q.get()
+                try:
+                    batch, err = q.get(timeout=0.1)
+                except queue.Empty:
+                    # Timed get (not a bare blocking get) so a consumer
+                    # resumed after close_async_loader() terminates even if
+                    # the producer's sentinel was drained by the close.
+                    if shutdown.is_set():
+                        return
+                    continue
                 if err is not None:
                     if isinstance(err, StopIteration):
                         return

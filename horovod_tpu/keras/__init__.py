@@ -39,55 +39,71 @@ from horovod_tpu.tensorflow import (  # noqa: F401
 from horovod_tpu.keras import callbacks  # noqa: F401
 
 
-class DistributedOptimizer:
-    """Wrap a keras optimizer: gradients are allreduce-averaged across
-    ranks before ``apply_gradients``.
+class _DistributedOptimizer:
+    """Method bodies grafted onto a dynamic subclass of the wrapped
+    optimizer's own class — so ``model.compile(optimizer=...)`` sees a
+    genuine keras optimizer (reference: horovod/_keras/__init__.py
+    create_distributed_optimizer's ``cls = type(...)`` trick)."""
 
-    Reference analog: hvd.DistributedOptimizer
-    (horovod/_keras/__init__.py create_distributed_optimizer). Wrapping
-    is by composition + delegation so it works across keras optimizer API
-    generations.
-    """
-
-    def __init__(self, optimizer, compression=Compression.none, op=Average,
-                 backward_passes_per_step=1):
-        if backward_passes_per_step != 1:
-            raise NotImplementedError(
-                "backward_passes_per_step > 1 for keras lands with the "
-                "gradient-aggregation helper")
-        self._opt = optimizer
-        self._compression = compression
-        self._op = op
-
-    def __getattr__(self, item):
-        return getattr(self._opt, item)
-
-    def _allreduce(self, grads):
+    def _hvd_allreduce(self, grads):
         from horovod_tpu.tensorflow import mpi_ops
 
         compressed, ctxs = [], []
         for g in grads:
             if isinstance(g, tf.IndexedSlices):
                 g = tf.convert_to_tensor(g)
-            c, ctx = self._compression.compress(g)
+            c, ctx = self._hvd_compression.compress(g)
             compressed.append(c)
             ctxs.append(ctx)
         reduced = mpi_ops.grouped_allreduce(
             compressed, names=[f"keras.grad.{i}"
                                for i in range(len(compressed))],
-            op=self._op)
-        return [self._compression.decompress(r, ctx)
+            op=self._hvd_op)
+        return [self._hvd_compression.decompress(r, ctx)
                 for r, ctx in zip(reduced, ctxs)]
+
+    # Exactly ONE of these is grafted onto the subclass (see
+    # DistributedOptimizer below): keras 3's BaseOptimizer.apply_gradients
+    # delegates to self.apply(), so overriding both would allreduce twice
+    # (harmlessly-looking with Average, wrong by a factor of size with Sum).
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         grads_and_vars = list(grads_and_vars)
-        grads = self._allreduce([g for g, _ in grads_and_vars])
-        return self._opt.apply_gradients(
+        grads = self._hvd_allreduce([g for g, _ in grads_and_vars])
+        return super(self.__class__, self).apply_gradients(
             zip(grads, [v for _, v in grads_and_vars]), **kwargs)
 
-    # keras 3 calls optimizer.apply(grads, vars)
     def apply(self, grads, variables=None, **kwargs):
-        grads = self._allreduce(list(grads))
+        grads = self._hvd_allreduce(list(grads))
         if variables is None:
-            return self._opt.apply(grads, **kwargs)
-        return self._opt.apply(grads, variables, **kwargs)
+            return super(self.__class__, self).apply(grads, **kwargs)
+        return super(self.__class__, self).apply(grads, variables, **kwargs)
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none, op=Average,
+                         backward_passes_per_step=1):
+    """Wrap a keras optimizer: gradients are allreduce-averaged across
+    ranks before apply.
+
+    Returns an instance of a dynamically-created subclass of
+    ``type(optimizer)``, rebuilt from its config — so it passes keras's
+    optimizer checks everywhere (compile, serialization), exactly like the
+    reference's create_distributed_optimizer.
+    """
+    if backward_passes_per_step != 1:
+        raise NotImplementedError(
+            "backward_passes_per_step > 1 for keras lands with the "
+            "gradient-aggregation helper")
+    members = {"_hvd_allreduce": _DistributedOptimizer._hvd_allreduce}
+    if hasattr(optimizer, "apply"):
+        # keras 3: apply() is the single grad-application chokepoint
+        # (apply_gradients delegates to it) — override only it.
+        members["apply"] = _DistributedOptimizer.apply
+    else:
+        # keras 2 family: apply_gradients is the chokepoint.
+        members["apply_gradients"] = _DistributedOptimizer.apply_gradients
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,), members)
+    dist = cls.from_config(optimizer.get_config())
+    dist._hvd_compression = compression
+    dist._hvd_op = op
+    return dist

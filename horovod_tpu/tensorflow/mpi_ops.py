@@ -56,7 +56,12 @@ def _to_np(tensor):
         arr = tensor.numpy()
     else:
         arr = np.asarray(tensor)
-    return np.ascontiguousarray(arr)
+    # Not np.ascontiguousarray: it promotes 0-d to 1-d and scalar
+    # variables (e.g. an optimizer's iteration counter) must round-trip
+    # shape-exact through broadcast.
+    if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    return arr
 
 
 def _run_numpy(fn, tensor, out_dtype=None):
@@ -85,13 +90,33 @@ def grouped_allreduce(tensors, names=None, op=Average, process_set_id=0):
     if names is None:
         base = _auto_name("grouped_allreduce")
         names = [f"{base}.{i}" for i in range(len(tensors))]
-    arrs = [_to_np(t) for t in tensors]
-    if arrs and all(a.dtype == arrs[0].dtype for a in arrs):
-        handles = eager_ops.grouped_allreduce_async(
-            arrs, names, op=op, process_set_id=process_set_id)
-        return [tf.convert_to_tensor(h.synchronize()) for h in handles]
-    return [allreduce(t, n, op=op, process_set_id=process_set_id)
-            for t, n in zip(tensors, names)]
+
+    def _grouped_np(arrs):
+        if arrs and all(a.dtype == arrs[0].dtype for a in arrs):
+            handles = eager_ops.grouped_allreduce_async(
+                arrs, names, op=op, process_set_id=process_set_id)
+            return [h.synchronize() for h in handles]
+        return [eager_ops.allreduce_async(
+                    a, n, op=op,
+                    process_set_id=process_set_id).synchronize()
+                for a, n in zip(arrs, names)]
+
+    symbolic = (not tf.executing_eagerly()
+                or any(not hasattr(t, "numpy") for t in tensors))
+    if symbolic:
+        # Inside tf.function (keras model.fit's train_step): one
+        # py_function hop for the whole group keeps them fusing as one
+        # negotiation, mirroring the eager path.
+        outs = tf.py_function(
+            lambda *ts: _grouped_np([_to_np(t) for t in ts]),
+            list(tensors), Tout=[t.dtype for t in tensors])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for o, t in zip(outs, tensors):
+            o.set_shape(t.shape)
+        return list(outs)
+    return [tf.convert_to_tensor(r)
+            for r in _grouped_np([_to_np(t) for t in tensors])]
 
 
 def allgather(tensor, name=None, process_set_id=0):

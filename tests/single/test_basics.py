@@ -81,3 +81,21 @@ def test_uninitialized_rank_raises():
     if not b.is_initialized():
         with pytest.raises(ValueError):
             b.rank()
+
+
+def test_scalar_allreduce_preserves_0d(hvd_core):
+    """Regression: np.ascontiguousarray promotes 0-d to 1-d; a scalar
+    allreduce must round-trip shape-exact (reference semantics)."""
+    import numpy as np
+
+    from horovod_tpu.common import eager_ops
+
+    out = eager_ops.allreduce_async(
+        np.asarray(3.0, np.float32), "scalar0d").synchronize()
+    assert out.shape == ()
+    assert out == 3.0
+    # Non-contiguous input still works (the contiguity path).
+    base = np.arange(10, dtype=np.float32)[::2]
+    out2 = eager_ops.allreduce_async(base, "strided").synchronize()
+    assert out2.shape == (5,)
+    assert np.array_equal(out2, base)

@@ -54,15 +54,23 @@ def test_async_prefetch_overlaps():
 
 
 def test_async_error_propagates():
-    class Boom(AsyncDataLoaderMixin, BaseDataLoader):
+    # The failing _iterate goes on the BASE class: defining it on the
+    # mixed class would shadow AsyncDataLoaderMixin._iterate in the MRO
+    # and bypass the producer thread entirely.
+    class BoomBase(BaseDataLoader):
         def _iterate(self):
             yield 1
             raise ValueError("bad batch")
 
-    it = iter(Boom())
+    class Boom(AsyncDataLoaderMixin, BoomBase):
+        pass
+
+    loader = Boom()
+    it = iter(loader)
     assert next(it) == 1
     with pytest.raises(ValueError, match="bad batch"):
         list(it)
+    assert loader._thread is not None or loader._queue is None  # async ran
 
 
 def test_close_mid_epoch():
