@@ -1,0 +1,75 @@
+"""Elastic (fault-tolerant) training with horovod_tpu.jax.elastic.
+
+Reference analog: examples/elastic/pytorch/pytorch_mnist_elastic.py —
+wrap the train loop in @hvd.elastic.run with a State; on worker
+loss/addition the loop rolls back to the last commit and resumes with the
+new world size.
+
+Run (hosts can come and go between polls):
+  horovodrun -np 2 --min-np 1 --max-np 4 \
+      --host-discovery-script ./discover_hosts.sh \
+      python examples/elastic/jax_elastic_mnist.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.models import mlp_forward, mlp_init
+
+
+def main():
+    hvd.init()
+
+    rng = np.random.RandomState(42)
+    data_x = rng.rand(4096, 784).astype(np.float32)
+    data_y = rng.randint(0, 10, 4096).astype(np.int32)
+
+    params = mlp_init(jax.random.PRNGKey(0), sizes=(784, 64, 10))
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+    state = hvd.elastic.JaxState(
+        params=params, opt_state=opt.init(params), epoch=0, batch=0)
+
+    def loss_fn(p, xb, yb):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            mlp_forward(p, xb), yb).mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    @hvd.elastic.run
+    def train(state):
+        bs = 64
+        while state.epoch < 3:
+            # Re-shard for the CURRENT world size each generation.
+            x = data_x[hvd.rank()::hvd.size()]
+            y = data_y[hvd.rank()::hvd.size()]
+            n_batches = x.shape[0] // bs
+            while state.batch < n_batches:
+                i = state.batch * bs
+                loss, grads = grad_fn(state.params,
+                                      jnp.asarray(x[i:i + bs]),
+                                      jnp.asarray(y[i:i + bs]))
+                updates, state.opt_state = opt.update(
+                    grads, state.opt_state, state.params)
+                state.params = optax.apply_updates(state.params, updates)
+                state.batch += 1
+                if state.batch % 20 == 0:
+                    # Checkpoint progress: rollback target after a failure.
+                    state.commit()
+                    if hvd.rank() == 0:
+                        print(f"epoch {state.epoch} batch {state.batch} "
+                              f"np={hvd.size()} loss {float(loss):.4f}")
+            state.batch = 0
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+    if hvd.rank() == 0:
+        print("elastic training finished")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Sharded llama-family pretraining — the flagship SPMD path.
+
+This is the TPU-native side of the framework (net-new vs the reference,
+which is pure data-parallel — SURVEY.md §5.7): a 4-axis
+data/fsdp/tensor/seq ``jax.sharding.Mesh``, megatron-style TP + FSDP
+parameter shardings, ring attention over the seq axis for long context,
+and one jitted train step that XLA turns into fused compute+collectives
+over ICI.
+
+Run on anything (CPU simulates a mesh):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/jax/jax_llama_pretrain.py --dp 2 --fsdp 2 --tp 2 --sp 1
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu import parallel
+from horovod_tpu.models import (
+    LlamaConfig,
+    llama_init,
+    llama_loss,
+    llama_partition_rules,
+)
+from horovod_tpu.parallel.sharding import apply_sharding, named_sharding
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2, help="data-parallel size")
+    ap.add_argument("--fsdp", type=int, default=2, help="fsdp shards")
+    ap.add_argument("--tp", type=int, default=2, help="tensor parallel")
+    ap.add_argument("--sp", type=int, default=1, help="sequence parallel")
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--n-layers", type=int, default=4)
+    args = ap.parse_args()
+
+    n_needed = args.dp * args.fsdp * args.tp * args.sp
+    if len(jax.devices()) < n_needed:
+        raise SystemExit(
+            f"need {n_needed} devices, have {len(jax.devices())} "
+            f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+
+    mesh = parallel.create_mesh(data=args.dp, fsdp=args.fsdp,
+                                tensor=args.tp, seq=args.sp,
+                                devices=jax.devices()[:n_needed])
+
+    heads = max(8, args.tp * 2)
+    cfg = LlamaConfig.tiny(
+        d_model=args.d_model, n_layers=args.n_layers, n_heads=heads,
+        n_kv_heads=heads, d_ff=4 * args.d_model, vocab_size=512)
+
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    shardings = parallel.shard_params(params, mesh, llama_partition_rules())
+    params = apply_sharding(params, shardings)
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    batch_size = 2 * args.dp * args.fsdp
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(llama_loss)(params, batch, cfg,
+                                                     mesh)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        tokens = jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (batch_size, args.seq_len)),
+            jnp.int32)
+        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+        batch = jax.device_put(
+            batch, named_sharding(mesh, ("data", "fsdp"), "seq"))
+        loss, params, opt_state = train_step(params, opt_state, batch)
+        print(f"step {step} mesh={dict(mesh.shape)} loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

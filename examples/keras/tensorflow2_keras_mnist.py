@@ -1,0 +1,54 @@
+"""Data-parallel Keras MNIST with horovod_tpu.keras.
+
+Reference analog: examples/tensorflow2/tensorflow2_keras_mnist.py —
+DistributedOptimizer wrap + the canonical callback trio (broadcast,
+metric averaging, LR warmup).
+
+Run:  horovodrun -np 2 python examples/keras/tensorflow2_keras_mnist.py
+"""
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    hvd.init()
+    tf.random.set_seed(1234)
+
+    rng = np.random.RandomState(42)
+    x = rng.rand(4096, 784).astype(np.float32)
+    y = rng.randint(0, 10, 4096).astype(np.int64)
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(128, activation="relu", input_shape=(784,)),
+        tf.keras.layers.Dense(10),
+    ])
+    base_lr = 0.01
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(base_lr * hvd.size()))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"])
+
+    callbacks = [
+        # Sync everyone to rank 0's weights before the first batch.
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        # Average epoch metrics across workers.
+        hvd.callbacks.MetricAverageCallback(),
+        # Ramp LR from base to base*size over the first 3 epochs.
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=base_lr * hvd.size(), warmup_epochs=3, verbose=0),
+    ]
+
+    model.fit(x, y, batch_size=64, epochs=4,
+              callbacks=callbacks,
+              verbose=2 if hvd.rank() == 0 else 0)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
