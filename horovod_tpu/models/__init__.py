@@ -14,3 +14,17 @@ from horovod_tpu.models.llama import (  # noqa: F401
     llama_partition_rules,
 )
 from horovod_tpu.models.mlp import mlp_forward, mlp_init  # noqa: F401
+from horovod_tpu.models.resnet import (  # noqa: F401
+    ResNetConfig,
+    resnet_forward,
+    resnet_init,
+    resnet_loss,
+    resnet_partition_rules,
+)
+from horovod_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    bert_forward,
+    bert_init,
+    bert_mlm_loss,
+    bert_partition_rules,
+)

@@ -1,0 +1,183 @@
+"""BERT-family encoder, TPU-first.
+
+Reference analog: the reference's transformer benchmark workload (its
+examples tree trains BERT via the framework frontends). Same design
+stance as ``llama.py``: functional params pytree, scan-over-layers with
+remat, bf16 compute / f32 master weights, megatron TP + FSDP partition
+rules. Bidirectional (non-causal) attention with an additive padding
+mask; learned position embeddings; MLM head tied to the token embedding.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_position: int = 512
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    norm_eps: float = 1e-12
+    dtype: str = "bfloat16"
+    remat: bool = True
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def large():
+        return BertConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)
+
+    @staticmethod
+    def tiny(**kw):
+        defaults = dict(vocab_size=256, max_position=128, d_model=64,
+                        n_layers=2, n_heads=4, d_ff=128)
+        defaults.update(kw)
+        return BertConfig(**defaults)
+
+
+def bert_init(config, key):
+    c = config
+    k = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5))
+
+    L = c.n_layers
+    return {
+        "embed": jax.random.normal(next(k), (c.vocab_size, c.d_model),
+                                   jnp.float32) * 0.02,
+        "pos_embed": jax.random.normal(next(k), (c.max_position, c.d_model),
+                                       jnp.float32) * 0.02,
+        "embed_norm": {"scale": jnp.ones(c.d_model),
+                       "bias": jnp.zeros(c.d_model)},
+        "layers": {
+            "attn_norm_scale": jnp.ones((L, c.d_model)),
+            "attn_norm_bias": jnp.zeros((L, c.d_model)),
+            "wq": dense(next(k), (L, c.d_model, c.d_model), c.d_model),
+            "wk": dense(next(k), (L, c.d_model, c.d_model), c.d_model),
+            "wv": dense(next(k), (L, c.d_model, c.d_model), c.d_model),
+            "wo": dense(next(k), (L, c.d_model, c.d_model), c.d_model),
+            "mlp_norm_scale": jnp.ones((L, c.d_model)),
+            "mlp_norm_bias": jnp.zeros((L, c.d_model)),
+            "w_in": dense(next(k), (L, c.d_model, c.d_ff), c.d_model),
+            "b_in": jnp.zeros((L, c.d_ff)),
+            "w_out": dense(next(k), (L, c.d_ff, c.d_model), c.d_ff),
+            "b_out": jnp.zeros((L, c.d_model)),
+        },
+        "mlm_norm": {"scale": jnp.ones(c.d_model),
+                     "bias": jnp.zeros(c.d_model)},
+        "mlm_dense": dense(next(k), (c.d_model, c.d_model), c.d_model),
+        "mlm_bias": jnp.zeros(c.vocab_size),  # head weights tied to embed
+    }
+
+
+def bert_partition_rules():
+    """Megatron TP + FSDP rules (same scheme as llama)."""
+    return [
+        (r"pos_embed", P(None, "fsdp")),
+        (r"^embed$", P("tensor", "fsdp")),
+        (r".*norm.*", P()),
+        (r"layers/w[qkv]$", P(None, "fsdp", "tensor")),
+        (r"layers/wo", P(None, "tensor", "fsdp")),
+        (r"layers/w_in", P(None, "fsdp", "tensor")),
+        (r"layers/b_in", P(None, "tensor")),
+        (r"layers/w_out", P(None, "tensor", "fsdp")),
+        (r"layers/b_out", P(None, None)),
+        (r"mlm_dense", P("fsdp", "tensor")),
+        (r"mlm_bias", P("tensor")),
+    ]
+
+
+def _layernorm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _encoder_attention(q, k, v, mask_bias):
+    """Bidirectional softmax attention. q,k,v [B,T,H,D]; mask_bias
+    [B,1,1,T] additive (-inf on padding)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (d ** 0.5)
+    scores = scores.astype(jnp.float32) + mask_bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def bert_forward(params, tokens, config, attention_mask=None, mesh=None):
+    """tokens [B,T] int32 -> MLM logits [B,T,vocab] (f32).
+
+    ``attention_mask`` [B,T] with 1 = real token, 0 = padding.
+    """
+    c = config
+    dt = c.compute_dtype
+    B, T = tokens.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((B, T), jnp.int32)
+    # Finite bias (not -inf): a fully-padded row (ragged final batch) must
+    # softmax to uniform garbage that the loss masks out, not to NaN.
+    mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                          -1e30).astype(jnp.float32)
+
+    h = params["embed"][tokens] + params["pos_embed"][None, :T]
+    h = _layernorm(h.astype(dt), params["embed_norm"]["scale"],
+                   params["embed_norm"]["bias"], c.norm_eps)
+
+    def layer(h, lp):
+        hn = _layernorm(h, lp["attn_norm_scale"], lp["attn_norm_bias"],
+                        c.norm_eps)
+        q = (hn @ lp["wq"].astype(dt)).reshape(B, T, c.n_heads, c.head_dim)
+        k = (hn @ lp["wk"].astype(dt)).reshape(B, T, c.n_heads, c.head_dim)
+        v = (hn @ lp["wv"].astype(dt)).reshape(B, T, c.n_heads, c.head_dim)
+        attn = _encoder_attention(q, k, v, mask_bias)
+        h = h + attn.reshape(B, T, c.d_model) @ lp["wo"].astype(dt)
+        hn = _layernorm(h, lp["mlp_norm_scale"], lp["mlp_norm_bias"],
+                        c.norm_eps)
+        ff = jax.nn.gelu(hn @ lp["w_in"].astype(dt) + lp["b_in"].astype(dt))
+        h = h + (ff @ lp["w_out"].astype(dt) + lp["b_out"].astype(dt))
+        return h, None
+
+    body = layer
+    if c.remat:
+        body = jax.checkpoint(layer)
+    h, _ = lax.scan(body, h, params["layers"])
+
+    # MLM head: dense + norm, decode against the tied embedding.
+    h = jax.nn.gelu(h @ params["mlm_dense"].astype(dt))
+    h = _layernorm(h, params["mlm_norm"]["scale"], params["mlm_norm"]["bias"],
+                   c.norm_eps)
+    logits = h.astype(jnp.float32) @ params["embed"].T + params["mlm_bias"]
+    return logits
+
+
+def bert_mlm_loss(params, batch, config, mesh=None):
+    """Masked-LM loss. batch = {"tokens": [B,T] (with [MASK] ids),
+    "targets": [B,T] original ids, "mlm_mask": [B,T] 1 where predicted,
+    optional "attention_mask": [B,T]}."""
+    logits = bert_forward(params, batch["tokens"], config,
+                          attention_mask=batch.get("attention_mask"),
+                          mesh=mesh)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, batch["targets"][..., None], -1)[..., 0]
+    m = batch["mlm_mask"].astype(jnp.float32)
+    return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)

@@ -24,10 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from horovod_tpu.parallel.ring_attention import (
-    blockwise_attention,
-    ring_self_attention,
-)
+from horovod_tpu.parallel.ring_attention import ring_self_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,7 +140,12 @@ def _attention(q, k, v, mesh, seq_axis):
         return ring_self_attention(q, k, v, mesh, causal=True,
                                    batch_axis=("data", "fsdp"),
                                    seq_axis=seq_axis)
-    return blockwise_attention(q, k, v, causal=True)
+    # Pallas flash kernel on TPU (no T^2 score materialization, so the
+    # layer no longer needs full remat for memory); flash_attention
+    # itself falls back to blockwise_attention off-TPU.
+    from horovod_tpu.ops import flash_attention
+
+    return flash_attention(q, k, v, causal=True)
 
 
 def _activation_spec(mesh):

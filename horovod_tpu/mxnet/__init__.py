@@ -52,6 +52,23 @@ from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
 )
 
 
+def _ps_size(process_set):
+    """Size of the process set — the world's for id 0, the subgroup's for
+    a ProcessSet object OR a plain integer id (both are accepted wherever
+    an id is expected, so both must scale gradients correctly)."""
+    if hasattr(process_set, "size"):
+        return process_set.size()
+    ps_id = int(process_set)
+    if ps_id == 0:
+        return size()
+    from horovod_tpu.common.basics import HorovodBasics
+
+    n = HorovodBasics().lib.hvdtpu_process_set_size(ps_id)
+    if n < 0:
+        raise ValueError(f"unknown process set id {ps_id}")
+    return n
+
+
 def broadcast_parameters(params, root_rank=0, prefix=""):
     """Broadcast a gluon ``ParameterDict`` / plain dict of NDArrays from
     ``root_rank`` (reference: horovod/mxnet broadcast_parameters)."""
@@ -84,13 +101,13 @@ class DistributedOptimizer(mx.optimizer.Optimizer):
         return getattr(self._optimizer, item)
 
     def _do_allreduce(self, index, grad):
-        if size(self._process_set_id) == 1:
+        if _ps_size(self._process_set_id) == 1:
             return
         # Predivide splits the averaging around the wire to control fp16
         # range: Sum with prescale 1/f and postscale f/size nets to an
         # exact average for any f (reference passes the same pair).
         f = self._gradient_predivide_factor
-        pre, post = 1.0 / f, f / size(self._process_set_id)
+        pre, post = 1.0 / f, f / _ps_size(self._process_set_id)
         if isinstance(index, (tuple, list)):
             if self._num_groups > 0:
                 names = [f"gradient.{i}" for i in index]
@@ -142,10 +159,10 @@ class DistributedTrainer(mx.gluon.Trainer):
         # Trainer applies rescale_grad itself: fold the 1/size of the
         # average there, and run the wire collective as a pre/post-scaled
         # Sum (net scale 1) so any predivide factor cancels exactly.
-        self._scale /= size(process_set_id)
+        self._scale /= _ps_size(process_set_id)
 
     def _allreduce_grads(self):
-        if size(self._hvd_process_set_id) == 1:
+        if _ps_size(self._hvd_process_set_id) == 1:
             return
         f = self._gradient_predivide_factor
         for i, param in enumerate(self._params):

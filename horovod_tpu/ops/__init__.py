@@ -1,0 +1,10 @@
+"""horovod_tpu.ops — pallas TPU kernels for the hot ops.
+
+Reference analog: the reference's CUDA kernels
+(``horovod/common/ops/cuda_kernels.cu`` — batched memcpy/scale); on TPU
+the equivalent hand-written layer is pallas kernels for ops XLA doesn't
+schedule optimally by itself. Flash attention is the flagship: it
+removes the T² score materialization that otherwise forces full remat.
+"""
+
+from horovod_tpu.ops.flash_attention import flash_attention  # noqa: F401

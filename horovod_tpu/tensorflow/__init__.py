@@ -48,6 +48,9 @@ from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
     start_timeline,
     stop_timeline,
 )
+from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: F401
+    SyncBatchNormalization,
+)
 
 
 class DistributedGradientTape:

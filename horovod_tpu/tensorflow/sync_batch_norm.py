@@ -1,0 +1,112 @@
+"""Cross-rank synchronized batch normalization for TF/Keras.
+
+Reference analog: ``horovod/tensorflow/sync_batch_norm.py``
+(SyncBatchNormalization): batch moments are computed over the GLOBAL
+batch — per-rank sums of x and x² are allreduce-summed before
+normalization — so data-parallel training with small per-rank batches
+behaves like one large batch.
+"""
+
+import tensorflow as tf
+
+
+class SyncBatchNormalization(tf.keras.layers.Layer):
+    """Drop-in BatchNormalization whose training-time moments span all
+    ranks (channels-last; normalizes over every axis but the last)."""
+
+    _counter = 0
+
+    def __init__(self, momentum=0.99, epsilon=1e-3, center=True, scale=True,
+                 process_set_id=0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.center = center
+        self.scale = scale
+        self.process_set_id = process_set_id
+        self._hvd_name = f"sync_bn.{SyncBatchNormalization._counter}"
+        SyncBatchNormalization._counter += 1
+
+    def build(self, input_shape):
+        c = int(input_shape[-1])
+        self.gamma = self.add_weight(name="gamma", shape=(c,),
+                                     initializer="ones",
+                                     trainable=self.scale)
+        self.beta = self.add_weight(name="beta", shape=(c,),
+                                    initializer="zeros",
+                                    trainable=self.center)
+        self.moving_mean = self.add_weight(name="moving_mean", shape=(c,),
+                                           initializer="zeros",
+                                           trainable=False)
+        self.moving_variance = self.add_weight(name="moving_variance",
+                                               shape=(c,),
+                                               initializer="ones",
+                                               trainable=False)
+        super().build(input_shape)
+
+    def _global_moments(self, x):
+        from horovod_tpu.tensorflow import mpi_ops
+
+        axes = list(range(x.shape.rank - 1))
+        n_local = tf.cast(
+            tf.reduce_prod([tf.shape(x)[a] for a in axes]), tf.float32)
+        local_sum = tf.reduce_sum(x, axis=axes)
+        local_sq = tf.reduce_sum(tf.square(x), axis=axes)
+        # process_set_id may be a ProcessSet object (it carries the
+        # subgroup size) or the world id 0.
+        ps_size = (self.process_set_id.size()
+                   if hasattr(self.process_set_id, "size")
+                   else mpi_ops.size())
+        if ps_size > 1:
+            # One fused negotiation for [sum, sum_sq, count].
+            packed = tf.concat(
+                [local_sum, local_sq, tf.reshape(n_local, [1])], axis=0)
+            packed = mpi_ops.allreduce(
+                packed, name=self._hvd_name, op=mpi_ops.Sum,
+                process_set_id=self.process_set_id)
+            # In graph mode the collective rides a py_function whose
+            # output rank is unknown; restore it so downstream
+            # (moving-stat assigns) see static [C] shapes.
+            c = int(local_sum.shape[0])
+            packed = tf.ensure_shape(packed, [2 * c + 1])
+            g_sum, g_sq, g_n = (packed[:c], packed[c:2 * c], packed[-1])
+        else:
+            g_sum, g_sq, g_n = local_sum, local_sq, n_local
+        mean = g_sum / g_n
+        var = g_sq / g_n - tf.square(mean)
+        return mean, var
+
+    def call(self, inputs, training=None):
+        x = tf.cast(inputs, tf.float32)
+        if training is None:
+            training = False
+
+        def train_moments():
+            mean, var = self._global_moments(x)
+            self.moving_mean.assign(
+                self.momentum * self.moving_mean + (1 - self.momentum) * mean)
+            self.moving_variance.assign(
+                self.momentum * self.moving_variance
+                + (1 - self.momentum) * var)
+            return mean, var
+
+        def infer_moments():
+            return (tf.identity(self.moving_mean),
+                    tf.identity(self.moving_variance))
+
+        # training may be a symbolic tensor under tf.function/Keras graph
+        # mode — branch with smart_cond, not Python `if`.
+        mean, var = tf.__internal__.smart_cond.smart_cond(
+            training, train_moments, infer_moments)
+        y = (x - mean) * tf.math.rsqrt(var + self.epsilon)
+        y = y * self.gamma + self.beta
+        return tf.cast(y, inputs.dtype)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(momentum=self.momentum, epsilon=self.epsilon,
+                   center=self.center, scale=self.scale,
+                   # ProcessSet objects aren't JSON-serializable; persist
+                   # the integer id (rebinding is on the loader).
+                   process_set_id=int(self.process_set_id))
+        return cfg
