@@ -118,6 +118,10 @@ class Status {
 struct TensorTableEntry {
   std::string name;
   int32_t handle = -1;
+  // 1 = accelerator-resident tensor: the registered device data plane
+  // (XLA executable over ICI) executes it; input/output stay null and the
+  // payload never touches these host pointers.
+  int32_t device = 0;
   const void* input = nullptr;   // caller-owned input buffer
   void* output = nullptr;        // caller-owned output buffer (allreduce)
   std::vector<int64_t> shape;

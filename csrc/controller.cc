@@ -33,8 +33,12 @@ int64_t CachedEntryBytes(const Response& r) { return ShapesTotalBytes(r); }
 // Shared fusion predicate for the cached and freshly-negotiated allreduce
 // paths — one site so the two fusion paths cannot diverge.
 bool FusableAllreducePair(DataType dtype_a, int32_t ps_a, ReduceOp op_a,
-                          DataType dtype_b, int32_t ps_b, ReduceOp op_b) {
-  return dtype_a == dtype_b && ps_a == ps_b && op_a == op_b;
+                          int32_t dev_a, DataType dtype_b, int32_t ps_b,
+                          ReduceOp op_b, int32_t dev_b) {
+  // Host and device tensors never share a fused group: the former moves
+  // through the host ring, the latter through one XLA program.
+  return dtype_a == dtype_b && ps_a == ps_b && op_a == op_b &&
+         dev_a == dev_b;
 }
 
 }  // namespace
@@ -217,6 +221,7 @@ Response Controller::BuildResponse(const std::string& key) {
   res.reduce_op = first.reduce_op;
   res.root_rank = first.root_rank;
   res.process_set_id = first.process_set_id;
+  res.device = first.device;
   res.tensor_shapes.push_back((int64_t)first.tensor_shape.size());
   res.tensor_shapes.insert(res.tensor_shapes.end(),
                            first.tensor_shape.begin(),
@@ -265,6 +270,8 @@ Response Controller::BuildResponse(const std::string& key) {
       err = "mismatched tensor dtypes across ranks";
     } else if (req.process_set_id != first.process_set_id) {
       err = "mismatched process sets across ranks";
+    } else if (req.device != first.device) {
+      err = "mismatched device placement across ranks";
     } else if (req.request_type == RequestType::ALLREDUCE ||
                req.request_type == RequestType::BROADCAST ||
                req.request_type == RequestType::REDUCESCATTER) {
@@ -354,8 +361,9 @@ ResponseList Controller::FuseResponses() {
         const Request& nreq = npt.requests.front();
         if (nreq.request_type != RequestType::ALLREDUCE ||
             !FusableAllreducePair(nreq.tensor_type, nreq.process_set_id,
-                                  nreq.reduce_op, first.tensor_type,
-                                  first.process_set_id, first.reduce_op)) {
+                                  nreq.reduce_op, nreq.device,
+                                  first.tensor_type, first.process_set_id,
+                                  first.reduce_op, first.device)) {
           break;
         }
         Response nres = BuildResponse(next_key);
@@ -481,8 +489,9 @@ void Controller::CollectCacheHits(ResponseList* list) {
         const Response& rn = cache_.Get(completed[i + group]);
         if (rn.response_type != Response::ResponseType::ALLREDUCE ||
             !FusableAllreducePair(rn.tensor_type, rn.process_set_id,
-                                  rn.reduce_op, r0.tensor_type,
-                                  r0.process_set_id, r0.reduce_op)) {
+                                  rn.reduce_op, rn.device, r0.tensor_type,
+                                  r0.process_set_id, r0.reduce_op,
+                                  r0.device)) {
           break;
         }
         int64_t nb = CachedEntryBytes(rn);

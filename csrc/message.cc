@@ -88,6 +88,7 @@ void WriteRequest(Writer& w, const Request& r) {
   w.Put<int32_t>(r.process_set_id);
   w.Put<int32_t>(r.group_id);
   w.PutI64Vec(r.splits);
+  w.Put<int32_t>(r.device);
 }
 
 bool ReadRequest(Reader& rd, Request* r) {
@@ -107,6 +108,7 @@ bool ReadRequest(Reader& rd, Request* r) {
   ok = ok && rd.Get(&r->process_set_id);
   ok = ok && rd.Get(&r->group_id);
   ok = ok && rd.GetI64Vec(&r->splits);
+  ok = ok && rd.Get(&r->device);
   return ok;
 }
 
@@ -122,6 +124,7 @@ void WriteResponse(Writer& w, const Response& r) {
   w.Put<int32_t>(r.root_rank);
   w.Put<int32_t>(r.process_set_id);
   w.Put<int32_t>(r.last_joined_rank);
+  w.Put<int32_t>(r.device);
 }
 
 bool ReadResponse(Reader& rd, Response* r) {
@@ -142,6 +145,7 @@ bool ReadResponse(Reader& rd, Response* r) {
   ok = ok && rd.Get(&r->root_rank);
   ok = ok && rd.Get(&r->process_set_id);
   ok = ok && rd.Get(&r->last_joined_rank);
+  ok = ok && rd.Get(&r->device);
   return ok;
 }
 

@@ -41,6 +41,9 @@ struct Request {
   int32_t process_set_id = 0;
   int32_t group_id = -1;  // grouped allreduce: negotiate atomically
   std::vector<int64_t> splits;  // alltoall send splits
+  // 1 = execute on the registered device data plane (XLA/ICI), 0 = host
+  // ring. All ranks must agree per tensor (validated like dtype/shape).
+  int32_t device = 0;
 };
 
 // Coordinator verdict: a (possibly fused) set of tensors to execute, or an
@@ -71,6 +74,9 @@ struct Response {
   int32_t root_rank = 0;  // broadcast: joined ranks need it to synthesize
   int32_t process_set_id = 0;
   int32_t last_joined_rank = -1;
+  // Mirrors Request::device: 1 routes the fused group to the registered
+  // device data plane instead of the host ring ops.
+  int32_t device = 0;
 };
 
 // Decoders for Response::tensor_shapes's flattened [ndim, dims...] layout —

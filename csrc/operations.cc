@@ -394,6 +394,48 @@ void SynthesizeJoinedEntries(GlobalState& st, const Response& response,
   *entries = std::move(ordered);
 }
 
+// Device data plane hook. The Python layer (horovod_tpu/jax/xla_ici.py)
+// registers one callback; the background thread hands it each fused
+// device Response in negotiated order — identical on every rank, so the
+// per-rank XLA program launches line up into one collective. This is the
+// TPU analog of the reference's op dispatch picking NCCL for GPU tensors
+// (horovod/common/ops/operation_manager.cc).
+typedef int32_t (*DeviceExecFn)(int32_t op_class, int32_t n,
+                                const char** names,
+                                const int64_t* shapes_flat, int32_t dtype,
+                                int32_t reduce_op, int32_t root_rank,
+                                int32_t process_set_id,
+                                const int64_t* rank_sizes,
+                                int32_t n_rank_sizes, char* err,
+                                int32_t err_cap);
+std::atomic<DeviceExecFn> g_device_exec{nullptr};
+
+Status ExecuteDeviceResponse(GlobalState& st, const Response& response) {
+  DeviceExecFn fn = g_device_exec.load();
+  if (fn == nullptr) {
+    return Status::PreconditionError(
+        "device tensor enqueued but no device data plane is registered");
+  }
+  std::vector<const char*> names;
+  names.reserve(response.tensor_names.size());
+  for (auto& n : response.tensor_names) names.push_back(n.c_str());
+  char err[512] = {0};
+  int32_t rc = fn((int32_t)response.response_type,
+                  (int32_t)response.tensor_names.size(), names.data(),
+                  response.tensor_shapes.data(),
+                  (int32_t)response.tensor_type,
+                  (int32_t)response.reduce_op,
+                  response.root_rank, response.process_set_id,
+                  response.tensor_sizes.data(),
+                  (int32_t)response.tensor_sizes.size(), err,
+                  (int32_t)sizeof(err) - 1);
+  if (rc != 0) {
+    return Status::Error(err[0] ? std::string(err)
+                                : "device data plane execution failed");
+  }
+  return Status::OK();
+}
+
 void ExecuteResponse(GlobalState& st, const Response& response) {
   if (response.response_type == Response::ResponseType::JOIN) {
     auto join_entries = st.tensor_queue.GetTensorEntriesFromResponse(response);
@@ -435,7 +477,11 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
   std::vector<std::vector<uint8_t>> zero_bufs;
   if (st.joined.load() &&
       entries.size() < response.tensor_names.size() &&
-      response.response_type != Response::ResponseType::ERROR) {
+      response.response_type != Response::ResponseType::ERROR &&
+      response.device == 0) {
+    // Device responses need no host zero buffers: the data-plane callback
+    // receives every fused name+shape and synthesizes zero contributions
+    // on-device for names this rank never enqueued.
     SynthesizeJoinedEntries(st, response, &entries, &zero_bufs);
   }
   Status status = Status::OK();
@@ -443,6 +489,9 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
     status = ps_status;
   } else if (response.response_type == Response::ResponseType::ERROR) {
     status = Status::PreconditionError(response.error_message);
+  } else if (response.device == 1 &&
+             response.response_type != Response::ResponseType::BARRIER) {
+    status = ExecuteDeviceResponse(st, response);
   } else if (response.response_type == Response::ResponseType::ALLREDUCE) {
     status = ExecuteAllreduce(st, dp, entries);
   } else {
@@ -788,6 +837,52 @@ int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
   m.tensor_shape = e.shape;
   m.reduce_op = e.reduce_op;
   m.process_set_id = process_set_id;
+  return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_set_device_callback(void* fn) {
+  // Register (or clear, with null) the device data plane executor. Called
+  // by the Python XLA/ICI layer with a ctypes CFUNCTYPE; the background
+  // thread invokes it for every device=1 fused response.
+  g_device_exec.store((DeviceExecFn)fn);
+  return 0;
+}
+
+int hvdtpu_enqueue_device(int op_class, const char* name, int ndim,
+                          const int64_t* shape, int dtype, int reduce_op,
+                          int root_rank, int process_set_id) {
+  // Negotiation-only enqueue for an accelerator-resident tensor: the
+  // payload stays in HBM under the Python data plane's registry; the core
+  // contributes ordering, fusion grouping, caching, and join handling.
+  // op_class uses Response::ResponseType values (0=allreduce, 1=allgather,
+  // 2=broadcast, 4=reducescatter).
+  CHECK_INIT(-1)
+  if (g_device_exec.load() == nullptr) return -1;
+  RequestType rt;
+  switch (op_class) {
+    case 0: rt = RequestType::ALLREDUCE; break;
+    case 1: rt = RequestType::ALLGATHER; break;
+    case 2: rt = RequestType::BROADCAST; break;
+    case 4: rt = RequestType::REDUCESCATTER; break;
+    default: return -1;  // alltoall rides the host path for now
+  }
+  TensorTableEntry e;
+  e.name = name;
+  e.device = 1;
+  e.shape.assign(shape, shape + ndim);
+  e.dtype = ToDataType(dtype);
+  e.reduce_op = (ReduceOp)reduce_op;
+  e.root_rank = root_rank;
+  e.process_set_id = process_set_id;
+  Request m;
+  m.request_type = rt;
+  m.tensor_name = e.name;
+  m.tensor_type = e.dtype;
+  m.tensor_shape = e.shape;
+  m.reduce_op = e.reduce_op;
+  m.root_rank = root_rank;
+  m.process_set_id = process_set_id;
+  m.device = 1;
   return EnqueueEntry(std::move(e), std::move(m));
 }
 

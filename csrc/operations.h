@@ -49,6 +49,15 @@ int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
                                  int reduce_op, double prescale,
                                  double postscale, int process_set_id);
 int hvdtpu_enqueue_barrier(int process_set_id);
+
+// Device data plane (xla_ici backend). Python registers one callback
+// (ctypes CFUNCTYPE matching DeviceExecFn in operations.cc); device
+// enqueues are negotiation-only — payloads stay in HBM on the Python
+// side, and the callback executes each fused group as one XLA program.
+int hvdtpu_set_device_callback(void* fn);
+int hvdtpu_enqueue_device(int op_class, const char* name, int ndim,
+                          const int64_t* shape, int dtype, int reduce_op,
+                          int root_rank, int process_set_id);
 // Join: this rank is out of data; returns a handle that completes once every
 // rank has joined. After completion, hvdtpu_last_joined_rank() gives the
 // last rank to join. Reference analog: horovod_join (operations.cc).

@@ -64,6 +64,7 @@ ResponseCache::LookupResult ResponseCache::Lookup(const Request& req,
   const Response& r = slots_[it->second].response;
   bool match = r.response_type == ExpectedType(req.request_type) &&
                r.tensor_type == req.tensor_type &&
+               r.device == req.device &&
                FirstShape(r) == req.tensor_shape;
   if (match) {
     switch (r.response_type) {
@@ -130,6 +131,7 @@ void ResponseCache::InsertFromResponses(
       slot.response.reduce_op = res.reduce_op;
       slot.response.root_rank = res.root_rank;
       slot.response.process_set_id = res.process_set_id;
+      slot.response.device = res.device;
       slot.response.tensor_sizes.clear();
       slot.response.error_message.clear();
       index_[key] = pos;

@@ -78,6 +78,11 @@ def load_library():
             cstr, p, i32, i64p, i32, i32, dbl, dbl, i32]
         lib.hvdtpu_enqueue_barrier.restype = i32
         lib.hvdtpu_enqueue_barrier.argtypes = [i32]
+        lib.hvdtpu_set_device_callback.restype = i32
+        lib.hvdtpu_set_device_callback.argtypes = [p]
+        lib.hvdtpu_enqueue_device.restype = i32
+        lib.hvdtpu_enqueue_device.argtypes = [
+            i32, cstr, i32, i64p, i32, i32, i32, i32]
         lib.hvdtpu_enqueue_join.restype = i32
         lib.hvdtpu_enqueue_join.argtypes = []
         lib.hvdtpu_last_joined_rank.restype = i32

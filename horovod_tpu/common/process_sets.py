@@ -13,6 +13,20 @@ from horovod_tpu.common.basics import HorovodBasics
 
 _basics = HorovodBasics()
 
+# id -> sorted member ranks, for consumers that only hold an id (e.g. the
+# xla_ici data plane mapping a fused response's process set onto a device
+# sub-mesh). Populated by add_process_set on this rank.
+_members_by_id = {}
+
+
+def members_of(process_set_id):
+    """Member ranks of a registered set; the world for id 0; None if the
+    id was never registered on this rank."""
+    if process_set_id == 0:
+        n = _basics.size()
+        return list(range(n)) if n and n > 0 else None
+    return _members_by_id.get(process_set_id)
+
 
 class ProcessSet:
     """A subgroup of ranks collectives can run over.
@@ -83,6 +97,7 @@ def add_process_set(process_set):
     if set_id < 0:
         raise ValueError(f"invalid process set ranks {ps.ranks}")
     ps.process_set_id = set_id
+    _members_by_id[set_id] = list(ps.ranks)
     # No rank may enqueue on the new set before every rank registered it.
     _barrier()
     return ps
@@ -95,6 +110,7 @@ def remove_process_set(process_set):
         raise ValueError("cannot remove the global process set")
     _barrier()  # drain any in-flight collectives on the set first
     rc = _basics.lib.hvdtpu_remove_process_set(ps_id)
+    _members_by_id.pop(ps_id, None)
     if isinstance(process_set, ProcessSet):
         process_set.process_set_id = None
     return rc == 0

@@ -1,0 +1,519 @@
+"""xla_ici device data plane: eager collectives as cached XLA programs.
+
+Reference analog: the NCCL data-plane backend
+(``horovod/common/ops/nccl_operations.cc``) plus the fusion buffer
+(``horovod/common/fusion_buffer_manager.cc``) — re-founded on XLA per
+SURVEY.md §7's key insight: Horovod's response cache ≅ a compiled-
+executable cache. Each fused group of device tensors becomes ONE jitted
+program — device-side concat → ``psum`` over the mesh axis → split, with
+pre/postscale folded in — compiled once per (op, shapes, dtype, scales,
+process-set) signature and replayed every later step. The C++ core keeps
+what it's good at: negotiation, ordering, fusion grouping, the response
+cache, and join handling over the host network. Because every member rank
+receives the identical fused ResponseList, the per-rank program launches
+line up into one collective over ICI (TPU pods) or the gloo CPU backend
+(tests).
+
+Topology: one device per rank ("rank-per-chip"). Multi-process runs
+require ``jax.distributed`` to be initialized with ``process_id`` equal to
+the Horovod rank; ``enable()`` does this itself from the controller
+address when possible.
+"""
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.common import process_sets
+from horovod_tpu.common.basics import HorovodBasics
+from horovod_tpu.common.eager_ops import _DTYPE_TO_ENUM, ReduceOp
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+_basics = HorovodBasics()
+
+_ENUM_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ENUM.items()}
+
+# Response::ResponseType values (csrc/message.h) — the callback's op_class.
+_OP_ALLREDUCE = 0
+_OP_ALLGATHER = 1
+_OP_BROADCAST = 2
+_OP_REDUCESCATTER = 4
+
+_EXEC_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int32,                    # return: 0 ok, nonzero = error
+    ctypes.c_int32,                    # op_class
+    ctypes.c_int32,                    # n fused tensors
+    ctypes.POINTER(ctypes.c_char_p),   # names
+    ctypes.POINTER(ctypes.c_int64),    # shapes_flat [ndim, dims...]*n
+    ctypes.c_int32,                    # dtype enum
+    ctypes.c_int32,                    # reduce_op
+    ctypes.c_int32,                    # root_rank
+    ctypes.c_int32,                    # process_set_id
+    ctypes.POINTER(ctypes.c_int64),    # rank_sizes (allgather first dims)
+    ctypes.c_int32,                    # n_rank_sizes
+    ctypes.POINTER(ctypes.c_char),     # err buffer
+    ctypes.c_int32)                    # err capacity
+
+
+def _decode_shapes(shapes_p, n):
+    shapes, pos = [], 0
+    for _ in range(n):
+        ndim = int(shapes_p[pos])
+        pos += 1
+        shapes.append(tuple(int(shapes_p[pos + j]) for j in range(ndim)))
+        pos += ndim
+    return shapes
+
+
+def _nelem(shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def _distributed_initialized():
+    """Whether jax.distributed.initialize already ran — checked WITHOUT
+    touching the backend (jax.process_count() would initialize it, locking
+    in a single-process topology)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:  # pragma: no cover - private API moved
+        return False
+
+
+class XlaIciDataPlane:
+    """Executes the core's fused device responses as cached XLA programs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = False
+        self._rank = 0
+        self._size = 1
+        self._devices = None          # rank -> jax device
+        self._local_device = None
+        self._inputs = {}             # (ps_id, name) -> (array, pre, post)
+        self._outputs = {}            # (ps_id, name) -> jax array
+        self._exec_cache = {}         # signature -> jitted program
+        self._cb_ref = None           # keep the CFUNCTYPE alive
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def active(self):
+        return self._active
+
+    def enable(self):
+        """Bind one device per rank and register with the core.
+
+        Multi-process: initializes ``jax.distributed`` against the
+        controller host (port = HOROVOD_XLA_COORD_PORT or controller
+        port + 1) unless the caller already did.
+        """
+        if self._active:
+            return
+        rank, size = _basics.rank(), _basics.size()
+        if rank < 0:
+            raise RuntimeError("hvd.init() must run before the XLA data "
+                               "plane is enabled")
+        if size > 1:
+            if not _distributed_initialized():
+                addr = os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
+                port = int(os.environ.get(
+                    "HOROVOD_XLA_COORD_PORT",
+                    int(os.environ.get("HOROVOD_CONTROLLER_PORT", 29500)) + 1))
+                # Must run BEFORE the backend client exists (so don't probe
+                # jax.default_backend() here). The CPU collectives setting
+                # is inert on TPU.
+                try:
+                    jax.config.update("jax_cpu_collectives_implementation",
+                                      "gloo")
+                except Exception:  # backend already up; keep its setting
+                    pass
+                jax.distributed.initialize(
+                    coordinator_address=f"{addr}:{port}",
+                    num_processes=size, process_id=rank)
+            if jax.process_count() != size or jax.process_index() != rank:
+                raise RuntimeError(
+                    f"jax.distributed topology (process "
+                    f"{jax.process_index()}/{jax.process_count()}) does not "
+                    f"match Horovod rank {rank}/{size}")
+            by_proc = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, []).append(d)
+            self._devices = []
+            for p in range(size):
+                devs = by_proc.get(p)
+                if not devs:
+                    raise RuntimeError(f"no jax device for process {p}")
+                # Rank-per-chip: one device per process. If a process owns
+                # several (e.g. CPU tests), every rank still uses its first
+                # so device lists agree across ranks.
+                self._devices.append(devs[0])
+            self._local_device = self._devices[rank]
+        else:
+            self._local_device = jax.local_devices()[0]
+            self._devices = [self._local_device]
+        self._rank, self._size = rank, size
+        self._cb_ref = _EXEC_FN(self._execute)
+        _basics.lib.hvdtpu_set_device_callback(
+            ctypes.cast(self._cb_ref, ctypes.c_void_p))
+        self._active = True
+
+    def disable(self):
+        if not self._active:
+            return
+        _basics.lib.hvdtpu_set_device_callback(None)
+        self._active = False
+        self._cb_ref = None
+        with self._lock:
+            self._inputs.clear()
+            self._outputs.clear()
+        self._exec_cache.clear()
+
+    # -- frontend side -----------------------------------------------------
+
+    def register_input(self, name, process_set_id, array, prescale=1.0,
+                       postscale=1.0):
+        arr = jax.device_put(array, self._local_device)
+        with self._lock:
+            self._inputs[(process_set_id, name)] = (arr, float(prescale),
+                                                    float(postscale))
+        return arr
+
+    def pop_output(self, name, process_set_id):
+        with self._lock:
+            return self._outputs.pop((process_set_id, name))
+
+    def drop(self, name, process_set_id):
+        """Release any buffers pinned for a failed collective (ERROR
+        response or enqueue failure — the callback never ran, so nothing
+        else pops the input and the HBM would stay pinned)."""
+        with self._lock:
+            self._inputs.pop((process_set_id, name), None)
+            self._outputs.pop((process_set_id, name), None)
+
+    # -- core side (background thread) ------------------------------------
+
+    def _execute(self, op_class, n, names_p, shapes_p, dtype, reduce_op,
+                 root_rank, ps_id, sizes_p, n_sizes, err_p, err_cap):
+        try:
+            names = [names_p[i].decode() for i in range(n)]
+            shapes = _decode_shapes(shapes_p, n)
+            np_dtype = _ENUM_TO_DTYPE[dtype]
+            rank_sizes = tuple(int(sizes_p[i]) for i in range(n_sizes))
+            self._run(op_class, names, shapes, np_dtype, reduce_op,
+                      root_rank, ps_id, rank_sizes)
+            return 0
+        except Exception as e:  # noqa: BLE001 — crosses the C boundary
+            msg = f"xla_ici: {type(e).__name__}: {e}".encode()[:err_cap - 1]
+            ctypes.memmove(err_p, msg + b"\0", len(msg) + 1)
+            return 1
+
+    def _members(self, ps_id):
+        members = process_sets.members_of(ps_id)
+        if members is None:
+            raise ValueError(f"unknown process set {ps_id}")
+        return tuple(members)
+
+    def _take_inputs(self, names, shapes, np_dtype, ps_id):
+        """Local contributions in fused order; zeros for names this rank
+        never enqueued (join support)."""
+        arrs, scales = [], []
+        with self._lock:
+            pending = [self._inputs.pop((ps_id, nm), None) for nm in names]
+        for nm, shape, p in zip(names, shapes, pending):
+            if p is None:
+                arrs.append(jnp.zeros(shape, np_dtype))
+                scales.append((1.0, 1.0))
+            else:
+                arr, pre, post = p
+                if arr.dtype != np_dtype:
+                    arr = arr.astype(np_dtype)
+                arrs.append(arr)
+                scales.append((pre, post))
+        return arrs, tuple(scales)
+
+    def _mesh(self, members):
+        return Mesh(np.array([self._devices[r] for r in members]), ("hvd",))
+
+    def _global(self, mesh, group, local_2d):
+        """Lift this rank's (1, k) block to the global (group, k) array."""
+        shard = jax.device_put(local_2d, self._local_device)
+        return jax.make_array_from_single_device_arrays(
+            (group,) + tuple(local_2d.shape[1:]),
+            NamedSharding(mesh, P("hvd")), [shard])
+
+    def _store(self, names, ps_id, outs):
+        with self._lock:
+            for nm, o in zip(names, outs):
+                self._outputs[(ps_id, nm)] = o
+
+    def _run(self, op_class, names, shapes, np_dtype, reduce_op, root_rank,
+             ps_id, rank_sizes):
+        members = self._members(ps_id)
+        group = len(members)
+        mesh = self._mesh(members)
+        if op_class == _OP_ALLREDUCE:
+            arrs, scales = self._take_inputs(names, shapes, np_dtype, ps_id)
+            sig = (op_class, members, np_dtype.str, tuple(shapes), reduce_op,
+                   scales)
+            fn = self._exec_cache.get(sig)
+            if fn is None:
+                fn = _build_allreduce(mesh, group, shapes, reduce_op, scales)
+                self._exec_cache[sig] = fn
+            gins = [self._global(mesh, group, a.reshape(1, -1))
+                    for a in arrs]
+            gouts = fn(*gins)
+            outs = [g.addressable_data(0).reshape(s)
+                    for g, s in zip(gouts, shapes)]
+            self._store(names, ps_id, outs)
+        elif op_class == _OP_BROADCAST:
+            arrs, _ = self._take_inputs(names, shapes, np_dtype, ps_id)
+            root_pos = members.index(root_rank)
+            sig = (op_class, members, np_dtype.str, tuple(shapes), root_pos)
+            fn = self._exec_cache.get(sig)
+            if fn is None:
+                fn = _build_broadcast(mesh, root_pos)
+                self._exec_cache[sig] = fn
+            g = self._global(mesh, group, arrs[0].reshape(1, -1))
+            out = fn(g).addressable_data(0).reshape(shapes[0])
+            self._store(names, ps_id, [out])
+        elif op_class == _OP_ALLGATHER:
+            # rank_sizes: per-member first dims (ragged allgather). This
+            # rank's contribution is zero-padded to the max first dim so
+            # shards are uniform; the program slices the padding back out.
+            shape = shapes[0]
+            rest = shape[1:] if shape else ()
+            restf = _nelem(rest)
+            dims = rank_sizes if rank_sizes else (shape[0] if shape else 1,)
+            max_d = max(max(dims), 1)
+            arrs, _ = self._take_inputs(
+                names, [(dims[members.index(self._rank)],) + rest], np_dtype,
+                ps_id)
+            local = arrs[0].reshape(-1, restf) if restf else \
+                arrs[0].reshape(-1, 1)
+            pad = max_d - local.shape[0]
+            if pad:
+                local = jnp.concatenate(
+                    [local, jnp.zeros((pad, local.shape[1]), np_dtype)])
+            sig = (op_class, members, np_dtype.str, dims, rest)
+            fn = self._exec_cache.get(sig)
+            if fn is None:
+                fn = _build_allgather(mesh, dims)
+                self._exec_cache[sig] = fn
+            g = self._global(mesh, group, local[None])
+            out = fn(g).addressable_data(0).reshape((sum(dims),) + rest)
+            self._store(names, ps_id, [out])
+        elif op_class == _OP_REDUCESCATTER:
+            arrs, scales = self._take_inputs(names, shapes, np_dtype, ps_id)
+            shape = shapes[0]
+            first = shape[0] if shape else 1
+            rest = shape[1:] if shape else ()
+            # First dim split as evenly as possible, remainder to lower
+            # member positions — same convention as the host ring
+            # (csrc/operations.cc REDUCESCATTER).
+            q, rem = divmod(first, group)
+            rows = [q + (1 if r < rem else 0) for r in range(group)]
+            my_pos = members.index(self._rank)
+            off = sum(rows[:my_pos])
+            sig = (op_class, members, np_dtype.str, tuple(shape), reduce_op,
+                   scales, my_pos)
+            fn = self._exec_cache.get(sig)
+            if fn is None:
+                fn = _build_reducescatter(mesh, group, reduce_op, scales[0],
+                                          off, rows[my_pos])
+                self._exec_cache[sig] = fn
+            restf = _nelem(rest)
+            g = self._global(mesh, group,
+                             arrs[0].reshape(1, first, restf if restf else 1))
+            out = fn(g).addressable_data(0).reshape((rows[my_pos],) + rest)
+            self._store(names, ps_id, [out])
+        else:
+            raise ValueError(f"unsupported device op_class {op_class}")
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    # check_vma off: outputs ARE replicated (psum/pmin/... results), but
+    # the checker can't always prove it through the slice/scale epilogue.
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def _reduce(buf, reduce_op, group):
+    if reduce_op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        red = lax.psum(buf, "hvd")
+        if reduce_op == ReduceOp.AVERAGE:
+            red = red / group if jnp.issubdtype(red.dtype, jnp.floating) \
+                else red // group
+        return red
+    if reduce_op == ReduceOp.MIN:
+        return lax.pmin(buf, "hvd")
+    if reduce_op == ReduceOp.MAX:
+        return lax.pmax(buf, "hvd")
+    if reduce_op == ReduceOp.PRODUCT:
+        return jnp.prod(lax.all_gather(buf, "hvd"), axis=0)
+    raise ValueError(f"reduce op {reduce_op} is not supported on the XLA "
+                     "data plane (Adasum rides the host path)")
+
+
+def _build_allreduce(mesh, group, shapes, reduce_op, scales):
+    """One program for the fused group: concat → reduce → split. This IS
+    the fusion buffer — it lives in HBM for the duration of the program
+    and XLA fuses the scale/concat/split elementwise work around the
+    collective (reference analog: MemcpyInFusionBuffer + cuda_kernels.cu,
+    done here by the compiler)."""
+    sizes = [max(_nelem(s), 1) for s in shapes]
+
+    def inner(*blocks):  # each (1, size_i)
+        parts = []
+        for b, (pre, _) in zip(blocks, scales):
+            x = b.reshape(-1)
+            if pre != 1.0:
+                x = x * np.asarray(pre, x.dtype)
+            parts.append(x)
+        buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        red = _reduce(buf, reduce_op, group)
+        outs, off = [], 0
+        for sz, (_, post) in zip(sizes, scales):
+            o = lax.slice_in_dim(red, off, off + sz)
+            off += sz
+            if post != 1.0:
+                o = o * np.asarray(post, o.dtype)
+            outs.append(o)
+        return tuple(outs)
+
+    k = len(shapes)
+    return jax.jit(_shard_map(inner, mesh, (P("hvd"),) * k, (P(None),) * k))
+
+
+def _build_broadcast(mesh, root_pos):
+    def inner(block):  # (1, n)
+        x = block.reshape(-1)
+        idx = lax.axis_index("hvd")
+        if jnp.issubdtype(x.dtype, jnp.bool_):
+            contrib = jnp.where(idx == root_pos, x.astype(jnp.uint8),
+                                jnp.zeros_like(x, jnp.uint8))
+            return lax.psum(contrib, "hvd").astype(jnp.bool_)
+        contrib = jnp.where(idx == root_pos, x, jnp.zeros_like(x))
+        return lax.psum(contrib, "hvd")
+
+    return jax.jit(_shard_map(inner, mesh, P("hvd"), P(None)))
+
+
+def _build_allgather(mesh, dims):
+    def inner(block):  # (1, max_d, restf)
+        g = lax.all_gather(block[0], "hvd")  # (group, max_d, restf)
+        segs = [lax.slice_in_dim(g[i], 0, d) for i, d in enumerate(dims)]
+        return jnp.concatenate(segs, axis=0)
+
+    return jax.jit(_shard_map(inner, mesh, P("hvd"), P(None)))
+
+
+def _build_reducescatter(mesh, group, reduce_op, scale, off, nrows):
+    pre, post = scale
+
+    def inner(block):  # (1, first, restf)
+        x = block[0]
+        if pre != 1.0:
+            x = x * np.asarray(pre, x.dtype)
+        red = _reduce(x, reduce_op, group)
+        out = lax.slice_in_dim(red, off, off + nrows)
+        if post != 1.0:
+            out = out * np.asarray(post, out.dtype)
+        return out
+
+    return jax.jit(_shard_map(inner, mesh, P("hvd"), P(None)))
+
+
+# Module-level singleton; frontends share it.
+_data_plane = XlaIciDataPlane()
+
+
+def data_plane():
+    return _data_plane
+
+
+def active():
+    return _data_plane.active
+
+
+def enable():
+    _data_plane.enable()
+
+
+def disable():
+    _data_plane.disable()
+
+
+class DeviceHandle:
+    """An in-flight device collective; ``synchronize`` returns the jax
+    array produced by the data plane (payload never left HBM)."""
+
+    def __init__(self, raw, name, process_set_id):
+        self._raw = raw
+        self._name = name
+        self._ps = process_set_id
+        self._done = False
+
+    def poll(self):
+        rc = _basics.lib.hvdtpu_poll(self._raw)
+        if rc < 0:
+            raise ValueError(f"invalid Horovod handle {self._raw}")
+        return rc == 1
+
+    def synchronize(self):
+        if self._done:
+            raise ValueError("handle already synchronized")
+        lib = _basics.lib
+        rc = lib.hvdtpu_wait(self._raw)
+        self._done = True
+        if rc != 0:
+            err = lib.hvdtpu_error_string(self._raw)
+            msg = err.decode() if err else "unknown error"
+            lib.hvdtpu_release(self._raw)
+            _data_plane.drop(self._name, self._ps)
+            raise HorovodInternalError(msg)
+        lib.hvdtpu_release(self._raw)
+        return _data_plane.pop_output(self._name, self._ps)
+
+
+# Response::ResponseType values accepted by hvdtpu_enqueue_device.
+_ENQUEUE_OPS = {
+    "allreduce": _OP_ALLREDUCE,
+    "allgather": _OP_ALLGATHER,
+    "broadcast": _OP_BROADCAST,
+    "reducescatter": _OP_REDUCESCATTER,
+}
+
+
+def enqueue_device(kind, array, name, reduce_op=ReduceOp.SUM,
+                   prescale_factor=1.0, postscale_factor=1.0, root_rank=0,
+                   process_set_id=0):
+    """Register the device array and enqueue its negotiation-only request.
+
+    The returned DeviceHandle's ``synchronize()`` yields the result as a
+    jax array on this rank's device.
+    """
+    ps_id = int(process_set_id)
+    arr = _data_plane.register_input(name, ps_id, array, prescale_factor,
+                                     postscale_factor)
+    shape = (ctypes.c_int64 * max(arr.ndim, 1))(*arr.shape)
+    dtype = _DTYPE_TO_ENUM[np.dtype(arr.dtype)]
+    h = _basics.lib.hvdtpu_enqueue_device(
+        _ENQUEUE_OPS[kind], name.encode(), arr.ndim, shape, dtype,
+        int(reduce_op), int(root_rank), ps_id)
+    if h < 0:
+        _data_plane.drop(name, ps_id)
+        raise RuntimeError(f"failed to enqueue device {kind} (is the XLA "
+                           "data plane enabled and Horovod running?)")
+    return DeviceHandle(h, name, ps_id)

@@ -1,0 +1,253 @@
+"""xla_ici device data plane: eager collectives as cached XLA programs.
+
+Reference analog: test/parallel/test_torch.py's op×dtype sweeps — but for
+the device path, where the payload stays a jax array end-to-end and the
+fused group executes as one compiled program over a gloo (test) / ICI
+(TPU) mesh. Expected values are analytic, as in the reference.
+"""
+
+import numpy as np
+import pytest
+
+from tests.utils_mp import run_ranks
+
+_ENV = {"HOROVOD_XLA_DATA_PLANE": "1"}
+
+
+def _worker_basic_ops(rank, size):
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import xla_ici
+
+    hvd.init()
+    try:
+        assert xla_ici.active()
+        # sum
+        out = hvd.allreduce(jnp.full((4,), float(rank)), op=hvd.Sum)
+        assert isinstance(out, jax.Array)
+        np.testing.assert_allclose(np.asarray(out), sum(range(size)))
+        # average
+        out = hvd.allreduce(jnp.full((3, 2), float(rank + 1)),
+                            op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(out), (size + 1) / 2)
+        # min / max / product over rank-distinct values
+        vals = jnp.array([float(rank + 1), float(-rank)])
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(vals, op=hvd.Min)),
+            [1.0, -(size - 1)])
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(vals, op=hvd.Max)),
+            [float(size), 0.0])
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(jnp.full((2,), float(rank + 2)),
+                                     op=hvd.Product)),
+            float(np.prod([i + 2 for i in range(size)])))
+        # scalar round-trip keeps its shape
+        out = hvd.allreduce(jnp.asarray(float(rank)), op=hvd.Sum)
+        assert out.shape == ()
+        np.testing.assert_allclose(float(out), sum(range(size)))
+        # int dtype
+        out = hvd.allreduce(jnp.full((4,), rank, jnp.int32), op=hvd.Sum)
+        assert out.dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(out), sum(range(size)))
+        # prescale/postscale fold into the program
+        out = hvd.allreduce(jnp.full((2,), float(rank + 1)), op=hvd.Sum,
+                            prescale_factor=0.5, postscale_factor=4.0)
+        np.testing.assert_allclose(
+            np.asarray(out), 0.5 * sum(i + 1 for i in range(size)) * 4.0)
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_allreduce_ops():
+    assert run_ranks(_worker_basic_ops, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
+def _worker_bcast_gather_scatter(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        # broadcast
+        out = hvd.broadcast(jnp.full((2, 3), float(rank + 5)), root_rank=1)
+        np.testing.assert_allclose(np.asarray(out), 6.0)
+        # ragged allgather: rank r contributes r+1 rows
+        out = hvd.allgather(jnp.full((rank + 1, 2), float(rank)))
+        exp = np.concatenate(
+            [np.full((i + 1, 2), float(i)) for i in range(size)])
+        np.testing.assert_allclose(np.asarray(out), exp)
+        # reducescatter with an uneven first-dim split (5 rows over 2)
+        big = jnp.arange(10, dtype=jnp.float32).reshape(5, 2) * (rank + 1)
+        out = hvd.reducescatter(big, op=hvd.Sum)
+        full = (np.arange(10, dtype=np.float32).reshape(5, 2)
+                * sum(i + 1 for i in range(size)))
+        rows = [5 // size + (1 if i < 5 % size else 0) for i in range(size)]
+        off = sum(rows[:rank])
+        np.testing.assert_allclose(np.asarray(out),
+                                   full[off:off + rows[rank]])
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_bcast_gather_scatter():
+    assert run_ranks(_worker_bcast_gather_scatter, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
+def _worker_fusion_and_cache(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import xla_ici
+
+    hvd.init()
+    try:
+        # Async burst: same dtype/op tensors may fuse into one program.
+        # Values must be exact either way; steady-state repeats must reuse
+        # the executable cache instead of growing it.
+        for step in range(4):
+            hs = [hvd.allreduce_async(
+                      jnp.full((8 + i,), float(rank + step)),
+                      name=f"grad.{i}", op=hvd.Sum)
+                  for i in range(3)]
+            for i, h in enumerate(hs):
+                out = h.synchronize()
+                assert out.shape == (8 + i,)
+                np.testing.assert_allclose(
+                    np.asarray(out), sum(range(size)) + size * step)
+            if step == 2:
+                # Steps 0-1 may group differently (first negotiation vs
+                # response-cache replay); by step 2 the cached grouping is
+                # the steady state and must stop compiling.
+                steady = len(xla_ici.data_plane()._exec_cache)
+        assert len(xla_ici.data_plane()._exec_cache) == steady, \
+            "executable cache grew on steady-state replay"
+        # Device responses must HIT the response cache in steady state
+        # (regression: the cached slot once dropped the device flag, which
+        # forced eviction + full renegotiation every cycle).
+        from horovod_tpu.common.basics import HorovodBasics
+        hits = HorovodBasics().lib.hvdtpu_response_cache_hits()
+        assert hits > 0, "device tensors never hit the response cache"
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_fusion_and_executable_cache():
+    # A long cycle makes the async burst land in ONE negotiation cycle
+    # every step, so the fused grouping — and thus the executable-cache
+    # signature — is deterministic on a loaded one-core box.
+    env = dict(_ENV, HOROVOD_CYCLE_TIME="50")
+    assert run_ranks(_worker_fusion_and_cache, 2, env=env,
+                     timeout=240) == ["ok"] * 2
+
+
+def _worker_process_set(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        ps = hvd.add_process_set([0, 1])
+        out = hvd.allreduce(jnp.full((4,), float(rank + 1)), op=hvd.Sum,
+                            process_set_id=ps)
+        np.testing.assert_allclose(np.asarray(out), 3.0)  # 1 + 2
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_process_set():
+    assert run_ranks(_worker_process_set, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
+def _worker_join(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        if rank == 0:
+            # Joined peers contribute zeros on-device.
+            out = hvd.allreduce(jnp.full((4,), 3.0), op=hvd.Sum,
+                                name="grad.j")
+            np.testing.assert_allclose(np.asarray(out), 3.0)
+            last = hvd.join()
+        else:
+            last = hvd.join()
+        assert last >= 0
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_join_synthesizes_zeros():
+    assert run_ranks(_worker_join, 2, env=_ENV, timeout=240) == ["ok"] * 2
+
+
+def _worker_failed_collective_no_leak(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.jax import xla_ici
+
+    hvd.init()
+    try:
+        # Mismatched dtypes across ranks -> ERROR response; the input
+        # pinned in the data plane registry must be released.
+        dt = jnp.float32 if rank == 0 else jnp.int32
+        try:
+            hvd.allreduce(jnp.zeros((4,), dt), name="bad.dtype", op=hvd.Sum)
+            raise AssertionError("mismatched dtypes should fail")
+        except HorovodInternalError:
+            pass
+        assert not xla_ici.data_plane()._inputs, "leaked device input"
+        # The core must still be usable afterwards.
+        out = hvd.allreduce(jnp.full((2,), float(rank)), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), sum(range(size)))
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_failed_device_collective_releases_input():
+    assert run_ranks(_worker_failed_collective_no_leak, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
+def _worker_adasum_host_fallback(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        # Adasum stays on the host ring; result is still a jax array.
+        out = hvd.allreduce(jnp.full((4,), float(rank + 1)), op=hvd.Adasum)
+        assert out.shape == (4,)
+        assert np.isfinite(np.asarray(out)).all()
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_adasum_falls_back_to_host_path():
+    assert run_ranks(_worker_adasum_host_fallback, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
+@pytest.mark.parametrize("np_ranks", [3])
+def test_device_three_ranks(np_ranks):
+    assert run_ranks(_worker_basic_ops, np_ranks, env=_ENV,
+                     timeout=300) == ["ok"] * np_ranks
