@@ -27,6 +27,17 @@ def _is_elastic():
     return bool(os.environ.get("HOROVOD_RDZV_ADDR"))
 
 
+# Frontends register topology-dependent re-initialization here (e.g. the
+# jax frontend re-attempts xla_ici enable); reset() runs them after the
+# new epoch's core is up.
+_post_reset_hooks = []
+
+
+def register_post_reset_hook(fn):
+    if fn not in _post_reset_hooks:
+        _post_reset_hooks.append(fn)
+
+
 def _worker_id():
     wid = os.environ.get("HOROVOD_WORKER_ID")
     if not wid:
@@ -72,7 +83,21 @@ def init():
 def reset():
     """Tear down and re-rendezvous (elastic epoch transition)."""
     _basics.shutdown()
+    # The xla_ici device data plane binds the OLD topology (mesh size,
+    # jax.distributed world); its callback must not survive into the new
+    # epoch. sys.modules check so torch/tf-only elastic processes never
+    # pull jax in here. The jax frontend's post-reset hook re-attempts
+    # enable for the new epoch (succeeds when the world size is unchanged;
+    # warns or raises otherwise — jax.distributed cannot re-initialize
+    # with a different world in-process).
+    import sys
+
+    xla_ici = sys.modules.get("horovod_tpu.jax.xla_ici")
+    if xla_ici is not None:
+        xla_ici.disable()
     init()
+    for hook in _post_reset_hooks:
+        hook()
 
 
 def _poll_hosts_updated():

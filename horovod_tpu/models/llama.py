@@ -39,6 +39,13 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = True
+    # Sparse mixture-of-experts (mixtral-style): n_experts == 0 keeps the
+    # dense FFN; otherwise every layer's FFN becomes top-k-routed experts
+    # sharded over the mesh's "expert" axis.
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self):
@@ -54,12 +61,24 @@ class LlamaConfig:
                            n_heads=32, n_kv_heads=8, d_ff=14336)
 
     @staticmethod
+    def mixtral_8x7b():
+        return LlamaConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, d_ff=14336,
+                           n_experts=8, n_experts_per_token=2)
+
+    @staticmethod
     def tiny(**kw):
         """Test/dryrun config: full architecture, toy sizes."""
         defaults = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
                         n_kv_heads=2, d_ff=128, rope_theta=10000.0)
         defaults.update(kw)
         return LlamaConfig(**defaults)
+
+    @staticmethod
+    def tiny_moe(**kw):
+        """Tiny sparse-MoE variant (expert-parallel test/dryrun config)."""
+        kw.setdefault("n_experts", 4)
+        return LlamaConfig.tiny(**kw)
 
 
 def llama_init(config, key):
@@ -76,23 +95,36 @@ def llama_init(config, key):
                 * (fan_in ** -0.5))
 
     L = c.n_layers
-    params = {
-        "embed": jax.random.normal(next(k), (c.vocab_size, c.d_model),
-                                   jnp.float32) * 0.02,
-        "layers": {
-            "attn_norm": jnp.ones((L, c.d_model)),
-            "wq": dense(next(k), (L, c.d_model, c.n_heads * hd), c.d_model),
-            "wk": dense(next(k), (L, c.d_model, c.n_kv_heads * hd),
-                        c.d_model),
-            "wv": dense(next(k), (L, c.d_model, c.n_kv_heads * hd),
-                        c.d_model),
-            "wo": dense(next(k), (L, c.n_heads * hd, c.d_model),
-                        c.n_heads * hd),
-            "mlp_norm": jnp.ones((L, c.d_model)),
+    layers = {
+        "attn_norm": jnp.ones((L, c.d_model)),
+        "wq": dense(next(k), (L, c.d_model, c.n_heads * hd), c.d_model),
+        "wk": dense(next(k), (L, c.d_model, c.n_kv_heads * hd),
+                    c.d_model),
+        "wv": dense(next(k), (L, c.d_model, c.n_kv_heads * hd),
+                    c.d_model),
+        "wo": dense(next(k), (L, c.n_heads * hd, c.d_model),
+                    c.n_heads * hd),
+        "mlp_norm": jnp.ones((L, c.d_model)),
+    }
+    if c.n_experts > 0:
+        E = c.n_experts
+        layers.update({
+            "router": dense(next(k), (L, c.d_model, E), c.d_model),
+            "moe_gate": dense(next(k), (L, E, c.d_model, c.d_ff),
+                              c.d_model),
+            "moe_up": dense(next(k), (L, E, c.d_model, c.d_ff), c.d_model),
+            "moe_down": dense(next(k), (L, E, c.d_ff, c.d_model), c.d_ff),
+        })
+    else:
+        layers.update({
             "w_gate": dense(next(k), (L, c.d_model, c.d_ff), c.d_model),
             "w_up": dense(next(k), (L, c.d_model, c.d_ff), c.d_model),
             "w_down": dense(next(k), (L, c.d_ff, c.d_model), c.d_ff),
-        },
+        })
+    params = {
+        "embed": jax.random.normal(next(k), (c.vocab_size, c.d_model),
+                                   jnp.float32) * 0.02,
+        "layers": layers,
         "final_norm": jnp.ones(c.d_model),
         "lm_head": dense(next(k), (c.d_model, c.vocab_size), c.d_model),
     }
@@ -113,6 +145,12 @@ def llama_partition_rules():
         (r"layers/wo", P(None, "tensor", "fsdp")),
         (r"layers/w_(gate|up)", P(None, "fsdp", "tensor")),
         (r"layers/w_down", P(None, "tensor", "fsdp")),
+        # MoE: experts shard over the "expert" mesh axis (EP); within an
+        # expert the FFN shards like the dense MLP. The router is tiny and
+        # stays replicated.
+        (r"layers/router", P(None, None, None)),
+        (r"layers/moe_(gate|up)", P(None, "expert", "fsdp", "tensor")),
+        (r"layers/moe_down", P(None, "expert", "tensor", "fsdp")),
         (r"final_norm", P(None)),
         (r"lm_head", P("fsdp", "tensor")),
     ]
@@ -153,12 +191,82 @@ def _activation_spec(mesh):
     return P(("data", "fsdp"), "seq", None)
 
 
-def llama_forward(params, tokens, config, mesh=None, seq_axis="seq"):
+def _moe_ffn(h, lp, c, mesh):
+    """Top-k routed expert FFN, GShard-style grouped einsum dispatch.
+
+    Static shapes throughout (XLA requirement): each batch row is a
+    dispatch GROUP (GShard's group axis — without it the one-hot
+    dispatch tensors are O(S²) in the token count); within a group,
+    tokens scatter into per-expert buffers of fixed capacity C via
+    one-hot tensors, and over-capacity tokens fall through on the
+    residual (combine weight zero). Groups ride the batch sharding
+    (data/fsdp); the [G, E, C, D] expert buffers get an "expert" axis
+    constraint so GSPMD inserts the token all-to-alls — the TPU analog
+    of expert-parallel dispatch. Reference analog: none (Horovod has no
+    MoE); design follows the GShard/Switch public formulation.
+    Returns (out [B,T,D], aux loss).
+    """
+    B, T, D = h.shape
+    E, K = c.n_experts, c.n_experts_per_token
+    C = max(int(T * K * c.capacity_factor / E), 1)
+
+    logits = h.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # [B, T, E] f32
+    gate_vals, gate_idx = lax.top_k(probs, K)               # [B, T, K]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # Switch-transformer load-balancing aux loss: E * <fraction routed to
+    # e> . <mean prob of e>, minimized (=1) at uniform routing.
+    top1 = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
+    aux = E * jnp.sum(top1.mean((0, 1)) * probs.mean((0, 1)))
+
+    # Position of each (token, slot) in its expert's per-group capacity
+    # buffer, filling slot 0 for every token before slot 1 (priority to
+    # the top-1 expert, as in GShard).
+    dt = c.compute_dtype
+    dispatch = jnp.zeros((B, T, E, C), dt)
+    combine = jnp.zeros((B, T, E, C), dt)
+    counts = jnp.zeros((B, E), jnp.int32)
+    for slot in range(K):
+        oh = jax.nn.one_hot(gate_idx[..., slot], E,
+                            dtype=jnp.int32)                    # [B,T,E]
+        pos = jnp.cumsum(oh, axis=1) - 1 + counts[:, None, :]   # [B,T,E]
+        keep = (pos < C) & (oh > 0)
+        pos_oh = jax.nn.one_hot(pos, C, dtype=dt) \
+            * keep[..., None].astype(dt)                        # [B,T,E,C]
+        dispatch = dispatch + pos_oh
+        combine = combine + pos_oh * gate_vals[..., slot].astype(
+            dt)[..., None, None]
+        counts = counts + oh.sum(1)
+
+    def constrain_e(z):
+        if mesh is None:
+            return z
+        return lax.with_sharding_constraint(
+            z, jax.sharding.NamedSharding(
+                mesh, P(("data", "fsdp"), "expert", None, None)))
+
+    xe = constrain_e(jnp.einsum("btec,btd->becd", dispatch,
+                                h.astype(dt)))                # [B,E,C,D]
+    gate = jax.nn.silu(jnp.einsum("becd,edf->becf", xe,
+                                  lp["moe_gate"].astype(dt)))
+    up = jnp.einsum("becd,edf->becf", xe, lp["moe_up"].astype(dt))
+    ye = constrain_e(jnp.einsum("becf,efd->becd", gate * up,
+                                lp["moe_down"].astype(dt)))
+    y = jnp.einsum("btec,becd->btd", combine, ye)             # [B,T,D]
+    return y, aux
+
+
+def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
+                  return_aux=False):
     """tokens [B, T] int32 -> logits [B, T, vocab] (float32).
 
     Under jit with a mesh, activations get sharding constraints so GSPMD
     lays out batch over data/fsdp and sequence over seq; the attention op
-    switches to ring attention when seq parallelism is active.
+    switches to ring attention when seq parallelism is active. With
+    ``return_aux`` the MoE load-balancing loss (mean over layers; 0 for
+    dense configs) is returned alongside the logits.
     """
     c = config
     dt = c.compute_dtype
@@ -187,30 +295,42 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq"):
         x = x + constrain(attn.reshape(b, t, -1) @ lp["wo"].astype(dt))
 
         h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
-        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-        up = h @ lp["w_up"].astype(dt)
-        x = x + constrain((gate * up) @ lp["w_down"].astype(dt))
-        return x, None
+        if c.n_experts > 0:
+            ff, aux = _moe_ffn(h, lp, c, mesh)
+        else:
+            gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+            up = h @ lp["w_up"].astype(dt)
+            ff = (gate * up) @ lp["w_down"].astype(dt)
+            aux = jnp.zeros((), jnp.float32)
+        x = x + constrain(ff)
+        return x, aux
 
     body = layer
     if c.remat:
         body = jax.checkpoint(layer)
-    x, _ = lax.scan(body, x, params["layers"])
+    x, aux_per_layer = lax.scan(body, x, params["layers"])
 
     x = _rmsnorm(x, params["final_norm"].astype(dt), c.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    if return_aux:
+        return logits, jnp.mean(aux_per_layer)
     return logits
 
 
 def llama_loss(params, batch, config, mesh=None, seq_axis="seq"):
-    """Causal LM loss. batch = {"tokens": [B,T], "targets": [B,T],
-    "mask": [B,T] or absent}."""
-    logits = llama_forward(params, batch["tokens"], config, mesh, seq_axis)
+    """Causal LM loss (+ weighted MoE aux loss for expert configs).
+    batch = {"tokens": [B,T], "targets": [B,T], "mask": [B,T] or absent}."""
+    logits, aux = llama_forward(params, batch["tokens"], config, mesh,
+                                seq_axis, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     tgt = batch["targets"]
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
     if mask is None:
-        return jnp.mean(nll)
-    mask = mask.astype(jnp.float32)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        loss = jnp.mean(nll)
+    else:
+        mask = mask.astype(jnp.float32)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if config.n_experts > 0:
+        loss = loss + config.moe_aux_weight * aux
+    return loss
