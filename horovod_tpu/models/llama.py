@@ -46,6 +46,9 @@ class LlamaConfig:
     n_experts_per_token: int = 2
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # GPipe microbatch count when the mesh has a non-trivial "pipe" axis
+    # (0 = one microbatch per stage). Batch must divide by it.
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self):
@@ -131,26 +134,29 @@ def llama_init(config, key):
     return params
 
 
-def llama_partition_rules():
+def llama_partition_rules(pipeline=False):
     """Megatron TP + FSDP sharding rules for the param pytree.
 
-    Layer-stacked tensors have a leading (unsharded) layer axis. The
+    Layer-stacked tensors have a leading layer axis — unsharded by
+    default, split over the "pipe" mesh axis when ``pipeline`` is set
+    (contiguous layer blocks = GPipe stages; see parallel.pipeline). The
     ``tensor`` axis splits heads / ffn; ``fsdp`` shards the other matmul
     dimension ZeRO-3 style. Pass to parallel.shard_params.
     """
+    lead = "pipe" if pipeline else None
     return [
         (r"embed", P("tensor", "fsdp")),
-        (r"layers/.*norm", P(None, None)),
-        (r"layers/w[qkv]$", P(None, "fsdp", "tensor")),
-        (r"layers/wo", P(None, "tensor", "fsdp")),
-        (r"layers/w_(gate|up)", P(None, "fsdp", "tensor")),
-        (r"layers/w_down", P(None, "tensor", "fsdp")),
+        (r"layers/.*norm", P(lead, None)),
+        (r"layers/w[qkv]$", P(lead, "fsdp", "tensor")),
+        (r"layers/wo", P(lead, "tensor", "fsdp")),
+        (r"layers/w_(gate|up)", P(lead, "fsdp", "tensor")),
+        (r"layers/w_down", P(lead, "tensor", "fsdp")),
         # MoE: experts shard over the "expert" mesh axis (EP); within an
         # expert the FFN shards like the dense MLP. The router is tiny and
         # stays replicated.
-        (r"layers/router", P(None, None, None)),
-        (r"layers/moe_(gate|up)", P(None, "expert", "fsdp", "tensor")),
-        (r"layers/moe_down", P(None, "expert", "tensor", "fsdp")),
+        (r"layers/router", P(lead, None, None)),
+        (r"layers/moe_(gate|up)", P(lead, "expert", "fsdp", "tensor")),
+        (r"layers/moe_down", P(lead, "expert", "tensor", "fsdp")),
         (r"final_norm", P(None)),
         (r"lm_head", P("fsdp", "tensor")),
     ]
@@ -271,7 +277,6 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
     c = config
     dt = c.compute_dtype
     b, t = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
 
     def constrain(x):
         if mesh is None:
@@ -283,16 +288,20 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
     x = constrain(x)
 
     def layer(x, lp):
+        # Shapes from x, not the enclosing scope: under pipelining the
+        # layer sees microbatches smaller than the full batch.
+        bb, tt = x.shape[0], x.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(tt), (bb, tt))
         h = _rmsnorm(x, lp["attn_norm"].astype(dt), c.norm_eps)
-        q = (h @ lp["wq"].astype(dt)).reshape(b, t, c.n_heads, c.head_dim)
-        kk = (h @ lp["wk"].astype(dt)).reshape(b, t, c.n_kv_heads,
+        q = (h @ lp["wq"].astype(dt)).reshape(bb, tt, c.n_heads, c.head_dim)
+        kk = (h @ lp["wk"].astype(dt)).reshape(bb, tt, c.n_kv_heads,
                                                c.head_dim)
-        vv = (h @ lp["wv"].astype(dt)).reshape(b, t, c.n_kv_heads,
+        vv = (h @ lp["wv"].astype(dt)).reshape(bb, tt, c.n_kv_heads,
                                                c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         kk = _rope(kk, positions, c.rope_theta)
         attn = _attention(q, kk, vv, mesh, seq_axis)
-        x = x + constrain(attn.reshape(b, t, -1) @ lp["wo"].astype(dt))
+        x = x + constrain(attn.reshape(bb, tt, -1) @ lp["wo"].astype(dt))
 
         h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
         if c.n_experts > 0:
@@ -308,12 +317,44 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
     body = layer
     if c.remat:
         body = jax.checkpoint(layer)
-    x, aux_per_layer = lax.scan(body, x, params["layers"])
+
+    n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
+    if n_stages > 1:
+        # GPipe over the "pipe" axis: each stage scans its contiguous
+        # layer block; microbatches rotate stage-to-stage via ppermute
+        # (parallel.pipeline.gpipe). seq parallelism is mutually
+        # exclusive with pipelining in this layout (ring attention's own
+        # shard_map cannot nest inside the pipeline's).
+        from horovod_tpu.parallel.pipeline import gpipe
+
+        M = c.pipeline_microbatches or n_stages
+        if seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+            raise ValueError("pipeline (pipe>1) and sequence parallelism "
+                             "(seq>1) cannot combine: ring attention's "
+                             "shard_map cannot nest inside the pipeline's")
+        if M <= 0 or b % M:
+            raise ValueError(f"batch {b} must divide into "
+                             f"{M} pipeline microbatches")
+        if c.n_layers % n_stages:
+            raise ValueError(f"n_layers {c.n_layers} must divide into "
+                             f"{n_stages} pipeline stages")
+
+        def stage_fn(lp_stage, x_mb):
+            x_out, aux_layers = lax.scan(body, x_mb, lp_stage)
+            return x_out, jnp.sum(aux_layers)
+
+        xs = x.reshape(M, b // M, t, x.shape[-1])
+        ys, aux_total = gpipe(stage_fn, params["layers"], xs, mesh)
+        x = ys.reshape(b, t, x.shape[-1])
+        aux = aux_total / (c.n_layers * M)
+    else:
+        x, aux_per_layer = lax.scan(body, x, params["layers"])
+        aux = jnp.mean(aux_per_layer)
 
     x = _rmsnorm(x, params["final_norm"].astype(dt), c.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
     if return_aux:
-        return logits, jnp.mean(aux_per_layer)
+        return logits, aux
     return logits
 
 

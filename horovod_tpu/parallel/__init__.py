@@ -30,6 +30,7 @@ from horovod_tpu.parallel.ops import (  # noqa: F401
     psum,
     reduce_scatter,
 )
+from horovod_tpu.parallel.pipeline import gpipe  # noqa: F401
 from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     blockwise_attention,
     ring_attention,

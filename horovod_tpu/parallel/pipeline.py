@@ -1,0 +1,70 @@
+"""GPipe-style pipeline parallelism over the mesh's "pipe" axis.
+
+Reference analog: none — Horovod is data-parallel only (SURVEY.md §5.7/
+§2.6); this is net-new TPU machinery like ring attention. Design: the
+layer stack is split into S contiguous stages (the stacked layer axis
+shards over "pipe", so each device holds its stage's weights); inside a
+*partial-manual* ``shard_map`` (manual over "pipe" only — tensor/fsdp/
+data stay with GSPMD), a ``lax.scan`` runs the classic GPipe schedule:
+each step every stage processes one microbatch and ``ppermute`` rotates
+activations to the next stage. M microbatches drain in M + S - 1 steps
+(the bubble); results collect on the last stage and are shared back with
+a masked ``psum``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def gpipe(stage_fn, stage_params, xs, mesh, axis="pipe"):
+    """Run microbatches through the pipeline.
+
+    ``stage_fn(stage_params_block, x_mb) -> (y_mb, aux)`` applies ONE
+    stage's slice of the network (aux is a scalar, e.g. an MoE balance
+    loss; return 0.0 if unused). ``stage_params`` is a pytree whose
+    leaves have a leading stacked-layer axis of length divisible by the
+    pipe size — ``shard_map`` splits it into per-stage blocks.
+    ``xs`` is ``[M, ...]`` microbatches. Returns ``(ys [M, ...],
+    aux_sum)`` where aux_sum totals stage_fn aux over all (stage,
+    microbatch) pairs.
+    """
+    S = mesh.shape[axis]
+    M = xs.shape[0]
+
+    def inner(sp, xs_):
+        stage = lax.axis_index(axis)
+
+        def step(state, t):
+            carry, buf, aux = state
+            inj = lax.dynamic_index_in_dim(xs_, jnp.clip(t, 0, M - 1), 0,
+                                           keepdims=False)
+            inp = jnp.where(stage == 0, inj, carry)
+            out, a = stage_fn(sp, inp)
+            # Bubble steps (stage s idle before t=s and after t=s+M-1)
+            # compute on garbage; mask their aux and never collect them.
+            valid = (t - stage >= 0) & (t - stage < M)
+            aux = aux + jnp.where(valid, a, 0.0)
+            cidx = jnp.clip(t - (S - 1), 0, M - 1)
+            collect = (stage == S - 1) & (t >= S - 1)
+            cur = lax.dynamic_index_in_dim(buf, cidx, 0, keepdims=False)
+            buf = lax.dynamic_update_index_in_dim(
+                buf, jnp.where(collect, out, cur), cidx, 0)
+            carry = lax.ppermute(out, axis,
+                                 [(i, (i + 1) % S) for i in range(S)])
+            return (carry, buf, aux), None
+
+        init = (jnp.zeros_like(xs_[0]), jnp.zeros_like(xs_),
+                jnp.zeros((), jnp.float32))
+        (carry, buf, aux), _ = lax.scan(step, init, jnp.arange(M + S - 1))
+        # Results live on the last stage; the loss is computed globally,
+        # so share them (and the aux total) across the pipe axis.
+        buf = lax.psum(
+            jnp.where(stage == S - 1, buf, jnp.zeros_like(buf)), axis)
+        aux = lax.psum(aux, axis)
+        return buf, aux
+
+    return jax.shard_map(inner, mesh=mesh, in_specs=(P(axis), P()),
+                         out_specs=(P(), P()), axis_names={axis},
+                         check_vma=False)(stage_params, xs)
