@@ -22,6 +22,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.parallel.ring_attention import ring_self_attention
@@ -38,7 +39,11 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
-    remat: bool = True
+    # Rematerialization: True/"full" recomputes the whole layer in
+    # backward (min HBM, ~1/3 extra FLOPs), "dots" saves matmul outputs
+    # and recomputes only elementwise work (the usual best MFU point),
+    # False/"none" saves everything.
+    remat: "bool | str" = True
     # Sparse mixture-of-experts (mixtral-style): n_experts == 0 keeps the
     # dense FFN; otherwise every layer's FFN becomes top-k-routed experts
     # sharded over the mesh's "expert" axis.
@@ -301,6 +306,9 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
         q = _rope(q, positions, c.rope_theta)
         kk = _rope(kk, positions, c.rope_theta)
         attn = _attention(q, kk, vv, mesh, seq_axis)
+        # Named for remat="attn": saving this one tensor keeps backward
+        # from re-running the whole attention forward.
+        attn = checkpoint_name(attn, "attn_out")
         x = x + constrain(attn.reshape(bb, tt, -1) @ lp["wo"].astype(dt))
 
         h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
@@ -315,8 +323,24 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
         return x, aux
 
     body = layer
-    if c.remat:
+    if c.remat == "dots":
+        body = jax.checkpoint(
+            layer, policy=jax.checkpoint_policies.dots_saveable)
+    elif c.remat == "attn":
+        # Full remat except the attention output (one [B,T,H*D] bf16
+        # tensor per layer): backward skips the second flash-attention
+        # forward at a small HBM cost.
+        body = jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out"))
+    elif c.remat in (False, "none"):
+        pass
+    elif c.remat in (True, "full"):
         body = jax.checkpoint(layer)
+    else:
+        raise ValueError(f"unknown remat mode {c.remat!r}: expected "
+                         "True/'full', 'dots', 'attn', or False/'none'")
 
     n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
     if n_stages > 1:
@@ -352,7 +376,10 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
         aux = jnp.mean(aux_per_layer)
 
     x = _rmsnorm(x, params["final_norm"].astype(dt), c.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    # bf16 operands, f32 accumulation: full MXU rate without giving up
+    # the f32 logits downstream softmax stability needs.
+    logits = jnp.matmul(x, params["lm_head"].astype(dt),
+                        preferred_element_type=jnp.float32)
     if return_aux:
         return logits, aux
     return logits
@@ -363,9 +390,12 @@ def llama_loss(params, batch, config, mesh=None, seq_axis="seq"):
     batch = {"tokens": [B,T], "targets": [B,T], "mask": [B,T] or absent}."""
     logits, aux = llama_forward(params, batch["tokens"], config, mesh,
                                 seq_axis, return_aux=True)
-    logp = jax.nn.log_softmax(logits, axis=-1)
     tgt = batch["targets"]
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    # logsumexp form: no second [B,T,vocab] f32 array for log_softmax —
+    # at bench shapes that array alone is GBs of HBM.
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    nll = lse - picked
     mask = batch.get("mask")
     if mask is None:
         loss = jnp.mean(nll)
