@@ -173,6 +173,31 @@ void Controller::MaybePromote(const std::string& key, PendingTensor& pt) {
     }
   }
   pt.queued = true;
+  const Request& first = pt.requests.front();
+  // Ranks disagreeing on the grouping must surface BuildResponse's
+  // mismatch ERROR, not sit in group_table_ waiting for members that
+  // will never arrive — promote such keys directly.
+  for (const auto& req : pt.requests) {
+    if (req.group_id != first.group_id ||
+        req.group_size != first.group_size) {
+      ready_queue_.push_back(key);
+      return;
+    }
+  }
+  if (first.group_id >= 0 && first.group_size > 1) {
+    // Hold group members until the whole group is ready, then release
+    // them contiguously so FuseResponses emits one pure group response.
+    std::string gkey = std::to_string(first.process_set_id) + ':' +
+                       std::to_string(first.group_id);
+    GroupState& gs = group_table_[gkey];
+    gs.size = first.group_size;
+    gs.ready_keys.push_back(key);
+    if ((int32_t)gs.ready_keys.size() >= gs.size) {
+      for (auto& k : gs.ready_keys) ready_queue_.push_back(k);
+      group_table_.erase(gkey);
+    }
+    return;
+  }
   ready_queue_.push_back(key);
 }
 
@@ -222,6 +247,7 @@ Response Controller::BuildResponse(const std::string& key) {
   res.root_rank = first.root_rank;
   res.process_set_id = first.process_set_id;
   res.device = first.device;
+  res.group_id = first.group_id;
   res.tensor_shapes.push_back((int64_t)first.tensor_shape.size());
   res.tensor_shapes.insert(res.tensor_shapes.end(),
                            first.tensor_shape.begin(),
@@ -272,6 +298,10 @@ Response Controller::BuildResponse(const std::string& key) {
       err = "mismatched process sets across ranks";
     } else if (req.device != first.device) {
       err = "mismatched device placement across ranks";
+    } else if (req.group_id != first.group_id ||
+               req.group_size != first.group_size) {
+      err = "mismatched allreduce grouping across ranks (grouped calls "
+            "must happen in the same order on every rank)";
     } else if (req.request_type == RequestType::ALLREDUCE ||
                req.request_type == RequestType::BROADCAST ||
                req.request_type == RequestType::REDUCESCATTER) {
@@ -355,10 +385,18 @@ ResponseList Controller::FuseResponses() {
     // counts inside the fused buffer; we keep v1 simpler.
     if (res.response_type == Response::ResponseType::ALLREDUCE &&
         first.reduce_op != ReduceOp::ADASUM) {
-      while (!ready_queue_.empty() && bytes < cfg_.fusion_threshold_bytes) {
+      while (!ready_queue_.empty()) {
         const std::string& next_key = ready_queue_.front();
         auto& npt = message_table_[next_key];
         const Request& nreq = npt.requests.front();
+        // Atomic groups fuse completely (no threshold) and stay PURE —
+        // never mixed with other tensors — so the response is exactly
+        // the group and can be skipped by the cache as a unit.
+        bool same_group = first.group_id >= 0 &&
+                          nreq.group_id == first.group_id &&
+                          nreq.process_set_id == first.process_set_id;
+        if (first.group_id >= 0 && !same_group) break;
+        if (first.group_id < 0 && nreq.group_id >= 0) break;
         if (nreq.request_type != RequestType::ALLREDUCE ||
             !FusableAllreducePair(nreq.tensor_type, nreq.process_set_id,
                                   nreq.reduce_op, nreq.device,
@@ -371,7 +409,11 @@ ResponseList Controller::FuseResponses() {
         int64_t nbytes = 1;
         for (auto d : nreq.tensor_shape) nbytes *= d;
         nbytes *= DataTypeSize(nreq.tensor_type);
-        if (bytes + nbytes > cfg_.fusion_threshold_bytes) break;
+        if (!same_group &&
+            (bytes >= cfg_.fusion_threshold_bytes ||
+             bytes + nbytes > cfg_.fusion_threshold_bytes)) {
+          break;
+        }
         res.tensor_names.push_back(nreq.tensor_name);
         res.tensor_shapes.push_back((int64_t)nreq.tensor_shape.size());
         res.tensor_shapes.insert(res.tensor_shapes.end(),

@@ -116,6 +116,15 @@ class Controller {
   static std::string TableKey(const Request& req);
   std::unordered_map<std::string, PendingTensor> message_table_;
   std::deque<std::string> ready_queue_;  // all-ranks-ready, FIFO order
+  // Atomic grouped negotiation (reference analog: group_table.cc): ready
+  // group members are held back here until the WHOLE group is ready on
+  // every rank, then pushed onto ready_queue_ together so they fuse into
+  // one pure response regardless of the fusion threshold.
+  struct GroupState {
+    int32_t size = 0;
+    std::vector<std::string> ready_keys;  // coordinator insertion order
+  };
+  std::unordered_map<std::string, GroupState> group_table_;
   std::vector<bool> shutdown_flags_;
   std::unordered_set<int32_t> joined_ranks_;
   int32_t last_joined_rank_ = -1;

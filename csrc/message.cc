@@ -87,6 +87,7 @@ void WriteRequest(Writer& w, const Request& r) {
   w.PutI64Vec(r.tensor_shape);
   w.Put<int32_t>(r.process_set_id);
   w.Put<int32_t>(r.group_id);
+  w.Put<int32_t>(r.group_size);
   w.PutI64Vec(r.splits);
   w.Put<int32_t>(r.device);
 }
@@ -107,6 +108,7 @@ bool ReadRequest(Reader& rd, Request* r) {
   ok = ok && rd.GetI64Vec(&r->tensor_shape);
   ok = ok && rd.Get(&r->process_set_id);
   ok = ok && rd.Get(&r->group_id);
+  ok = ok && rd.Get(&r->group_size);
   ok = ok && rd.GetI64Vec(&r->splits);
   ok = ok && rd.Get(&r->device);
   return ok;
@@ -125,6 +127,7 @@ void WriteResponse(Writer& w, const Response& r) {
   w.Put<int32_t>(r.process_set_id);
   w.Put<int32_t>(r.last_joined_rank);
   w.Put<int32_t>(r.device);
+  w.Put<int32_t>(r.group_id);
 }
 
 bool ReadResponse(Reader& rd, Response* r) {
@@ -146,6 +149,7 @@ bool ReadResponse(Reader& rd, Response* r) {
   ok = ok && rd.Get(&r->process_set_id);
   ok = ok && rd.Get(&r->last_joined_rank);
   ok = ok && rd.Get(&r->device);
+  ok = ok && rd.Get(&r->group_id);
   return ok;
 }
 

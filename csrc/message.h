@@ -40,6 +40,10 @@ struct Request {
   std::vector<int64_t> tensor_shape;
   int32_t process_set_id = 0;
   int32_t group_id = -1;  // grouped allreduce: negotiate atomically
+  // Number of tensors in the group (all members carry it; lets the
+  // coordinator hold the group back until every member is ready on
+  // every rank).
+  int32_t group_size = 0;
   std::vector<int64_t> splits;  // alltoall send splits
   // 1 = execute on the registered device data plane (XLA/ICI), 0 = host
   // ring. All ranks must agree per tensor (validated like dtype/shape).
@@ -77,6 +81,9 @@ struct Response {
   // Mirrors Request::device: 1 routes the fused group to the registered
   // device data plane instead of the host ring ops.
   int32_t device = 0;
+  // >= 0 marks an atomically-negotiated group's fused response; such
+  // responses are pure (only group members) and are never cached.
+  int32_t group_id = -1;
 };
 
 // Decoders for Response::tensor_shapes's flattened [ndim, dims...] layout —

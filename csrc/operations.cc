@@ -6,6 +6,7 @@
 #include "operations.h"
 
 #include <atomic>
+#include <unordered_set>
 #include <condition_variable>
 #include <cstring>
 #include <mutex>
@@ -689,11 +690,12 @@ int hvdtpu_local_size() { CHECK_INIT(-1) return g_state->local_size; }
 int hvdtpu_cross_rank() { CHECK_INIT(-1) return g_state->cross_rank; }
 int hvdtpu_cross_size() { CHECK_INIT(-1) return g_state->cross_size; }
 
-int hvdtpu_enqueue_allreduce(const char* name, const void* input, void* output,
-                             int ndim, const int64_t* shape, int dtype,
-                             int reduce_op, double prescale, double postscale,
-                             int process_set_id) {
-  CHECK_INIT(-1)
+static int EnqueueAllreduceInternal(const char* name, const void* input,
+                                    void* output, int ndim,
+                                    const int64_t* shape, int dtype,
+                                    int reduce_op, double prescale,
+                                    double postscale, int process_set_id,
+                                    int group_id, int group_size) {
   TensorTableEntry e;
   e.name = name;
   e.input = input;
@@ -713,8 +715,25 @@ int hvdtpu_enqueue_allreduce(const char* name, const void* input, void* output,
   m.prescale_factor = prescale;
   m.postscale_factor = postscale;
   m.process_set_id = process_set_id;
+  m.group_id = group_id;
+  m.group_size = group_id >= 0 ? group_size : 0;
   return EnqueueEntry(std::move(e), std::move(m));
 }
+
+int hvdtpu_enqueue_allreduce(const char* name, const void* input, void* output,
+                             int ndim, const int64_t* shape, int dtype,
+                             int reduce_op, double prescale, double postscale,
+                             int process_set_id) {
+  CHECK_INIT(-1)
+  return EnqueueAllreduceInternal(name, input, output, ndim, shape, dtype,
+                                  reduce_op, prescale, postscale,
+                                  process_set_id, -1, 0);
+}
+
+// Process-global group id counter. Matches across ranks as long as
+// grouped calls happen in the same order everywhere — the reference's
+// group_table.cc carries the identical contract.
+std::atomic<int32_t> g_next_group_id{0};
 
 int hvdtpu_enqueue_grouped_allreduce(int num_tensors, const char** names,
                                      const void** inputs, void** outputs,
@@ -723,24 +742,45 @@ int hvdtpu_enqueue_grouped_allreduce(int num_tensors, const char** names,
                                      double postscale, int process_set_id,
                                      int* handles_out) {
   CHECK_INIT(-1)
-  // v1: grouped == individual enqueues (they fuse in negotiation anyway).
-  // Reference analog: group_table.cc enforces atomic negotiation; the
-  // controller-side group barrier lands with the response cache milestone.
+  // Atomic negotiation (reference analog: group_table.cc): every tensor
+  // carries the same fresh group id + the group size; the coordinator
+  // holds members back until the whole group is ready on every rank and
+  // fuses them into one pure response regardless of the fusion threshold.
   //
   // Returns the number of tensors successfully enqueued (== num_tensors on
   // full success). On partial failure the caller still owns live handles
   // for the first `return value` tensors and must drain them before
   // releasing the underlying buffers.
-  for (int i = 0; i < num_tensors; i++) {
-    if (names[i] == nullptr || inputs[i] == nullptr ||
-        outputs[i] == nullptr || shapes[i] == nullptr) {
-      for (int j = i; j < num_tensors; j++) handles_out[j] = -1;
-      return i;
+  // Validate everything BEFORE enqueueing anything: a half-enqueued
+  // group can never complete (the coordinator holds it for the missing
+  // members), so reject up front. Covers null pointers, duplicate names
+  // within the group, and collisions with in-flight tensors (which
+  // AddToTensorQueue would otherwise reject member-by-member, silently
+  // dropping that member's request).
+  {
+    std::unordered_set<std::string> seen;
+    for (int i = 0; i < num_tensors; i++) {
+      bool bad = names[i] == nullptr || inputs[i] == nullptr ||
+                 outputs[i] == nullptr || shapes[i] == nullptr;
+      if (!bad) {
+        bad = !seen.insert(names[i]).second ||
+              g_state->tensor_queue.Contains(names[i]);
+      }
+      if (bad) {
+        for (int j = 0; j < num_tensors; j++) handles_out[j] = -1;
+        return 0;
+      }
     }
-    handles_out[i] = hvdtpu_enqueue_allreduce(
-        names[i], inputs[i], outputs[i], ndims[i], shapes[i], dtype, reduce_op,
-        prescale, postscale, process_set_id);
+  }
+  int32_t gid = num_tensors > 1 ? g_next_group_id.fetch_add(1) : -1;
+  for (int i = 0; i < num_tensors; i++) {
+    handles_out[i] = EnqueueAllreduceInternal(
+        names[i], inputs[i], outputs[i], ndims[i], shapes[i], dtype,
+        reduce_op, prescale, postscale, process_set_id, gid, num_tensors);
     if (handles_out[i] < 0) {
+      // Only possible via the shutdown race; queued members are failed
+      // by the loop-exit orphan sweep, so callers draining the prefix
+      // see errors, not hangs.
       for (int j = i + 1; j < num_tensors; j++) handles_out[j] = -1;
       return i;
     }
@@ -850,12 +890,14 @@ int hvdtpu_set_device_callback(void* fn) {
 
 int hvdtpu_enqueue_device(int op_class, const char* name, int ndim,
                           const int64_t* shape, int dtype, int reduce_op,
-                          int root_rank, int process_set_id) {
+                          int root_rank, int process_set_id, int group_id,
+                          int group_size) {
   // Negotiation-only enqueue for an accelerator-resident tensor: the
   // payload stays in HBM under the Python data plane's registry; the core
   // contributes ordering, fusion grouping, caching, and join handling.
   // op_class uses Response::ResponseType values (0=allreduce, 1=allgather,
-  // 2=broadcast, 4=reducescatter).
+  // 2=broadcast, 4=reducescatter). group_id/group_size (-1/0 = ungrouped,
+  // ids from hvdtpu_next_group_id) opt into atomic group negotiation.
   CHECK_INIT(-1)
   if (g_device_exec.load() == nullptr) return -1;
   RequestType rt;
@@ -883,7 +925,16 @@ int hvdtpu_enqueue_device(int op_class, const char* name, int ndim,
   m.root_rank = root_rank;
   m.process_set_id = process_set_id;
   m.device = 1;
+  m.group_id = group_id;
+  m.group_size = group_id >= 0 ? group_size : 0;
   return EnqueueEntry(std::move(e), std::move(m));
+}
+
+int hvdtpu_next_group_id() {
+  // Fresh group id for device-path grouped enqueues (host grouped
+  // enqueues draw from the same counter internally, keeping cross-rank
+  // ordering consistent across both paths).
+  return g_next_group_id.fetch_add(1);
 }
 
 int hvdtpu_add_process_set(const int32_t* ranks, int nranks) {

@@ -57,7 +57,9 @@ int hvdtpu_enqueue_barrier(int process_set_id);
 int hvdtpu_set_device_callback(void* fn);
 int hvdtpu_enqueue_device(int op_class, const char* name, int ndim,
                           const int64_t* shape, int dtype, int reduce_op,
-                          int root_rank, int process_set_id);
+                          int root_rank, int process_set_id, int group_id,
+                          int group_size);
+int hvdtpu_next_group_id();
 // Join: this rank is out of data; returns a handle that completes once every
 // rank has joined. After completion, hvdtpu_last_joined_rank() gives the
 // last rank to join. Reference analog: horovod_join (operations.cc).

@@ -51,7 +51,9 @@ bool ResponseCache::Eligible(const Response& r) {
 
 ResponseCache::LookupResult ResponseCache::Lookup(const Request& req,
                                                   int32_t* pos) {
-  if (!enabled()) {
+  if (!enabled() || req.group_id >= 0) {
+    // Grouped tensors renegotiate every time: the per-tensor cache-hit
+    // bitvector cannot preserve group atomicity.
     misses_++;
     return LookupResult::MISS;
   }
@@ -93,7 +95,8 @@ void ResponseCache::InsertFromResponses(
     const std::vector<Response>& responses) {
   if (!enabled()) return;
   for (const Response& res : responses) {
-    if (!Eligible(res)) continue;
+    // Grouped responses are never cached (see Lookup).
+    if (res.group_id >= 0 || !Eligible(res)) continue;
     // Split a fused response into per-tensor cache entries.
     size_t shape_pos = 0;
     for (size_t i = 0; i < res.tensor_names.size(); i++) {

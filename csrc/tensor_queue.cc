@@ -54,4 +54,9 @@ size_t TensorQueue::Size() {
   return tensor_table_.size();
 }
 
+bool TensorQueue::Contains(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mutex_);
+  return tensor_table_.count(name) != 0;
+}
+
 }  // namespace hvdtpu

@@ -34,6 +34,10 @@ class TensorQueue {
 
   size_t Size();
 
+  // Whether a tensor of this name is in flight (grouped enqueue
+  // pre-validation — a half-enqueued atomic group can never complete).
+  bool Contains(const std::string& name);
+
  private:
   std::mutex mutex_;
   std::unordered_map<std::string, TensorTableEntry> tensor_table_;

@@ -82,7 +82,9 @@ def load_library():
         lib.hvdtpu_set_device_callback.argtypes = [p]
         lib.hvdtpu_enqueue_device.restype = i32
         lib.hvdtpu_enqueue_device.argtypes = [
-            i32, cstr, i32, i64p, i32, i32, i32, i32]
+            i32, cstr, i32, i64p, i32, i32, i32, i32, i32, i32]
+        lib.hvdtpu_next_group_id.restype = i32
+        lib.hvdtpu_next_group_id.argtypes = []
         lib.hvdtpu_enqueue_join.restype = i32
         lib.hvdtpu_enqueue_join.argtypes = []
         lib.hvdtpu_last_joined_rank.restype = i32

@@ -192,8 +192,11 @@ def grouped_allreduce_async(arrays, names, op=ReduceOp.SUM,
     handles = [Handle(c_handles[i], (arrs[i],), outs[i], False, dtype)
                for i in range(max(rc, 0))]
     if rc < n:
-        # Partial failure: drain the in-flight prefix so the core is done
-        # touching our buffers before we raise (and before GC can free them).
+        # rc == 0: the core pre-validated (nulls, duplicate names,
+        # in-flight collisions) and enqueued nothing. rc > 0 can only be
+        # the shutdown race, where the loop-exit orphan sweep fails the
+        # queued prefix — so draining here sees errors, never a hang
+        # (atomic groups otherwise wait for their missing members).
         for h in handles:
             try:
                 h.synchronize()

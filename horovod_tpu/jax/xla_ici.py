@@ -498,7 +498,7 @@ _ENQUEUE_OPS = {
 
 def enqueue_device(kind, array, name, reduce_op=ReduceOp.SUM,
                    prescale_factor=1.0, postscale_factor=1.0, root_rank=0,
-                   process_set_id=0):
+                   process_set_id=0, group_id=-1, group_size=0):
     """Register the device array and enqueue its negotiation-only request.
 
     The returned DeviceHandle's ``synchronize()`` yields the result as a
@@ -511,9 +511,37 @@ def enqueue_device(kind, array, name, reduce_op=ReduceOp.SUM,
     dtype = _DTYPE_TO_ENUM[np.dtype(arr.dtype)]
     h = _basics.lib.hvdtpu_enqueue_device(
         _ENQUEUE_OPS[kind], name.encode(), arr.ndim, shape, dtype,
-        int(reduce_op), int(root_rank), ps_id)
+        int(reduce_op), int(root_rank), ps_id, int(group_id),
+        int(group_size))
     if h < 0:
         _data_plane.drop(name, ps_id)
         raise RuntimeError(f"failed to enqueue device {kind} (is the XLA "
                            "data plane enabled and Horovod running?)")
     return DeviceHandle(h, name, ps_id)
+
+
+def grouped_allreduce_device(tensors, names, reduce_op=ReduceOp.SUM,
+                             prescale_factor=1.0, postscale_factor=1.0,
+                             process_set_id=0):
+    """Atomically-negotiated grouped allreduce on device arrays: all
+    tensors fuse into ONE XLA program (reference analog: grouped
+    allreduce via group_table.cc, on the device data plane).
+
+    Validates BEFORE enqueueing anything: a half-enqueued atomic group
+    can never complete, hanging every member rank.
+    """
+    if len(names) != len(tensors):
+        raise ValueError(f"grouped_allreduce: {len(tensors)} tensors but "
+                         f"{len(names)} names")
+    if len(set(names)) != len(names):
+        raise ValueError(f"grouped_allreduce: duplicate names in {names}")
+    if not (_data_plane.active and _basics.is_initialized()):
+        raise RuntimeError("grouped_allreduce_device requires hvd.init() "
+                           "and an active XLA data plane")
+    gid = _basics.lib.hvdtpu_next_group_id() if len(tensors) > 1 else -1
+    return [enqueue_device("allreduce", t, nm, reduce_op=reduce_op,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           process_set_id=process_set_id, group_id=gid,
+                           group_size=len(tensors))
+            for t, nm in zip(tensors, names)]

@@ -431,3 +431,64 @@ def _worker_ps_barrier_and_errors(rank, size):
 
 def test_process_set_barrier_and_errors():
     assert run_ranks(_worker_ps_barrier_and_errors, 3) == ["ok"] * 3
+
+
+def _worker_grouped_atomic_host(rank, size):
+    b = _init(rank)
+    ops = _ops()
+    try:
+        # Threshold is 16 bytes (env): only atomic group negotiation can
+        # fuse these. Values must be exact and all handles complete.
+        for step in range(3):
+            handles = ops.grouped_allreduce_async(
+                [np.full(6 + i, float(rank + step), np.float32)
+                 for i in range(3)],
+                [f"g.{i}" for i in range(3)])
+            for i, h in enumerate(handles):
+                out = h.synchronize()
+                assert out.shape == (6 + i,)
+                np.testing.assert_allclose(out,
+                                           sum(range(size)) + size * step)
+        # Grouped tensors bypass the response cache entirely.
+        hits, misses, entries = b.response_cache_stats()
+        assert entries == 0, f"grouped tensors were cached: {entries}"
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+def test_grouped_allreduce_atomic_negotiation():
+    env = {"HOROVOD_FUSION_THRESHOLD": "16"}
+    assert run_ranks(_worker_grouped_atomic_host, 2, env=env,
+                     timeout=120) == ["ok"] * 2
+
+
+def _worker_grouped_mismatched_order(rank, size):
+    b = _init(rank)
+    ops = _ops()
+    try:
+        # Ranks disagree on grouping (rank 0 groups, rank 1 enqueues the
+        # same names individually): the coordinator must surface an error
+        # rather than hang.
+        if rank == 0:
+            handles = ops.grouped_allreduce_async(
+                [np.zeros(4, np.float32), np.zeros(5, np.float32)],
+                ["mm.0", "mm.1"])
+        else:
+            handles = [ops.allreduce_async(np.zeros(4, np.float32), "mm.0"),
+                       ops.allreduce_async(np.zeros(5, np.float32), "mm.1")]
+        saw_error = False
+        for h in handles:
+            try:
+                h.synchronize()
+            except ops.HorovodInternalError:
+                saw_error = True
+        assert saw_error, "mismatched grouping should error"
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+def test_grouped_mismatched_order_errors():
+    assert run_ranks(_worker_grouped_mismatched_order, 2,
+                     timeout=120) == ["ok"] * 2

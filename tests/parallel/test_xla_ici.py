@@ -149,6 +149,38 @@ def test_device_fusion_and_executable_cache():
                      timeout=240) == ["ok"] * 2
 
 
+def _worker_grouped_atomic(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import xla_ici
+
+    hvd.init()
+    try:
+        # HOROVOD_FUSION_THRESHOLD=16 bytes: ordinary fusion can't merge
+        # these tensors, so ONE executable whose signature carries all
+        # three shapes proves the group negotiated atomically.
+        hs = hvd.grouped_allreduce_async(
+            [jnp.full((8 + i,), float(rank)) for i in range(3)],
+            names=[f"g.{i}" for i in range(3)], op=hvd.Sum)
+        for i, h in enumerate(hs):
+            out = h.synchronize()
+            assert out.shape == (8 + i,)
+            np.testing.assert_allclose(np.asarray(out), sum(range(size)))
+        sigs = list(xla_ici.data_plane()._exec_cache)
+        assert any(len(sig[3]) == 3 for sig in sigs), \
+            f"group did not fuse into one program: {sigs}"
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_grouped_allreduce_atomic():
+    env = dict(_ENV, HOROVOD_FUSION_THRESHOLD="16")
+    assert run_ranks(_worker_grouped_atomic, 2, env=env,
+                     timeout=240) == ["ok"] * 2
+
+
 def _worker_process_set(rank, size):
     import jax.numpy as jnp
 
