@@ -317,6 +317,13 @@ Response Controller::BuildResponse(const std::string& key) {
       if (!ShapesMatch(req.tensor_shape, first.tensor_shape, true)) {
         err = "mismatched tensor shapes (non-first dims) across ranks";
       }
+      // Device alltoall is equal-split (one static XLA program): every
+      // rank must contribute the same first dim too.
+      if (req.request_type == RequestType::ALLTOALL && first.device == 1 &&
+          !ShapesMatch(req.tensor_shape, first.tensor_shape, false)) {
+        err = "device alltoall requires identical shapes on every rank "
+              "(ragged splits ride the host path)";
+      }
     }
     if (!err.empty()) break;
   }

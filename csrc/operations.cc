@@ -905,8 +905,9 @@ int hvdtpu_enqueue_device(int op_class, const char* name, int ndim,
     case 0: rt = RequestType::ALLREDUCE; break;
     case 1: rt = RequestType::ALLGATHER; break;
     case 2: rt = RequestType::BROADCAST; break;
+    case 3: rt = RequestType::ALLTOALL; break;  // equal splits only
     case 4: rt = RequestType::REDUCESCATTER; break;
-    default: return -1;  // alltoall rides the host path for now
+    default: return -1;
   }
   TensorTableEntry e;
   e.name = name;

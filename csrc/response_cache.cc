@@ -18,6 +18,7 @@ Response::ResponseType ExpectedType(RequestType t) {
   switch (t) {
     case RequestType::ALLREDUCE: return Response::ResponseType::ALLREDUCE;
     case RequestType::BROADCAST: return Response::ResponseType::BROADCAST;
+    case RequestType::ALLTOALL: return Response::ResponseType::ALLTOALL;
     case RequestType::REDUCESCATTER:
       return Response::ResponseType::REDUCESCATTER;
     default: return Response::ResponseType::ERROR;  // never cached
@@ -41,9 +42,15 @@ bool ResponseCache::Eligible(const Response& r) {
       return r.reduce_op != ReduceOp::ADASUM;
     case Response::ResponseType::BROADCAST:
     case Response::ResponseType::REDUCESCATTER:
-      // Fixed-shape collectives. Allgather/alltoall have data-dependent
-      // first dims / splits, so they renegotiate every time.
+      // Fixed-shape collectives. Allgather has data-dependent first
+      // dims, so it renegotiates every time.
       return true;
+    case Response::ResponseType::ALLTOALL:
+      // Host alltoall has data-dependent splits; DEVICE alltoall is
+      // equal-split with identical shapes on every rank (the controller
+      // enforces it), which is exactly what the cache's shape match
+      // needs.
+      return r.device == 1;
     default:
       return false;
   }

@@ -44,6 +44,7 @@ _ENUM_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ENUM.items()}
 _OP_ALLREDUCE = 0
 _OP_ALLGATHER = 1
 _OP_BROADCAST = 2
+_OP_ALLTOALL = 3
 _OP_REDUCESCATTER = 4
 
 _EXEC_FN = ctypes.CFUNCTYPE(
@@ -77,6 +78,13 @@ def _nelem(shape):
     for d in shape:
         n *= d
     return n
+
+
+def _row_elems(rest):
+    """Elements per first-dim row: 1 for scalar rows (rest == ()), the
+    true product otherwise — including 0 for zero-size trailing dims
+    (``x or 1`` would corrupt those)."""
+    return _nelem(rest) if rest else 1
 
 
 def _distributed_initialized():
@@ -294,14 +302,12 @@ class XlaIciDataPlane:
             # shards are uniform; the program slices the padding back out.
             shape = shapes[0]
             rest = shape[1:] if shape else ()
-            restf = _nelem(rest)
             dims = rank_sizes if rank_sizes else (shape[0] if shape else 1,)
             max_d = max(max(dims), 1)
+            my_rows = dims[members.index(self._rank)]
             arrs, _ = self._take_inputs(
-                names, [(dims[members.index(self._rank)],) + rest], np_dtype,
-                ps_id)
-            local = arrs[0].reshape(-1, restf) if restf else \
-                arrs[0].reshape(-1, 1)
+                names, [(my_rows,) + rest], np_dtype, ps_id)
+            local = arrs[0].reshape(my_rows, _row_elems(rest))
             pad = max_d - local.shape[0]
             if pad:
                 local = jnp.concatenate(
@@ -313,6 +319,27 @@ class XlaIciDataPlane:
                 self._exec_cache[sig] = fn
             g = self._global(mesh, group, local[None])
             out = fn(g).addressable_data(0).reshape((sum(dims),) + rest)
+            self._store(names, ps_id, [out])
+        elif op_class == _OP_ALLTOALL:
+            # Equal splits only (the coordinator enforces identical
+            # shapes): rank r's block j goes to rank j, landing at
+            # position r — one lax.all_to_all, static shapes.
+            shape = shapes[0]
+            first = shape[0] if shape else 1
+            rest = shape[1:] if shape else ()
+            if first % group:
+                raise ValueError(
+                    f"device alltoall first dim {first} not divisible by "
+                    f"group size {group}")
+            arrs, _ = self._take_inputs(names, shapes, np_dtype, ps_id)
+            sig = (op_class, members, np_dtype.str, tuple(shape))
+            fn = self._exec_cache.get(sig)
+            if fn is None:
+                fn = _build_alltoall(mesh, group)
+                self._exec_cache[sig] = fn
+            g = self._global(mesh, group,
+                             arrs[0].reshape(1, first, _row_elems(rest)))
+            out = fn(g).addressable_data(0).reshape((first,) + rest)
             self._store(names, ps_id, [out])
         elif op_class == _OP_REDUCESCATTER:
             arrs, scales = self._take_inputs(names, shapes, np_dtype, ps_id)
@@ -333,9 +360,8 @@ class XlaIciDataPlane:
                 fn = _build_reducescatter(mesh, group, reduce_op, scales[0],
                                           off, rows[my_pos])
                 self._exec_cache[sig] = fn
-            restf = _nelem(rest)
             g = self._global(mesh, group,
-                             arrs[0].reshape(1, first, restf if restf else 1))
+                             arrs[0].reshape(1, first, _row_elems(rest)))
             out = fn(g).addressable_data(0).reshape((rows[my_pos],) + rest)
             self._store(names, ps_id, [out])
         else:
@@ -419,6 +445,19 @@ def _build_allgather(mesh, dims):
     return jax.jit(_shard_map(inner, mesh, P("hvd"), P(None)))
 
 
+def _build_alltoall(mesh, group):
+    def inner(block):  # (1, first, restf)
+        x = block[0]
+        first, restf = x.shape
+        x = x.reshape(group, first // group, restf)
+        y = lax.all_to_all(x, "hvd", split_axis=0, concat_axis=0)
+        return y.reshape(1, first, restf)
+
+    # Output differs per rank: stays sharded over "hvd", each process
+    # reads its own shard.
+    return jax.jit(_shard_map(inner, mesh, P("hvd"), P("hvd")))
+
+
 def _build_reducescatter(mesh, group, reduce_op, scale, off, nrows):
     pre, post = scale
 
@@ -492,8 +531,15 @@ _ENQUEUE_OPS = {
     "allreduce": _OP_ALLREDUCE,
     "allgather": _OP_ALLGATHER,
     "broadcast": _OP_BROADCAST,
+    "alltoall": _OP_ALLTOALL,
     "reducescatter": _OP_REDUCESCATTER,
 }
+
+
+def alltoall_group_size(process_set_id):
+    """Member count of the set, for the frontend's equal-split check."""
+    members = process_sets.members_of(int(process_set_id))
+    return len(members) if members else 0
 
 
 def enqueue_device(kind, array, name, reduce_op=ReduceOp.SUM,

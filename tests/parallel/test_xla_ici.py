@@ -149,6 +149,50 @@ def test_device_fusion_and_executable_cache():
                      timeout=240) == ["ok"] * 2
 
 
+def _worker_alltoall(rank, size):
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        # Equal splits: rank r sends block j (rows of value r*10+j) to
+        # rank j; rank r ends with [0*10+r, 1*10+r, ...].
+        rows = 2
+        x = jnp.concatenate([jnp.full((rows, 3), float(rank * 10 + j))
+                             for j in range(size)])
+        out = hvd.alltoall(x)
+        assert isinstance(out, jax.Array)
+        exp = np.concatenate([np.full((rows, 3), float(j * 10 + rank))
+                              for j in range(size)])
+        np.testing.assert_allclose(np.asarray(out), exp)
+        # Steady state: repeated device alltoall hits the response cache
+        # (static shapes make it cacheable, unlike the host path).
+        from horovod_tpu.common.basics import HorovodBasics
+        for _ in range(3):
+            out = hvd.alltoall(x, name="a2a.steady")
+        hits, _, _ = HorovodBasics().response_cache_stats()
+        assert hits > 0, "device alltoall never hit the response cache"
+        # Ragged splits fall back to the host ring transparently.
+        splits = [rank + 1] + [1] * (size - 1)
+        total = sum(splits)
+        xr = jnp.arange(total, dtype=jnp.float32)
+        out = hvd.alltoall(xr, splits=splits)
+        assert out.ndim == 1
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_alltoall():
+    # Equal-split device alltoall is opt-in (a rank can't see its peers'
+    # shapes, so ragged splits=None must default to the host ring).
+    env = dict(_ENV, HOROVOD_XLA_ALLTOALL="1")
+    assert run_ranks(_worker_alltoall, 2, env=env,
+                     timeout=240) == ["ok"] * 2
+
+
 def _worker_grouped_atomic(rank, size):
     import jax.numpy as jnp
 
@@ -276,6 +320,37 @@ def _worker_adasum_host_fallback(rank, size):
 
 def test_adasum_falls_back_to_host_path():
     assert run_ranks(_worker_adasum_host_fallback, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
+def _worker_timeline_xprof(rank, size):
+    import glob
+    import json
+    import os
+    import tempfile
+
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        d = tempfile.mkdtemp()
+        tl = os.path.join(d, "t.json")
+        hvd.start_timeline(tl, xprof_dir=d)
+        out = hvd.allreduce(jnp.ones((4,)), op=hvd.Sum)
+        assert float(out[0]) == size
+        hvd.stop_timeline()
+        json.load(open(tl))  # valid chrome trace
+        assert glob.glob(d + "/**/*.xplane.pb", recursive=True), \
+            "no xprof trace written"
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_timeline_with_xprof_bridge():
+    assert run_ranks(_worker_timeline_xprof, 2, env=_ENV,
                      timeout=240) == ["ok"] * 2
 
 
