@@ -130,6 +130,9 @@ struct GlobalState {
   // never enqueued. Reference analog: global_state.h joined flag.
   std::atomic<bool> joined{false};
   std::atomic<int> last_joined_rank{-1};
+  // HOROVOD_HIERARCHICAL_ALLREDUCE: three-phase allreduce keeping most
+  // of the payload on the intra-node transport.
+  bool hierarchical = false;
   // Barrier sequence numbers, PER process set; must stay aligned across a
   // set's members, including barriers a joined rank participated in only
   // via synthesis. A global counter would desync when only a subset of
@@ -159,6 +162,21 @@ void ApplyPostOp(TensorTableEntry& e, void* buf, int64_t count, int size) {
   ScaleBuffer(buf, count, e.dtype, post);
 }
 
+// Flat ring, or three-phase hierarchical when enabled and the layout
+// allows (global set, >1 node, >1 rank per node, host-major ranks).
+// Reference analog: the NCCLAllreduce vs NCCLHierarchicalAllreduce pick
+// under HOROVOD_HIERARCHICAL_ALLREDUCE.
+Status RingAllreduce(GlobalState& st, DataPlane* dp, void* buf,
+                     int64_t count, DataType dt, ReduceOp op) {
+  // st.hierarchical is only true after the collective eligibility check
+  // at init (homogeneous host-major layout) — so the remaining per-call
+  // condition is just "global process set".
+  if (st.hierarchical && dp->size() == st.size) {
+    return dp->HierarchicalAllreduce(buf, count, dt, op, st.local_size);
+  }
+  return dp->Allreduce(buf, count, dt, op);
+}
+
 Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
                         std::vector<TensorTableEntry>& entries) {
   if (entries.size() == 1) {
@@ -168,7 +186,8 @@ Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
     }
     ScaleBuffer(e.output, e.NumElements(), e.dtype, e.prescale_factor);
     st.timeline.ActivityStart(e.name, "RING_ALLREDUCE");
-    Status s = dp->Allreduce(e.output, e.NumElements(), e.dtype, e.reduce_op);
+    Status s = RingAllreduce(st, dp, e.output, e.NumElements(), e.dtype,
+                             e.reduce_op);
     st.timeline.ActivityEnd(e.name);
     if (!s.ok()) return s;
     ApplyPostOp(e, e.output, e.NumElements(), dp->size());
@@ -192,7 +211,7 @@ Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
   DataType dt = entries[0].dtype;
   int64_t count = total / DataTypeSize(dt);
   for (auto& e : entries) st.timeline.ActivityStart(e.name, "RING_ALLREDUCE");
-  Status s = dp->Allreduce(base, count, dt, entries[0].reduce_op);
+  Status s = RingAllreduce(st, dp, base, count, dt, entries[0].reduce_op);
   for (auto& e : entries) st.timeline.ActivityEnd(e.name);
   if (!s.ok()) return s;
   off = 0;
@@ -616,6 +635,7 @@ int hvdtpu_init() {
   st->fusion_threshold =
       EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
   st->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
+  st->hierarchical = EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0;
 
   st->process_sets = std::make_unique<ProcessSetTable>(st->size);
 
@@ -636,6 +656,35 @@ int hvdtpu_init() {
     LOG_ERROR("init failed: %s", s.reason().c_str());
     st->controller.reset();
     return -1;
+  }
+  if (st->hierarchical && st->size > 1) {
+    // Eligibility must be agreed COLLECTIVELY: a per-rank decision from
+    // local env alone deadlocks when ranks diverge (heterogeneous
+    // local sizes, non-host-major placement). Every rank contributes
+    // (local_size, -local_size, layout-matches-host-major) and a MIN
+    // allreduce yields the global verdict identically everywhere.
+    int64_t probe[3] = {
+        st->local_size, -(int64_t)st->local_size,
+        (st->local_rank == st->rank % std::max(st->local_size, 1) &&
+         st->cross_rank == st->rank / std::max(st->local_size, 1))
+            ? 1
+            : 0};
+    Status hs = st->controller->data_plane()->Allreduce(
+        probe, 3, DataType::HVDTPU_INT64, ReduceOp::MIN);
+    bool homogeneous = hs.ok() && probe[0] == -probe[1];
+    bool host_major = hs.ok() && probe[2] == 1;
+    if (!hs.ok() || !homogeneous || !host_major || st->local_size <= 1 ||
+        st->size % st->local_size != 0 || st->size == st->local_size) {
+      if (st->rank == 0) {
+        LOG_WARN(
+            "HOROVOD_HIERARCHICAL_ALLREDUCE disabled: requires a "
+            "homogeneous host-major layout with >1 rank per node on >1 "
+            "nodes (local sizes %s, layout %s)",
+            homogeneous ? "uniform" : "mixed",
+            host_major ? "host-major" : "not host-major");
+      }
+      st->hierarchical = false;
+    }
   }
   std::string timeline_path = EnvStr("HOROVOD_TIMELINE", "");
   // Env-driven timeline records on the coordinator only: every rank shares

@@ -177,6 +177,56 @@ DataPlane DataPlane::Subset(const std::vector<int32_t>& members) const {
   return sub;
 }
 
+Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
+                                        ReduceOp op, int local_size) {
+  if (size_ == 1 || count == 0) return Status::OK();
+  if (local_size <= 1 || size_ % local_size != 0 ||
+      op == ReduceOp::ADASUM) {
+    return Allreduce(buf, count, dt, op);
+  }
+  const int cross_size = size_ / local_size;
+  if (cross_size <= 1) return Allreduce(buf, count, dt, op);
+  const int local_rank = rank_ % local_size;
+  const int node = rank_ / local_size;
+  const int64_t elem = DataTypeSize(dt);
+
+  // Local group: the ranks on this node; cross group: same local_rank on
+  // every node (host-major layout).
+  std::vector<int32_t> local_members(local_size);
+  for (int i = 0; i < local_size; i++) {
+    local_members[i] = node * local_size + i;
+  }
+  std::vector<int32_t> cross_members(cross_size);
+  for (int k = 0; k < cross_size; k++) {
+    cross_members[k] = k * local_size + local_rank;
+  }
+  DataPlane local = Subset(local_members);
+  DataPlane cross = Subset(cross_members);
+
+  // Phase 1: local reduce-scatter — this rank ends with its segment
+  // reduced across the node.
+  std::vector<int64_t> seg(local_size);
+  int64_t q = count / local_size, r = count % local_size;
+  for (int i = 0; i < local_size; i++) {
+    seg[i] = q + (i < r ? 1 : 0);
+  }
+  std::vector<uint8_t> my_seg((size_t)(seg[local_rank] * elem));
+  Status s = local.ReduceScatterv(buf, my_seg.data(), seg, dt, op,
+                                  /*destructive=*/true);
+  if (!s.ok()) return s;
+
+  // Phase 2: allreduce the segment across nodes (1/local_size of the
+  // payload crosses the node boundary).
+  s = cross.Allreduce(my_seg.data(), seg[local_rank], dt, op);
+  if (!s.ok()) return s;
+
+  // Phase 3: local allgather of the fully-reduced segments — rank-order
+  // concatenation is exactly the original buffer layout.
+  std::vector<int64_t> seg_bytes(local_size);
+  for (int i = 0; i < local_size; i++) seg_bytes[i] = seg[i] * elem;
+  return local.Allgatherv(my_seg.data(), buf, seg_bytes);
+}
+
 Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
                             ReduceOp op) {
   if (size_ == 1 || count == 0) return Status::OK();
@@ -314,7 +364,7 @@ Status DataPlane::Alltoallv(const void* input,
 
 Status DataPlane::ReduceScatterv(const void* input, void* output,
                                  const std::vector<int64_t>& elems_per_rank,
-                                 DataType dt, ReduceOp op) {
+                                 DataType dt, ReduceOp op, bool destructive) {
   const int64_t elem = DataTypeSize(dt);
   if (size_ == 1) {
     std::memcpy(output, input, (size_t)(elems_per_rank[0] * elem));
@@ -327,13 +377,20 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
     off += elems_per_rank[i];
     max_seg = std::max(max_seg, elems_per_rank[i]);
   }
-  // Work in a private copy so the caller's input is untouched.
-  std::vector<uint8_t> work((size_t)(off * elem));
-  std::memcpy(work.data(), input, work.size());
+  // Destructive mode clobbers the caller's buffer in place (hierarchical
+  // allreduce rewrites it in phase 3 anyway); otherwise work in a
+  // private copy so the caller's input is untouched.
+  std::vector<uint8_t> work;
+  uint8_t* base;
+  if (destructive) {
+    base = (uint8_t*)const_cast<void*>(input);
+  } else {
+    work.assign((const uint8_t*)input, (const uint8_t*)input + off * elem);
+    base = work.data();
+  }
   if ((int64_t)scratch_.size() < max_seg * elem) {
     scratch_.resize((size_t)(max_seg * elem));
   }
-  auto* base = work.data();
   // Segment rotation offset of -1: after size-1 steps the segment that has
   // accumulated all `size` contributions at rank r is exactly segment r.
   for (int step = 0; step < size_ - 1; step++) {

@@ -39,6 +39,15 @@ class DataPlane {
   // to AdasumAllreduce.
   Status Allreduce(void* buf, int64_t count, DataType dt, ReduceOp op);
 
+  // Hierarchical allreduce (HOROVOD_HIERARCHICAL_ALLREDUCE): local
+  // reduce-scatter -> cross-node allreduce of each segment among
+  // same-local-rank peers -> local allgather, cutting cross-node traffic
+  // by the local group size. Requires the host-major homogeneous layout
+  // (rank = cross_rank * local_size + local_rank) on the GLOBAL plane.
+  // Reference analog: NCCLHierarchicalAllreduce (ops/nccl_operations.cc).
+  Status HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
+                               ReduceOp op, int local_size);
+
   // Adaptive-summation allreduce (recursive doubling, floats only).
   // Reference analog: ops/adasum/ (see csrc/adasum.cc).
   Status AdasumAllreduce(void* buf, int64_t count, DataType dt);
@@ -56,10 +65,11 @@ class DataPlane {
                    void* output, const std::vector<int64_t>& recv_bytes);
 
   // Ring reduce-scatter: every rank holds the full `input`; rank r's output
-  // is its reduced segment of elems_per_rank[r] elements.
+  // is its reduced segment of elems_per_rank[r] elements. `destructive`
+  // permits clobbering `input` in place (skips the private work copy).
   Status ReduceScatterv(const void* input, void* output,
                         const std::vector<int64_t>& elems_per_rank,
-                        DataType dt, ReduceOp op);
+                        DataType dt, ReduceOp op, bool destructive = false);
 
   Status Barrier();
 

@@ -78,6 +78,9 @@ def parse_args(argv=None):
                             "FATAL"])
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--hierarchical-allreduce", action="store_true",
+                   help="three-phase allreduce: local reduce-scatter, "
+                        "cross-node allreduce, local allgather")
     p.add_argument("--config-file", default=None,
                    help="YAML file of the above knobs")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -145,6 +148,8 @@ def env_from_args(args):
         env["HOROVOD_AUTOTUNE"] = "1"
     if args.autotune_log_file:
         env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.hierarchical_allreduce:
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.nics:
         env["HOROVOD_GLOO_IFACE"] = args.nics
     return env
