@@ -1,0 +1,10 @@
+"""horovod_tpu.tensorflow.keras — tf.keras frontend alias.
+
+Reference analog: ``horovod/tensorflow/keras/__init__.py`` — the
+tf.keras-flavored entry point; identical surface to
+``horovod_tpu.keras`` (which targets the same tf.keras here, since
+standalone Keras is not a separate install in this environment).
+"""
+
+from horovod_tpu.keras import *  # noqa: F401,F403
+from horovod_tpu.keras import callbacks  # noqa: F401
