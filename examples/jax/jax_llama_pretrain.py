@@ -5,11 +5,16 @@ which is pure data-parallel — SURVEY.md §5.7): a 4-axis
 data/fsdp/tensor/seq ``jax.sharding.Mesh``, megatron-style TP + FSDP
 parameter shardings, ring attention over the seq axis for long context,
 and one jitted train step that XLA turns into fused compute+collectives
-over ICI.
+over ICI. ``--experts/--ep`` switch the FFNs to expert-parallel sparse
+MoE; ``--pp`` pipelines the layer stack GPipe-style over the pipe axis.
 
 Run on anything (CPU simulates a mesh):
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python examples/jax/jax_llama_pretrain.py --dp 2 --fsdp 2 --tp 2 --sp 1
+  # sparse-MoE with expert parallelism:
+  #   ... --dp 2 --fsdp 1 --tp 2 --ep 2
+  # pipeline parallelism:
+  #   ... --dp 1 --fsdp 2 --tp 2 --pp 2
 """
 
 import argparse
@@ -35,13 +40,22 @@ def main():
     ap.add_argument("--fsdp", type=int, default=2, help="fsdp shards")
     ap.add_argument("--tp", type=int, default=2, help="tensor parallel")
     ap.add_argument("--sp", type=int, default=1, help="sequence parallel")
+    ap.add_argument("--ep", type=int, default=1, help="expert parallel")
+    ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    ap.add_argument("--experts", type=int, default=0,
+                    help="sparse-MoE experts (0 = dense FFN)")
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--d-model", type=int, default=128)
     ap.add_argument("--n-layers", type=int, default=4)
     args = ap.parse_args()
 
-    n_needed = args.dp * args.fsdp * args.tp * args.sp
+    if args.pp > 1 and args.sp > 1:
+        raise SystemExit("--pp and --sp are mutually exclusive (ring "
+                         "attention cannot nest inside the pipeline)")
+    if args.ep > 1 and not args.experts:
+        args.experts = 2 * args.ep
+    n_needed = args.dp * args.fsdp * args.tp * args.sp * args.ep * args.pp
     if len(jax.devices()) < n_needed:
         raise SystemExit(
             f"need {n_needed} devices, have {len(jax.devices())} "
@@ -49,20 +63,31 @@ def main():
 
     mesh = parallel.create_mesh(data=args.dp, fsdp=args.fsdp,
                                 tensor=args.tp, seq=args.sp,
+                                expert=args.ep, pipe=args.pp,
                                 devices=jax.devices()[:n_needed])
 
     heads = max(8, args.tp * 2)
+    n_layers = args.n_layers
+    if args.pp > 1 and n_layers % args.pp:
+        # Round UP so the requested capacity is never silently shrunk.
+        n_layers = args.pp * (n_layers // args.pp + 1)
+        print(f"note: --n-layers rounded to {n_layers} "
+              f"(must divide into {args.pp} pipeline stages)")
     cfg = LlamaConfig.tiny(
-        d_model=args.d_model, n_layers=args.n_layers, n_heads=heads,
-        n_kv_heads=heads, d_ff=4 * args.d_model, vocab_size=512)
+        d_model=args.d_model, n_layers=n_layers, n_heads=heads,
+        n_kv_heads=heads, d_ff=4 * args.d_model, vocab_size=512,
+        n_experts=args.experts)
 
     params = llama_init(cfg, jax.random.PRNGKey(0))
-    shardings = parallel.shard_params(params, mesh, llama_partition_rules())
+    shardings = parallel.shard_params(
+        params, mesh, llama_partition_rules(pipeline=args.pp > 1))
     params = apply_sharding(params, shardings)
     tx = optax.adamw(3e-4, weight_decay=0.01)
     opt_state = tx.init(params)
 
-    batch_size = 2 * args.dp * args.fsdp
+    # Batch must split into dp*fsdp shards AND pp microbatches.
+    per = 2 * args.dp * args.fsdp
+    batch_size = per if per % max(args.pp, 1) == 0 else per * args.pp
 
     @jax.jit
     def train_step(params, opt_state, batch):

@@ -33,6 +33,16 @@ def gpipe(stage_fn, stage_params, xs, mesh, axis="pipe"):
     S = mesh.shape[axis]
     M = xs.shape[0]
 
+    # XLA CPU's AllReducePromotion pass crashes on the bf16 allreduces
+    # this program generates (the collection psum and AD's cotangent
+    # psum for the replicated xs input). CPU is the test substrate, so
+    # run the pipeline in f32 there; TPU keeps native bf16.
+    on_cpu = all(d.platform == "cpu" for d in mesh.devices.flat)
+    cast_dt = None
+    if on_cpu and xs.dtype in (jnp.bfloat16, jnp.float16):
+        cast_dt = xs.dtype
+        xs = xs.astype(jnp.float32)
+
     def inner(sp, xs_):
         stage = lax.axis_index(axis)
 
@@ -59,12 +69,22 @@ def gpipe(stage_fn, stage_params, xs, mesh, axis="pipe"):
                 jnp.zeros((), jnp.float32))
         (carry, buf, aux), _ = lax.scan(step, init, jnp.arange(M + S - 1))
         # Results live on the last stage; the loss is computed globally,
-        # so share them (and the aux total) across the pipe axis.
-        buf = lax.psum(
-            jnp.where(stage == S - 1, buf, jnp.zeros_like(buf)), axis)
+        # so share them (and the aux total) across the pipe axis. The
+        # psum runs in f32 for sub-f32 activations: XLA CPU's
+        # AllReducePromotion pass crashes on bf16 allreduce inside
+        # manual shard_map, and on TPU the f32 cast is fused anyway.
+        out_dt = buf.dtype
+        masked = jnp.where(stage == S - 1, buf, jnp.zeros_like(buf))
+        if out_dt in (jnp.bfloat16, jnp.float16):
+            buf = lax.psum(masked.astype(jnp.float32), axis).astype(out_dt)
+        else:
+            buf = lax.psum(masked, axis)
         aux = lax.psum(aux, axis)
         return buf, aux
 
-    return jax.shard_map(inner, mesh=mesh, in_specs=(P(axis), P()),
-                         out_specs=(P(), P()), axis_names={axis},
-                         check_vma=False)(stage_params, xs)
+    ys, aux = jax.shard_map(inner, mesh=mesh, in_specs=(P(axis), P()),
+                            out_specs=(P(), P()), axis_names={axis},
+                            check_vma=False)(stage_params, xs)
+    if cast_dt is not None:
+        ys = ys.astype(cast_dt)
+    return ys, aux

@@ -278,3 +278,27 @@ def test_pipeline_rejects_seq_parallel():
                                 devices=jax.devices()[:8])
     with pytest.raises(ValueError, match="sequence parallelism"):
         llama_forward(params, tokens, cfg, mesh)
+
+
+def test_pipeline_bf16_compiles_on_cpu():
+    """bf16 activations through the pipeline must not hit XLA CPU's
+    AllReducePromotion crash (regression: gpipe runs f32 on CPU)."""
+    _skip_unless_8()
+    cfg = LlamaConfig.tiny(n_layers=4, remat=False)  # default bf16
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    mesh = parallel.create_mesh(pipe=2, fsdp=2, tensor=2,
+                                devices=jax.devices()[:8])
+    p_sh = apply_sharding(
+        params, parallel.shard_params(params, mesh,
+                                      llama_partition_rules(pipeline=True)))
+    b_sh = jax.device_put(batch, named_sharding(mesh, ("data", "fsdp"),
+                                                "seq"))
+    loss, grads = jax.jit(
+        jax.value_and_grad(lambda p, b: llama_loss(p, b, cfg, mesh)))(
+            p_sh, b_sh)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g, dtype=np.float32)).all()
+               for g in jax.tree.leaves(grads))
