@@ -39,14 +39,61 @@ def _to_host(tree):
 
 
 class JaxState(State):
-    """Elastic state over named pytrees / picklable values."""
+    """Elastic state over named pytrees / picklable values.
 
-    def __init__(self, **kwargs):
+    ``checkpoint_dir`` makes every ``commit()`` also durable on disk via
+    the orbax engine (horovod_tpu.checkpoint) — surviving full-job
+    restarts, not just in-memory rollback. ``resume()`` reloads the
+    newest on-disk commit. Reference analog: the reference's elastic
+    State is memory-only (SURVEY.md §5.4); the disk layer is the
+    TPU-idiomatic extension.
+    """
+
+    def __init__(self, checkpoint_dir=None, **kwargs):
         super().__init__()
         self._keys = list(kwargs)
         for k, v in kwargs.items():
             setattr(self, k, v)
+        self._ckpt_mgr = None
+        self._commit_step = 0
+        if checkpoint_dir is not None:
+            from horovod_tpu.checkpoint import CheckpointManager
+
+            self._ckpt_mgr = CheckpointManager(checkpoint_dir)
+            # Continue numbering past any previous run's commits — orbax
+            # silently skips steps that already exist on disk, so
+            # restarting at 0 would drop every durable commit.
+            self._commit_step = self._ckpt_mgr.latest_step() or 0
         self.save()
+
+    def commit(self):
+        self.save()
+        if self._ckpt_mgr is not None:
+            from horovod_tpu.checkpoint import encode_pytree
+
+            self._commit_step += 1
+            # encode: non-array values (run names, dicts of config, ...)
+            # are legal elastic state but not orbax leaves.
+            self._ckpt_mgr.save(self._commit_step,
+                                encode_pytree(self._saved))
+        self.check_host_updates()
+
+    def resume(self):
+        """Load the newest on-disk commit into this state (cold restart).
+
+        Returns the restored step number, or None when the directory has
+        no checkpoint yet."""
+        if self._ckpt_mgr is None:
+            raise ValueError("JaxState was created without checkpoint_dir")
+        step = self._ckpt_mgr.latest_step()
+        if step is None:
+            return None
+        from horovod_tpu.checkpoint import decode_pytree
+
+        self._saved = decode_pytree(self._ckpt_mgr.restore(step))
+        self._commit_step = step
+        self.restore()
+        return step
 
     def save(self):
         self._saved = {k: _to_host(getattr(self, k)) for k in self._keys}

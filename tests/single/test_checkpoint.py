@@ -1,0 +1,124 @@
+"""Checkpoint engine (orbax): one-shot, managed, sharded, elastic.
+
+Reference analog: none in-core (SURVEY.md §5.4 — the reference delegates
+checkpointing to frameworks); this is the TPU-idiomatic engine the
+elastic/keras/spark layers compose with.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu import checkpoint as ckpt
+from horovod_tpu import parallel
+
+
+def test_one_shot_roundtrip(tmp_path):
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+             "step": np.int64(7)}
+    ckpt.save(tmp_path / "one", state)
+    back = ckpt.restore(tmp_path / "one")
+    np.testing.assert_allclose(np.asarray(back["params"]["w"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert int(back["step"]) == 7
+
+
+def test_manager_retention_and_steps(tmp_path):
+    with ckpt.CheckpointManager(tmp_path / "mgr", max_to_keep=2) as mgr:
+        for s in (1, 2, 3):
+            mgr.save(s, {"x": jnp.full((4,), float(s))}, wait=True)
+        assert mgr.latest_step() == 3
+        np.testing.assert_allclose(np.asarray(mgr.restore()["x"]), 3.0)
+        np.testing.assert_allclose(np.asarray(mgr.restore(step=2)["x"]), 2.0)
+    assert sorted(os.listdir(tmp_path / "mgr")) == ["2", "3"]
+
+
+def test_manager_restore_missing_raises(tmp_path):
+    with ckpt.CheckpointManager(tmp_path / "empty") as mgr:
+        assert mgr.latest_step() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+
+
+def test_sharded_restore_onto_mesh(tmp_path):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = parallel.create_mesh(fsdp=2, tensor=2, devices=jax.devices()[:4])
+    sh = NamedSharding(mesh, P("fsdp", "tensor"))
+    w = jax.device_put(jnp.arange(24.0).reshape(4, 6), sh)
+    ckpt.save(tmp_path / "sharded", {"w": w})
+    target = {"w": jax.ShapeDtypeStruct((4, 6), jnp.float32, sharding=sh)}
+    back = ckpt.restore(tmp_path / "sharded", target=target)
+    assert back["w"].sharding == sh
+    np.testing.assert_allclose(np.asarray(back["w"]),
+                               np.arange(24.0).reshape(4, 6))
+
+
+def test_elastic_state_durable_commit_and_resume(tmp_path):
+    from horovod_tpu.jax.elastic import JaxState
+
+    s1 = JaxState(checkpoint_dir=tmp_path / "el",
+                  params={"w": jnp.zeros((3,))}, epoch=0)
+    s1.params = {"w": jnp.full((3,), 5.0)}
+    s1.epoch = 4
+    s1.commit()
+    s1._ckpt_mgr.wait()
+
+    # Cold restart: a fresh state resumes the last durable commit.
+    s2 = JaxState(checkpoint_dir=tmp_path / "el",
+                  params={"w": jnp.zeros((3,))}, epoch=0)
+    step = s2.resume()
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(s2.params["w"]), 5.0)
+    assert int(s2.epoch) == 4
+
+    # In-memory rollback still works on top.
+    s2.params = {"w": jnp.full((3,), 9.0)}
+    s2.restore()
+    np.testing.assert_allclose(np.asarray(s2.params["w"]), 5.0)
+
+
+def test_restart_without_resume_keeps_committing(tmp_path):
+    """A fresh JaxState on an existing dir must continue step numbering
+    (regression: orbax silently skips existing steps, so restarting at 0
+    dropped every durable commit)."""
+    from horovod_tpu.jax.elastic import JaxState
+
+    s1 = JaxState(checkpoint_dir=tmp_path / "el", v=jnp.zeros(()))
+    s1.v = jnp.asarray(1.0)
+    s1.commit()
+    s1._ckpt_mgr.wait()
+
+    s2 = JaxState(checkpoint_dir=tmp_path / "el", v=jnp.zeros(()))
+    s2.v = jnp.asarray(2.0)
+    s2.commit()  # must land as step 2, not a silently-skipped step 1
+    s2._ckpt_mgr.wait()
+    assert s2._ckpt_mgr.latest_step() == 2
+
+    s3 = JaxState(checkpoint_dir=tmp_path / "el", v=jnp.zeros(()))
+    assert s3.resume() == 2
+    np.testing.assert_allclose(float(s3.v), 2.0)
+
+
+def test_elastic_state_with_non_array_values(tmp_path):
+    """Strings and arbitrary picklables are legal elastic state; durable
+    commits must round-trip them (regression: orbax rejects str leaves
+    in a deferred async error)."""
+    from horovod_tpu.jax.elastic import JaxState
+
+    s1 = JaxState(checkpoint_dir=tmp_path / "el",
+                  params={"w": jnp.ones((2,))},
+                  run_name="exp-42", meta={"lr": 0.1, "tag": "warmup"})
+    s1.commit()
+    s1._ckpt_mgr.wait()
+
+    s2 = JaxState(checkpoint_dir=tmp_path / "el",
+                  params={"w": jnp.zeros((2,))}, run_name="", meta={})
+    assert s2.resume() == 1
+    assert s2.run_name == "exp-42"
+    assert s2.meta["tag"] == "warmup"
+    np.testing.assert_allclose(np.asarray(s2.params["w"]), 1.0)
