@@ -34,17 +34,62 @@ def _cpu_state_dict(sd):
 class TorchState(State):
     """Elastic state for a model + optimizer (+ extra picklable attrs).
 
-    Reference analog: hvd.elastic.TorchState.
+    Reference analog: hvd.elastic.TorchState. ``checkpoint_dir`` makes
+    every ``commit()`` also durable on disk (whole-state pickle through
+    the orbax engine — torch state dicts are host tensors, so there is
+    no sharded-array layout to preserve) and ``resume()`` reloads the
+    newest commit after a full job restart; the reference's state is
+    memory-only (SURVEY.md §5.4).
     """
 
-    def __init__(self, model=None, optimizer=None, **kwargs):
+    def __init__(self, model=None, optimizer=None, checkpoint_dir=None,
+                 **kwargs):
         super().__init__()
         self.model = model
         self.optimizer = optimizer
         self._extra_keys = list(kwargs)
         for k, v in kwargs.items():
             setattr(self, k, v)
+        self._ckpt_mgr = None
+        self._commit_step = 0
+        if checkpoint_dir is not None:
+            from horovod_tpu.checkpoint import CheckpointManager
+
+            self._ckpt_mgr = CheckpointManager(checkpoint_dir)
+            self._commit_step = self._ckpt_mgr.latest_step() or 0
         self.save()
+
+    def commit(self):
+        self.save()
+        if self._ckpt_mgr is not None:
+            import pickle
+
+            import numpy as np
+
+            self._commit_step += 1
+            blob = np.frombuffer(pickle.dumps(self._saved),
+                                 np.uint8).copy()
+            self._ckpt_mgr.save(self._commit_step, {"state": blob})
+        self.check_host_updates()
+
+    def resume(self):
+        """Load the newest on-disk commit (cold restart). Returns the
+        restored step, or None when no checkpoint exists yet."""
+        if self._ckpt_mgr is None:
+            raise ValueError(
+                "TorchState was created without checkpoint_dir")
+        step = self._ckpt_mgr.latest_step()
+        if step is None:
+            return None
+        import pickle
+
+        import numpy as np
+
+        blob = self._ckpt_mgr.restore(step)["state"]
+        self._saved = pickle.loads(np.asarray(blob).tobytes())
+        self._commit_step = step
+        self.restore()
+        return step
 
     def save(self):
         self._saved = {

@@ -122,3 +122,27 @@ def test_elastic_state_with_non_array_values(tmp_path):
     assert s2.run_name == "exp-42"
     assert s2.meta["tag"] == "warmup"
     np.testing.assert_allclose(np.asarray(s2.params["w"]), 1.0)
+
+
+def test_torch_state_durable_commit_and_resume(tmp_path):
+    import torch
+
+    from horovod_tpu.torch.elastic import TorchState
+
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    s1 = TorchState(model=model, optimizer=opt,
+                    checkpoint_dir=tmp_path / "t", epoch=0)
+    with torch.no_grad():
+        model.weight.fill_(2.5)
+    s1.epoch = 3
+    s1.commit()
+    s1._ckpt_mgr.wait()
+
+    model2 = torch.nn.Linear(3, 2)
+    opt2 = torch.optim.SGD(model2.parameters(), lr=0.1)
+    s2 = TorchState(model=model2, optimizer=opt2,
+                    checkpoint_dir=tmp_path / "t", epoch=0)
+    assert s2.resume() == 1
+    assert int(s2.epoch) == 3
+    np.testing.assert_allclose(model2.weight.detach().numpy(), 2.5)
