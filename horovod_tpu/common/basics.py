@@ -239,3 +239,80 @@ class HorovodBasics:
         return (self.lib.hvdtpu_response_cache_hits(),
                 self.lib.hvdtpu_response_cache_misses(),
                 self.lib.hvdtpu_response_cache_entries())
+
+    # ---- capability surface -------------------------------------------
+    # Frontends re-export exactly these names (single source of truth).
+    CAPABILITY_NAMES = (
+        "gloo_built", "gloo_enabled", "mpi_built", "mpi_enabled",
+        "mpi_threads_supported", "xla_built", "xla_enabled", "nccl_built",
+        "cuda_built", "rocm_built", "ccl_built", "ddl_built")
+
+    # Reference analog: horovod/common/basics.py mpi_built/gloo_built/
+    # nccl_built/... — scripts probe these to pick code paths. Mapping:
+    # the TCP controller+ring plays Gloo's role (always built in), MPI is
+    # supported as a LAUNCH mode (mpirun env pickup, not an MPI library
+    # link), the xla_ici device plane replaces NCCL, and the CUDA/ROCm/
+    # oneCCL/DDL backends have no TPU analog.
+
+    def gloo_built(self, verbose=False):
+        """The built-in TCP controller + ring collectives (Gloo's role)."""
+        del verbose
+        return True
+
+    def gloo_enabled(self):
+        return True
+
+    def mpi_built(self, verbose=False):
+        """True: mpirun/srun/jsrun launches are supported via env pickup
+        (HOROVOD_* derived from OMPI/SLURM/LSF variables)."""
+        del verbose
+        return True
+
+    def mpi_enabled(self):
+        import os
+
+        # Same launcher variables _ENV_FALLBACKS accepts for rank pickup.
+        return any(v in os.environ
+                   for v in self._ENV_FALLBACKS["HOROVOD_RANK"])
+
+    def mpi_threads_supported(self):
+        # The controller owns all communication from one background
+        # thread; user threads only enqueue (thread-safe queue).
+        return True
+
+    def xla_built(self, verbose=False):
+        """Whether the xla_ici device data plane is importable."""
+        del verbose
+        try:
+            import jax  # noqa: F401
+
+            return True
+        except ImportError:  # pragma: no cover
+            return False
+
+    def xla_enabled(self):
+        """Whether the device data plane is ACTIVE in this process."""
+        import sys
+
+        mod = sys.modules.get("horovod_tpu.jax.xla_ici")
+        return bool(mod is not None and mod.active())
+
+    def nccl_built(self, verbose=False):
+        del verbose
+        return False  # the xla_ici device plane plays NCCL's role
+
+    def cuda_built(self, verbose=False):
+        del verbose
+        return False
+
+    def rocm_built(self, verbose=False):
+        del verbose
+        return False
+
+    def ccl_built(self, verbose=False):
+        del verbose
+        return False
+
+    def ddl_built(self, verbose=False):
+        del verbose
+        return False

@@ -77,3 +77,19 @@ from horovod_tpu.jax.optimizer import (  # noqa: F401
 )
 
 from horovod_tpu.jax import elastic  # noqa: E402,F401
+
+# Capability surface (reference analog: hvd.mpi_built()/gloo_built()/...).
+from horovod_tpu.jax.mpi_ops import (  # noqa: F401,E402
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
+    xla_built,
+    xla_enabled,
+)

@@ -107,3 +107,19 @@ def DistributedOptimizer(optimizer, compression=Compression.none, op=Average,
     dist._hvd_compression = compression
     dist._hvd_op = op
     return dist
+
+# Capability surface (reference analog: hvd.mpi_built()/gloo_built()/...).
+from horovod_tpu.tensorflow import (  # noqa: F401,E402
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
+    xla_built,
+    xla_enabled,
+)

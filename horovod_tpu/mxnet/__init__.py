@@ -172,3 +172,19 @@ class DistributedTrainer(mx.gluon.Trainer):
                                op=Sum, prescale_factor=1.0 / f,
                                postscale_factor=f,
                                process_set_id=self._hvd_process_set_id)
+
+# Capability surface (reference analog: hvd.mpi_built()/gloo_built()/...).
+from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401,E402
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
+    xla_built,
+    xla_enabled,
+)

@@ -38,6 +38,9 @@ def parse_args(argv=None):
     p.add_argument("--start-timeout", type=int, default=60,
                    help="seconds to wait for ranks to register")
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--check-build", action="store_true",
+                   help="print available frameworks/controllers/"
+                        "tensor-operation backends and exit")
     p.add_argument("--tpu-pod", action="store_true",
                    help="one rank per local TPU chip, chips pinned per rank")
     # Controller choice (reference: --gloo / --mpi / js autodetect).
@@ -88,6 +91,9 @@ def parse_args(argv=None):
     args = p.parse_args(argv)
     if args.config_file:
         _apply_config_file(args)
+    if args.check_build:
+        _print_check_build()
+        raise SystemExit(0)
     if not args.command:
         p.error("no command given")
     if args.command and args.command[0] == "--":
@@ -153,6 +159,41 @@ def env_from_args(args):
     if args.nics:
         env["HOROVOD_GLOO_IFACE"] = args.nics
     return env
+
+
+def _print_check_build():
+    """Reference analog: ``horovodrun --check-build`` — what this build
+    supports, probed live (frameworks by import, backends from the
+    capability API)."""
+    from horovod_tpu.common.basics import HorovodBasics
+    from horovod_tpu.version import __version__
+
+    def have(mod):
+        import importlib.util
+
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except (ImportError, ModuleNotFoundError, ValueError):
+            return False
+
+    b = HorovodBasics()
+    box = lambda v: "[X]" if v else "[ ]"  # noqa: E731
+    print(f"horovod_tpu v{__version__}:\n")
+    print("Available Frameworks:")
+    for label, mod in (("JAX", "jax"), ("PyTorch", "torch"),
+                      ("TensorFlow", "tensorflow"), ("MXNet", "mxnet")):
+        print(f"    {box(have(mod))} {label}")
+    print("\nAvailable Controllers:")
+    print(f"    {box(b.gloo_built())} TCP (gloo-style rendezvous)")
+    print(f"    {box(b.mpi_built())} MPI / Slurm / LSF env pickup")
+    print("\nAvailable Tensor Operations:")
+    print(f"    {box(b.gloo_built())} host ring (TCP)")
+    print(f"    {box(b.xla_built())} xla_ici device plane (TPU/ICI)")
+    print(f"    {box(b.nccl_built())} NCCL")
+    print(f"    {box(b.cuda_built())} CUDA")
+    print(f"    {box(b.rocm_built())} ROCm")
+    print(f"    {box(b.ccl_built())} oneCCL")
+    print(f"    {box(b.ddl_built())} DDL")
 
 
 def _tpu_pod_np():

@@ -104,3 +104,19 @@ class DistributedGradientTape:
         for i, r, ctx in zip(live_ix, reduced, ctxs):
             out[i] = self._compression.decompress(r, ctx)
         return tf.nest.pack_sequence_as(grads, out)
+
+# Capability surface (reference analog: hvd.mpi_built()/gloo_built()/...).
+from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401,E402
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
+    xla_built,
+    xla_enabled,
+)

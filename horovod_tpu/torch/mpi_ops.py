@@ -35,6 +35,9 @@ local_rank = _basics.local_rank
 local_size = _basics.local_size
 cross_rank = _basics.cross_rank
 cross_size = _basics.cross_size
+
+for _cap in _basics.CAPABILITY_NAMES:
+    globals()[_cap] = getattr(_basics, _cap)
 start_timeline = _basics.start_timeline
 stop_timeline = _basics.stop_timeline
 

@@ -99,3 +99,33 @@ def test_scalar_allreduce_preserves_0d(hvd_core):
     out2 = eager_ops.allreduce_async(base, "strided").synchronize()
     assert out2.shape == (5,)
     assert np.array_equal(out2, base)
+
+
+def test_capability_api():
+    """Reference parity: hvd.gloo_built()/nccl_built()/... exist on every
+    frontend and report the TPU build's reality."""
+    import horovod_tpu.jax as hvd
+
+    assert hvd.gloo_built() and hvd.gloo_enabled()
+    assert hvd.mpi_built() and hvd.mpi_threads_supported()
+    assert not hvd.nccl_built() and not hvd.cuda_built()
+    assert not hvd.rocm_built() and not hvd.ccl_built()
+    assert hvd.xla_built()          # jax importable here
+    assert isinstance(hvd.xla_enabled(), bool)
+
+    import horovod_tpu.torch as ht
+
+    assert ht.gloo_built() and not ht.nccl_built()
+
+
+def test_check_build_cli(capsys):
+    from horovod_tpu.runner import launch
+
+    try:
+        launch.run_commandline(["--check-build"])
+    except SystemExit as e:
+        assert e.code == 0
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "xla_ici device plane" in out
