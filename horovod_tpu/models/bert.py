@@ -114,14 +114,13 @@ def _layernorm(x, scale, bias, eps):
     return ((xf - mu) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
 
 
-def _encoder_attention(q, k, v, mask_bias):
-    """Bidirectional softmax attention. q,k,v [B,T,H,D]; mask_bias
-    [B,1,1,T] additive (-inf on padding)."""
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (d ** 0.5)
-    scores = scores.astype(jnp.float32) + mask_bias
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+def _encoder_attention(q, k, v, kv_bias):
+    """Bidirectional attention. q,k,v [B,T,H,D]; kv_bias [B,T] additive
+    per key (large negative on padding). Pallas flash kernel on TPU (no
+    T² score materialization); reference-math fallback elsewhere."""
+    from horovod_tpu.ops import flash_attention
+
+    return flash_attention(q, k, v, causal=False, kv_bias=kv_bias)
 
 
 def bert_forward(params, tokens, config, attention_mask=None, mesh=None):
@@ -136,8 +135,7 @@ def bert_forward(params, tokens, config, attention_mask=None, mesh=None):
         attention_mask = jnp.ones((B, T), jnp.int32)
     # Finite bias (not -inf): a fully-padded row (ragged final batch) must
     # softmax to uniform garbage that the loss masks out, not to NaN.
-    mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
-                          -1e30).astype(jnp.float32)
+    kv_bias = jnp.where(attention_mask > 0, 0.0, -1e30).astype(jnp.float32)
 
     h = params["embed"][tokens] + params["pos_embed"][None, :T]
     h = _layernorm(h.astype(dt), params["embed_norm"]["scale"],
@@ -149,7 +147,7 @@ def bert_forward(params, tokens, config, attention_mask=None, mesh=None):
         q = (hn @ lp["wq"].astype(dt)).reshape(B, T, c.n_heads, c.head_dim)
         k = (hn @ lp["wk"].astype(dt)).reshape(B, T, c.n_heads, c.head_dim)
         v = (hn @ lp["wv"].astype(dt)).reshape(B, T, c.n_heads, c.head_dim)
-        attn = _encoder_attention(q, k, v, mask_bias)
+        attn = _encoder_attention(q, k, v, kv_bias)
         h = h + attn.reshape(B, T, c.d_model) @ lp["wo"].astype(dt)
         hn = _layernorm(h, lp["mlp_norm_scale"], lp["mlp_norm_bias"],
                         c.norm_eps)

@@ -32,9 +32,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
 
+# Tests set this to run the kernels in pallas interpret mode on CPU —
+# the only way the TPU code paths (incl. the bias branches) get CI
+# coverage without a chip.
+_INTERPRET = False
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
-                causal):
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_k, causal,
+                has_bias):
+    # rest = ([bias_ref,] o_ref, lse_ref) — bias is a per-key additive
+    # f32 row [1, Tk] (padding masks), present only in the bias variant.
+    if has_bias:
+        bias_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
+        bias_ref = None
     bq, d = q_ref.shape
     tk = k_ref.shape[0]
     iq = pl.program_id(2)
@@ -57,6 +69,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
             kv_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
+        if has_bias:
+            s = s + bias_ref[:, pl.ds(j * block_k, block_k)]
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
@@ -80,7 +94,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, block_q, causal):
+                    *rest, scale, block_q, causal, has_bias):
+    if has_bias:
+        bias_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
+        bias_ref = None
     bk, d = k_ref.shape
     tq = q_ref.shape[0]
     jk = pl.program_id(2)
@@ -104,6 +123,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_pos = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
+        if has_bias:
+            s = s + bias_ref[:, pl.ds(jk * bk, bk)]
         p = jnp.exp(s - lse)                     # [bq, bk]
         dv = dv + jax.lax.dot_general(
             p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
@@ -127,7 +148,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale, block_k, causal):
+                   *rest, scale, block_k, causal, has_bias):
+    if has_bias:
+        bias_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
+        bias_ref = None
     bq, d = q_ref.shape
     tk = k_ref.shape[0]
     iq = pl.program_id(2)
@@ -149,6 +175,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kv_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
+        if has_bias:
+            s = s + bias_ref[:, pl.ds(j * block_k, block_k)]
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -178,25 +206,38 @@ def _pick_block(t, want):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, block_q, block_k):
-    o, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    o, _ = _flash_fwd_impl(q, k, v, None, causal, block_q, block_k)
     return o
 
 
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_biased(q, k, v, bias, causal, block_q, block_k):
+    o, _ = _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k):
     b, h, t, d = q.shape
     scale = d ** -0.5
     grid = (b, h, t // block_q)
+    has_bias = bias is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                               causal=causal)
+                               causal=causal, has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+    ]
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((None, 1, t), lambda bi, hi, qi: (bi, 0, 0)))
+        args.append(bias)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, block_q, d),
                          lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -207,39 +248,52 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
         ],
-    )(q, k, v)
+        interpret=_INTERPRET,
+    )(*args)
     return o, lse
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    o, lse = _flash_fwd_impl(q, k, v, None, causal, block_q, block_k)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, res, do):
-    q, k, v, o, lse = res
+def _flash_biased_fwd(q, k, v, bias, causal, block_q, block_k):
+    o, lse = _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k):
     b, h, t, d = q.shape
     scale = d ** -0.5
+    has_bias = bias is not None
     delta = (do.astype(jnp.float32)
              * o.astype(jnp.float32)).sum(-1, keepdims=True)
+    bias_spec = pl.BlockSpec((None, 1, t), lambda bi, hi, gi: (bi, 0, 0))
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
-                                   block_q=block_q, causal=causal)
+                                   block_q=block_q, causal=causal,
+                                   has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((None, None, t, d), lambda bi, hi, jk: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, block_k, d),
+                     lambda bi, hi, jk: (bi, hi, jk, 0)),
+        pl.BlockSpec((None, None, block_k, d),
+                     lambda bi, hi, jk: (bi, hi, jk, 0)),
+        pl.BlockSpec((None, None, t, d), lambda bi, hi, jk: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, t, 1),
+                     lambda bi, hi, jk: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, t, 1),
+                     lambda bi, hi, jk: (bi, hi, 0, 0)),
+    ]
+    args = [q, k, v, do, lse, delta]
+    if has_bias:
+        in_specs.append(bias_spec)
+        args.append(bias)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, h, t // block_k),
-        in_specs=[
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, jk: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, jk: (bi, hi, jk, 0)),
-            pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, jk: (bi, hi, jk, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, jk: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, t, 1),
-                         lambda bi, hi, jk: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, t, 1),
-                         lambda bi, hi, jk: (bi, hi, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, block_k, d),
                          lambda bi, hi, jk: (bi, hi, jk, 0)),
@@ -250,50 +304,101 @@ def _flash_bwd(causal, block_q, block_k, res, do):
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-    )(q, k, v, do, lse, delta)
+        interpret=_INTERPRET,
+    )(*args)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
-                                  block_k=block_k, causal=causal)
+                                  block_k=block_k, causal=causal,
+                                  has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, block_q, 1),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, block_q, 1),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+    ]
+    args = [q, k, v, do, lse, delta]
+    if has_bias:
+        in_specs.append(bias_spec)
+        args.append(bias)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, t // block_q),
-        in_specs=[
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, block_q, 1),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, block_q, 1),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, block_q, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-    )(q, k, v, do, lse, delta)
+        interpret=_INTERPRET,
+    )(*args)
     return dq, dk, dv
 
 
+def _flash_bwd(causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, None, o, lse, do, causal, block_q,
+                           block_k)
+
+
+def _flash_biased_bwd(causal, block_q, block_k, res, do):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, bias, o, lse, do, causal,
+                                 block_q, block_k)
+    # The bias is a padding mask (piecewise-constant); its cotangent is
+    # never consumed, so report zeros rather than paying a reduction.
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
 _flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_biased.defvjp(_flash_biased_fwd, _flash_biased_bwd)
 
 
-def flash_attention(q, k, v, causal=True, block_q=512, block_k=512):
+def _masked_attention_xla(q, k, v, kv_bias, causal):
+    """Reference-math fallback with a per-key additive bias (CPU tests;
+    shapes there are tiny, so materializing scores is fine)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / (d ** 0.5)
+    s = s + kv_bias[:, None, None, :].astype(jnp.float32)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def flash_attention(q, k, v, causal=True, kv_bias=None, block_q=512,
+                    block_k=512):
     """Flash attention. q,k,v: [B, T, H, D] (framework layout; kv heads
     may be fewer — GQA is expanded here). Returns [B, T, H, D].
+
+    ``kv_bias`` is an optional [B, Tk] f32 additive per-key bias —
+    padding masks pass 0 for real keys and a large negative for padding
+    (BERT-style bidirectional attention over ragged batches). It is
+    treated as a CONSTANT (stop_gradient on every path): masks have no
+    useful gradient, and the TPU kernel does not compute one.
 
     TPU: pallas kernel. Elsewhere: falls back to the XLA blockwise
     implementation (same math, used by CPU tests).
     """
+    from horovod_tpu.parallel.ring_attention import _repeat_kv
+
+    if kv_bias is not None:
+        kv_bias = lax.stop_gradient(kv_bias)
+    n_rep = q.shape[2] // k.shape[2]
     if jax.devices()[0].platform not in ("tpu", "axon"):
+        if kv_bias is not None:
+            return _masked_attention_xla(q, _repeat_kv(k, n_rep),
+                                         _repeat_kv(v, n_rep), kv_bias,
+                                         causal)
         from horovod_tpu.parallel.ring_attention import blockwise_attention
 
         return blockwise_attention(q, k, v, causal=causal)
 
-    from horovod_tpu.parallel.ring_attention import _repeat_kv
-
-    n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
     # [B,T,H,D] -> [B,H,T,D]
@@ -303,5 +408,9 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_k=512):
     t = qt.shape[2]
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
-    o = _flash(qt, kt, vt, causal, bq, bk)
+    if kv_bias is not None:
+        bias = kv_bias.astype(jnp.float32)[:, None, :]  # [B, 1, Tk]
+        o = _flash_biased(qt, kt, vt, bias, causal, bq, bk)
+    else:
+        o = _flash(qt, kt, vt, causal, bq, bk)
     return o.transpose(0, 2, 1, 3)
