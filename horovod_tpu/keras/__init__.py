@@ -62,6 +62,55 @@ class _DistributedOptimizer:
         return [self._hvd_compression.decompress(r, ctx)
                 for r, ctx in zip(reduced, ctxs)]
 
+    # Local gradient aggregation (backward_passes_per_step > 1).
+    # Reference analog: horovod/tensorflow/gradient_aggregation*.py
+    # LocalGradientAggregationHelper — accumulate N local backward passes,
+    # allreduce + apply only on the Nth, skip apply otherwise. tf.cond so
+    # the same code traces under tf.function.
+
+    def _hvd_agg_step(self, grads, variables, apply_fn):
+        grads = [tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices)
+                 else g for g in grads]
+        if self._hvd_agg_acc is None:
+            # init_scope lifts creation out of any tf.function trace; the
+            # initializers use only static shapes/dtypes, never in-graph
+            # gradient tensors.
+            with tf.init_scope():
+                self._hvd_agg_acc = [
+                    tf.Variable(tf.zeros(g.shape, g.dtype),
+                                trainable=False) for g in grads]
+                self._hvd_agg_counter = tf.Variable(0, dtype=tf.int64,
+                                                    trainable=False)
+        # Build the base optimizer's slot/iteration variables BEFORE the
+        # cond: keras cannot create variables inside a tf.cond branch
+        # when the boundary's first apply happens under tf.function.
+        if variables is not None and getattr(self, "built", True) is False:
+            self.build(variables)
+        for a, g in zip(self._hvd_agg_acc, grads):
+            a.assign_add(tf.cast(g, a.dtype))
+        self._hvd_agg_counter.assign_add(1)
+        n = self._hvd_backward_passes
+
+        def boundary():
+            avg = [tf.identity(a) / tf.cast(n, a.dtype)
+                   for a in self._hvd_agg_acc]
+            apply_fn(self._hvd_allreduce(avg))
+            for a in self._hvd_agg_acc:
+                a.assign(tf.zeros_like(a))
+            self._hvd_agg_counter.assign(0)
+            return tf.constant(True)
+
+        def skip():
+            # Reference parity (LocalGradientAggregationHelper):
+            # iterations counts every backward pass, including skipped
+            # applies — LR schedules keyed on it must not run N× slow.
+            it = getattr(self, "iterations", None)
+            if it is not None:
+                it.assign_add(1)
+            return tf.constant(False)
+
+        return tf.cond(tf.equal(self._hvd_agg_counter, n), boundary, skip)
+
     # Exactly ONE of these is grafted onto the subclass (see
     # DistributedOptimizer below): keras 3's BaseOptimizer.apply_gradients
     # delegates to self.apply(), so overriding both would allreduce twice
@@ -69,11 +118,28 @@ class _DistributedOptimizer:
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         grads_and_vars = list(grads_and_vars)
-        grads = self._hvd_allreduce([g for g, _ in grads_and_vars])
+        grads = [g for g, _ in grads_and_vars]
+        hvd_vars = [v for _, v in grads_and_vars]
+        if self._hvd_backward_passes > 1:
+            def apply_fn(reduced):
+                super(self.__class__, self).apply_gradients(
+                    zip(reduced, hvd_vars), **kwargs)
+
+            return self._hvd_agg_step(grads, hvd_vars, apply_fn)
+        grads = self._hvd_allreduce(grads)
         return super(self.__class__, self).apply_gradients(
-            zip(grads, [v for _, v in grads_and_vars]), **kwargs)
+            zip(grads, hvd_vars), **kwargs)
 
     def apply(self, grads, variables=None, **kwargs):
+        if self._hvd_backward_passes > 1:
+            def apply_fn(reduced):
+                if variables is None:
+                    super(self.__class__, self).apply(reduced, **kwargs)
+                else:
+                    super(self.__class__, self).apply(reduced, variables,
+                                                      **kwargs)
+
+            return self._hvd_agg_step(list(grads), variables, apply_fn)
         grads = self._hvd_allreduce(list(grads))
         if variables is None:
             return super(self.__class__, self).apply(grads, **kwargs)
@@ -90,11 +156,10 @@ def DistributedOptimizer(optimizer, compression=Compression.none, op=Average,
     optimizer checks everywhere (compile, serialization), exactly like the
     reference's create_distributed_optimizer.
     """
-    if backward_passes_per_step != 1:
-        raise NotImplementedError(
-            "backward_passes_per_step > 1 for keras lands with the "
-            "gradient-aggregation helper")
-    members = {"_hvd_allreduce": _DistributedOptimizer._hvd_allreduce}
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+    members = {"_hvd_allreduce": _DistributedOptimizer._hvd_allreduce,
+               "_hvd_agg_step": _DistributedOptimizer._hvd_agg_step}
     if hasattr(optimizer, "apply"):
         # keras 3: apply() is the single grad-application chokepoint
         # (apply_gradients delegates to it) — override only it.
@@ -106,6 +171,9 @@ def DistributedOptimizer(optimizer, compression=Compression.none, op=Average,
     dist = cls.from_config(optimizer.get_config())
     dist._hvd_compression = compression
     dist._hvd_op = op
+    dist._hvd_backward_passes = backward_passes_per_step
+    dist._hvd_agg_acc = None
+    dist._hvd_agg_counter = None
     return dist
 
 # Capability surface (reference analog: hvd.mpi_built()/gloo_built()/...).
