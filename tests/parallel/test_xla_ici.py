@@ -349,6 +349,39 @@ def _worker_timeline_xprof(rank, size):
         hvd.shutdown()
 
 
+def _worker_dtype_matrix(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        # bf16 is what real TPU gradients are; int32 rounds the matrix
+        # out (64-bit dtypes need jax x64 mode — the host-path tests
+        # cover those; bool rides broadcast's uint8 path).
+        for dt, tol in ((jnp.bfloat16, 1e-2), (jnp.float16, 1e-2),
+                        (jnp.float32, 1e-6), (jnp.int32, 0)):
+            out = hvd.allreduce(jnp.full((8,), rank + 1, dt), op=hvd.Sum,
+                                name=f"dt.{jnp.dtype(dt).name}")
+            assert out.dtype == dt, (dt, out.dtype)
+            np.testing.assert_allclose(
+                np.asarray(out.astype(jnp.float64)),
+                sum(i + 1 for i in range(size)), atol=float(tol))
+        out = hvd.broadcast(jnp.array([True, False, rank == 0]),
+                            root_rank=1)
+        assert out.dtype == jnp.bool_
+        np.testing.assert_array_equal(np.asarray(out),
+                                      [True, False, size == 1])
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_dtype_matrix():
+    assert run_ranks(_worker_dtype_matrix, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
 def test_timeline_with_xprof_bridge():
     assert run_ranks(_worker_timeline_xprof, 2, env=_ENV,
                      timeout=240) == ["ok"] * 2
