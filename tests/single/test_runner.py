@@ -254,3 +254,32 @@ def test_launcher_env_translation(monkeypatch):
     monkeypatch.setenv("HOROVOD_RANK", "0")
     HorovodBasics._translate_launcher_env()
     assert os.environ["HOROVOD_RANK"] == "0"
+
+
+def _interactive_fn(scale):
+    """Module-level (picklable) fn for horovod_tpu.runner.run."""
+    import numpy as np
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        out = hvd.allreduce(np.full(3, float(hvd.rank() + 1)), op=hvd.Sum)
+        return float(np.asarray(out)[0]) * scale
+    finally:
+        hvd.shutdown()
+
+
+def test_interactive_run():
+    """Reference analog: test_interactiverun.py — horovod.run() launches
+    fn on N local ranks, initializes each, returns results by rank."""
+    import os
+
+    from horovod_tpu import runner
+
+    env = {"JAX_PLATFORMS": "cpu",
+           "HOROVOD_XLA_DATA_PLANE": "0"}
+    results = runner.run(_interactive_fn, args=(10.0,), np=2, env=env,
+                         timeout=120)
+    assert results == [30.0, 30.0]  # sum(1..2) * 10 on both ranks
+    assert "HOROVOD_RANK" not in os.environ  # parent env untouched
