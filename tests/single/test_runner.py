@@ -277,9 +277,12 @@ def test_interactive_run():
 
     from horovod_tpu import runner
 
+    before = os.environ.get("HOROVOD_RANK")
     env = {"JAX_PLATFORMS": "cpu",
            "HOROVOD_XLA_DATA_PLANE": "0"}
     results = runner.run(_interactive_fn, args=(10.0,), np=2, env=env,
                          timeout=120)
     assert results == [30.0, 30.0]  # sum(1..2) * 10 on both ranks
-    assert "HOROVOD_RANK" not in os.environ  # parent env untouched
+    # run() must not mutate the parent environment (other tests may have
+    # set HOROVOD_RANK before us; assert it is unchanged, not absent).
+    assert os.environ.get("HOROVOD_RANK") == before
