@@ -152,21 +152,40 @@ class State:
         pass
 
 
-def _broadcast_object(obj, root_rank=0, name="elastic.obj"):
-    """Pickle-broadcast via two eager broadcasts (length, then payload)."""
+def _broadcast_object(obj, root_rank=0, name="elastic.obj",
+                      process_set_id=0):
+    """Pickle-broadcast via two eager broadcasts (length, then payload).
+    Only the root pickles; other ranks' ``obj`` is never serialized."""
     import pickle
 
     import numpy as np
 
-    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    if _basics.rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    else:
+        payload = np.zeros(0, dtype=np.uint8)
     n = eager_ops.broadcast_async(
         np.array([payload.size], dtype=np.int64), root_rank,
-        f"{name}.len").synchronize()[0]
+        f"{name}.len", process_set_id=process_set_id).synchronize()[0]
     buf = payload if _basics.rank() == root_rank else np.zeros(
         int(n), dtype=np.uint8)
-    out = eager_ops.broadcast_async(buf, root_rank,
-                                    f"{name}.payload").synchronize()
+    out = eager_ops.broadcast_async(
+        buf, root_rank, f"{name}.payload",
+        process_set_id=process_set_id).synchronize()
     return pickle.loads(out.tobytes())
+
+
+def _sync_state(state, name, attr="_saved"):
+    """Shared sync protocol for State subclasses that keep their snapshot
+    in one attribute: rank 0 snapshots, everyone adopts its broadcast,
+    then restores. No-op at size 1."""
+    if _basics.size() == 1:
+        return
+    if _basics.rank() == 0:
+        state.save()  # non-root snapshots are overwritten below
+    setattr(state, attr,
+            _broadcast_object(getattr(state, attr), name=name))
+    state.restore()
 
 
 class ObjectState(State):
@@ -191,11 +210,7 @@ class ObjectState(State):
             setattr(self, k, copy.deepcopy(v))
 
     def sync(self):
-        if _basics.size() == 1:
-            return
-        self._saved_state = _broadcast_object(self._saved_state,
-                                              name="elastic.object_state")
-        self.restore()
+        _sync_state(self, "elastic.object_state", attr="_saved_state")
 
 
 def run_fn(func):

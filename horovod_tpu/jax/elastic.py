@@ -25,7 +25,7 @@ import jax
 import numpy as np
 
 from horovod_tpu.common import elastic as _elastic
-from horovod_tpu.common.elastic import State, _broadcast_object
+from horovod_tpu.common.elastic import State
 
 run = _elastic.run_fn
 init = _elastic.init
@@ -103,10 +103,4 @@ class JaxState(State):
             setattr(self, k, copy.deepcopy(v))
 
     def sync(self):
-        from horovod_tpu.common.basics import HorovodBasics
-
-        if HorovodBasics().size() == 1:
-            return
-        self.save()
-        self._saved = _broadcast_object(self._saved, name="elastic.jax_state")
-        self.restore()
+        _elastic._sync_state(self, "elastic.jax_state")

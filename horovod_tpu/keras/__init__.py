@@ -18,8 +18,11 @@ from horovod_tpu.tensorflow import (  # noqa: F401
     ReduceOp,
     Sum,
     allgather,
+    allgather_object,
     allreduce,
     alltoall,
+    broadcast_object,
+    broadcast_object_fn,
     barrier,
     join,
     broadcast,
@@ -37,6 +40,7 @@ from horovod_tpu.tensorflow import (  # noqa: F401
     size,
 )
 from horovod_tpu.keras import callbacks  # noqa: F401
+from horovod_tpu.keras import elastic  # noqa: F401
 
 
 class _DistributedOptimizer:
@@ -45,22 +49,17 @@ class _DistributedOptimizer:
     genuine keras optimizer (reference: horovod/_keras/__init__.py
     create_distributed_optimizer's ``cls = type(...)`` trick)."""
 
-    def _hvd_allreduce(self, grads):
-        from horovod_tpu.tensorflow import mpi_ops
+    def _hvd_allreduce(self, grads, variables=None):
+        from horovod_tpu.tensorflow import _allreduce_grads_list
 
-        compressed, ctxs = [], []
-        for g in grads:
-            if isinstance(g, tf.IndexedSlices):
-                g = tf.convert_to_tensor(g)
-            c, ctx = self._hvd_compression.compress(g)
-            compressed.append(c)
-            ctxs.append(ctx)
-        reduced = mpi_ops.grouped_allreduce(
-            compressed, names=[f"keras.grad.{i}"
-                               for i in range(len(compressed))],
-            op=self._hvd_op)
-        return [self._hvd_compression.decompress(r, ctx)
-                for r, ctx in zip(reduced, ctxs)]
+        if variables is not None and len(variables) == len(grads):
+            names = [
+                f"keras.grad.{getattr(v, 'path', None) or getattr(v, 'name', i)}"
+                for i, v in enumerate(variables)]
+        else:
+            names = [f"keras.grad.{i}" for i in range(len(grads))]
+        return _allreduce_grads_list(grads, self._hvd_compression,
+                                     self._hvd_op, names)
 
     # Local gradient aggregation (backward_passes_per_step > 1).
     # Reference analog: horovod/tensorflow/gradient_aggregation*.py
@@ -94,7 +93,7 @@ class _DistributedOptimizer:
         def boundary():
             avg = [tf.identity(a) / tf.cast(n, a.dtype)
                    for a in self._hvd_agg_acc]
-            apply_fn(self._hvd_allreduce(avg))
+            apply_fn(self._hvd_allreduce(avg, variables))
             for a in self._hvd_agg_acc:
                 a.assign(tf.zeros_like(a))
             self._hvd_agg_counter.assign(0)
@@ -126,7 +125,7 @@ class _DistributedOptimizer:
                     zip(reduced, hvd_vars), **kwargs)
 
             return self._hvd_agg_step(grads, hvd_vars, apply_fn)
-        grads = self._hvd_allreduce(grads)
+        grads = self._hvd_allreduce(grads, hvd_vars)
         return super(self.__class__, self).apply_gradients(
             zip(grads, hvd_vars), **kwargs)
 
@@ -140,7 +139,7 @@ class _DistributedOptimizer:
                                                       **kwargs)
 
             return self._hvd_agg_step(list(grads), variables, apply_fn)
-        grads = self._hvd_allreduce(list(grads))
+        grads = self._hvd_allreduce(list(grads), variables)
         if variables is None:
             return super(self.__class__, self).apply(grads, **kwargs)
         return super(self.__class__, self).apply(grads, variables, **kwargs)
@@ -158,6 +157,17 @@ def DistributedOptimizer(optimizer, compression=Compression.none, op=Average,
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    from horovod_tpu.tensorflow import _is_v1_optimizer
+
+    if _is_v1_optimizer(optimizer):
+        # Legacy graph-mode optimizer handed to the keras entry point:
+        # route to the TF-level wrapper (same dispatch as
+        # hvd.tensorflow.DistributedOptimizer).
+        from horovod_tpu import tensorflow as _hvd_tf
+
+        return _hvd_tf.DistributedOptimizer(
+            optimizer, compression=compression, op=op,
+            backward_passes_per_step=backward_passes_per_step)
     members = {"_hvd_allreduce": _DistributedOptimizer._hvd_allreduce,
                "_hvd_agg_step": _DistributedOptimizer._hvd_agg_step}
     if hasattr(optimizer, "apply"):

@@ -1,0 +1,99 @@
+"""Elastic support for the Keras frontend: state + fit() callbacks.
+
+Reference analog: ``horovod/_keras/elastic.py`` +
+``horovod/tensorflow/keras/elastic.py`` (``KerasState``,
+``CommitStateCallback``, ``UpdateBatchStateCallback``,
+``UpdateEpochStateCallback``) — keep an elastic ``State`` current while
+``model.fit`` runs, so recovery resumes at the right epoch/batch.
+"""
+
+import tensorflow as tf
+
+from horovod_tpu.common import elastic as _elastic
+from horovod_tpu.tensorflow.elastic import (  # noqa: F401
+    ObjectState,
+    State,
+    TensorFlowKerasState,
+    TensorFlowState,
+)
+
+run = _elastic.run_fn
+init = _elastic.init
+reset = _elastic.reset
+
+
+class KerasState(TensorFlowKerasState):
+    """Elastic state for a compiled keras model (reference:
+    hvd.elastic.KerasState — identical to TensorFlowKerasState with the
+    optimizer taken from the model)."""
+
+    def __init__(self, model, **kwargs):
+        super().__init__(model, optimizer=None, **kwargs)
+
+
+class CommitStateCallback(tf.keras.callbacks.Callback):
+    """``state.commit()`` every ``batches_per_commit`` batches and at
+    every epoch end (reference: hvd.elastic.CommitStateCallback)."""
+
+    def __init__(self, state, batches_per_commit=1):
+        super().__init__()
+        self._state = state
+        self._batches_per_commit = batches_per_commit
+
+    def on_train_batch_end(self, batch, logs=None):
+        if (batch + 1) % self._batches_per_commit == 0:
+            self._state.commit()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._state.commit()
+
+
+class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
+    """Track ``state.batch`` and shorten the first restored epoch.
+
+    Reference analog: hvd.elastic.UpdateBatchStateCallback. On resume
+    (``fit(initial_epoch=state.epoch)`` re-entering the epoch a failure
+    interrupted), the committed batch count becomes an offset: callback
+    ``params['steps']`` is reduced by it (honored by keras-2-style loops;
+    keras 3 treats params as informational, so there the offset is kept
+    in ``state.batch`` for the input pipeline to skip) and subsequent
+    ``state.batch`` values continue from the offset, so commits made
+    after recovery record absolute progress within the epoch. Resets to
+    0 at epoch end."""
+
+    def __init__(self, state):
+        super().__init__()
+        self._state = state
+        self._offset = 0
+        if not hasattr(state, "batch"):
+            state.batch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._offset = 0
+        if epoch == getattr(self._state, "epoch", 0) \
+                and getattr(self._state, "batch", 0) > 0:
+            self._offset = self._state.batch
+            steps = (self.params or {}).get("steps")
+            if steps:
+                self.params["steps"] = max(steps - self._offset, 1)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._state.batch = self._offset + batch + 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._state.batch = 0
+
+
+class UpdateEpochStateCallback(tf.keras.callbacks.Callback):
+    """Track ``state.epoch`` so recovery re-enters ``fit`` with
+    ``initial_epoch=state.epoch`` (reference:
+    hvd.elastic.UpdateEpochStateCallback)."""
+
+    def __init__(self, state):
+        super().__init__()
+        self._state = state
+        if not hasattr(state, "epoch"):
+            state.epoch = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._state.epoch = epoch + 1

@@ -6,6 +6,8 @@ collectives, ``DistributedGradientTape``, ``broadcast_variables``,
 ``Compression``.
 """
 
+import itertools
+
 import tensorflow as tf
 
 from horovod_tpu.common.process_sets import (  # noqa: F401
@@ -51,6 +53,123 @@ from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
 from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: F401
     SyncBatchNormalization,
 )
+from horovod_tpu.tensorflow.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_object_fn,
+)
+from horovod_tpu.tensorflow import elastic  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         op=Average, backward_passes_per_step=1):
+    """Wrap an optimizer so gradients are allreduce-averaged before apply.
+
+    Reference analog: hvd.DistributedOptimizer
+    (horovod/tensorflow/__init__.py). Keras optimizers delegate to the
+    keras wrapper (the tf2-native path); legacy
+    ``tf.compat.v1.train.Optimizer`` instances get a v1-style wrapper
+    whose ``compute_gradients`` allreduces.
+    """
+    if _is_v1_optimizer(optimizer):
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "backward_passes_per_step > 1 is not supported for "
+                "tf.compat.v1 optimizers; use a keras optimizer")
+        return _make_v1_distributed_optimizer(optimizer, compression, op)
+    from horovod_tpu import keras as _keras
+
+    return _keras.DistributedOptimizer(
+        optimizer, compression=compression, op=op,
+        backward_passes_per_step=backward_passes_per_step)
+
+
+def _is_v1_optimizer(optimizer):
+    """True for legacy graph-mode ``tf.compat.v1.train.Optimizer``
+    instances (shared by the keras and TF DistributedOptimizer dispatch)."""
+    v1_base = getattr(getattr(tf.compat, "v1", None), "train", None)
+    v1_base = getattr(v1_base, "Optimizer", None)
+    return v1_base is not None and isinstance(optimizer, v1_base)
+
+
+def _allreduce_grads_list(grads, compression, op, names,
+                          process_set_id=0):
+    """Allreduce a gradient list (None-preserving, IndexedSlices
+    densified, compression applied around the wire). ``names`` must be
+    globally consistent across ranks — callers derive them from variable
+    names, not call order."""
+    from horovod_tpu.tensorflow import mpi_ops
+
+    live = [(i, g) for i, g in enumerate(grads) if g is not None]
+    compressed, ctxs = [], []
+    for _, g in live:
+        if isinstance(g, tf.IndexedSlices):
+            g = tf.convert_to_tensor(g)
+        c, ctx = compression.compress(g)
+        compressed.append(c)
+        ctxs.append(ctx)
+    reduced = mpi_ops.grouped_allreduce(
+        compressed, names=[names[i] for i, _ in live], op=op,
+        process_set_id=process_set_id)
+    out = list(grads)
+    for (i, _), r, ctx in zip(live, reduced, ctxs):
+        out[i] = compression.decompress(r, ctx)
+    return out
+
+
+_v1_wrapper_count = itertools.count()
+
+
+def _make_v1_distributed_optimizer(optimizer, compression, op):
+    """Graph-mode wrapper: a genuine ``tf.compat.v1.train.Optimizer``
+    subclass (so isinstance checks in estimators etc. pass) whose
+    ``compute_gradients`` allreduces; apply/slots delegate. The inherited
+    ``minimize`` composes the two with full v1 kwargs semantics."""
+    v1_base = tf.compat.v1.train.Optimizer
+
+    class _V1DistributedOptimizer(v1_base):
+        def __init__(self):
+            super().__init__(use_locking=False,
+                             name=f"Distributed{type(optimizer).__name__}")
+            self._opt = optimizer
+            self._compression = compression
+            self._hvd_op = op
+            # Both counters advance at graph-construction time, which is
+            # identical program order on every rank (SPMD), so the names
+            # stay globally consistent.
+            self._uid = next(_v1_wrapper_count)
+            self._cg_calls = itertools.count()
+
+        def compute_gradients(self, *args, **kwargs):
+            gvs = self._opt.compute_gradients(*args, **kwargs)
+            # Names keyed on (wrapper instance, call, variable): two
+            # wrapped optimizers — or two towers calling
+            # compute_gradients twice over shared variables — must not
+            # collide when session.run interleaves their groups.
+            call_n = next(self._cg_calls)
+            names = [
+                f"v1opt.{self._uid}.{call_n}.{getattr(v, 'name', i)}"
+                for i, (_, v) in enumerate(gvs)]
+            grads = _allreduce_grads_list(
+                [g for g, _ in gvs], self._compression, self._hvd_op,
+                names)
+            return list(zip(grads, [v for _, v in gvs]))
+
+        def apply_gradients(self, grads_and_vars, global_step=None,
+                            name=None):
+            return self._opt.apply_gradients(
+                grads_and_vars, global_step=global_step, name=name)
+
+        def get_slot(self, var, name):
+            return self._opt.get_slot(var, name)
+
+        def get_slot_names(self):
+            return self._opt.get_slot_names()
+
+        def variables(self):
+            return self._opt.variables()
+
+    return _V1DistributedOptimizer()
 
 
 class DistributedGradientTape:
@@ -85,24 +204,10 @@ class DistributedGradientTape:
 
     def _allreduce_grads(self, grads):
         flat = tf.nest.flatten(grads)
-        compressed, ctxs, live_ix = [], [], []
-        for i, g in enumerate(flat):
-            if g is None:
-                continue
-            if isinstance(g, tf.IndexedSlices):
-                g = tf.convert_to_tensor(g)
-            c, ctx = self._compression.compress(g)
-            compressed.append(c)
-            ctxs.append(ctx)
-            live_ix.append(i)
-        from horovod_tpu.tensorflow import mpi_ops
-
-        reduced = mpi_ops.grouped_allreduce(
-            compressed, names=[f"tape.grad.{i}" for i in live_ix],
-            op=self._op, process_set_id=self._process_set_id)
-        out = list(flat)
-        for i, r, ctx in zip(live_ix, reduced, ctxs):
-            out[i] = self._compression.decompress(r, ctx)
+        out = _allreduce_grads_list(
+            flat, self._compression, self._op,
+            [f"tape.grad.{i}" for i in range(len(flat))],
+            process_set_id=self._process_set_id)
         return tf.nest.pack_sequence_as(grads, out)
 
 # Capability surface (reference analog: hvd.mpi_built()/gloo_built()/...).

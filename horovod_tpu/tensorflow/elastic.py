@@ -1,0 +1,136 @@
+"""Elastic training for the TensorFlow/Keras frontend.
+
+Reference analog: ``horovod/tensorflow/elastic.py`` (``TensorFlowState``,
+``TensorFlowKerasState``, ``run``) — commit/restore snapshots of
+tf.Variables (or a whole keras model + optimizer) in host memory, rank-0
+broadcast on ``sync()``, driven by the shared elastic retry loop
+(``horovod_tpu/common/elastic.py``, SURVEY.md §3.4).
+"""
+
+import copy
+
+from horovod_tpu.common import elastic as _elastic
+from horovod_tpu.common.elastic import (  # noqa: F401
+    ObjectState,
+    State,
+)
+
+run = _elastic.run_fn
+init = _elastic.init
+reset = _elastic.reset
+
+
+class TensorFlowState(State):
+    """Elastic state over a list of ``tf.Variable`` (+ picklable attrs).
+
+    Reference analog: hvd.elastic.TensorFlowState — snapshots variable
+    values to host numpy on ``save()``, assigns them back on
+    ``restore()``, and broadcasts rank 0's snapshot on ``sync()``.
+    """
+
+    def __init__(self, variables=None, **kwargs):
+        super().__init__()
+        self.variables = list(variables) if variables is not None else []
+        self._extra_keys = list(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.save()
+
+    def save(self):
+        self._saved = {
+            "variables": [v.numpy().copy() for v in self.variables],
+            "extra": {k: copy.deepcopy(getattr(self, k))
+                      for k in self._extra_keys},
+        }
+
+    def restore(self):
+        saved = self._saved["variables"]
+        if len(saved) != len(self.variables):
+            raise ValueError(
+                f"saved snapshot has {len(saved)} variables but state "
+                f"tracks {len(self.variables)} — the variable list must "
+                "match across ranks and commits")
+        for var, val in zip(self.variables, saved):
+            var.assign(val)
+        for k, v in self._saved["extra"].items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        _elastic._sync_state(self, "elastic.tf_state")
+
+
+class TensorFlowKerasState(State):
+    """Elastic state for a keras model + optimizer (+ picklable attrs).
+
+    Reference analog: hvd.elastic.TensorFlowKerasState — snapshots
+    ``model.get_weights()`` and the optimizer's variables; ``sync()``
+    broadcasts rank 0's snapshot so a rejoined worker starts from the
+    surviving ranks' weights.
+    """
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        super().__init__()
+        self.model = model
+        self.optimizer = optimizer if optimizer is not None else getattr(
+            model, "optimizer", None)
+        self._extra_keys = list(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.save()
+
+    def _opt_vars(self):
+        if self.optimizer is None:
+            return []
+        # keras 3 exposes .variables; keras 2 optimizers expose
+        # .variables() (callable) or weights.
+        vars = getattr(self.optimizer, "variables", None)
+        if callable(vars):
+            vars = vars()
+        return list(vars) if vars is not None else []
+
+    def save(self):
+        self._saved = {
+            "model": [w.copy() for w in self.model.get_weights()],
+            "optimizer": [v.numpy().copy() for v in self._opt_vars()],
+            "extra": {k: copy.deepcopy(getattr(self, k))
+                      for k in self._extra_keys},
+        }
+
+    def restore(self):
+        if self._saved["model"]:
+            self.model.set_weights(
+                [w.copy() for w in self._saved["model"]])
+        saved_opt = self._saved["optimizer"]
+        if not saved_opt:
+            # Snapshot predates the optimizer's (lazy) build — nothing to
+            # roll back; leave whatever slots exist rather than failing
+            # recovery (mirrors the lenient empty-model branch above).
+            for k, v in self._saved["extra"].items():
+                setattr(self, k, copy.deepcopy(v))
+            return
+        opt_vars = self._opt_vars()
+        if len(opt_vars) != len(saved_opt) and self.optimizer is not None:
+            # A freshly-(re)joined worker may hold an unbuilt optimizer
+            # (no slot variables yet) while the broadcast snapshot came
+            # from a built one; build the slots, then restore.
+            build = getattr(self.optimizer, "build", None)
+            tvars = getattr(self.model, "trainable_variables", None)
+            if callable(build) and tvars:
+                try:
+                    build(tvars)
+                except Exception:  # noqa: BLE001 — fall through to check
+                    pass
+            opt_vars = self._opt_vars()
+        if len(opt_vars) != len(saved_opt):
+            raise ValueError(
+                f"optimizer snapshot has {len(saved_opt)} variables but "
+                f"the local optimizer has {len(opt_vars)}; restoring "
+                "would silently diverge — ensure the optimizer is built "
+                "identically on every rank")
+        for var, val in zip(opt_vars, saved_opt):
+            var.assign(val)
+        for k, v in self._saved["extra"].items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        _elastic._sync_state(self, "elastic.tf_keras_state")

@@ -1,0 +1,59 @@
+"""Object broadcast/gather helpers for the TensorFlow frontend.
+
+Reference analog: ``horovod/tensorflow/functions.py``
+(``broadcast_object``, ``broadcast_object_fn``, ``allgather_object``) —
+pickle the object, ship the length then the payload as uint8 tensors
+through the eager collective engine.
+"""
+
+import io
+import pickle
+
+import numpy as np
+
+from horovod_tpu.common import eager_ops
+from horovod_tpu.common.basics import HorovodBasics
+from horovod_tpu.common.elastic import _broadcast_object
+
+_basics = HorovodBasics()
+
+
+def broadcast_object(obj, root_rank=0, name=None, process_set_id=0):
+    """Broadcast an arbitrary picklable python object from ``root_rank``;
+    every rank returns the root's object."""
+    return _broadcast_object(obj, root_rank=root_rank,
+                             name=name or "tf.broadcast_object",
+                             process_set_id=process_set_id)
+
+
+def broadcast_object_fn(root_rank=0, name=None, process_set_id=0):
+    """Return a callable ``f(obj) -> obj`` bound to ``root_rank`` —
+    reference parity with hvd.broadcast_object_fn (used where the object
+    to broadcast is produced lazily, e.g. inside a tf.function guard)."""
+
+    def _fn(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set_id=process_set_id)
+
+    return _fn
+
+
+def allgather_object(obj, name=None, process_set_id=0):
+    """Gather a picklable python object from every rank; returns a list
+    indexed by rank."""
+    name = name or "tf.allgather_object"
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+
+    sizes = eager_ops.allgather_async(
+        np.array([payload.size], dtype=np.int64), f"{name}.len",
+        process_set_id=process_set_id).synchronize()
+    gathered = eager_ops.allgather_async(
+        payload, f"{name}.data",
+        process_set_id=process_set_id).synchronize()
+    out, off = [], 0
+    for s in sizes:
+        out.append(pickle.loads(gathered[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out

@@ -8,3 +8,4 @@ standalone Keras is not a separate install in this environment).
 
 from horovod_tpu.keras import *  # noqa: F401,F403
 from horovod_tpu.keras import callbacks  # noqa: F401
+from horovod_tpu.tensorflow.keras import elastic  # noqa: F401

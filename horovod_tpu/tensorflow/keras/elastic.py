@@ -1,0 +1,16 @@
+"""tf.keras elastic alias (reference analog:
+``horovod/tensorflow/keras/elastic.py``)."""
+
+from horovod_tpu.keras.elastic import (  # noqa: F401
+    CommitStateCallback,
+    KerasState,
+    ObjectState,
+    State,
+    TensorFlowKerasState,
+    TensorFlowState,
+    UpdateBatchStateCallback,
+    UpdateEpochStateCallback,
+    init,
+    reset,
+    run,
+)

@@ -8,7 +8,7 @@ broadcast on sync) + ``horovod/torch/elastic/__init__.py`` (``run``).
 import copy
 
 from horovod_tpu.common import elastic as _elastic
-from horovod_tpu.common.elastic import State, _broadcast_object
+from horovod_tpu.common.elastic import State
 
 run = _elastic.run_fn
 init = _elastic.init
@@ -112,11 +112,4 @@ class TorchState(State):
             setattr(self, k, copy.deepcopy(v))
 
     def sync(self):
-        from horovod_tpu.common.basics import HorovodBasics
-
-        if HorovodBasics().size() == 1:
-            return
-        self.save()
-        self._saved = _broadcast_object(self._saved,
-                                        name="elastic.torch_state")
-        self.restore()
+        _elastic._sync_state(self, "elastic.torch_state")
