@@ -175,6 +175,29 @@ def _broadcast_object(obj, root_rank=0, name="elastic.obj",
     return pickle.loads(out.tobytes())
 
 
+def _allgather_object(obj, name="allgather.obj", process_set_id=0):
+    """Pickle-gather an arbitrary object from every rank: list indexed by
+    rank. Shared wire protocol (length vector, then concatenated payload)
+    for every frontend's ``allgather_object``."""
+    import pickle
+
+    import numpy as np
+
+    payload = np.frombuffer(pickle.dumps(obj, pickle.HIGHEST_PROTOCOL),
+                            dtype=np.uint8)
+    sizes = eager_ops.allgather_async(
+        np.array([payload.size], dtype=np.int64), f"{name}.len",
+        process_set_id=process_set_id).synchronize()
+    data = eager_ops.allgather_async(
+        payload, f"{name}.data",
+        process_set_id=process_set_id).synchronize()
+    out, off = [], 0
+    for s in sizes:
+        out.append(pickle.loads(data[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out
+
+
 def _sync_state(state, name, attr="_saved"):
     """Shared sync protocol for State subclasses that keep their snapshot
     in one attribute: rank 0 snapshots, everyone adopts its broadcast,

@@ -83,17 +83,6 @@ def broadcast_object(obj, root_rank=0, name=None):
 def allgather_object(obj, name=None):
     """Gather an arbitrary python object from every rank; returns a list
     indexed by rank. Reference analog: hvd.allgather_object."""
-    name = name or "allgather_object"
-    buf = io.BytesIO()
-    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    from horovod_tpu.common.elastic import _allgather_object
 
-    sizes = np.asarray(mpi_ops.allgather(
-        np.array([payload.size], dtype=np.int64), name=f"{name}.len"))
-    gathered = np.asarray(mpi_ops.allgather(payload, name=f"{name}.data"))
-    out = []
-    off = 0
-    for s in sizes:
-        out.append(pickle.loads(gathered[off:off + int(s)].tobytes()))
-        off += int(s)
-    return out
+    return _allgather_object(obj, name=name or "allgather_object")

@@ -53,8 +53,11 @@ class _DistributedOptimizer:
         from horovod_tpu.tensorflow import _allreduce_grads_list
 
         if variables is not None and len(variables) == len(grads):
+            # Variable-derived for cross-rank stability, positional
+            # suffix for uniqueness (two models may both own a
+            # 'dense/kernel'; duplicate names fail group enqueue).
             names = [
-                f"keras.grad.{getattr(v, 'path', None) or getattr(v, 'name', i)}"
+                f"keras.grad.{getattr(v, 'path', None) or getattr(v, 'name', '')}.{i}"
                 for i, v in enumerate(variables)]
         else:
             names = [f"keras.grad.{i}" for i in range(len(grads))]

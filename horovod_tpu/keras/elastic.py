@@ -65,6 +65,7 @@ class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
         super().__init__()
         self._state = state
         self._offset = 0
+        self._orig_steps = None
         if not hasattr(state, "batch"):
             state.batch = 0
 
@@ -75,6 +76,7 @@ class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
             self._offset = self._state.batch
             steps = (self.params or {}).get("steps")
             if steps:
+                self._orig_steps = steps
                 self.params["steps"] = max(steps - self._offset, 1)
 
     def on_train_batch_end(self, batch, logs=None):
@@ -82,6 +84,11 @@ class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         self._state.batch = 0
+        if self._orig_steps is not None:
+            # params is shared by the whole CallbackList; un-shrink it so
+            # epochs after the resumed one see the true step count.
+            self.params["steps"] = self._orig_steps
+            self._orig_steps = None
 
 
 class UpdateEpochStateCallback(tf.keras.callbacks.Callback):

@@ -1,0 +1,161 @@
+"""Spark Lightning estimator.
+
+Reference analog: ``horovod/spark/lightning/estimator.py``
+(``TorchEstimator`` over LightningModules → ``TorchModel``). The
+reference drives a real ``pytorch_lightning.Trainer``; here the
+lightning *protocol* is duck-typed — any ``torch.nn.Module`` that
+implements ``training_step(batch, batch_idx)`` and
+``configure_optimizers()`` (optionally ``on_train_epoch_end()``)
+trains, which includes genuine ``pytorch_lightning.LightningModule``
+instances, without requiring the pytorch_lightning package in the
+image. Staging flow matches the Torch estimator: DataFrame → parquet in
+the store → ``horovod_tpu.spark.run`` → fitted transformer.
+"""
+
+from horovod_tpu.spark.common.params import EstimatorParams
+from horovod_tpu.spark.keras import _df_to_parquet, _load_np
+from horovod_tpu.spark.torch import (
+    TorchModel,
+    _deserialize_torch,
+    _serialize_torch,
+)
+
+
+def _unpack_optimizers(cfg):
+    """Normalize configure_optimizers()'s forms: a single optimizer, a
+    list of optimizers, a list/tuple of per-optimizer dicts, a
+    (optimizers, schedulers) tuple, or a dict with 'optimizer'
+    (+ optional 'lr_scheduler')."""
+    if isinstance(cfg, dict):
+        scheds = cfg.get("lr_scheduler")
+        scheds = [scheds] if scheds is not None else []
+        scheds = [s["scheduler"] if isinstance(s, dict) else s
+                  for s in scheds]
+        return [cfg["optimizer"]], scheds
+    if isinstance(cfg, tuple) and len(cfg) == 2 \
+            and isinstance(cfg[0], (list, tuple)):
+        opts, scheds = cfg
+        scheds = [s["scheduler"] if isinstance(s, dict) else s
+                  for s in scheds]
+        return list(opts), list(scheds)
+    if isinstance(cfg, (list, tuple)):
+        opts, scheds = [], []
+        for item in cfg:
+            o, s = _unpack_optimizers(item)
+            opts.extend(o)
+            scheds.extend(s)
+        return opts, scheds
+    return [cfg], []
+
+
+def _step_loss(out):
+    """training_step may return the loss tensor or a dict with 'loss'."""
+    if isinstance(out, dict):
+        return out["loss"]
+    return out
+
+
+def _named_params_for(model, base_opt, opt_idx):
+    """Scoped (name, param) pairs for one optimizer's param groups —
+    names must be distinct across optimizers for the collective layer."""
+    by_id = {id(p): n for n, p in model.named_parameters()}
+    out = []
+    for gi, group in enumerate(base_opt.param_groups):
+        for pi, p in enumerate(group["params"]):
+            name = by_id.get(id(p), f"g{gi}.p{pi}")
+            out.append((f"opt{opt_idx}.{name}", p))
+    return out
+
+
+def train_protocol_model(model, x_t, y_t, batch_size, epochs,
+                         distributed=True):
+    """Run the lightning-protocol training loop on host tensors.
+
+    With ``distributed=True`` every optimizer is wrapped in
+    ``horovod_tpu.torch.DistributedOptimizer`` and parameters/optimizer
+    state broadcast from rank 0 first (requires an initialized core).
+    Multiple optimizers follow lightning's multi-optimizer contract:
+    ``training_step(batch, batch_idx, optimizer_idx)`` is called once
+    per optimizer per batch, each with its own zero_grad/step.
+    """
+    base_opts, scheds = _unpack_optimizers(model.configure_optimizers())
+    if not base_opts:
+        raise ValueError("configure_optimizers() returned no optimizer")
+    opts = list(base_opts)
+    if distributed:
+        import horovod_tpu.torch as hvd
+
+        opts = [hvd.DistributedOptimizer(
+                    bo, named_parameters=_named_params_for(model, bo, oi))
+                for oi, bo in enumerate(base_opts)]
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        for bo in base_opts:
+            hvd.broadcast_optimizer_state(bo, root_rank=0)
+    n = x_t.shape[0]
+    model.train()
+    multi = len(opts) > 1
+    for _ in range(epochs):
+        for batch_idx, i in enumerate(range(0, n, batch_size)):
+            batch = (x_t[i:i + batch_size], y_t[i:i + batch_size])
+            for oi, opt in enumerate(opts):
+                opt.zero_grad()
+                loss = _step_loss(
+                    model.training_step(batch, batch_idx, oi) if multi
+                    else model.training_step(batch, batch_idx))
+                loss.backward()
+                opt.step()
+        for sched in scheds:
+            sched.step()
+        epoch_end = getattr(model, "on_train_epoch_end", None)
+        if callable(epoch_end):
+            epoch_end()
+    return model
+
+
+class LightningEstimator(EstimatorParams):
+    """fit(df) -> LightningModel. Params mirror the reference estimator
+    (the reference's ``TorchEstimator`` in ``horovod.spark.lightning``)."""
+
+    def fit(self, df, spark=None):
+        from horovod_tpu.spark import run as spark_run
+
+        if self.store is None:
+            raise ValueError(
+                "LightningEstimator needs a store= to stage data")
+        train_path = self.store.get_train_data_path(self.run_id)
+        _df_to_parquet(df, train_path, self.num_proc)
+
+        # Locals only below (see KerasEstimator): the closure must not
+        # capture self.
+        model_bytes = _serialize_torch(self.model)
+        params = dict(
+            train_path=train_path, feature_cols=tuple(self.feature_cols),
+            label_cols=tuple(self.label_cols), batch_size=self.batch_size,
+            epochs=self.epochs)
+
+        def train():
+            import numpy as np
+            import torch
+
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            model = _deserialize_torch(model_bytes)
+            x, y = _load_np(params["train_path"], params["feature_cols"],
+                            params["label_cols"], hvd.rank(), hvd.size())
+            train_protocol_model(
+                model, torch.from_numpy(np.ascontiguousarray(x)),
+                torch.from_numpy(np.ascontiguousarray(y)),
+                params["batch_size"], params["epochs"])
+            if hvd.rank() == 0:
+                return _serialize_torch(model)
+            return None
+
+        results = spark_run(train, num_proc=self.num_proc, spark=spark)
+        trained = next(r for r in results if r is not None)
+        return LightningModel(trained, self.feature_cols, self.label_cols)
+
+
+class LightningModel(TorchModel):
+    """Transformer over the fitted module (same surface as TorchModel —
+    the reference's ``TorchModel`` in ``horovod.spark.lightning``)."""

@@ -6,16 +6,10 @@ pickle the object, ship the length then the payload as uint8 tensors
 through the eager collective engine.
 """
 
-import io
-import pickle
-
-import numpy as np
-
-from horovod_tpu.common import eager_ops
-from horovod_tpu.common.basics import HorovodBasics
-from horovod_tpu.common.elastic import _broadcast_object
-
-_basics = HorovodBasics()
+from horovod_tpu.common.elastic import (
+    _allgather_object,
+    _broadcast_object,
+)
 
 
 def broadcast_object(obj, root_rank=0, name=None, process_set_id=0):
@@ -41,19 +35,5 @@ def broadcast_object_fn(root_rank=0, name=None, process_set_id=0):
 def allgather_object(obj, name=None, process_set_id=0):
     """Gather a picklable python object from every rank; returns a list
     indexed by rank."""
-    name = name or "tf.allgather_object"
-    buf = io.BytesIO()
-    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
-
-    sizes = eager_ops.allgather_async(
-        np.array([payload.size], dtype=np.int64), f"{name}.len",
-        process_set_id=process_set_id).synchronize()
-    gathered = eager_ops.allgather_async(
-        payload, f"{name}.data",
-        process_set_id=process_set_id).synchronize()
-    out, off = [], 0
-    for s in sizes:
-        out.append(pickle.loads(gathered[off:off + int(s)].tobytes()))
-        off += int(s)
-    return out
+    return _allgather_object(obj, name=name or "tf.allgather_object",
+                             process_set_id=process_set_id)

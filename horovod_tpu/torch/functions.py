@@ -104,16 +104,6 @@ def broadcast_object(obj, root_rank=0, name=None):
 
 
 def allgather_object(obj, name=None):
-    name = name or "allgather_object"
-    buf = io.BytesIO()
-    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-    payload = torch.from_numpy(
-        np.frombuffer(buf.getvalue(), dtype=np.uint8).copy())
-    sizes = mpi_ops.allgather(torch.tensor([payload.numel()]),
-                              name=f"{name}.len")
-    data = mpi_ops.allgather(payload, name=f"{name}.data")
-    out, off = [], 0
-    for s in sizes.tolist():
-        out.append(pickle.loads(data[off:off + s].numpy().tobytes()))
-        off += s
-    return out
+    from horovod_tpu.common.elastic import _allgather_object
+
+    return _allgather_object(obj, name=name or "allgather_object")

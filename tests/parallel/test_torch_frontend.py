@@ -247,3 +247,50 @@ def _worker_sync_bn(rank, size):
 
 def test_sync_batch_norm():
     assert run_ranks(_worker_sync_bn, 2) == ["ok"] * 2
+
+
+def _worker_lightning_protocol(rank, size):
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    hvd.init()
+    try:
+        torch.manual_seed(1234 + rank)  # diverge per rank pre-broadcast
+
+        class Lit(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.net = torch.nn.Linear(3, 1)
+
+            def forward(self, x):
+                return self.net(x)
+
+            def training_step(self, batch, batch_idx):
+                x, y = batch
+                return torch.nn.functional.mse_loss(self(x), y)
+
+            def configure_optimizers(self):
+                return torch.optim.SGD(self.parameters(), lr=0.05)
+
+        model = Lit()
+        rng = np.random.RandomState(rank)  # rank-local data shard
+        x = torch.from_numpy(rng.randn(16, 3).astype("float32"))
+        y = x @ torch.tensor([[1.0], [-1.0], [2.0]])
+        train_protocol_model(model, x, y, batch_size=8, epochs=2,
+                             distributed=True)
+        # broadcast + averaged grads => identical params on all ranks
+        digest = float(sum(p.detach().sum() for p in model.parameters()))
+        digests = hvd.allgather_object(digest)
+        assert all(abs(d - digests[0]) < 1e-6 for d in digests), digests
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+@pytest.mark.parametrize("size", [2])
+def test_lightning_protocol_distributed(size):
+    assert run_ranks(_worker_lightning_protocol, size, timeout=180) \
+        == ["ok"] * size

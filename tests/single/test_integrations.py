@@ -86,3 +86,119 @@ def test_spark_run_gating():
 
     with pytest.raises(ImportError, match="pyspark"):
         hs.run(lambda: None, num_proc=2)
+
+
+def test_lightning_protocol_training():
+    """The duck-typed lightning runner trains a module that implements
+    training_step/configure_optimizers, without pytorch_lightning."""
+    import torch
+
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    class Lit(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.net = torch.nn.Linear(3, 1)
+            self.epoch_ends = 0
+
+        def forward(self, x):
+            return self.net(x)
+
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            return {"loss": torch.nn.functional.mse_loss(self(x), y)}
+
+        def configure_optimizers(self):
+            opt = torch.optim.SGD(self.parameters(), lr=0.1)
+            sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1,
+                                                    gamma=0.5)
+            return [opt], [sched]
+
+        def on_train_epoch_end(self):
+            self.epoch_ends += 1
+
+    torch.manual_seed(0)
+    model = Lit()
+    x = torch.randn(32, 3)
+    w = torch.tensor([[1.0], [-2.0], [0.5]])
+    y = x @ w
+    loss0 = torch.nn.functional.mse_loss(model(x), y).item()
+    train_protocol_model(model, x, y, batch_size=8, epochs=3,
+                         distributed=False)
+    loss1 = torch.nn.functional.mse_loss(model(x), y).item()
+    assert loss1 < loss0 * 0.5
+    assert model.epoch_ends == 3
+
+
+def test_lightning_optimizer_unpacking():
+    import torch
+
+    from horovod_tpu.spark.lightning import _unpack_optimizers
+
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=0.1)
+    sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1)
+
+    assert _unpack_optimizers(opt) == ([opt], [])
+    assert _unpack_optimizers([opt]) == ([opt], [])
+    assert _unpack_optimizers(([opt], [sched])) == ([opt], [sched])
+    assert _unpack_optimizers(
+        {"optimizer": opt, "lr_scheduler": {"scheduler": sched}}) \
+        == ([opt], [sched])
+    assert _unpack_optimizers({"optimizer": opt}) == ([opt], [])
+
+    # lightning's tuple-of-dicts form (one dict per optimizer)
+    opt2 = torch.optim.SGD([p], lr=0.2)
+    assert _unpack_optimizers(({"optimizer": opt},
+                               {"optimizer": opt2,
+                                "lr_scheduler": {"scheduler": sched}})) \
+        == ([opt, opt2], [sched])
+
+
+def test_lightning_multi_optimizer_training():
+    """Two optimizers follow lightning's contract: training_step is
+    called once per optimizer with optimizer_idx, each one steps."""
+    import torch
+
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    class TwoOpt(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a = torch.nn.Linear(3, 1)
+            self.b = torch.nn.Linear(3, 1)
+            self.seen_idx = set()
+
+        def training_step(self, batch, batch_idx, optimizer_idx):
+            x, y = batch
+            self.seen_idx.add(optimizer_idx)
+            net = self.a if optimizer_idx == 0 else self.b
+            return torch.nn.functional.mse_loss(net(x), y)
+
+        def configure_optimizers(self):
+            return ({"optimizer": torch.optim.SGD(self.a.parameters(),
+                                                  lr=0.1)},
+                    {"optimizer": torch.optim.SGD(self.b.parameters(),
+                                                  lr=0.1)})
+
+    torch.manual_seed(0)
+    model = TwoOpt()
+    x = torch.randn(32, 3)
+    y = x @ torch.tensor([[1.0], [0.5], [-1.0]])
+    la0 = torch.nn.functional.mse_loss(model.a(x), y).item()
+    lb0 = torch.nn.functional.mse_loss(model.b(x), y).item()
+    train_protocol_model(model, x, y, batch_size=8, epochs=3,
+                         distributed=False)
+    assert model.seen_idx == {0, 1}
+    assert torch.nn.functional.mse_loss(model.a(x), y).item() < la0 * 0.5
+    assert torch.nn.functional.mse_loss(model.b(x), y).item() < lb0 * 0.5
+
+
+def test_lightning_estimator_requires_store():
+    import torch
+
+    from horovod_tpu.spark.lightning import LightningEstimator
+
+    est = LightningEstimator(model=torch.nn.Linear(2, 1), epochs=1)
+    with pytest.raises(ValueError, match="store"):
+        est.fit(df=None)
