@@ -1,0 +1,52 @@
+"""Shared estimator fit scaffold: stage the DataFrame, collect results.
+
+Reference analog: the common flow of ``horovod/spark/*/estimator.py`` —
+every estimator materializes the DataFrame to the store as parquet,
+launches training via ``horovod_tpu.spark.run``, and unwraps rank 0's
+returned model.
+"""
+
+import os
+
+import numpy as np
+
+
+def _df_to_parquet(df, path, num_proc):
+    df.repartition(max(num_proc or 1, 1)).write.mode("overwrite").parquet(path)
+
+
+def _load_np(path, feature_cols, label_cols, rank, size):
+    import pandas as pd
+
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path)
+        if f.endswith(".parquet"))
+    shard = files[rank::size] or files  # every rank needs >=1 shard
+    frames = [pd.read_parquet(f) for f in shard]
+    df = pd.concat(frames, ignore_index=True)
+    x = np.stack([np.asarray(v, np.float32)
+                  for v in df[list(feature_cols)].to_numpy().tolist()])
+    if x.ndim == 3 and x.shape[1] == 1:
+        x = x[:, 0]
+    y = df[list(label_cols)].to_numpy().astype(np.float32)
+    return x, y
+
+
+def stage_train_data(estimator, df):
+    """Validate the store and write the DataFrame as parquet; returns the
+    staged path."""
+    if estimator.store is None:
+        raise ValueError(
+            f"{type(estimator).__name__} needs a store= to stage data")
+    train_path = estimator.store.get_train_data_path(estimator.run_id)
+    _df_to_parquet(df, train_path, estimator.num_proc)
+    return train_path
+
+
+def collect_trained(results):
+    """Unwrap the non-None (rank 0) result from a spark_run result list."""
+    trained = next((r for r in results if r is not None), None)
+    if trained is None:
+        raise RuntimeError(
+            "no rank returned a trained model — rank 0's result is missing")
+    return trained

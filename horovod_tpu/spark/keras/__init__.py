@@ -10,32 +10,15 @@ The petastorm reader of the reference is replaced by a pandas/pyarrow
 parquet path — the store's data is plain parquet either way.
 """
 
-import os
-
 import numpy as np
 
+from horovod_tpu.spark.common.fit import (  # noqa: F401 — re-exported
+    _df_to_parquet,
+    _load_np,
+    collect_trained,
+    stage_train_data,
+)
 from horovod_tpu.spark.common.params import EstimatorParams
-
-
-def _df_to_parquet(df, path, num_proc):
-    df.repartition(max(num_proc or 1, 1)).write.mode("overwrite").parquet(path)
-
-
-def _load_np(path, feature_cols, label_cols, rank, size):
-    import pandas as pd
-
-    files = sorted(
-        os.path.join(path, f) for f in os.listdir(path)
-        if f.endswith(".parquet"))
-    shard = files[rank::size] or files  # every rank needs >=1 shard
-    frames = [pd.read_parquet(f) for f in shard]
-    df = pd.concat(frames, ignore_index=True)
-    x = np.stack([np.asarray(v, np.float32)
-                  for v in df[list(feature_cols)].to_numpy().tolist()])
-    if x.ndim == 3 and x.shape[1] == 1:
-        x = x[:, 0]
-    y = df[list(label_cols)].to_numpy().astype(np.float32)
-    return x, y
 
 
 class KerasEstimator(EstimatorParams):
@@ -48,10 +31,7 @@ class KerasEstimator(EstimatorParams):
     def fit(self, df, spark=None):
         from horovod_tpu.spark import run as spark_run
 
-        if self.store is None:
-            raise ValueError("KerasEstimator needs a store= to stage data")
-        train_path = self.store.get_train_data_path(self.run_id)
-        _df_to_parquet(df, train_path, self.num_proc)
+        train_path = stage_train_data(self, df)
 
         # Locals only below: the train closure must not capture self, or
         # cloudpickle ships the live model/store to executors alongside
@@ -86,7 +66,7 @@ class KerasEstimator(EstimatorParams):
             return None
 
         results = spark_run(train, num_proc=self.num_proc, spark=spark)
-        trained_bytes, history = next(r for r in results if r is not None)
+        trained_bytes, history = collect_trained(results)
         return KerasModel(trained_bytes, self.feature_cols, self.label_cols,
                           self.custom_objects, history)
 

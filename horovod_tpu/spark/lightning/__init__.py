@@ -12,8 +12,14 @@ image. Staging flow matches the Torch estimator: DataFrame → parquet in
 the store → ``horovod_tpu.spark.run`` → fitted transformer.
 """
 
+import contextlib
+
+from horovod_tpu.spark.common.fit import (
+    _load_np,
+    collect_trained,
+    stage_train_data,
+)
 from horovod_tpu.spark.common.params import EstimatorParams
-from horovod_tpu.spark.keras import _df_to_parquet, _load_np
 from horovod_tpu.spark.torch import (
     TorchModel,
     _deserialize_torch,
@@ -21,23 +27,30 @@ from horovod_tpu.spark.torch import (
 )
 
 
+def _sched_entry(s):
+    """Normalize one scheduler spec to {scheduler, interval, frequency}
+    (lightning's lr_scheduler dict form; bare schedulers step per epoch)."""
+    if isinstance(s, dict):
+        return {"scheduler": s["scheduler"],
+                "interval": s.get("interval", "epoch"),
+                "frequency": s.get("frequency", 1)}
+    return {"scheduler": s, "interval": "epoch", "frequency": 1}
+
+
 def _unpack_optimizers(cfg):
     """Normalize configure_optimizers()'s forms: a single optimizer, a
     list of optimizers, a list/tuple of per-optimizer dicts, a
     (optimizers, schedulers) tuple, or a dict with 'optimizer'
-    (+ optional 'lr_scheduler')."""
+    (+ optional 'lr_scheduler'). Scheduler specs keep their lightning
+    interval/frequency metadata."""
     if isinstance(cfg, dict):
         scheds = cfg.get("lr_scheduler")
-        scheds = [scheds] if scheds is not None else []
-        scheds = [s["scheduler"] if isinstance(s, dict) else s
-                  for s in scheds]
+        scheds = [_sched_entry(scheds)] if scheds is not None else []
         return [cfg["optimizer"]], scheds
     if isinstance(cfg, tuple) and len(cfg) == 2 \
             and isinstance(cfg[0], (list, tuple)):
         opts, scheds = cfg
-        scheds = [s["scheduler"] if isinstance(s, dict) else s
-                  for s in scheds]
-        return list(opts), list(scheds)
+        return list(opts), [_sched_entry(s) for s in scheds]
     if isinstance(cfg, (list, tuple)):
         opts, scheds = [], []
         for item in cfg:
@@ -53,6 +66,27 @@ def _step_loss(out):
     if isinstance(out, dict):
         return out["loss"]
     return out
+
+
+def _param_ids(base_opt):
+    return {id(p) for g in base_opt.param_groups for p in g["params"]}
+
+
+@contextlib.contextmanager
+def _toggle_optimizer(all_params, active_ids):
+    """Lightning's toggle_optimizer: while one optimizer trains, the
+    other optimizers' (non-shared) params get requires_grad=False so its
+    loss cannot deposit gradients into them (a GAN generator loss flows
+    through the discriminator but must not train it)."""
+    prev = [(p, p.requires_grad) for p in all_params]
+    for p in all_params:
+        if id(p) not in active_ids:
+            p.requires_grad_(False)
+    try:
+        yield
+    finally:
+        for p, rg in prev:
+            p.requires_grad_(rg)
 
 
 def _named_params_for(model, base_opt, opt_idx):
@@ -74,13 +108,27 @@ def train_protocol_model(model, x_t, y_t, batch_size, epochs,
     With ``distributed=True`` every optimizer is wrapped in
     ``horovod_tpu.torch.DistributedOptimizer`` and parameters/optimizer
     state broadcast from rank 0 first (requires an initialized core).
-    Multiple optimizers follow lightning's multi-optimizer contract:
-    ``training_step(batch, batch_idx, optimizer_idx)`` is called once
-    per optimizer per batch, each with its own zero_grad/step.
+    Multiple optimizers follow lightning's contract:
+    ``training_step(batch, batch_idx, optimizer_idx)`` runs once per
+    optimizer per batch under ``toggle_optimizer`` semantics (the other
+    optimizers' params are frozen, so cross-optimizer losses cannot
+    deposit gradients — or, distributed, enqueue stray allreduces).
+    Schedulers honor lightning's ``interval``/``frequency`` metadata.
     """
     base_opts, scheds = _unpack_optimizers(model.configure_optimizers())
     if not base_opts:
         raise ValueError("configure_optimizers() returned no optimizer")
+    multi = len(base_opts) > 1
+    ids_per_opt = [_param_ids(bo) for bo in base_opts]
+    if multi and distributed:
+        for a in range(len(ids_per_opt)):
+            for b in range(a + 1, len(ids_per_opt)):
+                if ids_per_opt[a] & ids_per_opt[b]:
+                    raise NotImplementedError(
+                        "distributed multi-optimizer training with "
+                        "parameters shared between optimizers is not "
+                        "supported — each shared param would register "
+                        "one gradient hook per optimizer")
     opts = list(base_opts)
     if distributed:
         import horovod_tpu.torch as hvd
@@ -91,21 +139,33 @@ def train_protocol_model(model, x_t, y_t, batch_size, epochs,
         hvd.broadcast_parameters(model.state_dict(), root_rank=0)
         for bo in base_opts:
             hvd.broadcast_optimizer_state(bo, root_rank=0)
+    all_params = list(model.parameters())
     n = x_t.shape[0]
     model.train()
-    multi = len(opts) > 1
-    for _ in range(epochs):
+    global_step = 0
+    for epoch in range(epochs):
         for batch_idx, i in enumerate(range(0, n, batch_size)):
             batch = (x_t[i:i + batch_size], y_t[i:i + batch_size])
             for oi, opt in enumerate(opts):
-                opt.zero_grad()
-                loss = _step_loss(
-                    model.training_step(batch, batch_idx, oi) if multi
-                    else model.training_step(batch, batch_idx))
-                loss.backward()
-                opt.step()
-        for sched in scheds:
-            sched.step()
+                with contextlib.ExitStack() as stack:
+                    if multi:
+                        stack.enter_context(
+                            _toggle_optimizer(all_params, ids_per_opt[oi]))
+                    opt.zero_grad()
+                    loss = _step_loss(
+                        model.training_step(batch, batch_idx, oi) if multi
+                        else model.training_step(batch, batch_idx))
+                    loss.backward()
+                    opt.step()
+            global_step += 1
+            for s in scheds:
+                if s["interval"] == "step" \
+                        and global_step % s["frequency"] == 0:
+                    s["scheduler"].step()
+        for s in scheds:
+            if s["interval"] == "epoch" \
+                    and (epoch + 1) % s["frequency"] == 0:
+                s["scheduler"].step()
         epoch_end = getattr(model, "on_train_epoch_end", None)
         if callable(epoch_end):
             epoch_end()
@@ -119,11 +179,7 @@ class LightningEstimator(EstimatorParams):
     def fit(self, df, spark=None):
         from horovod_tpu.spark import run as spark_run
 
-        if self.store is None:
-            raise ValueError(
-                "LightningEstimator needs a store= to stage data")
-        train_path = self.store.get_train_data_path(self.run_id)
-        _df_to_parquet(df, train_path, self.num_proc)
+        train_path = stage_train_data(self, df)
 
         # Locals only below (see KerasEstimator): the closure must not
         # capture self.
@@ -152,8 +208,8 @@ class LightningEstimator(EstimatorParams):
             return None
 
         results = spark_run(train, num_proc=self.num_proc, spark=spark)
-        trained = next(r for r in results if r is not None)
-        return LightningModel(trained, self.feature_cols, self.label_cols)
+        return LightningModel(collect_trained(results), self.feature_cols,
+                              self.label_cols)
 
 
 class LightningModel(TorchModel):

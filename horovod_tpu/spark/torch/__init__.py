@@ -10,8 +10,12 @@ import io
 
 import numpy as np
 
+from horovod_tpu.spark.common.fit import (
+    _load_np,
+    collect_trained,
+    stage_train_data,
+)
 from horovod_tpu.spark.common.params import EstimatorParams
-from horovod_tpu.spark.keras import _df_to_parquet, _load_np
 
 
 def _serialize_torch(model):
@@ -36,10 +40,7 @@ class TorchEstimator(EstimatorParams):
     def fit(self, df, spark=None):
         from horovod_tpu.spark import run as spark_run
 
-        if self.store is None:
-            raise ValueError("TorchEstimator needs a store= to stage data")
-        train_path = self.store.get_train_data_path(self.run_id)
-        _df_to_parquet(df, train_path, self.num_proc)
+        train_path = stage_train_data(self, df)
 
         model_bytes = _serialize_torch(self.model)
         loss_fn = self.loss
@@ -81,8 +82,8 @@ class TorchEstimator(EstimatorParams):
             return None
 
         results = spark_run(train, num_proc=self.num_proc, spark=spark)
-        trained = next(r for r in results if r is not None)
-        return TorchModel(trained, self.feature_cols, self.label_cols)
+        return TorchModel(collect_trained(results), self.feature_cols,
+                          self.label_cols)
 
 
 class TorchModel:

@@ -138,21 +138,116 @@ def test_lightning_optimizer_unpacking():
     p = torch.nn.Parameter(torch.zeros(1))
     opt = torch.optim.SGD([p], lr=0.1)
     sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1)
+    entry = {"scheduler": sched, "interval": "epoch", "frequency": 1}
 
     assert _unpack_optimizers(opt) == ([opt], [])
     assert _unpack_optimizers([opt]) == ([opt], [])
-    assert _unpack_optimizers(([opt], [sched])) == ([opt], [sched])
+    assert _unpack_optimizers(([opt], [sched])) == ([opt], [entry])
     assert _unpack_optimizers(
         {"optimizer": opt, "lr_scheduler": {"scheduler": sched}}) \
-        == ([opt], [sched])
+        == ([opt], [entry])
     assert _unpack_optimizers({"optimizer": opt}) == ([opt], [])
+
+    # interval/frequency metadata rides along (per-step schedulers)
+    assert _unpack_optimizers(
+        {"optimizer": opt,
+         "lr_scheduler": {"scheduler": sched, "interval": "step",
+                          "frequency": 2}}) \
+        == ([opt], [{"scheduler": sched, "interval": "step",
+                     "frequency": 2}])
 
     # lightning's tuple-of-dicts form (one dict per optimizer)
     opt2 = torch.optim.SGD([p], lr=0.2)
     assert _unpack_optimizers(({"optimizer": opt},
                                {"optimizer": opt2,
                                 "lr_scheduler": {"scheduler": sched}})) \
-        == ([opt, opt2], [sched])
+        == ([opt, opt2], [entry])
+
+
+def test_lightning_step_interval_scheduler():
+    """interval='step' schedulers advance per batch, not per epoch."""
+    import torch
+
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    class Lit(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.net = torch.nn.Linear(3, 1)
+
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            return torch.nn.functional.mse_loss(self.net(x), y)
+
+        def configure_optimizers(self):
+            opt = torch.optim.SGD(self.parameters(), lr=1.0)
+            sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1,
+                                                    gamma=0.5)
+            return {"optimizer": opt,
+                    "lr_scheduler": {"scheduler": sched,
+                                     "interval": "step"}}
+
+    seen = []
+
+    class Track(Lit):
+        def training_step(self, batch, batch_idx):
+            seen.append(self._opt.param_groups[0]["lr"])
+            return super().training_step(batch, batch_idx)
+
+        def configure_optimizers(self):
+            cfg = super().configure_optimizers()
+            self._opt = cfg["optimizer"]
+            return cfg
+
+    x, y = torch.randn(16, 3), torch.randn(16, 1)
+    model = Track()
+    train_protocol_model(model, x, y, batch_size=4, epochs=1,
+                         distributed=False)
+    # lr observed at each of the 4 batches: halved after every step
+    assert seen == [1.0, 0.5, 0.25, 0.125]
+
+
+def test_lightning_gan_style_toggle():
+    """Generator loss flowing through the discriminator must not train
+    the discriminator (lightning toggle_optimizer semantics)."""
+    import torch
+
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    toggles = []
+
+    class GAN(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.gen = torch.nn.Linear(3, 3)
+            self.disc = torch.nn.Linear(3, 1)
+
+        def training_step(self, batch, batch_idx, optimizer_idx):
+            x, _ = batch
+            toggles.append((optimizer_idx,
+                            next(self.gen.parameters()).requires_grad,
+                            next(self.disc.parameters()).requires_grad))
+            if optimizer_idx == 0:
+                # generator loss THROUGH the discriminator
+                return -self.disc(self.gen(x)).mean()
+            return self.disc(x.detach()).mean()
+
+        def configure_optimizers(self):
+            return [torch.optim.SGD(self.gen.parameters(), lr=0.1),
+                    torch.optim.SGD(self.disc.parameters(), lr=0.0)]
+
+    torch.manual_seed(0)
+    model = GAN()
+    disc_before = [p.detach().clone() for p in model.disc.parameters()]
+    x = torch.randn(8, 3)
+    train_protocol_model(model, x, torch.zeros(8, 1), batch_size=4,
+                         epochs=1, distributed=False)
+    # during the generator's step the disc was frozen, and vice versa
+    assert (0, True, False) in toggles and (1, False, True) in toggles
+    # disc lr=0: params bit-identical, and toggle state fully restored
+    for p, p0 in zip(model.disc.parameters(), disc_before):
+        assert torch.equal(p, p0)
+        assert p.requires_grad
 
 
 def test_lightning_multi_optimizer_training():
