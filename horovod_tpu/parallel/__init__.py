@@ -31,6 +31,10 @@ from horovod_tpu.parallel.ops import (  # noqa: F401
     reduce_scatter,
 )
 from horovod_tpu.parallel.pipeline import gpipe  # noqa: F401
+from horovod_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_self_attention,
+)
 from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     blockwise_attention,
     ring_attention,

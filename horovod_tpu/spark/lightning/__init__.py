@@ -73,14 +73,15 @@ def _param_ids(base_opt):
 
 
 @contextlib.contextmanager
-def _toggle_optimizer(all_params, active_ids):
-    """Lightning's toggle_optimizer: while one optimizer trains, the
-    other optimizers' (non-shared) params get requires_grad=False so its
-    loss cannot deposit gradients into them (a GAN generator loss flows
-    through the discriminator but must not train it)."""
+def _toggle_optimizer(all_params, active_ids, other_ids):
+    """Lightning's toggle_optimizer: while one optimizer trains, params
+    owned by the *other* optimizers (and not shared with the active one)
+    get requires_grad=False so its loss cannot deposit gradients into
+    them (a GAN generator loss flows through the discriminator but must
+    not train it). Params owned by no optimizer are left alone."""
     prev = [(p, p.requires_grad) for p in all_params]
     for p in all_params:
-        if id(p) not in active_ids:
+        if id(p) in other_ids and id(p) not in active_ids:
             p.requires_grad_(False)
     try:
         yield
@@ -149,8 +150,12 @@ def train_protocol_model(model, x_t, y_t, batch_size, epochs,
             for oi, opt in enumerate(opts):
                 with contextlib.ExitStack() as stack:
                     if multi:
+                        others = set().union(
+                            *(s for j, s in enumerate(ids_per_opt)
+                              if j != oi))
                         stack.enter_context(
-                            _toggle_optimizer(all_params, ids_per_opt[oi]))
+                            _toggle_optimizer(all_params, ids_per_opt[oi],
+                                              others))
                     opt.zero_grad()
                     loss = _step_loss(
                         model.training_step(batch, batch_idx, oi) if multi

@@ -250,6 +250,40 @@ def test_lightning_gan_style_toggle():
         assert p.requires_grad
 
 
+def test_lightning_toggle_spares_unowned_params():
+    """A param owned by no optimizer keeps requires_grad during every
+    training_step (lightning toggle_optimizer only freezes params owned
+    by the *other* optimizers)."""
+    import torch
+
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    observed = []
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a = torch.nn.Linear(2, 1)
+            self.b = torch.nn.Linear(2, 1)
+            self.free = torch.nn.Parameter(torch.zeros(1))  # no optimizer
+
+        def training_step(self, batch, batch_idx, optimizer_idx):
+            observed.append(self.free.requires_grad)
+            x, y = batch
+            net = self.a if optimizer_idx == 0 else self.b
+            return torch.nn.functional.mse_loss(net(x), y) \
+                + 0.0 * self.free.sum()
+
+        def configure_optimizers(self):
+            return [torch.optim.SGD(self.a.parameters(), lr=0.1),
+                    torch.optim.SGD(self.b.parameters(), lr=0.1)]
+
+    m = M()
+    train_protocol_model(m, torch.randn(4, 2), torch.randn(4, 1),
+                         batch_size=4, epochs=1, distributed=False)
+    assert observed and all(observed)
+
+
 def test_lightning_multi_optimizer_training():
     """Two optimizers follow lightning's contract: training_step is
     called once per optimizer with optimizer_idx, each one steps."""

@@ -135,3 +135,67 @@ def test_shard_params_rules():
     assert sh["embed"].spec == P("tensor", None)
     placed = apply_sharding(params, sh)
     assert placed["embed"].sharding.spec == P("tensor", None)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq_size", [2, 4])
+def test_ulysses_attention_exact(causal, seq_size):
+    """All-to-all sequence parallelism matches single-device attention,
+    including grouped-query K/V with head counts that don't divide the
+    axis (replicated internally)."""
+    mesh = parallel.create_mesh(data=8 // seq_size, seq=seq_size)
+    rng = np.random.RandomState(3)
+    b, t, h, hkv, d = 4, 32, 4, 2, 8
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, hkv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, hkv, d), jnp.float32)
+
+    out = parallel.ulysses_self_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out),
+                               _reference_attention(q, k, v, causal),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_attention_gradients_match():
+    mesh = parallel.create_mesh(data=2, seq=4)
+    rng = np.random.RandomState(4)
+    b, t, h, d = 2, 16, 4, 8
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+
+    def loss_uly(q, k, v):
+        return jnp.sum(parallel.ulysses_self_attention(q, k, v, mesh) ** 2)
+
+    def loss_plain(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v) ** 2)
+
+    g_u = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+    g_p = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+    for gu, gp in zip(g_u, g_p):
+        np.testing.assert_allclose(np.asarray(gu), np.asarray(gp),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_lcm_replication():
+    """Hkv % P != 0 with lcm(Hkv, P) < H: K/V replicate only to the lcm
+    and the result still matches the reference."""
+    mesh = parallel.create_mesh(data=2, seq=4)
+    rng = np.random.RandomState(6)
+    b, t, h, hkv, d = 2, 32, 8, 2, 8  # lcm(2, 4) = 4 < 8 = H
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, hkv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, hkv, d), jnp.float32)
+
+    out = parallel.ulysses_self_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               _reference_attention(q, k, v, True),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = parallel.create_mesh(data=1, seq=8)
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 16, 4, 8), jnp.float32)  # 4 heads, P=8
+    with pytest.raises(Exception, match="divisible|ring_attention"):
+        parallel.ulysses_self_attention(q, q, q, mesh)
