@@ -40,6 +40,9 @@ def main():
     ap.add_argument("--fsdp", type=int, default=2, help="fsdp shards")
     ap.add_argument("--tp", type=int, default=2, help="tensor parallel")
     ap.add_argument("--sp", type=int, default=1, help="sequence parallel")
+    ap.add_argument("--sp-mode", choices=("ring", "ulysses"),
+                    default="ring",
+                    help="sequence-parallel strategy (--sp > 1)")
     ap.add_argument("--ep", type=int, default=1, help="expert parallel")
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
     ap.add_argument("--experts", type=int, default=0,
@@ -76,7 +79,7 @@ def main():
     cfg = LlamaConfig.tiny(
         d_model=args.d_model, n_layers=n_layers, n_heads=heads,
         n_kv_heads=heads, d_ff=4 * args.d_model, vocab_size=512,
-        n_experts=args.experts)
+        n_experts=args.experts, seq_parallel=args.sp_mode)
 
     params = llama_init(cfg, jax.random.PRNGKey(0))
     shardings = parallel.shard_params(

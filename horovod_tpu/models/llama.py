@@ -54,6 +54,11 @@ class LlamaConfig:
     # GPipe microbatch count when the mesh has a non-trivial "pipe" axis
     # (0 = one microbatch per stage). Batch must divide by it.
     pipeline_microbatches: int = 0
+    # Sequence-parallel strategy when the mesh's "seq" axis is
+    # non-trivial: "ring" (K/V rotate via ppermute — any head count) or
+    # "ulysses" (all-to-all head/sequence reshard — needs
+    # n_heads % seq_size == 0, cheaper at short per-device sequences).
+    seq_parallel: str = "ring"
 
     @property
     def head_dim(self):
@@ -184,8 +189,17 @@ def _rope(x, positions, theta):
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
 
 
-def _attention(q, k, v, mesh, seq_axis):
+def _attention(q, k, v, mesh, seq_axis, seq_parallel="ring"):
     if mesh is not None and seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+        if seq_parallel == "ulysses":
+            from horovod_tpu.parallel.ulysses import ulysses_self_attention
+
+            return ulysses_self_attention(q, k, v, mesh, causal=True,
+                                          batch_axis=("data", "fsdp"),
+                                          seq_axis=seq_axis)
+        if seq_parallel not in ("ring", None):
+            raise ValueError(f"unknown seq_parallel {seq_parallel!r}: "
+                             "expected 'ring' or 'ulysses'")
         return ring_self_attention(q, k, v, mesh, causal=True,
                                    batch_axis=("data", "fsdp"),
                                    seq_axis=seq_axis)
@@ -305,7 +319,7 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
                                                c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         kk = _rope(kk, positions, c.rope_theta)
-        attn = _attention(q, kk, vv, mesh, seq_axis)
+        attn = _attention(q, kk, vv, mesh, seq_axis, c.seq_parallel)
         # Named for remat="attn": saving this one tensor keeps backward
         # from re-running the whole attention forward.
         attn = checkpoint_name(attn, "attn_out")

@@ -10,10 +10,10 @@ instead of ``P`` neighbor exchanges of K/V — cheaper than the ring when
 the per-device sequence is short relative to the head count, and it
 reuses the single-device flash/blockwise kernel unchanged.
 
-Trade-off vs ring attention: the head axis must divide by the mesh axis
-size (grouped-query K/V heads are replicated up to the query head count
-first when needed), and peak activation memory holds the full sequence
-for H/P heads.
+Trade-off vs ring attention: the mesh axis size must divide the head
+count (grouped-query K/V heads are replicated up to lcm(Hkv, P) when
+the axis does not divide Hkv), and peak activation memory holds the
+full sequence for H/P heads.
 """
 
 import math
@@ -33,8 +33,8 @@ def ulysses_attention(q, k, v, axis_name, causal=True):
 
     Must run inside shard_map with the sequence dimension sharded
     contiguously across the axis. Local shards: q [B, T/P, H, D];
-    k, v [B, T/P, Hkv, D]. Requires H % P == 0 (and replicates K/V
-    heads to H when Hkv does not divide P).
+    k, v [B, T/P, Hkv, D]. Requires H % P == 0; when P does not divide
+    Hkv, K/V heads are replicated up to lcm(Hkv, P) first.
     """
     n = lax.axis_size(axis_name)
     h = q.shape[2]

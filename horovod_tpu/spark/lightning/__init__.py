@@ -141,6 +141,10 @@ def train_protocol_model(model, x_t, y_t, batch_size, epochs,
         for bo in base_opts:
             hvd.broadcast_optimizer_state(bo, root_rank=0)
     all_params = list(model.parameters())
+    others_per_opt = [
+        set().union(*(s for j, s in enumerate(ids_per_opt) if j != oi))
+        if multi else set()
+        for oi in range(len(ids_per_opt))]
     n = x_t.shape[0]
     model.train()
     global_step = 0
@@ -150,12 +154,9 @@ def train_protocol_model(model, x_t, y_t, batch_size, epochs,
             for oi, opt in enumerate(opts):
                 with contextlib.ExitStack() as stack:
                     if multi:
-                        others = set().union(
-                            *(s for j, s in enumerate(ids_per_opt)
-                              if j != oi))
                         stack.enter_context(
                             _toggle_optimizer(all_params, ids_per_opt[oi],
-                                              others))
+                                              others_per_opt[oi]))
                     opt.zero_grad()
                     loss = _step_loss(
                         model.training_step(batch, batch_idx, oi) if multi

@@ -103,6 +103,26 @@ def test_seq_parallel_forward_matches():
                                atol=2e-4)
 
 
+def test_seq_parallel_ulysses_matches():
+    """Ulysses path (seq_parallel="ulysses", seq=4) must match the
+    single-device forward (tiny config has 4 heads -> divisible)."""
+    cfg = LlamaConfig.tiny(dtype="float32", n_layers=2,
+                           seq_parallel="ulysses")
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 32), 0,
+                                cfg.vocab_size)
+    ref = llama_forward(params, tokens, cfg)
+
+    mesh = parallel.create_mesh(data=2, seq=4)
+    shardings = parallel.shard_params(params, mesh, llama_partition_rules())
+    p_sh = apply_sharding(params, shardings)
+    t_sh = jax.device_put(tokens,
+                          named_sharding(mesh, ("data", "fsdp"), "seq"))
+    out = jax.jit(
+        lambda p, t: llama_forward(p, t, cfg, mesh))(p_sh, t_sh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4,
+                               atol=2e-4)
+
 # ---- sparse mixture-of-experts (expert parallelism) ----
 
 def test_moe_forward_and_aux():
