@@ -1,0 +1,113 @@
+"""Ring-allreduce bus-bandwidth micro-benchmark (BASELINE.json's
+north-star transport metric).
+
+Run under the launcher, one process per rank:
+
+    horovodrun -np 4 python benchmarks/allreduce_bench.py \
+        --size-mb 64 --iters 10
+
+Every rank allreduces a float32 buffer; rank 0 prints one JSON line with
+the achieved algorithm bandwidth (payload/time) and bus bandwidth
+(the ring moves 2(N-1)/N x payload per rank, the standard NCCL-tests
+convention), for both the first (cold negotiation) and steady-state
+(response-cache bitvector) iterations.
+
+On a TPU pod with the xla_ici device plane enabled the same script
+measures HBM-to-HBM collectives over ICI; on CPU hosts it measures the
+native host TCP ring.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=64.0)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--grouped", type=int, default=0,
+                    help="split the payload into N tensors fused by the "
+                         "runtime (exercises the fusion buffer)")
+    args = ap.parse_args()
+
+    # Honor JAX_PLATFORMS at the config level: some images register an
+    # accelerator plugin in sitecustomize that overrides the env var, and
+    # a host-ring benchmark must not bounce its outputs through an
+    # accelerator transfer per iteration.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import xla_ici
+
+    hvd.init()
+    n = hvd.size()
+    elems = int(args.size_mb * (1 << 20) / 4)
+    payload_bytes = elems * 4
+
+    # Allocate ONCE, outside the timed region (NCCL-tests convention).
+    # The xla_ici device plane only engages for jax.Array inputs, so on
+    # TPU the payload must be a device array (HBM-to-HBM over ICI);
+    # numpy would silently fall back to the host ring. On the host ring
+    # numpy is the honest choice — jax arrays would just add two copies
+    # per iteration.
+    device_plane = xla_ici.active()
+
+    def make(arr):
+        if device_plane:
+            import jax.numpy as jnp
+
+            return jnp.asarray(arr)
+        return arr
+
+    base = np.full(elems, float(hvd.rank() + 1), np.float32)
+    if args.grouped:
+        parts = [make(p) for p in np.array_split(base, args.grouped)]
+    else:
+        payload = make(base)
+
+    def one_iter(i):
+        t0 = time.perf_counter()
+        if args.grouped:
+            outs = hvd.grouped_allreduce(
+                parts, names=[f"bench.g{j}" for j in range(args.grouped)],
+                op=hvd.Sum)
+            np.asarray(outs[0])
+        else:
+            out = hvd.allreduce(payload, name="bench.allreduce",
+                                op=hvd.Sum)
+            np.asarray(out)
+        return time.perf_counter() - t0
+
+    cold = one_iter(0)
+    times = [one_iter(i + 1) for i in range(args.iters)]
+    steady = float(np.median(times))
+
+    if hvd.rank() == 0:
+        bus_factor = 2.0 * (n - 1) / n
+        print(json.dumps({
+            "metric": "ring_allreduce_bandwidth",
+            "plane": "xla_ici" if device_plane else "host_ring",
+            "ranks": n,
+            "payload_mb": round(payload_bytes / (1 << 20), 2),
+            "grouped": args.grouped,
+            "cold_s": round(cold, 4),
+            "steady_s": round(steady, 4),
+            "algo_gbps": round(payload_bytes / steady / 1e9, 3),
+            "bus_gbps": round(payload_bytes * bus_factor / steady / 1e9, 3),
+        }), flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

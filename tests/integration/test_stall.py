@@ -1,0 +1,55 @@
+"""The stall inspector names the missing ranks of a stuck collective.
+
+Reference analog: test/single/test_stall.py (SURVEY.md §4) — one rank
+delays its submission past HOROVOD_STALL_CHECK_TIME; the coordinator
+must log a warning naming the tensor and the absent rank, and the run
+must still complete once the straggler arrives (stall is a diagnostic,
+not an abort).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WORKER_SRC = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu.jax as hvd
+
+hvd.init()
+if hvd.rank() == 1:
+    time.sleep(13)  # past the 10s inspector sweep with a 2s threshold
+out = hvd.allreduce(np.ones(4, np.float32), name="late.tensor",
+                    op=hvd.Sum)
+assert float(np.asarray(out)[0]) == 2.0
+print("RANK" + str(hvd.rank()) + " DONE", flush=True)
+hvd.shutdown()
+"""
+
+
+def test_stall_warning_names_missing_rank(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER_SRC.format(repo=REPO))
+    env = dict(os.environ,
+               PYTHONPATH=REPO,
+               JAX_PLATFORMS="cpu",
+               HOROVOD_STALL_CHECK_TIME="2")
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    log = out.stdout + out.stderr
+    assert out.returncode == 0, log[-3000:]
+    assert log.count("DONE") == 2, log[-3000:]
+    assert "Stall detected" in log, log[-3000:]
+    assert "late.tensor" in log, log[-3000:]
+    # the delayed rank (1) is the one named missing
+    stall_line = next(ln for ln in log.splitlines()
+                      if "Stall detected" in ln)
+    assert "missing ranks: 1" in stall_line, stall_line
