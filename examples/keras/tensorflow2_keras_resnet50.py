@@ -1,0 +1,73 @@
+"""Data-parallel Keras ResNet-50 — the reference's headline workload.
+
+Reference analog: examples/keras/keras_imagenet_resnet50.py +
+docs/benchmarks.rst (the ~90%-of-linear scaling chart): stock
+tf.keras.applications.ResNet50, hvd.DistributedOptimizer, LR scaled by
+world size with warmup, synthetic ImageNet-like data so it runs
+hermetically. BASELINE config #2 is this script shape on a TPU pod.
+
+Run:  horovodrun -np 2 python examples/keras/tensorflow2_keras_resnet50.py \
+          --image-size 64 --batch-size 8 --steps 4
+"""
+
+import argparse
+import time
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--batch-size", type=int, default=32,
+                    help="per-rank batch size")
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--classes", type=int, default=1000)
+    args = ap.parse_args()
+
+    hvd.init()
+    tf.random.set_seed(1234)
+
+    # Synthetic ImageNet-shaped shard for this rank.
+    rng = np.random.RandomState(100 + hvd.rank())
+    n = args.steps * args.batch_size
+    x = rng.rand(n, args.image_size, args.image_size, 3).astype(np.float32)
+    y = rng.randint(0, args.classes, n).astype(np.int64)
+
+    model = tf.keras.applications.ResNet50(
+        weights=None, classes=args.classes,
+        input_shape=(args.image_size, args.image_size, 3))
+
+    base_lr = 0.0125 * hvd.size()  # linear LR scaling (reference recipe)
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(base_lr,
+                                                           momentum=0.9))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=base_lr, warmup_epochs=3, verbose=0),
+    ]
+
+    t0 = time.perf_counter()
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks,
+              verbose=2 if hvd.rank() == 0 else 0)
+    dt = time.perf_counter() - t0
+    images = n * args.epochs
+    if hvd.rank() == 0:
+        print(f"rank0: {images / dt:.1f} images/sec/rank "
+              f"({hvd.size() * images / dt:.1f} aggregate)")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

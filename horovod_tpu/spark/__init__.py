@@ -14,7 +14,9 @@ inside run()).
 
 from horovod_tpu.spark.runner import run, run_elastic  # noqa: F401
 from horovod_tpu.spark.common.store import (  # noqa: F401
+    DBFSLocalStore,
     FilesystemStore,
+    HDFSStore,
     LocalStore,
     Store,
 )

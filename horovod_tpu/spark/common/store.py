@@ -48,24 +48,30 @@ class Store:
 
     @staticmethod
     def create(prefix_path, *args, **kwargs):
-        """Factory mirroring the reference's Store.create dispatch."""
+        """Factory mirroring the reference's Store.create dispatch:
+        hdfs:// prefixes get the HDFS store, dbfs:/ the Databricks FUSE
+        mount, anything else a plain filesystem store."""
+        if prefix_path.startswith("hdfs://"):
+            return HDFSStore(prefix_path, *args, **kwargs)
+        if prefix_path.startswith("dbfs:/") \
+                or prefix_path.startswith("/dbfs/"):
+            return DBFSLocalStore(prefix_path, *args, **kwargs)
         return FilesystemStore(prefix_path, *args, **kwargs)
 
 
-class FilesystemStore(Store):
-    """Store rooted at a mounted filesystem prefix."""
+class _LayoutMixin:
+    """The store path layout, shared by every concrete store. Paths are
+    POSIX-style on all backends (local, FUSE mounts, HDFS)."""
 
-    def __init__(self, prefix_path, train_path=None, val_path=None,
-                 test_path=None, runs_path=None):
-        self.prefix_path = os.path.abspath(prefix_path)
-        self._train = train_path or os.path.join(self.prefix_path,
+    def _init_layout(self, prefix_path, train_path, val_path, test_path,
+                     runs_path):
+        self._train = train_path or os.path.join(prefix_path,
                                                  "intermediate_train_data")
-        self._val = val_path or os.path.join(self.prefix_path,
+        self._val = val_path or os.path.join(prefix_path,
                                              "intermediate_val_data")
-        self._test = test_path or os.path.join(self.prefix_path,
+        self._test = test_path or os.path.join(prefix_path,
                                                "intermediate_test_data")
-        self._runs = runs_path or os.path.join(self.prefix_path, "runs")
-        os.makedirs(self.prefix_path, exist_ok=True)
+        self._runs = runs_path or os.path.join(prefix_path, "runs")
 
     def _with_idx(self, base, idx):
         return base if idx is None else f"{base}.{idx}"
@@ -87,6 +93,18 @@ class FilesystemStore(Store):
 
     def get_logs_path(self, run_id):
         return os.path.join(self.get_run_path(run_id), "logs")
+
+
+class FilesystemStore(_LayoutMixin, Store):
+    """Store rooted at a mounted filesystem prefix. Directories are
+    created lazily on first write, so constructing a store (e.g. via
+    Store.create dispatch) never touches the filesystem."""
+
+    def __init__(self, prefix_path, train_path=None, val_path=None,
+                 test_path=None, runs_path=None):
+        self.prefix_path = os.path.abspath(prefix_path)
+        self._init_layout(self.prefix_path, train_path, val_path,
+                          test_path, runs_path)
 
     def exists(self, path):
         return os.path.exists(path)
@@ -124,3 +142,79 @@ class FilesystemStore(Store):
 
 class LocalStore(FilesystemStore):
     """Reference-compat alias (horovod.spark.common.store.LocalStore)."""
+
+
+class DBFSLocalStore(FilesystemStore):
+    """Databricks DBFS store via the FUSE mount (reference:
+    store.DBFSLocalStore): ``dbfs:/path`` addresses ``/dbfs/path``, after
+    which it is an ordinary filesystem store."""
+
+    def __init__(self, prefix_path, *args, **kwargs):
+        super().__init__(self.normalize_datasets_path(prefix_path),
+                         *args, **kwargs)
+
+    @staticmethod
+    def normalize_datasets_path(path):
+        if path.startswith("dbfs:/"):
+            return "/dbfs/" + path[len("dbfs:/"):].lstrip("/")
+        return path
+
+
+class HDFSStore(_LayoutMixin, Store):
+    """HDFS-backed store (reference: store.HDFSStore), gated on a working
+    libhdfs via ``pyarrow.fs.HadoopFileSystem``. The TPU-idiomatic
+    deployment usually prefers a mounted FilesystemStore (NFS/gcsfuse),
+    but jobs migrating from the reference keep their hdfs:// URLs."""
+
+    def __init__(self, prefix_path, train_path=None, val_path=None,
+                 test_path=None, runs_path=None, **hdfs_kwargs):
+        try:
+            from pyarrow import fs as _pafs
+
+            self._fs = _pafs.HadoopFileSystem.from_uri(prefix_path)[0] \
+                if hasattr(_pafs.HadoopFileSystem, "from_uri") \
+                else _pafs.HadoopFileSystem(**hdfs_kwargs)
+        except Exception as e:  # noqa: BLE001 — missing libhdfs/classpath
+            raise ImportError(
+                "HDFSStore needs pyarrow with a working libhdfs "
+                "(HADOOP_HOME/CLASSPATH); for mounted storage use "
+                f"FilesystemStore instead ({e})") from e
+        # Strip the scheme+authority: pyarrow's fs takes plain paths.
+        if "://" in prefix_path:
+            rest = prefix_path.split("://", 1)[1].split("/", 1)
+            self.prefix_path = "/" + (rest[1] if len(rest) > 1 else "")
+        else:
+            self.prefix_path = prefix_path
+        self._init_layout(self.prefix_path, train_path, val_path,
+                          test_path, runs_path)
+
+    def exists(self, path):
+        from pyarrow import fs as _pafs
+
+        return self._fs.get_file_info(path).type != _pafs.FileType.NotFound
+
+    def read(self, path):
+        with self._fs.open_input_stream(path) as f:
+            return f.read()
+
+    def write(self, path, data):
+        # Write-then-rename, like FilesystemStore: readers polling
+        # exists() must never observe a partially-written file.
+        self._fs.create_dir(path.rsplit("/", 1)[0], recursive=True)
+        tmp = path + ".tmp"
+        with self._fs.open_output_stream(tmp) as f:
+            f.write(data)
+        self._fs.move(tmp, path)
+
+    def sync_fn(self, run_id):
+        run_path = self.get_run_path(run_id)
+
+        def fn(local_run_path):
+            for root, _, files in os.walk(local_run_path):
+                rel = os.path.relpath(root, local_run_path)
+                dest = run_path if rel == "." else f"{run_path}/{rel}"
+                for name in files:
+                    with open(os.path.join(root, name), "rb") as f:
+                        self.write(f"{dest}/{name}", f.read())
+
+        return fn

@@ -331,3 +331,28 @@ def test_lightning_estimator_requires_store():
     est = LightningEstimator(model=torch.nn.Linear(2, 1), epochs=1)
     with pytest.raises(ValueError, match="store"):
         est.fit(df=None)
+
+
+def test_store_create_dispatch(tmp_path):
+    from horovod_tpu.spark.common.store import (
+        DBFSLocalStore,
+        FilesystemStore,
+        Store,
+    )
+
+    assert isinstance(Store.create(str(tmp_path)), FilesystemStore)
+    s = Store.create("dbfs:/ml/exp1")
+    assert isinstance(s, DBFSLocalStore)
+    assert s.prefix_path == "/dbfs/ml/exp1"
+    # hdfs:// requires libhdfs, absent here -> clean gating error
+    with pytest.raises(ImportError, match="HDFSStore|libhdfs"):
+        Store.create("hdfs://namenode:9000/ml/exp1")
+
+
+def test_dbfs_path_normalization():
+    from horovod_tpu.spark.common.store import DBFSLocalStore
+
+    norm = DBFSLocalStore.normalize_datasets_path
+    assert norm("dbfs:/a/b") == "/dbfs/a/b"
+    assert norm("/dbfs/a/b") == "/dbfs/a/b"
+    assert norm("/plain/path") == "/plain/path"
