@@ -120,6 +120,10 @@ struct GlobalState {
   std::atomic<bool> initialized{false};
   std::atomic<bool> shutdown_requested{false};
   std::atomic<bool> loop_exited{false};
+  // Loop exited because of a control-plane failure (peer lost) rather
+  // than a requested shutdown — enqueue failures in this state are the
+  // elastic-recoverable condition (HorovodInternalError in Python).
+  std::atomic<bool> loop_failed{false};
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
   std::atomic<int64_t> fusion_threshold{64 * 1024 * 1024};
@@ -546,6 +550,7 @@ void BackgroundThreadLoop(GlobalState& st) {
         std::move(requests), st.shutdown_requested.load(), &response_list);
     if (!s.ok()) {
       LOG_ERROR("control plane failure: %s", s.reason().c_str());
+      st.loop_failed = true;
       auto orphans = st.tensor_queue.RemoveAllEntries();
       for (auto& e : orphans) st.handles.MarkDone(e.handle, s, nullptr);
       break;
@@ -610,6 +615,8 @@ using namespace hvdtpu;
 
 extern "C" {
 
+extern std::atomic<int32_t> g_next_group_id;
+
 int hvdtpu_init() {
   std::lock_guard<std::mutex> lk(g_init_mutex);
   if (g_state && g_state->initialized.load()) return 0;
@@ -620,7 +627,12 @@ int hvdtpu_init() {
   GlobalState* st = g_state;
   st->shutdown_requested = false;
   st->loop_exited = false;
+  st->loop_failed = false;
   st->joined = false;
+  // Elastic re-init: grouped-collective ids must restart at 0 on every
+  // rank — a surviving worker whose counter kept its pre-failure value
+  // would mismatch freshly-respawned peers on every grouped call.
+  g_next_group_id = 0;
   {
     // Elastic re-init: new workers start at 0, so everyone must.
     std::lock_guard<std::mutex> lk(st->barrier_mutex);
@@ -710,6 +722,10 @@ int hvdtpu_init() {
   st->background_thread = std::thread(BackgroundThreadLoop, std::ref(*st));
   LOG_INFO("initialized rank %d/%d", st->rank, st->size);
   return 0;
+}
+
+int hvdtpu_loop_failed() {
+  return (g_state != nullptr && g_state->loop_failed.load()) ? 1 : 0;
 }
 
 int hvdtpu_shutdown() {

@@ -16,6 +16,9 @@ extern "C" {
 int hvdtpu_init();
 int hvdtpu_shutdown();
 int hvdtpu_is_initialized();
+// 1 when the background loop exited on a control-plane failure (peer
+// lost) — the elastic-recoverable state; 0 otherwise.
+int hvdtpu_loop_failed();
 int hvdtpu_rank();
 int hvdtpu_size();
 int hvdtpu_local_rank();

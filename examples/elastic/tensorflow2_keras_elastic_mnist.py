@@ -1,0 +1,64 @@
+"""Elastic (fault-tolerant) Keras training with hvd.elastic callbacks.
+
+Reference analog: examples/elastic/tensorflow2/tensorflow2_keras_mnist_elastic.py —
+a compiled keras model wrapped in KerasState; CommitStateCallback
+checkpoints during fit(), Update{Batch,Epoch}StateCallback keep the
+state's position current, and @hvd.elastic.run re-enters fit at
+initial_epoch=state.epoch after a worker is lost or added.
+
+Run (hosts can come and go between polls):
+  horovodrun -np 2 --min-np 1 --max-np 4 \
+      --host-discovery-script ./discover_hosts.sh \
+      python examples/elastic/tensorflow2_keras_elastic_mnist.py
+"""
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow.keras as hvd
+
+
+def main():
+    hvd.init()
+    tf.keras.utils.set_random_seed(1234)
+
+    rng = np.random.RandomState(42)
+    data_x = rng.rand(4096, 784).astype(np.float32)
+    data_y = rng.randint(0, 10, 4096).astype(np.int64)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(64, activation="relu", input_shape=(784,)),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.01))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"])
+
+    state = hvd.elastic.KerasState(model, batch=0, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        # Re-shard for the CURRENT world size each generation.
+        x = data_x[hvd.rank()::hvd.size()]
+        y = data_y[hvd.rank()::hvd.size()]
+        callbacks = [
+            hvd.elastic.UpdateBatchStateCallback(state),
+            hvd.elastic.UpdateEpochStateCallback(state),
+            # After the update callbacks: commits must snapshot the
+            # already-advanced position.
+            hvd.elastic.CommitStateCallback(state, batches_per_commit=20),
+        ]
+        model.fit(x, y, batch_size=64, epochs=3,
+                  initial_epoch=state.epoch, callbacks=callbacks,
+                  verbose=2 if hvd.rank() == 0 else 0)
+
+    train(state)
+    if hvd.rank() == 0:
+        print(f"done at epoch {state.epoch} with world size {hvd.size()}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

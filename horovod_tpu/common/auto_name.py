@@ -1,0 +1,33 @@
+"""Auto-generated collective names, elastic-safe.
+
+Unnamed collectives get ``<kind>.noname.<n>`` names from a per-process
+counter. The counter participates in elastic recovery: every rank must
+restart it at 0 on re-init, or a survivor's counters would mismatch
+freshly-respawned peers' names for every unnamed collective. Frontends
+create one namer each via ``make_auto_namer()``; the reset hook is
+self-registered.
+"""
+
+import threading
+
+
+def make_auto_namer():
+    """Return an ``auto_name(kind) -> str`` bound to fresh counters that
+    clear on every elastic reset."""
+    lock = threading.Lock()
+    counters = {}
+
+    def auto_name(kind):
+        with lock:
+            n = counters.get(kind, 0)
+            counters[kind] = n + 1
+        return f"{kind}.noname.{n}"
+
+    def _reset():
+        with lock:
+            counters.clear()
+
+    from horovod_tpu.common import elastic as _elastic
+
+    _elastic.register_post_reset_hook(_reset)
+    return auto_name

@@ -53,6 +53,7 @@ def load_library():
         lib.hvdtpu_init.restype = i32
         lib.hvdtpu_shutdown.restype = i32
         lib.hvdtpu_is_initialized.restype = i32
+        lib.hvdtpu_loop_failed.restype = i32
         for fn in ("rank", "size", "local_rank", "local_size", "cross_rank",
                    "cross_size"):
             getattr(lib, f"hvdtpu_{fn}").restype = i32

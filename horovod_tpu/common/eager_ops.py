@@ -136,6 +136,13 @@ HorovodVersionMismatchError = _exceptions.HorovodVersionMismatchError
 
 def _check_handle(h, name):
     if h < 0:
+        if _basics.lib.hvdtpu_loop_failed():
+            # The background loop died on a control-plane failure (a peer
+            # was lost): the elastic-recoverable condition, same as a
+            # collective failing in flight.
+            raise HorovodInternalError(
+                f"cannot enqueue {name}: collective runtime failed "
+                "(peer lost)")
         raise RuntimeError(
             f"Failed to enqueue {name} (is Horovod initialized and running?)")
     return h
@@ -202,6 +209,10 @@ def grouped_allreduce_async(arrays, names, op=ReduceOp.SUM,
                 h.synchronize()
             except HorovodInternalError:
                 pass
+        if _basics.lib.hvdtpu_loop_failed():
+            raise HorovodInternalError(
+                "cannot enqueue grouped allreduce: collective runtime "
+                "failed (peer lost)")
         raise RuntimeError(
             f"Failed to enqueue grouped allreduce (tensor {max(rc, 0)})")
     return handles

@@ -58,12 +58,12 @@ def init():
     client = RendezvousClient(os.environ["HOROVOD_RDZV_ADDR"],
                               os.environ["HOROVOD_RDZV_PORT"])
     notify_port = notification_manager.init()
+    last_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", 0))
     client.register(_worker_id(), os.environ.get("HOROVOD_HOSTNAME",
                                                  socket.gethostname()),
                     int(os.environ.get("HOROVOD_LOCAL_RANK", 0)),
-                    notify_port)
+                    notify_port, last_epoch=last_epoch)
     timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", 60))
-    last_epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", 0))
     asg = client.poll_assignment(_worker_id(), timeout,
                                  min_epoch=last_epoch + 1)
     os.environ["HOROVOD_ELASTIC_EPOCH"] = str(asg["epoch"])
@@ -236,6 +236,35 @@ class ObjectState(State):
         _sync_state(self, "elastic.object_state", attr="_saved_state")
 
 
+def _is_internal_error(exc):
+    """HorovodInternalError, possibly wrapped: frameworks that run our
+    ops inside their own executors re-raise with the original only in
+    the message/cause chain (e.g. tf.py_function surfaces it as
+    tf.errors.UnknownError whose message embeds the repr)."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, HorovodInternalError):
+            return True
+        # Wrapped form: the framework's error message quotes the original
+        # exception's rendered traceback ("...HorovodInternalError: msg").
+        # Match that shape, not the bare class name, so user messages that
+        # merely mention the class don't trigger silent retry loops.
+        txt = str(exc)
+        if "HorovodInternalError:" in txt or "HorovodInternalError(" in txt:
+            return True
+        if exc.__cause__ is not None:
+            exc = exc.__cause__
+        elif exc.__suppress_context__:
+            # `raise X from None`: the user deliberately detached the
+            # original error (e.g. converting a HorovodInternalError
+            # into an unrecoverable abort) — do not classify from it.
+            exc = None
+        else:
+            exc = exc.__context__
+    return False
+
+
 def run_fn(func):
     """Wrap an elastic train function: sync → run → recover loop.
 
@@ -254,11 +283,19 @@ def run_fn(func):
                 if not skip_sync:
                     state.sync()
                 return func(state, *args, **kwargs)
-            except HorovodInternalError:
-                state.restore()
-                skip_sync = False
             except HostsUpdatedInterrupt as e:
                 skip_sync = e.skip_sync
+            except Exception as e:  # noqa: BLE001 — see _is_internal_error
+                if not _is_internal_error(e):
+                    raise
+                if os.environ.get("HOROVOD_ELASTIC_VERBOSE"):
+                    import traceback
+
+                    print(f"[elastic] recovering from: {e!r}",
+                          file=__import__('sys').stderr)
+                    traceback.print_exc()
+                state.restore()
+                skip_sync = False
             reset()
             state.on_reset()
 

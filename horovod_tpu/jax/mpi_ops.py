@@ -12,7 +12,7 @@ TPU-native path (psum over an ICI mesh inside jit), see
 """
 
 import os
-import threading
+
 
 import jax
 import jax.numpy as jnp
@@ -134,18 +134,10 @@ is_homogeneous = _basics.is_homogeneous
 for _cap in _basics.CAPABILITY_NAMES:
     globals()[_cap] = getattr(_basics, _cap)
 
-_name_lock = threading.Lock()
-_name_counters = {}
+from horovod_tpu.common.auto_name import make_auto_namer
 
+_auto_name = make_auto_namer()
 
-def _auto_name(kind):
-    """Deterministic per-op-type names; matches across ranks as long as the
-    call order does (the same contract as the reference's autogenerated
-    ``allreduce.noop.N`` names)."""
-    with _name_lock:
-        n = _name_counters.get(kind, 0)
-        _name_counters[kind] = n + 1
-    return f"{kind}.noname.{n}"
 
 
 def _to_host(tensor):

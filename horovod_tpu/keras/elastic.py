@@ -33,7 +33,12 @@ class KerasState(TensorFlowKerasState):
 
 class CommitStateCallback(tf.keras.callbacks.Callback):
     """``state.commit()`` every ``batches_per_commit`` batches and at
-    every epoch end (reference: hvd.elastic.CommitStateCallback)."""
+    every epoch end (reference: hvd.elastic.CommitStateCallback).
+
+    List this AFTER Update{Batch,Epoch}StateCallback: keras runs
+    callbacks in list order, so the commit must fire after the state's
+    position was advanced — otherwise the epoch-end snapshot records the
+    previous epoch and recovery re-runs one epoch."""
 
     def __init__(self, state, batches_per_commit=1):
         super().__init__()

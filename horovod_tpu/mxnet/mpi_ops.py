@@ -6,7 +6,7 @@ completion writes back in-place — same contract as the reference's
 in-place ``allreduce_`` on NDArray.
 """
 
-import threading
+
 
 import mxnet as mx
 import numpy as np
@@ -42,15 +42,9 @@ stop_timeline = _basics.stop_timeline
 join = eager_ops.join
 barrier = eager_ops.barrier
 
-_name_lock = threading.Lock()
-_name_counters = {}
+from horovod_tpu.common.auto_name import make_auto_namer
 
-
-def _auto_name(kind):
-    with _name_lock:
-        n = _name_counters.get(kind, 0)
-        _name_counters[kind] = n + 1
-    return f"{kind}.noname.{n}"
+_auto_name = make_auto_namer()
 
 
 def _to_np(tensor):

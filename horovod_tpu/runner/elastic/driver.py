@@ -16,6 +16,7 @@ rendezvous; the driver respawns the slot (or proceeds smaller if the host
 is gone, down to min_np).
 """
 
+import itertools
 import os
 import shlex
 import sys
@@ -31,11 +32,15 @@ from horovod_tpu.runner.elastic.worker import notify_worker
 _FAILURES_TO_BLACKLIST = 3
 
 
+_spawn_seq = itertools.count()
+
+
 class _Worker:
     def __init__(self, worker_id, host, local_index):
         self.worker_id = worker_id
         self.host = host
         self.local_index = local_index  # slot on its host at spawn time
+        self.seq = next(_spawn_seq)     # spawn age: survivors < respawns
         self.kill_event = threading.Event()
         self.thread = None
         self.exit_code = None
@@ -126,7 +131,8 @@ class ElasticDriver:
                     print(f"[elastic driver] discovery failed: {e}",
                           file=sys.stderr)
                 continue
-            if changed or self._reconcile_needed.is_set():
+            rereg = self._rendezvous.take_reregistrations()
+            if changed or rereg or self._reconcile_needed.is_set():
                 self._reconcile_needed.clear()
                 self._reconcile(notify=bool(added))
 
@@ -184,6 +190,10 @@ class ElasticDriver:
 
     def _reconcile(self, notify=False):
         """Match the fleet to the current host view and cut a new epoch."""
+        # The upcoming cut covers any pending re-registrations; drain them
+        # so the monitor doesn't cut a second (ghost) epoch for the same
+        # recovery.
+        self._rendezvous.take_reregistrations()
         with self._lock:
             hosts = self._manager.current_hosts
             # Kill workers whose host vanished.
@@ -192,13 +202,16 @@ class ElasticDriver:
                     w.kill_event.set()
                     self._workers.pop(w.worker_id, None)
                     self._rendezvous.forget_worker(w.worker_id)
-            # Spawn to fill empty slots, up to max_np total.
-            per_host = {}
+            # Spawn into FREE slot indexes (a respawn reuses the slot its
+            # predecessor freed), up to max_np total.
+            used = {}
             for w in self._workers.values():
-                per_host[w.host] = per_host.get(w.host, 0) + 1
-            total = sum(per_host.values())
+                used.setdefault(w.host, set()).add(w.local_index)
+            total = sum(len(s) for s in used.values())
             for host, slots in sorted(hosts.items()):
-                for idx in range(per_host.get(host, 0), slots):
+                for idx in range(slots):
+                    if idx in used.get(host, set()):
+                        continue
                     if total >= self._max_np:
                         break
                     self._spawn(host, idx)
@@ -227,19 +240,45 @@ class ElasticDriver:
             registered = set(self._rendezvous.registered_workers())
             with self._lock:
                 ids &= set(self._workers)  # drop workers that died meanwhile
-            if ids and ids <= registered:
+            if not ids:
+                break  # whole cohort exited; fall through to the guard
+            if ids <= registered:
                 break
             time.sleep(0.1)
         else:
-            self._reconcile_needed.set()
+            # Registration timeout: retry the cut only if something
+            # actually failed (same rationale as the min_np guard below).
+            with self._lock:
+                if any(c != 0 for c in self._final_codes):
+                    self._reconcile_needed.set()
             return
         with self._lock:
             workers = [self._workers[i] for i in sorted(ids)
                        if i in self._workers]
-        if not workers:
+        if len(workers) < self._min_np:
+            # Workers vanished while we were waiting for registrations. A
+            # smaller-than-min_np epoch must never be published (it would
+            # split the job into an undersized world that trains alone) —
+            # but only re-reconcile if something actually FAILED; clean
+            # rc==0 exits mean the job is completing, and respawning
+            # would re-run the finished job.
+            with self._lock:
+                any_failed = any(c != 0 for c in self._final_codes)
+            if any_failed:
+                self._reconcile_needed.set()
             return
-        # Rank layout: sort by (host, local index) for stable, dense ranks.
-        workers.sort(key=lambda w: (w.host, w.local_index, w.worker_id))
+        # Rank layout: host-major (hierarchical allreduce requires ranks
+        # contiguous per host), with hosts ordered by their oldest
+        # member's spawn age and workers within a host oldest-first — so
+        # rank 0 is always a SURVIVOR (its state snapshot is what sync()
+        # broadcasts; a fresh respawn as rank 0 would wipe committed
+        # progress with untrained weights).
+        workers.sort(key=lambda w: (w.seq, w.local_index))
+        host_order = {}
+        for w in workers:
+            host_order.setdefault(w.host, len(host_order))
+        workers.sort(key=lambda w: (host_order[w.host], w.seq,
+                                    w.local_index))
         by_host = {}
         for w in workers:
             by_host.setdefault(w.host, []).append(w)

@@ -21,6 +21,11 @@ class _State:
         self.epoch = 0
         # epoch -> {worker_id -> assignment dict}
         self.assignments = {}
+        # Workers that re-registered after already being known: an alive
+        # worker re-entering rendezvous (in-process recovery) — the
+        # driver must cut a fresh epoch for them even though no process
+        # exited.
+        self.reregistered = set()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -51,6 +56,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/register":
             info = self._body()
             with self.state.lock:
+                if info["worker_id"] in self.state.workers \
+                        and info.get("last_epoch", 0) >= self.state.epoch:
+                    # A known worker that already consumed the current
+                    # epoch is waiting for a NEW one: in-process recovery.
+                    # (A re-register with last_epoch < current will be
+                    # satisfied by the already-published epoch.)
+                    self.state.reregistered.add(info["worker_id"])
                 self.state.workers[info["worker_id"]] = info
             return self._send(200)
         return self._send(404)
@@ -95,6 +107,15 @@ class RendezvousServer:
     def forget_worker(self, worker_id):
         with self._state.lock:
             self._state.workers.pop(worker_id, None)
+            self._state.reregistered.discard(worker_id)
+
+    def take_reregistrations(self):
+        """Drain and return worker ids that re-registered while alive
+        (in-process recovery awaiting a fresh epoch)."""
+        with self._state.lock:
+            out = set(self._state.reregistered)
+            self._state.reregistered.clear()
+            return out
 
     def start_epoch(self, assignments):
         """Publish a new epoch's worker_id -> assignment map; workers polling
@@ -139,10 +160,16 @@ class RendezvousClient:
         except urllib.error.HTTPError as e:  # non-2xx still carries status
             return e.code, None
 
-    def register(self, worker_id, host, local_rank, notify_port):
+    def register(self, worker_id, host, local_rank, notify_port,
+                 last_epoch=0):
+        """``last_epoch`` is the newest epoch this worker has consumed;
+        the driver cuts a fresh epoch only for workers that already
+        consumed the current one (true in-process recovery), so late
+        re-registrations don't produce ghost epochs."""
         code, _ = self._request("POST", "/register", {
             "worker_id": worker_id, "host": host,
-            "local_rank": local_rank, "notify_port": notify_port})
+            "local_rank": local_rank, "notify_port": notify_port,
+            "last_epoch": int(last_epoch)})
         if code != 200:
             raise RuntimeError(f"rendezvous register failed: HTTP {code}")
 

@@ -8,7 +8,7 @@ goes through ``tf.py_function`` — on TPU the in-graph path is
 ``xla_mpi_ops.cc`` bridges into XLA programs.
 """
 
-import threading
+
 
 import numpy as np
 import tensorflow as tf
@@ -43,15 +43,10 @@ for _cap in _basics.CAPABILITY_NAMES:
 start_timeline = _basics.start_timeline
 stop_timeline = _basics.stop_timeline
 
-_name_lock = threading.Lock()
-_name_counters = {}
+from horovod_tpu.common.auto_name import make_auto_namer
 
+_auto_name = make_auto_namer()
 
-def _auto_name(kind):
-    with _name_lock:
-        n = _name_counters.get(kind, 0)
-        _name_counters[kind] = n + 1
-    return f"{kind}.noname.{n}"
 
 
 def _to_np(tensor):

@@ -6,7 +6,7 @@ numpy views, so the core's ctypes enqueue writes results straight into
 tensor memory (the in-place ``allreduce_``/``broadcast_`` semantics).
 """
 
-import threading
+
 
 import numpy as np
 import torch
@@ -41,15 +41,10 @@ for _cap in _basics.CAPABILITY_NAMES:
 start_timeline = _basics.start_timeline
 stop_timeline = _basics.stop_timeline
 
-_name_lock = threading.Lock()
-_name_counters = {}
+from horovod_tpu.common.auto_name import make_auto_namer
 
+_auto_name = make_auto_namer()
 
-def _auto_name(kind):
-    with _name_lock:
-        n = _name_counters.get(kind, 0)
-        _name_counters[kind] = n + 1
-    return f"{kind}.noname.{n}"
 
 
 def _np_view(tensor):
