@@ -38,6 +38,13 @@ def register_post_reset_hook(fn):
         _post_reset_hooks.append(fn)
 
 
+def unregister_post_reset_hook(fn):
+    try:
+        _post_reset_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
 def _worker_id():
     wid = os.environ.get("HOROVOD_WORKER_ID")
     if not wid:
@@ -253,15 +260,11 @@ def _is_internal_error(exc):
         txt = str(exc)
         if "HorovodInternalError:" in txt or "HorovodInternalError(" in txt:
             return True
-        if exc.__cause__ is not None:
-            exc = exc.__cause__
-        elif exc.__suppress_context__:
-            # `raise X from None`: the user deliberately detached the
-            # original error (e.g. converting a HorovodInternalError
-            # into an unrecoverable abort) — do not classify from it.
-            exc = None
-        else:
-            exc = exc.__context__
+        # Walk explicit `raise ... from X` chains only. Implicit
+        # __context__ must not count: `except HorovodInternalError:
+        # raise RuntimeError("aborting")` is a deliberate abort, not a
+        # recoverable failure.
+        exc = exc.__cause__
     return False
 
 

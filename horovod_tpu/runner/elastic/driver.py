@@ -195,6 +195,13 @@ class ElasticDriver:
         # recovery.
         self._rendezvous.take_reregistrations()
         with self._lock:
+            fleet_done = (not self._workers and self._final_codes
+                          and all(c == 0 for c in self._final_codes))
+        if fleet_done:
+            # Everyone exited cleanly: the job is complete; never respawn
+            # a fresh fleet into the free slots (it would re-run the job).
+            return
+        with self._lock:
             hosts = self._manager.current_hosts
             # Kill workers whose host vanished.
             for w in list(self._workers.values()):
@@ -282,7 +289,10 @@ class ElasticDriver:
         by_host = {}
         for w in workers:
             by_host.setdefault(w.host, []).append(w)
-        hostnames = sorted(by_host)
+        # cross_rank must agree with the rank layout above (operations.cc
+        # hierarchical probe: cross_rank == rank / local_size), so order
+        # hosts exactly as the layout does.
+        hostnames = sorted(by_host, key=lambda h: host_order[h])
         root_host = workers[0].host
         controller_addr = ("127.0.0.1" if util.is_local_host(root_host)
                            else root_host)
@@ -301,6 +311,10 @@ class ElasticDriver:
                 "controller_port": controller_port,
             }
         epoch = self._rendezvous.start_epoch(assignments)
+        # Survivors that re-registered while we waited for respawn
+        # registrations are satisfied by the epoch just published — drain
+        # their flags so the monitor doesn't cut a ghost epoch for them.
+        self._rendezvous.take_reregistrations(satisfied_by=epoch)
         with self._lock:
             # Success is judged on the FINAL epoch only: a worker that died
             # and was recovered from must not fail the whole job.

@@ -109,12 +109,20 @@ class RendezvousServer:
             self._state.workers.pop(worker_id, None)
             self._state.reregistered.discard(worker_id)
 
-    def take_reregistrations(self):
+    def take_reregistrations(self, satisfied_by=None):
         """Drain and return worker ids that re-registered while alive
-        (in-process recovery awaiting a fresh epoch)."""
+        (in-process recovery awaiting a fresh epoch). With
+        ``satisfied_by=N``, drain only workers whose awaited epoch is
+        covered by the just-published epoch N (keep ones that failed
+        again and already need something newer)."""
         with self._state.lock:
-            out = set(self._state.reregistered)
-            self._state.reregistered.clear()
+            if satisfied_by is None:
+                out = set(self._state.reregistered)
+            else:
+                out = {w for w in self._state.reregistered
+                       if self._state.workers.get(w, {})
+                       .get("last_epoch", 0) < satisfied_by}
+            self._state.reregistered -= out
             return out
 
     def start_epoch(self, assignments):

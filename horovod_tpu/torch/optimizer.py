@@ -72,6 +72,22 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             process_set_id=self._process_set_id)
         self._handles[p] = (handle, ctx)
 
+    def _drain_inflight(self):
+        """Complete-or-discard every in-flight handle and reset ALL
+        delay countdowns — returns the optimizer to a clean state
+        (elastic recovery path). Every delay resets, not just handled
+        params': a param whose enqueue itself failed, or whose countdown
+        was mid-flight on a survivor, would otherwise stay desynced from
+        respawned peers forever."""
+        for _, (handle, _ctx) in list(self._handles.items()):
+            try:
+                handle.synchronize()
+            except Exception:  # noqa: BLE001 — poisoned by the failure
+                pass
+        self._handles.clear()
+        for p in self._allreduce_delay:
+            self._allreduce_delay[p] = self.backward_passes_per_step
+
     def synchronize(self):
         """Wait for all outstanding allreduces; write averaged grads back."""
         # Params whose countdown has not fired (e.g. user stepped early)
@@ -80,11 +96,19 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if 0 < delay < self.backward_passes_per_step \
                     and p not in self._handles and p.grad is not None:
                 self._allreduce_grad_async(p)
-        for p, (handle, ctx) in list(self._handles.items()):
-            out = handle.synchronize()
-            p.grad.copy_(self._compression.decompress(out, ctx)
-                         .view_as(p.grad))
-            self._allreduce_delay[p] = self.backward_passes_per_step
+        try:
+            for p, (handle, ctx) in list(self._handles.items()):
+                out = handle.synchronize()
+                p.grad.copy_(self._compression.decompress(out, ctx)
+                             .view_as(p.grad))
+                self._allreduce_delay[p] = self.backward_passes_per_step
+        except Exception:
+            # One failed collective poisons the rest of the batch: drain
+            # them all so the optimizer is reusable after the elastic
+            # loop restores and re-rendezvouses, then let the failure
+            # surface to the recovery scope.
+            self._drain_inflight()
+            raise
         self._handles.clear()
 
     @contextlib.contextmanager
@@ -104,9 +128,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def zero_grad(self, *args, **kwargs):
         if self._handles:
-            raise AssertionError(
-                "zero_grad called with allreduces in flight; call "
-                "optimizer.step() or optimizer.synchronize() first")
+            from horovod_tpu.common.basics import HorovodBasics
+
+            if HorovodBasics().lib.hvdtpu_loop_failed():
+                # Handles left over from a step the collective runtime's
+                # failure aborted (a hook enqueued, then a peer died
+                # before synchronize ran): stale, not a usage error.
+                self._drain_inflight()
+            else:
+                raise AssertionError(
+                    "zero_grad called with allreduces in flight; call "
+                    "optimizer.step() or optimizer.synchronize() first")
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
@@ -122,5 +154,27 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                dict(_DistributedOptimizer.__dict__))
     if named_parameters is not None:
         named_parameters = list(named_parameters)
-    return cls(optimizer.param_groups, named_parameters, compression,
+    dist = cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, process_set_id)
+
+    # Elastic recovery: handles enqueued by backward hooks before a peer
+    # failure are stale after re-init; drain them so the next
+    # zero_grad/step starts clean. Weakref so the hook registry doesn't
+    # keep dead optimizers alive.
+    import weakref
+
+    from horovod_tpu.common import elastic as _elastic
+
+    def _drain_on_reset():
+        opt = ref()
+        if opt is not None:
+            opt._drain_inflight()
+
+    # Unregister when the optimizer is collected so long-lived elastic
+    # processes that construct optimizers repeatedly don't accumulate
+    # dead hooks.
+    ref = weakref.ref(
+        dist, lambda _r: _elastic.unregister_post_reset_hook(
+            _drain_on_reset))
+    _elastic.register_post_reset_hook(_drain_on_reset)
+    return dist
