@@ -168,3 +168,47 @@ def test_object_state_commit_restore():
     state.restore()
     assert state.step == 5
     np.testing.assert_allclose(state.weights, 2)
+
+
+def test_cut_epoch_rank_layout_survivor_first():
+    """Rank 0 is the longest-lived worker; layout is host-major and
+    cross_rank agrees with rank // local_size (the hierarchical
+    allreduce probe's invariant), regardless of host name order."""
+    from horovod_tpu.runner.elastic.driver import ElasticDriver, _Worker
+
+    driver = ElasticDriver.__new__(ElasticDriver)
+    driver._lock = threading.RLock()
+    driver._min_np = 1
+    driver._start_timeout = 5
+    driver._final_codes = []
+    driver._reconcile_needed = threading.Event()
+    driver._verbose = False
+    driver._rendezvous = RendezvousServer()
+    try:
+        # 'zeta' host holds the two oldest workers (incl. the original
+        # rank 0); 'alpha' got a fresh respawn (highest seq).
+        workers = [_Worker("zeta:a", "zeta", 0),
+                   _Worker("zeta:b", "zeta", 1),
+                   _Worker("alpha:c", "alpha", 0),
+                   _Worker("alpha:d", "alpha", 1)]
+        # respawn on alpha slot 0: new uuid, max seq
+        respawn = _Worker("alpha:e", "alpha", 0)
+        fleet = [workers[0], workers[1], respawn, workers[3]]
+        driver._workers = {w.worker_id: w for w in fleet}
+        client = RendezvousClient("127.0.0.1", driver._rendezvous.port)
+        for w in fleet:
+            client.register(w.worker_id, w.host, w.local_index, None)
+        driver._cut_epoch(fleet)
+
+        asg = {w.worker_id: client.poll_assignment(w.worker_id, timeout=5)
+               for w in fleet}
+        # oldest worker (zeta:a) is rank 0 even though 'alpha' < 'zeta'
+        assert asg["zeta:a"]["rank"] == 0
+        # fresh respawn is ranked last within its host
+        assert asg["alpha:e"]["rank"] > asg["alpha:d"]["rank"]
+        for a in asg.values():
+            assert a["size"] == 4 and a["local_size"] == 2
+            assert a["cross_rank"] == a["rank"] // a["local_size"]
+            assert a["cross_size"] == 2
+    finally:
+        driver._rendezvous.stop()
