@@ -1,0 +1,160 @@
+#include "bayes_opt.h"
+
+#include <cmath>
+#include <limits>
+
+namespace hvdtpu {
+
+BayesOpt::BayesOpt(std::vector<std::array<double, 2>> candidates,
+                   double length_scale, double noise)
+    : cand_(std::move(candidates)),
+      ls2_(2.0 * length_scale * length_scale),
+      noise_(noise) {}
+
+double BayesOpt::Kernel(const std::array<double, 2>& a,
+                        const std::array<double, 2>& b) const {
+  double d0 = a[0] - b[0], d1 = a[1] - b[1];
+  return std::exp(-(d0 * d0 + d1 * d1) / ls2_);
+}
+
+void BayesOpt::AddSample(size_t idx, double y) {
+  xs_.push_back(idx);
+  ys_.push_back(y);
+}
+
+namespace {
+
+// Standard normal pdf/cdf (cdf via erf).
+double Phi(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+double phi(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+
+// Cholesky factorization of a (small) SPD matrix in place; returns false
+// if the matrix is not positive definite.
+bool Cholesky(std::vector<double>& m, size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    for (size_t j = 0; j <= i; j++) {
+      double s = m[i * n + j];
+      for (size_t k = 0; k < j; k++) s -= m[i * n + k] * m[j * n + k];
+      if (i == j) {
+        if (s <= 0) return false;
+        m[i * n + i] = std::sqrt(s);
+      } else {
+        m[i * n + j] = s / m[j * n + j];
+      }
+    }
+  }
+  return true;
+}
+
+// Solve L x = b (lower triangular), in place into b.
+void SolveLower(const std::vector<double>& L, size_t n,
+                std::vector<double>& b) {
+  for (size_t i = 0; i < n; i++) {
+    double s = b[i];
+    for (size_t k = 0; k < i; k++) s -= L[i * n + k] * b[k];
+    b[i] = s / L[i * n + i];
+  }
+}
+
+// Solve L^T x = b, in place into b.
+void SolveUpperT(const std::vector<double>& L, size_t n,
+                 std::vector<double>& b) {
+  for (size_t i = n; i-- > 0;) {
+    double s = b[i];
+    for (size_t k = i + 1; k < n; k++) s -= L[k * n + i] * b[k];
+    b[i] = s / L[i * n + i];
+  }
+}
+
+}  // namespace
+
+size_t BayesOpt::Suggest() const {
+  size_t n = xs_.size();
+  if (n == 0) return 0;
+
+  // Normalize observations to zero mean / unit variance so the unit-
+  // variance RBF prior is well matched regardless of the score scale.
+  double mean = 0;
+  for (double y : ys_) mean += y;
+  mean /= (double)n;
+  double var = 0;
+  for (double y : ys_) var += (y - mean) * (y - mean);
+  double sd = n > 1 ? std::sqrt(var / (double)n) : 1.0;
+  if (sd <= 0) sd = 1.0;
+  std::vector<double> yn(n);
+  double best_y = -std::numeric_limits<double>::infinity();
+  for (size_t i = 0; i < n; i++) {
+    yn[i] = (ys_[i] - mean) / sd;
+    if (yn[i] > best_y) best_y = yn[i];
+  }
+
+  // GP fit: K = k(X,X) + noise*I, alpha = K^-1 y (via Cholesky).
+  std::vector<double> K(n * n);
+  for (size_t i = 0; i < n; i++) {
+    for (size_t j = 0; j < n; j++) {
+      K[i * n + j] = Kernel(cand_[xs_[i]], cand_[xs_[j]]) +
+                     (i == j ? noise_ : 0.0);
+    }
+  }
+  std::vector<double> alpha = yn;
+  if (!Cholesky(K, n)) {
+    // Numerically degenerate (e.g. identical repeated samples): fall
+    // back to the best observed point.
+    return Best();
+  }
+  SolveLower(K, n, alpha);
+  SolveUpperT(K, n, alpha);
+
+  // Expected improvement over the grid.
+  constexpr double kXi = 0.01;  // exploration margin
+  double best_ei = -1;
+  size_t best_idx = Best();
+  std::vector<double> kstar(n), v(n);
+  for (size_t c = 0; c < cand_.size(); c++) {
+    for (size_t i = 0; i < n; i++) kstar[i] = Kernel(cand_[c], cand_[xs_[i]]);
+    double mu = 0;
+    for (size_t i = 0; i < n; i++) mu += kstar[i] * alpha[i];
+    v = kstar;
+    SolveLower(K, n, v);
+    double var_c = Kernel(cand_[c], cand_[c]);
+    for (size_t i = 0; i < n; i++) var_c -= v[i] * v[i];
+    double sigma = var_c > 1e-12 ? std::sqrt(var_c) : 0.0;
+    double ei;
+    if (sigma == 0.0) {
+      ei = mu - best_y - kXi > 0 ? mu - best_y - kXi : 0.0;
+    } else {
+      double z = (mu - best_y - kXi) / sigma;
+      ei = (mu - best_y - kXi) * Phi(z) + sigma * phi(z);
+    }
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_idx = c;
+    }
+  }
+  return best_idx;
+}
+
+size_t BayesOpt::Best() const {
+  // Mean observed score per candidate (repeat samples average).
+  double best = -std::numeric_limits<double>::infinity();
+  size_t best_idx = 0;
+  for (size_t c = 0; c < cand_.size(); c++) {
+    double sum = 0;
+    int cnt = 0;
+    for (size_t i = 0; i < xs_.size(); i++) {
+      if (xs_[i] == c) {
+        sum += ys_[i];
+        cnt++;
+      }
+    }
+    if (cnt && sum / cnt > best) {
+      best = sum / cnt;
+      best_idx = c;
+    }
+  }
+  return best_idx;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,51 @@
+// Gaussian-process Bayesian optimization over a small discrete
+// candidate grid. Reference analog: horovod/common/optim/
+// bayesian_optimization.cc + gaussian_process.cc (the autotuner's
+// sample proposer) — re-founded compactly for the TPU build's needs:
+// the design space is a few dozen (fusion threshold, cycle time)
+// pairs, so the Expected-Improvement acquisition is argmaxed over the
+// grid directly instead of gradient-optimized, and the GP posterior is
+// an exact small-N Cholesky solve. Deterministic: no random restarts.
+
+#ifndef HVDTPU_BAYES_OPT_H
+#define HVDTPU_BAYES_OPT_H
+
+#include <array>
+#include <cstddef>
+#include <vector>
+
+namespace hvdtpu {
+
+class BayesOpt {
+ public:
+  // candidates: points in the (already normalized, ~[0,1]^d) knob space.
+  explicit BayesOpt(std::vector<std::array<double, 2>> candidates,
+                    double length_scale = 0.3, double noise = 1e-3);
+
+  // Record an observation at candidates[idx] (y in any scale; it is
+  // re-normalized internally before each fit).
+  void AddSample(size_t idx, double y);
+
+  // Next candidate to evaluate: argmax Expected Improvement under the
+  // GP posterior. Unseen candidates win ties. Valid after >=1 sample.
+  size_t Suggest() const;
+
+  // Best candidate so far: the argmax of observed mean score.
+  size_t Best() const;
+
+  size_t num_samples() const { return xs_.size(); }
+
+ private:
+  double Kernel(const std::array<double, 2>& a,
+                const std::array<double, 2>& b) const;
+
+  std::vector<std::array<double, 2>> cand_;
+  double ls2_;    // 2 * length_scale^2
+  double noise_;
+  std::vector<size_t> xs_;   // sampled candidate indices
+  std::vector<double> ys_;   // raw scores
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_BAYES_OPT_H

@@ -712,9 +712,10 @@ int hvdtpu_init() {
       EnvInt64("HOROVOD_TIMELINE_MARK_CYCLES", 0) != 0;
   if (EnvInt64("HOROVOD_AUTOTUNE", 0) != 0) {
     st->param_manager = std::make_unique<ParameterManager>();
-    st->param_manager->Initialize(st->fusion_threshold.load(),
-                                  st->cycle_time_ms.load(),
-                                  EnvStr("HOROVOD_AUTOTUNE_LOG", ""));
+    st->param_manager->Initialize(
+        st->fusion_threshold.load(), st->cycle_time_ms.load(),
+        EnvStr("HOROVOD_AUTOTUNE_LOG", ""),
+        (int)EnvInt64("HOROVOD_AUTOTUNE_STEPS", 20));
   } else {
     st->param_manager.reset();
   }

@@ -1,6 +1,7 @@
 #include "parameter_manager.h"
 
 #include <algorithm>
+#include <cmath>
 
 #include "logging.h"
 
@@ -10,15 +11,33 @@ namespace {
 constexpr int64_t kMinWindowBytes = 1 << 20;   // score only meaningful windows
 constexpr int kMinWindowCycles = 20;
 constexpr double kMaxWindowSecs = 5.0;
-constexpr double kImprovementEps = 1.05;       // 5% better = accept move
 }  // namespace
 
 void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
-                                  const std::string& log_path) {
+                                  const std::string& log_path,
+                                  int max_samples) {
   for (int64_t v = 1 << 20; v <= (64 << 20); v *= 2) {
     fusion_values_.push_back(v);
   }
   cycle_values_ = {0.5, 1.0, 2.5, 5.0, 10.0};
+  max_samples_ = std::max(max_samples, 2);
+
+  // Candidate grid in a normalized space: log2(fusion MB) and log2(cycle)
+  // both scaled to [0,1] so one RBF length scale covers both knobs.
+  std::vector<std::array<double, 2>> cands;
+  double f_lo = std::log2((double)fusion_values_.front());
+  double f_hi = std::log2((double)fusion_values_.back());
+  double c_lo = std::log2(cycle_values_.front());
+  double c_hi = std::log2(cycle_values_.back());
+  for (size_t fi = 0; fi < fusion_values_.size(); fi++) {
+    for (size_t ci = 0; ci < cycle_values_.size(); ci++) {
+      cands.push_back({
+          (std::log2((double)fusion_values_[fi]) - f_lo) / (f_hi - f_lo),
+          (std::log2(cycle_values_[ci]) - c_lo) / (c_hi - c_lo)});
+    }
+  }
+  opt_ = std::make_unique<BayesOpt>(std::move(cands));
+
   // Start from the user-provided operating point (snap onto the grids).
   fusion_idx_ = 0;
   for (size_t i = 0; i < fusion_values_.size(); i++) {
@@ -28,6 +47,8 @@ void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
   for (size_t i = 0; i < cycle_values_.size(); i++) {
     if (cycle_values_[i] <= cycle_ms) cycle_idx_ = i;
   }
+  current_candidate_ = fusion_idx_ * cycle_values_.size() + cycle_idx_;
+
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_) {
@@ -49,74 +70,24 @@ void ParameterManager::Log(double score) {
   fflush(log_);
 }
 
-bool ParameterManager::Move(int direction) {
-  if (axis_ == 0) {
-    size_t prev = fusion_idx_;
-    fusion_idx_ = (size_t)std::clamp<int64_t>(
-        (int64_t)fusion_idx_ + direction, 0,
-        (int64_t)fusion_values_.size() - 1);
-    return fusion_idx_ != prev;
-  }
-  size_t prev = cycle_idx_;
-  cycle_idx_ = (size_t)std::clamp<int64_t>(
-      (int64_t)cycle_idx_ + direction, 0, (int64_t)cycle_values_.size() - 1);
-  return cycle_idx_ != prev;
-}
-
-void ParameterManager::AdvanceAxis() {
-  axis_ = 1 - axis_;
-  have_baseline_ = false;
-  tries_ = 0;
-  if (axis_ == 0 && --sweeps_left_ <= 0) {
-    done_ = true;
-    LOG_INFO("autotune converged: fusion=%lld bytes, cycle=%.2f ms",
-             (long long)fusion_threshold_bytes(), cycle_time_ms());
-  }
-}
-
-void ParameterManager::TryProbe() {
-  // Place the next probe; a clamped (no-op) Move means the grid edge —
-  // skip straight to the other direction or the next axis, so an "undo"
-  // is only ever applied to a probe that actually moved.
-  while (!done_) {
-    if (Move(direction_)) return;  // probe placed; next window scores it
-    if (++tries_ < 2) {
-      direction_ = -direction_;
-      continue;
-    }
-    AdvanceAxis();
-    return;  // new axis re-baselines on the next window
-  }
+void ParameterManager::MoveTo(size_t candidate) {
+  current_candidate_ = candidate;
+  fusion_idx_ = candidate / cycle_values_.size();
+  cycle_idx_ = candidate % cycle_values_.size();
 }
 
 void ParameterManager::Score(double bytes_per_sec) {
   Log(bytes_per_sec);
   if (done_) return;
-  if (!have_baseline_) {
-    // First scored window at the current point: probe up the active axis.
-    baseline_score_ = bytes_per_sec;
-    have_baseline_ = true;
-    direction_ = +1;
-    tries_ = 0;
-    TryProbe();
+  opt_->AddSample(current_candidate_, bytes_per_sec);
+  if ((int)opt_->num_samples() >= max_samples_) {
+    MoveTo(opt_->Best());
+    done_ = true;
+    LOG_INFO("autotune converged: fusion=%lld bytes, cycle=%.2f ms",
+             (long long)fusion_threshold_bytes(), cycle_time_ms());
     return;
   }
-  if (bytes_per_sec > baseline_score_ * kImprovementEps) {
-    // Improvement: adopt the probed point, keep walking this direction.
-    baseline_score_ = bytes_per_sec;
-    tries_ = 0;
-    TryProbe();
-    return;
-  }
-  // Not better: undo the probe (guaranteed to have moved — see TryProbe),
-  // then try the other direction once, else advance to the next axis.
-  Move(-direction_);
-  if (++tries_ < 2) {
-    direction_ = -direction_;
-    TryProbe();
-    return;
-  }
-  AdvanceAxis();
+  MoveTo(opt_->Suggest());
 }
 
 bool ParameterManager::Update(int64_t bytes) {

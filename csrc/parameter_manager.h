@@ -1,10 +1,11 @@
 // Online autotuning of fusion threshold + cycle time.
 // Reference analog: horovod/common/parameter_manager.h (ParameterManager,
-// driven by HOROVOD_AUTOTUNE) — there Bayesian optimization over warmup
-// samples (common/optim/bayesian_optimization.cc); here deterministic
-// coordinate descent over the same discrete grids, scoring windows by
-// allreduced bytes/sec. Runs on the coordinator only; chosen values ride to
-// workers on every ResponseList.
+// driven by HOROVOD_AUTOTUNE) with the same optimizer family: Bayesian
+// optimization (GP + Expected Improvement — csrc/bayes_opt.h, the analog
+// of common/optim/bayesian_optimization.cc) over the discrete
+// (fusion threshold, cycle time) grid, scoring sample windows by
+// allreduced bytes/sec. Runs on the coordinator only; chosen values ride
+// to workers on every ResponseList.
 
 #ifndef HVDTPU_PARAMETER_MANAGER_H
 #define HVDTPU_PARAMETER_MANAGER_H
@@ -12,16 +13,20 @@
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <memory>
 #include <string>
 #include <vector>
+
+#include "bayes_opt.h"
 
 namespace hvdtpu {
 
 class ParameterManager {
  public:
-  // log_path empty = no CSV log (HOROVOD_AUTOTUNE_LOG).
+  // log_path empty = no CSV log (HOROVOD_AUTOTUNE_LOG). max_samples is
+  // HOROVOD_AUTOTUNE_STEPS: scored windows before fixing the knobs.
   void Initialize(int64_t fusion_bytes, double cycle_ms,
-                  const std::string& log_path);
+                  const std::string& log_path, int max_samples = 20);
   ~ParameterManager();
 
   bool active() const { return active_; }
@@ -34,9 +39,7 @@ class ParameterManager {
 
  private:
   void Score(double bytes_per_sec);
-  bool Move(int direction);  // step the active axis by +-1; false if clamped
-  void TryProbe();           // place next probe, skipping clamped edges
-  void AdvanceAxis();
+  void MoveTo(size_t candidate);
   void Log(double score);
 
   bool active_ = false;
@@ -46,13 +49,11 @@ class ParameterManager {
   std::vector<double> cycle_values_;
   size_t fusion_idx_ = 0, cycle_idx_ = 0;
 
-  // Coordinate descent: tune fusion axis, then cycle axis, two sweeps.
-  int axis_ = 0;             // 0 = fusion, 1 = cycle
-  int sweeps_left_ = 2;      // full (fusion+cycle) passes remaining
-  int direction_ = +1;       // current probe direction on the axis
-  bool have_baseline_ = false;
-  double baseline_score_ = 0;  // score at current best point
-  int tries_ = 0;            // direction flips tried at this point
+  // Bayesian optimization over the flattened grid: candidate index
+  // c = fusion_i * |cycle| + cycle_i.
+  std::unique_ptr<BayesOpt> opt_;
+  size_t current_candidate_ = 0;
+  int max_samples_ = 20;
 
   // Window accumulation.
   int64_t window_bytes_ = 0;

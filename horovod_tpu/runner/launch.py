@@ -154,6 +154,10 @@ def env_from_args(args):
         env["HOROVOD_AUTOTUNE"] = "1"
     if args.autotune_log_file:
         env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if os.environ.get("HOROVOD_AUTOTUNE_STEPS"):
+        # Not a CLI flag, but it must still reach remote (ssh) ranks —
+        # only the coordinator reads it.
+        env["HOROVOD_AUTOTUNE_STEPS"] = os.environ["HOROVOD_AUTOTUNE_STEPS"]
     if args.hierarchical_allreduce:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.nics:

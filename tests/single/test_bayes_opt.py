@@ -1,0 +1,69 @@
+"""Native unit test for the autotuner's Bayesian optimizer.
+
+Compiles csrc/bayes_opt.cc with a small driver and checks that GP+EI
+finds the optimum of a synthetic response surface in far fewer samples
+than exhausting the grid (the property that justifies it over the
+previous coordinate-descent: sample efficiency on a noisy objective).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+DRIVER = r"""
+#include <cstdio>
+#include <cmath>
+#include "bayes_opt.h"
+
+using hvdtpu::BayesOpt;
+
+int main() {
+  // 8x5 grid shaped like the autotuner's 7x5 (fusion x cycle),
+  // normalized coords.
+  std::vector<std::array<double, 2>> cands;
+  for (int f = 0; f < 8; f++)
+    for (int c = 0; c < 5; c++)
+      cands.push_back({f / 7.0, c / 4.0});
+  // Smooth unimodal surface with optimum at (5/7, 1/4): mimics
+  // throughput peaking at a mid-grid fusion threshold / cycle time.
+  auto score = [](double x, double y) {
+    double dx = x - 5.0 / 7.0, dy = y - 0.25;
+    return 100.0 * std::exp(-6.0 * (dx * dx + dy * dy));
+  };
+
+  BayesOpt opt(cands);
+  size_t cur = 0;  // start at the grid corner (worst case)
+  for (int step = 0; step < 16; step++) {
+    opt.AddSample(cur, score(cands[cur][0], cands[cur][1]));
+    cur = opt.Suggest();
+  }
+  size_t best = opt.Best();
+  double got = score(cands[best][0], cands[best][1]);
+  // 16 samples over a 40-point grid must land within 2% of the peak.
+  if (got < 98.0) {
+    printf("FAIL best=%zu score=%.2f\n", best, got);
+    return 1;
+  }
+  printf("OK best=%zu score=%.2f samples=16/40\n", best, got);
+  return 0;
+}
+"""
+
+
+def test_bayes_opt_converges_sample_efficiently(tmp_path):
+    driver = tmp_path / "driver.cc"
+    driver.write_text(DRIVER)
+    binary = tmp_path / "bayes_test"
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17", f"-I{REPO}/csrc", str(driver),
+         f"{REPO}/csrc/bayes_opt.cc", "-o", str(binary)],
+        capture_output=True, text=True)
+    if build.returncode != 0:
+        pytest.skip(f"native toolchain unavailable: {build.stderr[:200]}")
+    run = subprocess.run([str(binary)], capture_output=True, text=True,
+                         timeout=60)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert run.stdout.startswith("OK"), run.stdout
