@@ -1,0 +1,16 @@
+#!/bin/sh
+# Sample host-discovery script for elastic runs (reference analog: the
+# --host-discovery-script contract in horovod/runner/elastic/discovery.py).
+# Print one "host:slots" line per currently-available host; the elastic
+# driver polls this script and grows/shrinks the job to match. Swap the
+# body for your autoscaler / resource-manager query. This sample reads a
+# plain hosts file so tests (and humans) can add/remove hosts by editing
+# it live:
+#
+#   echo "localhost:2" >  /tmp/hosts.txt
+#   horovodrun -np 2 --min-np 1 --max-np 4 \
+#       --host-discovery-script examples/elastic/discover_hosts.sh ...
+#   echo "localhost:4" >  /tmp/hosts.txt   # scale up mid-run
+#
+HOSTS_FILE="${HOROVOD_HOSTS_FILE:-/tmp/hosts.txt}"
+[ -f "$HOSTS_FILE" ] && cat "$HOSTS_FILE" || echo "localhost:2"

@@ -57,12 +57,16 @@ class HostManager:
         self._current = {}
 
     def update_available_hosts(self):
-        """Re-run discovery; returns (changed, added, removed)."""
+        """Re-run discovery; returns (changed, added, removed). ``added``
+        lists hosts whose capacity GREW — a brand-new host or extra slots
+        on a known one both count (workers must be notified either way,
+        or they keep training at the old size while new slots idle)."""
         found = self._discovery.find_available_hosts_and_slots()
         with self._lock:
             found = {h: s for h, s in found.items()
                      if h not in self._blacklist}
-            added = sorted(set(found) - set(self._current))
+            added = sorted(h for h, s in found.items()
+                           if s > self._current.get(h, 0))
             removed = sorted(set(self._current) - set(found))
             changed = bool(added or removed) or found != self._current
             self._current = found

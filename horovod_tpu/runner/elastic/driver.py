@@ -42,6 +42,7 @@ class _Worker:
         self.local_index = local_index  # slot on its host at spawn time
         self.seq = next(_spawn_seq)     # spawn age: survivors < respawns
         self.kill_event = threading.Event()
+        self.driver_killed = False      # deliberate kill, not a failure
         self.thread = None
         self.exit_code = None
 
@@ -110,6 +111,7 @@ class ElasticDriver:
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:
+            w.driver_killed = True
             w.kill_event.set()
         self._rendezvous.stop()
 
@@ -176,7 +178,12 @@ class ElasticDriver:
         with self._lock:
             self._workers.pop(worker.worker_id, None)
             self._rendezvous.forget_worker(worker.worker_id)
-            self._final_codes.append(rc)
+            if not worker.driver_killed:
+                self._final_codes.append(rc)
+        if worker.driver_killed:
+            # Deliberate kill (host removed / slot shrunk): not a failure
+            # — must not count toward blacklisting or the job's exit code.
+            return
         if rc == 0:
             # Clean finish: the job is completing; let peers finish too.
             return
@@ -203,9 +210,11 @@ class ElasticDriver:
             return
         with self._lock:
             hosts = self._manager.current_hosts
-            # Kill workers whose host vanished.
+            # Kill workers whose host vanished or whose slot no longer
+            # exists (slot-count decrease keeps the lowest indexes).
             for w in list(self._workers.values()):
-                if w.host not in hosts:
+                if w.host not in hosts or w.local_index >= hosts[w.host]:
+                    w.driver_killed = True
                     w.kill_event.set()
                     self._workers.pop(w.worker_id, None)
                     self._rendezvous.forget_worker(w.worker_id)
@@ -215,6 +224,7 @@ class ElasticDriver:
             for w in self._workers.values():
                 used.setdefault(w.host, set()).add(w.local_index)
             total = sum(len(s) for s in used.values())
+            spawned = 0
             for host, slots in sorted(hosts.items()):
                 for idx in range(slots):
                     if idx in used.get(host, set()):
@@ -223,6 +233,7 @@ class ElasticDriver:
                         break
                     self._spawn(host, idx)
                     total += 1
+                    spawned += 1
             alive = list(self._workers.values())
         if total < self._min_np:
             if self._verbose:
@@ -230,7 +241,11 @@ class ElasticDriver:
                       f"{self._min_np}; waiting for discovery",
                       file=sys.stderr)
             return
-        if notify:
+        if notify and spawned:
+            # Notify only when capacity growth actually ADDED workers: at
+            # max_np the discovery delta is unusable, and a notification
+            # would tear the whole fleet down for an identically-sized
+            # epoch (minutes of TPU re-init for nothing).
             registered = self._rendezvous.registered_workers()
             for w in alive:
                 info = registered.get(w.worker_id)

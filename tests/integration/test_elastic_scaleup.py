@@ -1,0 +1,85 @@
+"""End-to-end elastic scale-UP: discovery adds slots mid-training, the
+driver notifies workers, they take HostsUpdatedInterrupt (no rollback)
+and resume at the larger world size.
+
+Reference analog: test/integration/test_elastic_torch.py's
+host-addition cases (SURVEY.md §3.4: HostsUpdatedInterrupt path).
+"""
+
+import json
+import os
+import sys
+import time
+
+from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WORKER_SRC = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu.jax as hvd
+from horovod_tpu.jax import elastic
+
+tmp = {tmp!r}
+hvd.init()
+state = elastic.JaxState(step=0, sizes=[])
+
+@elastic.run
+def train(state):
+    while state.step < 24:
+        out = hvd.allreduce(np.ones(2, np.float32),
+                            name=f"s{{state.step}}", op=hvd.Sum)
+        state.sizes = list(state.sizes) + [int(np.asarray(out)[0])]
+        state.step += 1
+        state.commit()
+        time.sleep(0.4)  # slow enough for discovery to change mid-run
+
+train(state)
+wid = os.environ["HOROVOD_WORKER_ID"].replace(":", "_")
+with open(os.path.join(tmp, "done." + wid), "w") as f:
+    json.dump({{"sizes": [int(s) for s in state.sizes],
+               "final": hvd.size()}}, f)
+hvd.shutdown()
+"""
+
+
+def test_elastic_scale_up(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER_SRC.format(repo=REPO, tmp=str(tmp_path)))
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:2\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(0o755)
+
+    env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    driver = ElasticDriver(HostDiscoveryScript(str(script)),
+                           [sys.executable, str(worker.resolve())],
+                           min_np=2, max_np=3, poll_interval=0.5,
+                           start_timeout=60, env=env)
+    driver.start()
+    try:
+        # Let the 2-rank world make progress, then add a slot.
+        time.sleep(3)
+        hosts_file.write_text("localhost:3\n")
+        rc = driver.wait_for_completion()
+    finally:
+        driver.stop()
+    assert rc == 0
+
+    done = sorted(tmp_path.glob("done.*"))
+    assert len(done) == 3, [p.name for p in done]
+    finals = [json.loads(p.read_text()) for p in done]
+    assert all(r["final"] == 3 for r in finals), finals
+    # The longest-lived workers saw both world sizes: allreduce of ones
+    # sums to the size, so their history goes 2,...,2,3,...,3.
+    grew = [r for r in finals if 2 in r["sizes"] and 3 in r["sizes"]]
+    assert grew, finals
+    for r in finals:
+        assert sorted(r["sizes"]) == r["sizes"], r  # never shrank
