@@ -96,7 +96,14 @@ class ElasticDriver:
         """Block until the fleet has exited; returns 0 on success."""
         while True:
             with self._lock:
-                if not self._workers and not self._reconcile_needed.is_set():
+                # The job is over only when workers finished (or failed)
+                # on their own: an empty fleet with NO final codes means
+                # every worker was driver-killed (e.g. a transient empty
+                # discovery result) — keep waiting for discovery to
+                # restore hosts and the monitor to respawn.
+                if not self._workers \
+                        and not self._reconcile_needed.is_set() \
+                        and self._final_codes:
                     break
             if self._shutdown.is_set():
                 break
@@ -210,14 +217,26 @@ class ElasticDriver:
             return
         with self._lock:
             hosts = self._manager.current_hosts
-            # Kill workers whose host vanished or whose slot no longer
-            # exists (slot-count decrease keeps the lowest indexes).
+            # Kill workers whose host vanished; on slot-count decrease
+            # kill only the EXCESS count, youngest first — the oldest
+            # workers hold the committed state that rank 0's sync()
+            # broadcasts, so they must survive a shrink.
+            def _kill(w):
+                w.driver_killed = True
+                w.kill_event.set()
+                self._workers.pop(w.worker_id, None)
+                self._rendezvous.forget_worker(w.worker_id)
+
+            per_host = {}
             for w in list(self._workers.values()):
-                if w.host not in hosts or w.local_index >= hosts[w.host]:
-                    w.driver_killed = True
-                    w.kill_event.set()
-                    self._workers.pop(w.worker_id, None)
-                    self._rendezvous.forget_worker(w.worker_id)
+                if w.host not in hosts:
+                    _kill(w)
+                else:
+                    per_host.setdefault(w.host, []).append(w)
+            for host, ws in per_host.items():
+                ws.sort(key=lambda w: w.seq)
+                for w in ws[hosts[host]:]:  # youngest beyond capacity
+                    _kill(w)
             # Spawn into FREE slot indexes (a respawn reuses the slot its
             # predecessor freed), up to max_np total.
             used = {}

@@ -83,3 +83,39 @@ def test_elastic_scale_up(tmp_path):
     assert grew, finals
     for r in finals:
         assert sorted(r["sizes"]) == r["sizes"], r  # never shrank
+
+
+def test_elastic_scale_down(tmp_path):
+    """Slot shrink: the driver kills the excess worker (not booked as a
+    host failure), survivors recover and finish at the smaller size."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER_SRC.format(repo=REPO, tmp=str(tmp_path)))
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:3\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(0o755)
+
+    env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    driver = ElasticDriver(HostDiscoveryScript(str(script)),
+                           [sys.executable, str(worker.resolve())],
+                           min_np=2, max_np=3, poll_interval=0.5,
+                           start_timeout=60, env=env)
+    driver.start()
+    try:
+        time.sleep(3)
+        hosts_file.write_text("localhost:2\n")
+        rc = driver.wait_for_completion()
+    finally:
+        driver.stop()
+    assert rc == 0  # the deliberate kill must not fail the job
+
+    done = sorted(tmp_path.glob("done.*"))
+    assert len(done) == 2, [p.name for p in done]  # no respawn of slot 2
+    finals = [json.loads(p.read_text()) for p in done]
+    assert all(r["final"] == 2 for r in finals), finals
+    shrank = [r for r in finals if 3 in r["sizes"] and 2 in r["sizes"]]
+    assert shrank, finals
+    # the deliberate kill must not be booked as a host failure at all
+    # (three bookings would blacklist the host)
+    assert driver._host_failures.get("localhost", 0) == 0
