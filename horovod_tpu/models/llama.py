@@ -8,7 +8,9 @@ Design choices (vs a torch translation):
   standard TPU idiom (compile time does not grow with n_layers).
 - remat: each scanned layer is wrapped in ``jax.checkpoint`` so activations
   are recomputed in backward — HBM for FLOPs, the right TPU trade.
-- bfloat16 compute, float32 params/logits-softmax for stability.
+- bfloat16 compute; params stored in ``param_dtype`` (float32 default
+  for stability, bfloat16 for the pure-bf16 large-model recipe — the
+  HBM ceiling on a single chip); logits-softmax always float32.
 - attention dispatches to exact ring attention when the mesh has a
   non-trivial ``seq`` axis (long-context sequence parallelism), else to
   single-device flash-style blockwise attention.
@@ -59,6 +61,12 @@ class LlamaConfig:
     # "ulysses" (all-to-all head/sequence reshard — needs
     # n_heads % seq_size == 0, cheaper at short per-device sequences).
     seq_parallel: str = "ring"
+    # Parameter STORAGE dtype ("float32" default). "bfloat16" halves
+    # parameter/gradient/optimizer-state HBM (pure-bf16 training, the
+    # usual large-model recipe on TPU) — on one 16G chip it is what
+    # lets >1B-param configs fit; use fp32 when running few-hundred-M
+    # models where master-precision weights are free.
+    param_dtype: str = "float32"
 
     @property
     def head_dim(self):
@@ -95,17 +103,23 @@ class LlamaConfig:
 
 
 def llama_init(config, key):
-    """Initialize the parameter pytree (float32 master weights).
+    """Initialize the parameter pytree (stored in config.param_dtype;
+    float32 by default — "master weights" — or bfloat16 for the
+    pure-bf16 large-model recipe).
 
     Per-layer tensors are stacked on a leading n_layers axis for scan.
     """
     c = config
     hd = c.head_dim
     k = iter(jax.random.split(key, 16))
+    pd = jnp.dtype(c.param_dtype)
 
     def dense(key, shape, fan_in):
+        # Cast per-leaf at creation: a post-hoc whole-tree cast would
+        # transiently hold fp32 AND target trees (~1.5x init peak, which
+        # matters for >1B params on a 16G chip).
         return (jax.random.normal(key, shape, jnp.float32)
-                * (fan_in ** -0.5))
+                * (fan_in ** -0.5)).astype(pd)
 
     L = c.n_layers
     layers = {
@@ -141,6 +155,9 @@ def llama_init(config, key):
         "final_norm": jnp.ones(c.d_model),
         "lm_head": dense(next(k), (c.d_model, c.vocab_size), c.d_model),
     }
+    pd = jnp.dtype(c.param_dtype)
+    if pd != jnp.float32:
+        params = jax.tree.map(lambda x: x.astype(pd), params)
     return params
 
 

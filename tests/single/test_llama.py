@@ -322,3 +322,19 @@ def test_pipeline_bf16_compiles_on_cpu():
     assert np.isfinite(float(loss))
     assert all(np.isfinite(np.asarray(g, dtype=np.float32)).all()
                for g in jax.tree.leaves(grads))
+
+
+def test_param_dtype_bf16():
+    """param_dtype="bfloat16" stores every leaf in bf16 (the pure-bf16
+    large-model recipe) and the forward/loss stays finite."""
+    cfg = LlamaConfig.tiny(dtype="bfloat16", param_dtype="bfloat16",
+                           n_layers=2)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(params))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    out = llama_forward(params, tokens, cfg)
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+    loss = llama_loss(params, {"tokens": tokens,
+                               "targets": jnp.roll(tokens, -1, 1)}, cfg)
+    assert bool(jnp.isfinite(loss))
