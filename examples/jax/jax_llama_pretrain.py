@@ -43,6 +43,10 @@ def main():
     ap.add_argument("--sp-mode", choices=("ring", "ulysses"),
                     default="ring",
                     help="sequence-parallel strategy (--sp > 1)")
+    ap.add_argument("--param-dtype", choices=("float32", "bfloat16"),
+                    default="float32",
+                    help="parameter storage dtype (bfloat16 = pure-bf16 "
+                         "training, halves param/grad/opt HBM)")
     ap.add_argument("--ep", type=int, default=1, help="expert parallel")
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
     ap.add_argument("--experts", type=int, default=0,
@@ -79,7 +83,8 @@ def main():
     cfg = LlamaConfig.tiny(
         d_model=args.d_model, n_layers=n_layers, n_heads=heads,
         n_kv_heads=heads, d_ff=4 * args.d_model, vocab_size=512,
-        n_experts=args.experts, seq_parallel=args.sp_mode)
+        n_experts=args.experts, seq_parallel=args.sp_mode,
+        param_dtype=args.param_dtype)
 
     params = llama_init(cfg, jax.random.PRNGKey(0))
     shardings = parallel.shard_params(

@@ -123,7 +123,7 @@ def llama_init(config, key):
 
     L = c.n_layers
     layers = {
-        "attn_norm": jnp.ones((L, c.d_model)),
+        "attn_norm": jnp.ones((L, c.d_model), pd),
         "wq": dense(next(k), (L, c.d_model, c.n_heads * hd), c.d_model),
         "wk": dense(next(k), (L, c.d_model, c.n_kv_heads * hd),
                     c.d_model),
@@ -131,7 +131,7 @@ def llama_init(config, key):
                     c.d_model),
         "wo": dense(next(k), (L, c.n_heads * hd, c.d_model),
                     c.n_heads * hd),
-        "mlp_norm": jnp.ones((L, c.d_model)),
+        "mlp_norm": jnp.ones((L, c.d_model), pd),
     }
     if c.n_experts > 0:
         E = c.n_experts
@@ -149,15 +149,12 @@ def llama_init(config, key):
             "w_down": dense(next(k), (L, c.d_ff, c.d_model), c.d_ff),
         })
     params = {
-        "embed": jax.random.normal(next(k), (c.vocab_size, c.d_model),
-                                   jnp.float32) * 0.02,
+        "embed": (jax.random.normal(next(k), (c.vocab_size, c.d_model),
+                                    jnp.float32) * 0.02).astype(pd),
         "layers": layers,
-        "final_norm": jnp.ones(c.d_model),
+        "final_norm": jnp.ones(c.d_model, pd),
         "lm_head": dense(next(k), (c.d_model, c.vocab_size), c.d_model),
     }
-    pd = jnp.dtype(c.param_dtype)
-    if pd != jnp.float32:
-        params = jax.tree.map(lambda x: x.astype(pd), params)
     return params
 
 
