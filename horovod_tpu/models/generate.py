@@ -1,0 +1,139 @@
+"""KV-cached autoregressive decoding for the llama family.
+
+Net-new vs the reference (Horovod ships no inference path); TPU-first:
+one jitted program — prefill fills the cache with a single full-sequence
+pass, then ``lax.scan`` decodes token-by-token against a static-shaped
+cache (no dynamic shapes, no per-step retrace). Causal masking comes for
+free from ``blockwise_attention``'s global-position offsets: cache slots
+past the current position have ``kv_pos > q_pos`` and mask themselves.
+
+Dense configs only (MoE decode routing is a round-2 item); single-device
+or data-parallel batch — the sequence axis is not sharded at decode.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.models.llama import _rmsnorm, _rope
+from horovod_tpu.parallel.ring_attention import blockwise_attention
+
+
+def _layer_kv(h, lp, c, positions):
+    """Project h -> rope'd (k, v) for one layer. h [B,T,D] normalized."""
+    dt = c.compute_dtype
+    b, t = h.shape[0], h.shape[1]
+    k = (h @ lp["wk"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
+    v = (h @ lp["wv"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
+    return _rope(k, positions, c.rope_theta), v
+
+
+def _attend_step(x, lp, c, cache_k, cache_v, pos):
+    """One decode-position layer step against the cache.
+
+    x [B,1,D]; cache_k/v [B,max_len,Hkv,hd] with positions < pos valid
+    plus this step's k/v written at index pos before attending.
+    Returns (x_out, cache_k, cache_v).
+    """
+    dt = c.compute_dtype
+    b = x.shape[0]
+    positions = jnp.broadcast_to(pos, (b, 1))
+    h = _rmsnorm(x, lp["attn_norm"].astype(dt), c.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(b, 1, c.n_heads, c.head_dim)
+    q = _rope(q, positions, c.rope_theta)
+    k_new, v_new = _layer_kv(h, lp, c, positions)
+    cache_k = lax.dynamic_update_slice(cache_k, k_new, (0, pos, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v_new, (0, pos, 0, 0))
+    # q_offset=pos, kv_offset=0: slots > pos are future -> masked.
+    attn = blockwise_attention(q, cache_k, cache_v, causal=True,
+                               q_offset=pos, kv_offset=0)
+    x = x + attn.reshape(b, 1, -1) @ lp["wo"].astype(dt)
+    h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    return x, cache_k, cache_v
+
+
+@partial(jax.jit,
+         static_argnames=("config", "max_new_tokens", "temperature"))
+def llama_generate(params, prompt, config, max_new_tokens,
+                   temperature=0.0, key=None):
+    """Greedy (temperature=0) or sampled decoding.
+
+    prompt [B, T] int32 -> [B, T + max_new_tokens] (prompt + generated).
+    The whole prefill+decode is ONE compiled program; recompiles when
+    (config, prompt length, max_new_tokens, temperature) change —
+    temperature is static because it selects greedy vs sampled tracing.
+    """
+    c = config
+    if c.n_experts > 0:
+        raise NotImplementedError("MoE decode is not supported yet")
+    dt = c.compute_dtype
+    b, t0 = prompt.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, max_new_tokens)  # [0]=first, rest=steps
+
+    # ---- prefill: one full pass, capturing each layer's K/V ----------
+    x = params["embed"].astype(dt)[prompt]
+    positions = jnp.broadcast_to(jnp.arange(t0), (b, t0))
+
+    def prefill_layer(x, lp):
+        h = _rmsnorm(x, lp["attn_norm"].astype(dt), c.norm_eps)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, t0, c.n_heads, c.head_dim)
+        q = _rope(q, positions, c.rope_theta)
+        k, v = _layer_kv(h, lp, c, positions)
+        # Flash kernel (not blockwise): a long prompt must not
+        # materialize the [B,H,T,T] score tensor.
+        from horovod_tpu.ops import flash_attention
+
+        attn = flash_attention(q, k, v, causal=True)
+        x = x + attn.reshape(b, t0, -1) @ lp["wo"].astype(dt)
+        h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        # Cache padded to max_len so decode's dynamic_update_slice fits.
+        pad = jnp.zeros((b, max_new_tokens, c.n_kv_heads, c.head_dim), dt)
+        return x, (jnp.concatenate([k, pad], axis=1),
+                   jnp.concatenate([v, pad], axis=1))
+
+    x, (cache_k, cache_v) = lax.scan(prefill_layer, x, params["layers"])
+    # cache_k/v: [L, B, max_len, Hkv, hd]
+
+    def logits_of(x_last):
+        h = _rmsnorm(x_last, params["final_norm"].astype(dt), c.norm_eps)
+        return (h @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+    def pick(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1).astype(prompt.dtype)
+
+    first = pick(logits_of(x[:, -1:, :])[:, 0, :], keys[0])  # [B]
+
+    # ---- decode: scan max_new_tokens-1 steps (each feeds the previous
+    # token and emits the NEXT one; 'first' is prepended at the end) ---
+    def step(carry, step_key):
+        token, pos, cache_k, cache_v = carry
+        x = params["embed"].astype(dt)[token][:, None, :]  # [B,1,D]
+
+        def layer(x, packed):
+            lp, ck, cv = packed
+            x, ck, cv = _attend_step(x, lp, c, ck, cv, pos)
+            return x, (ck, cv)
+
+        x, (cache_k, cache_v) = lax.scan(
+            layer, x, (params["layers"], cache_k, cache_v))
+        nxt = pick(logits_of(x)[:, 0, :], step_key)
+        return (nxt, pos + 1, cache_k, cache_v), nxt
+
+    (_, _, _, _), toks = lax.scan(
+        step, (first, jnp.int32(t0), cache_k, cache_v), keys[1:])
+    # toks [max_new_tokens-1, B]: tokens generated after 'first'.
+    return jnp.concatenate(
+        [prompt, first[:, None], jnp.transpose(toks, (1, 0))], axis=1)
