@@ -7,8 +7,11 @@ cache (no dynamic shapes, no per-step retrace). Causal masking comes for
 free from ``blockwise_attention``'s global-position offsets: cache slots
 past the current position have ``kv_pos > q_pos`` and mask themselves.
 
-Dense configs only (MoE decode routing is a round-2 item); single-device
-or data-parallel batch — the sequence axis is not sharded at decode.
+Dense and MoE configs (per-token top-k routing is sequence-independent,
+so cached decode routes each new token exactly as a full forward would;
+only capacity-overflow drops can differ, and a single decoded token
+never overflows). Single-device or data-parallel batch — the sequence
+axis is not sharded at decode.
 """
 
 from functools import partial
@@ -17,8 +20,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.models.llama import _ffn as _llama_ffn
 from horovod_tpu.models.llama import _rmsnorm, _rope
 from horovod_tpu.parallel.ring_attention import blockwise_attention
+
+
+def _ffn(h, lp, c):
+    """llama.py's shared FFN, aux loss dropped (decode does not train).
+    MoE note: the decode step streams ALL experts through the capacity
+    dispatch (a top-k-only grouped matmul that reads just the selected
+    experts' weights is a known round-2 decode optimization)."""
+    y, _aux = _llama_ffn(h, lp, c, None)
+    return y
 
 
 def _layer_kv(h, lp, c, positions):
@@ -51,9 +64,7 @@ def _attend_step(x, lp, c, cache_k, cache_v, pos):
                                q_offset=pos, kv_offset=0)
     x = x + attn.reshape(b, 1, -1) @ lp["wo"].astype(dt)
     h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
-    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    x = x + _ffn(h, lp, c)
     return x, cache_k, cache_v
 
 
@@ -69,8 +80,6 @@ def llama_generate(params, prompt, config, max_new_tokens,
     temperature is static because it selects greedy vs sampled tracing.
     """
     c = config
-    if c.n_experts > 0:
-        raise NotImplementedError("MoE decode is not supported yet")
     dt = c.compute_dtype
     b, t0 = prompt.shape
     if key is None:
@@ -93,9 +102,7 @@ def llama_generate(params, prompt, config, max_new_tokens,
         attn = flash_attention(q, k, v, causal=True)
         x = x + attn.reshape(b, t0, -1) @ lp["wo"].astype(dt)
         h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
-        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-        up = h @ lp["w_up"].astype(dt)
-        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        x = x + _ffn(h, lp, c)
         # Cache padded to max_len so decode's dynamic_update_slice fits.
         pad = jnp.zeros((b, max_new_tokens, c.n_kv_heads, c.head_dim), dt)
         return x, (jnp.concatenate([k, pad], axis=1),

@@ -297,6 +297,20 @@ def _moe_ffn(h, lp, c, mesh):
     return y, aux
 
 
+def _ffn(h, lp, c, mesh=None):
+    """One layer's FFN on normalized activations: dense siglu MLP, or
+    top-k expert routing for MoE configs. Returns (y, aux_loss).
+    Shared by llama_forward and the cached decode path (generate.py) so
+    the two can never diverge."""
+    dt = c.compute_dtype
+    if c.n_experts > 0:
+        return _moe_ffn(h, lp, c, mesh)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    return ((gate * up) @ lp["w_down"].astype(dt),
+            jnp.zeros((), jnp.float32))
+
+
 def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
                   return_aux=False):
     """tokens [B, T] int32 -> logits [B, T, vocab] (float32).
@@ -340,13 +354,7 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
         x = x + constrain(attn.reshape(bb, tt, -1) @ lp["wo"].astype(dt))
 
         h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
-        if c.n_experts > 0:
-            ff, aux = _moe_ffn(h, lp, c, mesh)
-        else:
-            gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-            up = h @ lp["w_up"].astype(dt)
-            ff = (gate * up) @ lp["w_down"].astype(dt)
-            aux = jnp.zeros((), jnp.float32)
+        ff, aux = _ffn(h, lp, c, mesh)
         x = x + constrain(ff)
         return x, aux
 
