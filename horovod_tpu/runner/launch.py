@@ -207,7 +207,8 @@ def _tpu_pod_np():
     return len(jax.local_devices())
 
 
-def _slot_env(slot, controller_addr, controller_port, tpu_pod):
+def _slot_env(slot, controller_addr, controller_port, tpu_pod,
+              local=True):
     env = {
         "HOROVOD_RANK": str(slot.rank),
         "HOROVOD_SIZE": str(slot.size),
@@ -223,11 +224,17 @@ def _slot_env(slot, controller_addr, controller_port, tpu_pod):
         "OMPI_COMM_WORLD_LOCAL_RANK": str(slot.local_rank),
     }
     if tpu_pod:
-        # One chip per rank: restrict this process's PJRT client to its
-        # chip (rank-per-chip binding, SURVEY.md §7 step 3).
-        env["TPU_VISIBLE_DEVICES"] = str(slot.local_rank)
-        env["TPU_PROCESS_BOUNDS"] = "1,1,1"
-        env["JAX_LOCAL_DEVICE_IDS"] = str(slot.local_rank)
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        # The launcher's JAX_PLATFORMS describes only ITS host: a local
+        # slot with a non-libtpu PJRT plugin active (e.g. a tunneled dev
+        # chip) must not get the libtpu chip-binding vars (they break the
+        # plugin's registration and binding doesn't apply). Remote slots
+        # are assumed libtpu TPU hosts and always get rank-per-chip
+        # binding (SURVEY.md §7 step 3).
+        if not local or not plat or "tpu" in plat.split(","):
+            env["TPU_VISIBLE_DEVICES"] = str(slot.local_rank)
+            env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+            env["JAX_LOCAL_DEVICE_IDS"] = str(slot.local_rank)
     return env
 
 
@@ -337,7 +344,8 @@ def run_launcher(args):
         env = dict(os.environ)
         env.update(knob_env)
         slot_env = _slot_env(slot, controller_addr, controller_port,
-                             args.tpu_pod)
+                             args.tpu_pod,
+                             local=util.is_local_host(slot.hostname))
         env.update(slot_env)
         env.setdefault("HOROVOD_START_TIMEOUT", str(args.start_timeout))
         if util.is_local_host(slot.hostname):
