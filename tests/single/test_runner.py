@@ -280,8 +280,10 @@ def test_interactive_run():
     before = os.environ.get("HOROVOD_RANK")
     env = {"JAX_PLATFORMS": "cpu",
            "HOROVOD_XLA_DATA_PLANE": "0"}
+    # Generous per-rank timeout: spawned workers import TF/JAX on a
+    # single shared core and can take minutes when the machine is loaded.
     results = runner.run(_interactive_fn, args=(10.0,), np=2, env=env,
-                         timeout=120)
+                         timeout=300)
     assert results == [30.0, 30.0]  # sum(1..2) * 10 on both ranks
     # run() must not mutate the parent environment (other tests may have
     # set HOROVOD_RANK before us; assert it is unchanged, not absent).
