@@ -288,3 +288,40 @@ def test_interactive_run():
     # run() must not mutate the parent environment (other tests may have
     # set HOROVOD_RANK before us; assert it is unchanged, not absent).
     assert os.environ.get("HOROVOD_RANK") == before
+
+
+def test_tpu_pod_slot_env_binding():
+    """--tpu-pod chip binding: libtpu hosts get per-rank TPU_VISIBLE_DEVICES;
+    a LOCAL slot under a non-libtpu PJRT plugin (JAX_PLATFORMS names
+    something other than tpu) must NOT get the binding vars (they break
+    such plugins' registration); remote slots always get them."""
+    from unittest import mock
+
+    from horovod_tpu.runner.launch import _slot_env
+    from horovod_tpu.runner.util import SlotInfo
+
+    slot = SlotInfo(hostname="localhost", rank=1, local_rank=1,
+                    cross_rank=0, size=2, local_size=2, cross_size=1)
+
+    with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "tpu"}):
+        env = _slot_env(slot, "127.0.0.1", 29500, tpu_pod=True, local=True)
+        assert env["TPU_VISIBLE_DEVICES"] == "1"
+        assert env["JAX_LOCAL_DEVICE_IDS"] == "1"
+
+    with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "axon"}):
+        # local + plugin platform: no binding vars
+        env = _slot_env(slot, "127.0.0.1", 29500, tpu_pod=True, local=True)
+        assert "TPU_VISIBLE_DEVICES" not in env
+        # remote slot: launcher env says nothing about it -> binding on
+        env = _slot_env(slot, "127.0.0.1", 29500, tpu_pod=True,
+                        local=False)
+        assert env["TPU_VISIBLE_DEVICES"] == "1"
+
+    with mock.patch.dict(os.environ, clear=False) as _:
+        os.environ.pop("JAX_PLATFORMS", None)
+        env = _slot_env(slot, "127.0.0.1", 29500, tpu_pod=True, local=True)
+        assert env["TPU_VISIBLE_DEVICES"] == "1"  # unset -> libtpu default
+
+    # non-tpu-pod launches never set binding vars
+    env = _slot_env(slot, "127.0.0.1", 29500, tpu_pod=False)
+    assert "TPU_VISIBLE_DEVICES" not in env
