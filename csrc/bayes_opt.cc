@@ -107,10 +107,13 @@ size_t BayesOpt::Suggest() const {
   SolveLower(K, n, alpha);
   SolveUpperT(K, n, alpha);
 
-  // Expected improvement over the grid.
+  // Expected improvement over the grid. Unseen candidates win exact EI
+  // ties (flat posteriors would otherwise resample the lowest index).
   constexpr double kXi = 0.01;  // exploration margin
   double best_ei = -1;
   size_t best_idx = Best();
+  std::vector<char> seen(cand_.size(), 0);
+  for (size_t i : xs_) seen[i] = 1;
   std::vector<double> kstar(n), v(n);
   for (size_t c = 0; c < cand_.size(); c++) {
     for (size_t i = 0; i < n; i++) kstar[i] = Kernel(cand_[c], cand_[xs_[i]]);
@@ -128,7 +131,8 @@ size_t BayesOpt::Suggest() const {
       double z = (mu - best_y - kXi) / sigma;
       ei = (mu - best_y - kXi) * Phi(z) + sigma * phi(z);
     }
-    if (ei > best_ei) {
+    if (ei > best_ei ||
+        (ei == best_ei && !seen[c] && seen[best_idx])) {
       best_ei = ei;
       best_idx = c;
     }

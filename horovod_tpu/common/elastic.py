@@ -248,17 +248,30 @@ def _is_internal_error(exc):
     ops inside their own executors re-raise with the original only in
     the message/cause chain (e.g. tf.py_function surfaces it as
     tf.errors.UnknownError whose message embeds the repr)."""
+    # The textual fallback only fires for known framework wrapper types:
+    # a user RuntimeError that merely *mentions* the class name must not
+    # be swallowed into a silent restore/retry loop.
+    def _is_framework_wrapper(e):
+        return any(cls.__module__.startswith("tensorflow.")
+                   and cls.__name__ == "OpError"
+                   for cls in type(e).__mro__)
+
     seen = set()
     while exc is not None and id(exc) not in seen:
         seen.add(id(exc))
         if isinstance(exc, HorovodInternalError):
             return True
-        # Wrapped form: the framework's error message quotes the original
-        # exception's rendered traceback ("...HorovodInternalError: msg").
-        # Match that shape, not the bare class name, so user messages that
-        # merely mention the class don't trigger silent retry loops.
         txt = str(exc)
-        if "HorovodInternalError:" in txt or "HorovodInternalError(" in txt:
+        if _is_framework_wrapper(exc) and (
+                "HorovodInternalError:" in txt
+                or "HorovodInternalError(" in txt):
+            import warnings
+
+            warnings.warn(
+                "elastic recovery triggered by textual match inside a "
+                f"framework-wrapped error ({type(exc).__name__}); the "
+                "original HorovodInternalError was not in the __cause__ "
+                "chain", RuntimeWarning, stacklevel=3)
             return True
         # Walk explicit `raise ... from X` chains only. Implicit
         # __context__ must not count: `except HorovodInternalError:

@@ -143,7 +143,7 @@ class ElasticDriver:
             rereg = self._rendezvous.take_reregistrations()
             if changed or rereg or self._reconcile_needed.is_set():
                 self._reconcile_needed.clear()
-                self._reconcile(notify=bool(added))
+                self._reconcile(notify=bool(added), rereg=bool(rereg))
 
     def _spawn(self, host, local_index):
         worker_id = f"{host}:{uuid.uuid4().hex[:8]}"
@@ -202,12 +202,12 @@ class ElasticDriver:
             self._manager.blacklist(worker.host)
         self._reconcile_needed.set()
 
-    def _reconcile(self, notify=False):
+    def _reconcile(self, notify=False, rereg=False):
         """Match the fleet to the current host view and cut a new epoch."""
         # The upcoming cut covers any pending re-registrations; drain them
         # so the monitor doesn't cut a second (ghost) epoch for the same
         # recovery.
-        self._rendezvous.take_reregistrations()
+        rereg = bool(self._rendezvous.take_reregistrations()) or rereg
         with self._lock:
             fleet_done = (not self._workers and self._final_codes
                           and all(c == 0 for c in self._final_codes))
@@ -221,11 +221,15 @@ class ElasticDriver:
             # kill only the EXCESS count, youngest first — the oldest
             # workers hold the committed state that rank 0's sync()
             # broadcasts, so they must survive a shrink.
+            killed = 0
+
             def _kill(w):
+                nonlocal killed
                 w.driver_killed = True
                 w.kill_event.set()
                 self._workers.pop(w.worker_id, None)
                 self._rendezvous.forget_worker(w.worker_id)
+                killed += 1
 
             per_host = {}
             for w in list(self._workers.values()):
@@ -238,7 +242,12 @@ class ElasticDriver:
                 for w in ws[hosts[host]:]:  # youngest beyond capacity
                     _kill(w)
             # Spawn into FREE slot indexes (a respawn reuses the slot its
-            # predecessor freed), up to max_np total.
+            # predecessor freed), up to max_np total. A host's LIVE worker
+            # count — not its free indexes — bounds spawning: after a
+            # fail→respawn→shrink history a surviving oldest worker can
+            # occupy local_index >= slots, leaving a lower index free on a
+            # host that is already at capacity; filling it would publish
+            # local_size > slots and double-bind chips.
             used = {}
             for w in self._workers.values():
                 used.setdefault(w.host, set()).add(w.local_index)
@@ -248,9 +257,12 @@ class ElasticDriver:
                 for idx in range(slots):
                     if idx in used.get(host, set()):
                         continue
+                    if len(used.get(host, ())) >= slots:
+                        break
                     if total >= self._max_np:
                         break
                     self._spawn(host, idx)
+                    used.setdefault(host, set()).add(idx)
                     total += 1
                     spawned += 1
             alive = list(self._workers.values())
@@ -259,6 +271,13 @@ class ElasticDriver:
                 print(f"[elastic driver] {total} workers < min_np="
                       f"{self._min_np}; waiting for discovery",
                       file=sys.stderr)
+            return
+        if not spawned and not killed and not rereg:
+            # Nothing about the fleet changed (e.g. a discovery delta
+            # while at max_np). Cutting anyway would publish a ghost
+            # epoch: a later recovery would re-register with a stale
+            # last_epoch, adopt the dead assignment, and burn a full
+            # start-timeout round before the real recovery epoch.
             return
         if notify and spawned:
             # Notify only when capacity growth actually ADDED workers: at

@@ -49,6 +49,8 @@ class HorovodRunTaskService:
         self._driver_addr = tuple(driver_addr)
         self._key = key
         self._probe_timeout = probe_timeout
+        # _stopped must exist before the accept thread can observe self.
+        self._stopped = False
         # Probe listener: plain TCP accept; connectability is the test.
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -57,7 +59,6 @@ class HorovodRunTaskService:
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
-        self._stopped = False
 
     @property
     def listen_port(self):

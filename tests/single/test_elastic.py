@@ -156,6 +156,83 @@ open(os.path.join({str(marker)!r}, wid.replace(":", "_")), "w").write(
     assert sizes == {3}
 
 
+def _reconcile_driver(hosts):
+    """ElasticDriver with fake spawn/cut, for reconcile-logic tests."""
+    from horovod_tpu.runner.elastic.driver import ElasticDriver, _Worker
+
+    d = ElasticDriver.__new__(ElasticDriver)
+    d._lock = threading.RLock()
+    d._min_np = 1
+    d._max_np = 10 ** 9
+    d._start_timeout = 5
+    d._final_codes = []
+    d._reconcile_needed = threading.Event()
+    d._verbose = False
+    d._rendezvous = RendezvousServer()
+    d._workers = {}
+    d._host_failures = {}
+    d._shutdown = threading.Event()
+
+    class _Mgr:
+        current_hosts = dict(hosts)
+
+    d._manager = _Mgr()
+    d._spawned = []
+    d._cuts = []
+
+    def fake_spawn(host, idx):
+        w = _Worker(f"{host}:{len(d._spawned)}-{idx}", host, idx)
+        d._workers[w.worker_id] = w
+        d._spawned.append(w)
+        return w
+
+    d._spawn = fake_spawn
+    d._cut_epoch = lambda workers: d._cuts.append(list(workers))
+    return d
+
+
+def test_reconcile_shrink_respects_host_capacity():
+    """fail→respawn→shrink: a surviving oldest worker may hold
+    local_index >= slots; the freed lower index must NOT be refilled on
+    a host already at capacity (would publish local_size > slots and
+    double-bind chips)."""
+    d = _reconcile_driver({"h": 4})
+    try:
+        d._reconcile()
+        assert len(d._workers) == 4
+        # idx2 fails; its slot frees; the respawn takes it (youngest seq)
+        dead = next(w for w in d._workers.values() if w.local_index == 2)
+        del d._workers[dead.worker_id]
+        d._reconcile()
+        assert len(d._workers) == 4
+        # shrink to 3 slots: the respawn (youngest) dies; survivors hold
+        # indexes {0, 1, 3}; index 2 is free but the host is full.
+        d._manager.current_hosts = {"h": 3}
+        spawns_before = len(d._spawned)
+        d._reconcile()
+        assert len(d._workers) == 3
+        assert len(d._spawned) == spawns_before
+        assert {w.local_index for w in d._workers.values()} == {0, 1, 3}
+    finally:
+        d._rendezvous.stop()
+
+
+def test_reconcile_skips_ghost_epoch_when_fleet_unchanged():
+    """A reconcile that spawns nothing, kills nothing, and covers no
+    re-registration must not cut an epoch (a ghost epoch desyncs the
+    next real recovery's last_epoch tracking)."""
+    d = _reconcile_driver({"h": 2})
+    try:
+        d._reconcile()
+        assert len(d._cuts) == 1
+        d._reconcile()  # discovery delta with no usable change
+        assert len(d._cuts) == 1
+        d._reconcile(rereg=True)  # a worker re-registered: must cut
+        assert len(d._cuts) == 2
+    finally:
+        d._rendezvous.stop()
+
+
 def test_object_state_commit_restore():
     from horovod_tpu.common.elastic import ObjectState
 
