@@ -169,7 +169,7 @@ def llama_partition_rules(pipeline=False):
     """
     lead = "pipe" if pipeline else None
     return [
-        (r"embed", P("tensor", "fsdp")),
+        (r"embed", P(("tensor", "fsdp"), None)),
         (r"layers/.*norm", P(lead, None)),
         (r"layers/w[qkv]$", P(lead, "fsdp", "tensor")),
         (r"layers/wo", P(lead, "tensor", "fsdp")),
@@ -331,6 +331,18 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
         return lax.with_sharding_constraint(
             x, jax.sharding.NamedSharding(mesh, _activation_spec(mesh)))
 
+    # Layout contract for the vocab lookup: tokens are pinned to the
+    # activation layout (batch over data/fsdp, seq over seq) so the SPMD
+    # partitioner picks INDEX-passthrough for the gather — each device
+    # all-gathers the (small) table shard and gathers its own token
+    # block, and the output is born in the activation layout. Without the
+    # pin it picks operand-passthrough (output sharded over the table's d
+    # axis) and then "involuntary full rematerialization" to reshard
+    # [B,T,D] into the batch/seq layout.
+    if mesh is not None:
+        tokens = lax.with_sharding_constraint(
+            tokens, jax.sharding.NamedSharding(mesh, P(("data", "fsdp"),
+                                                       "seq")))
     x = params["embed"].astype(dt)[tokens]
     x = constrain(x)
 

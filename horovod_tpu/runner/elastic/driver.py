@@ -141,9 +141,15 @@ class ElasticDriver:
                           file=sys.stderr)
                 continue
             rereg = self._rendezvous.take_reregistrations()
-            if changed or rereg or self._reconcile_needed.is_set():
+            # _reconcile_needed marks an explicit retry request (worker
+            # failure, cut timeout, min_np guard) whose epoch was never
+            # published — those must cut even if the fleet looks
+            # unchanged, so they count like a pending re-registration.
+            needed = self._reconcile_needed.is_set()
+            if changed or rereg or needed:
                 self._reconcile_needed.clear()
-                self._reconcile(notify=bool(added), rereg=bool(rereg))
+                self._reconcile(notify=bool(added),
+                                force_cut=bool(rereg) or needed)
 
     def _spawn(self, host, local_index):
         worker_id = f"{host}:{uuid.uuid4().hex[:8]}"
@@ -202,12 +208,13 @@ class ElasticDriver:
             self._manager.blacklist(worker.host)
         self._reconcile_needed.set()
 
-    def _reconcile(self, notify=False, rereg=False):
+    def _reconcile(self, notify=False, force_cut=False):
         """Match the fleet to the current host view and cut a new epoch."""
         # The upcoming cut covers any pending re-registrations; drain them
         # so the monitor doesn't cut a second (ghost) epoch for the same
         # recovery.
-        rereg = bool(self._rendezvous.take_reregistrations()) or rereg
+        force_cut = bool(self._rendezvous.take_reregistrations()) \
+            or force_cut
         with self._lock:
             fleet_done = (not self._workers and self._final_codes
                           and all(c == 0 for c in self._final_codes))
@@ -272,7 +279,7 @@ class ElasticDriver:
                       f"{self._min_np}; waiting for discovery",
                       file=sys.stderr)
             return
-        if not spawned and not killed and not rereg:
+        if not spawned and not killed and not force_cut:
             # Nothing about the fleet changed (e.g. a discovery delta
             # while at max_np). Cutting anyway would publish a ghost
             # epoch: a later recovery would re-register with a stale

@@ -227,7 +227,7 @@ def test_reconcile_skips_ghost_epoch_when_fleet_unchanged():
         assert len(d._cuts) == 1
         d._reconcile()  # discovery delta with no usable change
         assert len(d._cuts) == 1
-        d._reconcile(rereg=True)  # a worker re-registered: must cut
+        d._reconcile(force_cut=True)  # re-registration / retry: must cut
         assert len(d._cuts) == 2
     finally:
         d._rendezvous.stop()
