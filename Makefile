@@ -1,23 +1,44 @@
 # Build the native core runtime (csrc/ -> horovod_tpu/lib/libhvdtpu_core.so).
 # Reference analog: horovod's CMake-driven per-framework extensions
 # (setup.py + CMakeLists.txt). Ours is a single framework-agnostic .so
-# loaded via ctypes (horovod_tpu/common/basics.py).
+# loaded via ctypes (horovod_tpu/common/basics.py), plus an optional
+# TensorFlow op library (csrc/tf_ops.cc -> libhvdtpu_tf.so) built against
+# the installed TF's headers — the analog of horovod/tensorflow/mpi_ops.cc
+# + xla_mpi_ops.cc.
 
 CXX      ?= g++
 CXXFLAGS ?= -O2 -g -std=c++17 -fPIC -Wall -Wextra -Wno-unused-parameter -pthread
 LDFLAGS  ?= -shared -pthread
 
-SRC := $(wildcard csrc/*.cc)
+SRC := $(filter-out csrc/tf_ops.cc,$(wildcard csrc/*.cc))
 HDR := $(wildcard csrc/*.h)
 OUT := horovod_tpu/lib/libhvdtpu_core.so
+TF_OUT := horovod_tpu/lib/libhvdtpu_tf.so
 
-.PHONY: core clean test
+# TF build flags come from the installed wheel; empty when TF is absent.
+PYTHON ?= python3
+TF_CFLAGS = $(shell $(PYTHON) -c "import tensorflow as tf; print(' '.join(tf.sysconfig.get_compile_flags()))" 2>/dev/null)
+TF_LFLAGS = $(shell $(PYTHON) -c "import tensorflow as tf; print(' '.join(tf.sysconfig.get_link_flags()))" 2>/dev/null)
+TF_INC = $(shell $(PYTHON) -c "import tensorflow as tf, os; print(os.path.join(os.path.dirname(tf.__file__), 'include'))" 2>/dev/null)
+
+.PHONY: core tf clean test
 
 core: $(OUT)
 
 $(OUT): $(SRC) $(HDR)
 	@mkdir -p horovod_tpu/lib
 	$(CXX) $(CXXFLAGS) $(SRC) $(LDFLAGS) -o $(OUT)
+
+tf: $(TF_OUT)
+
+$(TF_OUT): csrc/tf_ops.cc $(OUT)
+	@test -n "$(TF_CFLAGS)" || (echo "tensorflow not importable; skipping" && false)
+	$(CXX) -O2 -g -std=c++17 -fPIC -Wno-deprecated-declarations \
+	  csrc/tf_ops.cc $(TF_CFLAGS) -Icsrc -I$(TF_INC)/external/highwayhash \
+	  -I$(TF_INC)/external/farmhash_archive/src \
+	  -shared -pthread $(TF_LFLAGS) \
+	  -Lhorovod_tpu/lib -l:libhvdtpu_core.so '-Wl,-rpath,$$ORIGIN' \
+	  -o $(TF_OUT)
 
 clean:
 	rm -rf horovod_tpu/lib build

@@ -1,0 +1,643 @@
+// Native TensorFlow collective ops over the hvdtpu core runtime.
+//
+// Reference analogs: horovod/tensorflow/mpi_ops.cc (TF custom ops that
+// enqueue to the C++ core) and horovod/tensorflow/xla_mpi_ops.cc (the
+// HOROVOD_ENABLE_XLA_OPS custom-call bridge that lets collectives live
+// inside XLA-compiled programs). Re-founded for this build:
+//
+// - Each op registers BOTH a regular CPU kernel and a tf2xla kernel.
+//   The same graph node therefore works eagerly, inside tf.function,
+//   and inside tf.function(jit_compile=True): the TF executor picks the
+//   CPU kernel, the XLA bridge picks the tf2xla kernel.
+// - The CPU kernel calls the core's enqueue C API directly and waits on
+//   the handle — no Python, no GIL, no numpy round-trip (upstream's
+//   py_function limitation this file replaces).
+// - The tf2xla kernel lowers to an XLA CustomCall whose host callback
+//   re-enters the same core. Operand/attr metadata (shapes, dtype,
+//   names, reduce op, scale factors) is serialized into a trailing
+//   constant byte operand because the XLA:CPU legacy custom-call ABI
+//   does not pass `opaque`.
+// - Grouped allreduce is ONE op (variadic inputs) on both paths, so a
+//   gradient-tape group negotiates atomically as a single fused
+//   collective exactly like the eager grouped path.
+//
+// Ordering contract (same as upstream Horovod's): collectives must be
+// issued in a consistent order on every rank. Inside XLA programs this
+// holds when ranks compile the same program (SPMD), since XLA:CPU
+// executes custom-call thunks in schedule order.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tensorflow/core/framework/op.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "tensorflow/core/framework/shape_inference.h"
+
+#include "tensorflow/compiler/tf2xla/xla_op_kernel.h"
+#include "tensorflow/compiler/tf2xla/xla_op_registry.h"
+#include "xla/hlo/builder/xla_builder.h"
+#include "xla/service/custom_call_target_registry.h"
+
+// Core C API + dtype enum (single source of truth; linked against
+// libhvdtpu_core.so).
+#include "common.h"
+#include "operations.h"
+
+namespace hvdtpu_tf {
+
+using tensorflow::AsyncOpKernel;
+using tensorflow::OpKernel;
+using tensorflow::OpKernelConstruction;
+using tensorflow::OpKernelContext;
+using tensorflow::Tensor;
+
+// Shape pointer for rank-0 (scalar) tensors: the core's group
+// validation rejects null shape pointers, and std::vector::data() on an
+// empty vector is null.
+static const int64_t kScalarShape[1] = {0};
+
+static const int64_t* ShapeData(const std::vector<int64_t>& dims) {
+  return dims.empty() ? kScalarShape : dims.data();
+}
+
+// ---- dtype mapping --------------------------------------------------------
+
+static int ToHvdDtype(tensorflow::DataType dt) {
+  using hvdtpu::DataType;
+  switch (dt) {
+    case tensorflow::DT_UINT8: return (int)DataType::HVDTPU_UINT8;
+    case tensorflow::DT_INT8: return (int)DataType::HVDTPU_INT8;
+    case tensorflow::DT_INT32: return (int)DataType::HVDTPU_INT32;
+    case tensorflow::DT_INT64: return (int)DataType::HVDTPU_INT64;
+    case tensorflow::DT_HALF: return (int)DataType::HVDTPU_FLOAT16;
+    case tensorflow::DT_BFLOAT16: return (int)DataType::HVDTPU_BFLOAT16;
+    case tensorflow::DT_FLOAT: return (int)DataType::HVDTPU_FLOAT32;
+    case tensorflow::DT_DOUBLE: return (int)DataType::HVDTPU_FLOAT64;
+    case tensorflow::DT_BOOL: return (int)DataType::HVDTPU_BOOL;
+    case tensorflow::DT_UINT16: return (int)DataType::HVDTPU_UINT16;
+    default: return -1;
+  }
+}
+
+// ---- status helpers -------------------------------------------------------
+
+// Failures carry the canonical "HorovodInternalError:" marker inside
+// the TF OpError message: that is the wrapped form the elastic
+// recovery loop (common/elastic.py:_is_internal_error) classifies as
+// recoverable, mirroring how the reference's TF ops surface runtime
+// collective failures.
+static tensorflow::Status WaitHandle(int handle, const char* what) {
+  if (handle < 0) {
+    return tensorflow::errors::Internal(
+        what, ": HorovodInternalError: enqueue failed "
+        "(is horovod initialized?)");
+  }
+  int rc = hvdtpu_wait(handle);
+  if (rc != 0) {
+    const char* msg = hvdtpu_error_string(handle);
+    std::string reason = msg ? msg : "collective failed";
+    hvdtpu_release(handle);
+    return tensorflow::errors::Internal(what, ": HorovodInternalError: ",
+                                        reason);
+  }
+  hvdtpu_release(handle);
+  return tensorflow::OkStatus();
+}
+
+// ---- op registrations -----------------------------------------------------
+
+REGISTER_OP("HvdTpuAllreduce")
+    .Input("tensor: T")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, uint16, int32, int64, half, bfloat16, float, "
+          "double}")
+    .Attr("tensor_name: string")
+    .Attr("reduce_op: int = 0")  // csrc ReduceOp: AVERAGE=0
+    .Attr("prescale_factor: float = 1.0")
+    .Attr("postscale_factor: float = 1.0")
+    .Attr("process_set_id: int = 0")
+    .SetShapeFn(tensorflow::shape_inference::UnchangedShape);
+
+REGISTER_OP("HvdTpuGroupedAllreduce")
+    .Input("tensors: N * T")
+    .Output("outputs: N * T")
+    .Attr("N: int >= 1")
+    .Attr("T: {uint8, int8, uint16, int32, int64, half, bfloat16, float, "
+          "double}")
+    .Attr("tensor_names: list(string)")
+    .Attr("reduce_op: int = 0")
+    .Attr("prescale_factor: float = 1.0")
+    .Attr("postscale_factor: float = 1.0")
+    .Attr("process_set_id: int = 0")
+    .SetShapeFn([](tensorflow::shape_inference::InferenceContext* c) {
+      for (int i = 0; i < c->num_inputs(); i++) c->set_output(i, c->input(i));
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HvdTpuBroadcast")
+    .Input("tensor: T")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, uint16, int32, int64, half, bfloat16, float, "
+          "double, bool}")
+    .Attr("tensor_name: string")
+    .Attr("root_rank: int")
+    .Attr("process_set_id: int = 0")
+    .SetShapeFn(tensorflow::shape_inference::UnchangedShape);
+
+// ---- CPU kernels ----------------------------------------------------------
+
+// CPU kernels are ASYNC: Compute must not block the (possibly single)
+// executor thread in hvdtpu_wait — with inter-op parallelism 1, two
+// ranks blocking on differently-ordered independent collectives would
+// deadlock. ComputeAsync enqueues, releases the thread, and a detached
+// waiter fires `done` on completion (reference analog: the
+// AsyncOpKernel pattern of horovod/tensorflow/mpi_ops.cc; TF keeps the
+// context and its tensors alive until `done`).
+static void WaitAsync(OpKernelContext* c, AsyncOpKernel::DoneCallback done,
+                      std::vector<int> handles, const char* what) {
+  std::thread([c, done = std::move(done), handles = std::move(handles),
+               what]() {
+    tensorflow::Status status = tensorflow::OkStatus();
+    for (int h : handles) {  // drain every handle even when one fails
+      auto s = WaitHandle(h, what);
+      if (!s.ok()) status = s;
+    }
+    if (!status.ok()) c->SetStatus(status);
+    done();
+  }).detach();
+}
+
+class AllreduceCpuKernel : public AsyncOpKernel {
+ public:
+  explicit AllreduceCpuKernel(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &reduce_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale_factor", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void ComputeAsync(OpKernelContext* c, DoneCallback done) override {
+    const Tensor& in = c->input(0);
+    Tensor* out;
+    OP_REQUIRES_OK_ASYNC(c, c->allocate_output(0, in.shape(), &out), done);
+    int dtype = ToHvdDtype(in.dtype());
+    OP_REQUIRES_ASYNC(
+        c, dtype >= 0,
+        tensorflow::errors::InvalidArgument("unsupported dtype"), done);
+    auto dims = in.shape().dim_sizes();
+    std::vector<int64_t> shape(dims.begin(), dims.end());
+    int h = hvdtpu_enqueue_allreduce(
+        name_.c_str(), in.tensor_data().data(),
+        const_cast<char*>(out->tensor_data().data()), (int)shape.size(),
+        ShapeData(shape), dtype, reduce_op_, prescale_, postscale_,
+        process_set_id_);
+    WaitAsync(c, std::move(done), {h}, "HvdTpuAllreduce");
+  }
+
+ private:
+  std::string name_;
+  int reduce_op_, process_set_id_;
+  float prescale_, postscale_;
+};
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAllreduce").Device(tensorflow::DEVICE_CPU),
+                        AllreduceCpuKernel);
+
+class GroupedAllreduceCpuKernel : public AsyncOpKernel {
+ public:
+  explicit GroupedAllreduceCpuKernel(OpKernelConstruction* c)
+      : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_names", &names_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &reduce_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale_factor", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void ComputeAsync(OpKernelContext* c, DoneCallback done) override {
+    int n = c->num_inputs();
+    OP_REQUIRES_ASYNC(c, (int)names_.size() == n,
+                      tensorflow::errors::InvalidArgument(
+                          "tensor_names size must match input count"),
+                      done);
+    std::vector<const char*> names(n);
+    std::vector<const void*> ins(n);
+    std::vector<void*> outs(n);
+    std::vector<int> ndims(n);
+    std::vector<std::vector<int64_t>> shapes(n);
+    std::vector<const int64_t*> shape_ptrs(n);
+    int dtype = -1;
+    for (int i = 0; i < n; i++) {
+      const Tensor& in = c->input(i);
+      Tensor* out;
+      OP_REQUIRES_OK_ASYNC(c, c->allocate_output(i, in.shape(), &out),
+                           done);
+      names[i] = names_[i].c_str();
+      ins[i] = in.tensor_data().data();
+      outs[i] = const_cast<char*>(out->tensor_data().data());
+      auto dims = in.shape().dim_sizes();
+      shapes[i].assign(dims.begin(), dims.end());
+      ndims[i] = (int)shapes[i].size();
+      shape_ptrs[i] = ShapeData(shapes[i]);
+      dtype = ToHvdDtype(in.dtype());
+      OP_REQUIRES_ASYNC(
+          c, dtype >= 0,
+          tensorflow::errors::InvalidArgument("unsupported dtype"), done);
+    }
+    std::vector<int> handles(n, -1);
+    // Returns the enqueued-tensor count; unqueued members get handle
+    // -1, which WaitHandle reports — so draining every handle both
+    // surfaces failure and avoids leaking live handles on partial
+    // enqueue.
+    (void)hvdtpu_enqueue_grouped_allreduce(
+        n, names.data(), ins.data(), outs.data(), ndims.data(),
+        shape_ptrs.data(), dtype, reduce_op_, prescale_, postscale_,
+        process_set_id_, handles.data());
+    WaitAsync(c, std::move(done), std::move(handles),
+              "HvdTpuGroupedAllreduce");
+  }
+
+ private:
+  std::vector<std::string> names_;
+  int reduce_op_, process_set_id_;
+  float prescale_, postscale_;
+};
+REGISTER_KERNEL_BUILDER(
+    Name("HvdTpuGroupedAllreduce").Device(tensorflow::DEVICE_CPU),
+    GroupedAllreduceCpuKernel);
+
+class BroadcastCpuKernel : public AsyncOpKernel {
+ public:
+  explicit BroadcastCpuKernel(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("root_rank", &root_rank_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void ComputeAsync(OpKernelContext* c, DoneCallback done) override {
+    const Tensor& in = c->input(0);
+    Tensor* out;
+    OP_REQUIRES_OK_ASYNC(c, c->allocate_output(0, in.shape(), &out), done);
+    int dtype = ToHvdDtype(in.dtype());
+    OP_REQUIRES_ASYNC(
+        c, dtype >= 0,
+        tensorflow::errors::InvalidArgument("unsupported dtype"), done);
+    // Core broadcast is in-place: seed the output with this rank's value.
+    std::memcpy(const_cast<char*>(out->tensor_data().data()),
+                in.tensor_data().data(), in.tensor_data().size());
+    auto dims = in.shape().dim_sizes();
+    std::vector<int64_t> shape(dims.begin(), dims.end());
+    int h = hvdtpu_enqueue_broadcast(
+        name_.c_str(), const_cast<char*>(out->tensor_data().data()),
+        (int)shape.size(), ShapeData(shape), dtype, root_rank_,
+        process_set_id_);
+    WaitAsync(c, std::move(done), {h}, "HvdTpuBroadcast");
+  }
+
+ private:
+  std::string name_;
+  int root_rank_, process_set_id_;
+};
+REGISTER_KERNEL_BUILDER(Name("HvdTpuBroadcast").Device(tensorflow::DEVICE_CPU),
+                        BroadcastCpuKernel);
+
+// ---- XLA custom-call metadata --------------------------------------------
+//
+// The XLA:CPU legacy custom-call ABI is `void fn(void* out, const void**
+// ins)` with no opaque payload, so per-call metadata travels as a
+// trailing constant u8[] operand:
+//
+//   i64 kind (0=allreduce, 1=broadcast)
+//   i64 num_tensors
+//   i64 dtype            (csrc/common.h enum)
+//   i64 reduce_op_or_root
+//   i64 process_set_id
+//   f64 prescale, postscale
+//   per tensor: i64 ndim, i64 dims[ndim], i64 name_len, name bytes
+//               (zero-padded to an 8-byte boundary)
+
+namespace meta {
+
+static void PutI64(std::vector<uint8_t>& b, int64_t v) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+  b.insert(b.end(), p, p + 8);
+}
+
+static void PutF64(std::vector<uint8_t>& b, double v) {
+  int64_t bits;
+  std::memcpy(&bits, &v, 8);
+  PutI64(b, bits);
+}
+
+struct TensorMeta {
+  std::vector<int64_t> dims;
+  std::string name;
+};
+
+struct CallMeta {
+  int64_t kind = 0;
+  int64_t dtype = 0;
+  int64_t reduce_op_or_root = 0;
+  int64_t process_set_id = 0;
+  double prescale = 1.0, postscale = 1.0;
+  std::vector<TensorMeta> tensors;
+};
+
+static std::vector<uint8_t> Serialize(const CallMeta& m) {
+  std::vector<uint8_t> b;
+  PutI64(b, m.kind);
+  PutI64(b, (int64_t)m.tensors.size());
+  PutI64(b, m.dtype);
+  PutI64(b, m.reduce_op_or_root);
+  PutI64(b, m.process_set_id);
+  PutF64(b, m.prescale);
+  PutF64(b, m.postscale);
+  for (const auto& t : m.tensors) {
+    PutI64(b, (int64_t)t.dims.size());
+    for (int64_t d : t.dims) PutI64(b, d);
+    PutI64(b, (int64_t)t.name.size());
+    b.insert(b.end(), t.name.begin(), t.name.end());
+    while (b.size() % 8) b.push_back(0);
+  }
+  return b;
+}
+
+class Reader {
+ public:
+  explicit Reader(const uint8_t* p) : p_(p) {}
+  int64_t I64() {
+    int64_t v;
+    std::memcpy(&v, p_, 8);
+    p_ += 8;
+    return v;
+  }
+  double F64() {
+    double v;
+    std::memcpy(&v, p_, 8);
+    p_ += 8;
+    return v;
+  }
+  std::string Str(int64_t n) {
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += (n + 7) / 8 * 8;
+    return s;
+  }
+
+ private:
+  const uint8_t* p_;
+};
+
+static CallMeta Parse(const uint8_t* p) {
+  Reader r(p);
+  CallMeta m;
+  m.kind = r.I64();
+  int64_t n = r.I64();
+  m.dtype = r.I64();
+  m.reduce_op_or_root = r.I64();
+  m.process_set_id = r.I64();
+  m.prescale = r.F64();
+  m.postscale = r.F64();
+  m.tensors.resize(n);
+  for (auto& t : m.tensors) {
+    int64_t ndim = r.I64();
+    t.dims.resize(ndim);
+    for (auto& d : t.dims) d = r.I64();
+    t.name = r.Str(r.I64());
+  }
+  return m;
+}
+
+}  // namespace meta
+
+// ---- XLA host callbacks ---------------------------------------------------
+
+// Failure inside a compiled program cannot surface a Status through the
+// legacy ABI; dying loudly is the HorovodInternalError analog (peers see
+// the broken control plane and elastic mode recovers by respawn).
+static void DieInXla(const std::string& what, const std::string& why) {
+  std::fprintf(stderr, "hvdtpu %s failed inside an XLA program: %s\n",
+               what.c_str(), why.c_str());
+  std::abort();
+}
+
+extern "C" void hvdtpu_tf_xla_collective(void* out, const void** ins) {
+  // Operand layout: ins[0] = metadata bytes, ins[1..N] = tensor buffers.
+  // N==1 results are a bare buffer; N>1 results arrive as a tuple
+  // (void** of leaf buffers).
+  meta::CallMeta m = meta::Parse(reinterpret_cast<const uint8_t*>(ins[0]));
+  int n = (int)m.tensors.size();
+  void** outs_tuple = reinterpret_cast<void**>(out);
+  if (!hvdtpu_is_initialized()) {
+    DieInXla("collective", "horovod is not initialized");
+  }
+  if (m.kind == 1) {  // broadcast (always n==1)
+    void* dst = n == 1 ? out : outs_tuple[0];
+    const auto& t = m.tensors[0];
+    int64_t bytes = hvdtpu::DataTypeSize((hvdtpu::DataType)m.dtype);
+    for (int64_t d : t.dims) bytes *= d;
+    std::memcpy(dst, ins[1], bytes);
+    int h = hvdtpu_enqueue_broadcast(
+        t.name.c_str(), dst, (int)t.dims.size(), ShapeData(t.dims),
+        (int)m.dtype, (int)m.reduce_op_or_root, (int)m.process_set_id);
+    auto s = WaitHandle(h, "xla broadcast");
+    if (!s.ok()) DieInXla("broadcast", s.ToString());
+    return;
+  }
+  // allreduce (grouped when n > 1): enqueue all, then wait all — one
+  // atomic negotiation, and no cross-rank deadlock from wait order.
+  std::vector<const char*> names(n);
+  std::vector<const void*> inputs(n);
+  std::vector<void*> outputs(n);
+  std::vector<int> ndims(n);
+  std::vector<const int64_t*> shapes(n);
+  for (int i = 0; i < n; i++) {
+    names[i] = m.tensors[i].name.c_str();
+    inputs[i] = ins[1 + i];
+    outputs[i] = n == 1 ? out : outs_tuple[i];
+    ndims[i] = (int)m.tensors[i].dims.size();
+    shapes[i] = ShapeData(m.tensors[i].dims);
+  }
+  std::vector<int> handles(n, -1);
+  if (n == 1) {
+    handles[0] = hvdtpu_enqueue_allreduce(
+        names[0], inputs[0], outputs[0], ndims[0], shapes[0],
+        (int)m.dtype, (int)m.reduce_op_or_root, m.prescale, m.postscale,
+        (int)m.process_set_id);
+  } else {
+    // Returns the enqueued count; unqueued members get handle -1 and
+    // fail in the wait loop below.
+    (void)hvdtpu_enqueue_grouped_allreduce(
+        n, names.data(), inputs.data(), outputs.data(), ndims.data(),
+        shapes.data(), (int)m.dtype, (int)m.reduce_op_or_root, m.prescale,
+        m.postscale, (int)m.process_set_id, handles.data());
+  }
+  for (int h : handles) {
+    auto s = WaitHandle(h, "xla allreduce");
+    if (!s.ok()) DieInXla("allreduce", s.ToString());
+  }
+}
+
+static bool g_registered = [] {
+  xla::CustomCallTargetRegistry::Global()->Register(
+      "hvdtpu_tf_xla_collective",
+      reinterpret_cast<void*>(&hvdtpu_tf_xla_collective), "Host");
+  return true;
+}();
+
+// ---- tf2xla kernels -------------------------------------------------------
+
+static xla::XlaOp MetaConstant(xla::XlaBuilder* b,
+                               const meta::CallMeta& m) {
+  std::vector<uint8_t> bytes = meta::Serialize(m);
+  return xla::ConstantR1<uint8_t>(b, bytes);
+}
+
+class AllreduceXlaKernel : public tensorflow::XlaOpKernel {
+ public:
+  explicit AllreduceXlaKernel(OpKernelConstruction* c)
+      : tensorflow::XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &reduce_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale_factor", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    xla::XlaBuilder* b = ctx->builder();
+    auto shape_or = b->GetShape(ctx->Input(0));
+    OP_REQUIRES_OK(ctx, shape_or.status());
+    const xla::Shape& shape = shape_or.value();
+    meta::CallMeta m;
+    m.kind = 0;
+    m.dtype = ToHvdDtype(ctx->input_type(0));
+    OP_REQUIRES(ctx, m.dtype >= 0,
+                tensorflow::errors::InvalidArgument("unsupported dtype"));
+    m.reduce_op_or_root = reduce_op_;
+    m.process_set_id = process_set_id_;
+    m.prescale = prescale_;
+    m.postscale = postscale_;
+    meta::TensorMeta t;
+    t.dims.assign(shape.dimensions().begin(), shape.dimensions().end());
+    t.name = name_;
+    m.tensors.push_back(std::move(t));
+    auto out = xla::CustomCall(
+        b, "hvdtpu_tf_xla_collective", {MetaConstant(b, m), ctx->Input(0)},
+        shape, /*opaque=*/"", /*has_side_effect=*/true);
+    ctx->SetOutput(0, out);
+  }
+
+ private:
+  std::string name_;
+  int reduce_op_, process_set_id_;
+  float prescale_, postscale_;
+};
+REGISTER_XLA_OP(Name("HvdTpuAllreduce").Device(tensorflow::DEVICE_CPU_XLA_JIT),
+                AllreduceXlaKernel);
+
+class GroupedAllreduceXlaKernel : public tensorflow::XlaOpKernel {
+ public:
+  explicit GroupedAllreduceXlaKernel(OpKernelConstruction* c)
+      : tensorflow::XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_names", &names_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &reduce_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale_factor", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    xla::XlaBuilder* b = ctx->builder();
+    int n = ctx->num_inputs();
+    OP_REQUIRES(ctx, (int)names_.size() == n,
+                tensorflow::errors::InvalidArgument(
+                    "tensor_names size must match input count"));
+    meta::CallMeta m;
+    m.kind = 0;
+    m.dtype = ToHvdDtype(ctx->input_type(0));
+    OP_REQUIRES(ctx, m.dtype >= 0,
+                tensorflow::errors::InvalidArgument("unsupported dtype"));
+    m.reduce_op_or_root = reduce_op_;
+    m.process_set_id = process_set_id_;
+    m.prescale = prescale_;
+    m.postscale = postscale_;
+    std::vector<xla::XlaOp> operands = {xla::XlaOp()};  // meta, below
+    std::vector<xla::Shape> shapes;
+    for (int i = 0; i < n; i++) {
+      auto shape_or = b->GetShape(ctx->Input(i));
+      OP_REQUIRES_OK(ctx, shape_or.status());
+      meta::TensorMeta t;
+      t.dims.assign(shape_or.value().dimensions().begin(),
+                    shape_or.value().dimensions().end());
+      t.name = names_[i];
+      m.tensors.push_back(std::move(t));
+      operands.push_back(ctx->Input(i));
+      shapes.push_back(shape_or.value());
+    }
+    operands[0] = MetaConstant(b, m);
+    if (n == 1) {
+      auto out = xla::CustomCall(b, "hvdtpu_tf_xla_collective", operands,
+                                 shapes[0], "", /*has_side_effect=*/true);
+      ctx->SetOutput(0, out);
+      return;
+    }
+    xla::Shape tuple = xla::ShapeUtil::MakeTupleShape(shapes);
+    auto out = xla::CustomCall(b, "hvdtpu_tf_xla_collective", operands,
+                               tuple, "", /*has_side_effect=*/true);
+    for (int i = 0; i < n; i++) {
+      ctx->SetOutput(i, xla::GetTupleElement(out, i));
+    }
+  }
+
+ private:
+  std::vector<std::string> names_;
+  int reduce_op_, process_set_id_;
+  float prescale_, postscale_;
+};
+REGISTER_XLA_OP(
+    Name("HvdTpuGroupedAllreduce").Device(tensorflow::DEVICE_CPU_XLA_JIT),
+    GroupedAllreduceXlaKernel);
+
+class BroadcastXlaKernel : public tensorflow::XlaOpKernel {
+ public:
+  explicit BroadcastXlaKernel(OpKernelConstruction* c)
+      : tensorflow::XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("root_rank", &root_rank_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    xla::XlaBuilder* b = ctx->builder();
+    auto shape_or = b->GetShape(ctx->Input(0));
+    OP_REQUIRES_OK(ctx, shape_or.status());
+    meta::CallMeta m;
+    m.kind = 1;
+    m.dtype = ToHvdDtype(ctx->input_type(0));
+    OP_REQUIRES(ctx, m.dtype >= 0,
+                tensorflow::errors::InvalidArgument("unsupported dtype"));
+    m.reduce_op_or_root = root_rank_;
+    m.process_set_id = process_set_id_;
+    meta::TensorMeta t;
+    t.dims.assign(shape_or.value().dimensions().begin(),
+                  shape_or.value().dimensions().end());
+    t.name = name_;
+    m.tensors.push_back(std::move(t));
+    auto out = xla::CustomCall(
+        b, "hvdtpu_tf_xla_collective", {MetaConstant(b, m), ctx->Input(0)},
+        shape_or.value(), "", /*has_side_effect=*/true);
+    ctx->SetOutput(0, out);
+  }
+
+ private:
+  std::string name_;
+  int root_rank_, process_set_id_;
+};
+REGISTER_XLA_OP(Name("HvdTpuBroadcast").Device(tensorflow::DEVICE_CPU_XLA_JIT),
+                BroadcastXlaKernel);
+
+}  // namespace hvdtpu_tf

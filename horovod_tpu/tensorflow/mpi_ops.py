@@ -1,14 +1,23 @@
-"""TensorFlow eager collective ops.
+"""TensorFlow collective ops.
 
-Reference analog: ``horovod/tensorflow/mpi_ops.py`` + ``mpi_ops.cc``. The
-reference registers TF custom C ops; here eager tensors round-trip
-through the shared numpy engine (``common/eager_ops``) and graph-mode use
-goes through ``tf.py_function`` — on TPU the in-graph path is
-``horovod_tpu.parallel`` (XLA collectives), mirroring how upstream's
-``xla_mpi_ops.cc`` bridges into XLA programs.
+Reference analog: ``horovod/tensorflow/mpi_ops.py`` + ``mpi_ops.cc`` +
+``xla_mpi_ops.cc``. Two data paths:
+
+- **Native ops** (``csrc/tf_ops.cc`` -> ``libhvdtpu_tf.so``, built on
+  demand): real TF custom ops whose CPU kernels enqueue straight into
+  the core (no Python/GIL hop) and whose tf2xla kernels lower to an XLA
+  custom-call into the same core — collectives work inside
+  ``tf.function(jit_compile=True)``, upstream's HOROVOD_ENABLE_XLA_OPS
+  feature. Used automatically when the library builds/loads.
+- **Numpy fallback**: eager tensors round-trip through the shared numpy
+  engine (``common/eager_ops``); graph mode via ``tf.py_function``.
+  Active when TF headers aren't available (set
+  ``HOROVOD_TF_NATIVE_OPS=0`` to force it).
 """
 
-
+import os
+import subprocess
+import threading
 
 import numpy as np
 import tensorflow as tf
@@ -28,7 +37,15 @@ _basics = eager_ops._basics
 # In elastic mode (HOROVOD_RDZV_ADDR set) init consults the driver's
 # rendezvous for this epoch's rank assignment; static mode unchanged.
 from horovod_tpu.common import elastic as _elastic_init_mod
-init = _elastic_init_mod.init
+
+
+def init(*args, **kwargs):
+    # The native op library must register its tf2xla kernels BEFORE the
+    # first XLA compilation in the process: TF materializes the
+    # XLA_CPU_JIT kernel set once, lazily, and ignores later
+    # registrations. init() is the earliest hook every program calls.
+    _load_native()
+    return _elastic_init_mod.init(*args, **kwargs)
 shutdown = _basics.shutdown
 is_initialized = _basics.is_initialized
 rank = _basics.rank
@@ -46,6 +63,55 @@ stop_timeline = _basics.stop_timeline
 from horovod_tpu.common.auto_name import make_auto_namer
 
 _auto_name = make_auto_namer()
+
+# ---- native op library (build-on-demand, like basics.py for the core) ----
+
+_native_lock = threading.Lock()
+_native = None
+_native_failed = False
+
+
+def _load_native():
+    """tf.load_op_library the native TF ops, building them on first use.
+    Returns the op module or None (numpy fallback)."""
+    global _native, _native_failed
+    if _native is not None or _native_failed:
+        return _native
+    with _native_lock:
+        if _native is not None or _native_failed:
+            return _native
+        if os.environ.get("HOROVOD_TF_NATIVE_OPS", "1") == "0":
+            _native_failed = True
+            return None
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(pkg, "lib", "libhvdtpu_tf.so")
+        try:
+            if not os.path.exists(path):
+                root = os.path.dirname(pkg)
+                if not os.path.exists(os.path.join(root, "Makefile")):
+                    raise FileNotFoundError(path)
+                # Cross-process lock: concurrently launched ranks must not
+                # race the build.
+                import fcntl
+
+                os.makedirs(os.path.join(pkg, "lib"), exist_ok=True)
+                with open(os.path.join(pkg, "lib", ".tf_build_lock"),
+                          "w") as lock:
+                    fcntl.flock(lock, fcntl.LOCK_EX)
+                    if not os.path.exists(path):
+                        import sys
+
+                        subprocess.run(
+                            ["make", "-s", "tf",
+                             f"PYTHON={sys.executable}"],
+                            cwd=root, check=True, capture_output=True)
+            _native = tf.load_op_library(path)
+        except Exception as e:  # missing TF headers, old TF, build break…
+            tf.get_logger().warning(
+                "hvdtpu native TF ops unavailable (%s); falling back to "
+                "the py_function path (no jit_compile support)", e)
+            _native_failed = True
+    return _native
 
 
 
@@ -71,9 +137,25 @@ def _run_numpy(fn, tensor, out_dtype=None):
                           Tout=out_dtype or tensor.dtype)
 
 
+# Dtypes the native op registrations cover (csrc/tf_ops.cc).
+_NATIVE_DTYPES = frozenset((tf.uint8, tf.int8, tf.uint16, tf.int32,
+                            tf.int64, tf.float16, tf.bfloat16, tf.float32,
+                            tf.float64))
+
+
 def allreduce(tensor, name=None, op=Average, prescale_factor=1.0,
               postscale_factor=1.0, process_set_id=0):
     nm = name or _auto_name("allreduce")
+
+    lib = _load_native()
+    if lib is not None:
+        t = tf.convert_to_tensor(tensor)
+        if t.dtype in _NATIVE_DTYPES:
+            return lib.hvd_tpu_allreduce(
+                t, tensor_name=nm, reduce_op=int(op),
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set_id=process_set_id)
 
     def _fn(arr):
         return eager_ops.allreduce_async(
@@ -88,6 +170,32 @@ def grouped_allreduce(tensors, names=None, op=Average, process_set_id=0):
     if names is None:
         base = _auto_name("grouped_allreduce")
         names = [f"{base}.{i}" for i in range(len(tensors))]
+
+    lib = _load_native()
+    if lib is not None and tensors:
+        ts = [tf.convert_to_tensor(t) for t in tensors]
+        if (all(t.dtype == ts[0].dtype for t in ts)
+                and ts[0].dtype in _NATIVE_DTYPES):
+            # One variadic op = one atomic group negotiation, on every
+            # path (eager, graph, jit_compile).
+            return list(lib.hvd_tpu_grouped_allreduce(
+                ts, tensor_names=list(names), reduce_op=int(op),
+                process_set_id=process_set_id))
+        # Mixed dtypes: per-dtype native groups keep the no-GIL path and
+        # negotiate each sub-group atomically.
+        if all(t.dtype in _NATIVE_DTYPES for t in ts):
+            by_dtype = {}
+            for i, t in enumerate(ts):
+                by_dtype.setdefault(t.dtype, []).append(i)
+            out = [None] * len(ts)
+            for idxs in by_dtype.values():
+                red = lib.hvd_tpu_grouped_allreduce(
+                    [ts[i] for i in idxs],
+                    tensor_names=[names[i] for i in idxs],
+                    reduce_op=int(op), process_set_id=process_set_id)
+                for i, r in zip(idxs, red):
+                    out[i] = r
+            return out
 
     def _grouped_np(arrs):
         if arrs and all(a.dtype == arrs[0].dtype for a in arrs):
@@ -129,6 +237,14 @@ def allgather(tensor, name=None, process_set_id=0):
 
 def broadcast(tensor, root_rank, name=None, process_set_id=0):
     nm = name or _auto_name("broadcast")
+
+    lib = _load_native()
+    if lib is not None:
+        t = tf.convert_to_tensor(tensor)
+        if t.dtype in _NATIVE_DTYPES or t.dtype == tf.bool:
+            return lib.hvd_tpu_broadcast(
+                t, tensor_name=nm, root_rank=root_rank,
+                process_set_id=process_set_id)
 
     def _fn(arr):
         return eager_ops.broadcast_async(
