@@ -1,12 +1,20 @@
 """Torch eager collective ops.
 
-Reference analog: ``horovod/torch/mpi_ops.py`` + ``mpi_ops_v2.cc`` — here
-no C extension is needed: CPU torch tensors expose their storage through
-numpy views, so the core's ctypes enqueue writes results straight into
-tensor memory (the in-place ``allreduce_``/``broadcast_`` semantics).
+Reference analog: ``horovod/torch/mpi_ops.py`` + ``mpi_ops_v2.cc`` +
+``adapter_v2.cc``/``ready_event.cc`` (device tensors). Two data paths:
+
+- **CPU tensors** need no C extension: their storage is exposed through
+  numpy views, so the core's ctypes enqueue writes results straight into
+  tensor memory (the in-place ``allreduce_``/``broadcast_`` semantics).
+- **Device (non-CPU) tensors** bridge zero-copy via dlpack into the jax
+  frontend, whose eager collectives run on the ``xla_ici`` device data
+  plane when active — payloads stay in HBM, the reference's
+  adapter_v2/ready_event role. ``HOROVOD_TORCH_DEVICE_OPS=1`` forces
+  this bridge for CPU tensors too (used by tests; jax CPU arrays ride
+  the same code path as TPU ones).
 """
 
-
+import os
 
 import numpy as np
 import torch
@@ -47,6 +55,140 @@ _auto_name = make_auto_namer()
 
 
 
+def _jax_canonicalizes(dtype):
+    """True when jax (x64 disabled, the default) would silently downcast
+    this torch dtype (int64->int32, float64->float32)."""
+    if dtype not in (torch.int64, torch.float64):
+        return False
+    import jax
+
+    return not jax.config.jax_enable_x64
+
+
+def _use_device_bridge(tensor):
+    """Route through the dlpack->jax device plane? Non-CPU tensors
+    always (64-bit dtypes stage through the host instead — see
+    _host_staged_async); CPU tensors when HOROVOD_TORCH_DEVICE_OPS=1
+    (testable on CPU-only images, where jax CPU arrays take the
+    identical path)."""
+    if tensor.device.type != "cpu":
+        return True
+    return (os.environ.get("HOROVOD_TORCH_DEVICE_OPS", "0") == "1"
+            and not _jax_canonicalizes(tensor.dtype))
+
+
+def _np_to_torch(arr):
+    """host numpy array -> torch tensor (bfloat16-aware copy)."""
+    arr = np.asarray(arr)
+    if arr.dtype.name == "bfloat16":
+        return torch.from_numpy(
+            arr.view(np.uint16).copy()).view(torch.bfloat16)
+    return torch.from_numpy(np.array(arr, copy=True))
+
+
+def _to_jax(tensor):
+    """torch tensor -> jax array. dlpack imports the buffer zero-copy,
+    then one device-side copy snapshots the input: the host path's
+    'input snapshot' invariant (mutating the tensor before synchronize
+    must not corrupt the reduction) holds on the bridge too."""
+    import jax
+
+    t = tensor.detach()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    try:
+        return jax.numpy.array(jax.dlpack.from_dlpack(t), copy=True)
+    except Exception:
+        # Exotic layout/device pairing: host round-trip fallback.
+        return jax.numpy.asarray(t.cpu().numpy())
+
+
+def _from_jax(array, like):
+    """jax array -> torch tensor with `like`'s device/dtype."""
+    import torch.utils.dlpack as _tdl
+
+    try:
+        out = _tdl.from_dlpack(array)
+    except Exception:
+        # torch has no device type for this jax buffer (e.g. plain torch
+        # with a TPU-resident array): land on host, then move.
+        out = _np_to_torch(np.asarray(array))
+    if like is not None and out.device != like.device:
+        out = out.to(like.device)
+    return out
+
+
+class _BridgeHandle:
+    """In-flight device-plane op (dlpack->jax). ``dest`` keeps in-place
+    semantics: the result is copied into the original tensor."""
+
+    def __init__(self, inner, dest=None, like=None):
+        self._inner = inner
+        self._dest = dest
+        self._like = like if like is not None else dest
+
+    def poll(self):
+        return self._inner.poll()
+
+    def synchronize(self):
+        out = self._inner.synchronize()
+        res = _from_jax(out, self._like)
+        if self._dest is not None:
+            with torch.no_grad():  # dest may be a requires-grad leaf
+                self._dest.copy_(res.reshape(self._dest.shape))
+            return self._dest
+        return res
+
+
+_plane_probed = False
+
+
+def _bridge_async(kind, tensor, dest, *args, **kwargs):
+    from horovod_tpu.jax import mpi_ops as _jax_ops
+
+    global _plane_probed
+    if not _plane_probed:
+        # First bridged op: give the xla_ici device plane the same
+        # chance to come up as hvd.init() in the jax frontend does (on
+        # TPU, bridged payloads then stay in HBM; off TPU this is a
+        # no-op and the host path serves).
+        _jax_ops._maybe_enable_xla_data_plane()
+        _plane_probed = True
+    if _jax_canonicalizes(tensor.dtype):
+        # jax would downcast int64/float64: stage through the host path
+        # on a CPU clone and copy back, keeping exact-width semantics.
+        host = tensor.detach().cpu()
+        if not host.is_contiguous():
+            host = host.contiguous()
+        inner = _HOST_ASYNC[kind](host, *args, **kwargs)
+        return _HostStagedHandle(inner, dest=dest, like=tensor)
+    inner = getattr(_jax_ops, kind)(_to_jax(tensor), *args, **kwargs)
+    return _BridgeHandle(inner, dest=dest, like=tensor)
+
+
+class _HostStagedHandle:
+    """64-bit-exact device op: ran on a host clone; synchronize copies
+    the result back onto the original device tensor."""
+
+    def __init__(self, inner, dest=None, like=None):
+        self._inner = inner
+        self._dest = dest
+        self._like = like
+
+    def poll(self):
+        return self._inner.poll()
+
+    def synchronize(self):
+        res = self._inner.synchronize()
+        if self._dest is not None:
+            with torch.no_grad():
+                self._dest.copy_(res.reshape(self._dest.shape))
+            return self._dest
+        if self._like is not None and res.device != self._like.device:
+            res = res.to(self._like.device)
+        return res
+
+
 def _np_view(tensor):
     """Contiguous numpy view sharing the CPU tensor's storage."""
     if tensor.device.type != "cpu":
@@ -82,14 +224,20 @@ class Handle:
         if self._like is not None and self._like.dtype == torch.bfloat16:
             import ml_dtypes
 
-            return torch.from_numpy(
-                np_out.view(np.uint16).copy()).view(torch.bfloat16)
-        return torch.from_numpy(np.array(np_out, copy=True))
+            np_out = np_out.view(ml_dtypes.bfloat16)
+        return _np_to_torch(np_out)
 
 
 def allreduce_async_(tensor, name=None, op=Average, prescale_factor=1.0,
                      postscale_factor=1.0, process_set_id=0):
     """In-place async allreduce; result lands in `tensor`'s storage."""
+    if _use_device_bridge(tensor):
+        return _bridge_async(
+            "allreduce_async", tensor, tensor,
+            name or _auto_name("allreduce"), op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set_id=process_set_id)
     view = _np_view(tensor)
     inp = np.array(view, copy=True)  # input snapshot; output aliases tensor
     lib = eager_ops._basics.lib
@@ -141,6 +289,10 @@ def grouped_allreduce_(tensors, names=None, op=Average, process_set_id=0):
 
 
 def allgather_async(tensor, name=None, process_set_id=0):
+    if _use_device_bridge(tensor):
+        return _bridge_async(
+            "allgather_async", tensor, None,
+            name or _auto_name("allgather"), process_set_id=process_set_id)
     view = _np_view(tensor)
     inner = eager_ops.allgather_async(
         np.array(view, copy=True), name or _auto_name("allgather"),
@@ -153,6 +305,10 @@ def allgather(tensor, name=None, process_set_id=0):
 
 
 def broadcast_async_(tensor, root_rank, name=None, process_set_id=0):
+    if _use_device_bridge(tensor):
+        return _bridge_async(
+            "broadcast_async", tensor, tensor, root_rank,
+            name or _auto_name("broadcast"), process_set_id=process_set_id)
     view = _np_view(tensor)
     import ctypes
 
@@ -184,6 +340,10 @@ def broadcast_(tensor, root_rank, name=None, process_set_id=0):
 
 
 def alltoall_async(tensor, splits=None, name=None, process_set_id=0):
+    if _use_device_bridge(tensor):
+        return _bridge_async(
+            "alltoall_async", tensor, None, splits,
+            name or _auto_name("alltoall"), process_set_id=process_set_id)
     view = _np_view(tensor)
     inner = eager_ops.alltoall_async(
         np.array(view, copy=True),
@@ -197,6 +357,11 @@ def alltoall(tensor, splits=None, name=None, process_set_id=0):
 
 
 def reducescatter_async(tensor, name=None, op=Average, process_set_id=0):
+    if _use_device_bridge(tensor):
+        return _bridge_async(
+            "reducescatter_async", tensor, None,
+            name or _auto_name("reducescatter"), op=op,
+            process_set_id=process_set_id)
     view = _np_view(tensor)
     inner = eager_ops.reducescatter_async(
         np.array(view, copy=True), name or _auto_name("reducescatter"),
@@ -228,3 +393,15 @@ def join():
     Returns the last rank to join.
     """
     return eager_ops.join()
+
+
+# Host-path implementations backing _bridge_async's 64-bit staging (the
+# in-place variants write into the staged host clone, which
+# _HostStagedHandle then copies back to the device tensor).
+_HOST_ASYNC = {
+    "allreduce_async": allreduce_async_,
+    "allgather_async": allgather_async,
+    "broadcast_async": broadcast_async_,
+    "alltoall_async": alltoall_async,
+    "reducescatter_async": reducescatter_async,
+}

@@ -80,6 +80,63 @@ def test_torch_ops(size):
     assert run_ranks(_worker_ops, size) == ["ok"] * size
 
 
+def _worker_device_bridge(rank, size):
+    """Device-tensor path (ref adapter_v2.cc/ready_event.cc): tensors
+    bridge via dlpack into the jax frontend's data plane instead of the
+    CPU numpy view. HOROVOD_TORCH_DEVICE_OPS=1 forces the bridge so the
+    path is exercised with jax CPU arrays (identical code path to TPU)."""
+    import torch
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch import mpi_ops
+
+    hvd.init()
+    try:
+        t = torch.full((4, 3), float(rank))
+        assert mpi_ops._use_device_bridge(t)  # env forces it
+
+        # in-place: result lands in the original tensor object
+        out = hvd.allreduce_(t, op=hvd.Sum)
+        assert out is t
+        assert torch.allclose(t, torch.full((4, 3),
+                                            float(sum(range(size)))))
+
+        # out-of-place average
+        r = hvd.allreduce(torch.full((5,), float(rank)))
+        assert torch.allclose(r, torch.full((5,),
+                                            sum(range(size)) / size))
+
+        # bfloat16 survives the dlpack round trip
+        bf = hvd.allreduce(torch.full((8,), float(rank),
+                                      dtype=torch.bfloat16), op=hvd.Sum)
+        assert bf.dtype == torch.bfloat16
+        assert torch.allclose(bf.float(),
+                              torch.full((8,), float(sum(range(size)))))
+
+        # broadcast_ in-place from a non-zero root
+        b = torch.full((3,), float(rank))
+        hvd.broadcast_(b, root_rank=size - 1)
+        assert torch.allclose(b, torch.full((3,), float(size - 1)))
+
+        # allgather with unequal first dims
+        g = hvd.allgather(torch.full((rank + 1, 2), float(rank)))
+        assert g.shape == (sum(range(1, size + 1)), 2)
+
+        # reducescatter
+        rs = hvd.reducescatter(torch.full((size * 2, 3), float(rank + 1)),
+                               op=hvd.Sum)
+        assert torch.allclose(rs, torch.full((2, 3),
+                                             float(sum(range(1, size + 1)))))
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_torch_device_bridge():
+    assert run_ranks(_worker_device_bridge, 2,
+                     env={"HOROVOD_TORCH_DEVICE_OPS": "1"},
+                     timeout=180) == ["ok"] * 2
+
+
 def _make_model(seed):
     import torch
 
