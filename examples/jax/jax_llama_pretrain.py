@@ -47,6 +47,10 @@ def main():
                     default="float32",
                     help="parameter storage dtype (bfloat16 = pure-bf16 "
                          "training, halves param/grad/opt HBM)")
+    ap.add_argument("--master-weights", action="store_true",
+                    help="fp32 master params + fp32 adam moments with "
+                         "bf16 compute (parallel.master_weights) — the "
+                         "numerically safe mixed-precision recipe")
     ap.add_argument("--ep", type=int, default=1, help="expert parallel")
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
     ap.add_argument("--experts", type=int, default=0,
@@ -86,23 +90,45 @@ def main():
         n_experts=args.experts, seq_parallel=args.sp_mode,
         param_dtype=args.param_dtype)
 
+    if args.master_weights:
+        # bf16 compute; params stay fp32 so the master aliases them at
+        # init (no rounding, no transient double tree).
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, dtype="bfloat16",
+                                  param_dtype="float32")
+
     params = llama_init(cfg, jax.random.PRNGKey(0))
     shardings = parallel.shard_params(
         params, mesh, llama_partition_rules(pipeline=args.pp > 1))
     params = apply_sharding(params, shardings)
     tx = optax.adamw(3e-4, weight_decay=0.01)
-    opt_state = tx.init(params)
 
     # Batch must split into dp*fsdp shards AND pp microbatches.
     per = 2 * args.dp * args.fsdp
     batch_size = per if per % max(args.pp, 1) == 0 else per * args.pp
 
-    @jax.jit
-    def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(llama_loss)(params, batch, cfg,
-                                                     mesh)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return loss, optax.apply_updates(params, updates), opt_state
+    if args.master_weights:
+        # fp32 master copy + fp32 moments; bf16 cast feeds compute. The
+        # master inherits the param shardings (cast preserves them).
+        mw = parallel.master_weights(tx)
+        state = mw.init(params)
+
+        @jax.jit
+        def train_step(state, batch):
+            p = mw.compute_params(state)
+            loss, grads = jax.value_and_grad(llama_loss)(p, batch, cfg,
+                                                         mesh)
+            return loss, mw.apply(state, grads)
+    else:
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(llama_loss)(params, batch,
+                                                         cfg, mesh)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return loss, optax.apply_updates(params, updates), opt_state
 
     rng = np.random.RandomState(0)
     for step in range(args.steps):
@@ -112,7 +138,10 @@ def main():
         batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
         batch = jax.device_put(
             batch, named_sharding(mesh, ("data", "fsdp"), "seq"))
-        loss, params, opt_state = train_step(params, opt_state, batch)
+        if args.master_weights:
+            loss, state = train_step(state, batch)
+        else:
+            loss, params, opt_state = train_step(params, opt_state, batch)
         print(f"step {step} mesh={dict(mesh.shape)} loss={float(loss):.4f}")
 
 

@@ -45,3 +45,7 @@ from horovod_tpu.parallel.sharding import (  # noqa: F401
     shard_params,
     with_constraint,
 )
+from horovod_tpu.parallel.precision import (  # noqa: F401
+    MasterWeightsState,
+    master_weights,
+)

@@ -1,0 +1,74 @@
+"""Mixed-precision training: fp32 master weights with bf16 compute.
+
+Reference analog: none in the core reference — upstream Horovod trains
+in the framework's fp32 and only compresses the wire
+(``horovod/torch/compression.py`` ``Compression.fp16``). On a 16G-HBM
+TPU chip, pure-bf16 parameter+optimizer storage is the recipe that fits
+>1B params but leaves adam's second moment in bf16 (a long-horizon
+convergence hazard); this module provides the standard middle point:
+
+- **master**: fp32 copy of every parameter, owned by the train state;
+- **compute**: bf16 (or any ``compute_dtype``) cast of the master used
+  by forward/backward each step — XLA fuses the cast into consumers;
+- **optimizer**: any optax transformation, running in fp32 on the
+  master (moments therefore fp32).
+
+HBM cost per parameter: 4 (master) + inner-state (8 for adam) + the
+transient compute cast, vs 2+4 for pure-bf16 adam — the numerically
+safe recipe for sub-~1B models on one chip, and for any size when
+sharded (fsdp divides all of it).
+
+Usage::
+
+    mw = master_weights(optax.adam(3e-4))
+    state = mw.init(params)             # params any dtype; master = fp32
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, batch):
+        params = mw.compute_params(state)          # bf16 view
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        return loss, mw.apply(state, grads)
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MasterWeightsState(NamedTuple):
+    master: Any   # fp32 parameter pytree
+    inner: Any    # wrapped optimizer state (over the master tree)
+
+
+class MasterWeights(NamedTuple):
+    init: Any
+    compute_params: Any
+    apply: Any
+
+
+def master_weights(tx, compute_dtype=jnp.bfloat16,
+                   master_dtype=jnp.float32):
+    """Wrap an optax optimizer with an fp32 master-parameter loop."""
+
+    def init(params):
+        # fp32 inputs alias through jnp.asarray (no copy, no precision
+        # loss); init from fp32 params when possible.
+        master = jax.tree.map(
+            lambda p: jnp.asarray(p, master_dtype), params)
+        return MasterWeightsState(master=master, inner=tx.init(master))
+
+    def compute_params(state):
+        return jax.tree.map(
+            lambda p: p.astype(compute_dtype), state.master)
+
+    def apply(state, grads):
+        import optax  # deferred: parallel/ stays importable without optax
+
+        grads = jax.tree.map(
+            lambda g: g.astype(master_dtype), grads)
+        updates, inner = tx.update(grads, state.inner, state.master)
+        master = optax.apply_updates(state.master, updates)
+        return MasterWeightsState(master=master, inner=inner)
+
+    return MasterWeights(init=init, compute_params=compute_params,
+                         apply=apply)
