@@ -76,17 +76,30 @@ def main():
     else:
         payload = make(base)
 
+    def materialize(out):
+        # Completion probe must match the plane: on the device plane the
+        # result lives in HBM and np.asarray would time a full
+        # device→host transfer (over a tunnel, dwarfing the collective);
+        # block_until_ready is the honest fence there. The host ring's
+        # result is already host memory.
+        if device_plane:
+            import jax
+
+            jax.block_until_ready(out)
+        else:
+            np.asarray(out)
+
     def one_iter(i):
         t0 = time.perf_counter()
         if args.grouped:
             outs = hvd.grouped_allreduce(
                 parts, names=[f"bench.g{j}" for j in range(args.grouped)],
                 op=hvd.Sum)
-            np.asarray(outs[0])
+            materialize(outs[0])
         else:
             out = hvd.allreduce(payload, name="bench.allreduce",
                                 op=hvd.Sum)
-            np.asarray(out)
+            materialize(out)
         return time.perf_counter() - t0
 
     cold = one_iter(0)
