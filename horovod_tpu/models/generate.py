@@ -27,11 +27,54 @@ from horovod_tpu.parallel.ring_attention import blockwise_attention
 
 def _ffn(h, lp, c):
     """llama.py's shared FFN, aux loss dropped (decode does not train).
-    MoE note: the decode step streams ALL experts through the capacity
-    dispatch (a top-k-only grouped matmul that reads just the selected
-    experts' weights is a known round-2 decode optimization)."""
+    Serves prefill (the full-prompt pass keeps the capacity dispatch so
+    its drop semantics match llama_forward exactly), dense decode, and
+    MoE decode at large batch; small-batch MoE decode uses
+    _moe_ffn_topk."""
     y, _aux = _llama_ffn(h, lp, c, None)
     return y
+
+
+def _moe_ffn_topk(h, lp, c):
+    """Decode-step MoE FFN: gather only the K routed experts' weights
+    per token and run a [K]-grouped matmul — FLOPs and weight-HBM reads
+    scale with top-k, not the expert count E (the capacity dispatch in
+    llama._moe_ffn streams all E experts, which is right for training
+    but E/K-times wasteful for a single decoded token). Routing (same
+    router, same gate normalization) matches llama._moe_ffn; a single
+    token can never overflow per-expert capacity, so no drop divergence.
+
+    The gathers materialize one [K,D,F]-sized weight copy per token, so
+    this path only wins while B*T*K < E — _decode_ffn falls back to the
+    streaming dispatch beyond that (where it reads fewer weight bytes
+    anyway).
+    """
+    dt = c.compute_dtype
+    K = c.n_experts_per_token
+    logits = h.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # [B,T,E]
+    gate_vals, gate_idx = lax.top_k(probs, K)               # [B,T,K]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+    wg = lp["moe_gate"].astype(dt)[gate_idx]                # [B,T,K,D,F]
+    wu = lp["moe_up"].astype(dt)[gate_idx]
+    wd = lp["moe_down"].astype(dt)[gate_idx]                # [B,T,K,F,D]
+    hk = h.astype(dt)
+    gate = jax.nn.silu(jnp.einsum("btd,btkdf->btkf", hk, wg))
+    up = jnp.einsum("btd,btkdf->btkf", hk, wu)
+    y = jnp.einsum("btkf,btkfd->btkd", gate * up, wd)
+    return jnp.einsum("btk,btkd->btd", gate_vals.astype(dt), y)
+
+
+def _decode_ffn(h, lp, c):
+    """FFN for the one-token decode step: dense as-is; MoE via the
+    top-k gather while it touches fewer weights than streaming all E
+    experts (shapes are static, so this is a trace-time choice)."""
+    if c.n_experts > 0:
+        b, t, _ = h.shape
+        if b * t * c.n_experts_per_token < c.n_experts:
+            return _moe_ffn_topk(h, lp, c)
+    return _ffn(h, lp, c)
 
 
 def _layer_kv(h, lp, c, positions):
@@ -64,7 +107,7 @@ def _attend_step(x, lp, c, cache_k, cache_v, pos):
                                q_offset=pos, kv_offset=0)
     x = x + attn.reshape(b, 1, -1) @ lp["wo"].astype(dt)
     h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
-    x = x + _ffn(h, lp, c)
+    x = x + _decode_ffn(h, lp, c)
     return x, cache_k, cache_v
 
 
