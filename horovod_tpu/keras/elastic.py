@@ -71,24 +71,48 @@ class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
         self._state = state
         self._offset = 0
         self._orig_steps = None
+        self._resume_target = None
+        self._stopped_epoch_early = False
         if not hasattr(state, "batch"):
             state.batch = 0
 
     def on_epoch_begin(self, epoch, logs=None):
         self._offset = 0
+        self._resume_target = None
         if epoch == getattr(self._state, "epoch", 0) \
                 and getattr(self._state, "batch", 0) > 0:
             self._offset = self._state.batch
             steps = (self.params or {}).get("steps")
             if steps:
                 self._orig_steps = steps
-                self.params["steps"] = max(steps - self._offset, 1)
+                shortened = max(steps - self._offset, 1)
+                self.params["steps"] = shortened
+                # keras 3 treats params["steps"] as informational and
+                # runs the full epoch anyway; _resume_target enforces
+                # the shortened epoch via an early stop (below).
+                self._resume_target = shortened
 
     def on_train_batch_end(self, batch, logs=None):
         self._state.batch = self._offset + batch + 1
+        if (self._resume_target is not None
+                and batch + 1 >= self._resume_target
+                and not getattr(self.model, "stop_training", False)):
+            # End the resumed epoch after the remaining step count.
+            # keras 3's trainer breaks the batch loop on stop_training,
+            # runs on_epoch_end, and only THEN checks stop_training to
+            # leave the epoch loop — clearing the flag in our
+            # on_epoch_end therefore ends just this epoch, not training.
+            self._stopped_epoch_early = True
+            self.model.stop_training = True
 
     def on_epoch_end(self, epoch, logs=None):
         self._state.batch = 0
+        if self._stopped_epoch_early:
+            # Ours, not a user callback's (we checked stop_training was
+            # False before setting it): clear so later epochs still run.
+            self._stopped_epoch_early = False
+            self.model.stop_training = False
+        self._resume_target = None
         if self._orig_steps is not None:
             # params is shared by the whole CallbackList; un-shrink it so
             # epochs after the resumed one see the true step count.

@@ -82,6 +82,122 @@ hvd.shutdown()
 """
 
 
+MIDEPOCH_WORKER_SRC = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tensorflow as tf
+import horovod_tpu.tensorflow.keras as hvd
+
+tmp = {tmp!r}
+hvd.init()
+tf.keras.utils.set_random_seed(1234)
+
+model = tf.keras.Sequential([
+    tf.keras.layers.Dense(8, input_shape=(4,)),
+    tf.keras.layers.Dense(1),
+])
+model.compile(optimizer=hvd.DistributedOptimizer(
+    tf.keras.optimizers.SGD(0.01)), loss="mse")
+state = hvd.elastic.KerasState(model, batch=0, epoch=0)
+
+rng = np.random.RandomState(0)
+x = rng.rand(64, 4).astype("float32")
+y = rng.rand(64, 1).astype("float32")
+# 32 samples/rank at batch_size 8 -> 4 steps per epoch.
+
+
+class SuicideMidEpoch(tf.keras.callbacks.Callback):
+    def on_train_batch_begin(self, batch, logs=None):
+        # Die in epoch 1 entering batch 2 (state.batch == 2 committed).
+        if getattr(state, "epoch", 0) == 1 and state.batch == 2:
+            try:
+                fd = os.open(os.path.join(tmp, "suicide.lock"),
+                             os.O_CREAT | os.O_EXCL)
+                os.close(fd)
+                os._exit(17)
+            except FileExistsError:
+                pass
+
+
+class BatchCounter(tf.keras.callbacks.Callback):
+    def on_epoch_begin(self, epoch, logs=None):
+        self._n = 0
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._n += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        with open(os.path.join(tmp, "epochs.log"), "a") as f:
+            f.write(json.dumps(
+                {{"rank": hvd.rank(), "epoch": int(epoch),
+                  "batches": self._n,
+                  "after_kill": os.path.exists(
+                      os.path.join(tmp, "suicide.lock"))}}) + "\\n")
+
+
+@hvd.elastic.run
+def train(state):
+    cbs = [SuicideMidEpoch(),
+           hvd.elastic.UpdateBatchStateCallback(state),
+           hvd.elastic.UpdateEpochStateCallback(state),
+           BatchCounter(),
+           hvd.elastic.CommitStateCallback(state, batches_per_commit=1)]
+    model.fit(x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()],
+              batch_size=8, epochs=3, initial_epoch=state.epoch,
+              callbacks=cbs, verbose=0)
+
+train(state)
+wid = os.environ["HOROVOD_WORKER_ID"].replace(":", "_")
+with open(os.path.join(tmp, "done." + wid), "w") as f:
+    json.dump({{"epoch": int(state.epoch), "size": hvd.size()}}, f)
+hvd.shutdown()
+"""
+
+
+def test_keras_elastic_midepoch_resume_runs_remaining_steps(tmp_path):
+    """A worker dies two batches into epoch 1; recovery must finish that
+    epoch with the REMAINING two steps, not re-run all four (the keras 3
+    params['steps'] workaround — UpdateBatchStateCallback's early epoch
+    stop)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(MIDEPOCH_WORKER_SRC.format(repo=REPO,
+                                                 tmp=str(tmp_path)))
+    env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+           "TF_CPP_MIN_LOG_LEVEL": "3"}
+    driver = ElasticDriver(FixedHosts({"localhost": 2}),
+                           [sys.executable, str(worker.resolve())],
+                           min_np=2, max_np=2, poll_interval=0.5,
+                           start_timeout=120, env=env)
+    driver.start()
+    try:
+        rc = driver.wait_for_completion()
+    finally:
+        driver.stop()
+    assert rc == 0
+    assert (tmp_path / "suicide.lock").exists()
+
+    done = sorted(tmp_path.glob("done.*"))
+    assert len(done) == 2, [p.name for p in done]
+    for p in done:
+        r = json.loads(p.read_text())
+        assert r["epoch"] == 3 and r["size"] == 2
+
+    entries = [json.loads(ln) for ln in
+               (tmp_path / "epochs.log").read_text().splitlines()]
+    # The resumed epoch 1 must have run exactly the 2 remaining steps on
+    # every rank that completed it after the kill; full epochs run 4.
+    resumed = [e for e in entries if e["epoch"] == 1 and e["after_kill"]]
+    assert resumed, entries
+    assert all(e["batches"] == 2 for e in resumed), resumed
+    for later in (2,):
+        full = [e for e in entries if e["epoch"] == later]
+        assert full and all(e["batches"] == 4 for e in full), entries
+
+
 def test_keras_elastic_kill_and_recover(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER_SRC.format(repo=REPO, tmp=str(tmp_path)))
