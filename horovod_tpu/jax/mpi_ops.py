@@ -159,16 +159,21 @@ class Handle:
         return jnp.asarray(out)
 
 
-def _device_path(tensor, op=None):
+def _device_path(tensor, op=None, process_set_id=0):
     """Route through the xla_ici data plane? Only for accelerator-resident
-    jax arrays, and not Adasum (its per-tensor combine stays on host)."""
-    return (xla_ici.active() and isinstance(tensor, jax.Array)
-            and op != Adasum)
+    jax arrays. Adasum runs on-device for power-of-two float groups (the
+    recursive-doubling XLA program); otherwise it keeps the host path."""
+    if not (xla_ici.active() and isinstance(tensor, jax.Array)):
+        return False
+    if op == Adasum:
+        return xla_ici.adasum_device_supported(process_set_id,
+                                               tensor.dtype)
+    return True
 
 
 def allreduce_async(tensor, name=None, op=Average, prescale_factor=1.0,
                     postscale_factor=1.0, process_set_id=0):
-    if _device_path(tensor, op):
+    if _device_path(tensor, op, process_set_id):
         return xla_ici.enqueue_device(
             "allreduce", tensor, name or _auto_name("allreduce"),
             reduce_op=op, prescale_factor=prescale_factor,
@@ -196,7 +201,8 @@ def grouped_allreduce_async(tensors, names=None, op=Average,
     if names is None:
         base = _auto_name("grouped_allreduce")
         names = [f"{base}.{i}" for i in range(len(tensors))]
-    if (tensors and all(_device_path(t, op) for t in tensors)
+    if (tensors and all(_device_path(t, op, process_set_id)
+                        for t in tensors)
             and len({t.dtype for t in tensors}) == 1):
         return xla_ici.grouped_allreduce_device(
             tensors, names, reduce_op=op, prescale_factor=prescale_factor,
@@ -279,7 +285,9 @@ def alltoall(tensor, splits=None, name=None, process_set_id=0):
 
 def reducescatter_async(tensor, name=None, op=Average, prescale_factor=1.0,
                         postscale_factor=1.0, process_set_id=0):
-    if _device_path(tensor, op):
+    # Adasum reducescatter stays on the host path (the device program's
+    # reducer has no per-shard adasum form).
+    if op != Adasum and _device_path(tensor, op):
         return xla_ici.enqueue_device(
             "reducescatter", tensor, name or _auto_name("reducescatter"),
             reduce_op=op, prescale_factor=prescale_factor,

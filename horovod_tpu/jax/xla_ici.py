@@ -375,6 +375,33 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                          out_specs=out_specs, check_vma=False)
 
 
+def _adasum_combine(x, group):
+    """Adasum on the device plane: recursive-doubling pairwise combine
+    (reference analog: ops/adasum_gpu_operations.cc — a first-class GPU
+    op upstream; here one XLA program over the mesh axis).
+
+    Each stage pairs rank i with i^d and combines
+    ``(1 - a.b/(2|a|^2)) a + (1 - a.b/(2|b|^2)) b`` — symmetric, so both
+    partners hold the identical result and distances double. Dots run in
+    fp32 regardless of payload dtype (csrc/adasum.cc does the same for
+    half/bf16). Requires a power-of-two group; the frontend falls back
+    to the host path otherwise.
+    """
+    orig = x.dtype
+    x = x.astype(jnp.float32)
+    d = 1
+    while d < group:
+        y = lax.ppermute(x, "hvd", [(i, i ^ d) for i in range(group)])
+        dot = jnp.sum(x * y)
+        na = jnp.sum(x * x)
+        nb = jnp.sum(y * y)
+        ca = jnp.where(na > 0, 1.0 - dot / (2.0 * na), 1.0)
+        cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * nb), 1.0)
+        x = ca * x + cb * y
+        d *= 2
+    return x.astype(orig)
+
+
 def _reduce(buf, reduce_op, group):
     if reduce_op in (ReduceOp.SUM, ReduceOp.AVERAGE):
         red = lax.psum(buf, "hvd")
@@ -407,12 +434,24 @@ def _build_allreduce(mesh, group, shapes, reduce_op, scales):
             if pre != 1.0:
                 x = x * np.asarray(pre, x.dtype)
             parts.append(x)
-        buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        red = _reduce(buf, reduce_op, group)
+        if reduce_op == ReduceOp.ADASUM:
+            # Adasum is PER-TENSOR (the dot products that make it scale
+            # insensitive are per-gradient — reference
+            # Adasum::DispatchFusedAllreduce walks the fusion buffer
+            # tensor-by-tensor), so no concat fusion here; the stages
+            # still share the program and its collectives schedule.
+            red_parts = [_adasum_combine(p, group) for p in parts]
+        else:
+            buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            red = _reduce(buf, reduce_op, group)
+            red_parts = None
         outs, off = [], 0
-        for sz, (_, post) in zip(sizes, scales):
-            o = lax.slice_in_dim(red, off, off + sz)
-            off += sz
+        for i, (sz, (_, post)) in enumerate(zip(sizes, scales)):
+            if red_parts is not None:
+                o = red_parts[i]
+            else:
+                o = lax.slice_in_dim(red, off, off + sz)
+                off += sz
             if post != 1.0:
                 o = o * np.asarray(post, o.dtype)
             outs.append(o)
@@ -540,6 +579,15 @@ def alltoall_group_size(process_set_id):
     """Member count of the set, for the frontend's equal-split check."""
     members = process_sets.members_of(int(process_set_id))
     return len(members) if members else 0
+
+
+def adasum_device_supported(process_set_id, dtype):
+    """Device-plane Adasum serves power-of-two float groups; anything
+    else rides the host path (csrc/adasum.cc)."""
+    if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return False
+    n = alltoall_group_size(process_set_id)
+    return n > 0 and (n & (n - 1)) == 0
 
 
 def enqueue_device(kind, array, name, reduce_op=ReduceOp.SUM,

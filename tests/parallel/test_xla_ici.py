@@ -306,11 +306,16 @@ def _worker_adasum_host_fallback(rank, size):
     import jax.numpy as jnp
 
     import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import mpi_ops, xla_ici
 
     hvd.init()
     try:
-        # Adasum stays on the host ring; result is still a jax array.
-        out = hvd.allreduce(jnp.full((4,), float(rank + 1)), op=hvd.Adasum)
+        # Non-power-of-two group: Adasum stays on the host ring; the
+        # result is still a jax array.
+        x = jnp.full((4,), float(rank + 1))
+        assert not mpi_ops._device_path(x, hvd.Adasum)
+        assert not xla_ici.adasum_device_supported(0, x.dtype)
+        out = hvd.allreduce(x, op=hvd.Adasum)
         assert out.shape == (4,)
         assert np.isfinite(np.asarray(out)).all()
         return "ok"
@@ -319,8 +324,62 @@ def _worker_adasum_host_fallback(rank, size):
 
 
 def test_adasum_falls_back_to_host_path():
-    assert run_ranks(_worker_adasum_host_fallback, 2, env=_ENV,
-                     timeout=240) == ["ok"] * 2
+    # 3 ranks: not a power of two -> host path serves Adasum.
+    assert run_ranks(_worker_adasum_host_fallback, 3, env=_ENV,
+                     timeout=240) == ["ok"] * 3
+
+
+def _adasum_ref(vectors):
+    """Recursive-doubling Adasum in numpy (same pairing as the device
+    program and csrc/adasum.cc's closed form)."""
+    vs = [np.asarray(v, np.float64) for v in vectors]
+    n, d = len(vs), 1
+    while d < n:
+        nxt = list(vs)
+        for i in range(n):
+            a, b = vs[i], vs[i ^ d]
+            dot, na, nb = (a * b).sum(), (a * a).sum(), (b * b).sum()
+            ca = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
+            cb = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
+            nxt[i] = ca * a + cb * b
+        vs, d = nxt, d * 2
+    return vs[0]
+
+
+def _worker_adasum_device(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import mpi_ops
+
+    hvd.init()
+    try:
+        x = jnp.arange(1.0, 7.0) * (rank + 1) - rank  # rank-distinct
+        assert mpi_ops._device_path(x, hvd.Adasum)  # pow2 float group
+        out = hvd.allreduce(x, op=hvd.Adasum, name="adasum.dev")
+        ref = _adasum_ref([np.arange(1.0, 7.0) * (r + 1) - r
+                           for r in range(size)])
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+        # orthogonal gradients behave like sum; identical ones like mean
+        e = jnp.zeros((4,)).at[rank % 4].set(1.0)
+        if size <= 4:
+            out = hvd.allreduce(e, op=hvd.Adasum, name="adasum.orth")
+            np.testing.assert_allclose(
+                np.asarray(out),
+                np.sum([np.eye(4)[r % 4] for r in range(size)], axis=0),
+                rtol=1e-5)
+        same = jnp.full((3,), 2.0)
+        out = hvd.allreduce(same, op=hvd.Adasum, name="adasum.same")
+        np.testing.assert_allclose(np.asarray(out), 2.0, rtol=1e-5)
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_adasum_device_plane():
+    for size in (2, 4):
+        assert run_ranks(_worker_adasum_device, size, env=_ENV,
+                         timeout=240) == ["ok"] * size
 
 
 def _worker_timeline_xprof(rank, size):
