@@ -108,6 +108,26 @@ static tensorflow::Status WaitHandle(int handle, const char* what) {
   return tensorflow::OkStatus();
 }
 
+// Wait WITHOUT releasing on success: managed-result ops (allgather /
+// reducescatter / alltoall) still need the handle to query/copy the
+// core-owned output buffer; callers release after the copy.
+static tensorflow::Status WaitManaged(int handle, const char* what) {
+  if (handle < 0) {
+    return tensorflow::errors::Internal(
+        what, ": HorovodInternalError: enqueue failed "
+        "(is horovod initialized?)");
+  }
+  int rc = hvdtpu_wait(handle);
+  if (rc != 0) {
+    const char* msg = hvdtpu_error_string(handle);
+    std::string reason = msg ? msg : "collective failed";
+    hvdtpu_release(handle);
+    return tensorflow::errors::Internal(what, ": HorovodInternalError: ",
+                                        reason);
+  }
+  return tensorflow::OkStatus();
+}
+
 // ---- op registrations -----------------------------------------------------
 
 REGISTER_OP("HvdTpuAllreduce")
@@ -147,6 +167,54 @@ REGISTER_OP("HvdTpuBroadcast")
     .Attr("root_rank: int")
     .Attr("process_set_id: int = 0")
     .SetShapeFn(tensorflow::shape_inference::UnchangedShape);
+
+// Output rank matches the input; the first dim is only known at run
+// time (ragged allgather / rank-dependent reducescatter share).
+static tensorflow::Status UnknownFirstDimShape(
+    tensorflow::shape_inference::InferenceContext* c) {
+  tensorflow::shape_inference::ShapeHandle in = c->input(0);
+  if (!c->RankKnown(in) || c->Rank(in) == 0) {
+    c->set_output(0, c->UnknownShape());
+    return tensorflow::OkStatus();
+  }
+  tensorflow::shape_inference::ShapeHandle out;
+  TF_RETURN_IF_ERROR(c->ReplaceDim(in, 0, c->UnknownDim(), &out));
+  c->set_output(0, out);
+  return tensorflow::OkStatus();
+}
+
+REGISTER_OP("HvdTpuAllgather")
+    .Input("tensor: T")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, uint16, int32, int64, half, bfloat16, float, "
+          "double, bool}")
+    .Attr("tensor_name: string")
+    .Attr("process_set_id: int = 0")
+    .SetShapeFn(UnknownFirstDimShape);
+
+REGISTER_OP("HvdTpuReducescatter")
+    .Input("tensor: T")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, uint16, int32, int64, half, bfloat16, float, "
+          "double}")
+    .Attr("tensor_name: string")
+    .Attr("reduce_op: int = 0")
+    .Attr("prescale_factor: float = 1.0")
+    .Attr("postscale_factor: float = 1.0")
+    .Attr("process_set_id: int = 0")
+    .SetShapeFn(UnknownFirstDimShape);
+
+// splits: per-destination-rank first-dim row counts; EMPTY means equal
+// split. Output first dim depends on peers' splits -> unknown.
+REGISTER_OP("HvdTpuAlltoall")
+    .Input("tensor: T")
+    .Input("splits: int64")
+    .Output("output: T")
+    .Attr("T: {uint8, int8, uint16, int32, int64, half, bfloat16, float, "
+          "double, bool}")
+    .Attr("tensor_name: string")
+    .Attr("process_set_id: int = 0")
+    .SetShapeFn(UnknownFirstDimShape);
 
 // ---- CPU kernels ----------------------------------------------------------
 
@@ -269,6 +337,148 @@ class GroupedAllreduceCpuKernel : public AsyncOpKernel {
 REGISTER_KERNEL_BUILDER(
     Name("HvdTpuGroupedAllreduce").Device(tensorflow::DEVICE_CPU),
     GroupedAllreduceCpuKernel);
+
+// Managed-result completion: the core owns the output buffer (its size
+// depends on peers), so the waiter allocates the TF output from the
+// result shape and copies once.
+static void WaitManagedAsync(OpKernelContext* c,
+                             AsyncOpKernel::DoneCallback done, int handle,
+                             const char* what) {
+  std::thread([c, done = std::move(done), handle, what]() {
+    auto s = WaitManaged(handle, what);
+    if (!s.ok()) {
+      c->SetStatus(s);
+      done();
+      return;
+    }
+    int nd = hvdtpu_result_ndim(handle);
+    std::vector<int64_t> dims(nd > 0 ? nd : 0);
+    if (nd > 0) hvdtpu_result_shape(handle, dims.data());
+    tensorflow::TensorShape shape;
+    for (int64_t d : dims) shape.AddDim(d);
+    Tensor* out = nullptr;
+    auto as = c->allocate_output(0, shape, &out);
+    if (!as.ok()) {
+      hvdtpu_release(handle);
+      c->SetStatus(as);
+      done();
+      return;
+    }
+    if (hvdtpu_result_copy(
+            handle, const_cast<char*>(out->tensor_data().data()),
+            (int64_t)out->tensor_data().size()) != 0) {
+      c->SetStatus(tensorflow::errors::Internal(
+          what, ": HorovodInternalError: result copy failed"));
+    }
+    hvdtpu_release(handle);
+    done();
+  }).detach();
+}
+
+class AllgatherCpuKernel : public AsyncOpKernel {
+ public:
+  explicit AllgatherCpuKernel(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void ComputeAsync(OpKernelContext* c, DoneCallback done) override {
+    const Tensor& in = c->input(0);
+    int dtype = ToHvdDtype(in.dtype());
+    OP_REQUIRES_ASYNC(
+        c, dtype >= 0,
+        tensorflow::errors::InvalidArgument("unsupported dtype"), done);
+    auto dims = in.shape().dim_sizes();
+    std::vector<int64_t> shape(dims.begin(), dims.end());
+    int h = hvdtpu_enqueue_allgather(
+        name_.c_str(), in.tensor_data().data(), (int)shape.size(),
+        ShapeData(shape), dtype, process_set_id_);
+    WaitManagedAsync(c, std::move(done), h, "HvdTpuAllgather");
+  }
+
+ private:
+  std::string name_;
+  int process_set_id_;
+};
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAllgather").Device(tensorflow::DEVICE_CPU),
+                        AllgatherCpuKernel);
+
+class ReducescatterCpuKernel : public AsyncOpKernel {
+ public:
+  explicit ReducescatterCpuKernel(OpKernelConstruction* c)
+      : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &reduce_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale_factor", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void ComputeAsync(OpKernelContext* c, DoneCallback done) override {
+    const Tensor& in = c->input(0);
+    int dtype = ToHvdDtype(in.dtype());
+    OP_REQUIRES_ASYNC(
+        c, dtype >= 0,
+        tensorflow::errors::InvalidArgument("unsupported dtype"), done);
+    auto dims = in.shape().dim_sizes();
+    std::vector<int64_t> shape(dims.begin(), dims.end());
+    int h = hvdtpu_enqueue_reducescatter(
+        name_.c_str(), in.tensor_data().data(), (int)shape.size(),
+        ShapeData(shape), dtype, reduce_op_, prescale_, postscale_,
+        process_set_id_);
+    WaitManagedAsync(c, std::move(done), h, "HvdTpuReducescatter");
+  }
+
+ private:
+  std::string name_;
+  int reduce_op_, process_set_id_;
+  float prescale_, postscale_;
+};
+REGISTER_KERNEL_BUILDER(
+    Name("HvdTpuReducescatter").Device(tensorflow::DEVICE_CPU),
+    ReducescatterCpuKernel);
+
+class AlltoallCpuKernel : public AsyncOpKernel {
+ public:
+  explicit AlltoallCpuKernel(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void ComputeAsync(OpKernelContext* c, DoneCallback done) override {
+    const Tensor& in = c->input(0);
+    const Tensor& splits = c->input(1);
+    int dtype = ToHvdDtype(in.dtype());
+    OP_REQUIRES_ASYNC(
+        c, dtype >= 0,
+        tensorflow::errors::InvalidArgument("unsupported dtype"), done);
+    auto dims = in.shape().dim_sizes();
+    std::vector<int64_t> shape(dims.begin(), dims.end());
+    // Empty splits tensor = equal split across the set; otherwise the
+    // core reads exactly process-set-size entries.
+    const int64_t* sp = nullptr;
+    if (splits.NumElements() > 0) {
+      int group = hvdtpu_process_set_size(process_set_id_);
+      OP_REQUIRES_ASYNC(
+          c, (int64_t)splits.NumElements() == (int64_t)group,
+          tensorflow::errors::InvalidArgument(
+              "alltoall splits must have one entry per process-set "
+              "member (", group, "), got ", splits.NumElements()),
+          done);
+      sp = splits.flat<int64_t>().data();
+    }
+    int h = hvdtpu_enqueue_alltoall(
+        name_.c_str(), in.tensor_data().data(), (int)shape.size(),
+        ShapeData(shape), dtype, sp, process_set_id_);
+    WaitManagedAsync(c, std::move(done), h, "HvdTpuAlltoall");
+  }
+
+ private:
+  std::string name_;
+  int process_set_id_;
+};
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAlltoall").Device(tensorflow::DEVICE_CPU),
+                        AlltoallCpuKernel);
 
 class BroadcastCpuKernel : public AsyncOpKernel {
  public:
@@ -433,6 +643,49 @@ extern "C" void hvdtpu_tf_xla_collective(void* out, const void** ins) {
   void** outs_tuple = reinterpret_cast<void**>(out);
   if (!hvdtpu_is_initialized()) {
     DieInXla("collective", "horovod is not initialized");
+  }
+  if (m.kind >= 2) {
+    // Managed-result ops (2=allgather, 3=reducescatter, 4=alltoall):
+    // tensors[0] = input dims, tensors[1] = the COMPILE-TIME output
+    // dims; the core-owned result must match them exactly (in-jit these
+    // ops require shapes to be equal across ranks — XLA buffers are
+    // static).
+    const auto& tin = m.tensors[0];
+    const auto& tout = m.tensors[1];
+    int h = -1;
+    if (m.kind == 2) {
+      h = hvdtpu_enqueue_allgather(
+          tin.name.c_str(), ins[1], (int)tin.dims.size(),
+          ShapeData(tin.dims), (int)m.dtype, (int)m.process_set_id);
+    } else if (m.kind == 3) {
+      h = hvdtpu_enqueue_reducescatter(
+          tin.name.c_str(), ins[1], (int)tin.dims.size(),
+          ShapeData(tin.dims), (int)m.dtype, (int)m.reduce_op_or_root,
+          m.prescale, m.postscale, (int)m.process_set_id);
+    } else {
+      h = hvdtpu_enqueue_alltoall(
+          tin.name.c_str(), ins[1], (int)tin.dims.size(),
+          ShapeData(tin.dims), (int)m.dtype, nullptr,
+          (int)m.process_set_id);
+    }
+    auto s = WaitManaged(h, "xla managed collective");
+    if (!s.ok()) DieInXla("managed collective", s.ToString());
+    int64_t expect =
+        hvdtpu::DataTypeSize((hvdtpu::DataType)m.dtype);
+    for (int64_t d : tout.dims) expect *= d;
+    if (hvdtpu_result_size_bytes(h) != expect) {
+      hvdtpu_release(h);
+      DieInXla("managed collective",
+               "result shape differs from the compiled one — in-jit "
+               "allgather/reducescatter/alltoall require identical "
+               "shapes on every rank");
+    }
+    if (hvdtpu_result_copy(h, out, expect) != 0) {
+      hvdtpu_release(h);
+      DieInXla("managed collective", "result copy failed");
+    }
+    hvdtpu_release(h);
+    return;
   }
   if (m.kind == 1) {  // broadcast (always n==1)
     void* dst = n == 1 ? out : outs_tuple[0];
@@ -639,5 +892,175 @@ class BroadcastXlaKernel : public tensorflow::XlaOpKernel {
 };
 REGISTER_XLA_OP(Name("HvdTpuBroadcast").Device(tensorflow::DEVICE_CPU_XLA_JIT),
                 BroadcastXlaKernel);
+
+}  // namespace hvdtpu_tf
+
+namespace hvdtpu_tf {
+
+// In-jit managed-result kernels: output shapes are derived at COMPILE
+// time from the process-set geometry (the core is initialized before
+// the first XLA compile — init() loads this library), so these require
+// shape-identical inputs on every rank; the callback verifies at run
+// time and dies loudly on divergence.
+
+static meta::CallMeta ManagedMeta(int64_t kind, int dtype, int ps,
+                                  const std::string& name,
+                                  const std::vector<int64_t>& in_dims,
+                                  const std::vector<int64_t>& out_dims) {
+  meta::CallMeta m;
+  m.kind = kind;
+  m.dtype = dtype;
+  m.process_set_id = ps;
+  meta::TensorMeta tin;
+  tin.dims = in_dims;
+  tin.name = name;
+  m.tensors.push_back(std::move(tin));
+  meta::TensorMeta tout;
+  tout.dims = out_dims;
+  m.tensors.push_back(std::move(tout));
+  return m;
+}
+
+class AllgatherXlaKernel : public tensorflow::XlaOpKernel {
+ public:
+  explicit AllgatherXlaKernel(OpKernelConstruction* c)
+      : tensorflow::XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    xla::XlaBuilder* b = ctx->builder();
+    auto shape_or = b->GetShape(ctx->Input(0));
+    OP_REQUIRES_OK(ctx, shape_or.status());
+    int group = hvdtpu_process_set_size(process_set_id_);
+    OP_REQUIRES(ctx, group > 0,
+                tensorflow::errors::FailedPrecondition(
+                    "hvd.init() must run before jit-compiling allgather"));
+    std::vector<int64_t> in_dims(shape_or.value().dimensions().begin(),
+                                 shape_or.value().dimensions().end());
+    std::vector<int64_t> out_dims =
+        in_dims.empty() ? std::vector<int64_t>{group} : in_dims;
+    if (!in_dims.empty()) out_dims[0] *= group;
+    int dtype = ToHvdDtype(ctx->input_type(0));
+    OP_REQUIRES(ctx, dtype >= 0,
+                tensorflow::errors::InvalidArgument("unsupported dtype"));
+    meta::CallMeta m = ManagedMeta(2, dtype, process_set_id_, name_,
+                                   in_dims, out_dims);
+    xla::Shape out_shape = xla::ShapeUtil::MakeShape(
+        shape_or.value().element_type(), out_dims);
+    auto res = xla::CustomCall(
+        b, "hvdtpu_tf_xla_collective", {MetaConstant(b, m), ctx->Input(0)},
+        out_shape, "", /*has_side_effect=*/true);
+    ctx->SetOutput(0, res);
+  }
+
+ private:
+  std::string name_;
+  int process_set_id_;
+};
+REGISTER_XLA_OP(Name("HvdTpuAllgather").Device(tensorflow::DEVICE_CPU_XLA_JIT),
+                AllgatherXlaKernel);
+
+class ReducescatterXlaKernel : public tensorflow::XlaOpKernel {
+ public:
+  explicit ReducescatterXlaKernel(OpKernelConstruction* c)
+      : tensorflow::XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &reduce_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale_factor", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    xla::XlaBuilder* b = ctx->builder();
+    auto shape_or = b->GetShape(ctx->Input(0));
+    OP_REQUIRES_OK(ctx, shape_or.status());
+    int group = hvdtpu_process_set_size(process_set_id_);
+    int pos = hvdtpu_process_set_rank(process_set_id_);
+    OP_REQUIRES(ctx, group > 0 && pos >= 0,
+                tensorflow::errors::FailedPrecondition(
+                    "hvd.init() must run before jit-compiling "
+                    "reducescatter"));
+    std::vector<int64_t> in_dims(shape_or.value().dimensions().begin(),
+                                 shape_or.value().dimensions().end());
+    OP_REQUIRES(ctx, !in_dims.empty(),
+                tensorflow::errors::InvalidArgument(
+                    "reducescatter needs a rank>=1 tensor"));
+    // First dim split as evenly as possible, remainder to lower member
+    // positions — the host-ring convention (csrc/operations.cc).
+    int64_t q = in_dims[0] / group, rem = in_dims[0] % group;
+    std::vector<int64_t> out_dims = in_dims;
+    out_dims[0] = q + (pos < rem ? 1 : 0);
+    int dtype = ToHvdDtype(ctx->input_type(0));
+    OP_REQUIRES(ctx, dtype >= 0,
+                tensorflow::errors::InvalidArgument("unsupported dtype"));
+    meta::CallMeta m = ManagedMeta(3, dtype, process_set_id_, name_,
+                                   in_dims, out_dims);
+    m.reduce_op_or_root = reduce_op_;
+    m.prescale = prescale_;
+    m.postscale = postscale_;
+    xla::Shape out_shape = xla::ShapeUtil::MakeShape(
+        shape_or.value().element_type(), out_dims);
+    auto res = xla::CustomCall(
+        b, "hvdtpu_tf_xla_collective", {MetaConstant(b, m), ctx->Input(0)},
+        out_shape, "", /*has_side_effect=*/true);
+    ctx->SetOutput(0, res);
+  }
+
+ private:
+  std::string name_;
+  int reduce_op_, process_set_id_;
+  float prescale_, postscale_;
+};
+REGISTER_XLA_OP(
+    Name("HvdTpuReducescatter").Device(tensorflow::DEVICE_CPU_XLA_JIT),
+    ReducescatterXlaKernel);
+
+class AlltoallXlaKernel : public tensorflow::XlaOpKernel {
+ public:
+  explicit AlltoallXlaKernel(OpKernelConstruction* c)
+      : tensorflow::XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &process_set_id_));
+  }
+
+  void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    xla::XlaBuilder* b = ctx->builder();
+    auto shape_or = b->GetShape(ctx->Input(0));
+    OP_REQUIRES_OK(ctx, shape_or.status());
+    OP_REQUIRES(ctx, ctx->InputShape(1).num_elements() == 0,
+                tensorflow::errors::InvalidArgument(
+                    "in-jit alltoall supports equal splits only (pass "
+                    "splits=None)"));
+    int group = hvdtpu_process_set_size(process_set_id_);
+    OP_REQUIRES(ctx, group > 0,
+                tensorflow::errors::FailedPrecondition(
+                    "hvd.init() must run before jit-compiling alltoall"));
+    std::vector<int64_t> in_dims(shape_or.value().dimensions().begin(),
+                                 shape_or.value().dimensions().end());
+    OP_REQUIRES(ctx, !in_dims.empty() && in_dims[0] % group == 0,
+                tensorflow::errors::InvalidArgument(
+                    "alltoall first dim must be divisible by the group "
+                    "size"));
+    int dtype = ToHvdDtype(ctx->input_type(0));
+    OP_REQUIRES(ctx, dtype >= 0,
+                tensorflow::errors::InvalidArgument("unsupported dtype"));
+    // Equal splits: the output shape equals the input's.
+    meta::CallMeta m = ManagedMeta(4, dtype, process_set_id_, name_,
+                                   in_dims, in_dims);
+    auto res = xla::CustomCall(
+        b, "hvdtpu_tf_xla_collective", {MetaConstant(b, m), ctx->Input(0)},
+        shape_or.value(), "", /*has_side_effect=*/true);
+    ctx->SetOutput(0, res);
+  }
+
+ private:
+  std::string name_;
+  int process_set_id_;
+};
+REGISTER_XLA_OP(Name("HvdTpuAlltoall").Device(tensorflow::DEVICE_CPU_XLA_JIT),
+                AlltoallXlaKernel);
 
 }  // namespace hvdtpu_tf

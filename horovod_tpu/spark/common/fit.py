@@ -10,12 +10,20 @@ import os
 
 import numpy as np
 
+from horovod_tpu.spark.common.reader import (  # noqa: F401 — re-exported
+    AsyncParquetBatchReader,
+    ParquetBatchReader,
+    frame_to_xy,
+    staged_bytes,
+)
+
 
 def _df_to_parquet(df, path, num_proc):
     df.repartition(max(num_proc or 1, 1)).write.mode("overwrite").parquet(path)
 
 
 def _load_np(path, feature_cols, label_cols, rank, size):
+    """Whole-shard in-memory load (small datasets / inmemory_cache_all)."""
     import pandas as pd
 
     files = sorted(
@@ -24,12 +32,18 @@ def _load_np(path, feature_cols, label_cols, rank, size):
     shard = files[rank::size] or files  # every rank needs >=1 shard
     frames = [pd.read_parquet(f) for f in shard]
     df = pd.concat(frames, ignore_index=True)
-    x = np.stack([np.asarray(v, np.float32)
-                  for v in df[list(feature_cols)].to_numpy().tolist()])
-    if x.ndim == 3 and x.shape[1] == 1:
-        x = x[:, 0]
-    y = df[list(label_cols)].to_numpy().astype(np.float32)
-    return x, y
+    return frame_to_xy(df, feature_cols, label_cols)
+
+
+def use_streaming(inmemory_cache_all, train_path):
+    """Stream from parquet, or load the shard in memory? Mirrors the
+    reference's inmemory_cache_all petastorm switch; None decides by the
+    staged size so big datasets never materialize whole."""
+    if inmemory_cache_all is not None:
+        return not inmemory_cache_all
+    threshold_mb = float(os.environ.get(
+        "HOROVOD_SPARK_INMEMORY_THRESHOLD_MB", "512"))
+    return staged_bytes(train_path) > threshold_mb * (1 << 20)
 
 
 def stage_train_data(estimator, df):

@@ -26,6 +26,10 @@ class EstimatorParams:
         random_seed=None,
         run_id=None,
         train_steps_per_epoch=None,
+        # Reference param (petastorm estimators): True loads the whole
+        # shard into memory, False streams from parquet; None = auto by
+        # staged size (HOROVOD_SPARK_INMEMORY_THRESHOLD_MB, default 512).
+        inmemory_cache_all=None,
         validation_steps_per_epoch=None,
     )
 

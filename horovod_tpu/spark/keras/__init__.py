@@ -13,10 +13,12 @@ parquet path — the store's data is plain parquet either way.
 import numpy as np
 
 from horovod_tpu.spark.common.fit import (  # noqa: F401 — re-exported
+    AsyncParquetBatchReader,
     _df_to_parquet,
     _load_np,
     collect_trained,
     stage_train_data,
+    use_streaming,
 )
 from horovod_tpu.spark.common.params import EstimatorParams
 
@@ -43,24 +45,53 @@ class KerasEstimator(EstimatorParams):
             train_path=train_path, feature_cols=tuple(self.feature_cols),
             label_cols=tuple(self.label_cols), batch_size=self.batch_size,
             epochs=self.epochs, loss=self.loss, metrics=tuple(self.metrics),
-            verbose=self.verbose)
+            verbose=self.verbose,
+            streaming=use_streaming(self.inmemory_cache_all, train_path),
+            shuffle=bool(self.shuffle_buffer_size),
+            seed=self.random_seed or 0)
 
         def train():
             import horovod_tpu.keras as hvd
 
             hvd.init()
             model = _deserialize_keras(model_bytes, custom_objects)
-            x, y = _load_np(params["train_path"], params["feature_cols"],
-                            params["label_cols"], hvd.rank(), hvd.size())
             opt = hvd.DistributedOptimizer(model.optimizer)
             model.compile(optimizer=opt, loss=params["loss"] or model.loss,
                           metrics=list(params["metrics"]))
             callbacks = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
                          hvd.callbacks.MetricAverageCallback()]
-            hist = model.fit(x, y, batch_size=params["batch_size"],
-                             epochs=params["epochs"],
-                             verbose=params["verbose"] if hvd.rank() == 0
-                             else 0, callbacks=callbacks)
+            verbose = params["verbose"] if hvd.rank() == 0 else 0
+            if params["streaming"]:
+                # Large dataset: stream batches from the staged parquet
+                # with background prefetch instead of materializing the
+                # whole shard (the petastorm reader path).
+                reader = AsyncParquetBatchReader(
+                    path=params["train_path"],
+                    feature_cols=params["feature_cols"],
+                    label_cols=params["label_cols"],
+                    batch_size=params["batch_size"],
+                    rank=hvd.rank(), size=hvd.size(),
+                    shuffle=params["shuffle"], seed=params["seed"])
+                steps = len(reader)
+
+                def gen():
+                    while True:
+                        yield from iter(reader)
+
+                try:
+                    hist = model.fit(gen(), steps_per_epoch=steps,
+                                     epochs=params["epochs"],
+                                     verbose=verbose, callbacks=callbacks)
+                finally:
+                    reader.close_async_loader()
+            else:
+                x, y = _load_np(params["train_path"],
+                                params["feature_cols"],
+                                params["label_cols"], hvd.rank(),
+                                hvd.size())
+                hist = model.fit(x, y, batch_size=params["batch_size"],
+                                 epochs=params["epochs"],
+                                 verbose=verbose, callbacks=callbacks)
             if hvd.rank() == 0:
                 return _serialize_keras(model), hist.history
             return None

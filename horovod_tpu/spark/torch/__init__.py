@@ -11,7 +11,9 @@ import io
 import numpy as np
 
 from horovod_tpu.spark.common.fit import (
+    AsyncParquetBatchReader,
     _load_np,
+    use_streaming,
     collect_trained,
     stage_train_data,
 )
@@ -48,7 +50,10 @@ class TorchEstimator(EstimatorParams):
         params = dict(
             train_path=train_path, feature_cols=tuple(self.feature_cols),
             label_cols=tuple(self.label_cols), batch_size=self.batch_size,
-            epochs=self.epochs)
+            epochs=self.epochs,
+            streaming=use_streaming(self.inmemory_cache_all, train_path),
+            shuffle=bool(self.shuffle_buffer_size),
+            seed=self.random_seed or 0)
 
         def train():
             import torch
@@ -57,10 +62,6 @@ class TorchEstimator(EstimatorParams):
 
             hvd.init()
             model = _deserialize_torch(model_bytes)
-            x, y = _load_np(params["train_path"], params["feature_cols"],
-                            params["label_cols"], hvd.rank(), hvd.size())
-            x_t = torch.from_numpy(np.ascontiguousarray(x))
-            y_t = torch.from_numpy(np.ascontiguousarray(y))
             base_opt = (opt_factory(model.parameters()) if opt_factory
                         else torch.optim.SGD(model.parameters(), lr=0.01))
             opt = hvd.DistributedOptimizer(
@@ -68,15 +69,40 @@ class TorchEstimator(EstimatorParams):
             hvd.broadcast_parameters(model.state_dict(), root_rank=0)
             hvd.broadcast_optimizer_state(base_opt, root_rank=0)
             criterion = loss_fn or torch.nn.MSELoss()
-            n = x_t.shape[0]
-            bs = params["batch_size"]
-            for _ in range(params["epochs"]):
-                for i in range(0, n, bs):
-                    opt.zero_grad()
-                    out = model(x_t[i:i + bs])
-                    loss = criterion(out, y_t[i:i + bs])
-                    loss.backward()
-                    opt.step()
+
+            def step(xb, yb):
+                opt.zero_grad()
+                loss = criterion(model(torch.from_numpy(
+                    np.ascontiguousarray(xb))),
+                    torch.from_numpy(np.ascontiguousarray(yb)))
+                loss.backward()
+                opt.step()
+
+            if params["streaming"]:
+                # Stream + prefetch from the staged parquet (petastorm
+                # reader path) instead of materializing the shard.
+                reader = AsyncParquetBatchReader(
+                    path=params["train_path"],
+                    feature_cols=params["feature_cols"],
+                    label_cols=params["label_cols"],
+                    batch_size=params["batch_size"],
+                    rank=hvd.rank(), size=hvd.size(),
+                    shuffle=params["shuffle"], seed=params["seed"])
+                try:
+                    for _ in range(params["epochs"]):
+                        for xb, yb in reader:
+                            step(xb, yb)
+                finally:
+                    reader.close_async_loader()
+            else:
+                x, y = _load_np(params["train_path"],
+                                params["feature_cols"],
+                                params["label_cols"], hvd.rank(),
+                                hvd.size())
+                bs = params["batch_size"]
+                for _ in range(params["epochs"]):
+                    for i in range(0, len(x), bs):
+                        step(x[i:i + bs], y[i:i + bs])
             if hvd.rank() == 0:
                 return _serialize_torch(model)
             return None

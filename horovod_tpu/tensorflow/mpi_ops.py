@@ -143,19 +143,30 @@ _NATIVE_DTYPES = frozenset((tf.uint8, tf.int8, tf.uint16, tf.int32,
                             tf.float64))
 
 
+def _native_op(tensor, allow_bool=False):
+    """(lib, tensor) when the native op library serves this input, else
+    None — the shared gate for every collective's dispatch."""
+    lib = _load_native()
+    if lib is None:
+        return None
+    t = tf.convert_to_tensor(tensor)
+    if t.dtype in _NATIVE_DTYPES or (allow_bool and t.dtype == tf.bool):
+        return lib, t
+    return None
+
+
 def allreduce(tensor, name=None, op=Average, prescale_factor=1.0,
               postscale_factor=1.0, process_set_id=0):
     nm = name or _auto_name("allreduce")
 
-    lib = _load_native()
-    if lib is not None:
-        t = tf.convert_to_tensor(tensor)
-        if t.dtype in _NATIVE_DTYPES:
-            return lib.hvd_tpu_allreduce(
-                t, tensor_name=nm, reduce_op=int(op),
-                prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor,
-                process_set_id=process_set_id)
+    native = _native_op(tensor)
+    if native:
+        lib, t = native
+        return lib.hvd_tpu_allreduce(
+            t, tensor_name=nm, reduce_op=int(op),
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set_id=process_set_id)
 
     def _fn(arr):
         return eager_ops.allreduce_async(
@@ -228,6 +239,12 @@ def grouped_allreduce(tensors, names=None, op=Average, process_set_id=0):
 def allgather(tensor, name=None, process_set_id=0):
     nm = name or _auto_name("allgather")
 
+    native = _native_op(tensor, allow_bool=True)
+    if native:
+        lib, t = native
+        return lib.hvd_tpu_allgather(t, tensor_name=nm,
+                                     process_set_id=process_set_id)
+
     def _fn(arr):
         return eager_ops.allgather_async(
             arr, nm, process_set_id=process_set_id).synchronize()
@@ -238,13 +255,12 @@ def allgather(tensor, name=None, process_set_id=0):
 def broadcast(tensor, root_rank, name=None, process_set_id=0):
     nm = name or _auto_name("broadcast")
 
-    lib = _load_native()
-    if lib is not None:
-        t = tf.convert_to_tensor(tensor)
-        if t.dtype in _NATIVE_DTYPES or t.dtype == tf.bool:
-            return lib.hvd_tpu_broadcast(
-                t, tensor_name=nm, root_rank=root_rank,
-                process_set_id=process_set_id)
+    native = _native_op(tensor, allow_bool=True)
+    if native:
+        lib, t = native
+        return lib.hvd_tpu_broadcast(
+            t, tensor_name=nm, root_rank=root_rank,
+            process_set_id=process_set_id)
 
     def _fn(arr):
         return eager_ops.broadcast_async(
@@ -257,6 +273,14 @@ def broadcast(tensor, root_rank, name=None, process_set_id=0):
 def alltoall(tensor, splits=None, name=None, process_set_id=0):
     nm = name or _auto_name("alltoall")
 
+    native = _native_op(tensor, allow_bool=True)
+    if native:
+        lib, t = native
+        sp = (tf.constant([], dtype=tf.int64) if splits is None
+              else tf.cast(tf.convert_to_tensor(splits), tf.int64))
+        return lib.hvd_tpu_alltoall(t, sp, tensor_name=nm,
+                                    process_set_id=process_set_id)
+
     def _fn(arr):
         return eager_ops.alltoall_async(
             arr, None if splits is None else np.asarray(splits), nm,
@@ -267,6 +291,13 @@ def alltoall(tensor, splits=None, name=None, process_set_id=0):
 
 def reducescatter(tensor, name=None, op=Average, process_set_id=0):
     nm = name or _auto_name("reducescatter")
+
+    native = _native_op(tensor)
+    if native:
+        lib, t = native
+        return lib.hvd_tpu_reducescatter(
+            t, tensor_name=nm, reduce_op=int(op),
+            process_set_id=process_set_id)
 
     def _fn(arr):
         return eager_ops.reducescatter_async(

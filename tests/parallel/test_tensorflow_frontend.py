@@ -142,6 +142,48 @@ def test_jit_compiled_train_step():
     assert results == ["ok"] * 2 or results == ["skip"] * 2
 
 
+def _worker_jit_managed_ops(rank, size):
+    """allgather / reducescatter / alltoall inside jit_compile=True
+    (equal shapes across ranks — the static-shape contract of the
+    compiled path; ragged stays on the eager/graph CPU kernels)."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+    from horovod_tpu.tensorflow import mpi_ops
+
+    hvd.init()
+    try:
+        if mpi_ops._load_native() is None:
+            return "skip"
+
+        @tf.function(jit_compile=True)
+        def step(t):
+            g = hvd.allgather(t, name="jm.ag")              # [2s, 3]
+            rs = hvd.reducescatter(g, op=hvd.Sum, name="jm.rs")  # [2, 3]
+            a = hvd.alltoall(t, name="jm.a2a")              # [2, 3]
+            return g, rs, a
+
+        t = tf.fill([2, 3], float(rank + 1))
+        g, rs, a = step(t)
+        exp_g = np.repeat(np.arange(1, size + 1, dtype=np.float32), 2)
+        np.testing.assert_allclose(g.numpy(), exp_g[:, None] * np.ones(3))
+        # summed-then-scattered: this rank holds its own 2 rows x size
+        np.testing.assert_allclose(rs.numpy(), size * (rank + 1))
+        # equal-split alltoall: one row from every rank
+        exp_a = np.repeat(np.arange(1, size + 1, dtype=np.float32),
+                          2 // size if size <= 2 else 1)[:2]
+        np.testing.assert_allclose(np.sort(a.numpy()[:, 0]),
+                                   np.sort(exp_a))
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_jit_managed_collectives():
+    results = run_ranks(_worker_jit_managed_ops, 2, env=_TF_ENV,
+                        timeout=300)
+    assert results == ["ok"] * 2 or results == ["skip"] * 2
+
+
 def _worker_keras(rank, size):
     import tensorflow as tf
     import horovod_tpu.keras as hvd
