@@ -57,6 +57,13 @@ def init():
     """Initialize the core; in elastic mode, first obtain this epoch's rank
     assignment from the driver's rendezvous server."""
     if not _is_elastic():
+        # Bare-mpirun launch (no horovodrun, no env): derive identity and
+        # the rendezvous endpoint from the MPI world if one is running
+        # (reference analog: initializing on an existing MPI_COMM_WORLD,
+        # common/mpi/mpi_context.cc).
+        from horovod_tpu.common.mpi_bootstrap import maybe_bootstrap_from_mpi
+
+        maybe_bootstrap_from_mpi()
         _basics.init()
         return
     from horovod_tpu.runner.elastic.rendezvous import RendezvousClient

@@ -1,0 +1,85 @@
+"""Bootstrap rank/size/rendezvous from an existing MPI communicator.
+
+Reference analog: ``horovod/common/mpi/mpi_context.cc`` — upstream can
+initialize on an already-running MPI world (scripts launched by a plain
+``mpirun`` with no horovodrun, or embedding frameworks that own
+MPI_COMM_WORLD). Ours keeps the TCP control plane, but derives the
+worker identity and rendezvous endpoint from the communicator:
+
+- rank/size come from the comm;
+- local_rank/local_size from a shared-memory split (``Split_type``);
+- cross_rank/cross_size from a split keyed by local_rank;
+- rank 0 opens the controller port and broadcasts ``host:port``.
+
+Engaged by ``hvd.init()`` only when HOROVOD_RANK is absent from the env
+(a launcher always sets it) and ``mpi4py`` is importable with MPI
+already initialized — exactly the "running under mpirun without
+horovodrun" case.
+"""
+
+import os
+import socket
+
+
+def _mpi_comm():
+    """The world communicator, or None when this process isn't an MPI
+    program (mpi4py missing, or MPI not initialized)."""
+    try:
+        from mpi4py import MPI
+    except Exception:
+        return None
+    try:
+        if not MPI.Is_initialized():
+            return None
+        return MPI.COMM_WORLD
+    except Exception:
+        return None
+
+
+def maybe_bootstrap_from_mpi(environ=os.environ):
+    """Populate HOROVOD_* env from MPI when launched by bare mpirun.
+
+    Returns True when the env was populated from a communicator.
+    No-op (False) when a launcher already provided HOROVOD_RANK, or when
+    there is no usable MPI world.
+    """
+    if "HOROVOD_RANK" in environ:
+        return False
+    comm = _mpi_comm()
+    if comm is None or comm.Get_size() <= 1:
+        return False
+    from mpi4py import MPI
+
+    rank, size = comm.Get_rank(), comm.Get_size()
+    local_comm = comm.Split_type(MPI.COMM_TYPE_SHARED, key=rank)
+    local_rank = local_comm.Get_rank()
+    local_size = local_comm.Get_size()
+    cross_comm = comm.Split(color=local_rank, key=rank)
+    cross_rank = cross_comm.Get_rank()
+    cross_size = cross_comm.Get_size()
+
+    # Rank 0 owns the controller endpoint; everyone learns it via bcast
+    # (the comm plays the role horovodrun's env injection plays).
+    if rank == 0:
+        port = environ.get("HOROVOD_CONTROLLER_PORT")
+        if not port:
+            s = socket.socket()
+            s.bind(("", 0))
+            port = str(s.getsockname()[1])
+            s.close()
+        endpoint = (socket.gethostname(), port)
+    else:
+        endpoint = None
+    host, port = comm.bcast(endpoint, root=0)
+
+    environ.update({
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(local_rank),
+        "HOROVOD_LOCAL_SIZE": str(local_size),
+        "HOROVOD_CROSS_RANK": str(cross_rank),
+        "HOROVOD_CROSS_SIZE": str(cross_size),
+        "HOROVOD_CONTROLLER_ADDR": host,
+        "HOROVOD_CONTROLLER_PORT": str(port),
+    })
+    return True

@@ -129,3 +129,64 @@ def test_check_build_cli(capsys):
     assert "Available Frameworks" in out
     assert "[X] JAX" in out
     assert "xla_ici device plane" in out
+
+
+def test_mpi_bootstrap_from_fake_world(monkeypatch):
+    """Bare-mpirun init path (ref mpi_context.cc): HOROVOD_* env derives
+    from the MPI world when no launcher provided it. mpi4py is absent in
+    this image, so a faithful fake comm stands in."""
+    import sys
+    import types
+
+    class _Comm:
+        def __init__(self, rank, size):
+            self._rank, self._size = rank, size
+
+        def Get_rank(self):
+            return self._rank
+
+        def Get_size(self):
+            return self._size
+
+        def Split_type(self, kind, key=0):
+            return _Comm(self._rank % 2, 2)   # 2 ranks per fake host
+
+        def Split(self, color, key=0):
+            return _Comm(self._rank // 2, self._size // 2)
+
+        def bcast(self, obj, root=0):
+            # single process stands in for all ranks; rank 0's endpoint
+            return ("node0", "29999") if obj is None else obj
+
+    fake = types.ModuleType("mpi4py")
+    fake.MPI = types.SimpleNamespace(
+        Is_initialized=lambda: True,
+        COMM_TYPE_SHARED=object(),
+        COMM_WORLD=_Comm(3, 4),
+    )
+    monkeypatch.setitem(sys.modules, "mpi4py", fake)
+
+    from horovod_tpu.common.mpi_bootstrap import maybe_bootstrap_from_mpi
+
+    env = {}
+    assert maybe_bootstrap_from_mpi(env) is True
+    assert env["HOROVOD_RANK"] == "3" and env["HOROVOD_SIZE"] == "4"
+    assert env["HOROVOD_LOCAL_RANK"] == "1"
+    assert env["HOROVOD_LOCAL_SIZE"] == "2"
+    assert env["HOROVOD_CROSS_RANK"] == "1"
+    assert env["HOROVOD_CROSS_SIZE"] == "2"
+    assert env["HOROVOD_CONTROLLER_ADDR"] == "node0"
+    assert env["HOROVOD_CONTROLLER_PORT"] == "29999"
+
+    # a launcher-set env wins — the bootstrap must not touch it
+    env2 = {"HOROVOD_RANK": "0"}
+    assert maybe_bootstrap_from_mpi(env2) is False
+    assert env2 == {"HOROVOD_RANK": "0"}
+
+
+def test_mpi_bootstrap_noop_without_mpi():
+    from horovod_tpu.common.mpi_bootstrap import maybe_bootstrap_from_mpi
+
+    env = {}
+    assert maybe_bootstrap_from_mpi(env) is False  # no mpi4py installed
+    assert env == {}
