@@ -246,7 +246,8 @@ class HorovodBasics:
     CAPABILITY_NAMES = (
         "gloo_built", "gloo_enabled", "mpi_built", "mpi_enabled",
         "mpi_threads_supported", "xla_built", "xla_enabled", "nccl_built",
-        "cuda_built", "rocm_built", "ccl_built", "ddl_built")
+        "cuda_built", "rocm_built", "ccl_built", "ddl_built",
+        "tf_native_ops_built")
 
     # Reference analog: horovod/common/basics.py mpi_built/gloo_built/
     # nccl_built/... — scripts probe these to pick code paths. Mapping:
@@ -297,6 +298,25 @@ class HorovodBasics:
 
         mod = sys.modules.get("horovod_tpu.jax.xla_ici")
         return bool(mod is not None and mod.active())
+
+    def tf_native_ops_built(self, verbose=False):
+        """Whether the native TF op library (CPU kernels + in-jit XLA
+        custom-calls, csrc/tf_ops.cc) exists or can build here."""
+        del verbose
+        import os
+
+        lib = os.path.join(os.path.dirname(_lib_path()), "libhvdtpu_tf.so")
+        if os.path.exists(lib):
+            return True
+        try:
+            import tensorflow as tf  # noqa: F401
+
+            # Headers present = buildable on demand.
+            return os.path.isdir(os.path.join(
+                os.path.dirname(tf.__file__), "include", "tensorflow",
+                "compiler", "tf2xla"))
+        except ImportError:
+            return False
 
     def nccl_built(self, verbose=False):
         del verbose
