@@ -103,8 +103,12 @@ def _named_params_for(model, base_opt, opt_idx):
 
 
 def train_protocol_model(model, x_t, y_t, batch_size, epochs,
-                         distributed=True):
+                         distributed=True, batch_iter=None):
     """Run the lightning-protocol training loop on host tensors.
+
+    ``batch_iter``: optional callable returning one epoch's iterable of
+    ``(x, y)`` numpy batches (the streaming parquet reader path); when
+    given, ``x_t``/``y_t``/``batch_size`` are ignored.
 
     With ``distributed=True`` every optimizer is wrapped in
     ``horovod_tpu.torch.DistributedOptimizer`` and parameters/optimizer
@@ -145,12 +149,23 @@ def train_protocol_model(model, x_t, y_t, batch_size, epochs,
         set().union(*(s for j, s in enumerate(ids_per_opt) if j != oi))
         if multi else set()
         for oi in range(len(ids_per_opt))]
-    n = x_t.shape[0]
     model.train()
     global_step = 0
+
+    def epoch_batches():
+        if batch_iter is not None:
+            import numpy as np
+            import torch
+
+            for xb, yb in batch_iter():
+                yield (torch.from_numpy(np.ascontiguousarray(xb)),
+                       torch.from_numpy(np.ascontiguousarray(yb)))
+            return
+        for i in range(0, x_t.shape[0], batch_size):
+            yield (x_t[i:i + batch_size], y_t[i:i + batch_size])
+
     for epoch in range(epochs):
-        for batch_idx, i in enumerate(range(0, n, batch_size)):
-            batch = (x_t[i:i + batch_size], y_t[i:i + batch_size])
+        for batch_idx, batch in enumerate(epoch_batches()):
             for oi, opt in enumerate(opts):
                 with contextlib.ExitStack() as stack:
                     if multi:
@@ -190,10 +205,15 @@ class LightningEstimator(EstimatorParams):
         # Locals only below (see KerasEstimator): the closure must not
         # capture self.
         model_bytes = _serialize_torch(self.model)
+        from horovod_tpu.spark.common.fit import use_streaming
+
         params = dict(
             train_path=train_path, feature_cols=tuple(self.feature_cols),
             label_cols=tuple(self.label_cols), batch_size=self.batch_size,
-            epochs=self.epochs)
+            epochs=self.epochs,
+            streaming=use_streaming(self.inmemory_cache_all, train_path),
+            shuffle=bool(self.shuffle_buffer_size),
+            seed=self.random_seed or 0)
 
         def train():
             import numpy as np
@@ -203,12 +223,33 @@ class LightningEstimator(EstimatorParams):
 
             hvd.init()
             model = _deserialize_torch(model_bytes)
-            x, y = _load_np(params["train_path"], params["feature_cols"],
-                            params["label_cols"], hvd.rank(), hvd.size())
-            train_protocol_model(
-                model, torch.from_numpy(np.ascontiguousarray(x)),
-                torch.from_numpy(np.ascontiguousarray(y)),
-                params["batch_size"], params["epochs"])
+            if params["streaming"]:
+                from horovod_tpu.spark.common.fit import \
+                    AsyncParquetBatchReader
+
+                reader = AsyncParquetBatchReader(
+                    path=params["train_path"],
+                    feature_cols=params["feature_cols"],
+                    label_cols=params["label_cols"],
+                    batch_size=params["batch_size"],
+                    rank=hvd.rank(), size=hvd.size(),
+                    shuffle=params["shuffle"], seed=params["seed"])
+                try:
+                    train_protocol_model(
+                        model, None, None, params["batch_size"],
+                        params["epochs"],
+                        batch_iter=lambda: iter(reader))
+                finally:
+                    reader.close_async_loader()
+            else:
+                x, y = _load_np(params["train_path"],
+                                params["feature_cols"],
+                                params["label_cols"], hvd.rank(),
+                                hvd.size())
+                train_protocol_model(
+                    model, torch.from_numpy(np.ascontiguousarray(x)),
+                    torch.from_numpy(np.ascontiguousarray(y)),
+                    params["batch_size"], params["epochs"])
             if hvd.rank() == 0:
                 return _serialize_torch(model)
             return None

@@ -119,3 +119,43 @@ def test_empty_staging_rejected(tmp_path):
     with pytest.raises(ValueError, match="row group"):
         ParquetBatchReader(str(tmp_path / "empty"), ("features",),
                            ("label",), batch_size=4)
+
+
+def test_lightning_protocol_streams_from_reader(tmp_path):
+    """train_protocol_model's batch_iter path (the lightning estimator's
+    streaming mode) learns the same function as the in-memory path."""
+    import torch
+
+    from horovod_tpu.spark.common.reader import ParquetBatchReader
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    path = _stage(tmp_path, n_rows=96, n_files=2, row_group_size=16)
+
+    class Lit(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            torch.manual_seed(7)
+            self.net = torch.nn.Linear(4, 1)
+
+        def forward(self, x):
+            return self.net(x)
+
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            return torch.nn.functional.mse_loss(self(x), y)
+
+        def configure_optimizers(self):
+            return torch.optim.SGD(self.parameters(), lr=0.01)
+
+    reader = ParquetBatchReader(path, ("features",), ("label",),
+                                batch_size=16)
+    streamed = train_protocol_model(
+        Lit(), None, None, 16, epochs=2, distributed=False,
+        batch_iter=lambda: iter(reader))
+
+    x, y = _load_np(path, ("features",), ("label",), 0, 1)
+    inmem = train_protocol_model(
+        Lit(), torch.from_numpy(x), torch.from_numpy(y), 16, epochs=2,
+        distributed=False)
+    for a, b in zip(streamed.parameters(), inmem.parameters()):
+        assert torch.allclose(a, b, atol=1e-6)
