@@ -184,6 +184,47 @@ def test_jit_managed_collectives():
     assert results == ["ok"] * 2 or results == ["skip"] * 2
 
 
+def _worker_native_process_sets(rank, size):
+    """process_set_id flows through the native TF ops (eager + jit):
+    evens/odds each allreduce only within their set."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+    from horovod_tpu.tensorflow import mpi_ops
+
+    hvd.init()
+    try:
+        if mpi_ops._load_native() is None:
+            return "skip"
+        evens = hvd.add_process_set([r for r in range(size) if r % 2 == 0])
+        odds = hvd.add_process_set([r for r in range(size) if r % 2 == 1])
+        hvd.barrier()
+        mine = evens if rank % 2 == 0 else odds
+        peers = [r for r in range(size) if r % 2 == rank % 2]
+
+        out = hvd.allreduce(tf.fill([3], float(rank + 1)), op=hvd.Sum,
+                            name="nps.ar", process_set_id=mine)
+        np.testing.assert_allclose(out.numpy(),
+                                   sum(r + 1 for r in peers))
+
+        @tf.function(jit_compile=True)
+        def j(t):
+            return hvd.allreduce(t, op=hvd.Sum, name="nps.jar",
+                                 process_set_id=mine) * 2.0
+
+        out = j(tf.fill([2], float(rank + 1)))
+        np.testing.assert_allclose(out.numpy(),
+                                   2.0 * sum(r + 1 for r in peers))
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_native_ops_process_sets():
+    results = run_ranks(_worker_native_process_sets, 4, env=_TF_ENV,
+                        timeout=300)
+    assert results == ["ok"] * 4 or results == ["skip"] * 4
+
+
 def _worker_keras(rank, size):
     import tensorflow as tf
     import horovod_tpu.keras as hvd
