@@ -14,6 +14,10 @@ from setuptools.command.build_py import build_py
 class BuildWithCore(build_py):
     def run(self):
         subprocess.run(["make", "core"], check=True)
+        # Best effort: the TF op library needs the installed TF's
+        # headers; when absent it builds on demand at first use instead.
+        subprocess.run(["make", "tf"], check=False,
+                       capture_output=True)
         super().run()
 
 
