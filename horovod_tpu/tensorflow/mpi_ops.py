@@ -80,7 +80,10 @@ def _load_native():
     with _native_lock:
         if _native is not None or _native_failed:
             return _native
-        if os.environ.get("HOROVOD_TF_NATIVE_OPS", "1") == "0":
+        # HOROVOD_ENABLE_XLA_OPS is the reference's flag name for the
+        # in-jit op path; honor =0 as an opt-out alias.
+        if (os.environ.get("HOROVOD_TF_NATIVE_OPS", "1") == "0"
+                or os.environ.get("HOROVOD_ENABLE_XLA_OPS", "1") == "0"):
             _native_failed = True
             return None
         pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

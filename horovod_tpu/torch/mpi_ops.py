@@ -143,17 +143,23 @@ class _BridgeHandle:
 _plane_probed = False
 
 
+def _probe_device_plane():
+    """First bridged op: give the xla_ici device plane the same chance
+    to come up as hvd.init() in the jax frontend does (on TPU, bridged
+    payloads then stay in HBM; off TPU this is a no-op and the host
+    path serves)."""
+    global _plane_probed
+    if not _plane_probed:
+        from horovod_tpu.jax import mpi_ops as _jax_ops
+
+        _jax_ops._maybe_enable_xla_data_plane()
+        _plane_probed = True
+
+
 def _bridge_async(kind, tensor, dest, *args, **kwargs):
     from horovod_tpu.jax import mpi_ops as _jax_ops
 
-    global _plane_probed
-    if not _plane_probed:
-        # First bridged op: give the xla_ici device plane the same
-        # chance to come up as hvd.init() in the jax frontend does (on
-        # TPU, bridged payloads then stay in HBM; off TPU this is a
-        # no-op and the host path serves).
-        _jax_ops._maybe_enable_xla_data_plane()
-        _plane_probed = True
+    _probe_device_plane()
     if _jax_canonicalizes(tensor.dtype):
         # jax would downcast int64/float64: stage through the host path
         # on a CPU clone and copy back, keeping exact-width semantics.
@@ -279,6 +285,20 @@ def grouped_allreduce_async_(tensors, names=None, op=Average,
     if names is None:
         base = _auto_name("grouped_allreduce")
         names = [f"{base}.{i}" for i in range(len(tensors))]
+    if (tensors and all(_use_device_bridge(t) for t in tensors)
+            and len({t.dtype for t in tensors}) == 1
+            and not _jax_canonicalizes(tensors[0].dtype)):
+        # One atomic group negotiation through the jax frontend (fuses
+        # into a single device program when the xla_ici plane is up),
+        # instead of N independent bridged ops.
+        from horovod_tpu.jax import mpi_ops as _jax_ops
+
+        _probe_device_plane()
+        handles = _jax_ops.grouped_allreduce_async(
+            [_to_jax(t) for t in tensors], names=list(names), op=op,
+            process_set_id=process_set_id)
+        return [_BridgeHandle(h, dest=t, like=t)
+                for h, t in zip(handles, tensors)]
     return [allreduce_async_(t, n, op, process_set_id=process_set_id)
             for t, n in zip(tensors, names)]
 

@@ -126,6 +126,16 @@ def _worker_device_bridge(rank, size):
                                op=hvd.Sum)
         assert torch.allclose(rs, torch.full((2, 3),
                                              float(sum(range(1, size + 1)))))
+
+        # grouped: one atomic negotiation through the bridge, results
+        # land in-place in the original tensors
+        ts = [torch.full((3,), float(rank + i)) for i in range(3)]
+        outs = hvd.grouped_allreduce_(ts, op=hvd.Sum,
+                                      names=[f"bg.{i}" for i in range(3)])
+        for i, (t, o) in enumerate(zip(ts, outs)):
+            assert o is t
+            assert torch.allclose(t, torch.full(
+                (3,), float(sum(rk + i for rk in range(size)))))
         return "ok"
     finally:
         hvd.shutdown()
