@@ -17,9 +17,6 @@ TF_OUT := horovod_tpu/lib/libhvdtpu_tf.so
 
 # TF build flags come from the installed wheel; empty when TF is absent.
 PYTHON ?= python3
-TF_CFLAGS = $(shell $(PYTHON) -c "import tensorflow as tf; print(' '.join(tf.sysconfig.get_compile_flags()))" 2>/dev/null)
-TF_LFLAGS = $(shell $(PYTHON) -c "import tensorflow as tf; print(' '.join(tf.sysconfig.get_link_flags()))" 2>/dev/null)
-TF_INC = $(shell $(PYTHON) -c "import tensorflow as tf, os; print(os.path.join(os.path.dirname(tf.__file__), 'include'))" 2>/dev/null)
 
 .PHONY: core tf clean test
 
@@ -31,12 +28,19 @@ $(OUT): $(SRC) $(HDR)
 
 tf: $(TF_OUT)
 
+# The TF build flags come from ONE python probe at rule-execution time
+# (tensorflow imports are multi-second; `make core` must not pay them).
 $(TF_OUT): csrc/tf_ops.cc $(OUT)
-	@test -n "$(TF_CFLAGS)" || (echo "tensorflow not importable; skipping" && false)
+	@set -e; \
+	probe=$$($(PYTHON) -c "import tensorflow as tf, os; print(' '.join(tf.sysconfig.get_compile_flags())); print(' '.join(tf.sysconfig.get_link_flags())); print(os.path.join(os.path.dirname(tf.__file__), 'include'))" 2>/dev/null); \
+	test -n "$$probe" || { echo "tensorflow not importable; skipping"; exit 1; }; \
+	cflags=$$(printf '%s\n' "$$probe" | sed -n 1p); \
+	lflags=$$(printf '%s\n' "$$probe" | sed -n 2p); \
+	inc=$$(printf '%s\n' "$$probe" | sed -n 3p); \
 	$(CXX) -O2 -g -std=c++17 -fPIC -Wno-deprecated-declarations \
-	  csrc/tf_ops.cc $(TF_CFLAGS) -Icsrc -I$(TF_INC)/external/highwayhash \
-	  -I$(TF_INC)/external/farmhash_archive/src \
-	  -shared -pthread $(TF_LFLAGS) \
+	  csrc/tf_ops.cc $$cflags -Icsrc -I$$inc/external/highwayhash \
+	  -I$$inc/external/farmhash_archive/src \
+	  -shared -pthread $$lflags \
 	  -Lhorovod_tpu/lib -l:libhvdtpu_core.so '-Wl,-rpath,$$ORIGIN' \
 	  -o $(TF_OUT)
 

@@ -90,7 +90,8 @@ static int ToHvdDtype(tensorflow::DataType dt) {
 // recovery loop (common/elastic.py:_is_internal_error) classifies as
 // recoverable, mirroring how the reference's TF ops surface runtime
 // collective failures.
-static tensorflow::Status WaitHandle(int handle, const char* what) {
+static tensorflow::Status WaitImpl(int handle, const char* what,
+                                   bool release_on_success) {
   if (handle < 0) {
     return tensorflow::errors::Internal(
         what, ": HorovodInternalError: enqueue failed "
@@ -104,29 +105,35 @@ static tensorflow::Status WaitHandle(int handle, const char* what) {
     return tensorflow::errors::Internal(what, ": HorovodInternalError: ",
                                         reason);
   }
-  hvdtpu_release(handle);
+  if (release_on_success) hvdtpu_release(handle);
   return tensorflow::OkStatus();
+}
+
+static tensorflow::Status WaitHandle(int handle, const char* what) {
+  return WaitImpl(handle, what, /*release_on_success=*/true);
 }
 
 // Wait WITHOUT releasing on success: managed-result ops (allgather /
 // reducescatter / alltoall) still need the handle to query/copy the
 // core-owned output buffer; callers release after the copy.
 static tensorflow::Status WaitManaged(int handle, const char* what) {
-  if (handle < 0) {
-    return tensorflow::errors::Internal(
-        what, ": HorovodInternalError: enqueue failed "
-        "(is horovod initialized?)");
-  }
-  int rc = hvdtpu_wait(handle);
-  if (rc != 0) {
-    const char* msg = hvdtpu_error_string(handle);
-    std::string reason = msg ? msg : "collective failed";
-    hvdtpu_release(handle);
-    return tensorflow::errors::Internal(what, ": HorovodInternalError: ",
-                                        reason);
-  }
-  return tensorflow::OkStatus();
+  return WaitImpl(handle, what, /*release_on_success=*/false);
 }
+
+// Upstream's HOROVOD_ENABLE_XLA_OPS=0 disables collectives inside
+// XLA-compiled functions (they fail to compile with a clear message)
+// while the regular kernels keep working — mirror that contract.
+static bool XlaOpsEnabled() {
+  const char* v = std::getenv("HOROVOD_ENABLE_XLA_OPS");
+  return v == nullptr || std::string(v) != "0";
+}
+
+#define HVDTPU_REQUIRE_XLA_OPS(ctx)                                     \
+  OP_REQUIRES(ctx, XlaOpsEnabled(),                                     \
+              tensorflow::errors::FailedPrecondition(                   \
+                  "horovod collectives inside jit-compiled functions "  \
+                  "are disabled (HOROVOD_ENABLE_XLA_OPS=0); run this "  \
+                  "function without jit_compile"))
 
 // ---- op registrations -----------------------------------------------------
 
@@ -761,6 +768,7 @@ class AllreduceXlaKernel : public tensorflow::XlaOpKernel {
   }
 
   void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    HVDTPU_REQUIRE_XLA_OPS(ctx);
     xla::XlaBuilder* b = ctx->builder();
     auto shape_or = b->GetShape(ctx->Input(0));
     OP_REQUIRES_OK(ctx, shape_or.status());
@@ -804,6 +812,7 @@ class GroupedAllreduceXlaKernel : public tensorflow::XlaOpKernel {
   }
 
   void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    HVDTPU_REQUIRE_XLA_OPS(ctx);
     xla::XlaBuilder* b = ctx->builder();
     int n = ctx->num_inputs();
     OP_REQUIRES(ctx, (int)names_.size() == n,
@@ -865,6 +874,7 @@ class BroadcastXlaKernel : public tensorflow::XlaOpKernel {
   }
 
   void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    HVDTPU_REQUIRE_XLA_OPS(ctx);
     xla::XlaBuilder* b = ctx->builder();
     auto shape_or = b->GetShape(ctx->Input(0));
     OP_REQUIRES_OK(ctx, shape_or.status());
@@ -930,6 +940,7 @@ class AllgatherXlaKernel : public tensorflow::XlaOpKernel {
   }
 
   void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    HVDTPU_REQUIRE_XLA_OPS(ctx);
     xla::XlaBuilder* b = ctx->builder();
     auto shape_or = b->GetShape(ctx->Input(0));
     OP_REQUIRES_OK(ctx, shape_or.status());
@@ -974,6 +985,7 @@ class ReducescatterXlaKernel : public tensorflow::XlaOpKernel {
   }
 
   void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    HVDTPU_REQUIRE_XLA_OPS(ctx);
     xla::XlaBuilder* b = ctx->builder();
     auto shape_or = b->GetShape(ctx->Input(0));
     OP_REQUIRES_OK(ctx, shape_or.status());
@@ -1027,6 +1039,7 @@ class AlltoallXlaKernel : public tensorflow::XlaOpKernel {
   }
 
   void Compile(tensorflow::XlaOpKernelContext* ctx) override {
+    HVDTPU_REQUIRE_XLA_OPS(ctx);
     xla::XlaBuilder* b = ctx->builder();
     auto shape_or = b->GetShape(ctx->Input(0));
     OP_REQUIRES_OK(ctx, shape_or.status());

@@ -21,6 +21,19 @@ import os
 import socket
 
 
+def _routable_ip():
+    """Best-effort routable address for this host (the UDP-connect trick
+    the NIC-discovery task service uses); hostname as fallback."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostname()
+    finally:
+        s.close()
+
+
 def _mpi_comm():
     """The world communicator, or None when this process isn't an MPI
     program (mpi4py missing, or MPI not initialized)."""
@@ -63,11 +76,18 @@ def maybe_bootstrap_from_mpi(environ=os.environ):
     if rank == 0:
         port = environ.get("HOROVOD_CONTROLLER_PORT")
         if not port:
+            # Same ephemeral-port probe the launcher uses; the brief
+            # close->rebind window is shared with every free_port()
+            # user in the runner.
             s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             s.bind(("", 0))
             port = str(s.getsockname()[1])
             s.close()
-        endpoint = (socket.gethostname(), port)
+        # Publish a routable IP, not the bare hostname: peer hosts in
+        # containerized MPI clusters often cannot resolve each other's
+        # hostnames.
+        endpoint = (_routable_ip(), port)
     else:
         endpoint = None
     host, port = comm.bcast(endpoint, root=0)

@@ -110,6 +110,10 @@ class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
         if self._stopped_epoch_early:
             # Ours, not a user callback's (we checked stop_training was
             # False before setting it): clear so later epochs still run.
+            # ORDERING CONTRACT: list the hvd.elastic callbacks BEFORE
+            # user callbacks (as every example does) — a user callback
+            # that sets stop_training in its own on_epoch_end then runs
+            # after this clear and its stop request is preserved.
             self._stopped_epoch_early = False
             self.model.stop_training = False
         self._resume_target = None

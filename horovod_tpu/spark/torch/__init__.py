@@ -70,11 +70,9 @@ class TorchEstimator(EstimatorParams):
             hvd.broadcast_optimizer_state(base_opt, root_rank=0)
             criterion = loss_fn or torch.nn.MSELoss()
 
-            def step(xb, yb):
+            def step(xb_t, yb_t):
                 opt.zero_grad()
-                loss = criterion(model(torch.from_numpy(
-                    np.ascontiguousarray(xb))),
-                    torch.from_numpy(np.ascontiguousarray(yb)))
+                loss = criterion(model(xb_t), yb_t)
                 loss.backward()
                 opt.step()
 
@@ -91,7 +89,8 @@ class TorchEstimator(EstimatorParams):
                 try:
                     for _ in range(params["epochs"]):
                         for xb, yb in reader:
-                            step(xb, yb)
+                            step(torch.from_numpy(np.ascontiguousarray(xb)),
+                                 torch.from_numpy(np.ascontiguousarray(yb)))
                 finally:
                     reader.close_async_loader()
             else:
@@ -99,10 +98,13 @@ class TorchEstimator(EstimatorParams):
                                 params["feature_cols"],
                                 params["label_cols"], hvd.rank(),
                                 hvd.size())
+                # Convert the shard ONCE; batches are views.
+                x_t = torch.from_numpy(np.ascontiguousarray(x))
+                y_t = torch.from_numpy(np.ascontiguousarray(y))
                 bs = params["batch_size"]
                 for _ in range(params["epochs"]):
-                    for i in range(0, len(x), bs):
-                        step(x[i:i + bs], y[i:i + bs])
+                    for i in range(0, len(x_t), bs):
+                        step(x_t[i:i + bs], y_t[i:i + bs])
             if hvd.rank() == 0:
                 return _serialize_torch(model)
             return None

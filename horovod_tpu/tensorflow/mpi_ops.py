@@ -80,10 +80,11 @@ def _load_native():
     with _native_lock:
         if _native is not None or _native_failed:
             return _native
-        # HOROVOD_ENABLE_XLA_OPS is the reference's flag name for the
-        # in-jit op path; honor =0 as an opt-out alias.
-        if (os.environ.get("HOROVOD_TF_NATIVE_OPS", "1") == "0"
-                or os.environ.get("HOROVOD_ENABLE_XLA_OPS", "1") == "0"):
+        # HOROVOD_ENABLE_XLA_OPS=0 (the reference's flag) disables only
+        # the in-jit path — the tf2xla kernels check it at compile time
+        # (csrc/tf_ops.cc) — while the native CPU kernels stay active.
+        # HOROVOD_TF_NATIVE_OPS=0 disables the whole library.
+        if os.environ.get("HOROVOD_TF_NATIVE_OPS", "1") == "0":
             _native_failed = True
             return None
         pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
