@@ -225,6 +225,49 @@ def test_native_ops_process_sets():
     assert results == ["ok"] * 4 or results == ["skip"] * 4
 
 
+def _worker_keras_jit_compile_fit(rank, size):
+    """model.compile(jit_compile=True): keras 3's own XLA train function
+    contains the DistributedOptimizer's grouped allreduce — it must
+    compile via the native tf2xla kernels and keep replicas in sync."""
+    import tensorflow as tf
+    import horovod_tpu.keras as hvd
+    from horovod_tpu.tensorflow import mpi_ops
+
+    hvd.init()
+    try:
+        if mpi_ops._load_native() is None:
+            return "skip"
+        tf.keras.utils.set_random_seed(42 + rank)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Dense(4, input_shape=(8,)),
+            tf.keras.layers.Dense(1),
+        ])
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+        model.compile(optimizer=opt, loss="mse", jit_compile=True)
+        hvd.broadcast_variables(model.variables, root_rank=0, prefix="m")
+        x = tf.random.stateless_uniform([16, 8], seed=[rank, 1])
+        y = tf.random.stateless_uniform([16, 1], seed=[rank, 2])
+        model.fit(x, y, batch_size=8, epochs=2, verbose=0)
+
+        import horovod_tpu.tensorflow as hvdtf
+
+        for i, v in enumerate(model.trainable_variables):
+            g = hvdtf.allgather(tf.reshape(v, [1, -1]),
+                                name=f"kjc.{i}").numpy()
+            for row in g[1:]:
+                np.testing.assert_allclose(row, g[0], rtol=1e-5,
+                                           atol=1e-6)
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_keras_jit_compile_fit():
+    results = run_ranks(_worker_keras_jit_compile_fit, 2, env=_TF_ENV,
+                        timeout=300)
+    assert results == ["ok"] * 2 or results == ["skip"] * 2
+
+
 def _worker_keras(rank, size):
     import tensorflow as tf
     import horovod_tpu.keras as hvd
