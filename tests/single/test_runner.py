@@ -325,3 +325,20 @@ def test_tpu_pod_slot_env_binding():
     # non-tpu-pod launches never set binding vars
     env = _slot_env(slot, "127.0.0.1", 29500, tpu_pod=False)
     assert "TPU_VISIBLE_DEVICES" not in env
+
+
+def test_check_build_reports_capabilities(capsys):
+    """horovodrun --check-build (reference parity): frameworks, planes,
+    and the TF native op capability print truthfully."""
+    from horovod_tpu.runner.launch import _print_check_build
+
+    _print_check_build()
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "[X] TCP (gloo-style rendezvous)" in out
+    assert "[X] host ring (TCP)" in out
+    assert "[X] xla_ici device plane (TPU/ICI)" in out
+    # this image ships TF headers, so the native op row must be on
+    assert "[X] TF native ops (in-jit XLA collectives)" in out
+    assert "[ ] NCCL" in out
