@@ -566,6 +566,7 @@ struct CallMeta {
 
 static std::vector<uint8_t> Serialize(const CallMeta& m) {
   std::vector<uint8_t> b;
+  PutI64(b, 0);  // total byte length, patched in below
   PutI64(b, m.kind);
   PutI64(b, (int64_t)m.tensors.size());
   PutI64(b, m.dtype);
@@ -580,52 +581,108 @@ static std::vector<uint8_t> Serialize(const CallMeta& m) {
     b.insert(b.end(), t.name.begin(), t.name.end());
     while (b.size() % 8) b.push_back(0);
   }
+  int64_t total = (int64_t)b.size();
+  std::memcpy(b.data(), &total, 8);
   return b;
 }
 
+// Sanity caps for the self-declared metadata: nothing legitimate comes
+// close, and a corrupted buffer can't make the parser walk far past it.
+constexpr int64_t kMaxMetaBytes = int64_t(64) << 20;  // 64 MiB
+constexpr int64_t kMaxMetaTensors = 1 << 20;
+constexpr int64_t kMaxMetaNdim = 255;
+
+// Bounds-checked reader over the self-framing metadata buffer (ADVICE
+// r2): every read validates against the declared total, and any
+// inconsistency poisons the reader instead of walking off the buffer.
 class Reader {
  public:
-  explicit Reader(const uint8_t* p) : p_(p) {}
+  Reader(const uint8_t* p, int64_t len) : p_(p), end_(p + len) {}
+  bool ok() const { return ok_; }
   int64_t I64() {
+    if (!Need(8)) return 0;
     int64_t v;
     std::memcpy(&v, p_, 8);
     p_ += 8;
     return v;
   }
   double F64() {
+    if (!Need(8)) return 0.0;
     double v;
     std::memcpy(&v, p_, 8);
     p_ += 8;
     return v;
   }
   std::string Str(int64_t n) {
+    int64_t padded = (n + 7) / 8 * 8;
+    if (n < 0 || padded < n || !Need(padded)) {
+      ok_ = false;
+      return std::string();
+    }
     std::string s(reinterpret_cast<const char*>(p_), n);
-    p_ += (n + 7) / 8 * 8;
+    p_ += padded;
     return s;
   }
 
  private:
+  bool Need(int64_t n) {
+    if (!ok_ || end_ - p_ < n) {
+      ok_ = false;
+      return false;
+    }
+    return true;
+  }
   const uint8_t* p_;
+  const uint8_t* end_;
+  bool ok_ = true;
 };
 
-static CallMeta Parse(const uint8_t* p) {
-  Reader r(p);
-  CallMeta m;
-  m.kind = r.I64();
+// Parse with validation; returns false (and a reason) on any
+// inconsistency so the caller can die loudly instead of reading OOB.
+static bool Parse(const uint8_t* p, CallMeta* m, std::string* why) {
+  int64_t total;
+  std::memcpy(&total, p, 8);
+  if (total < 8 * 8 || total > kMaxMetaBytes || total % 8) {
+    *why = "implausible metadata length " + std::to_string(total);
+    return false;
+  }
+  Reader r(p, total);
+  r.I64();  // the length header itself
+  m->kind = r.I64();
   int64_t n = r.I64();
-  m.dtype = r.I64();
-  m.reduce_op_or_root = r.I64();
-  m.process_set_id = r.I64();
-  m.prescale = r.F64();
-  m.postscale = r.F64();
-  m.tensors.resize(n);
-  for (auto& t : m.tensors) {
+  m->dtype = r.I64();
+  m->reduce_op_or_root = r.I64();
+  m->process_set_id = r.I64();
+  m->prescale = r.F64();
+  m->postscale = r.F64();
+  if (m->kind < 0 || m->kind > 4) {
+    *why = "unknown collective kind " + std::to_string(m->kind);
+    return false;
+  }
+  // Managed-result ops (kind>=2) carry [input dims, output dims]; the
+  // others need at least the one tensor the callback dereferences.
+  int64_t min_tensors = m->kind >= 2 ? 2 : 1;
+  if (n < min_tensors || n > kMaxMetaTensors) {
+    *why = "implausible tensor count " + std::to_string(n) +
+           " for kind " + std::to_string(m->kind);
+    return false;
+  }
+  m->tensors.resize(n);
+  for (auto& t : m->tensors) {
     int64_t ndim = r.I64();
+    if (!r.ok() || ndim < 0 || ndim > kMaxMetaNdim) {
+      *why = "implausible ndim " + std::to_string(ndim);
+      return false;
+    }
     t.dims.resize(ndim);
     for (auto& d : t.dims) d = r.I64();
     t.name = r.Str(r.I64());
   }
-  return m;
+  if (!r.ok()) {
+    *why = "metadata truncated relative to declared length";
+    return false;
+  }
+  return true;
 }
 
 }  // namespace meta
@@ -645,7 +702,11 @@ extern "C" void hvdtpu_tf_xla_collective(void* out, const void** ins) {
   // Operand layout: ins[0] = metadata bytes, ins[1..N] = tensor buffers.
   // N==1 results are a bare buffer; N>1 results arrive as a tuple
   // (void** of leaf buffers).
-  meta::CallMeta m = meta::Parse(reinterpret_cast<const uint8_t*>(ins[0]));
+  meta::CallMeta m;
+  std::string why;
+  if (!meta::Parse(reinterpret_cast<const uint8_t*>(ins[0]), &m, &why)) {
+    DieInXla("metadata parse", why);
+  }
   int n = (int)m.tensors.size();
   void** outs_tuple = reinterpret_cast<void**>(out);
   if (!hvdtpu_is_initialized()) {

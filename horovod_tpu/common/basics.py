@@ -247,7 +247,7 @@ class HorovodBasics:
         "gloo_built", "gloo_enabled", "mpi_built", "mpi_enabled",
         "mpi_threads_supported", "xla_built", "xla_enabled", "nccl_built",
         "cuda_built", "rocm_built", "ccl_built", "ddl_built",
-        "tf_native_ops_built")
+        "tf_native_ops_built", "tf_native_ops_buildable")
 
     # Reference analog: horovod/common/basics.py mpi_built/gloo_built/
     # nccl_built/... — scripts probe these to pick code paths. Mapping:
@@ -301,17 +301,31 @@ class HorovodBasics:
 
     def tf_native_ops_built(self, verbose=False):
         """Whether the native TF op library (CPU kernels + in-jit XLA
-        custom-calls, csrc/tf_ops.cc) exists or can build here."""
+        custom-calls, csrc/tf_ops.cc) has actually been BUILT here.
+
+        Strict by design (ADVICE r2): headers merely being present does
+        not prove the on-demand build will succeed — see
+        ``tf_native_ops_buildable`` for that weaker probe.
+        """
         del verbose
         import os
 
         lib = os.path.join(os.path.dirname(_lib_path()), "libhvdtpu_tf.so")
-        if os.path.exists(lib):
+        return os.path.exists(lib)
+
+    def tf_native_ops_buildable(self, verbose=False):
+        """Whether the native TF op library could be built on demand
+        (tf2xla headers ship with the installed TF). Weaker than
+        ``tf_native_ops_built``: the build can still fail on
+        compiler/ABI mismatch."""
+        del verbose
+        import os
+
+        if self.tf_native_ops_built():
             return True
         try:
             import tensorflow as tf  # noqa: F401
 
-            # Headers present = buildable on demand.
             return os.path.isdir(os.path.join(
                 os.path.dirname(tf.__file__), "include", "tensorflow",
                 "compiler", "tf2xla"))

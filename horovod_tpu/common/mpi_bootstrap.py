@@ -12,39 +12,125 @@ worker identity and rendezvous endpoint from the communicator:
 - rank 0 opens the controller port and broadcasts ``host:port``.
 
 Engaged by ``hvd.init()`` only when HOROVOD_RANK is absent from the env
-(a launcher always sets it) and ``mpi4py`` is importable with MPI
-already initialized — exactly the "running under mpirun without
-horovodrun" case.
+(a launcher always sets it) and either the embedding program already
+imported mpi4py, or an MPI launcher's own env vars prove we are running
+under mpirun/srun — exactly the "running under mpirun without
+horovodrun" case. A bare ``from mpi4py import MPI`` calls MPI_Init as an
+import side effect, and a failing MPI_Init (stale PMI env under a
+different launcher) aborts the process before any try/except runs — so
+the import only happens behind the launcher-env gate, with
+``mpi4py.rc.initialize`` disabled and MPI_Init invoked explicitly.
 """
 
 import os
 import socket
+import sys
+
+# Env vars only an MPI-capable launcher sets on its children. Presence of
+# any of these is the precondition for importing mpi4py ourselves.
+# Deliberately NOT SLURM_PROCID: sbatch/srun set it on every task of
+# every job, MPI or not — srun's MPI plugins announce themselves through
+# PMI_SIZE / PMIX_RANK, which is the evidence an MPI runtime can
+# actually bootstrap here.
+_LAUNCHER_ENVS = (
+    "OMPI_COMM_WORLD_SIZE",   # Open MPI orted
+    "PMI_SIZE",               # MPICH / Hydra / PMI-1 (incl. srun --mpi=pmi2)
+    "MV2_COMM_WORLD_SIZE",    # MVAPICH2
+)
+
+# PMIx sets no standard size var itself; under srun --mpi=pmix the step
+# task count is the size evidence.
+_PMIX_SIZE_ENVS = ("SLURM_STEP_NUM_TASKS", "SLURM_NTASKS")
+
+
+def _under_mpi_launcher(environ):
+    """Launcher evidence check. Size evidence must also say >1 — an
+    '-np 1' world has nothing to bootstrap and is not worth an
+    MPI_Init (which under a half-configured PMI env can still
+    hard-abort)."""
+
+    def _gt1(val):
+        try:
+            return int(val) > 1
+        except (TypeError, ValueError):
+            return False
+
+    for var in _LAUNCHER_ENVS:
+        if _gt1(environ.get(var)):
+            return True
+    if "PMIX_RANK" in environ:
+        return any(_gt1(environ.get(v)) for v in _PMIX_SIZE_ENVS)
+    return False
 
 
 def _routable_ip():
-    """Best-effort routable address for this host (the UDP-connect trick
-    the NIC-discovery task service uses); hostname as fallback."""
+    """Best-effort routable IPv4 address for this host (the UDP-connect
+    trick the NIC-discovery task service uses), falling back to
+    resolver-reported non-loopback IPv4 addresses, then the hostname.
+
+    IPv4 only BY DESIGN: the control plane (csrc/wire.cc) listens and
+    connects AF_INET, so publishing an IPv6 literal here would hand
+    workers an endpoint they can never reach.
+    """
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
         s.connect(("10.255.255.255", 1))
         return s.getsockname()[0]
     except OSError:
-        return socket.gethostname()
+        pass
     finally:
         s.close()
-
-
-def _mpi_comm():
-    """The world communicator, or None when this process isn't an MPI
-    program (mpi4py missing, or MPI not initialized)."""
+    # Egress-filtered hosts where the UDP-connect trick finds nothing:
+    # any non-loopback IPv4 the resolver maps the hostname to.
     try:
-        from mpi4py import MPI
-    except Exception:
-        return None
+        for info in socket.getaddrinfo(socket.gethostname(), None,
+                                       socket.AF_INET):
+            addr = info[4][0]
+            if not addr.startswith("127."):
+                return addr
+    except OSError:
+        pass
+    return socket.gethostname()
+
+
+def _mpi_world(environ):
+    """(MPI module, COMM_WORLD) for a genuinely running MPI program, else
+    None. Never initializes an MPI runtime unless a launcher env var
+    proves one is expected."""
+    mod = sys.modules.get("mpi4py")
+    MPI = getattr(mod, "MPI", None) if mod is not None else None
+    if MPI is None:
+        if not _under_mpi_launcher(environ):
+            return None
+        try:
+            import mpi4py
+
+            # Import must stay side-effect free; Init runs explicitly
+            # below. (MPI_Init failure under a broken PMI bootstrap can
+            # still hard-abort — pre-init errors bypass error handlers —
+            # but the launcher gate means one was genuinely expected.)
+            mpi4py.rc.initialize = False
+            from mpi4py import MPI
+        except Exception:
+            return None
     try:
         if not MPI.Is_initialized():
-            return None
-        return MPI.COMM_WORLD
+            if not _under_mpi_launcher(environ):
+                # Embedding program imported mpi4py but never brought the
+                # world up, and no launcher is present: not an MPI run.
+                return None
+            MPI.Init()
+            # We initialized, so we must finalize — an Init-without-
+            # Finalize exit makes mpirun report the whole (successful)
+            # job as failed. Guarded: an embedding program or mpi4py's
+            # own atexit hook may get there first.
+            import atexit
+
+            atexit.register(
+                lambda: MPI.Finalize()
+                if MPI.Is_initialized() and not MPI.Is_finalized()
+                else None)
+        return MPI, MPI.COMM_WORLD
     except Exception:
         return None
 
@@ -58,10 +144,12 @@ def maybe_bootstrap_from_mpi(environ=os.environ):
     """
     if "HOROVOD_RANK" in environ:
         return False
-    comm = _mpi_comm()
-    if comm is None or comm.Get_size() <= 1:
+    world = _mpi_world(environ)
+    if world is None:
         return False
-    from mpi4py import MPI
+    MPI, comm = world
+    if comm.Get_size() <= 1:
+        return False
 
     rank, size = comm.Get_rank(), comm.Get_size()
     local_comm = comm.Split_type(MPI.COMM_TYPE_SHARED, key=rank)

@@ -193,8 +193,11 @@ def _print_check_build():
     print("\nAvailable Tensor Operations:")
     print(f"    {box(b.gloo_built())} host ring (TCP)")
     print(f"    {box(b.xla_built())} xla_ici device plane (TPU/ICI)")
-    print(f"    {box(b.tf_native_ops_built())} TF native ops "
-          f"(in-jit XLA collectives)")
+    tf_native = b.tf_native_ops_built()
+    tf_note = "" if tf_native or not b.tf_native_ops_buildable() \
+        else "  (not built; buildable on demand: make tf)"
+    print(f"    {box(tf_native)} TF native ops "
+          f"(in-jit XLA collectives){tf_note}")
     print(f"    {box(b.nccl_built())} NCCL")
     print(f"    {box(b.cuda_built())} CUDA")
     print(f"    {box(b.rocm_built())} ROCm")

@@ -22,13 +22,21 @@ from horovod_tpu.data import AsyncDataLoaderMixin, BaseDataLoader
 
 
 def frame_to_xy(df, feature_cols, label_cols):
-    """pandas frame -> (x, y) float32 arrays; vector-valued feature
-    columns (lists from Spark VectorUDT staging) are stacked."""
+    """pandas frame -> (x, y) arrays; vector-valued feature columns
+    (lists from Spark VectorUDT staging) are stacked.
+
+    Features cast to float32 (model inputs). Labels KEEP integer dtypes
+    — classification targets round-trip as ints through the reader
+    (sparse-categorical/cross-entropy losses need them); everything else
+    (floats, bools — BCE wants float targets) normalizes to float32.
+    """
     x = np.stack([np.asarray(v, np.float32)
                   for v in df[list(feature_cols)].to_numpy().tolist()])
     if x.ndim == 3 and x.shape[1] == 1:
         x = x[:, 0]
-    y = df[list(label_cols)].to_numpy().astype(np.float32)
+    y = df[list(label_cols)].to_numpy()
+    if not np.issubdtype(y.dtype, np.integer):
+        y = y.astype(np.float32)
     return x, y
 
 

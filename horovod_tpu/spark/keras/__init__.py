@@ -74,14 +74,24 @@ class KerasEstimator(EstimatorParams):
                     shuffle=params["shuffle"], seed=params["seed"])
                 steps = len(reader)
 
-                def gen():
-                    while True:
-                        yield from iter(reader)
-
+                # One fit call per keras epoch, each on a FRESH reader
+                # pass: keras/tf.data prefetching can pull batches past
+                # the steps_per_epoch boundary, which with a single
+                # infinite generator would drift the reader's epoch (and
+                # its per-epoch shuffle order) out of alignment with
+                # keras epochs. Stateful callbacks carry across the
+                # calls; histories are concatenated.
+                history = {}
                 try:
-                    hist = model.fit(gen(), steps_per_epoch=steps,
-                                     epochs=params["epochs"],
-                                     verbose=verbose, callbacks=callbacks)
+                    for epoch in range(params["epochs"]):
+                        hist = model.fit(iter(reader),
+                                         steps_per_epoch=steps,
+                                         epochs=epoch + 1,
+                                         initial_epoch=epoch,
+                                         verbose=verbose,
+                                         callbacks=callbacks)
+                        for k, v in hist.history.items():
+                            history.setdefault(k, []).extend(v)
                 finally:
                     reader.close_async_loader()
             else:
@@ -89,11 +99,12 @@ class KerasEstimator(EstimatorParams):
                                 params["feature_cols"],
                                 params["label_cols"], hvd.rank(),
                                 hvd.size())
-                hist = model.fit(x, y, batch_size=params["batch_size"],
-                                 epochs=params["epochs"],
-                                 verbose=verbose, callbacks=callbacks)
+                history = model.fit(x, y, batch_size=params["batch_size"],
+                                    epochs=params["epochs"],
+                                    verbose=verbose,
+                                    callbacks=callbacks).history
             if hvd.rank() == 0:
-                return _serialize_keras(model), hist.history
+                return _serialize_keras(model), history
             return None
 
         results = spark_run(train, num_proc=self.num_proc, spark=spark)

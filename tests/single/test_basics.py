@@ -190,3 +190,53 @@ def test_mpi_bootstrap_noop_without_mpi():
     env = {}
     assert maybe_bootstrap_from_mpi(env) is False  # no mpi4py installed
     assert env == {}
+
+    # Even with a launcher env present, absence of mpi4py stays a no-op.
+    env = {"OMPI_COMM_WORLD_SIZE": "4"}
+    assert maybe_bootstrap_from_mpi(env) is False
+    assert env == {"OMPI_COMM_WORLD_SIZE": "4"}
+
+
+def test_mpi_bootstrap_never_imports_mpi4py_unlaunched(monkeypatch):
+    """ADVICE r2 (medium): importing mpi4py MPI_Inits as a side effect,
+    which can hard-abort under a stale/foreign launcher env — so without
+    an MPI launcher's own env vars the bootstrap must not import it at
+    all (an exploding meta-path finder proves the import never starts)."""
+    import importlib.abc
+    import sys
+
+    attempts = []
+
+    class _Tripwire(importlib.abc.MetaPathFinder):
+        def find_spec(self, name, path=None, target=None):
+            if name == "mpi4py" or name.startswith("mpi4py."):
+                attempts.append(name)
+            return None
+
+    monkeypatch.setattr(sys, "meta_path", [_Tripwire()] + sys.meta_path)
+    sys.modules.pop("mpi4py", None)
+
+    from horovod_tpu.common.mpi_bootstrap import maybe_bootstrap_from_mpi
+
+    assert maybe_bootstrap_from_mpi({}) is False
+    assert attempts == []  # the import never even started
+
+    # Under a genuine MPI launcher env the import IS attempted (and here
+    # degrades to a clean no-op, mpi4py being absent from the image).
+    assert maybe_bootstrap_from_mpi({"OMPI_COMM_WORLD_SIZE": "2"}) is False
+    assert attempts  # gate opened exactly for the launcher case
+
+
+def test_mpi_bootstrap_imported_but_uninitialized(monkeypatch):
+    """Embedding program imported mpi4py but never brought the world up
+    and no launcher is present: not an MPI run."""
+    import sys
+    import types
+
+    fake = types.ModuleType("mpi4py")
+    fake.MPI = types.SimpleNamespace(Is_initialized=lambda: False)
+    monkeypatch.setitem(sys.modules, "mpi4py", fake)
+
+    from horovod_tpu.common.mpi_bootstrap import maybe_bootstrap_from_mpi
+
+    assert maybe_bootstrap_from_mpi({}) is False

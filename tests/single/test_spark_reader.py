@@ -121,6 +121,83 @@ def test_empty_staging_rejected(tmp_path):
                            ("label",), batch_size=4)
 
 
+def _stage_classification(tmp_path, n_rows=64, dim=4, n_classes=3):
+    """Staged parquet with INTEGER labels (classification contract)."""
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({
+        "features": [rng.rand(dim).astype("float32").tolist()
+                     for _ in range(n_rows)],
+        "label": rng.randint(0, n_classes, n_rows).astype("int64"),
+    })
+    df.to_parquet(tmp_path / "part-00000.parquet", row_group_size=16)
+    return str(tmp_path)
+
+
+def test_int_labels_round_trip_as_int(tmp_path):
+    """Classification labels keep their integer dtype through BOTH the
+    streaming reader and the in-memory load (features still cast to
+    float32) — sparse-categorical/cross-entropy losses require int
+    targets, so a silent float cast breaks the estimator contract."""
+    path = _stage_classification(tmp_path)
+    r = ParquetBatchReader(path, ("features",), ("label",), batch_size=16)
+    for x, y in r:
+        assert x.dtype == np.float32
+        assert np.issubdtype(y.dtype, np.integer)
+    x, y = _load_np(path, ("features",), ("label",), 0, 1)
+    assert x.dtype == np.float32
+    assert np.issubdtype(y.dtype, np.integer)
+    # float labels keep normalizing to float32 (regression contract)
+    (tmp_path / "float").mkdir(exist_ok=True)
+    path_f = _stage(tmp_path / "float", n_files=1)
+    xf, yf = _load_np(path_f, ("features",), ("label",), 0, 1)
+    assert yf.dtype == np.float32
+    # bool labels ALSO normalize to float32 (BCE wants float targets;
+    # no loss consumes bool)
+    (tmp_path / "bool").mkdir(exist_ok=True)
+    pd.DataFrame({
+        "features": [[0.0, 1.0, 0.0, 1.0]] * 8,
+        "label": [True, False] * 4,
+    }).to_parquet(tmp_path / "bool" / "part-00000.parquet")
+    xb, yb = _load_np(str(tmp_path / "bool"), ("features",), ("label",),
+                      0, 1)
+    assert yb.dtype == np.float32
+
+
+def test_classification_estimator_path_trains_with_int_labels(tmp_path):
+    """End-to-end through the estimator's protocol trainer: cross-entropy
+    REQUIRES integer class targets, so this only works because the
+    reader preserves them."""
+    import torch
+
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    path = _stage_classification(tmp_path, n_rows=96, n_classes=3)
+
+    class Clf(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            torch.manual_seed(3)
+            self.net = torch.nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.net(x)
+
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            return torch.nn.functional.cross_entropy(
+                self(x), y.reshape(-1))
+
+        def configure_optimizers(self):
+            return torch.optim.SGD(self.parameters(), lr=0.05)
+
+    reader = ParquetBatchReader(path, ("features",), ("label",),
+                                batch_size=16)
+    trained = train_protocol_model(
+        Clf(), None, None, 16, epochs=2, distributed=False,
+        batch_iter=lambda: iter(reader))
+    assert trained is not None
+
+
 def test_lightning_protocol_streams_from_reader(tmp_path):
     """train_protocol_model's batch_iter path (the lightning estimator's
     streaming mode) learns the same function as the in-memory path."""
