@@ -172,12 +172,17 @@ def _device_path(tensor, op=None, process_set_id=0):
 
 
 def allreduce_async(tensor, name=None, op=Average, prescale_factor=1.0,
-                    postscale_factor=1.0, process_set_id=0):
+                    postscale_factor=1.0, process_set_id=0, donate=False):
+    """``donate=True`` promises the input array will not be read again;
+    on the device data plane the fused program then reuses its HBM for
+    the result (the input is invalid afterwards). The host path ignores
+    it (the host copy is already detached from the device buffer)."""
     if _device_path(tensor, op, process_set_id):
         return xla_ici.enqueue_device(
             "allreduce", tensor, name or _auto_name("allreduce"),
             reduce_op=op, prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, process_set_id=process_set_id)
+            postscale_factor=postscale_factor, process_set_id=process_set_id,
+            donate=donate)
     arr = _to_host(tensor)
     inner = eager_ops.allreduce_async(
         arr, name or _auto_name("allreduce"), op=op,
@@ -194,10 +199,11 @@ def allreduce(tensor, name=None, op=Average, prescale_factor=1.0,
 
 def grouped_allreduce_async(tensors, names=None, op=Average,
                             prescale_factor=1.0, postscale_factor=1.0,
-                            process_set_id=0):
+                            process_set_id=0, donate=False):
     """Allreduce a list of tensors as one negotiation group (they fuse and
     complete atomically). Reference analog: hvd.grouped_allreduce
-    (horovod/common/group_table.cc)."""
+    (horovod/common/group_table.cc). ``donate`` as in
+    :func:`allreduce_async` (device plane only)."""
     if names is None:
         base = _auto_name("grouped_allreduce")
         names = [f"{base}.{i}" for i in range(len(tensors))]
@@ -206,7 +212,8 @@ def grouped_allreduce_async(tensors, names=None, op=Average,
             and len({t.dtype for t in tensors}) == 1):
         return xla_ici.grouped_allreduce_device(
             tensors, names, reduce_op=op, prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, process_set_id=process_set_id)
+            postscale_factor=postscale_factor, process_set_id=process_set_id,
+            donate=donate)
     arrs = [_to_host(t) for t in tensors]
     if arrs and all(a.dtype == arrs[0].dtype for a in arrs):
         inners = eager_ops.grouped_allreduce_async(
@@ -216,7 +223,8 @@ def grouped_allreduce_async(tensors, names=None, op=Average,
     # Mixed dtypes: fall back to per-tensor enqueue (still fuses per-dtype
     # in the core's fusion buffer, just not negotiated atomically).
     return [allreduce_async(t, n, op, prescale_factor, postscale_factor,
-                            process_set_id) for t, n in zip(tensors, names)]
+                            process_set_id, donate=donate)
+            for t, n in zip(tensors, names)]
 
 
 def grouped_allreduce(tensors, names=None, op=Average, prescale_factor=1.0,

@@ -17,21 +17,31 @@ from horovod_tpu.jax.compression import Compression
 
 
 def allreduce_gradients(grads, op=mpi_ops.Average,
-                        compression=Compression.none, prefix="grad"):
+                        compression=Compression.none, prefix="grad",
+                        donate=False):
     """Allreduce a gradient pytree across ranks (eager path).
 
     Leaves are enqueued as one negotiation group per dtype so the core
     fuses them into large buffers (reference: tensor fusion,
     HOROVOD_FUSION_THRESHOLD).
+
+    ``donate=True`` promises the caller will not read ``grads`` again
+    (the usual case — the reduced tree replaces them): on the device
+    data plane the fused program reuses the gradients' HBM for the
+    results, halving the collective's peak footprint.
     """
     leaves, treedef = jax.tree.flatten(grads)
+    del grads  # with donate, no live ref may outlast the collective
     compressed, ctxs = [], []
     for leaf in leaves:
         c, ctx = compression.compress(jnp.asarray(leaf))
         compressed.append(c)
         ctxs.append(ctx)
+    del leaves
     names = [f"{prefix}.{i}" for i in range(len(compressed))]
-    handles = mpi_ops.grouped_allreduce_async(compressed, names, op=op)
+    handles = mpi_ops.grouped_allreduce_async(compressed, names, op=op,
+                                              donate=donate)
+    del compressed
     reduced = [compression.decompress(h.synchronize(), ctx)
                for h, ctx in zip(handles, ctxs)]
     return jax.tree.unflatten(treedef, reduced)

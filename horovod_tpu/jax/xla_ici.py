@@ -109,10 +109,14 @@ class XlaIciDataPlane:
         self._size = 1
         self._devices = None          # rank -> jax device
         self._local_device = None
-        self._inputs = {}             # (ps_id, name) -> (array, pre, post)
+        self._inputs = {}             # (ps_id, name) -> (array, pre, post,
+                                      #                   donate)
         self._outputs = {}            # (ps_id, name) -> jax array
         self._exec_cache = {}         # signature -> jitted program
         self._cb_ref = None           # keep the CFUNCTYPE alive
+        self._retained_topology = None  # topology the cache compiled for
+        self.cache_reuses = 0         # enables that kept the cache
+        self.cache_invalidations = 0  # enables that had to clear it
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -172,6 +176,23 @@ class XlaIciDataPlane:
             self._local_device = jax.local_devices()[0]
             self._devices = [self._local_device]
         self._rank, self._size = rank, size
+        # Elastic fast re-init (SURVEY §7 hard part: "recovery requires
+        # tearing down and re-creating the PJRT client/mesh; slow — needs
+        # a cached-topology fast path"): compiled executables stay valid
+        # as long as (rank, size, device list) — everything their meshes
+        # and shard layouts close over — is unchanged. The common
+        # recovery case (a worker replaced at the same size) re-enables
+        # with the identical topology on every surviving rank, so the
+        # whole executable cache replays instead of recompiling. Any
+        # topology drift invalidates the lot.
+        topology = (rank, size, tuple(self._devices))
+        if self._exec_cache:
+            if topology == self._retained_topology:
+                self.cache_reuses += 1
+            else:
+                self._exec_cache.clear()
+                self.cache_invalidations += 1
+        self._retained_topology = topology
         self._cb_ref = _EXEC_FN(self._execute)
         _basics.lib.hvdtpu_set_device_callback(
             ctypes.cast(self._cb_ref, ctypes.c_void_p))
@@ -183,19 +204,39 @@ class XlaIciDataPlane:
         _basics.lib.hvdtpu_set_device_callback(None)
         self._active = False
         self._cb_ref = None
+        # In-flight payloads die with the epoch; the executable cache is
+        # RETAINED against self._retained_topology — enable() decides
+        # whether the next epoch can reuse it (elastic fast re-init) or
+        # must recompile (topology changed).
         with self._lock:
             self._inputs.clear()
             self._outputs.clear()
+
+    def executable_cache_size(self):
+        return len(self._exec_cache)
+
+    def invalidate(self):
+        """Drop every retained executable NOW (not at the next enable).
+
+        The fast re-init retention assumes the jax backend client
+        persists across the disable/enable cycle — true for in-process
+        elastic recovery, where jax.distributed cannot re-initialize a
+        different world anyway. Anything that genuinely tears down and
+        recreates the PJRT client must call this first: retained
+        executables pin the OLD client's devices until enable() sees
+        the topology changed."""
         self._exec_cache.clear()
+        self._retained_topology = None
 
     # -- frontend side -----------------------------------------------------
 
     def register_input(self, name, process_set_id, array, prescale=1.0,
-                       postscale=1.0):
+                       postscale=1.0, donate=False):
         arr = jax.device_put(array, self._local_device)
         with self._lock:
             self._inputs[(process_set_id, name)] = (arr, float(prescale),
-                                                    float(postscale))
+                                                    float(postscale),
+                                                    bool(donate))
         return arr
 
     def pop_output(self, name, process_set_id):
@@ -235,21 +276,26 @@ class XlaIciDataPlane:
 
     def _take_inputs(self, names, shapes, np_dtype, ps_id):
         """Local contributions in fused order; zeros for names this rank
-        never enqueued (join support)."""
+        never enqueued (join support). Third return: whether EVERY input
+        in the group was registered with donate=True (donation is
+        all-or-nothing per fused program)."""
         arrs, scales = [], []
+        donate = True
         with self._lock:
             pending = [self._inputs.pop((ps_id, nm), None) for nm in names]
         for nm, shape, p in zip(names, shapes, pending):
             if p is None:
                 arrs.append(jnp.zeros(shape, np_dtype))
                 scales.append((1.0, 1.0))
+                donate = False
             else:
-                arr, pre, post = p
+                arr, pre, post, don = p
                 if arr.dtype != np_dtype:
                     arr = arr.astype(np_dtype)
                 arrs.append(arr)
                 scales.append((pre, post))
-        return arrs, tuple(scales)
+                donate = donate and don
+        return arrs, tuple(scales), donate
 
     def _mesh(self, members):
         return Mesh(np.array([self._devices[r] for r in members]), ("hvd",))
@@ -272,21 +318,48 @@ class XlaIciDataPlane:
         group = len(members)
         mesh = self._mesh(members)
         if op_class == _OP_ALLREDUCE:
-            arrs, scales = self._take_inputs(names, shapes, np_dtype, ps_id)
+            arrs, scales, donate = self._take_inputs(names, shapes,
+                                                     np_dtype, ps_id)
             sig = (op_class, members, np_dtype.str, tuple(shapes), reduce_op,
-                   scales)
+                   scales, donate)
             fn = self._exec_cache.get(sig)
             if fn is None:
-                fn = _build_allreduce(mesh, group, shapes, reduce_op, scales)
+                if group == 1:
+                    fn = _build_allreduce_local(reduce_op, scales, donate)
+                else:
+                    fn = _build_allreduce(mesh, group, shapes, reduce_op,
+                                          scales, donate)
                 self._exec_cache[sig] = fn
-            gins = [self._global(mesh, group, a.reshape(1, -1))
-                    for a in arrs]
-            gouts = fn(*gins)
-            outs = [g.addressable_data(0).reshape(s)
-                    for g, s in zip(gouts, shapes)]
+            if group == 1:
+                # Single-member set: the reduction is identity × scales,
+                # so the program takes the arrays in their ORIGINAL
+                # shapes — no flat staging copies, no concat buffer, and
+                # with donation the outputs alias the inputs outright
+                # (zero HBM transient; at flagship gradient sizes the
+                # concat path's transients would not even fit next to
+                # the model). One executable call replaces ~2n per-
+                # tensor lifts — the dominant dispatch cost on
+                # high-latency transports.
+                outs = list(fn(*arrs))
+                del arrs
+                self._store(names, ps_id, outs)
+                return
+            # Reshape + lift one tensor at a time, RELEASING the flat
+            # staging copy's predecessor as we go — with donation active
+            # the fused program then runs with only one generation of
+            # buffers live (the HBM fusion-buffer story, SURVEY §7).
+            gins = []
+            for i in range(len(arrs)):
+                gins.append(self._global(mesh, group,
+                                         arrs[i].reshape(1, -1)))
+                arrs[i] = None
+            del arrs
+            # Outputs come back already in their final shapes (reshape
+            # folded into the compiled program — no host-side copy).
+            outs = [g.addressable_data(0) for g in fn(*gins)]
             self._store(names, ps_id, outs)
         elif op_class == _OP_BROADCAST:
-            arrs, _ = self._take_inputs(names, shapes, np_dtype, ps_id)
+            arrs, _, _ = self._take_inputs(names, shapes, np_dtype, ps_id)
             root_pos = members.index(root_rank)
             sig = (op_class, members, np_dtype.str, tuple(shapes), root_pos)
             fn = self._exec_cache.get(sig)
@@ -305,7 +378,7 @@ class XlaIciDataPlane:
             dims = rank_sizes if rank_sizes else (shape[0] if shape else 1,)
             max_d = max(max(dims), 1)
             my_rows = dims[members.index(self._rank)]
-            arrs, _ = self._take_inputs(
+            arrs, _, _ = self._take_inputs(
                 names, [(my_rows,) + rest], np_dtype, ps_id)
             local = arrs[0].reshape(my_rows, _row_elems(rest))
             pad = max_d - local.shape[0]
@@ -331,7 +404,7 @@ class XlaIciDataPlane:
                 raise ValueError(
                     f"device alltoall first dim {first} not divisible by "
                     f"group size {group}")
-            arrs, _ = self._take_inputs(names, shapes, np_dtype, ps_id)
+            arrs, _, _ = self._take_inputs(names, shapes, np_dtype, ps_id)
             sig = (op_class, members, np_dtype.str, tuple(shape))
             fn = self._exec_cache.get(sig)
             if fn is None:
@@ -342,7 +415,8 @@ class XlaIciDataPlane:
             out = fn(g).addressable_data(0).reshape((first,) + rest)
             self._store(names, ps_id, [out])
         elif op_class == _OP_REDUCESCATTER:
-            arrs, scales = self._take_inputs(names, shapes, np_dtype, ps_id)
+            arrs, scales, _ = self._take_inputs(names, shapes, np_dtype,
+                                                ps_id)
             shape = shapes[0]
             first = shape[0] if shape else 1
             rest = shape[1:] if shape else ()
@@ -419,12 +493,38 @@ def _reduce(buf, reduce_op, group):
                      "data plane (Adasum rides the host path)")
 
 
-def _build_allreduce(mesh, group, shapes, reduce_op, scales):
-    """One program for the fused group: concat → reduce → split. This IS
-    the fusion buffer — it lives in HBM for the duration of the program
-    and XLA fuses the scale/concat/split elementwise work around the
-    collective (reference analog: MemcpyInFusionBuffer + cuda_kernels.cu,
-    done here by the compiler)."""
+def _build_allreduce_local(reduce_op, scales, donate):
+    """The group-size-1 allreduce program: every reduce op over a single
+    member is the identity (sum/avg/min/max/product of one contribution;
+    Adasum's pairwise combine has no partner), so the compiled program
+    is just the pre/post scales — and with donation, pure buffer
+    aliasing. Original shapes in, original shapes out."""
+
+    def inner(*xs):
+        outs = []
+        for x, (pre, post) in zip(xs, scales):
+            if pre != 1.0:
+                x = x * np.asarray(pre, x.dtype)
+            if post != 1.0:
+                x = x * np.asarray(post, x.dtype)
+            outs.append(x)
+        return tuple(outs)
+
+    return jax.jit(
+        inner,
+        donate_argnums=tuple(range(len(scales))) if donate else ())
+
+
+def _build_allreduce(mesh, group, shapes, reduce_op, scales, donate=False):
+    """One program for the fused group: concat → reduce → split →
+    reshape-to-final. This IS the fusion buffer — it lives in HBM for
+    the duration of the program and XLA fuses the scale/concat/split
+    elementwise work around the collective (reference analog:
+    MemcpyInFusionBuffer + cuda_kernels.cu, done here by the compiler).
+    ``donate=True`` additionally donates the input blocks so the
+    outputs reuse their HBM (reference analog: the in-place fusion
+    buffer — safe only when the frontend promised the inputs are dead,
+    see ``enqueue_device(donate=...)``)."""
     sizes = [max(_nelem(s), 1) for s in shapes]
 
     def inner(*blocks):  # each (1, size_i)
@@ -454,11 +554,15 @@ def _build_allreduce(mesh, group, shapes, reduce_op, scales):
                 off += sz
             if post != 1.0:
                 o = o * np.asarray(post, o.dtype)
-            outs.append(o)
+            # Final shape comes out of the compiled program directly so
+            # the host never reshape-copies the result.
+            outs.append(o.reshape(shapes[i] if shapes[i] else ()))
         return tuple(outs)
 
     k = len(shapes)
-    return jax.jit(_shard_map(inner, mesh, (P("hvd"),) * k, (P(None),) * k))
+    out_specs = tuple(P(*(None,) * len(s)) if s else P() for s in shapes)
+    return jax.jit(_shard_map(inner, mesh, (P("hvd"),) * k, out_specs),
+                   donate_argnums=tuple(range(k)) if donate else ())
 
 
 def _build_broadcast(mesh, root_pos):
@@ -592,15 +696,22 @@ def adasum_device_supported(process_set_id, dtype):
 
 def enqueue_device(kind, array, name, reduce_op=ReduceOp.SUM,
                    prescale_factor=1.0, postscale_factor=1.0, root_rank=0,
-                   process_set_id=0, group_id=-1, group_size=0):
+                   process_set_id=0, group_id=-1, group_size=0,
+                   donate=False):
     """Register the device array and enqueue its negotiation-only request.
 
     The returned DeviceHandle's ``synchronize()`` yields the result as a
     jax array on this rank's device.
+
+    ``donate=True`` (allreduce only) promises the caller will not read
+    ``array`` again: the fused program then donates its HBM to the
+    result, halving the collective's peak footprint. The input array is
+    INVALID afterwards (jax donation semantics) — never set this for
+    buffers aliased outside jax (e.g. the torch dlpack bridge).
     """
     ps_id = int(process_set_id)
     arr = _data_plane.register_input(name, ps_id, array, prescale_factor,
-                                     postscale_factor)
+                                     postscale_factor, donate=donate)
     shape = (ctypes.c_int64 * max(arr.ndim, 1))(*arr.shape)
     dtype = _DTYPE_TO_ENUM[np.dtype(arr.dtype)]
     h = _basics.lib.hvdtpu_enqueue_device(
@@ -616,7 +727,7 @@ def enqueue_device(kind, array, name, reduce_op=ReduceOp.SUM,
 
 def grouped_allreduce_device(tensors, names, reduce_op=ReduceOp.SUM,
                              prescale_factor=1.0, postscale_factor=1.0,
-                             process_set_id=0):
+                             process_set_id=0, donate=False):
     """Atomically-negotiated grouped allreduce on device arrays: all
     tensors fuse into ONE XLA program (reference analog: grouped
     allreduce via group_table.cc, on the device data plane).
@@ -637,5 +748,5 @@ def grouped_allreduce_device(tensors, names, reduce_op=ReduceOp.SUM,
                            prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor,
                            process_set_id=process_set_id, group_id=gid,
-                           group_size=len(tensors))
+                           group_size=len(tensors), donate=donate)
             for t, nm in zip(tensors, names)]

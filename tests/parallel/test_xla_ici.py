@@ -225,6 +225,92 @@ def test_device_grouped_allreduce_atomic():
                      timeout=240) == ["ok"] * 2
 
 
+def _worker_elastic_fast_reinit(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.common.basics import HorovodBasics
+    from horovod_tpu.jax import xla_ici
+
+    hvd.init()
+    try:
+        out = hvd.allreduce(jnp.full((8,), float(rank)), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), sum(range(size)))
+        dp = xla_ici.data_plane()
+        n0 = dp.executable_cache_size()
+        assert n0 > 0
+        # The same-size epoch transition every SURVIVING rank runs in
+        # elastic reset(): core down+up, device plane disable+enable.
+        # Topology unchanged -> the compiled executables must be reused,
+        # not recompiled (SURVEY §7 "cached-topology fast path").
+        HorovodBasics().shutdown()
+        xla_ici.disable()
+        HorovodBasics().init()
+        xla_ici.enable()
+        assert dp.cache_reuses == 1 and dp.cache_invalidations == 0
+        assert dp.executable_cache_size() == n0
+        out = hvd.allreduce(jnp.full((8,), float(rank + 1)), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out),
+                                   sum(range(1, size + 1)))
+        assert dp.executable_cache_size() == n0, \
+            "same-signature collective recompiled after fast re-init"
+        # Topology drift invalidates the lot.
+        dp._retained_topology = ("another", "world")
+        xla_ici.disable()
+        xla_ici.enable()
+        assert dp.cache_invalidations == 1
+        assert dp.executable_cache_size() == 0
+        out = hvd.allreduce(jnp.full((8,), 1.0), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), float(size))
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_elastic_fast_reinit_reuses_executables():
+    assert run_ranks(_worker_elastic_fast_reinit, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
+def _worker_donated_allreduce(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax.optimizer import allreduce_gradients
+
+    hvd.init()
+    try:
+        # Grouped donated allreduce: results exact over repeated steps
+        # (cached donated program replays) and the donated signature is
+        # distinct from the non-donated one.
+        for step in range(3):
+            xs = [jnp.full((16,), float(rank + i + step)) for i in range(3)]
+            hs = hvd.grouped_allreduce_async(
+                xs, [f"don.{i}" for i in range(3)], op=hvd.Sum, donate=True)
+            del xs  # donation contract: no live refs past the collective
+            outs = [h.synchronize() for h in hs]
+            for i, o in enumerate(outs):
+                np.testing.assert_allclose(
+                    np.asarray(o), sum(r + i + step for r in range(size)))
+        # The gradient-tree helper with donation (the bench/optimizer
+        # fast path) — tree in, averaged tree out.
+        grads = {"w": jnp.full((4, 2), float(rank + 1)),
+                 "b": jnp.full((4,), float(rank))}
+        reduced = allreduce_gradients(grads, op=hvd.Average, donate=True)
+        np.testing.assert_allclose(np.asarray(reduced["w"]),
+                                   (size + 1) / 2)
+        np.testing.assert_allclose(np.asarray(reduced["b"]),
+                                   sum(range(size)) / size)
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_donated_allreduce():
+    assert run_ranks(_worker_donated_allreduce, 2, env=_ENV,
+                     timeout=240) == ["ok"] * 2
+
+
 def _worker_process_set(rank, size):
     import jax.numpy as jnp
 
