@@ -71,6 +71,88 @@ _native = None
 _native_failed = False
 
 
+class _NativeBuildPending(Exception):
+    """The op library is building in the background; this process uses
+    the numpy fallback (the build benefits the NEXT process — loading
+    tf2xla kernels after the process's first XLA compile would be
+    silently ignored, so a mid-process hot-load is never attempted)."""
+
+
+def _spawn_background_build(root, lib_dir):
+    """Kick off `make tf` detached, holding the cross-process build lock
+    for the build's lifetime (the lock fd is inherited by the child, and
+    flock follows the open file description, so the lock holds even
+    after this process exits)."""
+    import fcntl
+    import sys
+
+    lock = open(os.path.join(lib_dir, ".tf_build_lock"), "w")
+    try:
+        fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        lock.close()
+        return False  # another process is already building
+    log = open(os.path.join(lib_dir, "tf_build.log"), "w")
+    # A failed build leaves a marker so later processes stop relaunching
+    # the same doomed minutes-long compile (they fall back immediately
+    # and point at the log; delete the marker or run `make tf` by hand
+    # to retry).
+    marker = os.path.join(lib_dir, ".tf_build_failed")
+    subprocess.Popen(
+        ["/bin/sh", "-c",
+         f"make -s tf PYTHON='{sys.executable}' || : > '{marker}'"],
+        cwd=root, stdout=log, stderr=subprocess.STDOUT,
+        start_new_session=True, pass_fds=(lock.fileno(),))
+    log.close()
+    lock.close()  # the child's inherited fd keeps the lock alive
+    return True
+
+
+def _ensure_built(path, root):
+    """Make sure ``path`` exists, building per HOROVOD_TF_NATIVE_BUILD:
+
+    - ``async`` (default): never block init — start a detached
+      background build and raise _NativeBuildPending; THIS process runs
+      the numpy fallback, the next one loads the built library. (A cold
+      `make tf` takes minutes; blocking hvd.init() on it stalled real
+      programs — VERDICT r2.)
+    - ``sync``: the old behavior — build inline under the cross-process
+      lock (deterministic for CI images that pre-warm).
+    - ``0``/``off``: never build; fall back immediately.
+    """
+    if os.path.exists(path):
+        return
+    if not os.path.exists(os.path.join(root, "Makefile")):
+        raise FileNotFoundError(path)
+    mode = os.environ.get("HOROVOD_TF_NATIVE_BUILD", "async").lower()
+    if mode in ("0", "off", "false", "no"):
+        raise FileNotFoundError(f"{path} (builds disabled by "
+                                "HOROVOD_TF_NATIVE_BUILD)")
+    lib_dir = os.path.dirname(path)
+    os.makedirs(lib_dir, exist_ok=True)
+    marker = os.path.join(lib_dir, ".tf_build_failed")
+    if os.path.exists(marker):
+        raise FileNotFoundError(
+            f"{path} (a previous background build FAILED — see "
+            f"{os.path.join(lib_dir, 'tf_build.log')}; delete {marker} "
+            f"or run `make tf` to retry)")
+    if mode == "sync":
+        import fcntl
+        import sys
+
+        # Cross-process lock: concurrently launched ranks must not race
+        # the build.
+        with open(os.path.join(lib_dir, ".tf_build_lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not os.path.exists(path):
+                subprocess.run(
+                    ["make", "-s", "tf", f"PYTHON={sys.executable}"],
+                    cwd=root, check=True, capture_output=True)
+        return
+    _spawn_background_build(root, lib_dir)
+    raise _NativeBuildPending(path)
+
+
 def _load_native():
     """tf.load_op_library the native TF ops, building them on first use.
     Returns the op module or None (numpy fallback)."""
@@ -90,26 +172,17 @@ def _load_native():
         pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         path = os.path.join(pkg, "lib", "libhvdtpu_tf.so")
         try:
-            if not os.path.exists(path):
-                root = os.path.dirname(pkg)
-                if not os.path.exists(os.path.join(root, "Makefile")):
-                    raise FileNotFoundError(path)
-                # Cross-process lock: concurrently launched ranks must not
-                # race the build.
-                import fcntl
-
-                os.makedirs(os.path.join(pkg, "lib"), exist_ok=True)
-                with open(os.path.join(pkg, "lib", ".tf_build_lock"),
-                          "w") as lock:
-                    fcntl.flock(lock, fcntl.LOCK_EX)
-                    if not os.path.exists(path):
-                        import sys
-
-                        subprocess.run(
-                            ["make", "-s", "tf",
-                             f"PYTHON={sys.executable}"],
-                            cwd=root, check=True, capture_output=True)
+            _ensure_built(path, os.path.dirname(pkg))
             _native = tf.load_op_library(path)
+        except _NativeBuildPending:
+            tf.get_logger().warning(
+                "hvdtpu native TF ops are building in the background "
+                "(%s/tf_build.log); THIS process uses the py_function "
+                "fallback (no jit_compile support) — restart once the "
+                "build finishes, or set HOROVOD_TF_NATIVE_BUILD=sync to "
+                "block init on the build instead",
+                os.path.join(pkg, "lib"))
+            _native_failed = True
         except Exception as e:  # missing TF headers, old TF, build break…
             tf.get_logger().warning(
                 "hvdtpu native TF ops unavailable (%s); falling back to "
