@@ -1,12 +1,31 @@
-"""Benchmark entry point (driver contract): ONE JSON line to stdout.
+"""Benchmark entry point (driver contract): JSON lines to stdout.
 
-Measures the flagship llama train step (bf16 compute, remat, fused adam)
-on the available accelerator and reports model-FLOPs utilization. MFU is
-the single-chip analog of the reference's headline metric (scaling
-efficiency ≈ how close to hardware roofline the framework runs —
-docs/benchmarks.rst cites ~90% of linear at 128 GPUs); ``vs_baseline`` is
-measured MFU / 0.40, i.e. 1.0 marks the 40% MFU bar a well-tuned
-transformer stack hits on TPU at this scale.
+Measures llama train steps on the available accelerator and reports
+model-FLOPs utilization. MFU is the single-chip analog of the
+reference's headline metric (scaling efficiency ≈ how close to hardware
+roofline the framework runs — docs/benchmarks.rst cites ~90% of linear
+at 128 GPUs); ``vs_baseline`` is measured MFU / 0.40, i.e. 1.0 marks the
+40% MFU bar a well-tuned transformer stack hits on TPU at this scale.
+
+A plain run emits FOUR rows (the driver tail-parses the LAST line, so
+the pure-bf16 flagship stays last):
+
+1. ``llama_train_step_mfu_mixed`` — 809M, fp32 master weights + fp32
+   adam moments (``parallel.master_weights``): the numerically safe
+   recipe.
+2. ``llama_train_step_mfu_809m`` — the SAME 809M size in pure bf16:
+   the safety cost at fixed size is one subtraction against row 1.
+3. ``llama_train_step_mfu_eager`` — the flagship trained through the
+   EAGER Horovod path: jitted fwd/bwd, then ``hvd.grouped_allreduce``
+   of every gradient over the xla_ici device plane (size=1 exercises
+   enqueue → negotiate → cached-program replay each step, the
+   reference's `DistributedOptimizer` shape — docs/benchmarks.rst
+   measures hvd-wrapped training, not a raw-framework program), then a
+   jitted optimizer apply.
+4. ``llama_train_step_mfu`` — the 1.39B pure-bf16 flagship, one fused
+   SPMD jit step (the round-1/2 headline).
+
+``--mixed`` emits only row 1 (back-compat); ``--quick`` only row 4.
 """
 
 import functools
@@ -37,103 +56,214 @@ def _peak_flops(device):
     return _PEAK["cpu"]
 
 
-def main():
-    mixed = "--mixed" in sys.argv[1:]
-    on_accel = jax.devices()[0].platform != "cpu"
-    if on_accel and mixed:
-        # Mixed-precision flagship: fp32 master weights + fp32 adam
-        # moments (parallel.master_weights), bf16 compute. 12B HBM per
-        # param caps the size near ~850M on one 16G chip — the
-        # numerically safe recipe benched alongside the pure-bf16 one.
-        # param_dtype fp32: the master aliases the init tree (no bf16
-        # rounding of initial weights, no extra init transient).
-        cfg = LlamaConfig(vocab_size=32768, d_model=1536, n_layers=20,
-                          n_heads=24, n_kv_heads=12, d_ff=6144,
-                          dtype="bfloat16", remat="attn",
-                          param_dtype="float32")
-        batch, seq, steps = 4, 2048, 10
-    elif on_accel:
-        # 1.4B decoder: profiled sweet spot for one 16G-HBM chip.
-        # Pure-bf16 parameter storage (param_dtype) halves param/grad/
-        # optimizer HBM and is what lets >1B params fit at all; larger
-        # d_model raises matmul efficiency (0.50 MFU at d2048 vs 0.47 at
-        # d1536/667M fp32 params vs 0.45 at d1024/319M); remat="attn"
-        # beats full remat (the flash kernel makes saving one attention
-        # output per layer enough); d2560 regresses (0.45). Donated
-        # buffers throughout.
-        cfg = LlamaConfig(vocab_size=32768, d_model=2048, n_layers=20,
-                          n_heads=32, n_kv_heads=16, d_ff=8192,
-                          dtype="bfloat16", remat="attn",
-                          param_dtype="bfloat16")
-        batch, seq, steps = 4, 2048, 10
-    else:  # CI / no-accelerator smoke path
-        cfg = LlamaConfig.tiny(dtype="float32")
-        batch, seq, steps = 2, 128, 3
+# 1.4B decoder: profiled sweet spot for one 16G-HBM chip. Pure-bf16
+# parameter storage (param_dtype) halves param/grad/optimizer HBM and is
+# what lets >1B params fit at all; larger d_model raises matmul
+# efficiency (0.50 MFU at d2048 vs 0.47 at d1536/667M fp32 params vs
+# 0.45 at d1024/319M); remat="attn" beats full remat (the flash kernel
+# makes saving one attention output per layer enough); d2560 regresses
+# (0.45). Donated buffers throughout.
+def _flagship_cfg():
+    return LlamaConfig(vocab_size=32768, d_model=2048, n_layers=20,
+                       n_heads=32, n_kv_heads=16, d_ff=8192,
+                       dtype="bfloat16", remat="attn",
+                       param_dtype="bfloat16")
 
-    params = llama_init(cfg, jax.random.PRNGKey(0))
-    tx = optax.adam(3e-4)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
-                                cfg.vocab_size)
-    data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
-    n_params = sum(x.size for x in jax.tree.leaves(params))
 
-    if mixed:
-        from horovod_tpu.parallel import master_weights
+# 809M: the largest size whose fp32 master + fp32 adam moments (12B HBM
+# per param, parallel.master_weights) fit one 16G chip — and therefore
+# the size where mixed-vs-pure compares apples to apples.
+def _same_size_cfg(param_dtype):
+    return LlamaConfig(vocab_size=32768, d_model=1536, n_layers=20,
+                       n_heads=24, n_kv_heads=12, d_ff=6144,
+                       dtype="bfloat16", remat="attn",
+                       param_dtype=param_dtype)
 
-        mw = master_weights(tx)
-        carry = mw.init(params)
-        del params
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def step(carry, data):
-            p = mw.compute_params(carry)
-            loss, grads = jax.value_and_grad(llama_loss)(p, data, cfg)
-            return loss, mw.apply(carry, grads)
-    else:
-        opt = tx.init(params)
-        carry = (params, opt)
-
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def step(carry, data):
-            params, opt = carry
-            loss, grads = jax.value_and_grad(llama_loss)(params, data,
-                                                         cfg)
-            updates, opt = tx.update(grads, opt, params)
-            return loss, (optax.apply_updates(params, updates), opt)
-
-    t0 = time.perf_counter()
-    loss, carry = step(carry, data)
-    # Block on the whole output tree: some PJRT transports surface the
-    # scalar loss before the step's trailing ops finish.
-    jax.block_until_ready((loss, carry))
-    print(f"compile+first step: {time.perf_counter() - t0:.1f}s "
-          f"loss={float(loss):.3f}", file=sys.stderr)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, carry = step(carry, data)
-    jax.block_until_ready((loss, carry))
-    dt = (time.perf_counter() - t0) / steps
+def _mfu_row(metric, label_extra, n_params, cfg, batch, seq, dt):
     tokens_per_step = batch * seq
     # Standard (PaLM appendix B) model-FLOPs: 6N per token plus the
     # 12*L*T*d attention term; remat recompute is NOT credited.
     flops_per_token = (6 * n_params
                        + 12 * cfg.n_layers * seq * cfg.d_model)
-    flops_per_step = flops_per_token * tokens_per_step
-    mfu = flops_per_step / dt / _peak_flops(jax.devices()[0])
-
-    label = "fp32-master mixed precision" if mixed else "pure-bf16"
-    print(json.dumps({
-        "metric": ("llama_train_step_mfu_mixed" if mixed
-                   else "llama_train_step_mfu"),
+    mfu = (flops_per_token * tokens_per_step / dt
+           / _peak_flops(jax.devices()[0]))
+    return {
+        "metric": metric,
         "value": round(mfu, 4),
-        "unit": f"MFU ({n_params/1e6:.0f}M params, {label}, "
+        "unit": f"MFU ({n_params/1e6:.0f}M params, {label_extra}, "
                 f"{tokens_per_step} tok/step, "
                 f"{tokens_per_step/dt:.0f} tok/s, "
                 f"{dt*1e3:.0f} ms/step, "
                 f"{jax.devices()[0].device_kind})",
         "vs_baseline": round(mfu / 0.40, 3),
-    }))
+    }
+
+
+def _data(cfg, batch, seq):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    return {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+
+def _timed(step, carry, data, steps, what):
+    t0 = time.perf_counter()
+    loss, carry = step(carry, data)
+    # Block on the whole output tree: some PJRT transports surface the
+    # scalar loss before the step's trailing ops finish.
+    jax.block_until_ready((loss, carry))
+    print(f"{what}: compile+first step "
+          f"{time.perf_counter() - t0:.1f}s loss={float(loss):.3f}",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, carry = step(carry, data)
+    jax.block_until_ready((loss, carry))
+    dt = (time.perf_counter() - t0) / steps
+    del carry
+    return dt
+
+
+def run_spmd(cfg, batch, seq, steps, metric, label):
+    """One fused jit step: loss + grads + adam, donated buffers."""
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tx = optax.adam(3e-4)
+    carry = (params, tx.init(params))
+    del params
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(carry, data):
+        params, opt = carry
+        loss, grads = jax.value_and_grad(llama_loss)(params, data, cfg)
+        updates, opt = tx.update(grads, opt, params)
+        return loss, (optax.apply_updates(params, updates), opt)
+
+    dt = _timed(step, carry, _data(cfg, batch, seq), steps, metric)
+    return _mfu_row(metric, label, n_params, cfg, batch, seq, dt)
+
+
+def run_mixed(cfg, batch, seq, steps):
+    """fp32 master weights + fp32 adam moments, bf16 compute
+    (parallel.master_weights) — the numerically safe recipe.
+    param_dtype fp32: the master aliases the init tree (no bf16 rounding
+    of initial weights, no extra init transient)."""
+    from horovod_tpu.parallel import master_weights
+
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    mw = master_weights(optax.adam(3e-4))
+    carry = mw.init(params)
+    del params
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(carry, data):
+        p = mw.compute_params(carry)
+        loss, grads = jax.value_and_grad(llama_loss)(p, data, cfg)
+        return loss, mw.apply(carry, grads)
+
+    dt = _timed(step, carry, _data(cfg, batch, seq), steps,
+                "llama_train_step_mfu_mixed")
+    return _mfu_row("llama_train_step_mfu_mixed",
+                    "fp32-master mixed precision", n_params, cfg, batch,
+                    seq, dt)
+
+
+def run_eager(cfg, batch, seq, steps, label):
+    """The eager Horovod path: every step enqueues the full gradient
+    tree on the core (one atomic group), the background thread
+    negotiates it (response-cache bitvector in steady state) and
+    replays the cached fused XLA allreduce program on the chip, then a
+    jitted adam applies the averaged gradients. Reference analog:
+    §3.2's hot loop (torch DistributedOptimizer + NCCL backend)."""
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import xla_ici
+    from horovod_tpu.jax.optimizer import allreduce_gradients
+
+    hvd.init()
+    if not xla_ici.active() and jax.devices()[0].platform != "cpu":
+        xla_ici.enable()
+
+    # COMMITTED to the device from the start: the data plane's staging
+    # device_put commits the gradients, so apply_fn outputs would flip
+    # params from uncommitted to committed after step one — a new jit
+    # signature, i.e. a silent 12 s mid-loop recompile of grad_fn that
+    # once cost this row half its MFU.
+    dev = jax.devices()[0]
+    params = jax.device_put(llama_init(cfg, jax.random.PRNGKey(0)), dev)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tx = optax.adam(3e-4)
+    opt = jax.device_put(tx.init(params), dev)
+
+    grad_fn = jax.jit(
+        lambda p, d: jax.value_and_grad(llama_loss)(p, d, cfg))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def apply_fn(grads, params, opt):
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt
+
+    def step(carry, data):
+        params, opt = carry
+        loss, grads = grad_fn(params, data)
+        # Donated: the fused device program reuses the gradients' HBM.
+        grads = allreduce_gradients(grads, op=hvd.Average, donate=True)
+        params, opt = apply_fn(grads, params, opt)
+        return loss, (params, opt)
+
+    try:
+        dt = _timed(step, (params, opt), _data(cfg, batch, seq), steps,
+                    "llama_train_step_mfu_eager")
+    finally:
+        hvd.shutdown()
+    return _mfu_row("llama_train_step_mfu_eager", label, n_params, cfg,
+                    batch, seq, dt)
+
+
+def main():
+    argv = sys.argv[1:]
+    on_accel = jax.devices()[0].platform != "cpu"
+    if not on_accel:  # CI / no-accelerator smoke path
+        cfg = LlamaConfig.tiny(dtype="float32")
+        print(json.dumps(run_spmd(cfg, 2, 128, 3, "llama_train_step_mfu",
+                                  "cpu smoke")))
+        return
+
+    batch, seq, steps = 4, 2048, 10
+
+    def emit(row):
+        # Print each row AS PRODUCED: a later config failing must not
+        # discard minutes of already-measured rows (the driver
+        # tail-parses the last line, and row order keeps the flagship
+        # last).
+        print(json.dumps(row), flush=True)
+
+    if "--quick" in argv:
+        emit(run_spmd(_flagship_cfg(), batch, seq, steps,
+                      "llama_train_step_mfu", "pure-bf16"))
+    elif "--mixed" in argv:
+        emit(run_mixed(_same_size_cfg("float32"), batch, seq, steps))
+    else:
+        emit(run_mixed(_same_size_cfg("float32"), batch, seq, steps))
+        emit(run_spmd(_same_size_cfg("bfloat16"), batch, seq, steps,
+                      "llama_train_step_mfu_809m", "pure-bf16 same-size"))
+        try:
+            emit(run_eager(_flagship_cfg(), batch, seq, steps,
+                           "pure-bf16 eager hvd"))
+        except Exception as e:  # noqa: BLE001 — HBM headroom is config-
+            # dependent; fall back to the mixed-size config rather than
+            # lose the eager row.
+            print(f"eager flagship failed ({type(e).__name__}: {e}); "
+                  f"retrying at 809M", file=sys.stderr)
+            try:
+                emit(run_eager(_same_size_cfg("bfloat16"), batch, seq,
+                               steps, "pure-bf16 eager hvd (809M)"))
+            except Exception as e2:  # noqa: BLE001
+                print(f"eager 809M also failed ({type(e2).__name__}: "
+                      f"{e2}); continuing without an eager row",
+                      file=sys.stderr)
+        emit(run_spmd(_flagship_cfg(), batch, seq, steps,
+                      "llama_train_step_mfu", "pure-bf16"))
 
 
 if __name__ == "__main__":
