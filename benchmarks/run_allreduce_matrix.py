@@ -1,0 +1,127 @@
+"""Drive benchmarks/allreduce_bench.py over a plane × ranks × payload ×
+grouping matrix and assemble benchmarks/results_r{N}.json.
+
+Reference analog: the reference's perf story benches NCCL at up to 128
+GPUs (``ops/nccl_operations.cc`` scaling claims, docs/benchmarks.rst);
+this matrix is its single-box analog: the xla_ici device plane at 1-4
+ranks (forced-CPU jax devices when no multi-chip hardware — the same
+substrate tests/parallel/test_xla_ici.py uses) plus the host TCP ring,
+cold (first negotiation + compile) vs steady state (response-cache
+bitvector + executable replay).
+
+Usage: python benchmarks/run_allreduce_matrix.py [--out results.json]
+       [--skip-tpu]
+
+Absolute GB/s on a one-core box is scheduler-limited noise for ranks>1
+(every rank shares the core); ratios (cold/steady, grouped/flat) and
+bus_gbps>0 are the meaningful signals there. The single-rank TPU row
+measures real replay latency on the chip.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_case(plane, ranks, size_mb, grouped, iters=10, timeout=600):
+    """One launcher run; returns the parsed JSON row or an error row."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if plane == "xla_ici_cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HOROVOD_XLA_DATA_PLANE"] = "1"
+    elif plane == "host_ring":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HOROVOD_XLA_DATA_PLANE"] = "0"
+    elif plane == "xla_ici_tpu":
+        env.pop("JAX_PLATFORMS", None)
+        env["HOROVOD_XLA_DATA_PLANE"] = "1"
+        # The axon sitecustomize lives on PYTHONPATH; keep it reachable
+        # alongside the repo (clobbering it kills the TPU plugin).
+        axon = "/root/.axon_site"
+        if os.path.isdir(axon):
+            env["PYTHONPATH"] += os.pathsep + axon
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch", "-np",
+           str(ranks), sys.executable,
+           os.path.join(ROOT, "benchmarks", "allreduce_bench.py"),
+           "--size-mb", str(size_mb), "--iters", str(iters)]
+    if grouped:
+        cmd += ["--grouped", str(grouped)]
+    t0 = time.time()
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout, cwd=ROOT)
+    row = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        # launcher prefixes rank output; the JSON row is rank 0's line
+        idx = line.find('{"metric"')
+        if idx >= 0:
+            try:
+                row = json.loads(line[idx:])
+            except json.JSONDecodeError:
+                pass
+    if row is None:
+        return {"metric": "ring_allreduce_bandwidth", "plane": plane,
+                "ranks": ranks, "payload_mb": size_mb, "grouped": grouped,
+                "error": (proc.stderr or proc.stdout)[-400:],
+                "rc": proc.returncode}
+    row["plane_config"] = plane
+    row["wall_s"] = round(time.time() - t0, 1)
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        ROOT, "benchmarks", "results_r03.json"))
+    ap.add_argument("--skip-tpu", action="store_true")
+    args = ap.parse_args()
+
+    cases = [
+        # The headline: device plane at N>1 — fused-program scaling.
+        ("xla_ici_cpu", 2, 8, 0),
+        ("xla_ici_cpu", 2, 64, 0),
+        ("xla_ici_cpu", 4, 8, 0),
+        ("xla_ici_cpu", 4, 64, 0),
+        # 64-tensor fused group through ONE compiled program.
+        ("xla_ici_cpu", 2, 8, 64),
+        ("xla_ici_cpu", 4, 8, 64),
+        # Host TCP ring for continuity with r02.
+        ("host_ring", 2, 8, 0),
+        ("host_ring", 4, 8, 0),
+    ]
+    if not args.skip_tpu:
+        # Real-chip single-rank replay latency (r02 continuity).
+        cases += [("xla_ici_tpu", 1, 8, 0), ("xla_ici_tpu", 1, 64, 0),
+                  ("xla_ici_tpu", 1, 8, 64)]
+
+    rows = []
+    for plane, ranks, mb, grouped in cases:
+        print(f"== {plane} ranks={ranks} {mb}MB grouped={grouped}",
+              file=sys.stderr)
+        row = run_case(plane, ranks, mb, grouped)
+        print(json.dumps(row), file=sys.stderr)
+        rows.append(row)
+
+    out = {
+        "note": ("xla_ici_cpu rows run the REAL device data plane "
+                 "(negotiation + cached fused XLA programs) on forced-CPU "
+                 "jax devices — the no-hardware substrate; on one core, "
+                 "absolute GB/s at ranks>1 is scheduler-bound, so read "
+                 "cold/steady and grouped ratios, not GB/s. xla_ici_tpu "
+                 "rows are the real chip (single rank: replay latency). "
+                 "host_ring rows are the native TCP ring."),
+        "rows": rows,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
