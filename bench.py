@@ -29,6 +29,7 @@ the pure-bf16 flagship stays last):
 """
 
 import functools
+import gc
 import json
 import sys
 import time
@@ -198,7 +199,12 @@ def run_eager(cfg, batch, seq, steps, label):
     grad_fn = jax.jit(
         lambda p, d: jax.value_and_grad(llama_loss)(p, d, cfg))
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    # Grads are NOT donated here: they arrive as donation-ALIASED
+    # outputs of the device-plane identity program, and XLA refuses to
+    # re-donate an aliased buffer (the "donated buffers were not
+    # usable" warning) — listing them would only add noise. params/opt
+    # donation is what matters for the peak.
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def apply_fn(grads, params, opt):
         updates, opt = tx.update(grads, opt, params)
         return optax.apply_updates(params, updates), opt
@@ -235,8 +241,10 @@ def main():
         # Print each row AS PRODUCED: a later config failing must not
         # discard minutes of already-measured rows (the driver
         # tail-parses the last line, and row order keeps the flagship
-        # last).
+        # last). gc between rows returns every stale device buffer
+        # before the next config allocates.
         print(json.dumps(row), flush=True)
+        gc.collect()
 
     if "--quick" in argv:
         emit(run_spmd(_flagship_cfg(), batch, seq, steps,
@@ -244,9 +252,14 @@ def main():
     elif "--mixed" in argv:
         emit(run_mixed(_same_size_cfg("float32"), batch, seq, steps))
     else:
-        emit(run_mixed(_same_size_cfg("float32"), batch, seq, steps))
-        emit(run_spmd(_same_size_cfg("bfloat16"), batch, seq, steps,
-                      "llama_train_step_mfu_809m", "pure-bf16 same-size"))
+        # The eager flagship runs FIRST: its peak is the highest of the
+        # four and earlier runs fragment the device heap enough to OOM
+        # a config that fits cleanly on a virgin heap (observed r3:
+        # standalone fine, post-mixed/809m RESOURCE_EXHAUSTED with zero
+        # live arrays). Retries run OUTSIDE the except blocks — the
+        # live exception's traceback pins the failed attempt's frames
+        # (params, opt, the whole gradient tree).
+        eager_failed = False
         try:
             emit(run_eager(_flagship_cfg(), batch, seq, steps,
                            "pure-bf16 eager hvd"))
@@ -255,13 +268,20 @@ def main():
             # lose the eager row.
             print(f"eager flagship failed ({type(e).__name__}: {e}); "
                   f"retrying at 809M", file=sys.stderr)
+            eager_failed = True
+        if eager_failed:
+            gc.collect()
             try:
                 emit(run_eager(_same_size_cfg("bfloat16"), batch, seq,
                                steps, "pure-bf16 eager hvd (809M)"))
-            except Exception as e2:  # noqa: BLE001
-                print(f"eager 809M also failed ({type(e2).__name__}: "
-                      f"{e2}); continuing without an eager row",
+            except Exception as e:  # noqa: BLE001
+                print(f"eager 809M also failed ({type(e).__name__}: "
+                      f"{e}); continuing without an eager row",
                       file=sys.stderr)
+            gc.collect()
+        emit(run_mixed(_same_size_cfg("float32"), batch, seq, steps))
+        emit(run_spmd(_same_size_cfg("bfloat16"), batch, seq, steps,
+                      "llama_train_step_mfu_809m", "pure-bf16 same-size"))
         emit(run_spmd(_flagship_cfg(), batch, seq, steps,
                       "llama_train_step_mfu", "pure-bf16"))
 

@@ -1,0 +1,71 @@
+"""Llama pretraining through the EAGER Horovod path.
+
+Reference analog: the canonical torch example
+(``examples/pytorch/pytorch_synthetic_benchmark.py``): wrap the
+optimizer, let every step's gradients ride hvd.allreduce. Here the
+same shape in jax terms — jitted fwd/bwd, then a grouped DEVICE-PLANE
+allreduce of the whole gradient tree (one atomic negotiation, one
+cached fused XLA program over ICI), then a jitted optimizer apply.
+Measured round 3 at ~99% of the fully-fused SPMD step on one chip
+(docs/benchmarks.md) — the eager programming model costs ~nothing.
+
+Run:
+    horovodrun -np 4 python examples/jax/jax_llama_eager_hvd.py
+    # or on a TPU pod: horovodrun --tpu-pod python ...
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.jax.functions import broadcast_parameters
+from horovod_tpu.jax.optimizer import allreduce_gradients
+from horovod_tpu.models import LlamaConfig, llama_init, llama_loss
+
+
+def main():
+    hvd.init()
+    cfg = LlamaConfig.tiny(dtype="float32")  # size up on real hardware
+    tx = optax.adam(1e-3)
+
+    # Commit params/opt to the device up front: the data plane's
+    # staging commits gradients, and mixing committed/uncommitted
+    # trees flips the jit signature after the first step (a silent
+    # full recompile — docs/benchmarks.md).
+    dev = jax.local_devices()[0]
+    params = jax.device_put(llama_init(cfg, jax.random.PRNGKey(0)), dev)
+    params = broadcast_parameters(params, root_rank=0)
+    opt = jax.device_put(tx.init(params), dev)
+
+    grad_fn = jax.jit(
+        lambda p, d: jax.value_and_grad(llama_loss)(p, d, cfg))
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def apply_fn(grads, params, opt):
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt
+
+    batch, seq = 8, 128
+    key = jax.random.PRNGKey(hvd.rank())
+    for step in range(30):
+        key, k = jax.random.split(key)
+        tokens = jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
+        data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+        loss, grads = grad_fn(params, data)
+        # One atomic group: negotiation fuses all tensors, the device
+        # plane replays one cached program; donate=True lets it reuse
+        # the gradients' HBM for the averaged results.
+        grads = allreduce_gradients(grads, op=hvd.Average, donate=True)
+        params, opt = apply_fn(grads, params, opt)
+        if step % 10 == 0 and hvd.rank() == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+    if hvd.rank() == 0:
+        print(f"final loss {float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

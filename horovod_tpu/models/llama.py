@@ -204,22 +204,31 @@ def _rope(x, positions, theta):
 
 
 def _attention(q, k, v, mesh, seq_axis, seq_parallel="ring"):
+    # remat="attn" naming: the SP paths name their OUTPUT ("attn_out");
+    # the flash path names its custom-VJP residuals internally
+    # (flash_o/flash_lse) instead — naming the transposed output TOO
+    # would save a ~671 MB duplicate of flash_o at bench shapes (the
+    # transpose is a distinct buffer) for no backward work saved.
     if mesh is not None and seq_axis and mesh.shape.get(seq_axis, 1) > 1:
         if seq_parallel == "ulysses":
             from horovod_tpu.parallel.ulysses import ulysses_self_attention
 
-            return ulysses_self_attention(q, k, v, mesh, causal=True,
-                                          batch_axis=("data", "fsdp"),
-                                          seq_axis=seq_axis)
+            return checkpoint_name(
+                ulysses_self_attention(q, k, v, mesh, causal=True,
+                                       batch_axis=("data", "fsdp"),
+                                       seq_axis=seq_axis), "attn_out")
         if seq_parallel not in ("ring", None):
             raise ValueError(f"unknown seq_parallel {seq_parallel!r}: "
                              "expected 'ring' or 'ulysses'")
-        return ring_self_attention(q, k, v, mesh, causal=True,
-                                   batch_axis=("data", "fsdp"),
-                                   seq_axis=seq_axis)
+        return checkpoint_name(
+            ring_self_attention(q, k, v, mesh, causal=True,
+                                batch_axis=("data", "fsdp"),
+                                seq_axis=seq_axis), "attn_out")
     # Pallas flash kernel on TPU (no T^2 score materialization, so the
-    # layer no longer needs full remat for memory); flash_attention
-    # itself falls back to blockwise_attention off-TPU.
+    # layer no longer needs full remat for memory). flash_attention
+    # owns the remat naming for both of its paths: the pallas kernels
+    # name their VJP residuals (flash_o/flash_lse), the off-TPU
+    # fallback names its output attn_out.
     from horovod_tpu.ops import flash_attention
 
     return flash_attention(q, k, v, causal=True)
@@ -359,10 +368,8 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
                                                c.head_dim)
         q = _rope(q, positions, c.rope_theta)
         kk = _rope(kk, positions, c.rope_theta)
+        # remat="attn" save-names applied inside _attention (per path).
         attn = _attention(q, kk, vv, mesh, seq_axis, c.seq_parallel)
-        # Named for remat="attn": saving this one tensor keeps backward
-        # from re-running the whole attention forward.
-        attn = checkpoint_name(attn, "attn_out")
         x = x + constrain(attn.reshape(bb, tt, -1) @ lp["wo"].astype(dt))
 
         h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)

@@ -219,6 +219,11 @@ def _flash_biased(q, k, v, bias, causal, block_q, block_k):
 
 def _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k):
     b, h, t, d = q.shape
+    # GQA-native: k/v arrive UNREPEATED ([B, Hkv, T, D]); each query
+    # head's block specs index kv-head hi // n_rep, so the n_rep-fold
+    # expansion never materializes in HBM (the repeat would cost a copy
+    # per call and double the saved k/v residuals).
+    n_rep = h // k.shape[1]
     scale = d ** -0.5
     grid = (b, h, t // block_q)
     has_bias = bias is not None
@@ -227,8 +232,10 @@ def _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k):
     in_specs = [
         pl.BlockSpec((None, None, block_q, d),
                      lambda bi, hi, qi: (bi, hi, qi, 0)),
-        pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-        pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, t, d),
+                     lambda bi, hi, qi: (bi, hi // n_rep, 0, 0)),
+        pl.BlockSpec((None, None, t, d),
+                     lambda bi, hi, qi: (bi, hi // n_rep, 0, 0)),
     ]
     args = [q, k, v]
     if has_bias:
@@ -277,6 +284,8 @@ def _flash_biased_fwd(q, k, v, bias, causal, block_q, block_k):
 
 def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k):
     b, h, t, d = q.shape
+    hkv = k.shape[1]
+    n_rep = h // hkv
     scale = d ** -0.5
     has_bias = bias is not None
     delta = (do.astype(jnp.float32)
@@ -289,9 +298,9 @@ def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k):
     in_specs = [
         pl.BlockSpec((None, None, t, d), lambda bi, hi, jk: (bi, hi, 0, 0)),
         pl.BlockSpec((None, None, block_k, d),
-                     lambda bi, hi, jk: (bi, hi, jk, 0)),
+                     lambda bi, hi, jk: (bi, hi // n_rep, jk, 0)),
         pl.BlockSpec((None, None, block_k, d),
-                     lambda bi, hi, jk: (bi, hi, jk, 0)),
+                     lambda bi, hi, jk: (bi, hi // n_rep, jk, 0)),
         pl.BlockSpec((None, None, t, d), lambda bi, hi, jk: (bi, hi, 0, 0)),
         pl.BlockSpec((None, None, t, 1),
                      lambda bi, hi, jk: (bi, hi, 0, 0)),
@@ -302,6 +311,10 @@ def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k):
     if has_bias:
         in_specs.append(bias_spec)
         args.append(bias)
+    # dk/dv come out PER QUERY HEAD ([B, H, T, D]); the sum over each
+    # kv-head's n_rep sharing query heads happens outside the kernel
+    # (one cheap XLA reduction — keeps the kernel free of cross-grid
+    # accumulation state).
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, h, t // block_k),
@@ -313,11 +326,16 @@ def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k):
                          lambda bi, hi, jk: (bi, hi, jk, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), v.dtype),
         ],
         interpret=_INTERPRET,
     )(*args)
+    if n_rep > 1:
+        dk = dk.astype(jnp.float32).reshape(b, hkv, n_rep, t, d) \
+            .sum(axis=2).astype(k.dtype)
+        dv = dv.astype(jnp.float32).reshape(b, hkv, n_rep, t, d) \
+            .sum(axis=2).astype(v.dtype)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
                                   block_k=block_k, causal=causal,
@@ -325,8 +343,10 @@ def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k):
     in_specs = [
         pl.BlockSpec((None, None, block_q, d),
                      lambda bi, hi, qi: (bi, hi, qi, 0)),
-        pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-        pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, t, d),
+                     lambda bi, hi, qi: (bi, hi // n_rep, 0, 0)),
+        pl.BlockSpec((None, None, t, d),
+                     lambda bi, hi, qi: (bi, hi // n_rep, 0, 0)),
         pl.BlockSpec((None, None, block_q, d),
                      lambda bi, hi, qi: (bi, hi, qi, 0)),
         pl.BlockSpec((None, None, block_q, 1),
@@ -386,7 +406,9 @@ def _masked_attention_xla(q, k, v, kv_bias, causal):
 def flash_attention(q, k, v, causal=True, kv_bias=None, block_q=512,
                     block_k=512):
     """Flash attention. q,k,v: [B, T, H, D] (framework layout; kv heads
-    may be fewer — GQA is expanded here). Returns [B, T, H, D].
+    may be fewer — GQA is handled natively: the kernels index kv-head
+    ``query_head // n_rep``, so the expansion never materializes in
+    HBM). Returns [B, T, H, D].
 
     ``kv_bias`` is an optional [B, Tk] f32 additive per-key bias —
     padding masks pass 0 for real keys and a large negative for padding
@@ -403,17 +425,23 @@ def flash_attention(q, k, v, causal=True, kv_bias=None, block_q=512,
         kv_bias = lax.stop_gradient(kv_bias)
     n_rep = q.shape[2] // k.shape[2]
     if jax.devices()[0].platform not in ("tpu", "axon"):
+        # The fallback paths name their output for remat="attn" here —
+        # keeping the naming NEXT TO the platform predicate means a
+        # future fallback reason can't silently lose the saved
+        # activation (the pallas path instead names its VJP residuals,
+        # flash_o/flash_lse, in _flash_fwd).
         if kv_bias is not None:
-            return _masked_attention_xla(q, _repeat_kv(k, n_rep),
-                                         _repeat_kv(v, n_rep), kv_bias,
-                                         causal)
+            return checkpoint_name(
+                _masked_attention_xla(q, _repeat_kv(k, n_rep),
+                                      _repeat_kv(v, n_rep), kv_bias,
+                                      causal), "attn_out")
         from horovod_tpu.parallel.ring_attention import blockwise_attention
 
-        return blockwise_attention(q, k, v, causal=causal)
+        return checkpoint_name(blockwise_attention(q, k, v, causal=causal),
+                               "attn_out")
 
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
-    # [B,T,H,D] -> [B,H,T,D]
+    # [B,T,H,D] -> [B,H,T,D]; k/v stay at Hkv heads — the kernels index
+    # kv-head = query-head // n_rep, so GQA expansion never hits HBM.
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)

@@ -1,0 +1,6 @@
+"""Shared utilities (profiling reports, misc tooling)."""
+
+from horovod_tpu.utils.xplane_report import (  # noqa: F401
+    device_op_report,
+    format_report,
+)

@@ -1,0 +1,155 @@
+"""Device-op time attribution from an xprof (xplane) trace.
+
+Reference analog: the Horovod Timeline (``common/timeline.cc``) shows
+*runtime* phases (negotiation, queue, collective); what it cannot show
+is where the DEVICE time inside an XLA program goes. This tool closes
+that gap TPU-natively: point it at the trace directory written by
+``hvd.start_timeline(..., xprof_dir=...)`` (or any
+``jax.profiler.start_trace`` output) and it aggregates the device
+plane's per-op durations into readable buckets — matmul fusions,
+pallas custom-calls (flash attention), copies, control flow.
+
+TensorBoard isn't required (and isn't in minimal images): the raw
+``*.xplane.pb`` protos are parsed directly via TensorFlow's bundled
+xplane proto. Used in round 3 to find the flash-attention remat
+rerun that cost 12% of the train step (docs/benchmarks.md).
+
+CLI::
+
+    python -m horovod_tpu.utils.xplane_report /tmp/xprof_dir [--top N]
+"""
+
+import glob
+import os
+from collections import defaultdict
+
+# Buckets, first match wins. (name_lower -> bucket)
+_BUCKETS = (
+    (("custom-call", "custom_call", "flash", "pallas"), "custom-call (pallas/host)"),
+    (("while", "condition", "body"), "control flow"),
+    (("copy",), "copy"),
+    (("dot", "convolution"), "matmul/conv fusion"),
+    (("fusion",), "other fusion"),
+    (("transpose", "slice", "pad", "concat", "bitcast", "broadcast",
+      "reshape", "iota", "reduce"), "data movement / reduce"),
+)
+
+
+def _bucket(name):
+    n = name.lower()
+    for keys, label in _BUCKETS:
+        if any(k in n for k in keys):
+            return label
+    return "other"
+
+
+def _load_xspace(path):
+    """Parse one .xplane.pb. TF ships the proto; keep the import local
+    so the package works without TF installed."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:  # pragma: no cover - env without TF
+        raise ImportError(
+            "xplane_report needs the xplane proto bundled with "
+            "tensorflow (tensorflow.tsl.profiler.protobuf)") from e
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def _find_pb(path):
+    if os.path.isfile(path):
+        return [path]
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                            recursive=True))
+    if not hits:
+        raise FileNotFoundError(f"no *.xplane.pb under {path}")
+    return hits
+
+
+def device_op_report(path, plane_filter=("TPU", "GPU"), op_line="XLA Ops"):
+    """Aggregate device-op durations from a trace file or directory.
+
+    Returns a dict per device plane::
+
+        {plane_name: {
+            "total_s": wall-busy seconds on the op line,
+            "buckets": {bucket: seconds, ...},
+            "top_ops": [(op_name, seconds, count), ...],   # descending
+        }}
+
+    Notes: the op line's while/condition/body events NEST their body
+    ops, so "control flow" double-counts against the inner buckets —
+    read it as "time spent inside loops", not additional time. Steps /
+    module totals live on separate lines and are not summed here.
+    """
+    report = {}
+    per_plane_ops = {}
+    for pb in _find_pb(path):
+        xs = _load_xspace(pb)
+        for plane in xs.planes:
+            if plane_filter and not any(p in plane.name
+                                        for p in plane_filter):
+                continue
+            meta = {k: v.name for k, v in plane.event_metadata.items()}
+            for line in plane.lines:
+                if line.name != op_line:
+                    continue
+                entry = report.setdefault(plane.name, {
+                    "total_s": 0.0,
+                    "buckets": defaultdict(float),
+                    "top_ops": [],
+                })
+                # Per-op durations merge ACROSS files (multi-host traces
+                # write one .xplane.pb per host; split rows would
+                # misrank the heaviest op).
+                per_op = per_plane_ops.setdefault(
+                    plane.name, defaultdict(lambda: [0.0, 0]))
+                for ev in line.events:
+                    name = meta.get(ev.metadata_id, "?")
+                    dur = ev.duration_ps / 1e12
+                    entry["buckets"][_bucket(name)] += dur
+                    entry["total_s"] += dur
+                    acc = per_op[name]
+                    acc[0] += dur
+                    acc[1] += 1
+    for plane_name, entry in report.items():
+        entry["buckets"] = dict(entry["buckets"])
+        entry["top_ops"] = sorted(
+            ((n, a[0], a[1])
+             for n, a in per_plane_ops[plane_name].items()),
+            key=lambda t: -t[1])
+    return report
+
+
+def format_report(report, top=10):
+    """Human-readable table for :func:`device_op_report` output."""
+    lines = []
+    for plane, entry in report.items():
+        total = entry["total_s"] or 1e-30
+        lines.append(f"== {plane}: {entry['total_s'] * 1e3:.1f} ms busy "
+                     f"(op line; loops nest their bodies)")
+        for k, v in sorted(entry["buckets"].items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {k:28s} {v * 1e3:10.2f} ms  "
+                         f"{v / total * 100:5.1f}%")
+        if top:
+            lines.append("  -- top ops --")
+            for name, dur, count in entry["top_ops"][:top]:
+                lines.append(f"  {dur * 1e3:10.2f} ms  x{count:<4d} "
+                             f"{name[:90]}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="trace dir or .xplane.pb file")
+    ap.add_argument("--top", type=int, default=10)
+    args = ap.parse_args(argv)
+    print(format_report(device_op_report(args.path), top=args.top))
+
+
+if __name__ == "__main__":
+    main()

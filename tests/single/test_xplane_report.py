@@ -1,0 +1,67 @@
+"""Device-op attribution from xplane traces (the TPU-native half of the
+timeline subsystem: the runtime timeline shows negotiation phases, this
+shows where device time inside XLA programs goes)."""
+
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E402
+
+from horovod_tpu.utils import device_op_report, format_report  # noqa: E402
+
+
+def _make_trace(tmp_path):
+    """Synthetic XSpace with one TPU plane: an XLA Ops line carrying a
+    matmul fusion, a pallas custom-call, and a copy."""
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+    for mid, name in ((1, "%dot_fusion.1"), (2, "%custom-call.flash"),
+                      (3, "%copy.7"), (4, "%while.1")):
+        plane.event_metadata[mid].id = mid
+        plane.event_metadata[mid].name = name
+    line = plane.lines.add()
+    line.name = "XLA Ops"
+    for mid, dur_ms, n in ((1, 30.0, 3), (2, 50.0, 2), (3, 15.0, 5),
+                           (4, 80.0, 1)):
+        for _ in range(n):
+            ev = line.events.add()
+            ev.metadata_id = mid
+            ev.duration_ps = int(dur_ms / n * 1e9)
+    # a line the report must ignore
+    other = plane.lines.add()
+    other.name = "Steps"
+    ev = other.events.add()
+    ev.metadata_id = 1
+    ev.duration_ps = int(1e12)
+    # a non-device plane the filter must skip
+    host = xs.planes.add()
+    host.name = "/host:CPU"
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    (d / "vm.xplane.pb").write_bytes(xs.SerializeToString())
+    return str(tmp_path)
+
+
+def test_device_op_report_buckets_and_top_ops(tmp_path):
+    report = device_op_report(_make_trace(tmp_path))
+    assert list(report) == ["/device:TPU:0"]
+    entry = report["/device:TPU:0"]
+    b = entry["buckets"]
+    assert b["matmul/conv fusion"] == pytest.approx(0.030)
+    assert b["custom-call (pallas/host)"] == pytest.approx(0.050)
+    assert b["copy"] == pytest.approx(0.015)
+    assert b["control flow"] == pytest.approx(0.080)
+    assert entry["total_s"] == pytest.approx(0.175)
+    # top op is the while, then the custom-call
+    assert entry["top_ops"][0][0] == "%while.1"
+    assert entry["top_ops"][1] == ("%custom-call.flash",
+                                   pytest.approx(0.050), 2)
+    text = format_report(report, top=3)
+    assert "custom-call (pallas/host)" in text and "%while.1" in text
+
+
+def test_missing_trace_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        device_op_report(str(tmp_path))
