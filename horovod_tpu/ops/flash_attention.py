@@ -39,10 +39,18 @@ _NEG = -1e30
 _INTERPRET = False
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_k, causal,
-                has_bias):
-    # rest = ([bias_ref,] o_ref, lse_ref) — bias is a per-key additive
-    # f32 row [1, Tk] (padding masks), present only in the bias variant.
+def _fwd_kernel(*refs, scale, block_k, causal, has_bias, has_offsets):
+    # refs = ([offs_ref,] q_ref, k_ref, v_ref, [bias_ref,] o_ref,
+    # lse_ref). bias is a per-key additive f32 row [1, Tk] (padding
+    # masks). offs_ref is an SMEM int32 [2] = (q_offset, kv_offset):
+    # GLOBAL positions for causal masking when the call sees only a
+    # chunk of the sequence (ring attention steps) — dynamic, so one
+    # compiled kernel serves every ring step.
+    if has_offsets:
+        offs_ref, q_ref, k_ref, v_ref, *rest = refs
+    else:
+        (q_ref, k_ref, v_ref), rest = refs[:3], list(refs[3:])
+        offs_ref = None
     if has_bias:
         bias_ref, o_ref, lse_ref = rest
     else:
@@ -58,6 +66,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_k, causal,
     l = jnp.zeros((bq, 1), jnp.float32)
 
     q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    if has_offsets:
+        q_pos = q_pos + offs_ref[0]
+    kv_base = offs_ref[1] if has_offsets else 0
 
     def body(j, carry):
         acc, m, l = carry
@@ -67,7 +78,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_k, causal,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            kv_pos = j * block_k + lax.broadcasted_iota(
+            kv_pos = kv_base + j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
         if has_bias:
@@ -81,10 +92,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_k, causal,
             preferred_element_type=jnp.float32)
         return acc, m_new, l
 
-    if causal:
+    if causal and not has_offsets:
         # Only kv blocks whose start can be <= this q block's last row.
         n_blocks = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k,
                                tk // block_k)
+    elif causal:
+        # Offsets are scalar-prefetched (SMEM) precisely so they can
+        # shape control flow: skip kv blocks that start past this q
+        # block's last GLOBAL row (a causal ring's fully-future chunks
+        # cost zero matmuls instead of fully-masked ones).
+        last_q = offs_ref[0] + (iq + 1) * bq - 1
+        n_blocks = jnp.clip((last_q - offs_ref[1]) // block_k + 1, 0,
+                            tk // block_k)
     else:
         n_blocks = tk // block_k
     acc, m, l = lax.fori_loop(0, n_blocks, body, (acc, m, l))
@@ -94,8 +113,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_k, causal,
     lse_ref[:, :] = m + jnp.log(l)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    *rest, scale, block_q, causal, has_bias):
+def _bwd_dkv_kernel(*refs, scale, block_q, causal, has_bias,
+                    has_offsets):
+    if has_offsets:
+        offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
+            *rest = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest = refs
+        offs_ref = None
     if has_bias:
         bias_ref, dk_ref, dv_ref = rest
     else:
@@ -110,6 +135,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk = jnp.zeros((bk, d), jnp.float32)
     dv = jnp.zeros((bk, d), jnp.float32)
     kv_pos = jk * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    if has_offsets:
+        kv_pos = kv_pos + offs_ref[1]
+    q_base = offs_ref[0] if has_offsets else 0
 
     def body(i, carry):
         dk, dv = carry
@@ -121,7 +149,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qi, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = i * block_q + lax.broadcasted_iota(
+            q_pos = q_base + i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
         if has_bias:
@@ -139,8 +167,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         return dk, dv
 
-    if causal:
+    if causal and not has_offsets:
         start = jnp.maximum(jk * bk // block_q, 0)
+    elif causal:
+        # First q block whose last GLOBAL row reaches this kv block's
+        # global start (mirror of the static bound, shifted by offsets).
+        start = jnp.clip((offs_ref[1] + jk * bk - offs_ref[0]) // block_q,
+                         0, tq // block_q)
     else:
         start = 0
     dk, dv = lax.fori_loop(start, tq // block_q, body, (dk, dv))
@@ -148,8 +181,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:, :] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   *rest, scale, block_k, causal, has_bias):
+def _bwd_dq_kernel(*refs, scale, block_k, causal, has_bias, has_offsets):
+    if has_offsets:
+        offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
+            *rest = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest = refs
+        offs_ref = None
     if has_bias:
         bias_ref, dq_ref = rest
     else:
@@ -165,6 +203,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dq = jnp.zeros((bq, d), jnp.float32)
     q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    if has_offsets:
+        q_pos = q_pos + offs_ref[0]
+    kv_base = offs_ref[1] if has_offsets else 0
 
     def body(j, dq):
         k_blk = k_ref[pl.ds(j * block_k, block_k), :]
@@ -173,7 +214,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            kv_pos = j * block_k + lax.broadcasted_iota(
+            kv_pos = kv_base + j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
         if has_bias:
@@ -187,13 +228,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
+    if causal and not has_offsets:
         n_blocks = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k,
                                tk // block_k)
+    elif causal:
+        last_q = offs_ref[0] + (iq + 1) * bq - 1
+        n_blocks = jnp.clip((last_q - offs_ref[1]) // block_k + 1, 0,
+                            tk // block_k)
     else:
         n_blocks = tk // block_k
     dq = lax.fori_loop(0, n_blocks, body, dq)
     dq_ref[:, :] = dq.astype(dq_ref.dtype)
+
+
+def _pallas_dispatch(kernel, grid, in_specs, out_specs, out_shape, args,
+                     offsets):
+    """Shared fwd/bwd dispatch: plain grid, or scalar-prefetch grid
+    spec when dynamic offsets ride along (the SMEM scalars arrive
+    before the kernel body and every index map)."""
+    if offsets is not None:
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+                out_specs=out_specs),
+            out_shape=out_shape, interpret=_INTERPRET,
+        )(offsets, *args)
+    return pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=_INTERPRET)(*args)
 
 
 def _pick_block(t, want):
@@ -217,8 +280,10 @@ def _flash_biased(q, k, v, bias, causal, block_q, block_k):
     return o
 
 
-def _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k):
+def _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k,
+                    offsets=None):
     b, h, t, d = q.shape
+    tk = k.shape[2]
     # GQA-native: k/v arrive UNREPEATED ([B, Hkv, T, D]); each query
     # head's block specs index kv-head hi // n_rep, so the n_rep-fold
     # expansion never materializes in HBM (the repeat would cost a copy
@@ -227,38 +292,37 @@ def _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k):
     scale = d ** -0.5
     grid = (b, h, t // block_q)
     has_bias = bias is not None
+    has_offsets = offsets is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                               causal=causal, has_bias=has_bias)
+                               causal=causal, has_bias=has_bias,
+                               has_offsets=has_offsets)
+    # With scalar prefetch the index maps receive the scalar ref as a
+    # trailing arg; *a soaks it up either way.
     in_specs = [
         pl.BlockSpec((None, None, block_q, d),
-                     lambda bi, hi, qi: (bi, hi, qi, 0)),
-        pl.BlockSpec((None, None, t, d),
-                     lambda bi, hi, qi: (bi, hi // n_rep, 0, 0)),
-        pl.BlockSpec((None, None, t, d),
-                     lambda bi, hi, qi: (bi, hi // n_rep, 0, 0)),
+                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, tk, d),
+                     lambda bi, hi, qi, *a: (bi, hi // n_rep, 0, 0)),
+        pl.BlockSpec((None, None, tk, d),
+                     lambda bi, hi, qi, *a: (bi, hi // n_rep, 0, 0)),
     ]
     args = [q, k, v]
     if has_bias:
         in_specs.append(
-            pl.BlockSpec((None, 1, t), lambda bi, hi, qi: (bi, 0, 0)))
+            pl.BlockSpec((None, 1, tk), lambda bi, hi, qi, *a: (bi, 0, 0)))
         args.append(bias)
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, block_q, 1),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
-        ],
-        interpret=_INTERPRET,
-    )(*args)
-    return o, lse
+    out_specs = [
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, block_q, 1),
+                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+    ]
+    return _pallas_dispatch(kernel, grid, in_specs, out_specs, out_shape,
+                            args, offsets)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
@@ -282,91 +346,100 @@ def _flash_biased_fwd(q, k, v, bias, causal, block_q, block_k):
     return o, (q, k, v, bias, o, lse)
 
 
-def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k):
+def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k,
+                    offsets=None, dlse=None):
     b, h, t, d = q.shape
     hkv = k.shape[1]
+    tk = k.shape[2]
     n_rep = h // hkv
     scale = d ** -0.5
     has_bias = bias is not None
+    has_offsets = offsets is not None
     delta = (do.astype(jnp.float32)
              * o.astype(jnp.float32)).sum(-1, keepdims=True)
-    bias_spec = pl.BlockSpec((None, 1, t), lambda bi, hi, gi: (bi, 0, 0))
+    if dlse is not None:
+        # An incoming lse cotangent folds into delta: ds = p*(dp - delta)
+        # becomes p*(dp - delta + dlse), i.e. delta -= dlse.
+        delta = delta - dlse.astype(jnp.float32)
+    bias_spec = pl.BlockSpec((None, 1, tk),
+                             lambda bi, hi, gi, *a: (bi, 0, 0))
+
+    def call(kernel, grid, in_specs, out_specs, out_shape, args):
+        return _pallas_dispatch(kernel, grid, in_specs, out_specs,
+                                out_shape, args, offsets)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
                                    block_q=block_q, causal=causal,
-                                   has_bias=has_bias)
+                                   has_bias=has_bias,
+                                   has_offsets=has_offsets)
     in_specs = [
-        pl.BlockSpec((None, None, t, d), lambda bi, hi, jk: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, t, d),
+                     lambda bi, hi, jk, *a: (bi, hi, 0, 0)),
         pl.BlockSpec((None, None, block_k, d),
-                     lambda bi, hi, jk: (bi, hi // n_rep, jk, 0)),
+                     lambda bi, hi, jk, *a: (bi, hi // n_rep, jk, 0)),
         pl.BlockSpec((None, None, block_k, d),
-                     lambda bi, hi, jk: (bi, hi // n_rep, jk, 0)),
-        pl.BlockSpec((None, None, t, d), lambda bi, hi, jk: (bi, hi, 0, 0)),
+                     lambda bi, hi, jk, *a: (bi, hi // n_rep, jk, 0)),
+        pl.BlockSpec((None, None, t, d),
+                     lambda bi, hi, jk, *a: (bi, hi, 0, 0)),
         pl.BlockSpec((None, None, t, 1),
-                     lambda bi, hi, jk: (bi, hi, 0, 0)),
+                     lambda bi, hi, jk, *a: (bi, hi, 0, 0)),
         pl.BlockSpec((None, None, t, 1),
-                     lambda bi, hi, jk: (bi, hi, 0, 0)),
+                     lambda bi, hi, jk, *a: (bi, hi, 0, 0)),
     ]
     args = [q, k, v, do, lse, delta]
     if has_bias:
         in_specs.append(bias_spec)
         args.append(bias)
-    # dk/dv come out PER QUERY HEAD ([B, H, T, D]); the sum over each
+    # dk/dv come out PER QUERY HEAD ([B, H, Tk, D]); the sum over each
     # kv-head's n_rep sharing query heads happens outside the kernel
     # (one cheap XLA reduction — keeps the kernel free of cross-grid
     # accumulation state).
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(b, h, t // block_k),
-        in_specs=in_specs,
-        out_specs=[
+    dk, dv = call(
+        dkv_kernel, (b, h, tk // block_k), in_specs,
+        [
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, jk: (bi, hi, jk, 0)),
+                         lambda bi, hi, jk, *a: (bi, hi, jk, 0)),
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, jk: (bi, hi, jk, 0)),
+                         lambda bi, hi, jk, *a: (bi, hi, jk, 0)),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, t, d), v.dtype),
+        [
+            jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, d), v.dtype),
         ],
-        interpret=_INTERPRET,
-    )(*args)
+        args)
     if n_rep > 1:
-        dk = dk.astype(jnp.float32).reshape(b, hkv, n_rep, t, d) \
+        dk = dk.astype(jnp.float32).reshape(b, hkv, n_rep, tk, d) \
             .sum(axis=2).astype(k.dtype)
-        dv = dv.astype(jnp.float32).reshape(b, hkv, n_rep, t, d) \
+        dv = dv.astype(jnp.float32).reshape(b, hkv, n_rep, tk, d) \
             .sum(axis=2).astype(v.dtype)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
                                   block_k=block_k, causal=causal,
-                                  has_bias=has_bias)
+                                  has_bias=has_bias,
+                                  has_offsets=has_offsets)
     in_specs = [
         pl.BlockSpec((None, None, block_q, d),
-                     lambda bi, hi, qi: (bi, hi, qi, 0)),
-        pl.BlockSpec((None, None, t, d),
-                     lambda bi, hi, qi: (bi, hi // n_rep, 0, 0)),
-        pl.BlockSpec((None, None, t, d),
-                     lambda bi, hi, qi: (bi, hi // n_rep, 0, 0)),
+                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, tk, d),
+                     lambda bi, hi, qi, *a: (bi, hi // n_rep, 0, 0)),
+        pl.BlockSpec((None, None, tk, d),
+                     lambda bi, hi, qi, *a: (bi, hi // n_rep, 0, 0)),
         pl.BlockSpec((None, None, block_q, d),
-                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
         pl.BlockSpec((None, None, block_q, 1),
-                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
         pl.BlockSpec((None, None, block_q, 1),
-                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
     ]
     args = [q, k, v, do, lse, delta]
     if has_bias:
         in_specs.append(bias_spec)
         args.append(bias)
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(b, h, t // block_q),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, None, block_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=_INTERPRET,
-    )(*args)
+    dq = call(
+        dq_kernel, (b, h, t // block_q), in_specs,
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+        jax.ShapeDtypeStruct(q.shape, q.dtype), args)
     return dq, dk, dv
 
 
@@ -387,6 +460,60 @@ def _flash_biased_bwd(causal, block_q, block_k, res, do):
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 _flash_biased.defvjp(_flash_biased_fwd, _flash_biased_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_offsets(q, k, v, offsets, causal, block_q, block_k):
+    """Flash attention over a K/V CHUNK with dynamic global-position
+    offsets (SMEM scalars — one compiled kernel serves every ring
+    step). Returns (o, lse): the normalized chunk output plus its
+    logsumexp, exactly what ring attention's online-softmax merge
+    needs. q [B,H,Tq,D]; k,v [B,Hkv,Tk,D]; offsets int32 [2] =
+    (global q start, global kv start)."""
+    return _flash_fwd_impl(q, k, v, None, causal, block_q, block_k,
+                           offsets=offsets)
+
+
+def _flash_offsets_fwd(q, k, v, offsets, causal, block_q, block_k):
+    o, lse = _flash_fwd_impl(q, k, v, None, causal, block_q, block_k,
+                             offsets=offsets)
+    # Same residual naming as _flash_fwd: without it, remat="attn"
+    # re-runs every ring step's forward kernel in backward just to
+    # regenerate these (n ring steps per layer).
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
+    return (o, lse), (q, k, v, offsets, o, lse)
+
+
+def _flash_offsets_bwd(causal, block_q, block_k, res, cts):
+    q, k, v, offsets, o, lse = res
+    do, dlse = cts
+    dq, dk, dv = _flash_bwd_impl(q, k, v, None, o, lse, do, causal,
+                                 block_q, block_k, offsets=offsets,
+                                 dlse=dlse)
+    import numpy as _np
+
+    d_offs = _np.zeros(offsets.shape, jax.dtypes.float0)
+    return dq, dk, dv, d_offs
+
+
+_flash_offsets.defvjp(_flash_offsets_fwd, _flash_offsets_bwd)
+
+
+def flash_attention_chunk(q, k, v, q_offset, kv_offset, causal=True,
+                          block_q=512, block_k=512):
+    """One ring-attention step on the pallas kernels: attention of the
+    local queries against ONE K/V chunk, with global positions for the
+    causal mask. Layout [B, H(q)/Hkv(kv), T, D] (kernel layout — ring
+    loops keep tensors there to avoid per-step transposes). Returns
+    ``(o, lse)`` ready for logsumexp merging; differentiable (the lse
+    cotangent folds into the backward's delta).
+    """
+    bq = _pick_block(q.shape[2], block_q)
+    bk = _pick_block(k.shape[2], block_k)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(kv_offset, jnp.int32)])
+    return _flash_offsets(q, k, v, offsets, causal, bq, bk)
 
 
 def _masked_attention_xla(q, k, v, kv_bias, causal):
