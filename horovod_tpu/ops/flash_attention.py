@@ -551,7 +551,9 @@ def flash_attention(q, k, v, causal=True, kv_bias=None, block_q=512,
     if kv_bias is not None:
         kv_bias = lax.stop_gradient(kv_bias)
     n_rep = q.shape[2] // k.shape[2]
-    if jax.devices()[0].platform not in ("tpu", "axon"):
+    # _INTERPRET forces the pallas path off-TPU so tests cover the real
+    # kernel code (interpret mode) instead of the fallback.
+    if not _INTERPRET and jax.devices()[0].platform not in ("tpu", "axon"):
         # The fallback paths name their output for remat="attn" here —
         # keeping the naming NEXT TO the platform predicate means a
         # future fallback reason can't silently lose the saved

@@ -28,14 +28,20 @@ from horovod_tpu.parallel.ring_attention import (
 )
 
 
-def ulysses_attention(q, k, v, axis_name, causal=True):
+def ulysses_attention(q, k, v, axis_name, causal=True, use_flash=None):
     """Exact attention with sequence sharded over mesh axis ``axis_name``.
 
     Must run inside shard_map with the sequence dimension sharded
     contiguously across the axis. Local shards: q [B, T/P, H, D];
     k, v [B, T/P, Hkv, D]. Requires H % P == 0; when P does not divide
     Hkv, K/V heads are replicated up to lcm(Hkv, P) first.
+
+    ``use_flash`` (default: auto — True on TPU) runs the post-all-to-all
+    local attention through the pallas flash kernels (which handle the
+    remaining GQA grouping natively) instead of the XLA blockwise math.
     """
+    if use_flash is None:
+        use_flash = jax.devices()[0].platform in ("tpu", "axon")
     n = lax.axis_size(axis_name)
     h = q.shape[2]
     if h % n != 0:
@@ -45,8 +51,8 @@ def ulysses_attention(q, k, v, axis_name, causal=True):
     if k.shape[2] % n != 0:
         # GQA head count not divisible by the axis: replicate K/V only up
         # to lcm(Hkv, P). Both Hkv and P divide H, so the lcm does too,
-        # and the local blockwise attention re-expands the remaining
-        # grouping — moving H/lcm× less K/V than replicating to H.
+        # and the local attention re-expands the remaining grouping —
+        # moving H/lcm× less K/V than replicating to H.
         target = k.shape[2] * n // math.gcd(k.shape[2], n)
         k = _repeat_kv(k, target // k.shape[2])
         v = _repeat_kv(v, target // v.shape[2])
@@ -56,7 +62,12 @@ def ulysses_attention(q, k, v, axis_name, causal=True):
                               tiled=True)
 
     qg, kg, vg = to_heads(q), to_heads(k), to_heads(v)
-    out = blockwise_attention(qg, kg, vg, causal=causal)
+    if use_flash:
+        from horovod_tpu.ops import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=causal)
+    else:
+        out = blockwise_attention(qg, kg, vg, causal=causal)
     # [B, T, H/P, D] -> [B, T/P, H, D]
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)

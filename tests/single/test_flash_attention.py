@@ -207,9 +207,43 @@ def test_ring_attention_flash_path_matches_blockwise(causal):
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_path_matches_blockwise(causal):
+    """Ulysses' post-all-to-all local attention through the pallas
+    kernels (interpret) must match its blockwise path — incl. the GQA
+    grouping that survives the head split."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.parallel.ulysses import ulysses_attention
+
+    n_dev = 2
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
+    B, T, H, HKV, D = 1, 64, 4, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, HKV, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, HKV, D), jnp.float32)
+    spec = P(None, "seq", None, None)
+
+    def run(use_flash):
+        @jax.shard_map(mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+        def _r(ql, kl, vl):
+            return ulysses_attention(ql, kl, vl, "seq", causal=causal,
+                                     use_flash=use_flash)
+
+        return _r
+
+    np.testing.assert_allclose(np.asarray(run(True)(q, k, v)),
+                               np.asarray(run(False)(q, k, v)),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_public_api_mask_via_fallback():
-    # flash_attention() on CPU routes kv_bias through the XLA fallback;
-    # same math as the kernels (framework [B,T,H,D] layout).
+    # flash_attention() with kv_bias through the public API (framework
+    # [B,T,H,D] layout); under the _INTERPRET fixture this drives the
+    # biased pallas kernel on CPU (without it, the XLA fallback — same
+    # math either way).
     B, T, H, D = 2, 16, 2, 8
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
     q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32)
