@@ -856,7 +856,8 @@ int hvdtpu_enqueue_grouped_allreduce(int num_tensors, const char** names,
 
 int hvdtpu_enqueue_allgather(const char* name, const void* input, int ndim,
                              const int64_t* shape, int dtype,
-                             int process_set_id) {
+                             int process_set_id, int group_id,
+                             int group_size) {
   CHECK_INIT(-1)
   TensorTableEntry e;
   e.name = name;
@@ -870,6 +871,11 @@ int hvdtpu_enqueue_allgather(const char* name, const void* input, int ndim,
   m.tensor_type = e.dtype;
   m.tensor_shape = e.shape;
   m.process_set_id = process_set_id;
+  // Atomic group negotiation (hvd.grouped_allgather): same promotion
+  // machinery as grouped allreduce; responses stay per-tensor (only
+  // allreduce buffer-fuses), so execution paths are unchanged.
+  m.group_id = group_id;
+  m.group_size = group_id >= 0 ? group_size : 0;
   return EnqueueEntry(std::move(e), std::move(m));
 }
 
@@ -925,7 +931,8 @@ int hvdtpu_enqueue_alltoall(const char* name, const void* input, int ndim,
 int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
                                  const int64_t* shape, int dtype,
                                  int reduce_op, double prescale,
-                                 double postscale, int process_set_id) {
+                                 double postscale, int process_set_id,
+                                 int group_id, int group_size) {
   CHECK_INIT(-1)
   TensorTableEntry e;
   e.name = name;
@@ -943,6 +950,8 @@ int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
   m.tensor_shape = e.shape;
   m.reduce_op = e.reduce_op;
   m.process_set_id = process_set_id;
+  m.group_id = group_id;
+  m.group_size = group_id >= 0 ? group_size : 0;
   return EnqueueEntry(std::move(e), std::move(m));
 }
 

@@ -40,7 +40,8 @@ int hvdtpu_enqueue_grouped_allreduce(int num_tensors, const char** names,
                                      int* handles_out);
 int hvdtpu_enqueue_allgather(const char* name, const void* input, int ndim,
                              const int64_t* shape, int dtype,
-                             int process_set_id);
+                             int process_set_id, int group_id,
+                             int group_size);
 int hvdtpu_enqueue_broadcast(const char* name, void* buffer, int ndim,
                              const int64_t* shape, int dtype, int root_rank,
                              int process_set_id);
@@ -50,7 +51,8 @@ int hvdtpu_enqueue_alltoall(const char* name, const void* input, int ndim,
 int hvdtpu_enqueue_reducescatter(const char* name, const void* input, int ndim,
                                  const int64_t* shape, int dtype,
                                  int reduce_op, double prescale,
-                                 double postscale, int process_set_id);
+                                 double postscale, int process_set_id,
+                                 int group_id, int group_size);
 int hvdtpu_enqueue_barrier(int process_set_id);
 
 // Device data plane (xla_ici backend). Python registers one callback

@@ -67,7 +67,7 @@ def load_library():
             ctypes.POINTER(i32), ctypes.POINTER(i64p), i32, i32, dbl, dbl,
             i32, ctypes.POINTER(i32)]
         lib.hvdtpu_enqueue_allgather.restype = i32
-        lib.hvdtpu_enqueue_allgather.argtypes = [cstr, p, i32, i64p, i32, i32]
+        lib.hvdtpu_enqueue_allgather.argtypes = [cstr, p, i32, i64p, i32, i32, i32, i32]
         lib.hvdtpu_enqueue_broadcast.restype = i32
         lib.hvdtpu_enqueue_broadcast.argtypes = [cstr, p, i32, i64p, i32, i32,
                                                  i32]
@@ -76,7 +76,7 @@ def load_library():
                                                 i32]
         lib.hvdtpu_enqueue_reducescatter.restype = i32
         lib.hvdtpu_enqueue_reducescatter.argtypes = [
-            cstr, p, i32, i64p, i32, i32, dbl, dbl, i32]
+            cstr, p, i32, i64p, i32, i32, dbl, dbl, i32, i32, i32]
         lib.hvdtpu_enqueue_barrier.restype = i32
         lib.hvdtpu_enqueue_barrier.argtypes = [i32]
         lib.hvdtpu_set_device_callback.restype = i32

@@ -218,13 +218,27 @@ def grouped_allreduce_async(arrays, names, op=ReduceOp.SUM,
     return handles
 
 
-def allgather_async(array, name, process_set_id=0):
+def allgather_async(array, name, process_set_id=0, group_id=-1,
+                    group_size=0):
     arr = _as_contig(array)
     lib = _basics.lib
     h = lib.hvdtpu_enqueue_allgather(
         name.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.ndim,
-        _shape_array(arr.shape), _dtype_enum(arr.dtype), int(process_set_id))
+        _shape_array(arr.shape), _dtype_enum(arr.dtype), int(process_set_id),
+        int(group_id), int(group_size))
     return Handle(_check_handle(h, "allgather"), (arr,), None, True, arr.dtype)
+
+
+def grouped_allgather_async(arrays, names, process_set_id=0):
+    """Allgather a list of tensors as ONE negotiation group: the
+    coordinator holds every member until the whole group is ready on all
+    ranks, so the gathers complete atomically (reference analog:
+    hvd.grouped_allgather; group_table.cc machinery — responses stay
+    per-tensor, only allreduce buffer-fuses)."""
+    gid = _basics.lib.hvdtpu_next_group_id() if len(arrays) > 1 else -1
+    return [allgather_async(a, n, process_set_id=process_set_id,
+                            group_id=gid, group_size=len(arrays))
+            for a, n in zip(arrays, names)]
 
 
 def broadcast_async(array, root_rank, name, process_set_id=0):
@@ -256,15 +270,28 @@ def alltoall_async(array, splits, name, process_set_id=0):
 
 
 def reducescatter_async(array, name, op=ReduceOp.SUM, prescale_factor=1.0,
-                        postscale_factor=1.0, process_set_id=0):
+                        postscale_factor=1.0, process_set_id=0,
+                        group_id=-1, group_size=0):
     arr = _as_contig(array)
     lib = _basics.lib
     h = lib.hvdtpu_enqueue_reducescatter(
         name.encode(), arr.ctypes.data_as(ctypes.c_void_p), arr.ndim,
         _shape_array(arr.shape), _dtype_enum(arr.dtype), int(op),
-        float(prescale_factor), float(postscale_factor), int(process_set_id))
+        float(prescale_factor), float(postscale_factor), int(process_set_id),
+        int(group_id), int(group_size))
     return Handle(_check_handle(h, "reducescatter"), (arr,), None, True,
                   arr.dtype)
+
+
+def grouped_reducescatter_async(arrays, names, op=ReduceOp.SUM,
+                                process_set_id=0):
+    """Reduce-scatter a list of tensors as ONE negotiation group
+    (atomic completion; see grouped_allgather_async)."""
+    gid = _basics.lib.hvdtpu_next_group_id() if len(arrays) > 1 else -1
+    return [reducescatter_async(a, n, op=op,
+                                process_set_id=process_set_id,
+                                group_id=gid, group_size=len(arrays))
+            for a, n in zip(arrays, names)]
 
 
 def barrier(process_set_id=0):

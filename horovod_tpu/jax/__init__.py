@@ -53,8 +53,12 @@ from horovod_tpu.jax.mpi_ops import (  # noqa: F401
     broadcast_async,
     cross_rank,
     cross_size,
+    grouped_allgather,
+    grouped_allgather_async,
     grouped_allreduce,
     grouped_allreduce_async,
+    grouped_reducescatter,
+    grouped_reducescatter_async,
     init,
     is_homogeneous,
     is_initialized,

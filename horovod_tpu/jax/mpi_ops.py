@@ -249,6 +249,32 @@ def allgather(tensor, name=None, process_set_id=0):
     return allgather_async(tensor, name, process_set_id).synchronize()
 
 
+def grouped_allgather_async(tensors, names=None, process_set_id=0):
+    """Allgather a list of tensors as ONE negotiation group: atomic
+    completion across ranks (reference analog: hvd.grouped_allgather;
+    same group-promotion machinery as grouped allreduce — responses
+    stay per-tensor, only allreduce buffer-fuses)."""
+    if names is None:
+        base = _auto_name("grouped_allgather")
+        names = [f"{base}.{i}" for i in range(len(tensors))]
+    if tensors and all(_device_path(t) for t in tensors):
+        gid = (_basics.lib.hvdtpu_next_group_id()
+               if len(tensors) > 1 else -1)
+        return [xla_ici.enqueue_device(
+                    "allgather", t, nm, process_set_id=process_set_id,
+                    group_id=gid, group_size=len(tensors))
+                for t, nm in zip(tensors, names)]
+    arrs = [_to_host(t) for t in tensors]
+    inners = eager_ops.grouped_allgather_async(
+        arrs, list(names), process_set_id=process_set_id)
+    return [Handle(i) for i in inners]
+
+
+def grouped_allgather(tensors, names=None, process_set_id=0):
+    handles = grouped_allgather_async(tensors, names, process_set_id)
+    return [h.synchronize() for h in handles]
+
+
 def broadcast_async(tensor, root_rank, name=None, process_set_id=0):
     if _device_path(tensor):
         return xla_ici.enqueue_device(
@@ -312,6 +338,35 @@ def reducescatter(tensor, name=None, op=Average, prescale_factor=1.0,
                   postscale_factor=1.0, process_set_id=0):
     return reducescatter_async(tensor, name, op, prescale_factor,
                                postscale_factor, process_set_id).synchronize()
+
+
+def grouped_reducescatter_async(tensors, names=None, op=Average,
+                                process_set_id=0):
+    """Reduce-scatter a list of tensors as ONE negotiation group
+    (atomic completion; reference analog: hvd.grouped_reducescatter)."""
+    if names is None:
+        base = _auto_name("grouped_reducescatter")
+        names = [f"{base}.{i}" for i in range(len(tensors))]
+    if (tensors and op != Adasum
+            and all(_device_path(t, op) for t in tensors)):
+        gid = (_basics.lib.hvdtpu_next_group_id()
+               if len(tensors) > 1 else -1)
+        return [xla_ici.enqueue_device(
+                    "reducescatter", t, nm, reduce_op=op,
+                    process_set_id=process_set_id, group_id=gid,
+                    group_size=len(tensors))
+                for t, nm in zip(tensors, names)]
+    arrs = [_to_host(t) for t in tensors]
+    inners = eager_ops.grouped_reducescatter_async(
+        arrs, list(names), op=op, process_set_id=process_set_id)
+    return [Handle(i) for i in inners]
+
+
+def grouped_reducescatter(tensors, names=None, op=Average,
+                          process_set_id=0):
+    handles = grouped_reducescatter_async(tensors, names, op,
+                                          process_set_id)
+    return [h.synchronize() for h in handles]
 
 
 def synchronize(handle):

@@ -49,8 +49,12 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     broadcast_async_,
     cross_rank,
     cross_size,
+    grouped_allgather,
+    grouped_allgather_async,
     grouped_allreduce_,
     grouped_allreduce_async_,
+    grouped_reducescatter,
+    grouped_reducescatter_async,
     init,
     is_initialized,
     local_rank,

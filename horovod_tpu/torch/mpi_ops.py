@@ -324,6 +324,33 @@ def allgather(tensor, name=None, process_set_id=0):
     return allgather_async(tensor, name, process_set_id).synchronize()
 
 
+def grouped_allgather_async(tensors, names=None, process_set_id=0):
+    """Allgather a list of tensors as ONE atomic negotiation group
+    (reference analog: hvd.grouped_allgather)."""
+    if names is None:
+        base = _auto_name("grouped_allgather")
+        names = [f"{base}.{i}" for i in range(len(tensors))]
+    if (tensors and all(_use_device_bridge(t) for t in tensors)
+            and not any(_jax_canonicalizes(t.dtype) for t in tensors)):
+        from horovod_tpu.jax import mpi_ops as _jax_ops
+
+        _probe_device_plane()
+        handles = _jax_ops.grouped_allgather_async(
+            [_to_jax(t) for t in tensors], names=list(names),
+            process_set_id=process_set_id)
+        return [_BridgeHandle(h, like=t)
+                for h, t in zip(handles, tensors)]
+    views = [np.array(_np_view(t), copy=True) for t in tensors]
+    inners = eager_ops.grouped_allgather_async(
+        views, list(names), process_set_id=process_set_id)
+    return [Handle(i, like=t) for i, t in zip(inners, tensors)]
+
+
+def grouped_allgather(tensors, names=None, process_set_id=0):
+    hs = grouped_allgather_async(tensors, names, process_set_id)
+    return [h.synchronize() for h in hs]
+
+
 def broadcast_async_(tensor, root_rank, name=None, process_set_id=0):
     if _use_device_bridge(tensor):
         return _bridge_async(
@@ -392,6 +419,35 @@ def reducescatter_async(tensor, name=None, op=Average, process_set_id=0):
 def reducescatter(tensor, name=None, op=Average, process_set_id=0):
     return reducescatter_async(tensor, name, op,
                                process_set_id).synchronize()
+
+
+def grouped_reducescatter_async(tensors, names=None, op=Average,
+                                process_set_id=0):
+    """Reduce-scatter a list of tensors as ONE atomic negotiation group
+    (reference analog: hvd.grouped_reducescatter)."""
+    if names is None:
+        base = _auto_name("grouped_reducescatter")
+        names = [f"{base}.{i}" for i in range(len(tensors))]
+    if (tensors and all(_use_device_bridge(t) for t in tensors)
+            and not any(_jax_canonicalizes(t.dtype) for t in tensors)):
+        from horovod_tpu.jax import mpi_ops as _jax_ops
+
+        _probe_device_plane()
+        handles = _jax_ops.grouped_reducescatter_async(
+            [_to_jax(t) for t in tensors], names=list(names), op=op,
+            process_set_id=process_set_id)
+        return [_BridgeHandle(h, like=t)
+                for h, t in zip(handles, tensors)]
+    views = [np.array(_np_view(t), copy=True) for t in tensors]
+    inners = eager_ops.grouped_reducescatter_async(
+        views, list(names), op=op, process_set_id=process_set_id)
+    return [Handle(i, like=t) for i, t in zip(inners, tensors)]
+
+
+def grouped_reducescatter(tensors, names=None, op=Average,
+                          process_set_id=0):
+    hs = grouped_reducescatter_async(tensors, names, op, process_set_id)
+    return [h.synchronize() for h in hs]
 
 
 def synchronize(handle):

@@ -63,6 +63,21 @@ def _worker_ops(rank, size):
         assert torch.allclose(rs, torch.full((2, 3),
                                              float(sum(range(1, size + 1)))))
 
+        # grouped allgather / reducescatter (atomic negotiation)
+        gouts = hvd.grouped_allgather(
+            [torch.full((rank + 1, 2), float(rank + i)) for i in range(3)])
+        for i, g in enumerate(gouts):
+            exp = np.concatenate(
+                [np.full((rk + 1, 2), float(rk + i)) for rk in range(size)])
+            np.testing.assert_allclose(g.numpy(), exp)
+        routs = hvd.grouped_reducescatter(
+            [torch.full((size * 2, 3), float(rank + 1 + i))
+             for i in range(2)], op=hvd.Sum)
+        for i, r_ in enumerate(routs):
+            assert torch.allclose(
+                r_, torch.full((2, 3),
+                               float(sum(rk + 1 + i for rk in range(size)))))
+
         # broadcast_object / allgather_object
         obj = hvd.broadcast_object({"x": rank}, root_rank=0)
         assert obj == {"x": 0}
