@@ -13,6 +13,7 @@ import numpy as np
 from horovod_tpu.spark.common.reader import (  # noqa: F401 — re-exported
     AsyncParquetBatchReader,
     ParquetBatchReader,
+    _parquet_files,
     frame_to_xy,
     staged_bytes,
 )
@@ -55,6 +56,100 @@ def stage_train_data(estimator, df):
     train_path = estimator.store.get_train_data_path(estimator.run_id)
     _df_to_parquet(df, train_path, estimator.num_proc)
     return train_path
+
+
+def split_validation(train_path, validation, seed=0):
+    """Split staged parquet into train/validation (reference analog:
+    the estimators' ``validation`` param — a float fraction for a
+    random row split, or a column name whose truthy rows are the
+    validation set).
+
+    Operates on the STAGED parquet (pyarrow, one row group in memory at
+    a time), so it works identically for every estimator and is
+    testable without Spark. Returns ``(new_train_path, val_path)`` —
+    two sibling directories next to ``train_path``; the original stays
+    untouched.
+    """
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    if validation is None:
+        return train_path, None
+    by_column = isinstance(validation, str)
+    if not by_column and not (0.0 < float(validation) < 1.0):
+        raise ValueError(
+            f"validation must be a column name or a fraction in (0, 1); "
+            f"got {validation!r}")
+
+    out_train = train_path.rstrip("/") + "_train_split"
+    out_val = train_path.rstrip("/") + "_val_split"
+    for d in (out_train, out_val):
+        os.makedirs(d, exist_ok=True)
+        for f in os.listdir(d):
+            os.remove(os.path.join(d, f))
+
+    rng = np.random.RandomState(seed)
+    # One output file PER SOURCE FILE (same basename): the readers and
+    # _load_np shard by file/row group, so collapsing the num_proc-
+    # partitioned staging into one file would silently put every rank
+    # on the identical full split.
+    writers = {}
+    rows = {"train": 0, "val": 0}
+
+    def append(which, base, table):
+        rows[which] += table.num_rows
+        if table.num_rows == 0:
+            return
+        key = (which, base)
+        if key not in writers:
+            writers[key] = pq.ParquetWriter(
+                os.path.join(out_train if which == "train" else out_val,
+                             base), table.schema)
+        writers[key].write_table(table)
+
+    for f in _parquet_files(train_path):
+        base = os.path.basename(f)
+        pf = pq.ParquetFile(f)
+        for g in range(pf.metadata.num_row_groups):
+            table = pf.read_row_group(g)
+            if by_column:
+                if validation not in table.column_names:
+                    raise ValueError(
+                        f"validation column {validation!r} not in staged "
+                        f"data ({table.column_names})")
+                mask = np.asarray(
+                    table[validation].to_pandas().astype(bool))
+                table = table.drop_columns([validation])
+            else:
+                mask = rng.random_sample(table.num_rows) < float(validation)
+            mask = pa.array(mask)
+            append("val", base, table.filter(mask))
+            append("train", base, table.filter(pc.invert(mask)))
+    for w in writers.values():
+        w.close()
+    if rows["train"] == 0:
+        raise ValueError(
+            f"validation={validation!r} selected every staged row — "
+            "nothing left to train on")
+    if rows["val"] == 0:
+        return train_path, None  # nothing selected: keep original staging
+    return out_train, out_val
+
+
+def epoch_val_loss(val_path, feature_cols, label_cols, batch_size, rank,
+                   size, batch_loss, average_fn):
+    """One BATCHED validation pass over the staged val split (bounded
+    memory — the same reader machinery as training): returns the
+    cross-rank average of this rank's row-weighted mean loss. Shared by
+    the torch and lightning estimators' per-epoch hooks."""
+    reader = ParquetBatchReader(val_path, feature_cols, label_cols,
+                                batch_size, rank=rank, size=size)
+    total, n = 0.0, 0
+    for xb, yb in reader:
+        total += float(batch_loss(xb, yb)) * len(xb)
+        n += len(xb)
+    return average_fn(total / max(n, 1))
 
 
 def collect_trained(results):

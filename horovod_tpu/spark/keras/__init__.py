@@ -17,6 +17,7 @@ from horovod_tpu.spark.common.fit import (  # noqa: F401 — re-exported
     _df_to_parquet,
     _load_np,
     collect_trained,
+    split_validation,
     stage_train_data,
     use_streaming,
 )
@@ -34,6 +35,11 @@ class KerasEstimator(EstimatorParams):
         from horovod_tpu.spark import run as spark_run
 
         train_path = stage_train_data(self, df)
+        # validation= (fraction or marker column) splits the STAGED
+        # parquet — reference estimator contract (validation /
+        # validation_steps_per_epoch params).
+        train_path, val_path = split_validation(
+            train_path, self.validation, seed=self.random_seed or 0)
 
         # Locals only below: the train closure must not capture self, or
         # cloudpickle ships the live model/store to executors alongside
@@ -48,6 +54,8 @@ class KerasEstimator(EstimatorParams):
             verbose=self.verbose,
             streaming=use_streaming(self.inmemory_cache_all, train_path),
             shuffle=bool(self.shuffle_buffer_size),
+            val_path=val_path,
+            val_steps=self.validation_steps_per_epoch,
             seed=self.random_seed or 0)
 
         def train():
@@ -61,6 +69,35 @@ class KerasEstimator(EstimatorParams):
             callbacks = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
                          hvd.callbacks.MetricAverageCallback()]
             verbose = params["verbose"] if hvd.rank() == 0 else 0
+
+            # Per-epoch validation from the staged val split (sharded
+            # across ranks like training — the split preserves the
+            # per-file layout; MetricAverageCallback averages val_*
+            # metrics across ranks). Streaming mode streams validation
+            # too: the val split inherits the reason streaming was
+            # chosen.
+            val_kwargs = {}
+            val_reader = None
+            if params["val_path"] and params["streaming"]:
+                from horovod_tpu.spark.common.fit import ParquetBatchReader
+
+                val_reader = ParquetBatchReader(
+                    params["val_path"], params["feature_cols"],
+                    params["label_cols"], params["batch_size"],
+                    rank=hvd.rank(), size=hvd.size())
+                val_steps = len(val_reader)
+                if params["val_steps"]:
+                    val_steps = min(val_steps, params["val_steps"])
+            elif params["val_path"]:
+                vx, vy = _load_np(params["val_path"],
+                                  params["feature_cols"],
+                                  params["label_cols"], hvd.rank(),
+                                  hvd.size())
+                if params["val_steps"]:
+                    n = min(len(vx),
+                            params["val_steps"] * params["batch_size"])
+                    vx, vy = vx[:n], vy[:n]
+                val_kwargs = {"validation_data": (vx, vy)}
             if params["streaming"]:
                 # Large dataset: stream batches from the staged parquet
                 # with background prefetch instead of materializing the
@@ -84,12 +121,20 @@ class KerasEstimator(EstimatorParams):
                 history = {}
                 try:
                     for epoch in range(params["epochs"]):
+                        if val_reader is not None:
+                            # Fresh streaming pass per epoch (generator
+                            # validation_data requires explicit steps).
+                            val_kwargs = {
+                                "validation_data": iter(val_reader),
+                                "validation_steps": val_steps,
+                            }
                         hist = model.fit(iter(reader),
                                          steps_per_epoch=steps,
                                          epochs=epoch + 1,
                                          initial_epoch=epoch,
                                          verbose=verbose,
-                                         callbacks=callbacks)
+                                         callbacks=callbacks,
+                                         **val_kwargs)
                         for k, v in hist.history.items():
                             history.setdefault(k, []).extend(v)
                 finally:
@@ -101,8 +146,8 @@ class KerasEstimator(EstimatorParams):
                                 hvd.size())
                 history = model.fit(x, y, batch_size=params["batch_size"],
                                     epochs=params["epochs"],
-                                    verbose=verbose,
-                                    callbacks=callbacks).history
+                                    verbose=verbose, callbacks=callbacks,
+                                    **val_kwargs).history
             if hvd.rank() == 0:
                 return _serialize_keras(model), history
             return None

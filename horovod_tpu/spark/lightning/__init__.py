@@ -103,12 +103,16 @@ def _named_params_for(model, base_opt, opt_idx):
 
 
 def train_protocol_model(model, x_t, y_t, batch_size, epochs,
-                         distributed=True, batch_iter=None):
+                         distributed=True, batch_iter=None,
+                         on_epoch_end=None):
     """Run the lightning-protocol training loop on host tensors.
 
     ``batch_iter``: optional callable returning one epoch's iterable of
     ``(x, y)`` numpy batches (the streaming parquet reader path); when
     given, ``x_t``/``y_t``/``batch_size`` are ignored.
+    ``on_epoch_end``: optional callable ``(model, epoch)`` invoked after
+    each epoch (after the module's own on_train_epoch_end) — the
+    estimator's per-epoch validation hook.
 
     With ``distributed=True`` every optimizer is wrapped in
     ``horovod_tpu.torch.DistributedOptimizer`` and parameters/optimizer
@@ -190,6 +194,8 @@ def train_protocol_model(model, x_t, y_t, batch_size, epochs,
         epoch_end = getattr(model, "on_train_epoch_end", None)
         if callable(epoch_end):
             epoch_end()
+        if on_epoch_end is not None:
+            on_epoch_end(model, epoch)
     return model
 
 
@@ -199,8 +205,11 @@ class LightningEstimator(EstimatorParams):
 
     def fit(self, df, spark=None):
         from horovod_tpu.spark import run as spark_run
+        from horovod_tpu.spark.common.fit import split_validation
 
         train_path = stage_train_data(self, df)
+        train_path, val_path = split_validation(
+            train_path, self.validation, seed=self.random_seed or 0)
 
         # Locals only below (see KerasEstimator): the closure must not
         # capture self.
@@ -213,7 +222,7 @@ class LightningEstimator(EstimatorParams):
             epochs=self.epochs,
             streaming=use_streaming(self.inmemory_cache_all, train_path),
             shuffle=bool(self.shuffle_buffer_size),
-            seed=self.random_seed or 0)
+            val_path=val_path, seed=self.random_seed or 0)
 
         def train():
             import numpy as np
@@ -223,6 +232,36 @@ class LightningEstimator(EstimatorParams):
 
             hvd.init()
             model = _deserialize_torch(model_bytes)
+
+            val_history = []
+            on_epoch_end = None
+            if params["val_path"]:
+                from horovod_tpu.spark.common.fit import epoch_val_loss
+
+                def on_epoch_end(m, epoch):
+                    # validation_step if the module defines it
+                    # (lightning protocol), else training_step under
+                    # no_grad; one batched pass, averaged across ranks.
+                    step_fn = getattr(m, "validation_step", None) \
+                        or m.training_step
+
+                    def batch_loss(xb, yb):
+                        m.eval()
+                        with torch.no_grad():
+                            vl = _step_loss(step_fn(
+                                (torch.from_numpy(np.ascontiguousarray(xb)),
+                                 torch.from_numpy(np.ascontiguousarray(yb))),
+                                0))
+                        m.train()
+                        return vl
+
+                    val_history.append(epoch_val_loss(
+                        params["val_path"], params["feature_cols"],
+                        params["label_cols"], params["batch_size"],
+                        hvd.rank(), hvd.size(), batch_loss,
+                        lambda v: float(hvd.allreduce(
+                            torch.tensor([v]), op=hvd.Average))))
+
             if params["streaming"]:
                 from horovod_tpu.spark.common.fit import \
                     AsyncParquetBatchReader
@@ -238,7 +277,8 @@ class LightningEstimator(EstimatorParams):
                     train_protocol_model(
                         model, None, None, params["batch_size"],
                         params["epochs"],
-                        batch_iter=lambda: iter(reader))
+                        batch_iter=lambda: iter(reader),
+                        on_epoch_end=on_epoch_end)
                 finally:
                     reader.close_async_loader()
             else:
@@ -249,14 +289,16 @@ class LightningEstimator(EstimatorParams):
                 train_protocol_model(
                     model, torch.from_numpy(np.ascontiguousarray(x)),
                     torch.from_numpy(np.ascontiguousarray(y)),
-                    params["batch_size"], params["epochs"])
+                    params["batch_size"], params["epochs"],
+                    on_epoch_end=on_epoch_end)
             if hvd.rank() == 0:
-                return _serialize_torch(model)
+                return _serialize_torch(model), {"val_loss": val_history}
             return None
 
         results = spark_run(train, num_proc=self.num_proc, spark=spark)
-        return LightningModel(collect_trained(results), self.feature_cols,
-                              self.label_cols)
+        model_bytes_out, history = collect_trained(results)
+        return LightningModel(model_bytes_out, self.feature_cols,
+                              self.label_cols, history=history)
 
 
 class LightningModel(TorchModel):

@@ -41,8 +41,14 @@ class TorchEstimator(EstimatorParams):
 
     def fit(self, df, spark=None):
         from horovod_tpu.spark import run as spark_run
+        from horovod_tpu.spark.common.fit import split_validation
 
         train_path = stage_train_data(self, df)
+        # validation= (fraction or marker column): split the staged
+        # parquet; per-epoch val loss lands in the returned model's
+        # history (reference estimator contract).
+        train_path, val_path = split_validation(
+            train_path, self.validation, seed=self.random_seed or 0)
 
         model_bytes = _serialize_torch(self.model)
         loss_fn = self.loss
@@ -53,7 +59,7 @@ class TorchEstimator(EstimatorParams):
             epochs=self.epochs,
             streaming=use_streaming(self.inmemory_cache_all, train_path),
             shuffle=bool(self.shuffle_buffer_size),
-            seed=self.random_seed or 0)
+            val_path=val_path, seed=self.random_seed or 0)
 
         def train():
             import torch
@@ -76,6 +82,32 @@ class TorchEstimator(EstimatorParams):
                 loss.backward()
                 opt.step()
 
+            val_history = []
+
+            def epoch_end():
+                # Per-epoch validation loss: one BATCHED pass over the
+                # staged val split (bounded memory), rank-averaged so
+                # every rank records the global value.
+                if not params["val_path"]:
+                    return
+                from horovod_tpu.spark.common.fit import epoch_val_loss
+
+                def batch_loss(xb, yb):
+                    model.eval()
+                    with torch.no_grad():
+                        vl = criterion(
+                            model(torch.from_numpy(np.ascontiguousarray(xb))),
+                            torch.from_numpy(np.ascontiguousarray(yb)))
+                    model.train()
+                    return vl
+
+                val_history.append(epoch_val_loss(
+                    params["val_path"], params["feature_cols"],
+                    params["label_cols"], params["batch_size"],
+                    hvd.rank(), hvd.size(), batch_loss,
+                    lambda v: float(hvd.allreduce(
+                        torch.tensor([v]), op=hvd.Average))))
+
             if params["streaming"]:
                 # Stream + prefetch from the staged parquet (petastorm
                 # reader path) instead of materializing the shard.
@@ -91,6 +123,7 @@ class TorchEstimator(EstimatorParams):
                         for xb, yb in reader:
                             step(torch.from_numpy(np.ascontiguousarray(xb)),
                                  torch.from_numpy(np.ascontiguousarray(yb)))
+                        epoch_end()
                 finally:
                     reader.close_async_loader()
             else:
@@ -105,20 +138,24 @@ class TorchEstimator(EstimatorParams):
                 for _ in range(params["epochs"]):
                     for i in range(0, len(x_t), bs):
                         step(x_t[i:i + bs], y_t[i:i + bs])
+                    epoch_end()
             if hvd.rank() == 0:
-                return _serialize_torch(model)
+                return _serialize_torch(model), {"val_loss": val_history}
             return None
 
         results = spark_run(train, num_proc=self.num_proc, spark=spark)
-        return TorchModel(collect_trained(results), self.feature_cols,
-                          self.label_cols)
+        model_bytes_out, history = collect_trained(results)
+        return TorchModel(model_bytes_out, self.feature_cols,
+                          self.label_cols, history=history)
 
 
 class TorchModel:
-    def __init__(self, model_bytes, feature_cols, label_cols):
+    def __init__(self, model_bytes, feature_cols, label_cols,
+                 history=None):
         self._model_bytes = model_bytes
         self.feature_cols = tuple(feature_cols)
         self.label_cols = tuple(label_cols)
+        self.history = history
         self._model = None
 
     def getModel(self):

@@ -130,6 +130,52 @@ def test_lightning_protocol_training():
     assert model.epoch_ends == 3
 
 
+def test_protocol_trainer_on_epoch_end_hook():
+    """The estimator's per-epoch validation hook: called once per epoch
+    with (model, epoch); a recorded val loss shrinks as training
+    progresses."""
+    import torch
+
+    from horovod_tpu.spark.lightning import train_protocol_model
+
+    torch.manual_seed(0)
+    x = torch.randn(64, 4)
+    w_true = torch.randn(4, 1)
+    y = x @ w_true
+    vx, vy = torch.randn(16, 4), None
+    vy = vx @ w_true
+
+    class Lin(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            torch.manual_seed(1)
+            self.net = torch.nn.Linear(4, 1)
+
+        def forward(self, x):
+            return self.net(x)
+
+        def training_step(self, batch, batch_idx):
+            xb, yb = batch
+            return torch.nn.functional.mse_loss(self(xb), yb)
+
+        def configure_optimizers(self):
+            return torch.optim.SGD(self.parameters(), lr=0.1)
+
+    calls = []
+
+    def on_epoch_end(m, epoch):
+        m.eval()
+        with torch.no_grad():
+            calls.append((epoch, float(
+                torch.nn.functional.mse_loss(m(vx), vy))))
+        m.train()
+
+    train_protocol_model(Lin(), x, y, 16, epochs=5, distributed=False,
+                         on_epoch_end=on_epoch_end)
+    assert [e for e, _ in calls] == [0, 1, 2, 3, 4]
+    assert calls[-1][1] < calls[0][1]  # val loss fell
+
+
 def test_lightning_optimizer_unpacking():
     import torch
 

@@ -2,6 +2,8 @@
 Spark estimator data path) — testable without pyspark: the staged data
 is plain parquet either way (SURVEY.md §2.5)."""
 
+import os
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -196,6 +198,118 @@ def test_classification_estimator_path_trains_with_int_labels(tmp_path):
         Clf(), None, None, 16, epochs=2, distributed=False,
         batch_iter=lambda: iter(reader))
     assert trained is not None
+
+
+def test_split_validation_fraction(tmp_path):
+    """validation=0.25 (estimator contract): row-exact random split of
+    the staged parquet, deterministic by seed, disjoint and complete."""
+    from horovod_tpu.spark.common.fit import split_validation
+
+    path = _stage(tmp_path, n_rows=200, n_files=2, row_group_size=25)
+    tr, va = split_validation(path, 0.25, seed=3)
+    tr2, va2 = split_validation(path, 0.25, seed=3)
+    xt, yt = _load_np(tr, ("features",), ("label",), 0, 1)
+    xv, yv = _load_np(va, ("features",), ("label",), 0, 1)
+    assert len(yt) + len(yv) == 200
+    assert 20 <= len(yv) <= 80  # ~50 expected, loose stochastic bound
+    # disjoint, complete (labels are unique row ids)
+    both = np.concatenate([yt[:, 0], yv[:, 0]])
+    assert len(np.unique(both)) == 200
+    # deterministic across calls with the same seed
+    xv2, yv2 = _load_np(va2, ("features",), ("label",), 0, 1)
+    np.testing.assert_array_equal(np.sort(yv[:, 0]), np.sort(yv2[:, 0]))
+    # original staging untouched
+    x, y = _load_np(path, ("features",), ("label",), 0, 1)
+    assert len(y) == 200
+
+
+def test_split_validation_column(tmp_path):
+    """validation='is_val': truthy rows go to the val set; the marker
+    column is dropped from both outputs."""
+    import pyarrow.parquet as pq
+
+    from horovod_tpu.spark.common.fit import split_validation
+
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({
+        "features": [rng.rand(4).astype("float32").tolist()
+                     for _ in range(60)],
+        "label": np.arange(60, dtype="float32"),
+        "is_val": ([True] * 15 + [False] * 45),
+    })
+    (tmp_path / "staged").mkdir()
+    df.to_parquet(tmp_path / "staged" / "part-00000.parquet",
+                  row_group_size=16)
+    tr, va = split_validation(str(tmp_path / "staged"), "is_val")
+    xt, yt = _load_np(tr, ("features",), ("label",), 0, 1)
+    xv, yv = _load_np(va, ("features",), ("label",), 0, 1)
+    np.testing.assert_array_equal(np.sort(yv[:, 0]), np.arange(15.0))
+    np.testing.assert_array_equal(np.sort(yt[:, 0]),
+                                  np.arange(15.0, 60.0))
+    for d in (tr, va):
+        f = sorted(os.path.join(d, p) for p in os.listdir(d))[0]
+        assert "is_val" not in pq.ParquetFile(f).schema_arrow.names
+    # unknown column errors loudly
+    with pytest.raises(ValueError, match="not in staged"):
+        split_validation(str(tmp_path / "staged"), "nope")
+
+
+def test_split_validation_none_passthrough(tmp_path):
+    from horovod_tpu.spark.common.fit import split_validation
+
+    path = _stage(tmp_path)
+    assert split_validation(path, None) == (path, None)
+    with pytest.raises(ValueError, match="fraction"):
+        split_validation(path, 1.5)
+
+
+def test_split_validation_preserves_file_sharding(tmp_path):
+    """The split writes one output file per source file — collapsing to
+    a single file would put every rank on the identical full split
+    (file-level sharding in _load_np/readers)."""
+    from horovod_tpu.spark.common.fit import split_validation
+
+    path = _stage(tmp_path, n_rows=120, n_files=3, row_group_size=10)
+    tr, va = split_validation(path, 0.3, seed=1)
+    assert len([f for f in os.listdir(tr) if f.endswith(".parquet")]) == 3
+    # rank shards are genuinely disjoint subsets
+    _, y0 = _load_np(tr, ("features",), ("label",), 0, 3)
+    _, y1 = _load_np(tr, ("features",), ("label",), 1, 3)
+    assert not set(y0[:, 0]) & set(y1[:, 0])
+
+
+def test_split_validation_all_rows_selected_errors(tmp_path):
+    import pandas as _pd
+
+    from horovod_tpu.spark.common.fit import split_validation
+
+    (tmp_path / "s").mkdir()
+    _pd.DataFrame({
+        "features": [[1.0, 2.0]] * 8,
+        "label": np.arange(8, dtype="float32"),
+        "is_val": [True] * 8,
+    }).to_parquet(tmp_path / "s" / "part-00000.parquet")
+    with pytest.raises(ValueError, match="nothing left to train"):
+        split_validation(str(tmp_path / "s"), "is_val")
+
+
+def test_epoch_val_loss_batched(tmp_path):
+    """The shared per-epoch validation helper: batched row-weighted mean
+    over the val split, then the caller's cross-rank average."""
+    from horovod_tpu.spark.common.fit import epoch_val_loss
+
+    path = _stage(tmp_path, n_rows=50, n_files=1, row_group_size=10)
+    seen = []
+
+    def batch_loss(xb, yb):
+        seen.append(len(xb))
+        return float(yb.mean())
+
+    out = epoch_val_loss(path, ("features",), ("label",), 16, 0, 1,
+                         batch_loss, lambda v: v * 2)
+    assert sum(seen) == 50 and max(seen) <= 16  # batched, all rows
+    # row-weighted mean of label means == global label mean (0..49)
+    assert out == pytest.approx(2 * np.arange(50).mean())
 
 
 def test_lightning_protocol_streams_from_reader(tmp_path):
