@@ -399,7 +399,8 @@ class AllgatherCpuKernel : public AsyncOpKernel {
     std::vector<int64_t> shape(dims.begin(), dims.end());
     int h = hvdtpu_enqueue_allgather(
         name_.c_str(), in.tensor_data().data(), (int)shape.size(),
-        ShapeData(shape), dtype, process_set_id_);
+        ShapeData(shape), dtype, process_set_id_, /*group_id=*/-1,
+        /*group_size=*/0);
     WaitManagedAsync(c, std::move(done), h, "HvdTpuAllgather");
   }
 
@@ -432,7 +433,7 @@ class ReducescatterCpuKernel : public AsyncOpKernel {
     int h = hvdtpu_enqueue_reducescatter(
         name_.c_str(), in.tensor_data().data(), (int)shape.size(),
         ShapeData(shape), dtype, reduce_op_, prescale_, postscale_,
-        process_set_id_);
+        process_set_id_, /*group_id=*/-1, /*group_size=*/0);
     WaitManagedAsync(c, std::move(done), h, "HvdTpuReducescatter");
   }
 
@@ -724,12 +725,14 @@ extern "C" void hvdtpu_tf_xla_collective(void* out, const void** ins) {
     if (m.kind == 2) {
       h = hvdtpu_enqueue_allgather(
           tin.name.c_str(), ins[1], (int)tin.dims.size(),
-          ShapeData(tin.dims), (int)m.dtype, (int)m.process_set_id);
+          ShapeData(tin.dims), (int)m.dtype, (int)m.process_set_id,
+          /*group_id=*/-1, /*group_size=*/0);
     } else if (m.kind == 3) {
       h = hvdtpu_enqueue_reducescatter(
           tin.name.c_str(), ins[1], (int)tin.dims.size(),
           ShapeData(tin.dims), (int)m.dtype, (int)m.reduce_op_or_root,
-          m.prescale, m.postscale, (int)m.process_set_id);
+          m.prescale, m.postscale, (int)m.process_set_id,
+          /*group_id=*/-1, /*group_size=*/0);
     } else {
       h = hvdtpu_enqueue_alltoall(
           tin.name.c_str(), ins[1], (int)tin.dims.size(),
