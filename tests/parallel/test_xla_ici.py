@@ -270,6 +270,45 @@ def test_host_grouped_allgather_reducescatter():
                      timeout=240) == ["ok"] * 2
 
 
+def _worker_grouped_gather_process_set(rank, size):
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    try:
+        # Grouped allgather over a process-set SUBSET (ranks 0,2 of 3):
+        # the non-member runs an unrelated collective concurrently — the
+        # atomic group must complete among members only. Process sets
+        # register collectively (same order on every rank).
+        ps = hvd.add_process_set([0, 2])
+        ps_solo = hvd.add_process_set([1])
+        if rank in (0, 2):
+            pos = (0, None, 1)[rank]
+            outs = hvd.grouped_allgather(
+                [jnp.full((pos + 1, 2), float(rank + i))
+                 for i in range(2)],
+                names=[f"psg.{i}" for i in range(2)], process_set_id=ps)
+            for i, o in enumerate(outs):
+                exp = np.concatenate(
+                    [np.full((p + 1, 2), float(r + i))
+                     for r, p in ((0, 0), (2, 1))])
+                np.testing.assert_allclose(np.asarray(o), exp)
+        else:
+            out = hvd.allreduce(jnp.full((4,), 7.0), op=hvd.Sum,
+                                process_set_id=ps_solo)
+            np.testing.assert_allclose(np.asarray(out), 7.0)
+        hvd.barrier()
+        return "ok"
+    finally:
+        hvd.shutdown()
+
+
+def test_device_grouped_allgather_process_set():
+    assert run_ranks(_worker_grouped_gather_process_set, 3, env=_ENV,
+                     timeout=300) == ["ok"] * 3
+
+
 def _worker_elastic_fast_reinit(rank, size):
     import jax.numpy as jnp
 
