@@ -226,6 +226,56 @@ def run_eager(cfg, batch, seq, steps, label):
                     batch, seq, dt)
 
 
+def full_run_plan(batch, seq, steps):
+    """Ordered (name, thunk) rows of the full accelerator run.
+
+    ROW ORDER IS LOAD-BEARING: the eager flagship must be the FIRST
+    device-touching config — its peak HBM use is the highest of the
+    four, and earlier runs fragment the device heap enough to OOM a
+    config that fits cleanly on a virgin heap (observed r3: standalone
+    fine, post-mixed/809m RESOURCE_EXHAUSTED with zero live arrays).
+    The flagship SPMD row stays LAST because the driver tail-parses the
+    final line. `_check_plan_order` (called by main, pinned by
+    tests/single/test_bench_plan.py) refuses any reordering.
+    """
+    return [
+        ("eager_flagship",
+         lambda: run_eager(_flagship_cfg(), batch, seq, steps,
+                           "pure-bf16 eager hvd")),
+        ("mixed_809m",
+         lambda: run_mixed(_same_size_cfg("float32"), batch, seq, steps)),
+        ("spmd_809m",
+         lambda: run_spmd(_same_size_cfg("bfloat16"), batch, seq, steps,
+                          "llama_train_step_mfu_809m",
+                          "pure-bf16 same-size")),
+        ("spmd_flagship",
+         lambda: run_spmd(_flagship_cfg(), batch, seq, steps,
+                          "llama_train_step_mfu", "pure-bf16")),
+    ]
+
+
+_EXPECTED_PLAN = ("eager_flagship", "mixed_809m", "spmd_809m",
+                  "spmd_flagship")
+
+
+def _check_plan_order(plan):
+    names = tuple(name for name, _ in plan)
+    if not names or names[0] != "eager_flagship":
+        raise RuntimeError(
+            f"bench plan reordered: the eager flagship must run FIRST "
+            f"(virgin-heap requirement, see full_run_plan docstring); "
+            f"got {list(names)}")
+    if names[-1] != "spmd_flagship":
+        raise RuntimeError(
+            f"bench plan reordered: the SPMD flagship must run LAST "
+            f"(the driver tail-parses the final line); got {list(names)}")
+    if names != _EXPECTED_PLAN:
+        raise RuntimeError(
+            f"bench plan changed: expected {list(_EXPECTED_PLAN)}, got "
+            f"{list(names)} — if the change is intentional, update "
+            f"_EXPECTED_PLAN and re-measure heap headroom on a real chip")
+
+
 def main():
     argv = sys.argv[1:]
     on_accel = jax.devices()[0].platform != "cpu"
@@ -239,51 +289,49 @@ def main():
 
     def emit(row):
         # Print each row AS PRODUCED: a later config failing must not
-        # discard minutes of already-measured rows (the driver
-        # tail-parses the last line, and row order keeps the flagship
-        # last). gc between rows returns every stale device buffer
-        # before the next config allocates.
+        # discard minutes of already-measured rows. gc between rows
+        # returns every stale device buffer before the next config
+        # allocates.
         print(json.dumps(row), flush=True)
         gc.collect()
 
     if "--quick" in argv:
         emit(run_spmd(_flagship_cfg(), batch, seq, steps,
                       "llama_train_step_mfu", "pure-bf16"))
-    elif "--mixed" in argv:
+        return
+    if "--mixed" in argv:
         emit(run_mixed(_same_size_cfg("float32"), batch, seq, steps))
-    else:
-        # The eager flagship runs FIRST: its peak is the highest of the
-        # four and earlier runs fragment the device heap enough to OOM
-        # a config that fits cleanly on a virgin heap (observed r3:
-        # standalone fine, post-mixed/809m RESOURCE_EXHAUSTED with zero
-        # live arrays). Retries run OUTSIDE the except blocks — the
-        # live exception's traceback pins the failed attempt's frames
-        # (params, opt, the whole gradient tree).
-        eager_failed = False
-        try:
-            emit(run_eager(_flagship_cfg(), batch, seq, steps,
-                           "pure-bf16 eager hvd"))
-        except Exception as e:  # noqa: BLE001 — HBM headroom is config-
-            # dependent; fall back to the mixed-size config rather than
-            # lose the eager row.
-            print(f"eager flagship failed ({type(e).__name__}: {e}); "
-                  f"retrying at 809M", file=sys.stderr)
-            eager_failed = True
-        if eager_failed:
-            gc.collect()
+        return
+
+    plan = full_run_plan(batch, seq, steps)
+    _check_plan_order(plan)
+    for name, thunk in plan:
+        if name == "eager_flagship":
+            # Retries run OUTSIDE the except blocks — the live
+            # exception's traceback pins the failed attempt's frames
+            # (params, opt, the whole gradient tree).
+            eager_failed = False
             try:
-                emit(run_eager(_same_size_cfg("bfloat16"), batch, seq,
-                               steps, "pure-bf16 eager hvd (809M)"))
-            except Exception as e:  # noqa: BLE001
-                print(f"eager 809M also failed ({type(e).__name__}: "
-                      f"{e}); continuing without an eager row",
-                      file=sys.stderr)
-            gc.collect()
-        emit(run_mixed(_same_size_cfg("float32"), batch, seq, steps))
-        emit(run_spmd(_same_size_cfg("bfloat16"), batch, seq, steps,
-                      "llama_train_step_mfu_809m", "pure-bf16 same-size"))
-        emit(run_spmd(_flagship_cfg(), batch, seq, steps,
-                      "llama_train_step_mfu", "pure-bf16"))
+                emit(thunk())
+            except Exception as e:  # noqa: BLE001 — HBM headroom is
+                # config-dependent; fall back to the mixed-size config
+                # rather than lose the eager row.
+                print(f"eager flagship failed ({type(e).__name__}: {e});"
+                      f" retrying at 809M", file=sys.stderr)
+                eager_failed = True
+            if eager_failed:
+                gc.collect()
+                try:
+                    emit(run_eager(_same_size_cfg("bfloat16"), batch,
+                                   seq, steps,
+                                   "pure-bf16 eager hvd (809M)"))
+                except Exception as e:  # noqa: BLE001
+                    print(f"eager 809M also failed ({type(e).__name__}:"
+                          f" {e}); continuing without an eager row",
+                          file=sys.stderr)
+                gc.collect()
+        else:
+            emit(thunk())
 
 
 if __name__ == "__main__":

@@ -605,7 +605,15 @@ void Controller::ApplyCacheVerdicts(ResponseList* out) {
 void Controller::CheckForStalledTensors() {
   if (!cfg_.stall_check_enabled) return;
   auto now = std::chrono::steady_clock::now();
-  if (std::chrono::duration<double>(now - last_stall_check_).count() < 10.0) {
+  // Check at half the configured warning time (capped at 10s) so a
+  // sub-10s HOROVOD_STALL_CHECK_TIME fires on schedule instead of
+  // silently rounding up to the next 10s boundary. Floored at 100ms:
+  // a zero/tiny warning time must not turn the sweep into a per-cycle
+  // log flood (default cycle time is 1ms).
+  double interval =
+      std::min(10.0, std::max(0.1, cfg_.stall_warning_secs / 2.0));
+  if (std::chrono::duration<double>(now - last_stall_check_).count() <
+      interval) {
     return;
   }
   last_stall_check_ = now;

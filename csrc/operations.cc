@@ -434,11 +434,31 @@ typedef int32_t (*DeviceExecFn)(int32_t op_class, int32_t n,
                                 int32_t err_cap);
 std::atomic<DeviceExecFn> g_device_exec{nullptr};
 
+// Timeline activity for the device-plane execution phase, mirroring the
+// host ring's RING_* spans (reference analog: NCCL_ALLREDUCE etc. marks
+// in horovod/common/ops/nccl_operations.cc). Without these the device
+// plane's trace showed negotiation then done, with execution invisible.
+const char* DeviceActivityName(Response::ResponseType t) {
+  switch (t) {
+    case Response::ResponseType::ALLREDUCE: return "XLA_ALLREDUCE";
+    case Response::ResponseType::ALLGATHER: return "XLA_ALLGATHER";
+    case Response::ResponseType::BROADCAST: return "XLA_BROADCAST";
+    case Response::ResponseType::ALLTOALL: return "XLA_ALLTOALL";
+    case Response::ResponseType::REDUCESCATTER:
+      return "XLA_REDUCESCATTER";
+    default: return "XLA_COLLECTIVE";
+  }
+}
+
 Status ExecuteDeviceResponse(GlobalState& st, const Response& response) {
   DeviceExecFn fn = g_device_exec.load();
   if (fn == nullptr) {
     return Status::PreconditionError(
         "device tensor enqueued but no device data plane is registered");
+  }
+  const char* activity = DeviceActivityName(response.response_type);
+  for (auto& n : response.tensor_names) {
+    st.timeline.ActivityStart(n, activity);
   }
   std::vector<const char*> names;
   names.reserve(response.tensor_names.size());
@@ -453,6 +473,7 @@ Status ExecuteDeviceResponse(GlobalState& st, const Response& response) {
                   response.tensor_sizes.data(),
                   (int32_t)response.tensor_sizes.size(), err,
                   (int32_t)sizeof(err) - 1);
+  for (auto& n : response.tensor_names) st.timeline.ActivityEnd(n);
   if (rc != 0) {
     return Status::Error(err[0] ? std::string(err)
                                 : "device data plane execution failed");

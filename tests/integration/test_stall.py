@@ -24,7 +24,10 @@ import horovod_tpu.jax as hvd
 
 hvd.init()
 if hvd.rank() == 1:
-    time.sleep(13)  # past the 10s inspector sweep with a 2s threshold
+    # Past the 2s warning threshold but well under 10s: only fires if
+    # the inspector honors sub-10s check times (interval = warn/2, not
+    # the old hardcoded 10s sweep).
+    time.sleep(5)
 out = hvd.allreduce(np.ones(4, np.float32), name="late.tensor",
                     op=hvd.Sum)
 assert float(np.asarray(out)[0]) == 2.0

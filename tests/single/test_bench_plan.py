@@ -1,0 +1,37 @@
+"""Pin bench.py's row order (VERDICT r3 weak #3).
+
+The eager flagship must be the first device-touching config (it needs a
+virgin device heap — later placement OOMs under fragmentation even with
+zero live arrays) and the SPMD flagship must stay last (the driver
+tail-parses the final JSON line). A silent reordering regressed this
+once; these tests make it loud.
+"""
+
+import pytest
+
+import bench
+
+
+def test_eager_flagship_is_first_and_spmd_flagship_last():
+    plan = bench.full_run_plan(4, 2048, 10)
+    names = [name for name, _ in plan]
+    assert names[0] == "eager_flagship"
+    assert names[-1] == "spmd_flagship"
+    bench._check_plan_order(plan)  # the self-check main() runs
+
+
+def test_check_plan_order_rejects_reordering():
+    plan = bench.full_run_plan(4, 2048, 10)
+    with pytest.raises(RuntimeError, match="must run FIRST"):
+        bench._check_plan_order(plan[1:] + plan[:1])
+    with pytest.raises(RuntimeError, match="must run LAST"):
+        bench._check_plan_order(plan[:-2] + [plan[-1], plan[-2]])
+    # Middle-row swaps are rejected too (the guard pins the FULL order,
+    # not just the endpoints).
+    with pytest.raises(RuntimeError, match="plan changed"):
+        bench._check_plan_order(
+            [plan[0], plan[2], plan[1], plan[3]])
+    # An inserted row changes the sequence as well.
+    with pytest.raises(RuntimeError, match="plan changed"):
+        bench._check_plan_order(plan[:1] + [("extra", plan[1][1])] +
+                                plan[1:])

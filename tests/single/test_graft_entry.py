@@ -1,0 +1,53 @@
+"""Driver-contract guards for __graft_entry__.py.
+
+The multichip dryrun is only evidence of sharding correctness if the mesh
+it builds actually has parallel axes >1 — `_mesh_axes_for` must refuse to
+hand back a pure-data-parallel mesh for a device count that can't be split
+(VERDICT r3 weak #5: an odd n_devices used to yield a vacuously-green
+MULTICHIP artifact).
+"""
+
+import pytest
+
+import __graft_entry__ as ge
+
+
+def test_mesh_axes_even_counts_split_onto_parallel_axes():
+    sizes = ge._mesh_axes_for(8)
+    assert sizes == {"seq": 2, "tensor": 2, "fsdp": 2, "data": 1}
+    sizes = ge._mesh_axes_for(16)
+    assert sizes["seq"] == sizes["tensor"] == sizes["fsdp"] == 2
+    assert sizes["data"] == 2
+
+
+def test_mesh_axes_odd_count_raises_instead_of_vacuous_mesh():
+    with pytest.raises(ValueError, match="pure data parallel"):
+        ge._mesh_axes_for(7)
+    with pytest.raises(ValueError, match="pure data parallel"):
+        ge._mesh_axes_for(3)
+
+
+def test_mesh_axes_fixed_axes_must_divide():
+    with pytest.raises(ValueError, match="do not divide"):
+        ge._mesh_axes_for(7, axes=("tensor", "fsdp"), fixed={"pipe": 2})
+
+
+def test_mesh_axes_partial_collapse_warns_but_passes(capsys):
+    sizes = ge._mesh_axes_for(2)
+    assert sizes["seq"] == 2 and sizes["tensor"] == 1
+    out = capsys.readouterr().out
+    assert "collapsed to size 1" in out
+
+
+def test_mesh_axes_fixed_axis_counts_as_parallelism():
+    # n=2 entirely consumed by a fixed pipe axis: the requested axes all
+    # collapse, but the mesh is still parallel (pipe=2) — no raise.
+    sizes = ge._mesh_axes_for(2, axes=("tensor", "fsdp"), fixed={"pipe": 2})
+    assert sizes == {"pipe": 2, "tensor": 1, "fsdp": 1, "data": 1}
+
+
+def test_mesh_axes_degenerate_fixed_axis_does_not_bypass_guard():
+    # A size-1 fixed axis provides no parallelism — it must not defeat
+    # the pure-data-parallel refusal.
+    with pytest.raises(ValueError, match="pure data parallel"):
+        ge._mesh_axes_for(7, fixed={"pipe": 1})
