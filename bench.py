@@ -63,20 +63,24 @@ def _peak_flops(device):
 # efficiency (0.50 MFU at d2048 vs 0.47 at d1536/667M fp32 params vs
 # 0.45 at d1024/319M); remat="attn" beats full remat (the flash kernel
 # makes saving one attention output per layer enough); d2560 regresses
-# (0.45). Donated buffers throughout.
+# (0.45). head_dim 128 (16 heads, not 32) feeds the MXU full-depth
+# contractions in the flash kernel: 0.525 -> 0.63 MFU at identical
+# param count (r4 sweep, docs/benchmarks.md). Donated buffers
+# throughout.
 def _flagship_cfg():
     return LlamaConfig(vocab_size=32768, d_model=2048, n_layers=20,
-                       n_heads=32, n_kv_heads=16, d_ff=8192,
+                       n_heads=16, n_kv_heads=8, d_ff=8192,
                        dtype="bfloat16", remat="attn",
                        param_dtype="bfloat16")
 
 
 # 809M: the largest size whose fp32 master + fp32 adam moments (12B HBM
 # per param, parallel.master_weights) fit one 16G chip — and therefore
-# the size where mixed-vs-pure compares apples to apples.
+# the size where mixed-vs-pure compares apples to apples. Same
+# head_dim-128 recipe as the flagship (12 heads at d1536).
 def _same_size_cfg(param_dtype):
     return LlamaConfig(vocab_size=32768, d_model=1536, n_layers=20,
-                       n_heads=24, n_kv_heads=12, d_ff=6144,
+                       n_heads=12, n_kv_heads=6, d_ff=6144,
                        dtype="bfloat16", remat="attn",
                        param_dtype=param_dtype)
 

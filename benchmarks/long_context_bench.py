@@ -1,0 +1,71 @@
+"""Single-chip long-context training benchmark (flash-kernel path).
+
+Proves the net-new long-context stack's single-chip leg (SURVEY.md
+§5.7): the streamed pallas flash kernels (VMEM O(block), independent of
+sequence length — see ops/flash_attention.py) train the 1.39B flagship
+at sequence lengths the round-3 kernels could not compile (scoped-VMEM
+OOM in the backward at T=8192). The multi-chip leg (ring / Ulysses
+sequence parallelism) reuses the same kernels via
+``flash_attention_chunk``; this benchmark is the in-chip baseline those
+paths are compared against.
+
+Run on a real TPU chip::
+
+    python benchmarks/long_context_bench.py [--out results.json]
+
+Writes one row per (batch, seq) config: MFU, tokens/s, ms/step.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+# (batch, seq): 8192+ tokens of context on ONE chip; t16384 at b1 is
+# the largest activation footprint that fits beside the 1.39B model.
+CONFIGS = [(4, 2048), (2, 8192), (1, 16384)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="write JSON rows here")
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    import bench  # repo-root bench machinery (MFU accounting)
+
+    if jax.devices()[0].platform == "cpu":
+        print("long_context_bench needs an accelerator; skipping",
+              file=sys.stderr)
+        return
+
+    cfg = bench._flagship_cfg()
+    rows = []
+    for batch, seq in CONFIGS:
+        t0 = time.time()
+        row = bench.run_spmd(cfg, batch, seq, args.steps,
+                             f"long_context_mfu_t{seq}",
+                             f"pure-bf16 seq {seq}")
+        row["wall_s"] = round(time.time() - t0, 1)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.out:
+        payload = {
+            "note": "1.39B flagship, streamed flash kernels, one real "
+                    "chip. t8192/t16384 rows were scoped-VMEM compile "
+                    "errors before the r4 kernel streaming "
+                    "(docs/benchmarks.md).",
+            "rows": rows,
+        }
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,15 +7,23 @@ online-softmax attention keeps everything in VMEM; the residuals are
 just the output and the per-row logsumexp.
 
 Kernel design (v5e-friendly):
-- layout [B, H, T, D]; grid over (batch, head, q-block); K/V for the
-  whole (b,h) slice live in VMEM (T·D·bf16 = 256 KB at bench shapes),
-  the q-block loop streams over kv-blocks with `lax.fori_loop`.
-- f32 accumulators in VMEM scratch; bf16 matmul inputs (MXU native),
+- layout [B, H, T, D]; 4-D grid over (batch, head, outer-block,
+  inner-block) with the INNER loop as the last grid dimension, so
+  every operand is streamed block-by-block: VMEM residency is
+  O(block_q·block_k + (block_q+block_k)·D) — independent of sequence
+  length. (The round-3 kernels kept whole-(b,h) K/V or Q/dO slices
+  resident, which capped the single-chip backward at T≈4096 with a
+  scoped-VMEM compile error.)
+- online-softmax / gradient accumulators are f32 VMEM scratch that
+  persists across the inner grid steps; outputs are written on the
+  last inner step. bf16 matmul inputs (MXU native),
   `preferred_element_type=f32`.
-- causal masking by global position iota; `grid` order puts the q-block
-  dimension innermost so K/V blocks are reused across sequential steps.
-- backward = two kernels (dkv over kv-blocks, dq over q-blocks), the
-  standard flash decomposition with the saved logsumexp.
+- causal masking by global position iota; whole causally-irrelevant
+  blocks are skipped with `pl.when` (the block's DMA still streams,
+  but it costs bandwidth only — no MXU work).
+- backward = two kernels (dkv over kv-blocks with q streamed, dq over
+  q-blocks with kv streamed), the standard flash decomposition with
+  the saved logsumexp.
 
 Falls back to the XLA blockwise implementation off-TPU (pallas interpret
 mode is too slow for real runs; CPU tests exercise the same math via
@@ -39,82 +47,86 @@ _NEG = -1e30
 _INTERPRET = False
 
 
-def _fwd_kernel(*refs, scale, block_k, causal, has_bias, has_offsets):
+def _fwd_kernel(*refs, scale, causal, has_bias, has_offsets):
     # refs = ([offs_ref,] q_ref, k_ref, v_ref, [bias_ref,] o_ref,
-    # lse_ref). bias is a per-key additive f32 row [1, Tk] (padding
-    # masks). offs_ref is an SMEM int32 [2] = (q_offset, kv_offset):
-    # GLOBAL positions for causal masking when the call sees only a
-    # chunk of the sequence (ring attention steps) — dynamic, so one
-    # compiled kernel serves every ring step.
+    # lse_ref, acc_ref, m_ref, l_ref). grid = (b, h, iq, jj): q/o/lse
+    # blocks are keyed by iq (constant across the inner jj steps), k/v
+    # stream per jj; the online-softmax state lives in f32 VMEM scratch
+    # persisted across jj and the output is written on the last step.
+    # bias is a per-key additive f32 row [1, Tk] (padding masks).
+    # offs_ref is an SMEM int32 [2] = (q_offset, kv_offset): GLOBAL
+    # positions for causal masking when the call sees only a chunk of
+    # the sequence (ring attention steps) — dynamic, so one compiled
+    # kernel serves every ring step.
     if has_offsets:
         offs_ref, q_ref, k_ref, v_ref, *rest = refs
     else:
         (q_ref, k_ref, v_ref), rest = refs[:3], list(refs[3:])
         offs_ref = None
     if has_bias:
-        bias_ref, o_ref, lse_ref = rest
+        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     else:
-        o_ref, lse_ref = rest
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
         bias_ref = None
     bq, d = q_ref.shape
-    tk = k_ref.shape[0]
+    bk = k_ref.shape[0]
     iq = pl.program_id(2)
-    q = q_ref[:, :]
+    jj = pl.program_id(3)
+    n_jj = pl.num_programs(3)
 
-    acc = jnp.zeros((bq, d), jnp.float32)
-    m = jnp.full((bq, 1), _NEG, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
+    @pl.when(jj == 0)
+    def _init():
+        acc_ref[:, :] = jnp.zeros((bq, d), jnp.float32)
+        m_ref[:, :] = jnp.full((bq, 1), _NEG, jnp.float32)
+        l_ref[:, :] = jnp.zeros((bq, 1), jnp.float32)
 
-    q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-    if has_offsets:
-        q_pos = q_pos + offs_ref[0]
+    q_base = offs_ref[0] if has_offsets else 0
     kv_base = offs_ref[1] if has_offsets else 0
+    # Whole-block causal skip: the block's first GLOBAL kv position must
+    # not be past this q block's last GLOBAL row (with offsets the bases
+    # are scalar-prefetched SMEM values, so the predicate is dynamic —
+    # a causal ring's fully-future chunks cost zero matmuls).
+    relevant = True
+    if causal:
+        relevant = kv_base + jj * bk <= q_base + (iq + 1) * bq - 1
 
-    def body(j, carry):
-        acc, m, l = carry
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+    @pl.when(relevant)
+    def _update():
+        q = q_ref[:, :]
+        k_blk = k_ref[:, :]
+        v_blk = v_ref[:, :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            kv_pos = kv_base + j * block_k + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
+            q_pos = q_base + iq * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            kv_pos = kv_base + jj * bk + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
         if has_bias:
-            s = s + bias_ref[:, pl.ds(j * block_k, block_k)]
+            s = s + bias_ref[:, :]
+        m = m_ref[:, :]
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
+        l_ref[:, :] = l_ref[:, :] * corr + p.sum(axis=1, keepdims=True)
+        acc_ref[:, :] = acc_ref[:, :] * corr + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l
+        m_ref[:, :] = m_new
 
-    if causal and not has_offsets:
-        # Only kv blocks whose start can be <= this q block's last row.
-        n_blocks = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k,
-                               tk // block_k)
-    elif causal:
-        # Offsets are scalar-prefetched (SMEM) precisely so they can
-        # shape control flow: skip kv blocks that start past this q
-        # block's last GLOBAL row (a causal ring's fully-future chunks
-        # cost zero matmuls instead of fully-masked ones).
-        last_q = offs_ref[0] + (iq + 1) * bq - 1
-        n_blocks = jnp.clip((last_q - offs_ref[1]) // block_k + 1, 0,
-                            tk // block_k)
-    else:
-        n_blocks = tk // block_k
-    acc, m, l = lax.fori_loop(0, n_blocks, body, (acc, m, l))
-
-    l = jnp.maximum(l, 1e-30)
-    o_ref[:, :] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:, :] = m + jnp.log(l)
+    @pl.when(jj == n_jj - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :], 1e-30)
+        o_ref[:, :] = (acc_ref[:, :] / l).astype(o_ref.dtype)
+        lse_ref[:, :] = m_ref[:, :] + jnp.log(l)
 
 
-def _bwd_dkv_kernel(*refs, scale, block_q, causal, has_bias,
-                    has_offsets):
+def _bwd_dkv_kernel(*refs, scale, causal, has_bias, has_offsets):
+    # grid = (b, h, jk, iq): k/v/dk/dv blocks are keyed by jk (constant
+    # across the inner iq steps), q/do/lse/delta stream per iq; dk/dv
+    # accumulate in f32 VMEM scratch and are written on the last step.
     if has_offsets:
         offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
             *rest = refs
@@ -122,66 +134,70 @@ def _bwd_dkv_kernel(*refs, scale, block_q, causal, has_bias,
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest = refs
         offs_ref = None
     if has_bias:
-        bias_ref, dk_ref, dv_ref = rest
+        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
-        dk_ref, dv_ref = rest
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
         bias_ref = None
     bk, d = k_ref.shape
-    tq = q_ref.shape[0]
+    bq = q_ref.shape[0]
     jk = pl.program_id(2)
-    k = k_ref[:, :]
-    v = v_ref[:, :]
+    iq = pl.program_id(3)
+    n_iq = pl.num_programs(3)
 
-    dk = jnp.zeros((bk, d), jnp.float32)
-    dv = jnp.zeros((bk, d), jnp.float32)
-    kv_pos = jk * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-    if has_offsets:
-        kv_pos = kv_pos + offs_ref[1]
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:, :] = jnp.zeros((bk, d), jnp.float32)
+        dv_acc[:, :] = jnp.zeros((bk, d), jnp.float32)
+
     q_base = offs_ref[0] if has_offsets else 0
+    kv_base = offs_ref[1] if has_offsets else 0
+    relevant = True
+    if causal:
+        # This q block contributes iff its last GLOBAL row reaches the
+        # kv block's first GLOBAL position.
+        relevant = q_base + (iq + 1) * bq - 1 >= kv_base + jk * bk
 
-    def body(i, carry):
-        dk, dv = carry
-        qi = q_ref[pl.ds(i * block_q, block_q), :]
-        doi = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[pl.ds(i * block_q, block_q), :]
-        delta = delta_ref[pl.ds(i * block_q, block_q), :]
+    @pl.when(relevant)
+    def _update():
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        qi = q_ref[:, :]
+        doi = do_ref[:, :]
+        lse = lse_ref[:, :]
+        delta = delta_ref[:, :]
         s = jax.lax.dot_general(
             qi, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = q_base + i * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
+            q_pos = q_base + iq * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            kv_pos = kv_base + jk * bk + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
         if has_bias:
-            s = s + bias_ref[:, pl.ds(jk * bk, bk)]
+            s = s + bias_ref[:, :]
         p = jnp.exp(s - lse)                     # [bq, bk]
-        dv = dv + jax.lax.dot_general(
+        dv_acc[:, :] = dv_acc[:, :] + jax.lax.dot_general(
             p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             doi, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(
+        dk_acc[:, :] = dk_acc[:, :] + jax.lax.dot_general(
             ds.astype(qi.dtype), qi, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    if causal and not has_offsets:
-        start = jnp.maximum(jk * bk // block_q, 0)
-    elif causal:
-        # First q block whose last GLOBAL row reaches this kv block's
-        # global start (mirror of the static bound, shifted by offsets).
-        start = jnp.clip((offs_ref[1] + jk * bk - offs_ref[0]) // block_q,
-                         0, tq // block_q)
-    else:
-        start = 0
-    dk, dv = lax.fori_loop(start, tq // block_q, body, (dk, dv))
-    dk_ref[:, :] = dk.astype(dk_ref.dtype)
-    dv_ref[:, :] = dv.astype(dv_ref.dtype)
+    @pl.when(iq == n_iq - 1)
+    def _finish():
+        dk_ref[:, :] = dk_acc[:, :].astype(dk_ref.dtype)
+        dv_ref[:, :] = dv_acc[:, :].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(*refs, scale, block_k, causal, has_bias, has_offsets):
+def _bwd_dq_kernel(*refs, scale, causal, has_bias, has_offsets):
+    # grid = (b, h, iq, jj): q/do/lse/delta/dq blocks are keyed by iq
+    # (constant across the inner jj steps), k/v stream per jj; dq
+    # accumulates in f32 VMEM scratch, written on the last step.
     if has_offsets:
         offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
             *rest = refs
@@ -189,74 +205,78 @@ def _bwd_dq_kernel(*refs, scale, block_k, causal, has_bias, has_offsets):
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest = refs
         offs_ref = None
     if has_bias:
-        bias_ref, dq_ref = rest
+        bias_ref, dq_ref, dq_acc = rest
     else:
-        (dq_ref,) = rest
+        dq_ref, dq_acc = rest
         bias_ref = None
     bq, d = q_ref.shape
-    tk = k_ref.shape[0]
+    bk = k_ref.shape[0]
     iq = pl.program_id(2)
-    q = q_ref[:, :]
-    do = do_ref[:, :]
-    lse = lse_ref[:, :]
-    delta = delta_ref[:, :]
+    jj = pl.program_id(3)
+    n_jj = pl.num_programs(3)
 
-    dq = jnp.zeros((bq, d), jnp.float32)
-    q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-    if has_offsets:
-        q_pos = q_pos + offs_ref[0]
+    @pl.when(jj == 0)
+    def _init():
+        dq_acc[:, :] = jnp.zeros((bq, d), jnp.float32)
+
+    q_base = offs_ref[0] if has_offsets else 0
     kv_base = offs_ref[1] if has_offsets else 0
+    relevant = True
+    if causal:
+        relevant = kv_base + jj * bk <= q_base + (iq + 1) * bq - 1
 
-    def body(j, dq):
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+    @pl.when(relevant)
+    def _update():
+        q = q_ref[:, :]
+        do = do_ref[:, :]
+        lse = lse_ref[:, :]
+        delta = delta_ref[:, :]
+        k_blk = k_ref[:, :]
+        v_blk = v_ref[:, :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            kv_pos = kv_base + j * block_k + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
+            q_pos = q_base + iq * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            kv_pos = kv_base + jj * bk + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
         if has_bias:
-            s = s + bias_ref[:, pl.ds(j * block_k, block_k)]
+            s = s + bias_ref[:, :]
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
+        dq_acc[:, :] = dq_acc[:, :] + jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal and not has_offsets:
-        n_blocks = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k,
-                               tk // block_k)
-    elif causal:
-        last_q = offs_ref[0] + (iq + 1) * bq - 1
-        n_blocks = jnp.clip((last_q - offs_ref[1]) // block_k + 1, 0,
-                            tk // block_k)
-    else:
-        n_blocks = tk // block_k
-    dq = lax.fori_loop(0, n_blocks, body, dq)
-    dq_ref[:, :] = dq.astype(dq_ref.dtype)
+    @pl.when(jj == n_jj - 1)
+    def _finish():
+        dq_ref[:, :] = dq_acc[:, :].astype(dq_ref.dtype)
 
 
 def _pallas_dispatch(kernel, grid, in_specs, out_specs, out_shape, args,
-                     offsets):
+                     offsets, scratch_shapes):
     """Shared fwd/bwd dispatch: plain grid, or scalar-prefetch grid
     spec when dynamic offsets ride along (the SMEM scalars arrive
-    before the kernel body and every index map)."""
+    before the kernel body and every index map). ``scratch_shapes``
+    are the f32 VMEM accumulators that persist across the inner grid
+    dimension."""
     if offsets is not None:
         return pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
-                out_specs=out_specs),
+                out_specs=out_specs, scratch_shapes=scratch_shapes),
             out_shape=out_shape, interpret=_INTERPRET,
         )(offsets, *args)
     return pl.pallas_call(
         kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
-        out_shape=out_shape, interpret=_INTERPRET)(*args)
+        out_shape=out_shape, interpret=_INTERPRET,
+        scratch_shapes=scratch_shapes)(*args)
 
 
 def _pick_block(t, want):
@@ -290,39 +310,45 @@ def _flash_fwd_impl(q, k, v, bias, causal, block_q, block_k,
     # per call and double the saved k/v residuals).
     n_rep = h // k.shape[1]
     scale = d ** -0.5
-    grid = (b, h, t // block_q)
+    grid = (b, h, t // block_q, tk // block_k)
     has_bias = bias is not None
     has_offsets = offsets is not None
-    kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
+    kernel = functools.partial(_fwd_kernel, scale=scale,
                                causal=causal, has_bias=has_bias,
                                has_offsets=has_offsets)
     # With scalar prefetch the index maps receive the scalar ref as a
     # trailing arg; *a soaks it up either way.
     in_specs = [
         pl.BlockSpec((None, None, block_q, d),
-                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
-        pl.BlockSpec((None, None, tk, d),
-                     lambda bi, hi, qi, *a: (bi, hi // n_rep, 0, 0)),
-        pl.BlockSpec((None, None, tk, d),
-                     lambda bi, hi, qi, *a: (bi, hi // n_rep, 0, 0)),
+                     lambda bi, hi, qi, ji, *a: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, block_k, d),
+                     lambda bi, hi, qi, ji, *a: (bi, hi // n_rep, ji, 0)),
+        pl.BlockSpec((None, None, block_k, d),
+                     lambda bi, hi, qi, ji, *a: (bi, hi // n_rep, ji, 0)),
     ]
     args = [q, k, v]
     if has_bias:
         in_specs.append(
-            pl.BlockSpec((None, 1, tk), lambda bi, hi, qi, *a: (bi, 0, 0)))
+            pl.BlockSpec((None, 1, block_k),
+                         lambda bi, hi, qi, ji, *a: (bi, 0, ji)))
         args.append(bias)
     out_specs = [
         pl.BlockSpec((None, None, block_q, d),
-                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+                     lambda bi, hi, qi, ji, *a: (bi, hi, qi, 0)),
         pl.BlockSpec((None, None, block_q, 1),
-                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+                     lambda bi, hi, qi, ji, *a: (bi, hi, qi, 0)),
     ]
     out_shape = [
         jax.ShapeDtypeStruct(q.shape, q.dtype),
         jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
     ]
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),   # acc
+        pltpu.VMEM((block_q, 1), jnp.float32),   # m
+        pltpu.VMEM((block_q, 1), jnp.float32),   # l
+    ]
     return _pallas_dispatch(kernel, grid, in_specs, out_specs, out_shape,
-                            args, offsets)
+                            args, offsets, scratch)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
@@ -361,85 +387,93 @@ def _flash_bwd_impl(q, k, v, bias, o, lse, do, causal, block_q, block_k,
         # An incoming lse cotangent folds into delta: ds = p*(dp - delta)
         # becomes p*(dp - delta + dlse), i.e. delta -= dlse.
         delta = delta - dlse.astype(jnp.float32)
-    bias_spec = pl.BlockSpec((None, 1, tk),
-                             lambda bi, hi, gi, *a: (bi, 0, 0))
 
-    def call(kernel, grid, in_specs, out_specs, out_shape, args):
+    def call(kernel, grid, in_specs, out_specs, out_shape, args,
+             scratch):
         return _pallas_dispatch(kernel, grid, in_specs, out_specs,
-                                out_shape, args, offsets)
+                                out_shape, args, offsets, scratch)
 
+    # dkv: grid (b, h, jk, iq) — q/do/lse/delta stream over the inner
+    # iq dimension, k/v and the dk/dv accumulators stay pinned per jk.
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
-                                   block_q=block_q, causal=causal,
-                                   has_bias=has_bias,
+                                   causal=causal, has_bias=has_bias,
                                    has_offsets=has_offsets)
     in_specs = [
-        pl.BlockSpec((None, None, t, d),
-                     lambda bi, hi, jk, *a: (bi, hi, 0, 0)),
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, jk, iq, *a: (bi, hi, iq, 0)),
         pl.BlockSpec((None, None, block_k, d),
-                     lambda bi, hi, jk, *a: (bi, hi // n_rep, jk, 0)),
+                     lambda bi, hi, jk, iq, *a: (bi, hi // n_rep, jk, 0)),
         pl.BlockSpec((None, None, block_k, d),
-                     lambda bi, hi, jk, *a: (bi, hi // n_rep, jk, 0)),
-        pl.BlockSpec((None, None, t, d),
-                     lambda bi, hi, jk, *a: (bi, hi, 0, 0)),
-        pl.BlockSpec((None, None, t, 1),
-                     lambda bi, hi, jk, *a: (bi, hi, 0, 0)),
-        pl.BlockSpec((None, None, t, 1),
-                     lambda bi, hi, jk, *a: (bi, hi, 0, 0)),
+                     lambda bi, hi, jk, iq, *a: (bi, hi // n_rep, jk, 0)),
+        pl.BlockSpec((None, None, block_q, d),
+                     lambda bi, hi, jk, iq, *a: (bi, hi, iq, 0)),
+        pl.BlockSpec((None, None, block_q, 1),
+                     lambda bi, hi, jk, iq, *a: (bi, hi, iq, 0)),
+        pl.BlockSpec((None, None, block_q, 1),
+                     lambda bi, hi, jk, iq, *a: (bi, hi, iq, 0)),
     ]
     args = [q, k, v, do, lse, delta]
     if has_bias:
-        in_specs.append(bias_spec)
+        in_specs.append(
+            pl.BlockSpec((None, 1, block_k),
+                         lambda bi, hi, jk, iq, *a: (bi, 0, jk)))
         args.append(bias)
     # dk/dv come out PER QUERY HEAD ([B, H, Tk, D]); the sum over each
     # kv-head's n_rep sharing query heads happens outside the kernel
-    # (one cheap XLA reduction — keeps the kernel free of cross-grid
+    # (one cheap XLA reduction — keeps the kernel free of cross-kv-head
     # accumulation state).
     dk, dv = call(
-        dkv_kernel, (b, h, tk // block_k), in_specs,
+        dkv_kernel, (b, h, tk // block_k, t // block_q), in_specs,
         [
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, jk, *a: (bi, hi, jk, 0)),
+                         lambda bi, hi, jk, iq, *a: (bi, hi, jk, 0)),
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, jk, *a: (bi, hi, jk, 0)),
+                         lambda bi, hi, jk, iq, *a: (bi, hi, jk, 0)),
         ],
         [
             jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, tk, d), v.dtype),
         ],
-        args)
+        args,
+        [pltpu.VMEM((block_k, d), jnp.float32),
+         pltpu.VMEM((block_k, d), jnp.float32)])
     if n_rep > 1:
         dk = dk.astype(jnp.float32).reshape(b, hkv, n_rep, tk, d) \
             .sum(axis=2).astype(k.dtype)
         dv = dv.astype(jnp.float32).reshape(b, hkv, n_rep, tk, d) \
             .sum(axis=2).astype(v.dtype)
 
+    # dq: grid (b, h, iq, jj) — k/v stream over the inner jj dimension,
+    # q/do/lse/delta and the dq accumulator stay pinned per iq.
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
-                                  block_k=block_k, causal=causal,
-                                  has_bias=has_bias,
+                                  causal=causal, has_bias=has_bias,
                                   has_offsets=has_offsets)
     in_specs = [
         pl.BlockSpec((None, None, block_q, d),
-                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
-        pl.BlockSpec((None, None, tk, d),
-                     lambda bi, hi, qi, *a: (bi, hi // n_rep, 0, 0)),
-        pl.BlockSpec((None, None, tk, d),
-                     lambda bi, hi, qi, *a: (bi, hi // n_rep, 0, 0)),
+                     lambda bi, hi, qi, ji, *a: (bi, hi, qi, 0)),
+        pl.BlockSpec((None, None, block_k, d),
+                     lambda bi, hi, qi, ji, *a: (bi, hi // n_rep, ji, 0)),
+        pl.BlockSpec((None, None, block_k, d),
+                     lambda bi, hi, qi, ji, *a: (bi, hi // n_rep, ji, 0)),
         pl.BlockSpec((None, None, block_q, d),
-                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+                     lambda bi, hi, qi, ji, *a: (bi, hi, qi, 0)),
         pl.BlockSpec((None, None, block_q, 1),
-                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+                     lambda bi, hi, qi, ji, *a: (bi, hi, qi, 0)),
         pl.BlockSpec((None, None, block_q, 1),
-                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
+                     lambda bi, hi, qi, ji, *a: (bi, hi, qi, 0)),
     ]
     args = [q, k, v, do, lse, delta]
     if has_bias:
-        in_specs.append(bias_spec)
+        in_specs.append(
+            pl.BlockSpec((None, 1, block_k),
+                         lambda bi, hi, qi, ji, *a: (bi, 0, ji)))
         args.append(bias)
     dq = call(
-        dq_kernel, (b, h, t // block_q), in_specs,
+        dq_kernel, (b, h, t // block_q, tk // block_k), in_specs,
         pl.BlockSpec((None, None, block_q, d),
-                     lambda bi, hi, qi, *a: (bi, hi, qi, 0)),
-        jax.ShapeDtypeStruct(q.shape, q.dtype), args)
+                     lambda bi, hi, qi, ji, *a: (bi, hi, qi, 0)),
+        jax.ShapeDtypeStruct(q.shape, q.dtype), args,
+        [pltpu.VMEM((block_q, d), jnp.float32)])
     return dq, dk, dv
 
 
@@ -501,7 +535,7 @@ _flash_offsets.defvjp(_flash_offsets_fwd, _flash_offsets_bwd)
 
 
 def flash_attention_chunk(q, k, v, q_offset, kv_offset, causal=True,
-                          block_q=512, block_k=512):
+                          block_q=1024, block_k=1024):
     """One ring-attention step on the pallas kernels: attention of the
     local queries against ONE K/V chunk, with global positions for the
     causal mask. Layout [B, H(q)/Hkv(kv), T, D] (kernel layout — ring
@@ -530,8 +564,8 @@ def _masked_attention_xla(q, k, v, kv_bias, causal):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def flash_attention(q, k, v, causal=True, kv_bias=None, block_q=512,
-                    block_k=512):
+def flash_attention(q, k, v, causal=True, kv_bias=None, block_q=1024,
+                    block_k=1024):
     """Flash attention. q,k,v: [B, T, H, D] (framework layout; kv heads
     may be fewer — GQA is handled natively: the kernels index kv-head
     ``query_head // n_rep``, so the expansion never materializes in

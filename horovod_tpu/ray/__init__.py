@@ -6,6 +6,10 @@ per slot, placed by a colocation strategy; the executor wires the
 HOROVOD_* env across them and drives ``execute``/``run`` calls.
 """
 
+from horovod_tpu.ray.elastic import (  # noqa: F401
+    ElasticRayExecutor,
+    RayHostDiscovery,
+)
 from horovod_tpu.ray.runner import RayExecutor  # noqa: F401
 from horovod_tpu.ray.strategy import (  # noqa: F401
     ColocationStrategy,

@@ -1,0 +1,201 @@
+"""Elastic Horovod on Ray: auto-scaling worker fleet as Ray actors.
+
+Reference analog: ``horovod/ray/elastic_v2.py`` (ElasticRayExecutor +
+RayHostDiscovery): the driver discovers the Ray cluster's current
+nodes, spawns one worker actor per slot, and the elastic machinery
+(rendezvous, epoch cuts, respawn-on-failure, blacklist, scale-up/down)
+keeps the fleet matched to the cluster as nodes come and go.
+
+TPU-native redesign: rather than a second elastic driver, the Ray path
+reuses ``horovod_tpu.runner.elastic.driver.ElasticDriver`` wholesale —
+only the worker LAUNCH is swapped (`_execute_worker`): a Ray actor
+pinned to the discovered node runs the user's function instead of an
+ssh'd OS process. Discovery, reconcile, rendezvous, survivor-first rank
+layout, and blacklisting are the same code paths the launcher-based
+elastic tests already prove. The launcher backend is injectable, so the
+full add/remove/respawn lifecycle is unit-testable without a Ray
+cluster (thread-fake actors — the reference's own elastic test
+pattern).
+"""
+
+import threading
+
+from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+
+def _require_ray():
+    try:
+        import ray
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.ray elastic support requires the 'ray' package, "
+            "which is not installed in this environment.") from e
+    return ray
+
+
+class RayHostDiscovery:
+    """Discovery over the live Ray cluster: one entry per alive node,
+    slots = how many workers its resources can host.
+
+    Reference analog: ``elastic_v2.RayHostDiscovery`` (ray.nodes() →
+    {ip: slots} using CPU/GPU totals).
+    """
+
+    def __init__(self, cpus_per_worker=1, gpus_per_worker=0,
+                 use_gpu=None):
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
+        if use_gpu and not gpus_per_worker:
+            self.gpus_per_worker = 1
+
+    def find_available_hosts_and_slots(self):
+        ray = _require_ray()
+        hosts = {}
+        for node in ray.nodes():
+            if not node.get("Alive"):
+                continue
+            res = node.get("Resources", {}) or {}
+            slots = int(res.get("CPU", 0) // max(self.cpus_per_worker, 1))
+            if self.gpus_per_worker:
+                slots = min(slots,
+                            int(res.get("GPU", 0) // self.gpus_per_worker))
+            if slots > 0:
+                hosts[node.get("NodeManagerAddress")] = slots
+        return hosts
+
+
+def _ray_actor_launcher(cpus_per_worker=1, gpus_per_worker=0,
+                        poll_s=0.25):
+    """Real backend: run the worker fn inside a Ray actor pinned to the
+    worker's discovered node. Returns a launcher callable with the
+    injectable-backend signature ``(worker, env, fn, events) ->
+    (rc, result)``."""
+    ray = _require_ray()
+
+    @ray.remote
+    class _ElasticWorker:
+        def run(self, env, fn):
+            import os
+
+            os.environ.update(env)
+            return fn(env)
+
+    def launch(worker, env, fn, events):
+        # Ship ONLY the HOROVOD_* contract vars to the actor — the env
+        # dict the driver builds starts from the driver node's full
+        # os.environ, and overwriting a remote node's JAX_PLATFORMS /
+        # TPU_* / PATH with the driver's would silently move workers
+        # onto the wrong devices (the ssh backend exports HOROVOD_*
+        # only for the same reason).
+        env = {k: v for k, v in env.items() if k.startswith("HOROVOD_")}
+        actor = _ElasticWorker.options(
+            num_cpus=cpus_per_worker, num_gpus=gpus_per_worker,
+            # Pin to the discovered node: discovery reports node IPs and
+            # ray publishes a node:<ip> custom resource per node.
+            resources={f"node:{worker.host}": 0.001},
+        ).remote()
+        ref = actor.run.remote(env, fn)
+        try:
+            while True:
+                done, _ = ray.wait([ref], timeout=poll_s)
+                if done:
+                    try:
+                        return 0, ray.get(done[0])
+                    except Exception:  # noqa: BLE001 — actor death or
+                        # user-fn failure both mean this slot failed.
+                        return 1, None
+                if any(ev.is_set() for ev in events):
+                    return 1, None
+        finally:
+            ray.kill(actor)
+
+    return launch
+
+
+class _ElasticRayDriver(ElasticDriver):
+    """ElasticDriver with actor-launched workers + per-worker results.
+    Everything but the launch backend is inherited unchanged."""
+
+    def __init__(self, discovery, fn, launcher, min_np, **kw):
+        super().__init__(discovery, command=[], min_np=min_np, **kw)
+        self._fn = fn
+        self._launcher = launcher
+        self._results = {}
+        self._results_lock = threading.Lock()
+
+    def _execute_worker(self, worker, env):
+        rc, result = self._launcher(worker, env, self._fn,
+                                    [worker.kill_event, self._shutdown])
+        if rc == 0 and not worker.driver_killed:
+            with self._results_lock:
+                self._results[worker.worker_id] = result
+        return rc
+
+    def results(self):
+        with self._results_lock:
+            return dict(self._results)
+
+
+class ElasticRayExecutor:
+    """Reference-shaped elastic executor: construct with discovery +
+    fleet bounds, then ``run(fn)`` blocks until the job completes and
+    returns the successful workers' results.
+
+    ``launcher`` is the actor backend — default is real Ray actors;
+    tests inject thread-fakes (``(worker, env, fn, events) ->
+    (rc, result)``).
+    """
+
+    def __init__(self, discovery=None, min_np=1, max_np=None,
+                 cpus_per_worker=1, gpus_per_worker=0, env_vars=None,
+                 override_discovery=None, launcher=None,
+                 poll_interval=2.0, start_timeout=60, verbose=False):
+        self.discovery = override_discovery or discovery
+        if self.discovery is None:
+            self.discovery = RayHostDiscovery(
+                cpus_per_worker=cpus_per_worker,
+                gpus_per_worker=gpus_per_worker)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.env_vars = dict(env_vars or {})
+        self._launcher = launcher
+        self._cpus = cpus_per_worker
+        self._gpus = gpus_per_worker
+        self._poll_interval = poll_interval
+        self._start_timeout = start_timeout
+        self._verbose = verbose
+        self.driver = None
+
+    def start(self):
+        """No-op kept for reference API parity (`start(); run(fn)`) —
+        the fleet cannot spawn before ``run`` supplies the worker fn."""
+
+    def run(self, fn):
+        """Run ``fn`` elastically; blocks until the job completes and
+        returns the successful workers' results (sorted by worker id).
+
+        The worker-fn contract is the same on every backend: ``fn`` is
+        called with the HOROVOD_* env dict (rendezvous address, worker
+        id, hostname); real Ray actors additionally apply it to
+        ``os.environ`` first, so ``hvd.init()`` works unmodified.
+        """
+        launcher = self._launcher or _ray_actor_launcher(
+            cpus_per_worker=self._cpus, gpus_per_worker=self._gpus)
+        self.driver = _ElasticRayDriver(
+            self.discovery, fn, launcher, min_np=self.min_np,
+            max_np=self.max_np, env=self.env_vars,
+            poll_interval=self._poll_interval,
+            start_timeout=self._start_timeout, verbose=self._verbose)
+        try:
+            self.driver.start()
+            rc = self.driver.wait_for_completion()
+        finally:
+            # stop() also runs when start() itself times out waiting
+            # for min_np slots — the rendezvous HTTP server was already
+            # live from __init__ and must not leak.
+            results = self.driver.results()
+            self.driver.stop()
+        if rc != 0:
+            raise RuntimeError(
+                f"elastic ray job failed (exit code {rc})")
+        return [results[wid] for wid in sorted(results)]

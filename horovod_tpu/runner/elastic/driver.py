@@ -165,19 +165,7 @@ class ElasticDriver:
                 "HOROVOD_RDZV_ADDR": self._rdzv_addr(),
                 "HOROVOD_RDZV_PORT": str(self._rendezvous.port),
             })
-            if util.is_local_host(host):
-                cmd = list(self._command)
-            else:
-                exports = " ".join(
-                    f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
-                    if k.startswith("HOROVOD_"))
-                cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
-                       f"cd {shlex.quote(os.getcwd())} && env {exports} "
-                       + " ".join(shlex.quote(c) for c in self._command)]
-            rc = safe_shell_exec.execute(
-                cmd, env=env,
-                prefix=f"[{worker_id}]: " if self._verbose else b"",
-                events=[w.kill_event, self._shutdown])
+            rc = self._execute_worker(w, env)
             self._on_worker_exit(w, rc)
 
         w.thread = threading.Thread(target=run, daemon=True)
@@ -185,6 +173,28 @@ class ElasticDriver:
             self._workers[worker_id] = w
         w.thread.start()
         return w
+
+    def _execute_worker(self, worker, env):
+        """Launch one worker and block until it exits; return its exit
+        code. The default backend execs ``self._command`` as an OS
+        process (locally or over ssh). Actor-based executors (the Ray
+        elastic executor) override this — the rest of the driver
+        (discovery, reconcile, rendezvous, epoch cuts) is backend-
+        agnostic. Implementations must honor ``worker.kill_event`` and
+        ``self._shutdown``."""
+        if util.is_local_host(worker.host):
+            cmd = list(self._command)
+        else:
+            exports = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+                if k.startswith("HOROVOD_"))
+            cmd = ["ssh", "-o", "StrictHostKeyChecking=no", worker.host,
+                   f"cd {shlex.quote(os.getcwd())} && env {exports} "
+                   + " ".join(shlex.quote(c) for c in self._command)]
+        return safe_shell_exec.execute(
+            cmd, env=env,
+            prefix=f"[{worker.worker_id}]: " if self._verbose else b"",
+            events=[worker.kill_event, self._shutdown])
 
     def _on_worker_exit(self, worker, rc):
         worker.exit_code = rc

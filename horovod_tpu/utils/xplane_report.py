@@ -20,6 +20,7 @@ CLI::
 """
 
 import glob
+import re
 import os
 from collections import defaultdict
 
@@ -35,8 +36,28 @@ _BUCKETS = (
 )
 
 
+# First lowercase identifier directly followed by '(' after the '=':
+# that is the opcode (layout/memory-space annotations like T(8,128) or
+# S(1) are uppercase, so they can't match).
+_OPCODE_RE = re.compile(r"([a-z][a-z0-9_.-]*)\(")
+
+
+def _op_ident(name):
+    """The DEFINED op's identity: ``%lhs = type opcode(operands...)`` →
+    ``lhs opcode``. Matching the full HLO text misbuckets badly — any
+    matmul fusion whose *operand* is a ``%copy-done`` used to land in
+    the copy bucket (this overstated copy time 20× on the round-3
+    flagship trace: 276 "copy" ms/step that were mostly fused weight-
+    gradient matmuls consuming async-prefetched operands)."""
+    lhs, sep, rhs = name.partition(" = ")
+    if not sep:
+        return name
+    m = _OPCODE_RE.search(rhs)
+    return f"{lhs} {m.group(1)}" if m else lhs
+
+
 def _bucket(name):
-    n = name.lower()
+    n = _op_ident(name).lower()
     for keys, label in _BUCKETS:
         if any(k in n for k in keys):
             return label
