@@ -70,7 +70,7 @@ def _peak_flops(device):
 def _flagship_cfg():
     return LlamaConfig(vocab_size=32768, d_model=2048, n_layers=20,
                        n_heads=16, n_kv_heads=8, d_ff=8192,
-                       dtype="bfloat16", remat="attn",
+                       dtype="bfloat16", remat="attn+gate",
                        param_dtype="bfloat16")
 
 
@@ -81,7 +81,7 @@ def _flagship_cfg():
 def _same_size_cfg(param_dtype):
     return LlamaConfig(vocab_size=32768, d_model=1536, n_layers=20,
                        n_heads=12, n_kv_heads=6, d_ff=6144,
-                       dtype="bfloat16", remat="attn",
+                       dtype="bfloat16", remat="attn+gate",
                        param_dtype=param_dtype)
 
 

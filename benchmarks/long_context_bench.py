@@ -17,6 +17,7 @@ Writes one row per (batch, seq) config: MFU, tokens/s, ms/step.
 """
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -27,9 +28,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import jax
 
-# (batch, seq): 8192+ tokens of context on ONE chip; t16384 at b1 is
-# the largest activation footprint that fits beside the 1.39B model.
-CONFIGS = [(4, 2048), (2, 8192), (1, 16384)]
+# (batch, seq, remat): 8192+ tokens of context on ONE chip; t16384 at
+# b1 is the largest activation footprint that fits beside the 1.39B
+# model. The remat tradeoff flips with T: the flagship's "attn+gate"
+# (save FFN gate residuals, skip their recompute) wins at t2048 but
+# its per-layer [B,T,d_ff] saves grow linearly in T and OOM HBM at
+# t8192 (19.4G needed) — the long rows drop back to "attn".
+CONFIGS = [(4, 2048, None), (2, 8192, "attn"), (1, 16384, "attn")]
 
 
 def main():
@@ -45,9 +50,11 @@ def main():
               file=sys.stderr)
         return
 
-    cfg = bench._flagship_cfg()
     rows = []
-    for batch, seq in CONFIGS:
+    for batch, seq, remat in CONFIGS:
+        cfg = bench._flagship_cfg()
+        if remat is not None:
+            cfg = dataclasses.replace(cfg, remat=remat)
         t0 = time.time()
         row = bench.run_spmd(cfg, batch, seq, args.steps,
                              f"long_context_mfu_t{seq}",

@@ -42,8 +42,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     # Rematerialization: True/"full" recomputes the whole layer in
-    # backward (min HBM, ~1/3 extra FLOPs), "dots" saves matmul outputs
-    # and recomputes only elementwise work (the usual best MFU point),
+    # backward (min HBM, ~1/3 extra FLOPs); "attn" saves only the flash
+    # kernel's residuals; "attn+gate" also saves the pre-silu FFN gate
+    # (skips one matmul re-run per layer — best measured MFU at bench
+    # shapes); "attn+ffn" saves both up-projections (more HBM); "dots"
+    # saves every matmul output and recomputes only elementwise work;
     # False/"none" saves everything.
     remat: "bool | str" = True
     # Sparse mixture-of-experts (mixtral-style): n_experts == 0 keeps the
@@ -314,9 +317,15 @@ def _ffn(h, lp, c, mesh=None):
     dt = c.compute_dtype
     if c.n_experts > 0:
         return _moe_ffn(h, lp, c, mesh)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
-    return ((gate * up) @ lp["w_down"].astype(dt),
+    # Named for remat="attn+ffn": saving the two up-projections (the
+    # bulk of a layer's recomputed matmul FLOPs) lets backward rebuild
+    # silu(gate)*up elementwise instead of re-running both matmuls.
+    # The PRE-silu value is what must be saved — silu's own vjp needs
+    # its primal input, so saving post-silu would still re-run the
+    # matmul to regenerate it.
+    gate_pre = checkpoint_name(h @ lp["w_gate"].astype(dt), "ffn_gate")
+    up = checkpoint_name(h @ lp["w_up"].astype(dt), "ffn_up")
+    return ((jax.nn.silu(gate_pre) * up) @ lp["w_down"].astype(dt),
             jnp.zeros((), jnp.float32))
 
 
@@ -393,13 +402,29 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
             layer,
             policy=jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "flash_o", "flash_lse"))
+    elif c.remat in ("attn+ffn", "attn+gate"):
+        # "attn" plus FFN up-projection residuals (pre-silu gate, and
+        # for "attn+ffn" also up — [B,T,d_ff] each per layer): trades
+        # d·d_ff matmul re-runs per layer for HBM — the largest
+        # recompute term after attention. Measured on one v5e chip the
+        # HBM price exceeds the win (the batch must shrink to fit, see
+        # docs/benchmarks.md r4 notes); the modes exist for multi-chip
+        # FSDP runs where per-chip activation memory is the constraint
+        # that actually relaxes.
+        names = ["attn_out", "flash_o", "flash_lse", "ffn_gate"]
+        if c.remat == "attn+ffn":
+            names.append("ffn_up")
+        body = jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(*names))
     elif c.remat in (False, "none"):
         pass
     elif c.remat in (True, "full"):
         body = jax.checkpoint(layer)
     else:
         raise ValueError(f"unknown remat mode {c.remat!r}: expected "
-                         "True/'full', 'dots', 'attn', or False/'none'")
+                         "True/'full', 'dots', 'attn', 'attn+gate', "
+                         "'attn+ffn', or False/'none'")
 
     n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
     if n_stages > 1:

@@ -431,3 +431,42 @@ def test_master_weights_tracks_fp32_training():
     # both optimize; final losses agree to bf16-forward tolerance
     assert ref[-1] < ref[0] and mixed[-1] < mixed[0]
     assert abs(ref[-1] - mixed[-1]) / abs(ref[-1]) < 0.05, (ref, mixed)
+
+
+def test_remat_modes_agree_on_gradients():
+    """Every remat policy is a pure scheduling choice: loss and grads
+    must match remat=False bit-for-bit-ish (f32 tolerances). Covers the
+    r4 'attn+gate'/'attn+ffn' modes whose saved FFN residuals must not
+    change the math."""
+    cfg0 = LlamaConfig.tiny(dtype="float32", n_layers=2, remat=False)
+    params = llama_init(cfg0, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg0.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+    def loss_and_grads(remat):
+        cfg = dataclasses.replace(cfg0, remat=remat)
+        return jax.jit(jax.value_and_grad(
+            lambda p: llama_loss(p, batch, cfg)))(params)
+
+    ref_loss, ref_grads = loss_and_grads(False)
+    for mode in ("attn", "attn+gate", "attn+ffn", "dots", "full"):
+        loss, grads = loss_and_grads(mode)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-6, err_msg=mode)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+                err_msg=mode),
+            grads, ref_grads)
+
+
+def test_unknown_remat_mode_rejected():
+    import pytest
+
+    cfg = LlamaConfig.tiny(dtype="float32", remat="bogus")
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab_size)
+    with pytest.raises(ValueError, match="unknown remat mode"):
+        llama_forward(params, tokens, cfg)
