@@ -298,6 +298,13 @@ def _moe_ffn(h, lp, c, mesh):
             z, jax.sharding.NamedSharding(
                 mesh, P(("data", "fsdp"), "expert", None, None)))
 
+    # Named for remat="attn+gate" (the FFN-residual mode): the one-hot
+    # cumsum routing chain above is bandwidth-bound vector work over
+    # [B,T,E,C] tensors — saving its two products keeps backward from
+    # re-running it (the MoE analog of the dense mode's saved gate).
+    dispatch = checkpoint_name(dispatch, "moe_dispatch")
+    combine = checkpoint_name(combine, "moe_combine")
+
     xe = constrain_e(jnp.einsum("btec,btd->becd", dispatch,
                                 h.astype(dt)))                # [B,E,C,D]
     gate = jax.nn.silu(jnp.einsum("becd,edf->becf", xe,
@@ -411,7 +418,8 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
         # docs/benchmarks.md r4 notes); the modes exist for multi-chip
         # FSDP runs where per-chip activation memory is the constraint
         # that actually relaxes.
-        names = ["attn_out", "flash_o", "flash_lse", "ffn_gate"]
+        names = ["attn_out", "flash_o", "flash_lse", "ffn_gate",
+                 "moe_dispatch", "moe_combine"]
         if c.remat == "attn+ffn":
             names.append("ffn_up")
         body = jax.checkpoint(

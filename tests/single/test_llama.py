@@ -461,6 +461,32 @@ def test_remat_modes_agree_on_gradients():
             grads, ref_grads)
 
 
+def test_remat_modes_agree_on_gradients_moe():
+    """Same scheduling-only contract for the MoE layer — covers the
+    saved moe_dispatch/moe_combine residuals under attn+gate."""
+    cfg0 = LlamaConfig.tiny_moe(dtype="float32", n_layers=2, remat=False)
+    params = llama_init(cfg0, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg0.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+    def loss_and_grads(remat):
+        cfg = dataclasses.replace(cfg0, remat=remat)
+        return jax.jit(jax.value_and_grad(
+            lambda p: llama_loss(p, batch, cfg)))(params)
+
+    ref_loss, ref_grads = loss_and_grads(False)
+    for mode in ("attn", "attn+gate", "attn+ffn", "dots", "full"):
+        loss, grads = loss_and_grads(mode)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-6, err_msg=mode)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+                err_msg=mode),
+            grads, ref_grads)
+
+
 def test_unknown_remat_mode_rejected():
     import pytest
 
