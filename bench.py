@@ -49,12 +49,18 @@ _PEAK = {"v4": 275e12, "v5e": 197e12, "v5 lite": 197e12, "v5": 459e12,
          "v5p": 459e12, "v6e": 918e12, "cpu": 5e11}
 
 
-def _peak_flops(device):
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, val in sorted(_PEAK.items(), key=lambda kv: -len(kv[0])):
+def match_device_table(device, table, default_key="cpu"):
+    """Longest-key-first substring match of device_kind against a
+    per-generation table (shared by the MFU and MBU benches)."""
+    kind = getattr(device, "device_kind", default_key).lower()
+    for key, val in sorted(table.items(), key=lambda kv: -len(kv[0])):
         if key in kind:
             return val
-    return _PEAK["cpu"]
+    return table[default_key]
+
+
+def _peak_flops(device):
+    return match_device_table(device, _PEAK)
 
 
 # 1.4B decoder: profiled sweet spot for one 16G-HBM chip. Pure-bf16

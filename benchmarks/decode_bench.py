@@ -1,0 +1,123 @@
+"""Single-chip autoregressive decode benchmark (KV-cache path).
+
+Measures ``llama_generate`` (models/generate.py: one compiled
+prefill+decode program, per-layer KV caches updated in-place via
+dynamic_update_slice) on the real chip. Decode is HBM-bandwidth-bound —
+every step streams the full parameter set plus the KV cache — so
+alongside tokens/s this reports **MBU** (memory-bandwidth utilization:
+bytes-that-must-move per step / step time / peak HBM bandwidth), the
+decode analog of training MFU.
+
+Per-step time is isolated by differencing two generation lengths
+(256 vs 32 new tokens): each timed call re-runs the prefill too, and
+at large batch the prefill is a material fraction of the wall time —
+dividing a whole call by its decode steps would overstate ms/step.
+
+Run on a real TPU chip::
+
+    python benchmarks/decode_bench.py [--out results.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+# Peak HBM GB/s by device generation (v5e: 819 GB/s per chip).
+_HBM_PEAK = {"v4": 1228e9, "v5e": 819e9, "v5 lite": 819e9,
+             "v5p": 2765e9, "v6e": 1640e9, "cpu": 100e9}
+
+# (batch, prompt_len): bs1 is the latency point, bs16/bs64 throughput.
+CONFIGS = [(1, 128), (16, 128), (64, 128)]
+NEW_LONG, NEW_SHORT = 256, 32
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import bench
+    from horovod_tpu.models import llama_init
+    from horovod_tpu.models.generate import llama_generate
+
+    if jax.devices()[0].platform == "cpu":
+        print("decode_bench needs an accelerator; skipping",
+              file=sys.stderr)
+        return
+
+    cfg = bench._flagship_cfg()
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    param_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree.leaves(params))
+    hbm_peak = bench.match_device_table(jax.devices()[0], _HBM_PEAK)
+
+    def timed(gen, prompt, reps=3):
+        # Materialize to HOST, not block_until_ready: on some PJRT
+        # transports block_until_ready returns before the program
+        # finishes, which once inflated this row 1000x. The [B, T+new]
+        # int32 copy itself is microseconds.
+        t0 = time.time()
+        np.asarray(gen(params, prompt))
+        first_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(reps):
+            np.asarray(gen(params, prompt))
+        return first_s, (time.time() - t0) / reps
+
+    rows = []
+    for batch, t0_len in CONFIGS:
+        prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, t0_len), 0, cfg.vocab_size)
+        gen_long = jax.jit(
+            lambda p, t: llama_generate(p, t, cfg, NEW_LONG))
+        gen_short = jax.jit(
+            lambda p, t: llama_generate(p, t, cfg, NEW_SHORT))
+        first_s, dt_long = timed(gen_long, prompt)
+        _, dt_short = timed(gen_short, prompt)
+        # Decode-only per-step time: the prefill and fixed dispatch
+        # costs cancel in the difference.
+        step_s = (dt_long - dt_short) / (NEW_LONG - NEW_SHORT)
+        tok_s = batch / step_s
+        # Bytes per decode step: all params + the mean live KV slice
+        # (cache grows t0 -> t0+new; attention reads the filled prefix).
+        kv_mean = (cfg.n_layers * batch * (t0_len + NEW_LONG / 2)
+                   * cfg.n_kv_heads * cfg.head_dim * 2 * 2)
+        mbu = (param_bytes + kv_mean) / step_s / hbm_peak
+        row = {
+            "metric": f"decode_tok_s_b{batch}",
+            "value": round(tok_s, 1),
+            "unit": f"tok/s decode-only ({n_params / 1e6:.0f}M params "
+                    f"bf16, batch {batch}, prompt {t0_len}, "
+                    f"{step_s * 1e3:.2f} ms/step, MBU {mbu:.2f}, "
+                    f"first call incl compile {first_s:.0f}s, "
+                    f"{jax.devices()[0].device_kind})",
+            "vs_baseline": round(mbu, 3),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.out:
+        payload = {
+            "note": "Decode (KV cache) on one real chip; per-step time "
+                    "isolated by differencing 256- vs 32-token "
+                    "generations (prefill cancels). vs_baseline "
+                    "carries MBU (step bytes / step time / peak HBM "
+                    "bw) - the bandwidth-roofline utilization, "
+                    "decode's analog of MFU.",
+            "rows": rows,
+        }
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -3,9 +3,10 @@
 Net-new vs the reference (Horovod ships no inference path); TPU-first:
 one jitted program — prefill fills the cache with a single full-sequence
 pass, then ``lax.scan`` decodes token-by-token against a static-shaped
-cache (no dynamic shapes, no per-step retrace). Causal masking comes for
-free from ``blockwise_attention``'s global-position offsets: cache slots
-past the current position have ``kv_pos > q_pos`` and mask themselves.
+cache (no dynamic shapes, no per-step retrace). The per-step attention
+is GQA-native (``_decode_attention``): grouped einsums read the cache
+at its stored kv-head width, and slots past the current position mask
+themselves by global index.
 
 Dense and MoE configs (per-token top-k routing is sequence-independent,
 so cached decode routes each new token exactly as a full forward would;
@@ -22,7 +23,6 @@ from jax import lax
 
 from horovod_tpu.models.llama import _ffn as _llama_ffn
 from horovod_tpu.models.llama import _rmsnorm, _rope
-from horovod_tpu.parallel.ring_attention import blockwise_attention
 
 
 def _ffn(h, lp, c):
@@ -86,6 +86,35 @@ def _layer_kv(h, lp, c, positions):
     return _rope(k, positions, c.rope_theta), v
 
 
+def _decode_attention(q, cache_k, cache_v, pos):
+    """One-token attention against the cache, GQA-native.
+
+    q [B,1,H,D]; cache_k/v [B,S,Hkv,D]; slots <= pos are valid. The
+    grouped einsums index kv-heads directly — repeating the cache to H
+    query heads (what the generic blockwise path does) would stream an
+    n_rep× expanded copy of the cache through HBM per layer per step,
+    and decode is pure bandwidth: at batch 64 that repeat alone tripled
+    step time.
+    """
+    b, _, hq, d = q.shape
+    s_len, hkv = cache_k.shape[1], cache_k.shape[2]
+    n_rep = hq // hkv
+    qg = q.reshape(b, 1, hkv, n_rep, d)
+    # s: [B, G, R, S] logits per kv-head group; f32 softmax.
+    s = jnp.einsum("bqgrd,bsgd->bgrs", qg, cache_k,
+                   preferred_element_type=jnp.float32)
+    s = s * (d ** -0.5)
+    valid = jnp.arange(s_len) <= pos                  # [S]
+    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    # p stays f32 through the value contraction (matching the training
+    # path's accumulation): rounding the attention weights to bf16
+    # before PV can flip greedy decode at a near-tie.
+    out = jnp.einsum("bgrs,bsgd->bgrd", p, cache_v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, 1, hq, d).astype(q.dtype)
+
+
 def _attend_step(x, lp, c, cache_k, cache_v, pos):
     """One decode-position layer step against the cache.
 
@@ -102,9 +131,7 @@ def _attend_step(x, lp, c, cache_k, cache_v, pos):
     k_new, v_new = _layer_kv(h, lp, c, positions)
     cache_k = lax.dynamic_update_slice(cache_k, k_new, (0, pos, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, v_new, (0, pos, 0, 0))
-    # q_offset=pos, kv_offset=0: slots > pos are future -> masked.
-    attn = blockwise_attention(q, cache_k, cache_v, causal=True,
-                               q_offset=pos, kv_offset=0)
+    attn = _decode_attention(q, cache_k, cache_v, pos)
     x = x + attn.reshape(b, 1, -1) @ lp["wo"].astype(dt)
     h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
     x = x + _decode_ffn(h, lp, c)
