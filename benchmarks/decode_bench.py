@@ -88,9 +88,14 @@ def main():
         # costs cancel in the difference.
         step_s = (dt_long - dt_short) / (NEW_LONG - NEW_SHORT)
         tok_s = batch / step_s
-        # Bytes per decode step: all params + the mean live KV slice
-        # (cache grows t0 -> t0+new; attention reads the filled prefix).
-        kv_mean = (cfg.n_layers * batch * (t0_len + NEW_LONG / 2)
+        # Bytes per decode step: all params + the KV cache traffic.
+        # _decode_attention reads the FULL padded cache
+        # [B, t0+new, Hkv, D] every step (dense einsum, masked by
+        # index), so a run of n steps streams n*(t0+n) positions; the
+        # differenced window's effective length per step is
+        # (L*(t0+L) - S*(t0+S)) / (L-S) = t0 + L + S.
+        kv_mean = (cfg.n_layers * batch
+                   * (t0_len + NEW_LONG + NEW_SHORT)
                    * cfg.n_kv_heads * cfg.head_dim * 2 * 2)
         mbu = (param_bytes + kv_mean) / step_s / hbm_peak
         row = {

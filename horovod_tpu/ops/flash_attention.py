@@ -119,7 +119,15 @@ def _fwd_kernel(*refs, scale, causal, has_bias, has_offsets):
     @pl.when(jj == n_jj - 1)
     def _finish():
         l = jnp.maximum(l_ref[:, :], 1e-30)
-        o_ref[:, :] = (acc_ref[:, :] / l).astype(o_ref.dtype)
+        # A q row with ZERO valid keys (possible in ring/offset chunks
+        # whose kv chunk is entirely future) keeps m == _NEG, so
+        # p = exp(s - m) = 1 uniformly and acc/l would be mean-of-V.
+        # Zero those rows: their lse stays ~_NEG, so ring logsumexp
+        # merging weights them out anyway, but the standalone chunk
+        # output must be correct in its own right.
+        valid = m_ref[:, :] > _NEG / 2
+        o_ref[:, :] = jnp.where(
+            valid, acc_ref[:, :] / l, 0.0).astype(o_ref.dtype)
         lse_ref[:, :] = m_ref[:, :] + jnp.log(l)
 
 
@@ -176,7 +184,10 @@ def _bwd_dkv_kernel(*refs, scale, causal, has_bias, has_offsets):
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
         if has_bias:
             s = s + bias_ref[:, :]
-        p = jnp.exp(s - lse)                     # [bq, bk]
+        # For a q row with ZERO valid keys lse is itself ~_NEG, so
+        # exp(s - lse) rounds to 1 per masked key — guard on s directly
+        # (valid rows are unaffected: their masked keys underflow to 0).
+        p = jnp.where(s > _NEG / 2, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dv_acc[:, :] = dv_acc[:, :] + jax.lax.dot_general(
             p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -244,7 +255,8 @@ def _bwd_dq_kernel(*refs, scale, causal, has_bias, has_offsets):
             s = jnp.where(q_pos >= kv_pos, s, _NEG)
         if has_bias:
             s = s + bias_ref[:, :]
-        p = jnp.exp(s - lse)
+        # Same zero-valid-key guard as the dkv kernel (see there).
+        p = jnp.where(s > _NEG / 2, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)

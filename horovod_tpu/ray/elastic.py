@@ -18,6 +18,7 @@ cluster (thread-fake actors — the reference's own elastic test
 pattern).
 """
 
+import sys
 import threading
 
 from horovod_tpu.runner.elastic.driver import ElasticDriver
@@ -65,12 +66,19 @@ class RayHostDiscovery:
 
 
 def _ray_actor_launcher(cpus_per_worker=1, gpus_per_worker=0,
-                        poll_s=0.25):
+                        poll_s=0.25, extra_env_keys=(), verbose=False):
     """Real backend: run the worker fn inside a Ray actor pinned to the
     worker's discovered node. Returns a launcher callable with the
     injectable-backend signature ``(worker, env, fn, events) ->
-    (rc, result)``."""
+    (rc, result)``.
+
+    ``extra_env_keys`` names env vars to ship to the actor on top of
+    the HOROVOD_* contract — the executor threads the keys of its
+    user-supplied ``env_vars`` through here so explicitly requested
+    vars reach the workers on the Ray backend too.
+    """
     ray = _require_ray()
+    extra_env_keys = frozenset(extra_env_keys)
 
     @ray.remote
     class _ElasticWorker:
@@ -81,13 +89,15 @@ def _ray_actor_launcher(cpus_per_worker=1, gpus_per_worker=0,
             return fn(env)
 
     def launch(worker, env, fn, events):
-        # Ship ONLY the HOROVOD_* contract vars to the actor — the env
-        # dict the driver builds starts from the driver node's full
-        # os.environ, and overwriting a remote node's JAX_PLATFORMS /
-        # TPU_* / PATH with the driver's would silently move workers
-        # onto the wrong devices (the ssh backend exports HOROVOD_*
-        # only for the same reason).
-        env = {k: v for k, v in env.items() if k.startswith("HOROVOD_")}
+        # Ship the HOROVOD_* contract vars plus any explicitly
+        # user-requested keys to the actor — the env dict the driver
+        # builds starts from the driver node's full os.environ, and
+        # overwriting a remote node's JAX_PLATFORMS / TPU_* / PATH with
+        # the driver's would silently move workers onto the wrong
+        # devices (the ssh backend exports HOROVOD_* only for the same
+        # reason).
+        env = {k: v for k, v in env.items()
+               if k.startswith("HOROVOD_") or k in extra_env_keys}
         actor = _ElasticWorker.options(
             num_cpus=cpus_per_worker, num_gpus=gpus_per_worker,
             # Pin to the discovered node: discovery reports node IPs and
@@ -101,8 +111,14 @@ def _ray_actor_launcher(cpus_per_worker=1, gpus_per_worker=0,
                 if done:
                     try:
                         return 0, ray.get(done[0])
-                    except Exception:  # noqa: BLE001 — actor death or
-                        # user-fn failure both mean this slot failed.
+                    except Exception as e:  # noqa: BLE001 — actor death
+                        # or user-fn failure both mean this slot failed;
+                        # surface the cause like the ssh backend does
+                        # worker stderr, else real-cluster failures are
+                        # undiagnosable.
+                        if verbose:
+                            print(f"[{worker.worker_id}]: actor failed: "
+                                  f"{e!r}", file=sys.stderr)
                         return 1, None
                 if any(ev.is_set() for ev in events):
                     return 1, None
@@ -157,7 +173,11 @@ class ElasticRayExecutor:
                 gpus_per_worker=gpus_per_worker)
         self.min_np = min_np
         self.max_np = max_np
-        self.env_vars = dict(env_vars or {})
+        # Stringify: these land in os.environ.update on the actor,
+        # which raises on non-str values (users pass ints routinely,
+        # e.g. OMP_NUM_THREADS=4).
+        self.env_vars = {str(k): str(v)
+                         for k, v in (env_vars or {}).items()}
         self._launcher = launcher
         self._cpus = cpus_per_worker
         self._gpus = gpus_per_worker
@@ -180,7 +200,8 @@ class ElasticRayExecutor:
         ``os.environ`` first, so ``hvd.init()`` works unmodified.
         """
         launcher = self._launcher or _ray_actor_launcher(
-            cpus_per_worker=self._cpus, gpus_per_worker=self._gpus)
+            cpus_per_worker=self._cpus, gpus_per_worker=self._gpus,
+            extra_env_keys=self.env_vars, verbose=self._verbose)
         self.driver = _ElasticRayDriver(
             self.discovery, fn, launcher, min_np=self.min_np,
             max_np=self.max_np, env=self.env_vars,

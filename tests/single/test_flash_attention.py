@@ -256,3 +256,37 @@ def test_public_api_mask_via_fallback():
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(ref.transpose(0, 2, 1, 3)),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_zero_valid_key_rows_zero_output_and_grads():
+    """q rows with zero valid keys INSIDE a causally-relevant block
+    (kv chunk starts mid-q-block) used to emit mean-of-V rows in the
+    forward (m stuck at _NEG -> p uniform) and leak spurious dq/dk/dv
+    in the backward (lse ~ _NEG makes exp(s - lse) round to 1). Both
+    must be exactly zero so a standalone chunk is correct in its own
+    right, not just after logsumexp merging."""
+    q, k, v = _qkv(seed=7, T=16)
+    # block_q=16 spans all queries; kv chunk starts at global 8, so
+    # rows 0..7 have zero valid keys inside a relevant block (row 8
+    # attends to one key, etc.) — the whole-block skip does NOT fire.
+    def run(qq, kk, vv):
+        return fa_mod.flash_attention_chunk(
+            qq, kk, vv, q_offset=0, kv_offset=8, causal=True,
+            block_q=16, block_k=16)
+
+    o, lse = run(q, k, v)
+    np.testing.assert_array_equal(np.asarray(o[:, :, :8]), 0.0)
+    assert np.all(np.asarray(lse[:, :, :8]) < -1e29)
+    # Rows with valid keys must be untouched by the guard.
+    assert np.all(np.abs(np.asarray(o[:, :, 8:])) > 0)
+
+    # Cotangent ONLY on the fully-masked rows: every gradient must be
+    # exactly zero (pre-fix: dv max ~8, dq max ~6).
+    def loss(qq, kk, vv):
+        oo, _ = run(qq, kk, vv)
+        return (oo[:, :, :8] ** 2).sum() + oo[:, :, :8].sum()
+
+    dq, dk, dv = jax.grad(loss, (0, 1, 2))(q, k, v)
+    for g, name in zip((dq, dk, dv), "qkv"):
+        np.testing.assert_array_equal(
+            np.asarray(g), 0.0, err_msg=f"d{name} leaked")
