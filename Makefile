@@ -18,7 +18,7 @@ TF_OUT := horovod_tpu/lib/libhvdtpu_tf.so
 # TF build flags come from the installed wheel; empty when TF is absent.
 PYTHON ?= python3
 
-.PHONY: core tf clean test
+.PHONY: core tf clean test test-quick
 
 core: $(OUT)
 
@@ -49,3 +49,8 @@ clean:
 
 test: core
 	python -m pytest tests/ -x -q
+
+# Sub-5-minute lane: core runtime units, the multi-rank eager-ops file,
+# and the elastic driver path (the full suite is ~25 min).
+test-quick: core
+	python -m pytest tests/ -m quick -x -q

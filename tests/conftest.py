@@ -39,6 +39,10 @@ def _ensure_core_built():
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "quick: sub-5-minute CI lane — core runtime, one multi-rank "
+        "file, one elastic path (make test-quick)")
     _ensure_core_built()
 
 

@@ -10,6 +10,9 @@ import pytest
 
 from tests.utils_mp import run_ranks
 
+# Part of the sub-5-minute CI lane (make test-quick).
+pytestmark = pytest.mark.quick
+
 
 def _init(rank):
     from horovod_tpu.common import basics

@@ -8,6 +8,9 @@ meaningful at size 1, plus basics lifecycle checks.
 import numpy as np
 import pytest
 
+# Part of the sub-5-minute CI lane (make test-quick).
+pytestmark = pytest.mark.quick
+
 
 @pytest.fixture()
 def hvd_core(monkeypatch):

@@ -11,6 +11,9 @@ import pytest
 
 import bench
 
+# Part of the sub-5-minute CI lane (make test-quick).
+pytestmark = pytest.mark.quick
+
 
 def test_eager_flagship_is_first_and_spmd_flagship_last():
     plan = bench.full_run_plan(4, 2048, 10)

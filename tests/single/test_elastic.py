@@ -27,6 +27,9 @@ from horovod_tpu.runner.elastic.worker import (
     notify_worker,
 )
 
+# Part of the sub-5-minute CI lane (make test-quick).
+pytestmark = pytest.mark.quick
+
 
 def _script(tmp_path, hosts_file):
     path = tmp_path / "discover.sh"
@@ -127,6 +130,7 @@ def test_driver_spawns_and_cuts_epoch(tmp_path):
 import json, os, sys
 sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})
 from horovod_tpu.runner.elastic.rendezvous import RendezvousClient
+
 wid = os.environ["HOROVOD_WORKER_ID"]
 c = RendezvousClient(os.environ["HOROVOD_RDZV_ADDR"],
                      os.environ["HOROVOD_RDZV_PORT"])

@@ -14,6 +14,9 @@ import pytest
 
 from horovod_tpu.runner import launch, util
 
+# Part of the sub-5-minute CI lane (make test-quick).
+pytestmark = pytest.mark.quick
+
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
