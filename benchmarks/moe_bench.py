@@ -1,13 +1,15 @@
-"""Single-chip sparse-MoE training benchmark (GShard dispatch path).
+"""Single-chip sparse-MoE training benchmark (dropless grouped-GEMM).
 
-The MoE stack (mixtral-style top-k routing with grouped-einsum GShard
-dispatch, ``models/llama.py:_moe_ffn``) is net-new vs the reference
-(Horovod has no model layer at all); until round 4 it had only run at
-toy sizes on the CPU test substrate and in the multichip dryrun. This
-benchmark trains a 1.49B-total / 889M-active MoE decoder on the real
-chip and reports MFU against ACTIVE parameters — the standard sparse
-accounting (a routed token runs K of E experts, so its model FLOPs are
-6·N_active, not 6·N_total).
+The MoE stack is net-new vs the reference (Horovod has no model layer
+at all). The single-chip training path dispatches via the dropless
+sorted grouped-GEMM (``ops/grouped_moe.py``: argsort by expert +
+megablox ragged matmuls — no capacity factor, no one-hot dispatch
+einsums, no dropped tokens); expert-parallel meshes use the GShard
+einsum path instead. This benchmark trains a 1.49B-total /
+889M-active MoE decoder on the real chip and reports MFU against
+ACTIVE parameters — the standard sparse accounting (a routed token
+runs K of E experts, so its model FLOPs are 6·N_active, not
+6·N_total).
 
 Run on a real TPU chip::
 
@@ -31,15 +33,20 @@ def _moe_cfg():
 
     # Sized for one 16G chip in pure bf16 (params+grads+2 adam moments
     # = 8 bytes/param): 4 experts top-2 halves the FFN FLOPs per token
-    # while the parameter count stays flagship-class. remat="attn"
-    # (not "attn+gate"): saving the [B,T,E,C] dispatch/combine tensors
-    # costs 2G at this size and overflows HBM by ~0.5G — the saved-
-    # residual modes need either fewer layers or a pod's FSDP headroom.
+    # while the parameter count stays flagship-class. The default
+    # moe_impl="auto" resolves to the dropless grouped-GEMM dispatch
+    # (ops/grouped_moe.py) on the single-chip program — no capacity
+    # padding, no one-hot dispatch einsums. remat="attn+moe"
+    # additionally saves the per-layer y_slots residual ([S*K, D] bf16)
+    # so backward skips the down-projection GEMM re-run, and
+    # scan_unroll turns the stacked expert-weight dynamic slices
+    # static (r5 sweep: 563 -> 495 ms/step all-in vs the r4 GShard
+    # path).
     return LlamaConfig(vocab_size=32768, d_model=2048, n_layers=12,
                        n_heads=16, n_kv_heads=8, d_ff=4096,
                        n_experts=4, n_experts_per_token=2,
-                       capacity_factor=1.25, dtype="bfloat16",
-                       remat="attn", param_dtype="bfloat16")
+                       dtype="bfloat16", remat="attn+moe",
+                       param_dtype="bfloat16", scan_unroll=12)
 
 
 def _active_params(params, cfg):
@@ -102,8 +109,10 @@ def main():
         payload = {
             "note": "MoE decoder on one real chip; MFU counts ACTIVE "
                     "params (6*N_active + attention) per the standard "
-                    "sparse accounting. GShard grouped-einsum dispatch, "
-                    "capacity_factor 1.25.",
+                    "sparse accounting. Dropless sorted grouped-GEMM "
+                    "dispatch (megablox), remat=attn+moe, unrolled "
+                    "layer scan; every routed token-slot is computed "
+                    "(no capacity factor, no drops).",
             "rows": [row],
         }
         with open(args.out, "w") as f:

@@ -9,10 +9,16 @@ at its stored kv-head width, and slots past the current position mask
 themselves by global index.
 
 Dense and MoE configs (per-token top-k routing is sequence-independent,
-so cached decode routes each new token exactly as a full forward would;
-only capacity-overflow drops can differ, and a single decoded token
-never overflows). Single-device or data-parallel batch — the sequence
-axis is not sharded at decode.
+so cached decode routes each new token exactly as a full forward would).
+With the default ``moe_impl="auto"`` the single-chip prefill resolves
+to the DROPLESS grouped dispatch (ops/grouped_moe.py), which matches
+the top-k decode path exactly — no capacity drops anywhere. A
+checkpoint trained under an expert-parallel mesh (auto -> GShard,
+capacity drops) should set ``moe_impl="gshard"`` for bit-parity with
+its training-time prefill semantics; its decode steps still use the
+drop-free top-k path (a single token never overflows capacity).
+Single-device or data-parallel batch — the sequence axis is not
+sharded at decode.
 """
 
 from functools import partial
@@ -22,15 +28,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.models.llama import _ffn as _llama_ffn
-from horovod_tpu.models.llama import _rmsnorm, _rope
+from horovod_tpu.models.llama import _rmsnorm, _rope, moe_route
 
 
 def _ffn(h, lp, c):
     """llama.py's shared FFN, aux loss dropped (decode does not train).
-    Serves prefill (the full-prompt pass keeps the capacity dispatch so
-    its drop semantics match llama_forward exactly), dense decode, and
-    MoE decode at large batch; small-batch MoE decode uses
-    _moe_ffn_topk."""
+    Serves prefill, dense decode, and MoE decode at large batch;
+    small-batch MoE decode uses _moe_ffn_topk. Dispatch follows
+    ``c.moe_impl`` exactly as llama_forward with no mesh does (see the
+    module docstring for the gshard-trained-checkpoint caveat)."""
     y, _aux = _llama_ffn(h, lp, c, None)
     return y
 
@@ -51,11 +57,7 @@ def _moe_ffn_topk(h, lp, c):
     """
     dt = c.compute_dtype
     K = c.n_experts_per_token
-    logits = h.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)                 # [B,T,E]
-    gate_vals, gate_idx = lax.top_k(probs, K)               # [B,T,K]
-    gate_vals = gate_vals / jnp.maximum(
-        gate_vals.sum(-1, keepdims=True), 1e-9)
+    gate_vals, gate_idx, _aux = moe_route(h, lp["router"], K)  # [B,T,K]
     wg = lp["moe_gate"].astype(dt)[gate_idx]                # [B,T,K,D,F]
     wu = lp["moe_up"].astype(dt)[gate_idx]
     wd = lp["moe_down"].astype(dt)[gate_idx]                # [B,T,K,F,D]

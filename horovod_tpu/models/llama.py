@@ -29,6 +29,9 @@ from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.parallel.ring_attention import ring_self_attention
 
+# Extra residual names the "moe" remat mode saves beyond "attn+moe".
+_MOE_EXTRA_SAVE = ("moe_x_sorted", "moe_gate_act", "moe_up_act")
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -56,6 +59,13 @@ class LlamaConfig:
     n_experts_per_token: int = 2
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Expert dispatch implementation: "grouped" = dropless sorted
+    # grouped-GEMM (megablox; no capacity padding, no one-hot dispatch
+    # einsums, no dropped tokens — fastest on a single program),
+    # "gshard" = capacity-factor one-hot einsum dispatch (the [G,E,C,D]
+    # buffers give GSPMD its expert-parallel all-to-all seam), "auto" =
+    # grouped when no mesh is active, gshard under a mesh.
+    moe_impl: str = "auto"
     # GPipe microbatch count when the mesh has a non-trivial "pipe" axis
     # (0 = one microbatch per stage). Batch must divide by it.
     pipeline_microbatches: int = 0
@@ -64,6 +74,13 @@ class LlamaConfig:
     # "ulysses" (all-to-all head/sequence reshard — needs
     # n_heads % seq_size == 0, cheaper at short per-device sequences).
     seq_parallel: str = "ring"
+    # Unroll factor for the scan-over-layers (1 = rolled, n_layers =
+    # fully unrolled). Unrolling turns the stacked-weight dynamic
+    # slices into static ones — on TPU that halves the per-layer weight
+    # copies feeding grouped-GEMM custom-calls (measured -5% MoE step
+    # time at bench shape) at the price of compile time and program
+    # size. Leave 1 for multi-chip pipeline meshes.
+    scan_unroll: int = 1
     # Parameter STORAGE dtype ("float32" default). "bfloat16" halves
     # parameter/gradient/optimizer-state HBM (pure-bf16 training, the
     # usual large-model recipe on TPU) — on one 16G chip it is what
@@ -242,6 +259,29 @@ def _activation_spec(mesh):
     return P(("data", "fsdp"), "seq", None)
 
 
+def moe_route(h, router_w, n_experts_per_token):
+    """The ONE router: f32 logits matmul, softmax, top-K, epsilon-
+    guarded gate normalization, and the Switch load-balancing aux loss
+    (E * <fraction top-1 routed to e> . <mean prob of e>, minimized =1
+    at uniform routing). Shared by the GShard dispatch below, the
+    dropless grouped dispatch (ops/grouped_moe.py), and cached decode
+    (models/generate.py) so the three can never drift.
+
+    ``h`` is [..., D] with any leading shape; returns
+    (gate_vals [..., K] f32-normalized, gate_idx [..., K] int32, aux).
+    """
+    E = router_w.shape[-1]
+    logits = h.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                # [..., E]
+    gate_vals, gate_idx = lax.top_k(probs, n_experts_per_token)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+    lead = tuple(range(probs.ndim - 1))
+    top1 = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
+    aux = E * jnp.sum(top1.mean(lead) * probs.mean(lead))
+    return gate_vals, gate_idx, aux
+
+
 def _moe_ffn(h, lp, c, mesh):
     """Top-k routed expert FFN, GShard-style grouped einsum dispatch.
 
@@ -261,16 +301,7 @@ def _moe_ffn(h, lp, c, mesh):
     E, K = c.n_experts, c.n_experts_per_token
     C = max(int(T * K * c.capacity_factor / E), 1)
 
-    logits = h.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)                 # [B, T, E] f32
-    gate_vals, gate_idx = lax.top_k(probs, K)               # [B, T, K]
-    gate_vals = gate_vals / jnp.maximum(
-        gate_vals.sum(-1, keepdims=True), 1e-9)
-
-    # Switch-transformer load-balancing aux loss: E * <fraction routed to
-    # e> . <mean prob of e>, minimized (=1) at uniform routing.
-    top1 = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
-    aux = E * jnp.sum(top1.mean((0, 1)) * probs.mean((0, 1)))
+    gate_vals, gate_idx, aux = moe_route(h, lp["router"], K)  # [B,T,K]
 
     # Position of each (token, slot) in its expert's per-group capacity
     # buffer, filling slot 0 for every token before slot 1 (priority to
@@ -323,6 +354,14 @@ def _ffn(h, lp, c, mesh=None):
     the two can never diverge."""
     dt = c.compute_dtype
     if c.n_experts > 0:
+        if c.moe_impl == "grouped" or (c.moe_impl == "auto"
+                                       and mesh is None):
+            from horovod_tpu.ops.grouped_moe import grouped_moe_ffn
+
+            return grouped_moe_ffn(h, lp, c)
+        if c.moe_impl not in ("auto", "gshard"):
+            raise ValueError(f"unknown moe_impl {c.moe_impl!r}: "
+                             "expected 'auto', 'grouped', or 'gshard'")
         return _moe_ffn(h, lp, c, mesh)
     # Named for remat="attn+ffn": saving the two up-projections (the
     # bulk of a layer's recomputed matmul FLOPs) lets backward rebuild
@@ -409,6 +448,37 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
             layer,
             policy=jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "flash_o", "flash_lse"))
+    elif c.remat in ("attn+moe", "moe") and not (
+            c.n_experts > 0
+            and (c.moe_impl == "grouped"
+                 or (c.moe_impl == "auto" and mesh is None))):
+        # These modes save residuals only grouped_moe_ffn emits; under
+        # GShard dispatch (mesh present or moe_impl="gshard") or a
+        # dense config they would silently degrade to plain "attn".
+        raise ValueError(
+            f"remat={c.remat!r} requires the grouped MoE dispatch "
+            "(n_experts > 0 and moe_impl='grouped', or 'auto' with no "
+            "mesh); use remat='attn' or 'attn+gate' here")
+    elif c.remat == "attn+moe":
+        # "attn" plus the grouped-MoE y_slots residual ([S*K, D] bf16
+        # per layer): the router's combine-weight gradient consumes
+        # y_slots, so without it the backward remat must re-run the
+        # down-projection grouped GEMM per layer.
+        body = jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "flash_o", "flash_lse", "moe_y_slots"))
+    elif c.remat == "moe":
+        # Save the whole grouped-expert chain (x_sorted, pre-silu gate,
+        # up, y_slots — ~[S*K, 2F+2D] bf16 per layer): backward re-runs
+        # NO grouped matmul. The HBM price usually needs microbatched
+        # steps (gradient accumulation) at bench sizes; see
+        # benchmarks/moe_bench.py.
+        body = jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "flash_o", "flash_lse", "moe_y_slots",
+                *_MOE_EXTRA_SAVE))
     elif c.remat in ("attn+ffn", "attn+gate"):
         # "attn" plus FFN up-projection residuals (pre-silu gate, and
         # for "attn+ffn" also up — [B,T,d_ff] each per layer): trades
@@ -432,7 +502,8 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
     else:
         raise ValueError(f"unknown remat mode {c.remat!r}: expected "
                          "True/'full', 'dots', 'attn', 'attn+gate', "
-                         "'attn+ffn', or False/'none'")
+                         "'attn+ffn', 'attn+moe', 'moe', or "
+                         "False/'none'")
 
     n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
     if n_stages > 1:
@@ -464,7 +535,8 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
         x = ys.reshape(b, t, x.shape[-1])
         aux = aux_total / (c.n_layers * M)
     else:
-        x, aux_per_layer = lax.scan(body, x, params["layers"])
+        x, aux_per_layer = lax.scan(body, x, params["layers"],
+                                    unroll=c.scan_unroll)
         aux = jnp.mean(aux_per_layer)
 
     x = _rmsnorm(x, params["final_norm"].astype(dt), c.norm_eps)

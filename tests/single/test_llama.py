@@ -189,7 +189,13 @@ def test_moe_expert_parallel_matches_single_device():
     if len(jax.devices()) < 8:
         import pytest
         pytest.skip("needs 8 virtual devices")
-    cfg = LlamaConfig.tiny_moe(dtype="float32", remat=False)
+    # Pin the GShard dispatch on BOTH sides: this test certifies EP
+    # sharding, and the mesh-free default would otherwise pick the
+    # dropless grouped path whose no-drop semantics legitimately
+    # diverge from capacity-1.25 GShard (see
+    # test_grouped_moe_matches_gshard_when_dropless for that parity).
+    cfg = LlamaConfig.tiny_moe(dtype="float32", remat=False,
+                               moe_impl="gshard")
     params = llama_init(cfg, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
                                 cfg.vocab_size)
@@ -273,7 +279,11 @@ def test_pipeline_with_moe():
     """PP x EP x TP: logits must match; the loss differs only by the
     per-microbatch aux term (Switch aux is nonlinear in batch)."""
     _skip_unless_8()
-    cfg = LlamaConfig.tiny_moe(dtype="float32", n_layers=4, remat=False)
+    # gshard pinned on both sides: mesh-free "auto" would pick the
+    # dropless grouped path, which legitimately diverges from
+    # capacity-1.25 GShard on overflow tokens.
+    cfg = LlamaConfig.tiny_moe(dtype="float32", n_layers=4, remat=False,
+                               moe_impl="gshard")
     params = llama_init(cfg, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
                                 cfg.vocab_size)
@@ -476,7 +486,10 @@ def test_remat_modes_agree_on_gradients_moe():
             lambda p: llama_loss(p, batch, cfg)))(params)
 
     ref_loss, ref_grads = loss_and_grads(False)
-    for mode in ("attn", "attn+gate", "attn+ffn", "dots", "full"):
+    # attn+moe / moe cover the grouped path's saved residuals
+    # (y_slots; x_sorted/gate/up) — remat must stay scheduling-only.
+    for mode in ("attn", "attn+gate", "attn+ffn", "attn+moe", "moe",
+                 "dots", "full"):
         loss, grads = loss_and_grads(mode)
         np.testing.assert_allclose(float(loss), float(ref_loss),
                                    rtol=1e-6, err_msg=mode)
@@ -485,6 +498,28 @@ def test_remat_modes_agree_on_gradients_moe():
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
                 err_msg=mode),
             grads, ref_grads)
+
+
+def test_scan_unroll_is_scheduling_only():
+    """scan_unroll must not change values or gradients."""
+    cfg0 = LlamaConfig.tiny_moe(dtype="float32", n_layers=4, remat="attn")
+    params = llama_init(cfg0, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg0.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+    def loss_and_grads(unroll):
+        cfg = dataclasses.replace(cfg0, scan_unroll=unroll)
+        return jax.jit(jax.value_and_grad(
+            lambda p: llama_loss(p, batch, cfg)))(params)
+
+    ref_loss, ref_grads = loss_and_grads(1)
+    loss, grads = loss_and_grads(4)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        grads, ref_grads)
 
 
 def test_unknown_remat_mode_rejected():
@@ -496,3 +531,21 @@ def test_unknown_remat_mode_rejected():
                                 cfg.vocab_size)
     with pytest.raises(ValueError, match="unknown remat mode"):
         llama_forward(params, tokens, cfg)
+
+
+def test_moe_remat_modes_rejected_without_grouped_dispatch():
+    """attn+moe / moe save residuals only grouped_moe_ffn emits — a
+    dense config or a forced-GShard one must fail loudly instead of
+    silently degrading to plain attn remat."""
+    import pytest
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 256)
+    dense = LlamaConfig.tiny(dtype="float32", remat="attn+moe")
+    with pytest.raises(ValueError, match="grouped MoE dispatch"):
+        llama_forward(llama_init(dense, jax.random.PRNGKey(0)), tokens,
+                      dense)
+    gshard = LlamaConfig.tiny_moe(dtype="float32", remat="moe",
+                                  moe_impl="gshard")
+    with pytest.raises(ValueError, match="grouped MoE dispatch"):
+        llama_forward(llama_init(gshard, jax.random.PRNGKey(0)), tokens,
+                      gshard)
