@@ -421,8 +421,14 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
                                                c.head_dim)
         vv = (h @ lp["wv"].astype(dt)).reshape(bb, tt, c.n_kv_heads,
                                                c.head_dim)
-        q = _rope(q, positions, c.rope_theta)
-        kk = _rope(kk, positions, c.rope_theta)
+        # Named for remat="attn+gate+qkv": saving the POST-rope q/k and
+        # v ([B,T,H(kv),D] bf16 — ~67 MB/layer at bench shapes) lets
+        # backward skip the wq/wk/wv matmul + rope re-runs entirely
+        # (attn_out/flash_o already cover wo's operands).
+        q = checkpoint_name(_rope(q, positions, c.rope_theta), "rope_q")
+        kk = checkpoint_name(_rope(kk, positions, c.rope_theta),
+                             "rope_k")
+        vv = checkpoint_name(vv, "attn_v")
         # remat="attn" save-names applied inside _attention (per path).
         attn = _attention(q, kk, vv, mesh, seq_axis, c.seq_parallel)
         x = x + constrain(attn.reshape(bb, tt, -1) @ lp["wo"].astype(dt))
@@ -479,6 +485,22 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
             policy=jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "flash_o", "flash_lse", "moe_y_slots",
                 *_MOE_EXTRA_SAVE))
+    elif c.remat == "attn+gate+qkv":
+        # "attn+gate" plus the post-rope q/k/v: backward re-runs only
+        # the rmsnorms and elementwise chains — no qkv matmuls, no
+        # rope, no FFN gate matmul. The extra ~[B,T,2D] bf16 per layer
+        # is the cheapest matmul-recompute elimination left after
+        # attn+gate — FOR SHAPES WITH HBM HEADROOM: at the 16G-chip
+        # flagship bench shape it exceeds HBM (r5: the AOT compile
+        # helper crashes rather than reporting a clean OOM), so the
+        # mode is pinned by the CPU remat-equivalence test but has no
+        # on-chip flagship measurement.
+        body = jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "flash_o", "flash_lse", "ffn_gate",
+                "moe_dispatch", "moe_combine", "rope_q", "rope_k",
+                "attn_v"))
     elif c.remat in ("attn+ffn", "attn+gate"):
         # "attn" plus FFN up-projection residuals (pre-silu gate, and
         # for "attn+ffn" also up — [B,T,d_ff] each per layer): trades
@@ -502,8 +524,8 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
     else:
         raise ValueError(f"unknown remat mode {c.remat!r}: expected "
                          "True/'full', 'dots', 'attn', 'attn+gate', "
-                         "'attn+ffn', 'attn+moe', 'moe', or "
-                         "False/'none'")
+                         "'attn+gate+qkv', 'attn+ffn', 'attn+moe', "
+                         "'moe', or False/'none'")
 
     n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
     if n_stages > 1:

@@ -460,7 +460,8 @@ def test_remat_modes_agree_on_gradients():
             lambda p: llama_loss(p, batch, cfg)))(params)
 
     ref_loss, ref_grads = loss_and_grads(False)
-    for mode in ("attn", "attn+gate", "attn+ffn", "dots", "full"):
+    for mode in ("attn", "attn+gate", "attn+gate+qkv", "attn+ffn",
+                 "dots", "full"):
         loss, grads = loss_and_grads(mode)
         np.testing.assert_allclose(float(loss), float(ref_loss),
                                    rtol=1e-6, err_msg=mode)
@@ -488,8 +489,8 @@ def test_remat_modes_agree_on_gradients_moe():
     ref_loss, ref_grads = loss_and_grads(False)
     # attn+moe / moe cover the grouped path's saved residuals
     # (y_slots; x_sorted/gate/up) — remat must stay scheduling-only.
-    for mode in ("attn", "attn+gate", "attn+ffn", "attn+moe", "moe",
-                 "dots", "full"):
+    for mode in ("attn", "attn+gate", "attn+gate+qkv", "attn+ffn",
+                 "attn+moe", "moe", "dots", "full"):
         loss, grads = loss_and_grads(mode)
         np.testing.assert_allclose(float(loss), float(ref_loss),
                                    rtol=1e-6, err_msg=mode)
