@@ -69,6 +69,13 @@ class LlamaConfig:
     # GPipe microbatch count when the mesh has a non-trivial "pipe" axis
     # (0 = one microbatch per stage). Batch must divide by it.
     pipeline_microbatches: int = 0
+    # Pipeline schedule for TRAINING: "gpipe" (all forwards, then AD's
+    # reversed backward — per-stage activation stash grows with M) or
+    # "1f1b" (interleaved forward/backward, loss fused into the last
+    # stage, stash bounded by ~2S microbatch inputs — see
+    # parallel.pipeline.one_f_one_b). Forward-only calls
+    # (llama_forward) always use gpipe: 1F1B never materializes logits.
+    pipeline_schedule: str = "gpipe"
     # Sequence-parallel strategy when the mesh's "seq" axis is
     # non-trivial: "ring" (K/V rotate via ppermute — any head count) or
     # "ulysses" (all-to-all head/sequence reshard — needs
@@ -390,10 +397,7 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
     b, t = tokens.shape
 
     def constrain(x):
-        if mesh is None:
-            return x
-        return lax.with_sharding_constraint(
-            x, jax.sharding.NamedSharding(mesh, _activation_spec(mesh)))
+        return _constrain(x, mesh)
 
     # Layout contract for the vocab lookup: tokens are pinned to the
     # activation layout (batch over data/fsdp, seq over seq) so the SPMD
@@ -409,6 +413,86 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
                                                        "seq")))
     x = params["embed"].astype(dt)[tokens]
     x = constrain(x)
+
+    body = _build_layer_body(c, mesh, seq_axis)
+
+    n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
+    if n_stages > 1:
+        # GPipe over the "pipe" axis: each stage scans its contiguous
+        # layer block; microbatches rotate stage-to-stage via ppermute
+        # (parallel.pipeline.gpipe). llama_forward always uses gpipe —
+        # it must produce LOGITS, which the 1F1B schedule (loss fused
+        # into the last stage; see llama_loss) never materializes.
+        from horovod_tpu.parallel.pipeline import gpipe
+
+        M = _validate_pipeline(c, b, mesh, seq_axis, n_stages)
+        xs = x.reshape(M, b // M, t, x.shape[-1])
+        ys, aux_total = gpipe(_stage_scan(body), params["layers"], xs,
+                              mesh)
+        x = ys.reshape(b, t, x.shape[-1])
+        aux = aux_total / (c.n_layers * M)
+    else:
+        x, aux_per_layer = lax.scan(body, x, params["layers"],
+                                    unroll=c.scan_unroll)
+        aux = jnp.mean(aux_per_layer)
+
+    x = _rmsnorm(x, params["final_norm"].astype(dt), c.norm_eps)
+    # bf16 operands, f32 accumulation: full MXU rate without giving up
+    # the f32 logits downstream softmax stability needs.
+    logits = jnp.matmul(x, params["lm_head"].astype(dt),
+                        preferred_element_type=jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
+
+
+def _constrain(x, mesh):
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, _activation_spec(mesh)))
+
+
+def _stage_scan(body):
+    """One pipeline stage = a scan of ``body`` over its layer block
+    (shared by the gpipe and 1f1b paths)."""
+    def stage_fn(lp_stage, x_mb):
+        x_out, aux_layers = lax.scan(body, x_mb, lp_stage)
+        return x_out, jnp.sum(aux_layers)
+    return stage_fn
+
+
+def _validate_pipeline(c, b, mesh, seq_axis, n_stages):
+    """Shared gpipe/1f1b precondition checks; returns the microbatch
+    count M. seq parallelism is mutually exclusive with pipelining in
+    this layout (ring attention's own shard_map cannot nest inside the
+    pipeline's)."""
+    M = c.pipeline_microbatches or n_stages
+    if seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+        raise ValueError("pipeline (pipe>1) and sequence parallelism "
+                         "(seq>1) cannot combine: ring attention's "
+                         "shard_map cannot nest inside the pipeline's")
+    if M <= 0 or b % M:
+        raise ValueError(f"batch {b} must divide into "
+                         f"{M} pipeline microbatches")
+    if c.n_layers % n_stages:
+        raise ValueError(f"n_layers {c.n_layers} must divide into "
+                         f"{n_stages} pipeline stages")
+    return M
+
+
+def _build_layer_body(c, mesh, seq_axis, constrain_acts=True):
+    """One decoder layer as a scan body, wrapped in the configured
+    remat policy — shared by llama_forward (single-device and gpipe)
+    and the 1F1B training path. ``constrain_acts=False`` drops the
+    per-activation sharding constraints (the 1F1B path differentiates
+    INSIDE the pipe-manual shard_map, and XLA CPU aborts transposing
+    with_sharding_constraint on auto axes there; GSPMD still lays out
+    activations by propagation from the sharded params)."""
+    dt = c.compute_dtype
+
+    def constrain(x):
+        return _constrain(x, mesh) if constrain_acts else x
 
     def layer(x, lp):
         # Shapes from x, not the enclosing scope: under pipelining the
@@ -527,53 +611,28 @@ def llama_forward(params, tokens, config, mesh=None, seq_axis="seq",
                          "'attn+gate+qkv', 'attn+ffn', 'attn+moe', "
                          "'moe', or False/'none'")
 
-    n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
-    if n_stages > 1:
-        # GPipe over the "pipe" axis: each stage scans its contiguous
-        # layer block; microbatches rotate stage-to-stage via ppermute
-        # (parallel.pipeline.gpipe). seq parallelism is mutually
-        # exclusive with pipelining in this layout (ring attention's own
-        # shard_map cannot nest inside the pipeline's).
-        from horovod_tpu.parallel.pipeline import gpipe
-
-        M = c.pipeline_microbatches or n_stages
-        if seq_axis and mesh.shape.get(seq_axis, 1) > 1:
-            raise ValueError("pipeline (pipe>1) and sequence parallelism "
-                             "(seq>1) cannot combine: ring attention's "
-                             "shard_map cannot nest inside the pipeline's")
-        if M <= 0 or b % M:
-            raise ValueError(f"batch {b} must divide into "
-                             f"{M} pipeline microbatches")
-        if c.n_layers % n_stages:
-            raise ValueError(f"n_layers {c.n_layers} must divide into "
-                             f"{n_stages} pipeline stages")
-
-        def stage_fn(lp_stage, x_mb):
-            x_out, aux_layers = lax.scan(body, x_mb, lp_stage)
-            return x_out, jnp.sum(aux_layers)
-
-        xs = x.reshape(M, b // M, t, x.shape[-1])
-        ys, aux_total = gpipe(stage_fn, params["layers"], xs, mesh)
-        x = ys.reshape(b, t, x.shape[-1])
-        aux = aux_total / (c.n_layers * M)
-    else:
-        x, aux_per_layer = lax.scan(body, x, params["layers"],
-                                    unroll=c.scan_unroll)
-        aux = jnp.mean(aux_per_layer)
-
-    x = _rmsnorm(x, params["final_norm"].astype(dt), c.norm_eps)
-    # bf16 operands, f32 accumulation: full MXU rate without giving up
-    # the f32 logits downstream softmax stability needs.
-    logits = jnp.matmul(x, params["lm_head"].astype(dt),
-                        preferred_element_type=jnp.float32)
-    if return_aux:
-        return logits, aux
-    return logits
+    return body
 
 
 def llama_loss(params, batch, config, mesh=None, seq_axis="seq"):
     """Causal LM loss (+ weighted MoE aux loss for expert configs).
-    batch = {"tokens": [B,T], "targets": [B,T], "mask": [B,T] or absent}."""
+    batch = {"tokens": [B,T], "targets": [B,T], "mask": [B,T] or absent}.
+
+    With an active "pipe" mesh axis and ``pipeline_schedule="1f1b"``
+    the loss runs through the interleaved 1F1B schedule (loss fused
+    into the last stage, O(S) activation stash — see
+    parallel.pipeline.one_f_one_b) instead of gpipe + a global logits
+    pass; values and gradients are pinned equal by
+    tests/single/test_pipeline_1f1b.py.
+    """
+    n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
+    if n_stages > 1 and config.pipeline_schedule == "1f1b":
+        return _llama_loss_1f1b(params, batch, config, mesh, seq_axis,
+                                n_stages)
+    if config.pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"unknown pipeline_schedule {config.pipeline_schedule!r}: "
+            "expected 'gpipe' or '1f1b'")
     logits, aux = llama_forward(params, batch["tokens"], config, mesh,
                                 seq_axis, return_aux=True)
     tgt = batch["targets"]
@@ -591,3 +650,87 @@ def llama_loss(params, batch, config, mesh=None, seq_axis="seq"):
     if config.n_experts > 0:
         loss = loss + config.moe_aux_weight * aux
     return loss
+
+
+def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
+    """Training loss through the 1F1B pipeline schedule.
+
+    The schedule computes loss AND gradients in one combined scan
+    (parallel.pipeline.one_f_one_b); a ``custom_vjp`` hands those
+    gradients to the outer ``jax.value_and_grad`` so callers keep the
+    ordinary llama_loss contract. The MoE aux objective is folded into
+    the schedule's backward via its constant per-contribution cotangent
+    (moe_aux_weight / (n_layers * M)) — identical math to the gpipe
+    path's ``loss + w * mean(aux)``.
+    """
+    from horovod_tpu.parallel.pipeline import one_f_one_b
+
+    dt = c.compute_dtype
+    b, t = batch["tokens"].shape
+    M = _validate_pipeline(c, b, mesh, seq_axis, n_stages)
+    stage_fn = _stage_scan(
+        _build_layer_body(c, mesh, seq_axis, constrain_acts=False))
+
+    tokens = batch["tokens"]
+    if mesh is not None:
+        tokens = lax.with_sharding_constraint(
+            tokens, jax.sharding.NamedSharding(
+                mesh, P(("data", "fsdp"), "seq")))
+
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones((b, t), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    # The mask denominator is global across microbatches, so it is
+    # computed OUTSIDE the schedule and folded into each microbatch's
+    # loss numerator (mask is data, not a differentiated value).
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+
+    def loss_fn(hp, y_mb, la):
+        final_norm, lm_head = hp
+        tgt, m = la
+        h = _rmsnorm(y_mb, final_norm.astype(dt), c.norm_eps)
+        logits = jnp.matmul(h, lm_head.astype(dt),
+                            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, tgt[..., None],
+                                     axis=-1)[..., 0]
+        return jnp.sum((lse - picked) * m) / denom
+
+    aux_ct = (c.moe_aux_weight / (c.n_layers * M)
+              if c.n_experts > 0 else 0.0)
+
+    def schedule_fwd(sp, hp, xs, largs):
+        loss, aux, d_sp, d_hp, d_xs = one_f_one_b(
+            stage_fn, loss_fn, sp, hp, xs, largs, mesh,
+            aux_cotangent=aux_ct)
+        return loss + aux_ct * aux, (d_sp, d_hp, d_xs, largs)
+
+    # Primal == fwd minus residuals, by construction (one definition,
+    # so the no-grad value can never diverge from the differentiated
+    # one).
+    schedule = jax.custom_vjp(
+        lambda sp, hp, xs, largs: schedule_fwd(sp, hp, xs, largs)[0])
+
+    def schedule_bwd(res, dl):
+        import numpy as _np
+
+        d_sp, d_hp, d_xs, largs = res
+        scale = lambda g: jax.tree.map(  # noqa: E731
+            lambda x: (x * dl).astype(x.dtype), g)
+        d_largs = jax.tree.map(
+            lambda x: (jnp.zeros_like(x)
+                       if jnp.issubdtype(x.dtype, jnp.inexact)
+                       else _np.zeros(x.shape, jax.dtypes.float0)),
+            largs)
+        return scale(d_sp), scale(d_hp), scale(d_xs), d_largs
+
+    schedule.defvjp(schedule_fwd, schedule_bwd)
+
+    x = _constrain(params["embed"].astype(dt)[tokens], mesh)
+    xs = x.reshape(M, b // M, t, x.shape[-1])
+    largs = (batch["targets"].reshape(M, b // M, t),
+             mask.reshape(M, b // M, t))
+    return schedule(params["layers"],
+                    (params["final_norm"], params["lm_head"]), xs,
+                    largs)

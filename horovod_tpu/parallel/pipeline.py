@@ -88,3 +88,159 @@ def gpipe(stage_fn, stage_params, xs, mesh, axis="pipe"):
     if cast_dt is not None:
         ys = ys.astype(cast_dt)
     return ys, aux
+
+
+def one_f_one_b(stage_fn, loss_fn, stage_params, head_params, xs,
+                loss_args, mesh, axis="pipe", aux_cotangent=0.0):
+    """1F1B pipeline schedule: forward AND backward interleaved in one
+    lockstep scan, with the loss computed on the last stage per
+    microbatch.
+
+    Why not let AD differentiate :func:`gpipe`? Its backward replays
+    the forward scan in reverse, so every stage stashes activations for
+    ALL M microbatches — O(M) memory. Here each slot runs one forward
+    subtick and one backward subtick per stage: stage ``s`` forwards
+    microbatch ``m`` at slot ``s + m``, the last stage turns it
+    straight into a loss cotangent, and the backward walks back up at
+    slot ``2(S-1) - s + m``. A stage therefore holds at most
+    ``min(M, 2(S-1-s) + 1) <= 2S - 1`` stashed INPUTS (activations are
+    recomputed from the stashed input during the backward subtick —
+    per-stage remat, the standard 1F1B trade). Timeline = ``M + 2(S-1)``
+    slots; the ``2(S-1)/(M + 2(S-1))`` bubble fraction matches GPipe's
+    forward+backward total, so the win is memory, not bubble.
+
+    ``stage_fn(sp_block, x_mb) -> (y_mb, aux_scalar)`` as in gpipe.
+    ``loss_fn(head_params, y_mb, loss_args_mb) -> scalar`` is the last
+    stage's per-microbatch objective NUMERATOR (any global
+    normalization — e.g. a mask-token count — must be folded in by the
+    caller, since microbatches cannot see each other's denominators).
+    ``loss_args`` is a pytree with leading microbatch axis M (targets,
+    masks, ...). ``aux_cotangent`` is the constant d(objective)/d(aux)
+    applied to every valid (stage, microbatch) aux contribution — e.g.
+    ``moe_aux_weight / (n_layers * M)``.
+
+    Returns ``(loss_sum, aux_sum, d_stage_params, d_head_params,
+    d_xs)`` — the gradient of ``loss_sum + aux_cotangent * aux_raw_sum``
+    with respect to (stage_params, head_params, xs). Callers wanting
+    plain ``value_and_grad`` ergonomics should wrap this in a
+    ``custom_vjp`` (see models/llama.py's 1f1b path).
+
+    Reference analog: none (net-new, like gpipe); the schedule is the
+    public non-interleaved 1F1B (PipeDream-flush) formulation.
+    """
+    S = mesh.shape[axis]
+    M = xs.shape[0]
+    Q = min(M, 2 * S - 1)                       # stash depth per stage
+    U = M + 2 * (S - 1)                         # total slots
+
+    on_cpu = all(d.platform == "cpu" for d in mesh.devices.flat)
+    cast_dt = None
+    if on_cpu and xs.dtype in (jnp.bfloat16, jnp.float16):
+        cast_dt = xs.dtype
+        xs = xs.astype(jnp.float32)
+
+    def inner(sp, hp, xs_, largs_):
+        stage = lax.axis_index(axis)
+        is_last = stage == S - 1
+
+        def slot(state, u):
+            (fwd_carry, bwd_carry, stash, d_sp, d_hp, d_xs, loss,
+             aux) = state
+
+            # ---- forward subtick ----
+            m_f = u - stage
+            f_valid = (m_f >= 0) & (m_f < M)
+            mf_c = jnp.clip(m_f, 0, M - 1)
+            inj = lax.dynamic_index_in_dim(xs_, mf_c, 0, keepdims=False)
+            x_in = jnp.where(stage == 0, inj, fwd_carry)
+            out, a = stage_fn(sp, x_in)
+            aux = aux + jnp.where(f_valid, a, 0.0)
+
+            # Last stage: microbatch loss + its cotangents wrt the
+            # stage output AND the head params, all from ONE
+            # linearization of the loss head (it contains the
+            # [mb,T,D]@[D,vocab] logits matmul — the model's largest —
+            # so a second grad call would double the head work every
+            # slot). Both are consumed by THIS slot's backward subtick
+            # (the last stage's backward slot equals its forward slot).
+            la = jax.tree.map(
+                lambda t: lax.dynamic_index_in_dim(t, mf_c, 0,
+                                                   keepdims=False),
+                largs_)
+            lval, (g_last, d_hp_m) = jax.value_and_grad(
+                lambda o, h: loss_fn(h, o, la), argnums=(0, 1))(out, hp)
+            lvalid = is_last & f_valid
+            loss = loss + jnp.where(lvalid, lval, 0.0)
+            d_hp = jax.tree.map(
+                lambda acc, gm: acc + jnp.where(lvalid, gm, 0),
+                d_hp, d_hp_m)
+
+            pos_f = mf_c % Q
+            old = lax.dynamic_index_in_dim(stash, pos_f, 0,
+                                           keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(f_valid, x_in, old), pos_f, 0)
+            fwd_carry = lax.ppermute(
+                out, axis, [(i, (i + 1) % S) for i in range(S)])
+
+            # ---- backward subtick ----
+            m_b = u - (2 * (S - 1) - stage)
+            b_valid = (m_b >= 0) & (m_b < M)
+            mb_c = jnp.clip(m_b, 0, M - 1)
+            x_b = lax.dynamic_index_in_dim(stash, mb_c % Q, 0,
+                                           keepdims=False)
+            g_in = jnp.where(is_last, g_last, bwd_carry)
+            _, pull = jax.vjp(stage_fn, sp, x_b)
+            d_sp_m, dx = pull((g_in,
+                               jnp.where(b_valid,
+                                         jnp.float32(aux_cotangent),
+                                         0.0)))
+            d_sp = jax.tree.map(
+                lambda acc, gm: acc + jnp.where(b_valid, gm, 0),
+                d_sp, d_sp_m)
+            # Stage 0's dx is the gradient wrt xs[m_b].
+            cur = lax.dynamic_index_in_dim(d_xs, mb_c, 0, keepdims=False)
+            d_xs = lax.dynamic_update_index_in_dim(
+                d_xs, jnp.where((stage == 0) & b_valid, dx, cur), mb_c,
+                0)
+            bwd_carry = lax.ppermute(
+                dx, axis, [(i, (i - 1) % S) for i in range(S)])
+            return (fwd_carry, bwd_carry, stash, d_sp, d_hp, d_xs,
+                    loss, aux), None
+
+        mb_shape = xs_[0]
+        init = (jnp.zeros_like(mb_shape), jnp.zeros_like(mb_shape),
+                jnp.zeros((Q,) + mb_shape.shape, mb_shape.dtype),
+                jax.tree.map(jnp.zeros_like, sp),
+                jax.tree.map(jnp.zeros_like, hp),
+                jnp.zeros_like(xs_),
+                jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        (_, _, _, d_sp, d_hp, d_xs, loss, aux), _ = lax.scan(
+            slot, init, jnp.arange(U))
+
+        def share(x):
+            # Sum across the pipe axis; f32 for sub-f32 payloads (the
+            # CPU AllReducePromotion crash, as in gpipe).
+            if x.dtype in (jnp.bfloat16, jnp.float16):
+                return lax.psum(x.astype(jnp.float32),
+                                axis).astype(x.dtype)
+            return lax.psum(x, axis)
+
+        # d_sp stays stage-local (out_specs P(axis) reassembles the
+        # stacked layer axis); everything else is summed — each piece
+        # is nonzero on exactly one stage.
+        d_hp = jax.tree.map(share, d_hp)
+        d_xs = share(d_xs)
+        loss = lax.psum(loss, axis)
+        aux = lax.psum(aux, axis)
+        return d_sp, d_hp, d_xs, loss, aux
+
+    d_sp, d_hp, d_xs, loss, aux = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(axis), P(), P(), P()),
+        out_specs=(P(axis), P(), P(), P(), P()),
+        axis_names={axis}, check_vma=False)(
+            stage_params, head_params, xs, loss_args)
+    if cast_dt is not None:
+        d_xs = d_xs.astype(cast_dt)
+    return loss, aux, d_sp, d_hp, d_xs
