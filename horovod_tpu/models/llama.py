@@ -635,12 +635,7 @@ def llama_loss(params, batch, config, mesh=None, seq_axis="seq"):
             "expected 'gpipe' or '1f1b'")
     logits, aux = llama_forward(params, batch["tokens"], config, mesh,
                                 seq_axis, return_aux=True)
-    tgt = batch["targets"]
-    # logsumexp form: no second [B,T,vocab] f32 array for log_softmax —
-    # at bench shapes that array alone is GBs of HBM.
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    picked = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
-    nll = lse - picked
+    nll = _token_nll(logits, batch["targets"])
     mask = batch.get("mask")
     if mask is None:
         loss = jnp.mean(nll)
@@ -650,6 +645,17 @@ def llama_loss(params, batch, config, mesh=None, seq_axis="seq"):
     if config.n_experts > 0:
         loss = loss + config.moe_aux_weight * aux
     return loss
+
+
+def _token_nll(logits, targets):
+    """Per-token negative log-likelihood in logsumexp form: no second
+    [B,T,vocab] f32 array for log_softmax — at bench shapes that array
+    alone is GBs of HBM. The ONE cross-entropy used by llama_loss and
+    the 1F1B last-stage loss head."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None],
+                                 axis=-1)[..., 0]
+    return lse - picked
 
 
 def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
@@ -692,10 +698,7 @@ def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
         h = _rmsnorm(y_mb, final_norm.astype(dt), c.norm_eps)
         logits = jnp.matmul(h, lm_head.astype(dt),
                             preferred_element_type=jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        picked = jnp.take_along_axis(logits, tgt[..., None],
-                                     axis=-1)[..., 0]
-        return jnp.sum((lse - picked) * m) / denom
+        return jnp.sum(_token_nll(logits, tgt) * m) / denom
 
     aux_ct = (c.moe_aux_weight / (c.n_layers * M)
               if c.n_experts > 0 else 0.0)
