@@ -262,20 +262,15 @@ def run_mixed(cfg, batch, seq, steps):
                     seq, dt)
 
 
-def run_eager(cfg, batch, seq, steps, label):
-    """The eager Horovod path: every step enqueues the full gradient
-    tree on the core (one atomic group), the background thread
-    negotiates it (response-cache bitvector in steady state) and
-    replays the cached fused XLA allreduce program on the chip, then a
-    jitted adam applies the averaged gradients. Reference analog:
-    §3.2's hot loop (torch DistributedOptimizer + NCCL backend)."""
+def make_eager_step(cfg):
+    """Eager-Horovod step builder, shared with
+    benchmarks/autotune_bench.py (hvd must already be initialized):
+    jitted grad program, ``hvd.grouped_allreduce`` of the gradient tree
+    over the device plane, jitted adam apply. Returns
+    ``(step, (params, opt), n_params)`` with
+    ``step(carry, data) -> (loss, carry)``."""
     import horovod_tpu.jax as hvd
-    from horovod_tpu.jax import xla_ici
     from horovod_tpu.jax.optimizer import allreduce_gradients
-
-    hvd.init()
-    if not xla_ici.active() and jax.devices()[0].platform != "cpu":
-        xla_ici.enable()
 
     # COMMITTED to the device from the start: the data plane's staging
     # device_put commits the gradients, so apply_fn outputs would flip
@@ -310,8 +305,26 @@ def run_eager(cfg, batch, seq, steps, label):
         params, opt = apply_fn(grads, params, opt)
         return loss, (params, opt)
 
+    return step, (params, opt), n_params
+
+
+def run_eager(cfg, batch, seq, steps, label):
+    """The eager Horovod path: every step enqueues the full gradient
+    tree on the core (one atomic group), the background thread
+    negotiates it (response-cache bitvector in steady state) and
+    replays the cached fused XLA allreduce program on the chip, then a
+    jitted adam applies the averaged gradients. Reference analog:
+    §3.2's hot loop (torch DistributedOptimizer + NCCL backend)."""
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import xla_ici
+
+    hvd.init()
+    if not xla_ici.active() and jax.devices()[0].platform != "cpu":
+        xla_ici.enable()
+
+    step, carry, n_params = make_eager_step(cfg)
     try:
-        dt = _timed(step, (params, opt), _data(cfg, batch, seq), steps,
+        dt = _timed(step, carry, _data(cfg, batch, seq), steps,
                     "llama_train_step_mfu_eager")
     finally:
         hvd.shutdown()

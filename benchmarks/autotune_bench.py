@@ -1,0 +1,168 @@
+"""On-chip proof of the autotuner (HOROVOD_AUTOTUNE=1).
+
+Reference analog: ``horovod/common/parameter_manager.cc`` + autotuning
+docs — the reference tunes fusion-buffer size and cycle time online by
+scoring realized bytes/sec; ours does the same with a Bayesian
+optimizer over the (fusion_threshold, cycle_time) grid
+(``csrc/parameter_manager.cc`` + ``csrc/bayes_opt.cc``).
+
+This benchmark runs the EAGER flagship training loop (the same
+grad -> hvd.grouped_allreduce -> adam shape as bench.py's eager row)
+twice in one process on the real chip:
+
+1. autotune OFF, default knobs — baseline ms/step;
+2. shutdown, re-init with ``HOROVOD_AUTOTUNE=1`` +
+   ``HOROVOD_AUTOTUNE_LOG`` — run until the optimizer converges (the
+   log stops changing knobs), then time steps at the converged
+   operating point.
+
+Emits JSON rows and writes ``results_r05_autotune.json`` with the
+warmup->converged knob trajectory parsed from the autotune log.
+
+Run on a real TPU chip::
+
+    python benchmarks/autotune_bench.py [--out results.json]
+"""
+
+import argparse
+import csv
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _eager_loop(cfg, batch, seq, steps, warmup):
+    """One eager-Horovod training run (bench.make_eager_step — the
+    SAME step the eager bench row times); returns mean ms/step over
+    the last ``steps`` steps (after ``warmup``)."""
+    import numpy as np
+
+    import bench
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import xla_ici
+
+    hvd.init()
+    if not xla_ici.active() and jax.devices()[0].platform != "cpu":
+        xla_ici.enable()
+
+    data = bench._data(cfg, batch, seq)
+    try:
+        step, carry, _ = bench.make_eager_step(cfg)
+        loss, carry = step(carry, data)
+        np.asarray(loss)
+        for i in range(warmup):
+            loss, carry = step(carry, data)
+            if i % 16 == 15:   # bound async run-ahead (HBM)
+                np.asarray(loss)
+        np.asarray(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, carry = step(carry, data)
+        np.asarray(loss)
+        dt = (time.perf_counter() - t0) / steps
+    finally:
+        hvd.shutdown()
+    return dt
+
+
+def _parse_log(path):
+    """(trajectory rows, converged knob dict). The tuner logs one CSV
+    row per scored window, and on convergence appends a FINAL row at
+    the chosen operating point (csrc/parameter_manager.cc), so
+    rows[-1] is the knobs the post-convergence steps ran with. Missing
+    or empty log -> empty trajectory (the measurements still count)."""
+    rows = []
+    try:
+        with open(path) as f:
+            for row in csv.DictReader(f):
+                rows.append({
+                    "fusion_threshold_bytes":
+                        int(row["fusion_threshold_bytes"]),
+                    "cycle_time_ms": float(row["cycle_time_ms"]),
+                    "score_bytes_per_sec":
+                        float(row["score_bytes_per_sec"]),
+                })
+    except OSError as e:
+        print(f"autotune log unreadable ({e}); reporting empty "
+              f"trajectory", file=sys.stderr)
+    conv = ({"fusion_threshold_bytes":
+             rows[-1]["fusion_threshold_bytes"],
+             "cycle_time_ms": rows[-1]["cycle_time_ms"]}
+            if rows else {})
+    return rows, conv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--steps", type=int, default=15)
+    # The tuner scores one window per <=5 s of wall time and converges
+    # after 20 samples (HOROVOD_AUTOTUNE_STEPS), so the tuning phase
+    # needs ~20 x 5 s / step-time steps before the timed window.
+    ap.add_argument("--tune-steps", type=int, default=200)
+    args = ap.parse_args()
+
+    import bench
+
+    if jax.devices()[0].platform == "cpu":
+        print("autotune_bench needs an accelerator; skipping",
+              file=sys.stderr)
+        return
+
+    cfg = bench._flagship_cfg()
+    batch, seq = 4, 2048
+    log_path = "/tmp/hvdtpu_autotune.csv"
+
+    for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_LOG"):
+        os.environ.pop(k, None)
+    dt_off = _eager_loop(cfg, batch, seq, args.steps, warmup=3)
+
+    os.environ["HOROVOD_AUTOTUNE"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_LOG"] = log_path
+    try:
+        dt_on = _eager_loop(cfg, batch, seq, args.steps,
+                            warmup=args.tune_steps)
+    finally:
+        for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_LOG"):
+            os.environ.pop(k, None)
+
+    trajectory, converged = _parse_log(log_path)
+    row = {
+        "metric": "autotune_eager_step_ms",
+        "value": round(dt_on * 1e3, 2),
+        "unit": (f"ms/step eager flagship at converged knobs "
+                 f"(default knobs: {dt_off * 1e3:.2f} ms/step; "
+                 f"converged: {converged}; "
+                 f"{len(trajectory)} scored windows, "
+                 f"{jax.devices()[0].device_kind})"),
+        "vs_baseline": round(dt_off / dt_on, 4),
+    }
+    print(json.dumps(row), flush=True)
+    if args.out:
+        payload = {
+            "note": "HOROVOD_AUTOTUNE=1 over the eager flagship "
+                    "training loop on one real chip (size-1 device "
+                    "plane). vs_baseline = default-knob step time / "
+                    "converged-knob step time (>1 means the tuner "
+                    "helped). Trajectory = every scored "
+                    "(fusion, cycle, bytes/sec) window from "
+                    "HOROVOD_AUTOTUNE_LOG, in order.",
+            "default_step_ms": round(dt_off * 1e3, 2),
+            "converged_step_ms": round(dt_on * 1e3, 2),
+            "converged_knobs": converged,
+            "trajectory": trajectory,
+            "rows": [row],
+        }
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -161,4 +161,16 @@ size_t BayesOpt::Best() const {
   return best_idx;
 }
 
+double BayesOpt::MeanScore(size_t idx) const {
+  double sum = 0;
+  int cnt = 0;
+  for (size_t i = 0; i < xs_.size(); i++) {
+    if (xs_[i] == idx) {
+      sum += ys_[i];
+      cnt++;
+    }
+  }
+  return cnt ? sum / cnt : 0.0;
+}
+
 }  // namespace hvdtpu

@@ -33,6 +33,9 @@ class BayesOpt {
   // Best candidate so far: the argmax of observed mean score.
   size_t Best() const;
 
+  // Mean observed score at candidates[idx] (0 if never sampled).
+  double MeanScore(size_t idx) const;
+
   size_t num_samples() const { return xs_.size(); }
 
  private:

@@ -83,6 +83,10 @@ void ParameterManager::Score(double bytes_per_sec) {
   if ((int)opt_->num_samples() >= max_samples_) {
     MoveTo(opt_->Best());
     done_ = true;
+    // Final log row = the CONVERGED operating point (with its mean
+    // observed score), not the 20th sampled candidate — consumers
+    // read rows[-1] as "what the tuner settled on".
+    Log(opt_->MeanScore(current_candidate_));
     LOG_INFO("autotune converged: fusion=%lld bytes, cycle=%.2f ms",
              (long long)fusion_threshold_bytes(), cycle_time_ms());
     return;
