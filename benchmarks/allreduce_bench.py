@@ -36,7 +36,16 @@ def main():
     ap.add_argument("--grouped", type=int, default=0,
                     help="split the payload into N tensors fused by the "
                          "runtime (exercises the fusion buffer)")
+    ap.add_argument("--op", default="allreduce",
+                    choices=("allreduce", "adasum", "allgather",
+                             "reducescatter"),
+                    help="collective to time; adasum = allreduce with "
+                         "op=Adasum (device-plane recursive doubling); "
+                         "allgather/reducescatter require --grouped")
     args = ap.parse_args()
+    if args.op in ("allgather", "reducescatter") and not args.grouped:
+        ap.error(f"--op {args.op} requires --grouped (the grouped "
+                 f"variants are the benched surface)")
 
     # Honor JAX_PLATFORMS at the config level: some images register an
     # accelerator plugin in sitecustomize that overrides the env var, and
@@ -89,16 +98,24 @@ def main():
         else:
             np.asarray(out)
 
+    names = [f"bench.g{j}" for j in range(args.grouped or 0)]
+
     def one_iter(i):
         t0 = time.perf_counter()
-        if args.grouped:
-            outs = hvd.grouped_allreduce(
-                parts, names=[f"bench.g{j}" for j in range(args.grouped)],
-                op=hvd.Sum)
+        if args.op == "allgather":
+            outs = hvd.grouped_allgather(parts, names=names)
+            materialize(outs[0])
+        elif args.op == "reducescatter":
+            outs = hvd.grouped_reducescatter(parts, names=names,
+                                             op=hvd.Sum)
+            materialize(outs[0])
+        elif args.grouped:
+            op = hvd.Adasum if args.op == "adasum" else hvd.Sum
+            outs = hvd.grouped_allreduce(parts, names=names, op=op)
             materialize(outs[0])
         else:
-            out = hvd.allreduce(payload, name="bench.allreduce",
-                                op=hvd.Sum)
+            op = hvd.Adasum if args.op == "adasum" else hvd.Sum
+            out = hvd.allreduce(payload, name="bench.allreduce", op=op)
             materialize(out)
         return time.perf_counter() - t0
 
@@ -107,9 +124,16 @@ def main():
     steady = float(np.median(times))
 
     if hvd.rank() == 0:
-        bus_factor = 2.0 * (n - 1) / n
+        # NCCL-tests bus-bandwidth conventions per collective: the ring
+        # moves 2(N-1)/N x payload per rank for allreduce-likes and
+        # (N-1)/N for allgather/reducescatter.
+        if args.op in ("allgather", "reducescatter"):
+            bus_factor = (n - 1) / n
+        else:
+            bus_factor = 2.0 * (n - 1) / n
         print(json.dumps({
-            "metric": "ring_allreduce_bandwidth",
+            "metric": f"ring_{args.op}_bandwidth",
+            "op": args.op,
             "plane": "xla_ici" if device_plane else "host_ring",
             "ranks": n,
             "payload_mb": round(payload_bytes / (1 << 20), 2),

@@ -28,7 +28,8 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_case(plane, ranks, size_mb, grouped, iters=10, timeout=600):
+def run_case(plane, ranks, size_mb, grouped, op="allreduce", iters=10,
+             timeout=600):
     """One launcher run; returns the parsed JSON row or an error row."""
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
@@ -49,7 +50,8 @@ def run_case(plane, ranks, size_mb, grouped, iters=10, timeout=600):
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch", "-np",
            str(ranks), sys.executable,
            os.path.join(ROOT, "benchmarks", "allreduce_bench.py"),
-           "--size-mb", str(size_mb), "--iters", str(iters)]
+           "--size-mb", str(size_mb), "--iters", str(iters),
+           "--op", op]
     if grouped:
         cmd += ["--grouped", str(grouped)]
     t0 = time.time()
@@ -66,7 +68,8 @@ def run_case(plane, ranks, size_mb, grouped, iters=10, timeout=600):
             except json.JSONDecodeError:
                 pass
     if row is None:
-        return {"metric": "ring_allreduce_bandwidth", "plane": plane,
+        return {"metric": f"ring_{op}_bandwidth", "op": op,
+                "plane": plane,
                 "ranks": ranks, "payload_mb": size_mb, "grouped": grouped,
                 "error": (proc.stderr or proc.stdout)[-400:],
                 "rc": proc.returncode}
@@ -78,33 +81,50 @@ def run_case(plane, ranks, size_mb, grouped, iters=10, timeout=600):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
-        ROOT, "benchmarks", "results_r03.json"))
+        ROOT, "benchmarks", "results_r05.json"))
     ap.add_argument("--skip-tpu", action="store_true")
     args = ap.parse_args()
 
     cases = [
         # The headline: device plane at N>1 — fused-program scaling.
-        ("xla_ici_cpu", 2, 8, 0),
-        ("xla_ici_cpu", 2, 64, 0),
-        ("xla_ici_cpu", 4, 8, 0),
-        ("xla_ici_cpu", 4, 64, 0),
+        ("xla_ici_cpu", 2, 8, 0, "allreduce"),
+        ("xla_ici_cpu", 2, 64, 0, "allreduce"),
+        ("xla_ici_cpu", 4, 8, 0, "allreduce"),
+        ("xla_ici_cpu", 4, 64, 0, "allreduce"),
+        # r5: the full 8-rank timing matrix (r4 proved 8-rank
+        # CORRECTNESS only — tests/parallel/test_xla_ici.py).
+        ("xla_ici_cpu", 8, 8, 0, "allreduce"),
+        ("xla_ici_cpu", 8, 64, 0, "allreduce"),
+        ("xla_ici_cpu", 8, 8, 64, "allreduce"),
+        # Device-plane Adasum (recursive doubling) + the grouped
+        # allgather/reducescatter surfaces, previously unbenched.
+        ("xla_ici_cpu", 4, 8, 0, "adasum"),
+        ("xla_ici_cpu", 8, 8, 0, "adasum"),
+        ("xla_ici_cpu", 8, 8, 16, "allgather"),
+        ("xla_ici_cpu", 8, 8, 16, "reducescatter"),
         # 64-tensor fused group through ONE compiled program.
-        ("xla_ici_cpu", 2, 8, 64),
-        ("xla_ici_cpu", 4, 8, 64),
+        ("xla_ici_cpu", 2, 8, 64, "allreduce"),
+        ("xla_ici_cpu", 4, 8, 64, "allreduce"),
         # Host TCP ring for continuity with r02.
-        ("host_ring", 2, 8, 0),
-        ("host_ring", 4, 8, 0),
+        ("host_ring", 2, 8, 0, "allreduce"),
+        ("host_ring", 4, 8, 0, "allreduce"),
     ]
     if not args.skip_tpu:
         # Real-chip single-rank replay latency (r02 continuity).
-        cases += [("xla_ici_tpu", 1, 8, 0), ("xla_ici_tpu", 1, 64, 0),
-                  ("xla_ici_tpu", 1, 8, 64)]
+        cases += [("xla_ici_tpu", 1, 8, 0, "allreduce"),
+                  ("xla_ici_tpu", 1, 64, 0, "allreduce"),
+                  ("xla_ici_tpu", 1, 8, 64, "allreduce")]
 
     rows = []
-    for plane, ranks, mb, grouped in cases:
-        print(f"== {plane} ranks={ranks} {mb}MB grouped={grouped}",
-              file=sys.stderr)
-        row = run_case(plane, ranks, mb, grouped)
+    for plane, ranks, mb, grouped, op in cases:
+        print(f"== {plane} ranks={ranks} {mb}MB grouped={grouped} "
+              f"op={op}", file=sys.stderr)
+        row = run_case(plane, ranks, mb, grouped, op)
+        if "error" in row:
+            # One retry: rendezvous port binds occasionally race on a
+            # busy box (observed rate ~1/15 launches).
+            print("retrying after error", file=sys.stderr)
+            row = run_case(plane, ranks, mb, grouped, op)
         print(json.dumps(row), file=sys.stderr)
         rows.append(row)
 
