@@ -60,6 +60,32 @@ Status Controller::Initialize() {
     return Status::OK();
   }
 
+  if (cfg_.use_external_transport) {
+    // Bare-MPI mode: no rendezvous, no sockets. Ranks and sizes come
+    // from the launcher env; both planes address peers through the
+    // registered message transport (control = tag 0, data = tag 1).
+    if (!ExternalTransportActive()) {
+      return Status::Error(
+          "HOROVOD_CONTROLLER=mpi but no external transport registered "
+          "(the frontend registers mpi4py callbacks before init)");
+    }
+    if (rank == 0) {
+      control_fds_.assign(size, -1);
+      for (int i = 1; i < size; i++) control_fds_[i] = ExtFd(i, 0);
+    } else {
+      control_fds_.assign(1, ExtFd(0, 0));
+    }
+    std::vector<int> peers(size, -1);
+    for (int j = 0; j < size; j++) {
+      if (j != rank) peers[j] = ExtFd(j, 1);
+    }
+    data_plane_ = std::make_unique<DataPlane>(rank, size,
+                                              std::move(peers));
+    LOG_DEBUG("rank %d: external-transport planes up (size=%d)", rank,
+              size);
+    return Status::OK();
+  }
+
   // 1) Data-plane listen socket (ephemeral port).
   int data_port = 0;
   int data_listen = TcpListen(&data_port);

@@ -37,6 +37,10 @@ struct ControllerConfig {
   // Readiness for a tensor on process set S waits only on S's members.
   // Not owned; outlives the controller (lives in GlobalState).
   const ProcessSetTable* process_sets = nullptr;
+  // HOROVOD_CONTROLLER=mpi: route control frames AND ring data through
+  // the registered external message transport (wire.h) — zero TCP
+  // sockets, for firewalled MPI-only fabrics.
+  bool use_external_transport = false;
 };
 
 class Controller {

@@ -683,6 +683,10 @@ int hvdtpu_init() {
   cfg.stall_warning_secs = EnvDouble("HOROVOD_STALL_CHECK_TIME", 60.0);
   cfg.stall_check_enabled =
       EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) == 0;
+  // HOROVOD_CONTROLLER=mpi: zero-TCP mode — control negotiation AND
+  // ring data ride the registered external transport (mpi4py
+  // point-to-point; the frontend registers callbacks before init).
+  cfg.use_external_transport = EnvStr("HOROVOD_CONTROLLER", "") == "mpi";
   st->controller = std::make_unique<Controller>(cfg);
   Status s = st->controller->Initialize();
   if (!s.ok()) {
@@ -1157,6 +1161,13 @@ int hvdtpu_release(int handle) {
   CHECK_INIT(-1)
   g_state->handles.Release(handle);
   return 0;
+}
+
+// Register the external (socket-free) message transport BEFORE init —
+// used with HOROVOD_CONTROLLER=mpi (bare-MPI fabrics). Function
+// pointers are ctypes callbacks; see wire.h for the contract.
+void hvdtpu_set_external_transport(void* send_fn, void* recv_fn) {
+  SetExternalTransport((ExternalSendFn)send_fn, (ExternalRecvFn)recv_fn);
 }
 
 int64_t hvdtpu_fusion_threshold_bytes() {

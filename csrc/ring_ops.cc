@@ -306,7 +306,17 @@ Status DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
     *len = std::min(CHUNK, bytes - *off);
   };
   if (is_root) {
-    return SendAll(right_fd(), base, (size_t)bytes);
+    // Send CHUNK-sized pieces, matching the forwarders' chunked
+    // receives: over TCP the stream hides the boundaries, but the
+    // external (message) transport requires every send to pair with an
+    // equal-length recv.
+    for (int64_t i = 0; i < nchunks; i++) {
+      int64_t off, len;
+      chunk_span(i, &off, &len);
+      Status s = SendAll(right_fd(), base + off, (size_t)len);
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
   }
   for (int64_t i = 0; i < nchunks; i++) {
     int64_t off, len;

@@ -17,6 +17,44 @@
 
 namespace hvdtpu {
 
+namespace {
+ExternalSendFn g_ext_send = nullptr;
+ExternalRecvFn g_ext_recv = nullptr;
+
+Status ExtSend(int fd, const void* buf, size_t len) {
+  if (!g_ext_send) return Status::Error("external transport not set");
+  int rc = g_ext_send(ExtFdPeer(fd), ExtFdTag(fd), buf, (long long)len);
+  if (rc != 0) {
+    return Status::Error("external transport send failed rc=" +
+                         std::to_string(rc));
+  }
+  return Status::OK();
+}
+
+// Exact-length receive: the senders' messages are 1:1 with the
+// receivers' expected lengths on both planes (control frames are sent
+// as one message; ring chunks pair SendAll/RecvAll of equal size).
+Status ExtRecvExact(int fd, void* buf, size_t len) {
+  if (!g_ext_recv) return Status::Error("external transport not set");
+  long long got = g_ext_recv(ExtFdPeer(fd), ExtFdTag(fd), buf,
+                             (long long)len);
+  if (got < 0) return Status::Error("external transport recv failed");
+  if ((size_t)got != len) {
+    return Status::Error("external transport message length mismatch: "
+                         "expected " + std::to_string(len) + ", got " +
+                         std::to_string(got));
+  }
+  return Status::OK();
+}
+}  // namespace
+
+void SetExternalTransport(ExternalSendFn send, ExternalRecvFn recv) {
+  g_ext_send = send;
+  g_ext_recv = recv;
+}
+
+bool ExternalTransportActive() { return g_ext_send && g_ext_recv; }
+
 static void SetSockOpts(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -78,10 +116,11 @@ int TcpConnect(const std::string& host, int port, int timeout_ms) {
 }
 
 void TcpClose(int fd) {
-  if (fd >= 0) close(fd);
+  if (fd >= 0) close(fd);  // external fds (< 0) have nothing to close
 }
 
 Status SendAll(int fd, const void* buf, size_t len) {
+  if (IsExtFd(fd)) return ExtSend(fd, buf, len);
   const char* p = (const char*)buf;
   while (len > 0) {
     ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
@@ -96,6 +135,7 @@ Status SendAll(int fd, const void* buf, size_t len) {
 }
 
 Status RecvAll(int fd, void* buf, size_t len) {
+  if (IsExtFd(fd)) return ExtRecvExact(fd, buf, len);
   char* p = (char*)buf;
   while (len > 0) {
     ssize_t n = recv(fd, p, len, 0);
@@ -111,6 +151,11 @@ Status RecvAll(int fd, void* buf, size_t len) {
 }
 
 Status SendFrame(int fd, const std::string& payload) {
+  if (IsExtFd(fd)) {
+    // One message per frame: the transport preserves boundaries, so no
+    // length prefix is needed.
+    return ExtSend(fd, payload.data(), payload.size());
+  }
   uint64_t len = payload.size();
   Status s = SendAll(fd, &len, sizeof(len));
   if (!s.ok()) return s;
@@ -118,6 +163,16 @@ Status SendFrame(int fd, const std::string& payload) {
 }
 
 Status RecvFrame(int fd, std::string* payload) {
+  if (IsExtFd(fd)) {
+    if (!g_ext_recv) return Status::Error("external transport not set");
+    // Two-phase: probe the next message's length (cap 0 holds it on
+    // the Python side), then copy it out.
+    long long len = g_ext_recv(ExtFdPeer(fd), ExtFdTag(fd), nullptr, 0);
+    if (len < 0) return Status::Error("external transport recv failed");
+    payload->resize((size_t)len);
+    if (len == 0) return Status::OK();
+    return ExtRecvExact(fd, payload->data(), (size_t)len);
+  }
   uint64_t len = 0;
   Status s = RecvAll(fd, &len, sizeof(len));
   if (!s.ok()) return s;
@@ -153,6 +208,16 @@ class ScopedNonblock {
 
 Status DuplexTransfer(int send_fd, const void* send_buf, size_t send_len,
                       int recv_fd, void* recv_buf, size_t recv_len) {
+  if (IsExtFd(send_fd) || IsExtFd(recv_fd)) {
+    // The external transport's sends are buffered/asynchronous by
+    // contract, so send-then-recv cannot deadlock the ring.
+    if (send_len > 0) {
+      Status s = SendAll(send_fd, send_buf, send_len);
+      if (!s.ok()) return s;
+    }
+    if (recv_len > 0) return RecvAll(recv_fd, recv_buf, recv_len);
+    return Status::OK();
+  }
   ScopedNonblock nb(send_fd, recv_fd);
   const char* sp = (const char*)send_buf;
   char* rp = (char*)recv_buf;

@@ -45,6 +45,39 @@ Status DuplexTransfer(int send_fd, const void* send_buf, size_t send_len,
 // Best local IP for peers to reach us (first non-loopback, else 127.0.0.1).
 std::string LocalAddress();
 
+// ---- external (socket-free) message transport ------------------------
+// Bare-MPI fabrics forbid ad-hoc TCP; the frontend can register a
+// message transport (mpi4py point-to-point in practice) and the wire
+// primitives above route through it for EXTERNAL fds. An external fd
+// encodes (peer rank, channel): channel 0 = control frames, 1 = ring
+// data — distinct tags keep a peer's next-cycle control traffic from
+// racing its in-flight data chunks. Reference analog:
+// horovod/common/mpi_controller.cc (MPI_Gatherv-based negotiation) —
+// re-founded as a transport seam so ONE controller serves both fabrics.
+//
+// send: deliver len bytes to peer on tag; must not block against a
+//   peer that is itself sending (buffered/async semantics). Returns 0
+//   on success.
+// recv: cap == 0 -> block for the next message on (peer, tag), hold
+//   it, return its length; cap >= len -> copy the held (or next)
+//   message into buf, return its length. Negative on error.
+typedef int (*ExternalSendFn)(int peer, int tag, const void* buf,
+                              long long len);
+typedef long long (*ExternalRecvFn)(int peer, int tag, void* buf,
+                                    long long cap);
+
+void SetExternalTransport(ExternalSendFn send, ExternalRecvFn recv);
+bool ExternalTransportActive();
+
+// Encode/decode an external fd. Valid fds are <= kExtFdBase.
+constexpr int kExtFdBase = -16;
+inline int ExtFd(int peer, int tag) {
+  return kExtFdBase - (peer * 2 + tag);
+}
+inline bool IsExtFd(int fd) { return fd <= kExtFdBase; }
+inline int ExtFdPeer(int fd) { return (kExtFdBase - fd) / 2; }
+inline int ExtFdTag(int fd) { return (kExtFdBase - fd) % 2; }
+
 }  // namespace hvdtpu
 
 #endif  // HVDTPU_WIRE_H

@@ -51,6 +51,8 @@ def load_library():
         cstr = ctypes.c_char_p
 
         lib.hvdtpu_init.restype = i32
+        lib.hvdtpu_set_external_transport.restype = None
+        lib.hvdtpu_set_external_transport.argtypes = [p, p]
         lib.hvdtpu_shutdown.restype = i32
         lib.hvdtpu_is_initialized.restype = i32
         lib.hvdtpu_loop_failed.restype = i32

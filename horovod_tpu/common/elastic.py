@@ -60,10 +60,16 @@ def init():
         # Bare-mpirun launch (no horovodrun, no env): derive identity and
         # the rendezvous endpoint from the MPI world if one is running
         # (reference analog: initializing on an existing MPI_COMM_WORLD,
-        # common/mpi/mpi_context.cc).
-        from horovod_tpu.common.mpi_bootstrap import maybe_bootstrap_from_mpi
+        # common/mpi/mpi_context.cc). HOROVOD_CONTROLLER=mpi goes
+        # further: control AND ring data ride mpi4py point-to-point —
+        # zero TCP sockets (firewalled MPI-only fabrics).
+        from horovod_tpu.common.mpi_bootstrap import (
+            bootstrap_mpi_control,
+            maybe_bootstrap_from_mpi,
+        )
 
-        maybe_bootstrap_from_mpi()
+        if not bootstrap_mpi_control():
+            maybe_bootstrap_from_mpi()
         _basics.init()
         return
     from horovod_tpu.runner.elastic.rendezvous import RendezvousClient

@@ -151,13 +151,8 @@ def maybe_bootstrap_from_mpi(environ=os.environ):
     if comm.Get_size() <= 1:
         return False
 
-    rank, size = comm.Get_rank(), comm.Get_size()
-    local_comm = comm.Split_type(MPI.COMM_TYPE_SHARED, key=rank)
-    local_rank = local_comm.Get_rank()
-    local_size = local_comm.Get_size()
-    cross_comm = comm.Split(color=local_rank, key=rank)
-    cross_rank = cross_comm.Get_rank()
-    cross_size = cross_comm.Get_size()
+    identity = _identity_env(MPI, comm)
+    rank = int(identity["HOROVOD_RANK"])
 
     # Rank 0 owns the controller endpoint; everyone learns it via bcast
     # (the comm plays the role horovodrun's env injection plays).
@@ -180,14 +175,131 @@ def maybe_bootstrap_from_mpi(environ=os.environ):
         endpoint = None
     host, port = comm.bcast(endpoint, root=0)
 
+    environ.update(identity)
     environ.update({
-        "HOROVOD_RANK": str(rank),
-        "HOROVOD_SIZE": str(size),
-        "HOROVOD_LOCAL_RANK": str(local_rank),
-        "HOROVOD_LOCAL_SIZE": str(local_size),
-        "HOROVOD_CROSS_RANK": str(cross_rank),
-        "HOROVOD_CROSS_SIZE": str(cross_size),
         "HOROVOD_CONTROLLER_ADDR": host,
         "HOROVOD_CONTROLLER_PORT": str(port),
     })
+    return True
+
+
+def _identity_env(MPI, comm):
+    """The six HOROVOD_* identity vars from a communicator: global
+    rank/size, shared-memory local split, cross split keyed by local
+    rank. One derivation shared by the TCP and MPI control paths."""
+    rank, size = comm.Get_rank(), comm.Get_size()
+    local_comm = comm.Split_type(MPI.COMM_TYPE_SHARED, key=rank)
+    cross_comm = comm.Split(color=local_comm.Get_rank(), key=rank)
+    return {
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(local_comm.Get_rank()),
+        "HOROVOD_LOCAL_SIZE": str(local_comm.Get_size()),
+        "HOROVOD_CROSS_RANK": str(cross_comm.Get_rank()),
+        "HOROVOD_CROSS_SIZE": str(cross_comm.Get_size()),
+    }
+
+
+# ---- HOROVOD_CONTROLLER=mpi: the zero-TCP control + data planes ------
+#
+# Reference analog: horovod/common/mpi_controller.cc — upstream's MPI
+# controller negotiates with MPI_Gatherv/MPI_Bcast and moves host
+# tensors with MPI collectives, so a firewalled MPI-only fabric never
+# needs ad-hoc sockets. Ours keeps ONE controller (csrc/controller.cc)
+# and swaps the WIRE underneath it: with HOROVOD_CONTROLLER=mpi the
+# C core routes control frames (tag 0) and ring data chunks (tag 1)
+# through the callbacks registered here, which relay over mpi4py
+# point-to-point. Zero TCP sockets are opened in this mode
+# (tests/parallel/test_mpi_control.py pins that).
+
+# The ctypes callback objects MUST outlive the background thread — a
+# GC'd CFUNCTYPE leaves the C side calling freed memory.
+_transport_refs = []
+
+
+def _register_external_transport(comm):
+    """Register mpi4py-backed send/recv callbacks with the core.
+
+    Contract (csrc/wire.h): send must be buffered/asynchronous (isend —
+    a blocking ring send would deadlock); recv with cap==0 blocks for
+    the next (peer, tag) message, holds it, and returns its length,
+    then a second call copies it out. Real-MPI caveat: the callbacks
+    run on the core's background thread, so the MPI library must
+    provide MPI_THREAD_MULTIPLE if the main thread also uses the comm
+    after init (ours does not)."""
+    import ctypes
+
+    from horovod_tpu.common.basics import HorovodBasics
+
+    held = {}           # (peer, tag) -> bytes, for two-phase recv
+    inflight = []       # isend requests not yet completed
+
+    send_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                              ctypes.c_void_p, ctypes.c_longlong)
+    recv_t = ctypes.CFUNCTYPE(ctypes.c_longlong, ctypes.c_int,
+                              ctypes.c_int, ctypes.c_void_p,
+                              ctypes.c_longlong)
+
+    def _send(peer, tag, buf, length):
+        try:
+            data = ctypes.string_at(buf, length) if length else b""
+            inflight.append(comm.isend(data, dest=peer, tag=tag))
+            # Opportunistic completion sweep keeps the request list
+            # bounded without ever blocking the sender.
+            inflight[:] = [r for r in inflight if not _done(r)]
+            return 0
+        except Exception:  # noqa: BLE001 — surfaces as a Status error
+            return -1
+
+    def _done(req):
+        try:
+            flag = req.test()
+        except Exception:  # noqa: BLE001
+            return True
+        # mpi4py returns (flag, msg); fakes may return a bare bool.
+        return bool(flag[0] if isinstance(flag, tuple) else flag)
+
+    def _recv(peer, tag, buf, cap):
+        try:
+            key = (peer, tag)
+            msg = held.pop(key, None)
+            if msg is None:
+                msg = comm.recv(source=peer, tag=tag)
+            if cap == 0:
+                if msg:
+                    held[key] = msg   # empty messages need no phase 2
+                return len(msg)
+            if cap < len(msg):
+                held[key] = msg
+                return -2
+            ctypes.memmove(buf, msg, len(msg))
+            return len(msg)
+        except Exception:  # noqa: BLE001
+            return -1
+
+    send_cb = send_t(_send)
+    recv_cb = recv_t(_recv)
+    _transport_refs.extend([send_cb, recv_cb, comm])
+    lib = HorovodBasics().lib
+    lib.hvdtpu_set_external_transport(
+        ctypes.cast(send_cb, ctypes.c_void_p),
+        ctypes.cast(recv_cb, ctypes.c_void_p))
+
+
+def bootstrap_mpi_control(environ=os.environ):
+    """Engage the zero-TCP MPI control+data planes when
+    ``HOROVOD_CONTROLLER=mpi``: derive identity from the communicator
+    (unless a launcher already set HOROVOD_RANK) and register the
+    message transport. Returns True when engaged."""
+    if environ.get("HOROVOD_CONTROLLER") != "mpi":
+        return False
+    world = _mpi_world(environ)
+    if world is None:
+        raise RuntimeError(
+            "HOROVOD_CONTROLLER=mpi requires a running MPI world "
+            "(mpi4py importable and launched under an MPI launcher)")
+    MPI, comm = world
+    if "HOROVOD_RANK" not in environ:
+        environ.update(_identity_env(MPI, comm))
+    _register_external_transport(comm)
     return True

@@ -1,0 +1,100 @@
+"""File-mailbox fake of the mpi4py surface the MPI control plane uses.
+
+Injected as ``sys.modules["mpi4py"]`` by tests: point-to-point
+``isend``/``recv`` become atomic file renames in a shared directory
+(FAKE_MPI_DIR), so a multi-process HOROVOD_CONTROLLER=mpi run needs NO
+sockets of any kind — which is exactly what the zero-TCP test asserts.
+Message ordering per (src, dst, tag) stream is by sequence number;
+``os.replace`` makes publication atomic. Mirrors the reference's
+elastic-test pattern of faking infrastructure at the API seam.
+"""
+
+import os
+import pickle
+import time
+
+
+class _Req:
+    def test(self):
+        return (True, None)
+
+
+class _SubComm:
+    def __init__(self, rank, size):
+        self._rank, self._size = rank, size
+
+    def Get_rank(self):
+        return self._rank
+
+    def Get_size(self):
+        return self._size
+
+
+class _Comm:
+    def __init__(self):
+        self.dir = os.environ["FAKE_MPI_DIR"]
+        self.rank = int(os.environ["FAKE_MPI_RANK"])
+        self.size = int(os.environ["FAKE_MPI_SIZE"])
+        self._send_seq = {}
+        self._recv_seq = {}
+
+    def Get_rank(self):
+        return self.rank
+
+    def Get_size(self):
+        return self.size
+
+    def Split_type(self, kind, key=0):
+        # Single-host fake: every rank shares the "node".
+        return _SubComm(self.rank, self.size)
+
+    def Split(self, color=0, key=0):
+        # Distinct colors per rank in the bootstrap's usage.
+        return _SubComm(0, 1)
+
+    def _path(self, src, dst, tag, seq):
+        return os.path.join(self.dir, f"m_{src}_{dst}_{tag}_{seq:08d}")
+
+    def isend(self, data, dest, tag=0):
+        seq = self._send_seq.get((dest, tag), 0)
+        self._send_seq[(dest, tag)] = seq + 1
+        final = self._path(self.rank, dest, tag, seq)
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(data, f)
+        os.replace(tmp, final)
+        return _Req()
+
+    def recv(self, source, tag=0):
+        seq = self._recv_seq.get((source, tag), 0)
+        self._recv_seq[(source, tag)] = seq + 1
+        path = self._path(source, self.rank, tag, seq)
+        deadline = time.time() + 60
+        while not os.path.exists(path):
+            if time.time() > deadline:
+                raise TimeoutError(f"fake MPI recv timed out: {path}")
+            time.sleep(0.002)
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        os.remove(path)
+        return data
+
+
+class _MPIModule:
+    COMM_TYPE_SHARED = 1
+
+    def __init__(self):
+        self.COMM_WORLD = _Comm()
+
+    def Is_initialized(self):
+        return True
+
+    def Is_finalized(self):
+        return False
+
+
+MPI = _MPIModule()
+
+
+class rc:  # mpi4py.rc lookalike (mpi_bootstrap sets rc.initialize)
+    initialize = False

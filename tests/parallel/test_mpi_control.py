@@ -1,0 +1,120 @@
+"""HOROVOD_CONTROLLER=mpi: the zero-TCP control + data planes.
+
+Reference analog: horovod/common/mpi_controller.cc — on firewalled
+MPI-only fabrics the reference never opens ad-hoc sockets. Ours routes
+the controller's frames and the host ring's chunks through mpi4py
+callbacks (csrc/wire.h external transport); these tests run 3 real OS
+ranks over the file-mailbox fake (tests/fake_mpi.py) and assert both
+collective correctness AND that the process opened ZERO new sockets of
+any family — the property the mode exists for.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from tests.utils_mp import run_ranks
+
+pytestmark = pytest.mark.quick
+
+
+def _socket_fds():
+    fds = []
+    d = "/proc/self/fd"
+    for f in os.listdir(d):
+        try:
+            target = os.readlink(os.path.join(d, f))
+        except OSError:
+            continue
+        if target.startswith("socket:"):
+            fds.append(target)
+    return sorted(fds)
+
+
+def _worker(rank, size):
+    import sys
+
+    os.environ["FAKE_MPI_RANK"] = str(rank)
+    os.environ["FAKE_MPI_SIZE"] = str(size)
+    os.environ["HOROVOD_CONTROLLER"] = "mpi"
+    # Prove the TCP rendezvous is unused: poison the endpoint.
+    os.environ["HOROVOD_CONTROLLER_ADDR"] = "203.0.113.1"  # TEST-NET
+    os.environ["HOROVOD_CONTROLLER_PORT"] = "1"
+    # The file mailbox costs ~ms per message; a relaxed cycle keeps the
+    # background loop from hammering it.
+    os.environ.setdefault("HOROVOD_CYCLE_TIME", "20")
+
+    import tests.fake_mpi as fake_mpi
+
+    sys.modules["mpi4py"] = fake_mpi
+
+    baseline = _socket_fds()
+
+    from horovod_tpu.common import basics, eager_ops, elastic
+
+    elastic.init()
+    b = basics.HorovodBasics()
+    assert b.rank() == rank and b.size() == size
+
+    # Host-ring collectives over the external transport (tag-1 chunks).
+    out = eager_ops.allreduce_async(
+        np.full(8, float(rank + 1), np.float32), "mpi.ar").synchronize()
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+
+    gathered = eager_ops.allgather_async(
+        np.full((2, 3), rank, np.int32), "mpi.ag").synchronize()
+    assert gathered.shape == (2 * size, 3)
+    np.testing.assert_array_equal(gathered[::2, 0], np.arange(size))
+
+    bc = eager_ops.broadcast_async(
+        np.full(4, float(rank), np.float64), 1, "mpi.bc").synchronize()
+    np.testing.assert_allclose(bc, 1.0)
+
+    # >1 MB payloads drive the CHUNKED ring paths, where every send
+    # must pair with an equal-length recv on the message transport
+    # (regression: the broadcast root used to send one whole-buffer
+    # message against the forwarders' 1 MB chunked receives).
+    big = 3 * (1 << 20) // 4 + 531  # ~3 MB of f32, not chunk-aligned
+    out = eager_ops.allreduce_async(
+        np.full(big, float(rank + 1), np.float32),
+        "mpi.ar.big").synchronize()
+    np.testing.assert_allclose(out[:4], sum(range(1, size + 1)))
+    bc = eager_ops.broadcast_async(
+        np.arange(big, dtype=np.float32) if rank == 0
+        else np.zeros(big, np.float32), 0, "mpi.bc.big").synchronize()
+    np.testing.assert_allclose(bc[-3:], np.arange(big - 3, big))
+
+    after = _socket_fds()
+    assert after == baseline, (
+        f"HOROVOD_CONTROLLER=mpi opened sockets: baseline={baseline} "
+        f"after={after}")
+
+    b.shutdown()
+    return True
+
+
+def test_mpi_control_plane_zero_tcp_three_ranks(tmp_path):
+    with tempfile.TemporaryDirectory() as mailbox:
+        results = run_ranks(_worker, 3, timeout=180,
+                            env={"FAKE_MPI_DIR": mailbox})
+    assert all(results)
+
+
+def _worker_no_transport(rank, size):
+    os.environ["HOROVOD_CONTROLLER"] = "mpi"
+    from horovod_tpu.common import basics
+
+    try:
+        basics.HorovodBasics().init()
+    except RuntimeError:
+        return True
+    return False
+
+
+def test_mpi_controller_requires_transport():
+    """HOROVOD_CONTROLLER=mpi without a registered transport must fail
+    loudly at init, not silently fall back to TCP."""
+    results = run_ranks(_worker_no_transport, 2, timeout=60)
+    assert all(results)
