@@ -29,18 +29,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax
 
 # (batch, seq, remat): 8192+ tokens of context on ONE chip; t16384 at
-# b1 is the largest activation footprint that fits beside the 1.39B
+# b1 is the largest activation footprint that fits beside the 1.4B
 # model. The remat tradeoff flips with T: the flagship's "attn+gate"
 # (save FFN gate residuals, skip their recompute) wins at t2048 but
 # its per-layer [B,T,d_ff] saves grow linearly in T and OOM HBM at
-# t8192 (19.4G needed) — the long rows drop back to "attn".
-CONFIGS = [(4, 2048, None), (2, 8192, "attn"), (1, 16384, "attn")]
+# t8192 — the t8192 row drops to "attn", and at t16384 the r5 flagship
+# geometry (d_ff 13312) needs full remat even for the flash residuals'
+# neighbors to fit.
+# Largest activation footprint FIRST: the t16384 row only fits on a
+# virgin heap (the axon allocator fragments across configs — same
+# behavior bench.py works around for its flagship row).
+CONFIGS = [(1, 16384, True), (2, 8192, "attn"), (4, 2048, None)]
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None, help="write JSON rows here")
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--one", type=int, default=0,
+                    help="child mode: run ONLY config #N (1-based)")
     args = ap.parse_args()
 
     import bench  # repo-root bench machinery (MFU accounting)
@@ -50,23 +57,57 @@ def main():
               file=sys.stderr)
         return
 
-    rows = []
-    for batch, seq, remat in CONFIGS:
+    if args.one:
+        # Child mode: ONE config on a virgin heap. The fused step (not
+        # the split grad/apply) — at these activation footprints the
+        # split layout's non-donatable gradient copy is what OOMs.
+        batch, seq, remat = CONFIGS[args.one - 1]
         cfg = bench._flagship_cfg()
         if remat is not None:
             cfg = dataclasses.replace(cfg, remat=remat)
+        row = bench.run_spmd_fused(cfg, batch, seq, args.steps,
+                                   f"long_context_mfu_t{seq}",
+                                   f"pure-bf16 seq {seq}")
+        print(json.dumps(row), flush=True)
+        return
+
+    # Orchestrator: one subprocess per config — every row gets a virgin
+    # heap (the axon allocator fragments across configs; the t16384 row
+    # does not survive any same-process predecessor) and a failing row
+    # cannot take the others down.
+    import subprocess
+
+    rows = []
+    for i in range(1, len(CONFIGS) + 1):
+        batch, seq, remat = CONFIGS[i - 1]
         t0 = time.time()
-        row = bench.run_spmd(cfg, batch, seq, args.steps,
-                             f"long_context_mfu_t{seq}",
-                             f"pure-bf16 seq {seq}")
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one",
+                 str(i), "--steps", str(args.steps)],
+                capture_output=True, text=True, timeout=540, check=True)
+            row = None
+            for line in reversed(out.stdout.strip().splitlines()):
+                try:
+                    row = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            if row is None:
+                raise RuntimeError(
+                    f"no row in child output: {out.stdout[-200:]!r}")
+        except Exception as e:  # noqa: BLE001 — keep the other rows
+            row = {"metric": f"long_context_mfu_t{seq}",
+                   "error": f"{type(e).__name__}: {str(e)[:200]}"}
         row["wall_s"] = round(time.time() - t0, 1)
         rows.append(row)
         print(json.dumps(row), flush=True)
     if args.out:
         payload = {
-            "note": "1.39B flagship, streamed flash kernels, one real "
-                    "chip. t8192/t16384 rows were scoped-VMEM compile "
-                    "errors before the r4 kernel streaming "
+            "note": "1.4B flagship, streamed flash kernels, one real "
+                    "chip; one subprocess per row (virgin heap). "
+                    "t8192/t16384 rows were scoped-VMEM compile errors "
+                    "before the r4 kernel streaming "
                     "(docs/benchmarks.md).",
             "rows": rows,
         }
