@@ -131,11 +131,16 @@ def _attend_step(x, lp, c, cache_k, cache_v, li, pos):
     """
     dt = c.compute_dtype
     b = x.shape[0]
+    # x is 2-D [B, D] through the layer: the [B, 1, D] singleton-dim
+    # form makes XLA pick {2,0,1}-style layouts for the residual/norm
+    # chains and pay a layout cast per op (~2 ms/step across 14 layers
+    # at flagship b64). The sequence dim reappears only at the
+    # attention/FFN boundaries that need it.
     positions = jnp.broadcast_to(pos, (b, 1))
     h = _rmsnorm(x, lp["attn_norm"].astype(dt), c.norm_eps)
     q = (h @ lp["wq"].astype(dt)).reshape(b, 1, c.n_heads, c.head_dim)
     q = _rope(q, positions, c.rope_theta)
-    k_new, v_new = _layer_kv(h, lp, c, positions)
+    k_new, v_new = _layer_kv(h[:, None, :], lp, c, positions)
     cache_k = lax.dynamic_update_slice(cache_k, k_new[None],
                                        (li, 0, pos, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, v_new[None],
@@ -143,9 +148,9 @@ def _attend_step(x, lp, c, cache_k, cache_v, li, pos):
     ck = lax.dynamic_index_in_dim(cache_k, li, 0, keepdims=False)
     cv = lax.dynamic_index_in_dim(cache_v, li, 0, keepdims=False)
     attn = _decode_attention(q, ck, cv, pos)
-    x = x + attn.reshape(b, 1, -1) @ lp["wo"].astype(dt)
+    x = x + attn.reshape(b, -1) @ lp["wo"].astype(dt)
     h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
-    x = x + _decode_ffn(h, lp, c)
+    x = x + _decode_ffn(h[:, None, :], lp, c)[:, 0, :]
     return x, cache_k, cache_v
 
 
@@ -208,7 +213,7 @@ def llama_generate(params, prompt, config, max_new_tokens,
     # token and emits the NEXT one; 'first' is prepended at the end) ---
     def step(carry, step_key):
         token, pos, cache_k, cache_v = carry
-        x = params["embed"].astype(dt)[token][:, None, :]  # [B,1,D]
+        x = params["embed"].astype(dt)[token]       # [B, D] (2-D!)
 
         def layer(lcarry, lp):
             x, ck, cv, li = lcarry
@@ -218,7 +223,7 @@ def llama_generate(params, prompt, config, max_new_tokens,
         (x, cache_k, cache_v, _), _ = lax.scan(
             layer, (x, cache_k, cache_v, jnp.int32(0)),
             params["layers"])
-        nxt = pick(logits_of(x)[:, 0, :], step_key)
+        nxt = pick(logits_of(x), step_key)
         return (nxt, pos + 1, cache_k, cache_v), nxt
 
     (_, _, _, _), toks = lax.scan(
