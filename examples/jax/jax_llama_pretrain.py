@@ -53,6 +53,11 @@ def main():
                          "numerically safe mixed-precision recipe")
     ap.add_argument("--ep", type=int, default=1, help="expert parallel")
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    ap.add_argument("--pp-schedule", default="gpipe",
+                    choices=("gpipe", "1f1b"),
+                    help="pipeline schedule: gpipe (AD backward, O(M) "
+                         "activation stash) or 1f1b (interleaved "
+                         "fwd/bwd, O(stages) stash)")
     ap.add_argument("--experts", type=int, default=0,
                     help="sparse-MoE experts (0 = dense FFN)")
     ap.add_argument("--seq-len", type=int, default=128)
@@ -88,6 +93,7 @@ def main():
         d_model=args.d_model, n_layers=n_layers, n_heads=heads,
         n_kv_heads=heads, d_ff=4 * args.d_model, vocab_size=512,
         n_experts=args.experts, seq_parallel=args.sp_mode,
+        pipeline_schedule=args.pp_schedule,
         param_dtype=args.param_dtype)
 
     if args.master_weights:
