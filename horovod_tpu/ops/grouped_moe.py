@@ -42,8 +42,16 @@ from jax.ad_checkpoint import checkpoint_name
 # Megablox tile sizes (m, k, n), clamped to the problem dims. Swept on
 # a v5e chip at bench shape (m=16K, D=2048, F=4096): large k/n tiles
 # beat the (128,128,128) default by ~2x; m=512 keeps the ragged group
-# boundaries cheap.
-_TILING = (512, 1024, 1024)
+# boundaries cheap. The three directions get INDEPENDENT tilings —
+# megablox's stock custom_vjp reuses the forward tiling for dlhs and
+# tgmm, so one direction's compiler ceiling caps all three. (On this
+# box every tile > 1024 in any direction crashes the AOT compile
+# helper, so all three sit at the shared optimum; the seam is for
+# standard libtpu stacks. Gradient parity with the stock VJP is pinned
+# on-chip — see docs/benchmarks.md.)
+_TILING = (512, 1024, 1024)          # forward gmm
+_TILING_DLHS = (512, 1024, 1024)     # backward dlhs gmm (transposed rhs)
+_TILING_TGMM = (512, 1024, 1024)     # backward dW tgmm
 
 
 def _on_tpu():
@@ -98,21 +106,53 @@ def _dispatch_gather_bwd(K, sorted_order, g):
 _dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
 
 
+def _clamp(tiling, m, k, n):
+    tm, tk, tn = tiling
+    return (min(tm, m), min(tk, k), min(tn, n))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _gmm_tpu(lhs, rhs, group_sizes):
+    from jax.experimental.pallas.ops.tpu.megablox.gmm import gmm
+
+    m, k = lhs.shape
+    n = rhs.shape[-1]
+    return gmm(lhs, rhs, group_sizes,
+               preferred_element_type=lhs.dtype,
+               tiling=_clamp(_TILING, m, k, n))
+
+
+def _gmm_tpu_fwd(lhs, rhs, group_sizes):
+    return _gmm_tpu(lhs, rhs, group_sizes), (lhs, rhs, group_sizes)
+
+
+def _gmm_tpu_bwd(res, grad):
+    # Same decomposition as megablox's stock VJP (ops.py), but each
+    # direction gets its own tiling: dlhs = grad @ rhs^T via gmm with
+    # transpose_rhs, dW via the transposed-lhs tgmm kernel.
+    from jax.experimental.pallas.ops.tpu.megablox.gmm import gmm, tgmm
+
+    lhs, rhs, group_sizes = res
+    m, k = lhs.shape
+    n = rhs.shape[-1]
+    dlhs = gmm(grad, rhs, group_sizes, lhs.dtype,
+               _clamp(_TILING_DLHS, m, k, n), transpose_rhs=True)
+    drhs = tgmm(lhs.swapaxes(0, 1), grad, group_sizes, rhs.dtype,
+                _clamp(_TILING_TGMM, m, k, n))
+    return dlhs, drhs, None
+
+
+_gmm_tpu.defvjp(_gmm_tpu_fwd, _gmm_tpu_bwd)
+
+
 def _grouped_mm(lhs, rhs, group_sizes):
     """Ragged grouped matmul: rows of ``lhs`` [M, K] are grouped
     contiguously per ``group_sizes`` [E]; ``rhs`` [E, K, N]. On TPU this
     is the megablox pallas kernel (dense-matmul throughput, f32
-    accumulation, custom VJP via the transposed kernel). Off-TPU tests
-    use an exact one-hot einsum (tiny shapes only)."""
+    accumulation) under our per-direction-tiling custom VJP. Off-TPU
+    tests use an exact one-hot einsum (tiny shapes only)."""
     if _on_tpu():
-        from jax.experimental.pallas.ops.tpu.megablox import gmm
-
-        m, k = lhs.shape
-        n = rhs.shape[-1]
-        tm, tk, tn = _TILING
-        tiling = (min(tm, m), min(tk, k), min(tn, n))
-        return gmm(lhs, rhs, group_sizes,
-                   preferred_element_type=lhs.dtype, tiling=tiling)
+        return _gmm_tpu(lhs, rhs, group_sizes)
     # Exact fallback: expert id per row from the group layout, then a
     # one-hot contraction (f32-exact; O(M*E*K*N) — test shapes only).
     eid = jnp.sum(jnp.arange(lhs.shape[0])[:, None]
