@@ -4,7 +4,7 @@ Net-new vs the reference (Horovod ships no inference path); TPU-first:
 one jitted program — prefill fills the cache with a single full-sequence
 pass, then ``lax.scan`` decodes token-by-token against a static-shaped
 cache (no dynamic shapes, no per-step retrace). The per-step attention
-is GQA-native (``_decode_attention``): grouped einsums read the cache
+is GQA-native (``_decode_attention``): the fused kernel reads the cache
 at its stored kv-head width, and slots past the current position mask
 themselves by global index.
 
@@ -89,42 +89,23 @@ def _layer_kv(h, lp, c, positions):
 
 
 def _decode_attention(q, cache_k, cache_v, pos):
-    """One-token attention against the cache, GQA-native.
-
-    q [B,1,H,D]; cache_k/v [B,S,Hkv,D]; slots <= pos are valid. The
-    grouped einsums index kv-heads directly — repeating the cache to H
-    query heads (what the generic blockwise path does) would stream an
-    n_rep× expanded copy of the cache through HBM per layer per step,
-    and decode is pure bandwidth: at batch 64 that repeat alone tripled
-    step time.
+    """One-token attention against the cache, GQA-native: the fused
+    pallas kernel on TPU (scores + masked softmax + PV folded into the
+    one pass that streams the cache — ops/decode_attention.py), the
+    same-recipe einsum chain elsewhere. Either way kv-heads are indexed
+    directly: repeating the cache to H query heads would stream an
+    n_rep× expanded copy through HBM per layer per step, and decode is
+    pure bandwidth (at batch 64 that repeat alone tripled step time).
     """
-    b, _, hq, d = q.shape
-    s_len, hkv = cache_k.shape[1], cache_k.shape[2]
-    n_rep = hq // hkv
-    qg = q.reshape(b, 1, hkv, n_rep, d)
-    # s: [B, G, R, S] logits per kv-head group; f32 softmax.
-    s = jnp.einsum("bqgrd,bsgd->bgrs", qg, cache_k,
-                   preferred_element_type=jnp.float32)
-    s = s * (d ** -0.5)
-    valid = jnp.arange(s_len) <= pos                  # [S]
-    s = jnp.where(valid[None, None, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    # The value contraction takes bf16 attention weights with f32
-    # accumulation — the EXACT recipe of the training flash kernel
-    # (ops/flash_attention.py casts p to v's dtype before the PV
-    # dot_general with preferred_element_type=f32), so decode matches
-    # training bit-for-bit-closer than an all-f32 PV would, and the
-    # [B,G,R,S] f32->bf16 halves the softmax chain's bandwidth
-    # (~0.5 ms/step at flagship b64).
-    out = jnp.einsum("bgrs,bsgd->bgrd", p.astype(cache_v.dtype),
-                     cache_v, preferred_element_type=jnp.float32)
-    return out.reshape(b, 1, hq, d).astype(q.dtype)
+    from horovod_tpu.ops.decode_attention import decode_attention
+
+    return decode_attention(q, cache_k, cache_v, pos)
 
 
 def _attend_step(x, lp, c, cache_k, cache_v, li, pos):
     """One decode-position layer step against the STACKED caches.
 
-    x [B,1,D]; cache_k/v [L,B,max_len,Hkv,hd] with positions < pos
+    x [B,D]; cache_k/v [L,B,Hkv,max_len,hd] with positions < pos
     valid; this step's k/v are written at (li, :, pos) before
     attending. The caches stay scan CARRIES and are updated by
     layer-indexed dynamic_update_slice — passing them as scanned
@@ -145,10 +126,12 @@ def _attend_step(x, lp, c, cache_k, cache_v, li, pos):
     q = (h @ lp["wq"].astype(dt)).reshape(b, 1, c.n_heads, c.head_dim)
     q = _rope(q, positions, c.rope_theta)
     k_new, v_new = _layer_kv(h[:, None, :], lp, c, positions)
-    cache_k = lax.dynamic_update_slice(cache_k, k_new[None],
-                                       (li, 0, pos, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, v_new[None],
-                                       (li, 0, pos, 0, 0))
+    # Caches live heads-major [L, B, Hkv, S, D] (the attention-kernel
+    # layout); the new token's [B, 1, Hkv, D] projects to [B, Hkv, 1, D].
+    cache_k = lax.dynamic_update_slice(
+        cache_k, k_new.transpose(0, 2, 1, 3)[None], (li, 0, 0, pos, 0))
+    cache_v = lax.dynamic_update_slice(
+        cache_v, v_new.transpose(0, 2, 1, 3)[None], (li, 0, 0, pos, 0))
     ck = lax.dynamic_index_in_dim(cache_k, li, 0, keepdims=False)
     cv = lax.dynamic_index_in_dim(cache_v, li, 0, keepdims=False)
     attn = _decode_attention(q, ck, cv, pos)
@@ -194,12 +177,16 @@ def llama_generate(params, prompt, config, max_new_tokens,
         h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
         x = x + _ffn(h, lp, c)
         # Cache padded to max_len so decode's dynamic_update_slice fits.
-        pad = jnp.zeros((b, max_new_tokens, c.n_kv_heads, c.head_dim), dt)
-        return x, (jnp.concatenate([k, pad], axis=1),
-                   jnp.concatenate([v, pad], axis=1))
+        # Heads-major cache layout [B, Hkv, max_len, hd] (the decode
+        # attention kernel's layout); one transpose per layer at
+        # prefill, never again.
+        pad = jnp.zeros((b, c.n_kv_heads, max_new_tokens, c.head_dim),
+                        dt)
+        return x, (jnp.concatenate([k.transpose(0, 2, 1, 3), pad], 2),
+                   jnp.concatenate([v.transpose(0, 2, 1, 3), pad], 2))
 
     x, (cache_k, cache_v) = lax.scan(prefill_layer, x, params["layers"])
-    # cache_k/v: [L, B, max_len, Hkv, hd]
+    # cache_k/v: [L, B, Hkv, max_len, hd]
 
     def logits_of(x_last):
         h = _rmsnorm(x_last, params["final_norm"].astype(dt), c.norm_eps)

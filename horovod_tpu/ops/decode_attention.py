@@ -1,0 +1,120 @@
+"""Fused single-position decode attention as a pallas TPU kernel.
+
+The XLA path (models/generate.py's einsum chain) materializes the f32
+score tensor [B, G, R, S], the softmax statistics, and the f32->bf16
+probability cast as separate HBM round-trips — ~0.07 ms/layer of pure
+bandwidth overhead on top of the KV-cache stream at flagship batch 64.
+This kernel folds scores + masked softmax + the value contraction into
+the one pass that streams the cache: grid (batch, kv-head group), each
+program loads its [S, D] K/V slices into VMEM (decode caches are
+short — S = prompt + max_new), computes the R grouped query rows
+against them, and writes [R, D] back. GQA-native like the rest of the
+stack: K/V are read at their stored head count.
+
+Same numeric recipe as the XLA path and the training flash kernel:
+f32 scores and softmax, bf16 probabilities into a f32-accumulated PV.
+Falls back to the einsum path off-TPU; interpret mode gives the kernel
+CPU test coverage (tests/single/test_decode_attention.py).
+
+Reference analog: none (Horovod ships no inference path).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+# Tests set this to run the kernel in interpret mode on CPU.
+_INTERPRET = False
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale):
+    # q [R, D]; k/v [S, D] — one (batch, kv-head) slice, fully resident
+    # in VMEM (decode S is prompt+max_new, ~hundreds). pos is an SMEM
+    # scalar: cache slots <= pos are valid.
+    q = q_ref[:, :]
+    k = k_ref[:, :]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    valid = lax.broadcasted_iota(jnp.int32, s.shape, 1) <= pos_ref[0]
+    s = jnp.where(valid, s, _NEG)
+    m = s.max(axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=1, keepdims=True)
+    p = (p / l).astype(v_ref.dtype)
+    o_ref[:, :] = jax.lax.dot_general(
+        p, v_ref[:, :], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def decode_attention(q, cache_k, cache_v, pos):
+    """One-token attention against the cache, GQA-native.
+
+    q [B, 1, H, D]; cache_k/v [B, Hkv, S, D] (kernel layout — heads
+    major, like the flash kernels, so the pallas block's trailing dims
+    are the contiguous [S, D] slice); slots <= pos valid.
+    Returns [B, 1, H, D] in q's dtype.
+    """
+    b, _, hq, d = q.shape
+    hkv, s_len = cache_k.shape[1], cache_k.shape[2]
+    n_rep = hq // hkv
+
+    # Each grid program holds its whole [S, D] K and V slices plus the
+    # f32 score rows in VMEM; past ~long-context cache lengths that
+    # exceeds the ~16 MB budget and the kernel cannot lower — fall back
+    # to the same-recipe einsum chain (slower per step, any S). Shapes
+    # are static, so this is a trace-time choice.
+    vmem_bytes = (2 * s_len * d * cache_k.dtype.itemsize  # K + V
+                  + n_rep * s_len * 4                     # f32 scores
+                  + 2 * n_rep * d * 4)                    # q + out
+    if vmem_bytes > 12 * (1 << 20):
+        return _decode_attention_xla(q, cache_k, cache_v, pos)
+
+    if not _INTERPRET and jax.devices()[0].platform not in ("tpu", "axon"):
+        return _decode_attention_xla(q, cache_k, cache_v, pos)
+
+    qg = q.reshape(b, hkv, n_rep, d)
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    kernel = functools.partial(_kernel, scale=d ** -0.5)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv),
+            in_specs=[
+                pl.BlockSpec((None, None, n_rep, d),
+                             lambda bi, gi, *a: (bi, gi, 0, 0)),
+                pl.BlockSpec((None, None, s_len, d),
+                             lambda bi, gi, *a: (bi, gi, 0, 0)),
+                pl.BlockSpec((None, None, s_len, d),
+                             lambda bi, gi, *a: (bi, gi, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, None, n_rep, d),
+                                   lambda bi, gi, *a: (bi, gi, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, n_rep, d), q.dtype),
+        interpret=_INTERPRET,
+    )(pos_arr, qg, cache_k, cache_v)
+    return out.reshape(b, 1, hq, d)
+
+
+def _decode_attention_xla(q, cache_k, cache_v, pos):
+    """Reference-math einsum chain (off-TPU fallback; same numerics).
+    cache_k/v in the [B, Hkv, S, D] kernel layout."""
+    b, _, hq, d = q.shape
+    hkv, s_len = cache_k.shape[1], cache_k.shape[2]
+    n_rep = hq // hkv
+    qg = q.reshape(b, hkv, n_rep, d)
+    s = jnp.einsum("bgrd,bgsd->bgrs", qg, cache_k,
+                   preferred_element_type=jnp.float32) * (d ** -0.5)
+    valid = jnp.arange(s_len) <= pos
+    s = jnp.where(valid[None, None, None, :], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bgrs,bgsd->bgrd", p.astype(cache_v.dtype),
+                     cache_v, preferred_element_type=jnp.float32)
+    return out.reshape(b, 1, hq, d).astype(q.dtype)

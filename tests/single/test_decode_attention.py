@@ -1,0 +1,90 @@
+"""Fused decode-attention kernel (interpret mode) vs the einsum path.
+
+The kernel and the XLA fallback must agree exactly in recipe (f32
+scores/softmax, bf16 p into f32-accumulated PV), so tolerances are
+tight; position masking and GQA grouping are the failure modes worth
+pinning.
+"""
+
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+da = importlib.import_module("horovod_tpu.ops.decode_attention")
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    da._INTERPRET = True
+    yield
+    da._INTERPRET = False
+
+
+@pytest.mark.parametrize("pos", [0, 3, 11])
+@pytest.mark.parametrize("n_rep", [1, 4])
+def test_kernel_matches_einsum(pos, n_rep):
+    B, S, HKV, D = 3, 12, 2, 8
+    H = HKV * n_rep
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
+    ck = jax.random.normal(ks[1], (B, HKV, S, D), jnp.float32)
+    cv = jax.random.normal(ks[2], (B, HKV, S, D), jnp.float32)
+    out = da.decode_attention(q, ck, cv, jnp.int32(pos))
+    ref = da._decode_attention_xla(q, ck, cv, jnp.int32(pos))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_position_mask_blocks_future_slots():
+    # Poison cache slots past pos with huge values: output must not move.
+    B, S, HKV, D = 1, 8, 1, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, 1, 2, D), jnp.float32)
+    ck = jax.random.normal(ks[1], (B, HKV, S, D), jnp.float32)
+    cv = jax.random.normal(ks[2], (B, HKV, S, D), jnp.float32)
+    pos = jnp.int32(4)
+    base = da.decode_attention(q, ck, cv, pos)
+    ck2 = ck.at[:, :, 5:].set(1e3)
+    cv2 = cv.at[:, :, 5:].set(1e3)
+    np.testing.assert_array_equal(
+        np.asarray(da.decode_attention(q, ck2, cv2, pos)),
+        np.asarray(base))
+
+
+def test_bf16_recipe_kernel_matches_einsum():
+    """bf16 caches drive the production recipe (bf16 p into f32
+    accumulation): kernel and einsum path must agree in bf16, where
+    the p-cast actually rounds."""
+    B, S, HKV, D, n_rep = 2, 16, 2, 8, 4
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, 1, HKV * n_rep, D), jnp.bfloat16)
+    ck = jax.random.normal(ks[1], (B, HKV, S, D), jnp.bfloat16)
+    cv = jax.random.normal(ks[2], (B, HKV, S, D), jnp.bfloat16)
+    out = da.decode_attention(q, ck, cv, jnp.int32(9))
+    ref = da._decode_attention_xla(q, ck, cv, jnp.int32(9))
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_long_cache_falls_back_to_einsum(monkeypatch):
+    """Past the VMEM budget the trace-time guard must route to the
+    einsum path instead of a pallas lowering failure."""
+    called = {}
+    real = da._decode_attention_xla
+
+    def spy(*a):
+        called["xla"] = True
+        return real(*a)
+
+    monkeypatch.setattr(da, "_decode_attention_xla", spy)
+    B, S, HKV, D = 1, 64 * 1024, 1, 128   # ~32 MB of K+V per program
+    q = jnp.zeros((B, 1, 2, D), jnp.bfloat16)
+    ck = jnp.zeros((B, HKV, S, D), jnp.bfloat16)
+    cv = jnp.zeros((B, HKV, S, D), jnp.bfloat16)
+    out = da.decode_attention(q, ck, cv, jnp.int32(5))
+    assert called.get("xla") and out.shape == (B, 1, 2, D)
