@@ -400,8 +400,9 @@ def _flagship_row():
 # The one bench shape (batch, seq, steps): main() AND the --quick
 # subprocess AND the fused fallback all read this constant, so the
 # headline row can never silently run at a different shape than the
-# comparison rows.
-_BENCH_SHAPE = (4, 2048, 10)
+# comparison rows. 15 steps (~8.5 s of stepping per row) tightens the
+# run-to-run spread the 10-step windows showed (±1.5%).
+_BENCH_SHAPE = (4, 2048, 15)
 
 _EXPECTED_PLAN = ("eager_flagship", "mixed_809m", "spmd_809m",
                   "spmd_flagship")
