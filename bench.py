@@ -25,9 +25,15 @@ the pure-bf16 flagship stays last):
 4. ``llama_train_step_mfu`` — the 1.43B pure-bf16 flagship, split
    grad/apply SPMD step. Measured FIRST in a fresh subprocess (virgin
    heap; see _flagship_row) but EMITTED last so the driver's tail-parse
-   gets the headline.
+   gets the headline. The subprocess measures BOTH optimizer-apply
+   formulations (optax split apply vs the single-pass
+   ``parallel.fused_adam``), records them in a ``llama_update_sweep``
+   row emitted just before the headline, and headlines the winner.
 
-``--mixed`` emits only row 1 (back-compat); ``--quick`` only row 4.
+``--mixed`` emits only row 1 (back-compat); ``--quick`` only the
+flagship rows; ``--sweep`` runs the on-chip tuning lane (remat
+save-set, flash block shapes, microbatch accumulation — see
+_run_sweep).
 """
 
 import functools
@@ -161,39 +167,35 @@ def _timed(step, carry, data, steps, what):
     return dt
 
 
-def run_spmd(cfg, batch, seq, steps, metric, label):
-    """Two-program train step: a grad jit then an optimizer-apply jit,
-    donated buffers. Splitting the adam update out of the grad program
-    measures ~3% FASTER than the single fused jit at flagship shape
-    (573 -> 552 ms, r5) — the fused program's interleaved update
-    schedules worse — so the split layout is the benchmark default;
-    it is also the same program structure the eager-Horovod row uses
-    minus the collective."""
-    tx = optax.adam(3e-4)
+def run_spmd(cfg, batch, seq, steps, metric, label, update="split",
+             microbatches=1):
+    """Split-program train step (``parallel.make_split_train_step``):
+    one jitted grad program — called once per microbatch, accumulating
+    into donated gradient buffers — and one jitted optimizer-apply
+    program. Splitting the adam update out of the grad program measures
+    ~3% FASTER than the single fused-into-grad jit at flagship shape
+    (573 -> 552 ms, r5) — the monolith's interleaved update schedules
+    worse — and it is the same program structure the eager-Horovod row
+    uses minus the collective.
+
+    ``update``: "split" = optax adam (updates tree + apply_updates, the
+    r5 baseline), "fused" = ``parallel.fused_adam`` (the whole update
+    as ONE elementwise pass per leaf — the r6 fewer-passes-over-params
+    attack on the adam HBM tail). ``--quick`` measures both and
+    headlines the winner (the ``llama_update_sweep`` row records the
+    comparison)."""
+    from horovod_tpu.parallel import fused_adam, make_split_train_step
+
+    tx = fused_adam(3e-4) if update == "fused" else optax.adam(3e-4)
 
     # n_params from shapes only — no device allocation.
     shapes = jax.eval_shape(lambda k: llama_init(cfg, k),
                            jax.random.PRNGKey(0))
     n_params = sum(x.size for x in jax.tree.leaves(shapes))
 
-    grad_fn = jax.jit(
-        lambda p, d: jax.value_and_grad(llama_loss)(p, d, cfg),
-        **_step_jit_kwargs())
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
-                       **_step_jit_kwargs())
-    def apply_fn(grads, params, opt):
-        updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), opt
-
-    def step(carry, data):
-        params, opt = carry
-        loss, grads = grad_fn(params, data)
-        return loss, apply_fn(grads, params, opt)
-
-    def make_carry():
-        params = llama_init(cfg, jax.random.PRNGKey(0))
-        return (params, tx.init(params))
+    ts = make_split_train_step(
+        lambda p, d: llama_loss(p, d, cfg), tx,
+        microbatches=microbatches, jit_kwargs=_step_jit_kwargs())
 
     # The initial carry is passed as a TEMPORARY on purpose: on the
     # axon transport a donated buffer is not returned to the heap while
@@ -201,8 +203,8 @@ def run_spmd(cfg, batch, seq, steps, metric, label):
     # copy is exactly what OOMs the split step at flagship scale
     # (empirically bisected r5 — the module-level form worked, the
     # caller-held form failed).
-    dt = _timed(step, make_carry(), _data(cfg, batch, seq), steps,
-                metric)
+    dt = _timed(ts.step, ts.init(llama_init(cfg, jax.random.PRNGKey(0))),
+                _data(cfg, batch, seq), steps, metric)
     return _mfu_row(metric, label, n_params, cfg, batch, seq, dt)
 
 
@@ -358,24 +360,58 @@ def full_run_plan(batch, seq, steps):
     ]
 
 
+def _quick_rows(batch, seq, steps):
+    """Flagship rows for the fresh-heap subprocess: measure the r5
+    split-apply baseline FIRST (known-good on a virgin heap), then the
+    single-pass fused-adam variant; yield a ``llama_update_sweep`` row
+    recording both, then the BETTER one as the headline (last line —
+    the driver tail-parses it)."""
+    base = run_spmd(_flagship_cfg(), batch, seq, steps,
+                    "llama_train_step_mfu", "pure-bf16")
+    fused = None
+    gc.collect()
+    try:
+        fused = run_spmd(_flagship_cfg(), batch, seq, steps,
+                         "llama_train_step_mfu", "pure-bf16 fused-adam",
+                         update="fused")
+    except Exception as e:  # noqa: BLE001 — the fused candidate runs on
+        # a non-virgin heap; any failure keeps the measured baseline.
+        print(f"fused-update flagship failed ({type(e).__name__}: {e}); "
+              f"keeping the split-apply row", file=sys.stderr)
+    sweep = {
+        "metric": "llama_update_sweep",
+        "update_split": base["value"],
+        "update_fused": fused["value"] if fused else None,
+        "unit": "MFU; optax split apply vs single-pass fused adam "
+                "(parallel.fused_adam), flagship shape",
+    }
+    best = base if fused is None or base["value"] >= fused["value"] \
+        else fused
+    return [sweep, best]
+
+
 def _flagship_row():
-    """The headline flagship row, measured in a FRESH SUBPROCESS
-    (`bench.py --quick`): the split grad/apply step needs a virgin HBM
-    heap — it OOMs both after three prior in-process configs AND in a
-    child racing a live parent client, so main() runs this BEFORE the
-    parent initializes its own TPU client, holds the row, and emits it
-    last (the driver tail-parses the final line). Falls back to the
-    in-process fused step (~3% slower, fragmentation-tolerant) if the
-    subprocess fails."""
+    """The headline flagship row (+ the update-sweep row riding along),
+    measured in a FRESH SUBPROCESS (`bench.py --quick`): the split
+    grad/apply step needs a virgin HBM heap — it OOMs both after three
+    prior in-process configs AND in a child racing a live parent
+    client, so main() runs this BEFORE the parent initializes its own
+    TPU client, holds the rows, and emits them last (headline as the
+    final line — the driver tail-parses it). Falls back to the
+    in-process monolithic-jit step (~3% slower, fragmentation-tolerant)
+    if the subprocess fails. Returns ``(headline_row, extra_rows)``."""
     import os
     import subprocess
 
     gc.collect()
     try:
+        # 1500 s: the child now compiles the flagship grad program for
+        # BOTH apply formulations (split then fused) before timing.
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--quick"],
-            capture_output=True, text=True, timeout=900, check=True)
-        for line in reversed(out.stdout.strip().splitlines()):
+            capture_output=True, text=True, timeout=1500, check=True)
+        headline, extras = None, []
+        for line in out.stdout.strip().splitlines():
             try:
                 row = json.loads(line)
             except json.JSONDecodeError:
@@ -385,16 +421,20 @@ def _flagship_row():
                     # metric if it lost the accelerator — a meaningless
                     # number that must not become the headline.
                     and "cpu smoke" not in row.get("unit", "")):
-                return row
-        raise RuntimeError(f"no flagship row in --quick output: "
-                           f"{out.stdout[-300:]!r}")
+                headline = row
+            elif row.get("metric") == "llama_update_sweep":
+                extras.append(row)
+        if headline is None:
+            raise RuntimeError(f"no flagship row in --quick output: "
+                               f"{out.stdout[-300:]!r}")
+        return headline, extras
     except Exception as e:  # noqa: BLE001 — subprocess/OOM/parse: any
-        # failure falls back to the fused in-process measurement.
+        # failure falls back to the monolithic in-process measurement.
         print(f"flagship subprocess failed ({type(e).__name__}: {e}); "
               f"falling back to the fused in-process step",
               file=sys.stderr)
         return run_spmd_fused(_flagship_cfg(), *_BENCH_SHAPE,
-                              "llama_train_step_mfu", "pure-bf16")
+                              "llama_train_step_mfu", "pure-bf16"), []
 
 
 # The one bench shape (batch, seq, steps): main() AND the --quick
@@ -450,6 +490,77 @@ def _smoke_row():
     return run_spmd(cfg, 2, 128, 3, "llama_train_step_mfu", "cpu smoke")
 
 
+def _sweep_points(batch):
+    """The --sweep point table: (name, config, run_spmd kwargs)."""
+    import dataclasses
+
+    fc = _flagship_cfg()
+    return [
+        ("update-split-b4", fc, dict()),
+        ("update-fused-b4", fc, dict(update="fused")),
+        # 2-way accumulation at 2x batch: same per-microbatch activation
+        # footprint as b4, double the tokens amortizing the apply pass.
+        ("fused-b8-accum2", fc,
+         dict(update="fused", microbatches=2, batch=2 * batch)),
+        ("remat-attn", dataclasses.replace(fc, remat="attn"), dict()),
+        # attn+gate+qkv exceeded HBM monolithically at b4 (r5); under
+        # 2-way accumulation the halved activation stash may fit.
+        ("remat-attn+gate+qkv-accum2",
+         dataclasses.replace(fc, remat="attn+gate+qkv"),
+         dict(update="fused", microbatches=2)),
+        ("flash-block-512", dataclasses.replace(fc, flash_block=512),
+         dict(update="fused")),
+        ("flash-block-2048", dataclasses.replace(fc, flash_block=2048),
+         dict(update="fused")),
+    ]
+
+
+def _run_sweep_point(name, batch, seq, steps, emit):
+    """Measure ONE sweep point in THIS process (`--sweep-point NAME`,
+    spawned by --sweep)."""
+    for pname, cfg, kw in _sweep_points(batch):
+        if pname == name:
+            b = kw.pop("batch", batch)
+            emit(run_spmd(cfg, b, seq, steps,
+                          f"llama_sweep_{name}", name, **kw))
+            return
+    raise SystemExit(f"unknown sweep point {name!r}")
+
+
+def _run_sweep(batch, seq, steps, emit):
+    """On-chip tuning lane (`bench.py --sweep`, NOT part of the driver
+    run): update formulation, microbatch accumulation, remat save-set,
+    and flash (qkv-attention) block shapes at the flagship geometry.
+    One JSON row per point, each measured in its OWN subprocess on a
+    virgin heap: an in-process try/except would let one point's OOM
+    fragment the device heap and poison every later measurement (the
+    r3/r5 RESOURCE_EXHAUSTED-with-zero-live-arrays trap), so a crashing
+    or hanging point yields an error row and the sweep continues."""
+    import os
+    import subprocess
+
+    for name, _cfg, _kw in _sweep_points(batch):
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--sweep-point", name],
+                capture_output=True, text=True, timeout=1500)
+            row = None
+            for line in out.stdout.strip().splitlines():
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+            if row is None or out.returncode != 0:
+                tail = (out.stderr or out.stdout).strip()[-300:]
+                row = {"metric": f"llama_sweep_{name}",
+                       "error": f"rc={out.returncode}: {tail}"}
+        except subprocess.TimeoutExpired:
+            row = {"metric": f"llama_sweep_{name}",
+                   "error": "HUNG: no result within 1500 s"}
+        emit(row)
+
+
 def main():
     argv = sys.argv[1:]
     batch, seq, steps = _BENCH_SHAPE
@@ -466,14 +577,29 @@ def main():
         if jax.devices()[0].platform == "cpu":
             emit(_smoke_row())
             return
-        emit(run_spmd(_flagship_cfg(), batch, seq, steps,
-                      "llama_train_step_mfu", "pure-bf16"))
+        for row in _quick_rows(batch, seq, steps):
+            emit(row)
         return
     if "--mixed" in argv:
         if jax.devices()[0].platform == "cpu":
             emit(_smoke_row())
             return
         emit(run_mixed(_same_size_cfg("float32"), batch, seq, steps))
+        return
+    if "--sweep-point" in argv:
+        if jax.devices()[0].platform == "cpu":
+            print("--sweep-point needs an accelerator; skipping",
+                  file=sys.stderr)
+            return
+        name = argv[argv.index("--sweep-point") + 1]
+        _run_sweep_point(name, batch, seq, steps, emit)
+        return
+    if "--sweep" in argv:
+        if _probe_platform() == "cpu":
+            print("--sweep needs an accelerator; skipping",
+                  file=sys.stderr)
+            return
+        _run_sweep(batch, seq, steps, emit)
         return
 
     # Platform probe runs out-of-process: the flagship row must be the
@@ -483,14 +609,17 @@ def main():
         emit(_smoke_row())
         return
 
-    flagship_row = _flagship_row()
+    flagship_row, flagship_extras = _flagship_row()
 
     plan = full_run_plan(batch, seq, steps)
     _check_plan_order(plan)
     for name, thunk in plan:
         if name == "spmd_flagship":
             # Measured first (subprocess, virgin heap), emitted last
-            # (the driver tail-parses the final line).
+            # (the driver tail-parses the final line); the update-sweep
+            # row measured alongside it lands just before.
+            for extra in flagship_extras:
+                emit(extra)
             emit(flagship_row)
         elif name == "eager_flagship":
             # Retries run OUTSIDE the except blocks — the live

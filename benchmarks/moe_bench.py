@@ -11,6 +11,21 @@ ACTIVE parameters — the standard sparse accounting (a routed token
 runs K of E experts, so its model FLOPs are 6·N_active, not
 6·N_total).
 
+Round-6 attack on the 0.55 wall: the default configuration is now the
+SPLIT-PROGRAM step (``parallel.make_split_train_step``) with
+``remat="moe"`` (backward re-runs NO grouped matmul) and 2-way
+microbatch gradient accumulation — the formulation r5 identified but
+could not run, because the same math as ONE monolithic jit crashes
+this environment's AOT compile helper (HTTP 500; see
+``benchmarks/aot_crash_repro.py``). The split step compiles the
+per-microbatch grad program and the single-pass fused-adam apply
+program separately and never hands the helper the
+full-save+microbatch monolith. If the attack config still fails here,
+this bench FAILS LOUDLY (nonzero rc) instead of silently skipping —
+the r5 silent-skip is what hid the blocker for a round. The r5
+configuration is reachable as
+``--remat attn+moe --microbatches 1 --update split``.
+
 Run on a real TPU chip::
 
     python benchmarks/moe_bench.py [--out results.json]
@@ -21,6 +36,7 @@ import json
 import os
 import sys
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -28,7 +44,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax
 
 
-def _moe_cfg():
+def _moe_cfg(remat="moe"):
     from horovod_tpu.models import LlamaConfig
 
     # Sized for one 16G chip in pure bf16 (params+grads+2 adam moments
@@ -36,16 +52,15 @@ def _moe_cfg():
     # while the parameter count stays flagship-class. The default
     # moe_impl="auto" resolves to the dropless grouped-GEMM dispatch
     # (ops/grouped_moe.py) on the single-chip program — no capacity
-    # padding, no one-hot dispatch einsums. remat="attn+moe"
-    # additionally saves the per-layer y_slots residual ([S*K, D] bf16)
-    # so backward skips the down-projection GEMM re-run, and
-    # scan_unroll turns the stacked expert-weight dynamic slices
-    # static (r5 sweep: 563 -> 495 ms/step all-in vs the r4 GShard
-    # path).
+    # padding, no one-hot dispatch einsums. remat="moe" saves the whole
+    # expert chain (x_sorted, pre-silu gate, up, y_slots) so backward
+    # re-runs NO grouped matmul; its HBM price is what the microbatch
+    # accumulation pays for. scan_unroll turns the stacked
+    # expert-weight dynamic slices static (r5 sweep: -24 ms/step).
     return LlamaConfig(vocab_size=32768, d_model=2048, n_layers=12,
                        n_heads=16, n_kv_heads=8, d_ff=4096,
                        n_experts=4, n_experts_per_token=2,
-                       dtype="bfloat16", remat="attn+moe",
+                       dtype="bfloat16", remat=remat,
                        param_dtype="bfloat16", scan_unroll=12)
 
 
@@ -65,42 +80,72 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--microbatches", type=int, default=2,
+                    help="grad-accumulation microbatches (2 = the r6 "
+                         "attack config; 1 = monolithic-batch grad "
+                         "program)")
+    ap.add_argument("--remat", default="moe",
+                    help="remat save-set (moe = r6 attack; attn+moe = "
+                         "the r5 configuration)")
+    ap.add_argument("--update", default="fused",
+                    choices=("fused", "split"),
+                    help="optimizer apply: single-pass fused adam vs "
+                         "optax split apply")
+    ap.add_argument("--batch", type=int, default=4)
     args = ap.parse_args()
 
-    import functools
-
-    import jax.numpy as jnp
     import optax
 
     import bench
     from horovod_tpu.models import llama_init, llama_loss
+    from horovod_tpu.parallel import fused_adam, make_split_train_step
 
     if jax.devices()[0].platform == "cpu":
         print("moe_bench needs an accelerator; skipping", file=sys.stderr)
         return
 
-    cfg = _moe_cfg()
-    batch, seq = 4, 2048
-    params = llama_init(cfg, jax.random.PRNGKey(0))
-    total, active = _active_params(params, cfg)
-    tx = optax.adam(3e-4)
-    carry = (params, tx.init(params))
-    del params
-
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(carry, data):
-        params, opt = carry
-        loss, grads = jax.value_and_grad(llama_loss)(params, data, cfg)
-        updates, opt = tx.update(grads, opt, params)
-        return loss, (optax.apply_updates(params, updates), opt)
+    cfg = _moe_cfg(args.remat)
+    batch, seq = args.batch, 2048
+    # Param counts from shapes only — no device allocation yet.
+    shapes = jax.eval_shape(lambda k: llama_init(cfg, k),
+                            jax.random.PRNGKey(0))
+    total, active = _active_params(shapes, cfg)
+    tx = (fused_adam(3e-4) if args.update == "fused"
+          else optax.adam(3e-4))
+    ts = make_split_train_step(
+        lambda p, d: llama_loss(p, d, cfg), tx,
+        microbatches=args.microbatches)
 
     t0 = time.time()
-    dt = bench._timed(step, carry, bench._data(cfg, batch, seq),
-                      args.steps, "moe_train_step_mfu")
+    try:
+        # Initial carry passed as a TEMPORARY (no caller-held reference
+        # to the donated buffers — the axon ghost-copy rule, see
+        # bench.run_spmd).
+        dt = bench._timed(ts.step,
+                          ts.init(llama_init(cfg, jax.random.PRNGKey(0))),
+                          bench._data(cfg, batch, seq),
+                          args.steps, "moe_train_step_mfu")
+    except Exception:
+        # LOUD failure (nonzero rc): r5's silent skip is what hid the
+        # AOT-helper blocker for a whole round. The traceback is the
+        # artifact; aot_crash_repro.py minimizes it.
+        traceback.print_exc()
+        print(
+            f"MOE BENCH FAILED: the attack config (split-program step, "
+            f"remat={args.remat!r}, {args.microbatches}-way microbatch "
+            f"accumulation, update={args.update!r}) did not complete. "
+            f"If this is the AOT compile helper crash (HTTP 500), "
+            f"reproduce/minimize with benchmarks/aot_crash_repro.py; "
+            f"the r5 configuration is `--remat attn+moe "
+            f"--microbatches 1 --update split` (0.494 active-MFU).",
+            file=sys.stderr)
+        sys.exit(2)
     row = bench._mfu_row(
         "moe_train_step_mfu",
         f"sparse MoE E{cfg.n_experts} top-{cfg.n_experts_per_token}, "
-        f"{total / 1e6:.0f}M total / {active / 1e6:.0f}M active",
+        f"{total / 1e6:.0f}M total / {active / 1e6:.0f}M active, "
+        f"remat={args.remat}, accum{args.microbatches}, "
+        f"update-{args.update}",
         active, cfg, batch, seq,
         dt)
     row["wall_s"] = round(time.time() - t0, 1)
@@ -110,9 +155,12 @@ def main():
             "note": "MoE decoder on one real chip; MFU counts ACTIVE "
                     "params (6*N_active + attention) per the standard "
                     "sparse accounting. Dropless sorted grouped-GEMM "
-                    "dispatch (megablox), remat=attn+moe, unrolled "
-                    "layer scan; every routed token-slot is computed "
-                    "(no capacity factor, no drops).",
+                    "dispatch (megablox), split-program train step "
+                    f"(remat={args.remat}, {args.microbatches}-way "
+                    "microbatch grad accumulation, "
+                    f"{args.update} adam apply); every routed "
+                    "token-slot is computed (no capacity factor, no "
+                    "drops).",
             "rows": [row],
         }
         with open(args.out, "w") as f:

@@ -61,6 +61,14 @@ std::string LocalAddress();
 // recv: cap == 0 -> block for the next message on (peer, tag), hold
 //   it, return its length; cap >= len -> copy the held (or next)
 //   message into buf, return its length. Negative on error.
+// Threading contract: the core invokes BOTH callbacks from its single
+//   background thread only — the control (tag 0) and data (tag 1)
+//   planes share one caller, and the two-phase recv (length probe,
+//   then copy-out) of one message is never interleaved with another
+//   call. Implementations may therefore keep per-transport state
+//   without synchronization; any future threaded data plane must
+//   revisit this clause (the python mpi4py transport guards its state
+//   with a lock regardless — common/mpi_bootstrap.py).
 typedef int (*ExternalSendFn)(int peer, int tag, const void* buf,
                               long long len);
 typedef long long (*ExternalRecvFn)(int peer, int tag, void* buf,

@@ -223,16 +223,28 @@ def _register_external_transport(comm):
     Contract (csrc/wire.h): send must be buffered/asynchronous (isend —
     a blocking ring send would deadlock); recv with cap==0 blocks for
     the next (peer, tag) message, holds it, and returns its length,
-    then a second call copies it out. Real-MPI caveat: the callbacks
-    run on the core's background thread, so the MPI library must
+    then a second call copies it out. The core invokes both callbacks
+    only from its single background thread (the wire.h contract), so
+    the shared state below (``held``, ``inflight``, the comm) needs no
+    synchronization TODAY. The lock converts the silent-corruption
+    failure mode of a contract violation (interleaved two-phase recv,
+    concurrent comm access from an MPI built without
+    MPI_THREAD_MULTIPLE) into a visible stall instead; it does NOT
+    make a threaded data plane safe — a second caller blocking on
+    ``_send`` while ``_recv`` holds the lock across a network wait is
+    a ring deadlock, which is why wire.h says a threaded plane must
+    revisit the contract (per-peer locks + a non-blocking probe), not
+    just rely on this lock. Real-MPI caveat: the MPI library must
     provide MPI_THREAD_MULTIPLE if the main thread also uses the comm
     after init (ours does not)."""
     import ctypes
+    import threading
 
     from horovod_tpu.common.basics import HorovodBasics
 
     held = {}           # (peer, tag) -> bytes, for two-phase recv
     inflight = []       # isend requests not yet completed
+    lock = threading.Lock()  # guards held/inflight/comm (see docstring)
 
     send_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, ctypes.c_int,
                               ctypes.c_void_p, ctypes.c_longlong)
@@ -243,10 +255,11 @@ def _register_external_transport(comm):
     def _send(peer, tag, buf, length):
         try:
             data = ctypes.string_at(buf, length) if length else b""
-            inflight.append(comm.isend(data, dest=peer, tag=tag))
-            # Opportunistic completion sweep keeps the request list
-            # bounded without ever blocking the sender.
-            inflight[:] = [r for r in inflight if not _done(r)]
+            with lock:
+                inflight.append(comm.isend(data, dest=peer, tag=tag))
+                # Opportunistic completion sweep keeps the request list
+                # bounded without ever blocking the sender.
+                inflight[:] = [r for r in inflight if not _done(r)]
             return 0
         except Exception:  # noqa: BLE001 — surfaces as a Status error
             return -1
@@ -261,19 +274,25 @@ def _register_external_transport(comm):
 
     def _recv(peer, tag, buf, cap):
         try:
-            key = (peer, tag)
-            msg = held.pop(key, None)
-            if msg is None:
-                msg = comm.recv(source=peer, tag=tag)
-            if cap == 0:
-                if msg:
-                    held[key] = msg   # empty messages need no phase 2
+            # The lock is held ACROSS the blocking comm.recv by design:
+            # serializing every comm access is what an MPI built
+            # without MPI_THREAD_MULTIPLE requires, and the cap==0 /
+            # copy-out phases of one message must not interleave with
+            # another caller's.
+            with lock:
+                key = (peer, tag)
+                msg = held.pop(key, None)
+                if msg is None:
+                    msg = comm.recv(source=peer, tag=tag)
+                if cap == 0:
+                    if msg:
+                        held[key] = msg  # empty msgs need no phase 2
+                    return len(msg)
+                if cap < len(msg):
+                    held[key] = msg
+                    return -2
+                ctypes.memmove(buf, msg, len(msg))
                 return len(msg)
-            if cap < len(msg):
-                held[key] = msg
-                return -2
-            ctypes.memmove(buf, msg, len(msg))
-            return len(msg)
         except Exception:  # noqa: BLE001
             return -1
 

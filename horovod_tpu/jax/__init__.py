@@ -75,6 +75,7 @@ from horovod_tpu.jax.mpi_ops import (  # noqa: F401
     synchronize,
 )
 from horovod_tpu.jax.optimizer import (  # noqa: F401
+    DistributedFusedAdam,
     DistributedGradientTransformation,
     DistributedOptimizer,
     allreduce_gradients,

@@ -94,3 +94,46 @@ def DistributedGradientTransformation(optimizer, op=mpi_ops.Average,
 
 # Reference-familiar name.
 DistributedOptimizer = DistributedGradientTransformation
+
+
+def DistributedFusedAdam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
+                         op=mpi_ops.Average,
+                         compression=Compression.none):
+    """Eager-Horovod counterpart of the single-pass fused update
+    (``parallel.precision.fused_adam``): allreduce the gradient pytree
+    across ranks (donated — the fused device program reuses the
+    gradients' HBM), then apply adam in ONE jitted pass over params
+    (no updates tree, no separate ``optax.apply_updates`` pass over
+    param-sized arrays).
+
+    Protocol matches ``FusedOptimizer`` (``init(params) -> state``,
+    ``apply(params, grads, state) -> (params, state)``) for use in an
+    eager step loop::
+
+        opt = hvd.DistributedFusedAdam(3e-4)
+        state = opt.init(params)
+        loss, grads = grad_fn(params, batch)        # jitted fwd+bwd
+        params, state = opt.apply(params, grads, state)
+
+    The allreduce is an eager collective (enqueue -> negotiate ->
+    cached device-program replay), so ``apply`` itself must stay
+    OUTSIDE jit; the update math runs as its own jitted program — the
+    same split-program layout ``bench.py``'s eager row measures.
+    """
+    from horovod_tpu.parallel.precision import FusedOptimizer, fused_adam
+
+    inner = fused_adam(learning_rate, b1=b1, b2=b2, eps=eps)
+
+    # Grads are NOT donated into the update jit: they arrive as
+    # donation-aliased outputs of the device-plane program and XLA
+    # refuses to re-donate an aliased buffer (see bench.py's eager
+    # apply_fn). params/state donation is what bounds the peak.
+    jitted_apply = jax.jit(inner.apply, donate_argnums=(0, 2))
+
+    def apply(params, grads, state):
+        grads = allreduce_gradients(grads, op=op,
+                                    compression=compression,
+                                    donate=True)
+        return jitted_apply(params, grads, state)
+
+    return FusedOptimizer(init=inner.init, apply=apply)

@@ -19,6 +19,17 @@ its training-time prefill semantics; its decode steps still use the
 drop-free top-k path (a single token never overflows capacity).
 Single-device or data-parallel batch — the sequence axis is not
 sharded at decode.
+
+Numerics (changed round 5): decode attention — both the fused pallas
+kernel and the einsum fallback — casts the softmaxed attention
+probabilities to bf16 before the PV contraction and accumulates in
+f32, matching the training flash kernel's recipe exactly. Round 4
+kept the probabilities f32 through PV; rounding them to bf16 can flip
+the greedy argmax when two next-token logits sit within rounding
+distance, so greedy output may differ from round-4 behavior at such
+near-ties. The two decode paths stay mutually consistent, and
+train/decode now share one numerics contract (see docs/benchmarks.md,
+"Decode numerics").
 """
 
 from functools import partial

@@ -75,6 +75,11 @@ class LlamaConfig:
     # stage, stash bounded by ~2S microbatch inputs — see
     # parallel.pipeline.one_f_one_b). Forward-only calls
     # (llama_forward) always use gpipe: 1F1B never materializes logits.
+    # Value-only llama_loss calls (eval loops, loss logging without
+    # grad) also run the gpipe forward + loss head under "1f1b" — the
+    # schedule's combined forward/backward computes every gradient just
+    # to discard them (~3x the needed work), so only
+    # jax.grad/value_and_grad engages it.
     pipeline_schedule: str = "gpipe"
     # Sequence-parallel strategy when the mesh's "seq" axis is
     # non-trivial: "ring" (K/V rotate via ppermute — any head count) or
@@ -88,6 +93,12 @@ class LlamaConfig:
     # time at bench shape) at the price of compile time and program
     # size. Leave 1 for multi-chip pipeline meshes.
     scan_unroll: int = 1
+    # Pallas flash-attention block size (both the q and k grid blocks;
+    # 0 = the kernel default, 1024 — the measured optimum of
+    # {256,512,1024,2048}² at t2048, docs/benchmarks.md r4). Exposed so
+    # bench.py --sweep can re-sweep the attention block shapes when the
+    # geometry moves; ring/ulysses SP paths keep their own defaults.
+    flash_block: int = 0
     # Parameter STORAGE dtype ("float32" default). "bfloat16" halves
     # parameter/gradient/optimizer-state HBM (pure-bf16 training, the
     # usual large-model recipe on TPU) — on one 16G chip it is what
@@ -230,7 +241,8 @@ def _rope(x, positions, theta):
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
 
 
-def _attention(q, k, v, mesh, seq_axis, seq_parallel="ring"):
+def _attention(q, k, v, mesh, seq_axis, seq_parallel="ring",
+               flash_block=0):
     # remat="attn" naming: the SP paths name their OUTPUT ("attn_out");
     # the flash path names its custom-VJP residuals internally
     # (flash_o/flash_lse) instead — naming the transposed output TOO
@@ -258,6 +270,9 @@ def _attention(q, k, v, mesh, seq_axis, seq_parallel="ring"):
     # fallback names its output attn_out.
     from horovod_tpu.ops import flash_attention
 
+    if flash_block:
+        return flash_attention(q, k, v, causal=True,
+                               block_q=flash_block, block_k=flash_block)
     return flash_attention(q, k, v, causal=True)
 
 
@@ -514,7 +529,8 @@ def _build_layer_body(c, mesh, seq_axis, constrain_acts=True):
                              "rope_k")
         vv = checkpoint_name(vv, "attn_v")
         # remat="attn" save-names applied inside _attention (per path).
-        attn = _attention(q, kk, vv, mesh, seq_axis, c.seq_parallel)
+        attn = _attention(q, kk, vv, mesh, seq_axis, c.seq_parallel,
+                          c.flash_block)
         x = x + constrain(attn.reshape(bb, tt, -1) @ lp["wo"].astype(dt))
 
         h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
@@ -709,11 +725,22 @@ def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
             aux_cotangent=aux_ct)
         return loss + aux_ct * aux, (d_sp, d_hp, d_xs, largs)
 
-    # Primal == fwd minus residuals, by construction (one definition,
-    # so the no-grad value can never diverge from the differentiated
-    # one).
-    schedule = jax.custom_vjp(
-        lambda sp, hp, xs, largs: schedule_fwd(sp, hp, xs, largs)[0])
+    def schedule_primal(sp, hp, xs, largs):
+        # VALUE-ONLY path (eval loops, loss logging): the gpipe forward
+        # plus the shared loss head. one_f_one_b computes every
+        # gradient to produce its value, so routing no-grad calls
+        # through it costs ~3x the needed work (ADVICE r5); under
+        # differentiation custom_vjp uses schedule_fwd instead. Same
+        # stage_fn, same loss_fn, same aux folding — equality of the
+        # two values is the gpipe-vs-1f1b loss identity
+        # tests/single/test_pipeline_1f1b.py pins.
+        from horovod_tpu.parallel.pipeline import gpipe
+
+        ys, aux_total = gpipe(stage_fn, sp, xs, mesh)
+        losses = jax.vmap(loss_fn, in_axes=(None, 0, 0))(hp, ys, largs)
+        return jnp.sum(losses) + aux_ct * aux_total
+
+    schedule = jax.custom_vjp(schedule_primal)
 
     def schedule_bwd(res, dl):
         import numpy as _np

@@ -111,6 +111,28 @@ def _clamp(tiling, m, k, n):
     return (min(tm, m), min(tk, k), min(tn, n))
 
 
+def _bwd_tilings(m, k, n):
+    """Per-direction backward tilings clamped against EACH matmul's own
+    (rows, contraction, out) dims — NOT the forward's (m, k, n).
+
+    - dlhs runs ``gmm(grad [m,n], rhs [E,k,n], transpose_rhs=True)``:
+      gmm reads its problem dims as (m, lhs.shape[1], rhs.shape[1]) =
+      (m, n, k) — contraction over n, output k;
+    - tgmm runs ``tgmm(lhs^T [k,m], grad [m,n])``: its (m, k, n) are
+      (lhs.shape[1], lhs.shape[0], rhs.shape[1]) = (m, k, n), which
+      COINCIDES with the forward dims (the contraction is over m, which
+      tm tiles).
+
+    Clamping dlhs against the forward dims handed it a tile larger than
+    its real contraction/output whenever k and n straddle the 1024 tile
+    boundary (d_model < 1024 <= d_ff — the gate/up projections'
+    backward; ADVICE r5). Shapes pinned by
+    tests/single/test_grouped_moe.py::test_bwd_tilings_clamp_per_direction.
+    """
+    return (_clamp(_TILING_DLHS, m, n, k),   # dlhs: (m, n, k)
+            _clamp(_TILING_TGMM, m, k, n))   # tgmm: (m, k, n)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=())
 def _gmm_tpu(lhs, rhs, group_sizes):
     from jax.experimental.pallas.ops.tpu.megablox.gmm import gmm
@@ -135,10 +157,11 @@ def _gmm_tpu_bwd(res, grad):
     lhs, rhs, group_sizes = res
     m, k = lhs.shape
     n = rhs.shape[-1]
+    dlhs_tiling, tgmm_tiling = _bwd_tilings(m, k, n)
     dlhs = gmm(grad, rhs, group_sizes, lhs.dtype,
-               _clamp(_TILING_DLHS, m, k, n), transpose_rhs=True)
+               dlhs_tiling, transpose_rhs=True)
     drhs = tgmm(lhs.swapaxes(0, 1), grad, group_sizes, rhs.dtype,
-                _clamp(_TILING_TGMM, m, k, n))
+                tgmm_tiling)
     return dlhs, drhs, None
 
 

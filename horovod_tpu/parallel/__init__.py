@@ -46,6 +46,15 @@ from horovod_tpu.parallel.sharding import (  # noqa: F401
     with_constraint,
 )
 from horovod_tpu.parallel.precision import (  # noqa: F401
+    FusedAdamState,
+    FusedMasterState,
+    FusedOptimizer,
     MasterWeightsState,
+    fused_adam,
+    fused_master_adam,
     master_weights,
+)
+from horovod_tpu.parallel.train_step import (  # noqa: F401
+    TrainStep,
+    make_split_train_step,
 )

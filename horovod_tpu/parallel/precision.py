@@ -1,4 +1,5 @@
-"""Mixed-precision training: fp32 master weights with bf16 compute.
+"""Mixed-precision training: fp32 master weights with bf16 compute,
+plus the single-pass FUSED optimizer-apply kernels.
 
 Reference analog: none in the core reference — upstream Horovod trains
 in the framework's fp32 and only compresses the wire
@@ -27,6 +28,25 @@ Usage::
         params = mw.compute_params(state)          # bf16 view
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         return loss, mw.apply(state, grads)
+
+Fused formulation (round 6): the optimizer update is the largest pure
+HBM-bandwidth tax left on the flagship step (the r5 MoE xplane puts the
+adam traffic on 1.49B carried params at ~25 ms/step — 7 passes over
+param-sized arrays). ``fused_adam`` expresses the whole update — moment
+updates, bias correction, parameter write — as ONE elementwise
+expression per leaf so XLA emits a single fused loop touching each
+param-sized array exactly once (4 reads, 3 writes — the adam minimum),
+instead of optax's chain of per-transformation trees (each a
+potentially materialized intermediate). ``fused_master_adam``
+additionally folds the master->compute cast into the same pass, so the
+split formulation's second read of the master tree
+(``apply`` then ``compute_params``) disappears. Both are
+drop-in ``FusedOptimizer`` objects for
+``parallel.train_step.make_split_train_step``; numerical equivalence
+to ``optax.adam`` / ``master_weights(optax.adam(...))`` at f32 is
+pinned by ``tests/single/test_llama.py`` (for bf16 params the fused
+kernels keep the update math in f32 where optax rounds per transform —
+see ``fused_adam``).
 """
 
 from typing import Any, NamedTuple
@@ -72,3 +92,145 @@ def master_weights(tx, compute_dtype=jnp.bfloat16,
 
     return MasterWeights(init=init, compute_params=compute_params,
                          apply=apply)
+
+
+# ---- fused single-pass optimizer apply -------------------------------
+
+class FusedAdamState(NamedTuple):
+    count: Any    # int32 scalar step counter
+    mu: Any       # first-moment pytree
+    nu: Any       # second-moment pytree
+
+
+class FusedOptimizer(NamedTuple):
+    """The optimizer protocol ``make_split_train_step`` recognizes as
+    fused: ``apply(params, grads, state) -> (new_params, new_state)``
+    produces the updated parameters DIRECTLY (no intermediate updates
+    tree, no separate ``optax.apply_updates`` pass)."""
+    init: Any
+    apply: Any
+
+
+def _adam_leaf(p, g, mu, nu, lr, b1, b2, eps, bc1, bc2, out_dtype):
+    """One parameter leaf's full adam step in f32, emitted as a single
+    elementwise expression so XLA fuses it into one pass."""
+    gf = g.astype(jnp.float32)
+    mu2 = b1 * mu.astype(jnp.float32) + (1.0 - b1) * gf
+    nu2 = b2 * nu.astype(jnp.float32) + (1.0 - b2) * jnp.square(gf)
+    update = lr * (mu2 / bc1) / (jnp.sqrt(nu2 / bc2) + eps)
+    p2 = (p.astype(jnp.float32) - update).astype(out_dtype)
+    return p2, mu2.astype(mu.dtype), nu2.astype(nu.dtype)
+
+
+def _bias_corrections(count, b1, b2):
+    cf = count.astype(jnp.float32)
+    return 1.0 - b1 ** cf, 1.0 - b2 ** cf
+
+
+def fused_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8):
+    """Single-pass adam: moments in the parameter dtype (matching
+    ``optax.adam``'s default ``mu_dtype``), all math in f32. For f32
+    params this is numerically equivalent to ``optax.adam`` (pinned by
+    ``tests/single/test_llama.py::test_fused_adam_matches_optax``).
+    For bf16 params (the pure-bf16 flagship) the two deliberately
+    differ: optax's chained transforms do moment arithmetic in the
+    bf16 gradient dtype, while this kernel computes every step in f32
+    and only rounds the STORED moments/params to bf16 — the same
+    optimizer to bf16 resolution, with strictly less rounding inside
+    the update math."""
+
+    def init(params):
+        return FusedAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params))
+
+    def apply(params, grads, state):
+        count = state.count + 1
+        bc1, bc2 = _bias_corrections(count, b1, b2)
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        out = [_adam_leaf(p, g, mu, nu, learning_rate, b1, b2, eps,
+                          bc1, bc2, p.dtype)
+               for p, g, mu, nu in zip(flat_p, flat_g, flat_mu, flat_nu)]
+        unflat = lambda i: jax.tree.unflatten(  # noqa: E731
+            treedef, [o[i] for o in out])
+        return unflat(0), FusedAdamState(count=count, mu=unflat(1),
+                                         nu=unflat(2))
+
+    return FusedOptimizer(init=init, apply=apply)
+
+
+class FusedMasterState(NamedTuple):
+    master: Any   # master-dtype (fp32) parameter pytree
+    count: Any
+    mu: Any       # f32 moments (the numerically safe recipe)
+    nu: Any
+
+
+class FusedMasterOptimizer(NamedTuple):
+    """FusedOptimizer protocol plus the initial-cast helper (the step
+    carry holds COMPUTE-dtype params; build it as
+    ``(opt.compute_params(state), state)`` after ``init``)."""
+    init: Any
+    apply: Any
+    compute_params: Any
+
+
+def fused_master_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
+                      compute_dtype=jnp.bfloat16,
+                      master_dtype=jnp.float32):
+    """Adam + master-weight cast in a SINGLE pass over params.
+
+    The split formulation (``master_weights(optax.adam(...))``) touches
+    every master-sized array twice per step: once in ``apply`` (update)
+    and once in ``compute_params`` (the bf16 cast the next forward
+    consumes). Here ``apply(params, grads, state)`` emits the new
+    master AND its compute-dtype cast from the same fused loop — one
+    read of the master tree per step instead of two. The ``params``
+    argument is the previous step's compute cast; its buffers are
+    donated back as the new cast's storage (it does not enter the
+    math). Returns ``(new_compute_params, new_state)`` — the
+    ``FusedOptimizer`` protocol, so it drops into
+    ``make_split_train_step`` unchanged.
+    """
+
+    def init(params):
+        # jnp.array (copy), NOT jnp.asarray: for params already in
+        # master_dtype asarray returns the SAME buffer, and the apply
+        # jits donate the state — an aliased master would invalidate
+        # the caller's params tree after the first step.
+        master = jax.tree.map(lambda p: jnp.array(p, master_dtype),
+                              params)
+        zeros = lambda t: jax.tree.map(jnp.zeros_like, t)  # noqa: E731
+        return FusedMasterState(master=master,
+                                count=jnp.zeros((), jnp.int32),
+                                mu=zeros(master), nu=zeros(master))
+
+    def compute_params(state):
+        return jax.tree.map(lambda p: p.astype(compute_dtype),
+                            state.master)
+
+    def apply(params, grads, state):
+        del params  # donated storage only; math reads the master
+        count = state.count + 1
+        bc1, bc2 = _bias_corrections(count, b1, b2)
+        flat_m, treedef = jax.tree.flatten(state.master)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        out = []
+        for m, g, mu, nu in zip(flat_m, flat_g, flat_mu, flat_nu):
+            m2, mu2, nu2 = _adam_leaf(m, g, mu, nu, learning_rate, b1,
+                                      b2, eps, bc1, bc2, m.dtype)
+            out.append((m2.astype(compute_dtype), m2, mu2, nu2))
+        unflat = lambda i: jax.tree.unflatten(  # noqa: E731
+            treedef, [o[i] for o in out])
+        state = FusedMasterState(master=unflat(1), count=count,
+                                 mu=unflat(2), nu=unflat(3))
+        return unflat(0), state
+
+    return FusedMasterOptimizer(init=init, apply=apply,
+                                compute_params=compute_params)

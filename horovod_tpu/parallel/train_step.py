@@ -1,0 +1,157 @@
+"""Split-program training step: grad and optimizer-apply as two jits.
+
+Why two programs instead of one fused train-step jit (measured, r5/r6):
+
+1. the split layout is FASTER at flagship shape — the fused program's
+   interleaved adam update schedules worse (573 -> 552 ms/step, r5);
+2. it is the formulation that dodges this environment's AOT-compile-
+   helper crash on the MoE config: ``remat="moe"`` + microbatch
+   gradient accumulation compiled as ONE monolithic jit crashes the
+   helper (HTTP 500 — see benchmarks/aot_crash_repro.py), while the
+   same math as a small grad program called N times plus a trivial
+   apply program compiles each piece separately and never hands the
+   helper the monolith;
+3. N-way microbatch gradient accumulation falls out naturally: the
+   grad program runs once per microbatch into a donated accumulator,
+   so per-microbatch activation memory is 1/N of the full batch — the
+   enabler for expensive remat save-sets (``remat="moe"``) at bench
+   sizes.
+
+The two programs are connected by DONATED gradient buffers: the first
+microbatch's gradient outputs become the accumulator, each accumulation
+step donates it forward, and the apply program donates it a final time
+(plus params and optimizer state), so exactly one params-sized gradient
+tree is live per step.
+
+Semantics: the per-microbatch loss is scaled by 1/N inside the grad
+program, so the accumulated gradients equal the full-batch mean-loss
+gradients and the accumulated loss equals the full-batch mean loss —
+bit-for-bit-ish equivalence with the monolithic jit is pinned by
+``tests/single/test_llama.py`` and the driver's ``dryrun_multichip``
+split-step pass. That identity requires the loss to be a per-example
+MEAN (linear in the batch axis). Batch-NONLINEAR terms become the
+mean of per-microbatch values instead of the full-batch value:
+
+- the MoE Switch aux loss (batch routing statistics) — the same
+  semantics the pipeline microbatch path already has (see
+  ``test_pipeline_with_moe``);
+- a MASKED mean ``sum(nll*mask)/sum(mask)`` whose token counts differ
+  across microbatches: each microbatch's masked mean gets weight 1/N
+  regardless of how many real tokens it holds. For exact equivalence
+  on padded batches, fold a GLOBAL denominator into ``loss_fn``
+  (compute ``sum(mask)`` over the full batch outside the step and
+  have ``loss_fn`` return ``sum(nll*mask)/global_denom``) — exactly
+  what the 1F1B schedule does with its loss numerator
+  (``models/llama.py``, "mask denominator is global across
+  microbatches").
+
+Reference analog: ``backward_passes_per_step`` local gradient
+aggregation (``horovod/tensorflow/gradient_aggregation.py``), re-founded
+as a program-structure choice instead of an optimizer wrapper.
+"""
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class TrainStep(NamedTuple):
+    init: Any   # init(params) -> carry
+    step: Any   # step(carry, batch) -> (loss, carry)
+
+
+def _split_microbatches(batch, n):
+    """Split every leaf of ``batch`` into ``n`` equal chunks along the
+    leading (batch) axis. Runs OUTSIDE jit — each chunk is then a
+    separate call to the grad program."""
+    leaves = jax.tree.leaves(batch)
+    if not leaves:
+        raise ValueError("empty batch")
+    b = leaves[0].shape[0]
+    if b % n:
+        raise ValueError(f"batch size {b} must divide into "
+                         f"{n} microbatches")
+    mb = b // n
+    return [jax.tree.map(lambda x: x[i * mb:(i + 1) * mb], batch)
+            for i in range(n)]
+
+
+def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
+                          jit_kwargs=None):
+    """Build the split-program step for ``loss_fn(params, batch)``.
+
+    ``optimizer`` is either an optax ``GradientTransformation``
+    (``init``/``update`` — the SPLIT apply: updates tree +
+    ``optax.apply_updates``) or a ``FusedOptimizer`` /
+    ``FusedMasterOptimizer`` from ``parallel.precision``
+    (``init``/``apply`` — the single-pass FUSED apply). For the master
+    variant the carry's params are the COMPUTE-dtype cast (built by
+    ``init``); the fp32 master lives inside the optimizer state.
+
+    Returns ``TrainStep(init, step)`` with
+    ``init(params) -> carry`` and ``step(carry, batch) -> (loss,
+    carry)``; ``jit_kwargs`` (e.g. TPU compiler options) apply to every
+    program.
+    """
+    jk = dict(jit_kwargs or {})
+    n = int(microbatches)
+    if n < 1:
+        raise ValueError(f"microbatches must be >= 1, got {microbatches}")
+    fused = hasattr(optimizer, "apply")
+
+    if fused:
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2), **jk)
+        def apply_fn(grads, params, opt):
+            return optimizer.apply(params, grads, opt)
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2), **jk)
+        def apply_fn(grads, params, opt):
+            import optax  # deferred: parallel/ imports without optax
+
+            updates, opt = optimizer.update(grads, opt, params)
+            return optax.apply_updates(params, updates), opt
+
+    if n == 1:
+        grad_fn = jax.jit(
+            lambda p, d: jax.value_and_grad(loss_fn)(p, d), **jk)
+
+        def step(carry, batch):
+            params, opt = carry
+            loss, grads = grad_fn(params, batch)
+            params, opt = apply_fn(grads, params, opt)
+            return loss, (params, opt)
+    else:
+        def scaled_loss(p, d):
+            # 1/N inside the grad program: accumulated grads == the
+            # full-batch mean-loss grads, accumulated loss == the
+            # full-batch mean loss — no extra scaling pass anywhere.
+            return loss_fn(p, d) / n
+
+        grad_first = jax.jit(
+            lambda p, d: jax.value_and_grad(scaled_loss)(p, d), **jk)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2), **jk)
+        def grad_acc(params, loss_acc, acc, d):
+            loss, g = jax.value_and_grad(scaled_loss)(params, d)
+            return loss_acc + loss, jax.tree.map(jnp.add, acc, g)
+
+        def step(carry, batch):
+            params, opt = carry
+            mbs = _split_microbatches(batch, n)
+            loss, grads = grad_first(params, mbs[0])
+            for mb in mbs[1:]:
+                loss, grads = grad_acc(params, loss, grads, mb)
+            params, opt = apply_fn(grads, params, opt)
+            return loss, (params, opt)
+
+    def init(params):
+        opt = optimizer.init(params)
+        if hasattr(optimizer, "compute_params"):
+            # Master-weights variant: the carry holds the compute cast;
+            # the fp32 master (inside ``opt``) owns the precision.
+            params = optimizer.compute_params(opt)
+        return (params, opt)
+
+    return TrainStep(init=init, step=step)

@@ -106,3 +106,30 @@ def test_llama_forward_grouped_impl_end_to_end():
     assert all(np.isfinite(np.asarray(g)).all() for g in flat)
     # The expert weights receive gradient (routing engaged).
     assert float(jnp.abs(grads["layers"]["moe_down"]).max()) > 0
+
+
+def test_bwd_tilings_clamp_per_direction():
+    """Each backward matmul's tiling clamps against ITS OWN problem
+    dims, not the forward's (ADVICE r5): the dlhs gmm (transpose_rhs)
+    reads its (m, contraction, out) as (m, n, k) — contraction over the
+    forward's OUTPUT dim n, output over the forward's contraction k —
+    while tgmm's dims coincide with the forward's (m, k, n)."""
+    from horovod_tpu.ops.grouped_moe import _bwd_tilings
+
+    # d_model(k)=512 < 1024 <= d_ff(n)=2048 — the straddling shape that
+    # mis-clamped before: the old forward-dims clamp gave dlhs a
+    # contraction tile of 512 (under its real 2048) and an output tile
+    # of 1024 (OVER its real 512-wide output).
+    dlhs, tgmm = _bwd_tilings(4096, 512, 2048)
+    assert dlhs == (512, 1024, 512), dlhs   # (m, n=2048->1024, k=512)
+    assert tgmm == (512, 512, 1024), tgmm   # (m, k=512, n=2048->1024)
+
+    # Small-everything shapes clamp every direction to the problem.
+    dlhs, tgmm = _bwd_tilings(256, 128, 64)
+    assert dlhs == (256, 64, 128), dlhs
+    assert tgmm == (256, 128, 64), tgmm
+
+    # Large square shapes sit at the swept optimum in all directions.
+    dlhs, tgmm = _bwd_tilings(16384, 2048, 4096)
+    assert dlhs == (512, 1024, 1024), dlhs
+    assert tgmm == (512, 1024, 1024), tgmm

@@ -86,8 +86,51 @@ def test_sharded_train_step_matches_single_device():
                                    atol=1e-6)
 
 
+def test_flash_block_is_pure_scheduling():
+    """LlamaConfig.flash_block (the bench --sweep knob for the pallas
+    q/k grid blocks) must not change the math: loss and grads match the
+    kernel-default config. Runs the REAL pallas kernels in interpret
+    mode (the XLA fallback ignores the block args, which would make
+    this test vacuous on CPU) — an oversized block exercises
+    _pick_block's clamp-to-sequence too."""
+    import dataclasses
+    import importlib
+
+    fa_mod = importlib.import_module("horovod_tpu.ops.flash_attention")
+
+    cfg = LlamaConfig.tiny(dtype="float32", n_layers=2, remat=False)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    fa_mod._INTERPRET = True
+    try:
+        ref_l, ref_g = jax.value_and_grad(llama_loss)(params, batch, cfg)
+        for block in (16, 512):  # clamped to t=32 / below it
+            cfg_b = dataclasses.replace(cfg, flash_block=block)
+            l, g = jax.value_and_grad(llama_loss)(params, batch, cfg_b)
+            np.testing.assert_allclose(float(l), float(ref_l),
+                                       rtol=1e-6)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5,
+                    atol=1e-6),
+                ref_g, g)
+    finally:
+        fa_mod._INTERPRET = False
+
+
+def _skip_without_shard_map():
+    # The ring/ulysses/pipeline paths build on jax.shard_map; older jax
+    # (< 0.6, e.g. a CPU-only dev box) only has the experimental alias.
+    if not hasattr(jax, "shard_map"):
+        import pytest
+        pytest.skip("needs jax.shard_map (jax >= 0.6)")
+
+
 def test_seq_parallel_forward_matches():
     """Ring-attention path (seq=4) must match the single-device forward."""
+    _skip_without_shard_map()
     cfg = LlamaConfig.tiny(dtype="float32", n_layers=2)
     params = llama_init(cfg, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 32), 0,
@@ -108,6 +151,7 @@ def test_seq_parallel_forward_matches():
 def test_seq_parallel_ulysses_matches():
     """Ulysses path (seq_parallel="ulysses", seq=4) must match the
     single-device forward (tiny config has 4 heads -> divisible)."""
+    _skip_without_shard_map()
     cfg = LlamaConfig.tiny(dtype="float32", n_layers=2,
                            seq_parallel="ulysses")
     params = llama_init(cfg, jax.random.PRNGKey(0))
@@ -215,6 +259,7 @@ def test_moe_expert_parallel_matches_single_device():
 # ---- pipeline parallelism (GPipe over the "pipe" axis) ----
 
 def _skip_unless_8():
+    _skip_without_shard_map()
     if len(jax.devices()) < 8:
         import pytest
         pytest.skip("needs 8 virtual devices")
@@ -441,6 +486,176 @@ def test_master_weights_tracks_fp32_training():
     # both optimize; final losses agree to bf16-forward tolerance
     assert ref[-1] < ref[0] and mixed[-1] < mixed[0]
     assert abs(ref[-1] - mixed[-1]) / abs(ref[-1]) < 0.05, (ref, mixed)
+
+
+# ---- split-program train step + fused optimizer apply (round 6) ----
+
+def _tiny_train_setup(batch_shape=(4, 16)):
+    cfg = LlamaConfig.tiny(dtype="float32", n_layers=2, remat=False)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), batch_shape, 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    return cfg, params, batch
+
+
+def _monolithic_step(cfg, tx, params, batch):
+    @jax.jit
+    def step(p, o, b):
+        loss, grads = jax.value_and_grad(llama_loss)(p, b, cfg)
+        updates, o = tx.update(grads, o, p)
+        return loss, optax.apply_updates(p, updates)
+
+    return step(params, tx.init(params), batch)
+
+
+def test_split_step_matches_monolithic():
+    """The two-program step (grad jit + apply jit, donated buffers)
+    must reproduce the single monolithic jit exactly: same loss, same
+    updated params. SGD so parameter deltas are linear in the gradient
+    (see test_sharded_train_step_matches_single_device)."""
+    from horovod_tpu.parallel import make_split_train_step
+
+    cfg, params, batch = _tiny_train_setup()
+    tx = optax.sgd(1e-1)
+    ref_loss, ref_params = _monolithic_step(cfg, tx, params, batch)
+
+    ts = make_split_train_step(
+        lambda p, d: llama_loss(p, d, cfg), tx)
+    loss, (p2, _) = ts.step(ts.init(params), batch)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        ref_params, p2)
+
+
+def test_split_step_2way_accumulation_matches_monolithic():
+    """2-way microbatch gradient accumulation (two sequential calls to
+    the grad program into a donated accumulator, 1/N loss scaling
+    inside the program) must equal the full-batch monolithic step to
+    f32 reduction-order tolerance — the pin that certifies the r6
+    MoE/flagship attack formulation computes the same math."""
+    from horovod_tpu.parallel import make_split_train_step
+
+    cfg, params, batch = _tiny_train_setup()
+    tx = optax.sgd(1e-1)
+    ref_loss, ref_params = _monolithic_step(cfg, tx, params, batch)
+
+    ts = make_split_train_step(
+        lambda p, d: llama_loss(p, d, cfg), tx, microbatches=2)
+    loss, (p2, _) = ts.step(ts.init(params), batch)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        ref_params, p2)
+
+
+def test_split_step_rejects_indivisible_microbatches():
+    import pytest
+
+    from horovod_tpu.parallel import make_split_train_step
+
+    cfg, params, batch = _tiny_train_setup(batch_shape=(4, 16))
+    ts = make_split_train_step(
+        lambda p, d: llama_loss(p, d, cfg), optax.sgd(1e-1),
+        microbatches=3)
+    with pytest.raises(ValueError, match="microbatches"):
+        ts.step(ts.init(params), batch)
+
+
+def test_fused_adam_matches_optax():
+    """The single-pass fused adam (parallel.fused_adam) is the same
+    optimizer as optax.adam — moments, bias correction, update — just
+    expressed as one fused elementwise pass per leaf. Multi-step so the
+    count/bias-correction trajectory is covered."""
+    from horovod_tpu.parallel import fused_adam
+
+    cfg, params, batch = _tiny_train_setup()
+    grads = jax.grad(llama_loss)(params, batch, cfg)
+
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    p_ref = params
+    fa = fused_adam(1e-2)
+    st = fa.init(params)
+    p_f = params
+    for _ in range(3):
+        updates, opt = tx.update(grads, opt, p_ref)
+        p_ref = optax.apply_updates(p_ref, updates)
+        p_f, st = fa.apply(p_f, grads, st)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        p_ref, p_f)
+    assert int(st.count) == 3
+
+
+def test_fused_master_adam_matches_split_master():
+    """fused_master_adam (adam + master cast in ONE pass) must track
+    the split formulation (master_weights(optax.adam) then
+    compute_params) exactly: same fp32 master trajectory, same bf16
+    compute cast; moments stay fp32."""
+    from horovod_tpu.parallel import fused_master_adam, master_weights
+
+    cfg, params, batch = _tiny_train_setup()
+    grads = jax.grad(llama_loss)(params, batch, cfg)
+
+    mw = master_weights(optax.adam(1e-2))
+    mw_state = mw.init(params)
+    fm = fused_master_adam(1e-2)
+    fm_state = fm.init(params)
+    compute = fm.compute_params(fm_state)
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(compute))
+    assert all(x.dtype == jnp.float32
+               for t in (fm_state.master, fm_state.mu, fm_state.nu)
+               for x in jax.tree.leaves(t))
+    for _ in range(3):
+        mw_state = mw.apply(mw_state, grads)
+        compute, fm_state = fm.apply(compute, grads, fm_state)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        mw_state.master, fm_state.master)
+    # The fused cast IS the fused master rounded to bf16, bitwise.
+    jax.tree.map(
+        lambda m, c: np.testing.assert_array_equal(
+            np.asarray(m.astype(jnp.bfloat16), dtype=np.float32),
+            np.asarray(c, dtype=np.float32)),
+        fm_state.master, compute)
+    # Across the two formulations the casts agree to bf16 resolution
+    # (masters within 1e-6 can round across a bf16 ULP boundary).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32),
+            np.asarray(b, dtype=np.float32), rtol=1e-2, atol=1e-3),
+        mw.compute_params(mw_state), compute)
+
+
+def test_split_step_with_fused_master_trains():
+    """End-to-end: split-program step + 2-way accumulation + the fused
+    master-adam apply optimizes (the carry holds the bf16 compute cast;
+    the fp32 master lives in the optimizer state)."""
+    from horovod_tpu.parallel import (
+        fused_master_adam,
+        make_split_train_step,
+    )
+
+    cfg, params, batch = _tiny_train_setup()
+    ts = make_split_train_step(
+        lambda p, d: llama_loss(p, d, cfg), fused_master_adam(1e-2),
+        microbatches=2)
+    carry = ts.init(params)
+    assert all(x.dtype == jnp.bfloat16
+               for x in jax.tree.leaves(carry[0]))
+    losses = []
+    for _ in range(6):
+        loss, carry = ts.step(carry, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
 
 
 def test_remat_modes_agree_on_gradients():

@@ -24,6 +24,10 @@ from horovod_tpu.parallel.sharding import apply_sharding, named_sharding
 
 
 def _skip_unless_8():
+    if not hasattr(jax, "shard_map"):
+        # gpipe/1F1B shard_map over the pipe axis; older jax (< 0.6,
+        # e.g. a CPU-only dev box) only has the experimental alias.
+        pytest.skip("needs jax.shard_map (jax >= 0.6)")
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
 
@@ -144,3 +148,26 @@ def test_unknown_pipeline_schedule_rejected():
     params, batch = _setup(cfg)
     with pytest.raises(ValueError, match="pipeline_schedule"):
         llama_loss(params, batch, cfg)
+
+
+def test_1f1b_value_only_routes_through_gpipe_and_matches():
+    """A no-grad llama_loss call under pipeline_schedule="1f1b" runs
+    the custom_vjp PRIMAL — the gpipe forward + loss head (one forward,
+    no gradients; ADVICE r5) — and its value must match the
+    differentiated path's loss."""
+    _skip_unless_8()
+    cfg = LlamaConfig.tiny(dtype="float32", n_layers=4, remat=False,
+                           pipeline_schedule="1f1b")
+    params, batch = _setup(cfg, with_mask=True)
+    mesh = parallel.create_mesh(pipe=2, fsdp=2, tensor=2,
+                                devices=jax.devices()[:8])
+    p_sh = apply_sharding(
+        params, parallel.shard_params(params, mesh,
+                                      llama_partition_rules(pipeline=True)))
+    b_sh = jax.device_put(
+        batch, named_sharding(mesh, ("data", "fsdp"), "seq"))
+    value_only = jax.jit(lambda p: llama_loss(p, b_sh, cfg, mesh))(p_sh)
+    grad_loss, _ = jax.jit(jax.value_and_grad(
+        lambda p: llama_loss(p, b_sh, cfg, mesh)))(p_sh)
+    np.testing.assert_allclose(float(value_only), float(grad_loss),
+                               rtol=1e-5)
