@@ -264,16 +264,11 @@ def run_mixed(cfg, batch, seq, steps):
                     seq, dt)
 
 
-def make_eager_step(cfg):
-    """Eager-Horovod step builder, shared with
-    benchmarks/autotune_bench.py (hvd must already be initialized):
-    jitted grad program, ``hvd.grouped_allreduce`` of the gradient tree
-    over the device plane, jitted adam apply. Returns
-    ``(step, (params, opt), n_params)`` with
-    ``step(carry, data) -> (loss, carry)``."""
-    import horovod_tpu.jax as hvd
-    from horovod_tpu.jax.optimizer import allreduce_gradients
-
+def _eager_parts(cfg):
+    """Shared scaffolding for the eager step builders: committed
+    params/opt, the jitted grad program, and the params/opt-donating
+    adam apply program. ONE copy so the grouped and ungrouped lanes can
+    only ever differ by their allreduce granularity."""
     # COMMITTED to the device from the start: the data plane's staging
     # device_put commits the gradients, so apply_fn outputs would flip
     # params from uncommitted to committed after step one — a new jit
@@ -299,6 +294,21 @@ def make_eager_step(cfg):
         updates, opt = tx.update(grads, opt, params)
         return optax.apply_updates(params, updates), opt
 
+    return (params, opt), n_params, grad_fn, apply_fn
+
+
+def make_eager_step(cfg):
+    """Eager-Horovod step builder, shared with
+    benchmarks/autotune_bench.py (hvd must already be initialized):
+    jitted grad program, ``hvd.grouped_allreduce`` of the gradient tree
+    over the device plane, jitted adam apply. Returns
+    ``(step, (params, opt), n_params)`` with
+    ``step(carry, data) -> (loss, carry)``."""
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax.optimizer import allreduce_gradients
+
+    carry0, n_params, grad_fn, apply_fn = _eager_parts(cfg)
+
     def step(carry, data):
         params, opt = carry
         loss, grads = grad_fn(params, data)
@@ -307,7 +317,58 @@ def make_eager_step(cfg):
         params, opt = apply_fn(grads, params, opt)
         return loss, (params, opt)
 
-    return step, (params, opt), n_params
+    return step, carry0, n_params
+
+
+def make_eager_ungrouped_step(cfg):
+    """UNGROUPED per-parameter eager step: every gradient is enqueued
+    as its OWN allreduce — layer-stacked leaves are unstacked into
+    per-layer tensors first, the granularity a per-parameter framework
+    hands Horovod (183 small allreduces/step at the 809M 20-layer
+    geometry) — so the core's fusion threshold and cycle time genuinely
+    bind: the background loop must re-batch the flood of small tensors
+    into fused buffers every cycle. This is the workload
+    ``benchmarks/autotune_bench.py --ungrouped`` tunes (VERDICT r5 #4:
+    the grouped row was a null because one pre-grouped allreduce leaves
+    the knobs nothing to do). Returns ``(step, carry, n_params)`` like
+    :func:`make_eager_step`."""
+    import horovod_tpu.jax as hvd
+
+    carry0, n_params, grad_fn, apply_fn = _eager_parts(cfg)
+
+    def step(carry, data):
+        params, opt = carry
+        loss, grads = grad_fn(params, data)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        handles, rebuild = [], []
+        for i, (path, leaf) in enumerate(flat):
+            stacked = "layers" in jax.tree_util.keystr(path)
+            if stacked:
+                # one allreduce PER LAYER, as a per-parameter frontend
+                # would issue them (stable names keep the response
+                # cache hot across steps)
+                hs = [hvd.allreduce_async(leaf[j], name=f"ug{i}.{j}",
+                                          op=hvd.Average)
+                      for j in range(leaf.shape[0])]
+                handles.extend(hs)
+                rebuild.append((True, len(hs)))
+            else:
+                handles.append(hvd.allreduce_async(
+                    leaf, name=f"ug{i}", op=hvd.Average))
+                rebuild.append((False, 1))
+        outs = [h.synchronize() for h in handles]
+        leaves, k = [], 0
+        for stacked, n in rebuild:
+            if stacked:
+                leaves.append(jnp.stack(outs[k:k + n]))
+            else:
+                leaves.append(outs[k])
+            k += n
+        grads = jax.tree.unflatten(treedef, leaves)
+        params, opt = apply_fn(grads, params, opt)
+        return loss, (params, opt)
+
+    return step, carry0, n_params
 
 
 def run_eager(cfg, batch, seq, steps, label):
@@ -498,10 +559,13 @@ def _sweep_points(batch):
     return [
         ("update-split-b4", fc, dict()),
         ("update-fused-b4", fc, dict(update="fused")),
-        # 2-way accumulation at 2x batch: same per-microbatch activation
-        # footprint as b4, double the tokens amortizing the apply pass.
+        # Microbatch-accumulation lane: N-way accumulation at N-x batch
+        # keeps the per-microbatch activation footprint of b4 while
+        # amortizing the optimizer-apply pass over more tokens.
         ("fused-b8-accum2", fc,
          dict(update="fused", microbatches=2, batch=2 * batch)),
+        ("fused-b16-accum4", fc,
+         dict(update="fused", microbatches=4, batch=4 * batch)),
         ("remat-attn", dataclasses.replace(fc, remat="attn"), dict()),
         # attn+gate+qkv exceeded HBM monolithically at b4 (r5); under
         # 2-way accumulation the halved activation stash may fit.
@@ -515,14 +579,59 @@ def _sweep_points(batch):
     ]
 
 
+def _bubble_rows(S=4, microbatches=(8, 16), virtual=(1, 2, 4)):
+    """One JSON row per (schedule, V, accum) pipeline point — the
+    schedule-derived bubble fraction at ``S`` stages, straight from the
+    slot tables the implementation executes, so the driver's bench
+    capture can diff schedules without parsing prose. Pure host math:
+    emitted by --sweep on ANY substrate (a single chip cannot raise a
+    pipe axis, so these are the pipeline lane's portable numbers; the
+    gradient equivalence behind them is pinned by
+    tests/single/test_pipeline_interleaved.py).
+
+    gpipe / lockstep-1f1b use their closed forms (in fwd+bwd subtick
+    units, matching the interleaved engine's accounting); interleaved
+    rows come from parallel.pipeline.build_interleaved_schedule.
+    """
+    from horovod_tpu.parallel.pipeline import build_interleaved_schedule
+
+    rows = []
+
+    def row(schedule, V, M, bubble, slots):
+        return {
+            "metric": "pipeline_bubble",
+            "schedule": schedule, "V": V, "accum": M, "S": S,
+            "slots": slots, "value": round(bubble, 4),
+            "unit": f"idle fraction of fwd+bwd subticks, S={S} stages, "
+                    f"M={M} microbatches, V={V} virtual chunks/device",
+        }
+
+    for M in microbatches:
+        rows.append(row("gpipe", 1, M,
+                        2 * (S - 1) / (2 * M + 2 * (S - 1)),
+                        2 * (M + S - 1)))
+        rows.append(row("1f1b", 1, M,
+                        2 * (S - 1) / (M + 2 * (S - 1)),
+                        2 * (M + 2 * (S - 1))))
+        for V in virtual:
+            s = build_interleaved_schedule(S, V, M)
+            rows.append(row("interleaved_1f1b", V, M,
+                            s.bubble_fraction, s.n_slots))
+    return rows
+
+
 def _run_sweep_point(name, batch, seq, steps, emit):
     """Measure ONE sweep point in THIS process (`--sweep-point NAME`,
-    spawned by --sweep)."""
+    spawned by --sweep). Every row carries explicit (schedule, V,
+    accum) fields so schedule diffs are machine-readable."""
     for pname, cfg, kw in _sweep_points(batch):
         if pname == name:
             b = kw.pop("batch", batch)
-            emit(run_spmd(cfg, b, seq, steps,
-                          f"llama_sweep_{name}", name, **kw))
+            row = run_spmd(cfg, b, seq, steps,
+                           f"llama_sweep_{name}", name, **kw)
+            row.update(schedule="none", V=1,
+                       accum=kw.get("microbatches", 1))
+            emit(row)
             return
     raise SystemExit(f"unknown sweep point {name!r}")
 
@@ -595,9 +704,13 @@ def main():
         _run_sweep_point(name, batch, seq, steps, emit)
         return
     if "--sweep" in argv:
+        # Pipeline (schedule, V, accum) bubble rows are host math —
+        # emitted on every substrate, before the measured lane.
+        for row in _bubble_rows():
+            emit(row)
         if _probe_platform() == "cpu":
-            print("--sweep needs an accelerator; skipping",
-                  file=sys.stderr)
+            print("--sweep: no accelerator; emitted the schedule-"
+                  "derived pipeline rows only", file=sys.stderr)
             return
         _run_sweep(batch, seq, steps, emit)
         return

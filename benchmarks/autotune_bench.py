@@ -6,9 +6,7 @@ scoring realized bytes/sec; ours does the same with a Bayesian
 optimizer over the (fusion_threshold, cycle_time) grid
 (``csrc/parameter_manager.cc`` + ``csrc/bayes_opt.cc``).
 
-This benchmark runs the EAGER flagship training loop (the same
-grad -> hvd.grouped_allreduce -> adam shape as bench.py's eager row)
-twice in one process on the real chip:
+This benchmark runs an EAGER training loop twice in one process:
 
 1. autotune OFF, default knobs — baseline ms/step;
 2. shutdown, re-init with ``HOROVOD_AUTOTUNE=1`` +
@@ -16,12 +14,25 @@ twice in one process on the real chip:
    log stops changing knobs), then time steps at the converged
    operating point.
 
-Emits JSON rows and writes ``results_r05_autotune.json`` with the
-warmup->converged knob trajectory parsed from the autotune log.
+Two lanes:
 
-Run on a real TPU chip::
+- default: the GROUPED flagship row (one pre-grouped allreduce/step —
+  bench.make_eager_step). r5 proved this a null result: with one
+  fused tensor per step the fusion threshold has nothing to fuse.
+- ``--ungrouped``: the per-parameter row (bench.
+  make_eager_ungrouped_step — 183 small allreduces/step at the 809M
+  20-layer geometry), where the fusion buffer and cycle time genuinely
+  bind and the tuner has a number to move (VERDICT r5 #4). Unlike the
+  grouped lane this one also runs on a CPU-only box: the knobs govern
+  the CONTROL plane (enqueue batching, negotiation cadence), which the
+  native core runs identically there — the row is labeled with its
+  substrate either way.
 
-    python benchmarks/autotune_bench.py [--out results.json]
+Emits JSON rows and writes ``--out`` (e.g.
+``benchmarks/results_r06_autotune.json``) with the warmup->converged
+knob trajectory parsed from the autotune log::
+
+    python benchmarks/autotune_bench.py --ungrouped [--out results.json]
 """
 
 import argparse
@@ -38,10 +49,11 @@ import jax
 import jax.numpy as jnp
 
 
-def _eager_loop(cfg, batch, seq, steps, warmup):
+def _eager_loop(cfg, batch, seq, steps, warmup, make_step=None):
     """One eager-Horovod training run (bench.make_eager_step — the
-    SAME step the eager bench row times); returns mean ms/step over
-    the last ``steps`` steps (after ``warmup``)."""
+    SAME step the eager bench row times — or any other builder, e.g.
+    the ungrouped per-grad one); returns mean ms/step over the last
+    ``steps`` steps (after ``warmup``)."""
     import numpy as np
 
     import bench
@@ -54,7 +66,7 @@ def _eager_loop(cfg, batch, seq, steps, warmup):
 
     data = bench._data(cfg, batch, seq)
     try:
-        step, carry, _ = bench.make_eager_step(cfg)
+        step, carry, _ = (make_step or bench.make_eager_step)(cfg)
         loss, carry = step(carry, data)
         np.asarray(loss)
         for i in range(warmup):
@@ -107,37 +119,88 @@ def main():
     # after 20 samples (HOROVOD_AUTOTUNE_STEPS), so the tuning phase
     # needs ~20 x 5 s / step-time steps before the timed window.
     ap.add_argument("--tune-steps", type=int, default=200)
+    ap.add_argument("--ungrouped", action="store_true",
+                    help="per-parameter allreduces (183 small tensors/"
+                         "step) instead of one grouped tree — the "
+                         "workload where fusion/cycle knobs bind")
+    # Scored windows before the tuner fixes its knobs
+    # (HOROVOD_AUTOTUNE_STEPS; core default 20). The ungrouped lane's
+    # windows span ~one step each (kMinWindowBytes closes fast on many
+    # small tensors), so per-window scores are noisy and the Bayesian
+    # optimizer wants more samples than the grouped lane needed.
+    ap.add_argument("--autotune-steps", type=int, default=None)
     args = ap.parse_args()
 
     import bench
+    from horovod_tpu.models import LlamaConfig
 
-    if jax.devices()[0].platform == "cpu":
-        print("autotune_bench needs an accelerator; skipping",
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu and not args.ungrouped:
+        print("the grouped autotune lane needs an accelerator "
+              "(use --ungrouped for the control-plane lane); skipping",
               file=sys.stderr)
         return
 
-    cfg = bench._flagship_cfg()
-    batch, seq = 4, 2048
+    if args.ungrouped:
+        make_step = bench.make_eager_ungrouped_step
+        if on_cpu:
+            # Same 183-allreduce CONTROL-plane shape (9 stacked leaves
+            # x 20 layers + 3), toy payloads: the fusion/cycle knobs
+            # act on enqueue batching and negotiation cadence, which
+            # the core runs identically on the CPU substrate.
+            cfg = LlamaConfig.tiny(n_layers=20, dtype="float32")
+            batch, seq = 2, 64
+            lane = "ungrouped-per-grad (tiny model, cpu control-plane)"
+        else:
+            cfg = bench._same_size_cfg("bfloat16")   # 809M, 20 layers
+            batch, seq = 4, 2048
+            lane = "ungrouped-per-grad 809M"
+        # Bursty per-grad traffic needs score windows spanning SEVERAL
+        # steps (one gradient tree of bytes per step), or per-window
+        # bytes/sec is dominated by where the window boundary lands in
+        # the compute/allreduce burst cycle — set the floor to ~6 steps
+        # of gradient bytes.
+        import jax as _jax
+
+        from horovod_tpu.models import llama_init
+        shapes = _jax.eval_shape(
+            lambda k: llama_init(cfg, k), _jax.random.PRNGKey(0))
+        step_bytes = sum(x.size * x.dtype.itemsize
+                         for x in _jax.tree.leaves(shapes))
+        os.environ["HOROVOD_AUTOTUNE_WINDOW_BYTES"] = str(6 * step_bytes)
+        os.environ["HOROVOD_AUTOTUNE_WINDOW_CYCLES"] = "40"
+    else:
+        make_step = None
+        cfg = bench._flagship_cfg()
+        batch, seq = 4, 2048
+        lane = "grouped flagship"
+
     log_path = "/tmp/hvdtpu_autotune.csv"
 
     for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_LOG"):
         os.environ.pop(k, None)
-    dt_off = _eager_loop(cfg, batch, seq, args.steps, warmup=3)
+    dt_off = _eager_loop(cfg, batch, seq, args.steps, warmup=3,
+                         make_step=make_step)
 
     os.environ["HOROVOD_AUTOTUNE"] = "1"
     os.environ["HOROVOD_AUTOTUNE_LOG"] = log_path
+    if args.autotune_steps:
+        os.environ["HOROVOD_AUTOTUNE_STEPS"] = str(args.autotune_steps)
     try:
         dt_on = _eager_loop(cfg, batch, seq, args.steps,
-                            warmup=args.tune_steps)
+                            warmup=args.tune_steps, make_step=make_step)
     finally:
-        for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_LOG"):
+        for k in ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_LOG",
+                  "HOROVOD_AUTOTUNE_STEPS",
+                  "HOROVOD_AUTOTUNE_WINDOW_BYTES",
+                  "HOROVOD_AUTOTUNE_WINDOW_CYCLES"):
             os.environ.pop(k, None)
 
     trajectory, converged = _parse_log(log_path)
     row = {
         "metric": "autotune_eager_step_ms",
         "value": round(dt_on * 1e3, 2),
-        "unit": (f"ms/step eager flagship at converged knobs "
+        "unit": (f"ms/step eager {lane} at converged knobs "
                  f"(default knobs: {dt_off * 1e3:.2f} ms/step; "
                  f"converged: {converged}; "
                  f"{len(trajectory)} scored windows, "
@@ -147,13 +210,16 @@ def main():
     print(json.dumps(row), flush=True)
     if args.out:
         payload = {
-            "note": "HOROVOD_AUTOTUNE=1 over the eager flagship "
-                    "training loop on one real chip (size-1 device "
-                    "plane). vs_baseline = default-knob step time / "
-                    "converged-knob step time (>1 means the tuner "
-                    "helped). Trajectory = every scored "
+            "note": f"HOROVOD_AUTOTUNE=1 over the eager {lane} "
+                    "training loop (size-1 data plane: the knobs "
+                    "govern the core's enqueue->negotiate->fuse "
+                    "control path). vs_baseline = default-knob step "
+                    "time / converged-knob step time (>1 means the "
+                    "tuner helped). Trajectory = every scored "
                     "(fusion, cycle, bytes/sec) window from "
                     "HOROVOD_AUTOTUNE_LOG, in order.",
+            "lane": lane,
+            "substrate": str(jax.devices()[0].device_kind),
             "default_step_ms": round(dt_off * 1e3, 2),
             "converged_step_ms": round(dt_on * 1e3, 2),
             "converged_knobs": converged,

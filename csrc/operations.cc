@@ -740,7 +740,9 @@ int hvdtpu_init() {
     st->param_manager->Initialize(
         st->fusion_threshold.load(), st->cycle_time_ms.load(),
         EnvStr("HOROVOD_AUTOTUNE_LOG", ""),
-        (int)EnvInt64("HOROVOD_AUTOTUNE_STEPS", 20));
+        (int)EnvInt64("HOROVOD_AUTOTUNE_STEPS", 20),
+        EnvInt64("HOROVOD_AUTOTUNE_WINDOW_BYTES", 1 << 20),
+        (int)EnvInt64("HOROVOD_AUTOTUNE_WINDOW_CYCLES", 20));
   } else {
     st->param_manager.reset();
   }

@@ -8,14 +8,15 @@
 namespace hvdtpu {
 
 namespace {
-constexpr int64_t kMinWindowBytes = 1 << 20;   // score only meaningful windows
-constexpr int kMinWindowCycles = 20;
 constexpr double kMaxWindowSecs = 5.0;
 }  // namespace
 
 void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
                                   const std::string& log_path,
-                                  int max_samples) {
+                                  int max_samples, int64_t window_bytes,
+                                  int window_cycles) {
+  min_window_bytes_ = std::max<int64_t>(window_bytes, 1);
+  min_window_cycles_ = std::max(window_cycles, 1);
   for (int64_t v = 1 << 20; v <= (64 << 20); v *= 2) {
     fusion_values_.push_back(v);
   }
@@ -98,7 +99,28 @@ bool ParameterManager::Update(int64_t bytes) {
   if (!active_ || done_) return false;
   auto now = std::chrono::steady_clock::now();
   if (!window_started_) {
-    window_start_ = now;
+    // A window's clock starts where the PREVIOUS window closed, not at
+    // its own first enqueue: eager training traffic is bursty (a long
+    // gradient-compute phase, then a flood of allreduces), and a
+    // first-enqueue clock silently drops the idle phase from the
+    // score. That bias made bytes/sec REWARD small cycle times —
+    // windows close inside the burst where instantaneous throughput
+    // is high — while the realized step time is worst exactly there
+    // (measured r6, benchmarks/results_r06_autotune.json: the
+    // per-grad lane's knob landscape inverts). Wall-clock windows
+    // make the score proportional to end-to-end training throughput,
+    // which is the number the tuner exists to move. Exception: a
+    // carried-over gap of a whole window or more is a knob-UNRELATED
+    // stall (eval loop, checkpoint, re-jit) — charging it to whatever
+    // candidate happens to be active would feed the optimizer a
+    // near-zero garbage sample (the window would close on its first
+    // Update via the kMaxWindowSecs cap), so such gaps start fresh.
+    auto start = window_ended_ ? window_end_ : now;
+    if (std::chrono::duration<double>(now - start).count() >=
+        kMaxWindowSecs) {
+      start = now;
+    }
+    window_start_ = start;
     window_started_ = true;
     window_bytes_ = 0;
     window_cycles_ = 0;
@@ -106,18 +128,27 @@ bool ParameterManager::Update(int64_t bytes) {
   window_bytes_ += bytes;
   window_cycles_++;
   double secs = std::chrono::duration<double>(now - window_start_).count();
-  bool window_full = (window_bytes_ >= kMinWindowBytes &&
-                      window_cycles_ >= kMinWindowCycles) ||
+  bool window_full = (window_bytes_ >= min_window_bytes_ &&
+                      window_cycles_ >= min_window_cycles_) ||
                      secs >= kMaxWindowSecs;
   if (!window_full || secs <= 0) return false;
   int64_t prev_fusion = fusion_threshold_bytes();
   double prev_cycle = cycle_time_ms();
   if (warmup_windows_ > 0) {
     warmup_windows_--;  // discard: startup warmup pollutes the score
-  } else if (window_bytes_ > 0) {
+  } else if (window_bytes_ >= min_window_bytes_ ||
+             window_cycles_ >= min_window_cycles_) {
     Score((double)window_bytes_ / secs);
   }
+  // else: a window that hit the kMaxWindowSecs cap with traffic below
+  // BOTH floors is a stall artifact (a sub-cap pause carried into the
+  // window start plus one or two enqueues) — discard it rather than
+  // feed the optimizer a near-zero sample charged to an innocent
+  // candidate. Genuinely slow workloads still score: their cap-closed
+  // windows clear the cycle floor.
   window_started_ = false;
+  window_end_ = now;
+  window_ended_ = true;
   return fusion_threshold_bytes() != prev_fusion ||
          cycle_time_ms() != prev_cycle;
 }

@@ -25,8 +25,15 @@ class ParameterManager {
  public:
   // log_path empty = no CSV log (HOROVOD_AUTOTUNE_LOG). max_samples is
   // HOROVOD_AUTOTUNE_STEPS: scored windows before fixing the knobs.
+  // window_bytes/window_cycles (HOROVOD_AUTOTUNE_WINDOW_BYTES /
+  // _WINDOW_CYCLES) are the floors a window must clear before it is
+  // scored: bursty eager workloads want windows spanning SEVERAL
+  // steps, or per-window bytes/sec is dominated by where in the
+  // compute/allreduce burst cycle the window boundary lands.
   void Initialize(int64_t fusion_bytes, double cycle_ms,
-                  const std::string& log_path, int max_samples = 20);
+                  const std::string& log_path, int max_samples = 20,
+                  int64_t window_bytes = 1 << 20,
+                  int window_cycles = 20);
   ~ParameterManager();
 
   bool active() const { return active_; }
@@ -55,12 +62,18 @@ class ParameterManager {
   size_t current_candidate_ = 0;
   int max_samples_ = 20;
 
-  // Window accumulation.
+  // Window accumulation. Windows are scored over WALL time: each
+  // window's clock starts where the previous one closed (see Update),
+  // so compute-phase idle counts against the knobs that caused it.
   int64_t window_bytes_ = 0;
   int window_cycles_ = 0;
+  int64_t min_window_bytes_ = 1 << 20;
+  int min_window_cycles_ = 20;
   int warmup_windows_ = 3;
   std::chrono::steady_clock::time_point window_start_;
+  std::chrono::steady_clock::time_point window_end_;
   bool window_started_ = false;
+  bool window_ended_ = false;
 
   FILE* log_ = nullptr;
 };

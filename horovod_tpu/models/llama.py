@@ -70,17 +70,26 @@ class LlamaConfig:
     # (0 = one microbatch per stage). Batch must divide by it.
     pipeline_microbatches: int = 0
     # Pipeline schedule for TRAINING: "gpipe" (all forwards, then AD's
-    # reversed backward — per-stage activation stash grows with M) or
-    # "1f1b" (interleaved forward/backward, loss fused into the last
+    # reversed backward — per-stage activation stash grows with M),
+    # "1f1b" (lockstep forward/backward slots, loss fused into the last
     # stage, stash bounded by ~2S microbatch inputs — see
-    # parallel.pipeline.one_f_one_b). Forward-only calls
-    # (llama_forward) always use gpipe: 1F1B never materializes logits.
-    # Value-only llama_loss calls (eval loops, loss logging without
-    # grad) also run the gpipe forward + loss head under "1f1b" — the
-    # schedule's combined forward/backward computes every gradient just
-    # to discard them (~3x the needed work), so only
-    # jax.grad/value_and_grad engages it.
+    # parallel.pipeline.one_f_one_b), or "interleaved_1f1b" (each
+    # device holds pipeline_virtual_stages NON-contiguous layer chunks;
+    # single-subtick slots cut the bubble to 2(S-1)/(2MV + 2(S-1)),
+    # ~V-fold below 1f1b — parallel.pipeline.interleaved_one_f_one_b).
+    # Forward-only calls (llama_forward) always use gpipe: the fused
+    # schedules never materialize logits. Value-only llama_loss calls
+    # (eval loops, loss logging without grad) also run the gpipe
+    # forward + loss head under both 1F1B variants — their combined
+    # forward/backward computes every gradient just to discard them
+    # (~3x the needed work), so only jax.grad/value_and_grad engages
+    # them.
     pipeline_schedule: str = "gpipe"
+    # Virtual chunks per device for "interleaved_1f1b" (Megatron's
+    # virtual pipeline size). n_layers must divide by
+    # pipe_size * pipeline_virtual_stages; 1 = the true non-interleaved
+    # 1F1B through the same single-subtick engine.
+    pipeline_virtual_stages: int = 1
     # Sequence-parallel strategy when the mesh's "seq" axis is
     # non-trivial: "ring" (K/V rotate via ppermute — any head count) or
     # "ulysses" (all-to-all head/sequence reshard — needs
@@ -490,9 +499,20 @@ def _validate_pipeline(c, b, mesh, seq_axis, n_stages):
     if M <= 0 or b % M:
         raise ValueError(f"batch {b} must divide into "
                          f"{M} pipeline microbatches")
-    if c.n_layers % n_stages:
+    V = c.pipeline_virtual_stages
+    if V < 1:
+        raise ValueError(f"pipeline_virtual_stages must be >= 1, got {V}")
+    if V > 1 and c.pipeline_schedule != "interleaved_1f1b":
+        raise ValueError(
+            f"pipeline_virtual_stages={V} requires "
+            f"pipeline_schedule='interleaved_1f1b' "
+            f"(got {c.pipeline_schedule!r})")
+    chunks = n_stages * (V if c.pipeline_schedule == "interleaved_1f1b"
+                         else 1)
+    if c.n_layers % chunks:
         raise ValueError(f"n_layers {c.n_layers} must divide into "
-                         f"{n_stages} pipeline stages")
+                         f"{chunks} pipeline stage chunks "
+                         f"({n_stages} stages x {V} virtual)")
     return M
 
 
@@ -642,13 +662,15 @@ def llama_loss(params, batch, config, mesh=None, seq_axis="seq"):
     tests/single/test_pipeline_1f1b.py.
     """
     n_stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
-    if n_stages > 1 and config.pipeline_schedule == "1f1b":
+    if n_stages > 1 and config.pipeline_schedule in ("1f1b",
+                                                     "interleaved_1f1b"):
         return _llama_loss_1f1b(params, batch, config, mesh, seq_axis,
                                 n_stages)
-    if config.pipeline_schedule not in ("gpipe", "1f1b"):
+    if config.pipeline_schedule not in ("gpipe", "1f1b",
+                                        "interleaved_1f1b"):
         raise ValueError(
             f"unknown pipeline_schedule {config.pipeline_schedule!r}: "
-            "expected 'gpipe' or '1f1b'")
+            "expected 'gpipe', '1f1b', or 'interleaved_1f1b'")
     logits, aux = llama_forward(params, batch["tokens"], config, mesh,
                                 seq_axis, return_aux=True)
     nll = _token_nll(logits, batch["targets"])
@@ -675,17 +697,26 @@ def _token_nll(logits, targets):
 
 
 def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
-    """Training loss through the 1F1B pipeline schedule.
+    """Training loss through a fused-backward pipeline schedule —
+    lockstep "1f1b" or the virtual-stage "interleaved_1f1b".
 
     The schedule computes loss AND gradients in one combined scan
-    (parallel.pipeline.one_f_one_b); a ``custom_vjp`` hands those
-    gradients to the outer ``jax.value_and_grad`` so callers keep the
-    ordinary llama_loss contract. The MoE aux objective is folded into
-    the schedule's backward via its constant per-contribution cotangent
+    (parallel.pipeline.one_f_one_b / interleaved_one_f_one_b); a
+    ``custom_vjp`` hands those gradients to the outer
+    ``jax.value_and_grad`` so callers keep the ordinary llama_loss
+    contract. The MoE aux objective is folded into the schedule's
+    backward via its constant per-contribution cotangent
     (moe_aux_weight / (n_layers * M)) — identical math to the gpipe
-    path's ``loss + w * mean(aux)``.
+    path's ``loss + w * mean(aux)``. For the interleaved schedule the
+    stacked layer axis is split into ``n_stages * V`` chunks and
+    device ``s`` holds the non-contiguous chunks ``v*S + s`` (the
+    engine permutes/unpermutes internally, so params and grads stay in
+    canonical layer order here).
     """
-    from horovod_tpu.parallel.pipeline import one_f_one_b
+    from horovod_tpu.parallel.pipeline import (
+        interleaved_one_f_one_b,
+        one_f_one_b,
+    )
 
     dt = c.compute_dtype
     b, t = batch["tokens"].shape
@@ -720,9 +751,15 @@ def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
               if c.n_experts > 0 else 0.0)
 
     def schedule_fwd(sp, hp, xs, largs):
-        loss, aux, d_sp, d_hp, d_xs = one_f_one_b(
-            stage_fn, loss_fn, sp, hp, xs, largs, mesh,
-            aux_cotangent=aux_ct)
+        if c.pipeline_schedule == "interleaved_1f1b":
+            loss, aux, d_sp, d_hp, d_xs = interleaved_one_f_one_b(
+                stage_fn, loss_fn, sp, hp, xs, largs, mesh,
+                num_virtual=c.pipeline_virtual_stages,
+                aux_cotangent=aux_ct)
+        else:
+            loss, aux, d_sp, d_hp, d_xs = one_f_one_b(
+                stage_fn, loss_fn, sp, hp, xs, largs, mesh,
+                aux_cotangent=aux_ct)
         return loss + aux_ct * aux, (d_sp, d_hp, d_xs, largs)
 
     def schedule_primal(sp, hp, xs, largs):

@@ -30,7 +30,12 @@ from horovod_tpu.parallel.ops import (  # noqa: F401
     psum,
     reduce_scatter,
 )
-from horovod_tpu.parallel.pipeline import gpipe  # noqa: F401
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    build_interleaved_schedule,
+    gpipe,
+    interleaved_one_f_one_b,
+    one_f_one_b,
+)
 from horovod_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
     ulysses_self_attention,

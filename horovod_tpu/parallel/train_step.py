@@ -18,10 +18,13 @@ Why two programs instead of one fused train-step jit (measured, r5/r6):
    sizes.
 
 The two programs are connected by DONATED gradient buffers: the first
-microbatch's gradient outputs become the accumulator, each accumulation
-step donates it forward, and the apply program donates it a final time
-(plus params and optimizer state), so exactly one params-sized gradient
-tree is live per step.
+microbatch's gradient outputs become the accumulator and each
+accumulation step donates it forward, so exactly one params-sized
+gradient tree is live per step. The apply program donates only params
+and optimizer state — its outputs are exactly one params tree plus one
+state tree, which those donate into 1:1, so a donated gradient tree
+could never alias an output and only produced XLA's "donated buffers
+were not usable" warning (see apply_fn below).
 
 Semantics: the per-microbatch loss is scaled by 1/N inside the grad
 program, so the accumulated gradients equal the full-batch mean-loss
@@ -101,12 +104,20 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
         raise ValueError(f"microbatches must be >= 1, got {microbatches}")
     fused = hasattr(optimizer, "apply")
 
+    # Grads are NOT donated: the apply program's outputs are exactly
+    # one params tree + one optimizer-state tree, and params/opt donate
+    # into them 1:1; a donated grads tree can never find an output to
+    # alias and only triggers XLA's "Some donated buffers were not
+    # usable" warning on every leaf (observed on the fp32-master path,
+    # BENCH r5 tail — the r6 fix, pinned by
+    # tests/single/test_llama.py::test_apply_jit_emits_no_donation_warning).
+    # The buffers are dead the moment apply returns either way.
     if fused:
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2), **jk)
+        @functools.partial(jax.jit, donate_argnums=(1, 2), **jk)
         def apply_fn(grads, params, opt):
             return optimizer.apply(params, grads, opt)
     else:
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2), **jk)
+        @functools.partial(jax.jit, donate_argnums=(1, 2), **jk)
         def apply_fn(grads, params, opt):
             import optax  # deferred: parallel/ imports without optax
 
@@ -129,6 +140,18 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
             # full-batch mean loss — no extra scaling pass anywhere.
             return loss_fn(p, d) / n
 
+        # TWO grad programs on purpose: the first microbatch runs an
+        # accumulator-free jit and its outputs BECOME the accumulator.
+        # Folding both into one program by seeding grad_acc with a
+        # zeros tree (halving the dominant fwd+bwd compile) was tried
+        # in r7 and MISCOMPILES: with a zeros accumulator whose
+        # sharding is the params', GSPMD picks a different partitioning
+        # for the embedding-gradient scatter-add inside pipeline-
+        # schedule programs and produces wrong embed grads on the CPU
+        # substrate (loss right, one leaf off by O(grad) — caught by
+        # test_interleaved_composes_with_split_train_step). Keep the
+        # two-program layout unless that equivalence test passes with
+        # the fold on every substrate.
         grad_first = jax.jit(
             lambda p, d: jax.value_and_grad(scaled_loss)(p, d), **jk)
 

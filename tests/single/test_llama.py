@@ -658,6 +658,41 @@ def test_split_step_with_fused_master_trains():
     assert losses[-1] < losses[0], losses
 
 
+def test_apply_jit_emits_no_donation_warning():
+    """The split step's apply jit must donate ONLY buffers XLA can
+    actually alias (params + optimizer state; gradients have no
+    matching output). The fp32-master path used to warn "Some donated
+    buffers were not usable" on every compute-cast leaf (BENCH r5
+    tail); this pins the r6 argument-layout fix for BOTH the fused
+    master-adam apply and the optax split apply, on bf16-param
+    configs where grads/params/master dtypes actually differ."""
+    import warnings
+
+    from horovod_tpu.parallel import (
+        fused_master_adam,
+        make_split_train_step,
+    )
+
+    cfg = LlamaConfig.tiny(n_layers=2, remat=False,
+                           param_dtype="bfloat16")  # bf16 compute+store
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+    for tx in (fused_master_adam(1e-2), optax.adam(1e-2)):
+        ts = make_split_train_step(
+            lambda p, d: llama_loss(p, d, cfg), tx, microbatches=2)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            loss, carry = ts.step(ts.init(params), batch)
+            jax.block_until_ready(loss)
+        bad = [w for w in caught
+               if "donated buffers were not usable" in str(w.message)]
+        assert not bad, (type(tx).__name__, [str(w.message)
+                                             for w in bad])
+
+
 def test_remat_modes_agree_on_gradients():
     """Every remat policy is a pure scheduling choice: loss and grads
     must match remat=False bit-for-bit-ish (f32 tolerances). Covers the
