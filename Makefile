@@ -18,13 +18,53 @@ TF_OUT := horovod_tpu/lib/libhvdtpu_tf.so
 # TF build flags come from the installed wheel; empty when TF is absent.
 PYTHON ?= python3
 
-.PHONY: core tf clean test test-quick
+TSAN_OUT := horovod_tpu/lib/libhvdtpu_core_tsan.so
+ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
+
+.PHONY: core tf clean test test-quick lint lint-csrc core-tsan core-asan
 
 core: $(OUT)
 
 $(OUT): $(SRC) $(HDR)
 	@mkdir -p horovod_tpu/lib
 	$(CXX) $(CXXFLAGS) $(SRC) $(LDFLAGS) -o $(OUT)
+
+# Sanitizer builds of the core runtime (load via HVDTPU_CORE_LIB=...,
+# LD_PRELOAD the matching runtime — tests/single/test_sanitizer_smoke.py
+# drives a multi-threaded allreduce through the TSan build).
+core-tsan: $(TSAN_OUT)
+core-asan: $(ASAN_OUT)
+
+$(TSAN_OUT): $(SRC) $(HDR)
+	@mkdir -p horovod_tpu/lib
+	$(CXX) -O1 -g -std=c++17 -fPIC -fsanitize=thread -pthread \
+	  $(SRC) $(LDFLAGS) -fsanitize=thread -o $(TSAN_OUT)
+
+$(ASAN_OUT): $(SRC) $(HDR)
+	@mkdir -p horovod_tpu/lib
+	$(CXX) -O1 -g -std=c++17 -fPIC -fsanitize=address -pthread \
+	  $(SRC) $(LDFLAGS) -fsanitize=address -o $(ASAN_OUT)
+
+# Strict-warning build of the native core: full -Wextra, warnings as
+# errors, a REAL -O2 compile+link (not -fsyntax-only — optimization-
+# dependent warnings like -Wmaybe-uninitialized need the middle-end).
+# tf_ops.cc is excluded exactly as in the core build: it requires the
+# installed TF's headers, which the lint box may not have.
+lint-csrc:
+	$(CXX) -O2 -std=c++17 -fPIC -Werror -Wall -Wextra -pthread \
+	  $(SRC) $(LDFLAGS) -o /dev/null
+	@echo "lint-csrc: clean ($(words $(SRC)) files, -Werror -Wall -Wextra)"
+
+# Python lint: ruff (when installed — the driver container does not
+# ship it; config lives in pyproject.toml) + an hvdlint static-analysis
+# pass over every shipped program (see docs/analysis.md).
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check horovod_tpu/parallel horovod_tpu/analysis bench.py; \
+	else \
+	  echo "lint: ruff not installed; skipping style pass"; \
+	fi
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.analysis.lint --all
 
 tf: $(TF_OUT)
 

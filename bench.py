@@ -682,6 +682,20 @@ def main():
         print(json.dumps(row), flush=True)
         gc.collect()
 
+    if "--lint" in argv:
+        # hvdlint preflight: statically analyze every shipped program
+        # (collective divergence, axis validity, donation hazards,
+        # pipeline schedule conformance — docs/analysis.md) BEFORE
+        # committing chip-hours. Exit nonzero on any error diagnostic;
+        # a 256-chip deadlock this catches costs seconds here.
+        from horovod_tpu.analysis.lint import main as lint_main
+
+        rc = lint_main(["--all"])
+        if rc != 0:
+            sys.exit(rc)
+        argv = [a for a in argv if a != "--lint"]
+        if not argv:
+            return
     if "--quick" in argv:
         if jax.devices()[0].platform == "cpu":
             emit(_smoke_row())

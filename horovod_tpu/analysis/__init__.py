@@ -1,0 +1,51 @@
+"""horovod_tpu.analysis — hvdlint, the static SPMD analyzer.
+
+Horovod's classic production failure is silent cross-worker divergence:
+ranks issuing different collective sequences and deadlocking at scale.
+Upstream catches it at RUNTIME (the controller negotiation +
+response-cache consistency checks, csrc/controller.cc); a TPU-native
+rebuild can catch the whole class BEFORE launch by analyzing the jitted
+program. This package lowers any function the repo jits to a
+ClosedJaxpr, walks every sub-jaxpr, extracts the ordered collective
+signature per control-flow path, and runs the C1-C5 check catalog over
+it — see docs/analysis.md.
+
+Library entry point::
+
+    from horovod_tpu import analysis
+    diags = analysis.lint(step_fn, (carry, batch), mesh=mesh)
+    assert not analysis.errors(diags)
+
+CLI: ``python -m horovod_tpu.analysis.lint --all``.
+"""
+
+from horovod_tpu.analysis.diagnostics import (  # noqa: F401
+    ERROR,
+    SEVERITIES,
+    WARNING,
+    Diagnostic,
+    errors,
+    filter_allowed,
+)
+from horovod_tpu.analysis.extract import (  # noqa: F401
+    Branches,
+    Collective,
+    Extraction,
+    Loop,
+    extract,
+    linearize,
+)
+
+def __getattr__(name):
+    # Lazy: ``analysis.lint`` is BOTH the entry-point function and the
+    # CLI submodule (``python -m horovod_tpu.analysis.lint``). The
+    # function lives in api.py; resolving it lazily from there (and
+    # caching it into the package namespace) keeps the attribute a
+    # callable even though a same-named CLI submodule exists, and keeps
+    # runpy from warning about double imports when the CLI runs.
+    if name == "lint":
+        from horovod_tpu.analysis.api import lint
+
+        globals()["lint"] = lint
+        return lint
+    raise AttributeError(name)

@@ -1,0 +1,215 @@
+"""The hvdlint check catalog (C1-C5) over an extracted signature.
+
+Each check is a pure function ``(extraction, context) -> [Diagnostic]``;
+:func:`run_all` applies every shipped check. See docs/analysis.md for
+the catalog with before/after examples.
+"""
+
+import collections
+
+from horovod_tpu.analysis import diagnostics as D
+from horovod_tpu.analysis.extract import (
+    Branches,
+    Collective,
+    iter_nodes,
+    linearize,
+)
+
+
+def check_collective_divergence(ex, ctx):
+    """C1: cond/switch branches whose collective sequences differ.
+
+    Under SPMD every rank must issue the SAME ordered collective
+    sequence; a data-dependent branch with differing sequences
+    deadlocks the moment two ranks disagree on the predicate. When the
+    predicate provably derives from ``lax.axis_index`` the disagreement
+    is structural, not probabilistic — the message says so.
+    """
+    out = []
+    for node in iter_nodes(ex.signature):
+        if not isinstance(node, Branches):
+            continue
+        sigs = [tuple(c.key for c in linearize(opt))
+                for opt in node.options]
+        if len(set(sigs)) <= 1:
+            continue
+        counts = "/".join(str(len(s)) for s in sigs)
+        cause = ("predicate derives from lax.axis_index — ranks WILL "
+                 "take different branches"
+                 if node.pred_rank_dependent else
+                 "any cross-rank disagreement on the predicate deadlocks")
+        out.append(D.make(
+            "C1", node.path,
+            f"cond/switch branches issue different collective "
+            f"sequences ({counts} collectives per branch); {cause}",
+            hint="hoist collectives out of the branches (compute "
+                 "masked contributions and reduce unconditionally), or "
+                 "make every branch issue the identical sequence",
+            source=node.source))
+    return out
+
+
+def check_axis_validity(ex, ctx):
+    """C2: collectives over axis names absent from the declared mesh.
+
+    ``mesh_axes`` of ``None`` means the caller declared nothing at all
+    (no mesh, no axis_env) — no ground truth, skip. An EMPTY declared
+    set is different: every collective axis is then undeclared (the
+    no-mesh typo'd-axis case) and must be flagged.
+    """
+    mesh_axes = ctx.get("mesh_axes")
+    if mesh_axes is None:
+        return []
+    declared = set(mesh_axes)
+    out = []
+    for node in iter_nodes(ex.signature):
+        if not isinstance(node, Collective):
+            continue
+        unknown = [a for a in node.axes if a not in declared]
+        if unknown:
+            out.append(D.make(
+                "C2", node.path,
+                f"{node.prim} over axis {unknown} not in the declared "
+                f"mesh axes {sorted(declared)}",
+                hint="add the axis to the mesh (parallel.mesh."
+                     "create_mesh) or fix the axis name; on jax<0.6 "
+                     "boxes a drifted vmap axis_name shows up exactly "
+                     "like this",
+                source=node.source))
+    return out
+
+
+def check_width_waste(ex, ctx):
+    """C3: fp32 reductions fed by bf16/fp16 producers whose result is
+    consumed at fp32 — the wire carries double the information the
+    data holds. The f32-accumulate roundtrip (cast up, reduce, cast
+    straight back down) is exempt: that is the numerically-recommended
+    pattern and the cast is fused on TPU."""
+    out = []
+    for node in iter_nodes(ex.signature):
+        if not isinstance(node, Collective):
+            continue
+        if node.upcast_from and not node.roundtrip:
+            out.append(D.make(
+                "C3", node.path,
+                f"{node.prim} reduces float32 data upcast from "
+                f"{node.upcast_from} ({node.nelems} elements) and the "
+                f"result stays float32",
+                hint=f"reduce in {node.upcast_from} (EQuARX-style "
+                     "compressed allreduce is the cheapest ICI win), or "
+                     "cast the result straight back to "
+                     f"{node.upcast_from} if f32 was only for "
+                     "accumulation",
+                source=node.source))
+    return out
+
+
+def check_donation_hazards(ex, ctx):
+    """C4: donated buffers that cannot alias any output — more donated
+    buffers of a (shape, dtype) class than outputs of that class.
+    XLA's "Some donated buffers were not usable" warning-class (the r6
+    apply-jit bug: grads donated into an apply whose outputs are
+    exactly params+opt) promoted to a pre-commit error.
+
+    A donated invar the program never READS is fine by itself —
+    ``fused_master_adam`` donates the previous compute-cast purely as
+    output storage — so unconsumed donations are flagged only when
+    they also fail the aliasing count; the message calls them out as
+    the likely dead weight.
+    """
+    out = []
+    for site in ex.donation_sites:
+        jaxpr = site.jaxpr.jaxpr if hasattr(site.jaxpr, "jaxpr") \
+            else site.jaxpr
+        outvars = [v for v in jaxpr.outvars if hasattr(v, "count")]
+        donated_vars = [v for v, d in zip(jaxpr.invars, site.donated)
+                        if d]
+        read = set()
+        for eqn in jaxpr.eqns:
+            read.update(v for v in eqn.invars if hasattr(v, "count"))
+        read.update(outvars)
+
+        buckets = collections.Counter(_bucket(v) for v in donated_vars)
+        out_buckets = collections.Counter(_bucket(v) for v in outvars)
+        for bucket, n_donated in sorted(buckets.items()):
+            n_out = out_buckets.get(bucket, 0)
+            excess = n_donated - n_out
+            if excess <= 0:
+                continue
+            shape, dtype = bucket
+            n_unread = sum(1 for v in donated_vars
+                           if _bucket(v) == bucket and v not in read)
+            unread = (f" ({n_unread} of them never read by the "
+                      "program)" if n_unread else "")
+            out.append(D.make(
+                "C4", site.path,
+                f"{excess} donated {dtype}{list(shape)} buffer(s) in "
+                f"program '{site.name}' cannot alias any output "
+                f"({n_donated} donated vs {n_out} outputs of that "
+                f"shape/dtype){unread} — XLA will warn 'donated "
+                "buffers were not usable' and silently keep them live",
+                hint="donate only buffers an output can reuse 1:1 "
+                     "(e.g. params/opt-state into their updated "
+                     "versions); gradients feeding an apply program "
+                     "usually must NOT be donated",
+                source=site.source))
+    return out
+
+
+def check_schedule_conformance(ex, ctx):
+    """C5: the traced collective sequence must equal the host-side
+    prediction (``expect_collectives`` — built by
+    ``parallel.pipeline.predicted_collectives`` from the same schedule
+    tables the engines execute)."""
+    expected = ctx.get("expect_collectives")
+    if expected is None:
+        return []
+    actual = [(c.prim, tuple(c.axes)) for c in linearize(ex.signature)]
+    expected = [(p, tuple(a) if isinstance(a, (tuple, list)) else (a,))
+                for p, a in expected]
+    if actual == expected:
+        return []
+    msg = _first_divergence(actual, expected)
+    return [D.make(
+        "C5", "<program>",
+        f"collective sequence deviates from the schedule table's "
+        f"prediction: {msg}",
+        hint="the engine and its host schedule builder disagree — "
+             "either the schedule table changed without the engine "
+             "(or vice versa), or an extra/missing collective crept "
+             "into the stage/loss functions")]
+
+
+def _first_divergence(actual, expected):
+    n = min(len(actual), len(expected))
+    for i in range(n):
+        if actual[i] != expected[i]:
+            return (f"first divergence at collective #{i}: traced "
+                    f"{actual[i]}, predicted {expected[i]} "
+                    f"(traced {len(actual)} vs predicted "
+                    f"{len(expected)} total)")
+    return (f"traced {len(actual)} collectives vs predicted "
+            f"{len(expected)} (prefix matches)")
+
+
+def _bucket(v):
+    aval = v.aval
+    return (tuple(int(d) for d in aval.shape), str(aval.dtype))
+
+
+ALL_CHECKS = (
+    check_collective_divergence,
+    check_axis_validity,
+    check_width_waste,
+    check_donation_hazards,
+    check_schedule_conformance,
+)
+
+
+def run_all(extraction, context=None):
+    """Apply every check; returns the concatenated diagnostics."""
+    context = context or {}
+    out = []
+    for check in ALL_CHECKS:
+        out.extend(check(extraction, context))
+    return out

@@ -1,0 +1,88 @@
+"""Diagnostic objects for hvdlint (the static SPMD analyzer).
+
+A :class:`Diagnostic` is one finding against one program location. The
+check ids are stable API (tests assert them, allowlists name them):
+
+- **C1** collective-divergence — cond/switch branches whose collective
+  sequences differ (the classic SPMD deadlock shape; Horovod catches
+  this class at RUNTIME via the controller's negotiation — see
+  csrc/controller.cc — hvdlint catches it before launch).
+- **C2** axis validity — a collective over an axis name absent from the
+  declared mesh.
+- **C3** width waste — an fp32 reduction whose operand was upcast from
+  a sub-fp32 dtype and whose result is consumed at fp32 (the wire
+  carries 2x the bytes the data has; see docs/analysis.md for the
+  EQuARX/compressed-lane connection). The f32-accumulate ROUNDTRIP
+  (bf16 -> f32 -> psum -> bf16) is deliberately exempt.
+- **C4** donation hazard — a donated invar that no eqn consumes, or
+  more donated buffers of a (shape, dtype) class than outputs that
+  could alias them (XLA's "Some donated buffers were not usable"
+  warning-class, promoted to a pre-commit error).
+- **C5** schedule conformance — a pipeline program whose traced
+  ppermute/psum sequence deviates from the host-built schedule table's
+  prediction.
+"""
+
+import dataclasses
+
+ERROR = "error"
+WARNING = "warning"
+
+#: check id -> default severity
+SEVERITIES = {
+    "C1": ERROR,
+    "C2": ERROR,
+    "C3": WARNING,
+    "C4": ERROR,
+    "C5": ERROR,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One hvdlint finding.
+
+    ``path`` is the structural location inside the traced program
+    (e.g. ``"pjit:apply_fn"`` or ``"scan/cond"``); ``source`` is the
+    user ``file:line`` jax recorded for the offending equation when
+    available.
+    """
+
+    id: str              # "C1".."C5"
+    severity: str        # ERROR or WARNING
+    path: str            # structural jaxpr path
+    message: str         # what is wrong
+    hint: str = ""       # how to fix it
+    source: str = ""     # user file:line (best effort)
+
+    def format(self):
+        loc = self.path or "<program>"
+        src = f" [{self.source}]" if self.source else ""
+        hint = f"\n    hint: {self.hint}" if self.hint else ""
+        return f"{self.id} {self.severity}: {loc}{src}: {self.message}{hint}"
+
+
+def make(check_id, path, message, hint="", source="", severity=None):
+    """Build a Diagnostic with the check's default severity."""
+    return Diagnostic(
+        id=check_id,
+        severity=severity or SEVERITIES[check_id],
+        path=path,
+        message=message,
+        hint=hint,
+        source=source,
+    )
+
+
+def filter_allowed(diags, allow=()):
+    """Drop diagnostics named by ``allow`` (check ids, e.g. ``("C3",)``,
+    or exact ``"C3:path"`` pairs — the allowlist mechanism documented in
+    docs/analysis.md)."""
+    allow = frozenset(allow)
+    return [d for d in diags
+            if d.id not in allow and f"{d.id}:{d.path}" not in allow]
+
+
+def errors(diags):
+    """The error-severity subset (what CI gates on)."""
+    return [d for d in diags if d.severity == ERROR]

@@ -1,0 +1,198 @@
+"""Registry of the repo's shipped jitted programs, lint-ready.
+
+Every program the training stack jits — the monolithic and split llama
+train steps, both fused optimizer applies, and all three pipeline
+schedule engines — is buildable here with abstract inputs, so the CLI
+(``python -m horovod_tpu.analysis.lint --all``), ``make lint``,
+``bench.py --lint``, and the pytest fixture all lint the SAME set.
+Adding a program here is how a future subsystem buys pre-launch
+collective-consistency checking for free.
+
+Pipeline programs are linted at the per-device ``inner`` level (built
+by ``parallel.pipeline.build_pipeline_inner`` from the same
+``models.llama`` stage/loss programs the engines run) with the
+host-schedule prediction attached — no mesh, devices, or shard_map
+required, which is what keeps the full check suite running on the
+jax 0.4.x CPU boxes that execute the schedules under vmap emulation.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.analysis.api import lint
+
+# Pipeline lint geometry: S stages x V virtual chunks x M microbatches.
+_S, _V, _M = 2, 2, 4
+_BATCH, _SEQ = 4, 8
+
+
+@dataclasses.dataclass
+class LintSpec:
+    """One program plus everything ``lint`` needs to analyze it."""
+
+    fn: object
+    args: tuple
+    mesh: object = None
+    axis_env: object = None
+    expect_collectives: object = None
+    donate_argnums: tuple = ()
+
+    def run(self, allow=()):
+        return lint(self.fn, self.args, mesh=self.mesh,
+                    axis_env=self.axis_env,
+                    donate_argnums=self.donate_argnums,
+                    expect_collectives=self.expect_collectives,
+                    allow=allow)
+
+
+def _config(name):
+    from horovod_tpu.models.llama import LlamaConfig
+
+    # n_layers=4 so the layer stack divides into S*V=4 pipeline chunks.
+    presets = {
+        "tiny": lambda: LlamaConfig.tiny(n_layers=4),
+        "tiny_moe": lambda: LlamaConfig.tiny_moe(n_layers=4),
+    }
+    if name not in presets:
+        raise ValueError(f"unknown config {name!r}: expected one of "
+                         f"{sorted(presets)}")
+    return presets[name]()
+
+
+def _abstract_params(cfg):
+    from horovod_tpu.models.llama import llama_init
+
+    return jax.eval_shape(
+        lambda: llama_init(cfg, jax.random.PRNGKey(0)))
+
+
+def _abstract_batch():
+    tok = jax.ShapeDtypeStruct((_BATCH, _SEQ), jnp.int32)
+    return {"tokens": tok, "targets": tok,
+            "mask": jax.ShapeDtypeStruct((_BATCH, _SEQ), jnp.float32)}
+
+
+def _mesh():
+    """A trivial mesh over whatever devices exist: lint only needs the
+    axis NAMES declared; every axis can be size 1."""
+    from horovod_tpu.parallel.mesh import create_mesh
+
+    return create_mesh()
+
+
+def _loss_fn(cfg, mesh):
+    from horovod_tpu.models.llama import llama_loss
+
+    return functools.partial(llama_loss, config=cfg, mesh=mesh)
+
+
+def _monolithic(config):
+    cfg = _config(config)
+    mesh = _mesh()
+    loss = _loss_fn(cfg, mesh)
+    step = jax.jit(lambda p, b: jax.value_and_grad(loss)(p, b))
+    return LintSpec(fn=step, args=(_abstract_params(cfg),
+                                   _abstract_batch()), mesh=mesh)
+
+
+def _split(config, optimizer_name):
+    import optax
+
+    from horovod_tpu.parallel.precision import (
+        fused_adam,
+        fused_master_adam,
+    )
+    from horovod_tpu.parallel.train_step import make_split_train_step
+
+    cfg = _config(config)
+    mesh = _mesh()
+    optimizer = {
+        "adam": lambda: optax.adam(1e-3),
+        "fused_adam": lambda: fused_adam(1e-3),
+        "fused_master_adam": lambda: fused_master_adam(1e-3),
+    }[optimizer_name]()
+    ts = make_split_train_step(_loss_fn(cfg, mesh), optimizer,
+                               microbatches=2)
+    carry = jax.eval_shape(ts.init, _abstract_params(cfg))
+    return LintSpec(fn=ts.step, args=(carry, _abstract_batch()),
+                    mesh=mesh)
+
+
+def _pipeline(config, schedule):
+    from horovod_tpu.models.llama import llama_pipeline_programs
+    from horovod_tpu.parallel.pipeline import (
+        build_pipeline_inner,
+        predicted_collectives,
+    )
+
+    cfg = _config(config)
+    stage_fn, loss_fn, aux_ct = llama_pipeline_programs(
+        cfg, mesh=None, microbatches=_M, denom=float(_BATCH * _SEQ))
+    inner = build_pipeline_inner(schedule, stage_fn, loss_fn, S=_S,
+                                 M=_M, num_virtual=_V,
+                                 aux_cotangent=aux_ct)
+    expect = predicted_collectives(schedule, S=_S, M=_M,
+                                   num_virtual=_V, n_head_leaves=2)
+
+    params = _abstract_params(cfg)
+    layers = params["layers"]
+    # Per-device stage block: leading stacked-layer axis / S (the
+    # interleaved engine holds the same total as V chunks of L/(S*V)).
+    sp = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(
+            (l.shape[0] // _S,) + l.shape[1:], l.dtype), layers)
+    mb = _BATCH // _M
+    d = cfg.d_model
+    xs = jax.ShapeDtypeStruct((_M, mb, _SEQ, d), cfg.compute_dtype)
+    if schedule == "gpipe":
+        return LintSpec(fn=inner, args=(sp, xs),
+                        axis_env=[("pipe", _S)],
+                        expect_collectives=expect)
+    hp = (params["final_norm"], params["lm_head"])
+    largs = (jax.ShapeDtypeStruct((_M, mb, _SEQ), jnp.int32),
+             jax.ShapeDtypeStruct((_M, mb, _SEQ), jnp.float32))
+    return LintSpec(fn=inner, args=(sp, hp, xs, largs),
+                    axis_env=[("pipe", _S)], expect_collectives=expect)
+
+
+_REGISTRY = {
+    "llama_train_step": _monolithic,
+    "llama_train_step_split":
+        functools.partial(_split, optimizer_name="adam"),
+    "llama_train_step_split_fused_adam":
+        functools.partial(_split, optimizer_name="fused_adam"),
+    "llama_train_step_split_fused_master_adam":
+        functools.partial(_split, optimizer_name="fused_master_adam"),
+    "pipeline_gpipe":
+        functools.partial(_pipeline, schedule="gpipe"),
+    "pipeline_1f1b":
+        functools.partial(_pipeline, schedule="1f1b"),
+    "pipeline_interleaved_1f1b":
+        functools.partial(_pipeline, schedule="interleaved_1f1b"),
+}
+
+
+def program_names():
+    return sorted(_REGISTRY)
+
+
+def build_program(name, config="tiny"):
+    """Build a registered program's :class:`LintSpec`."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown program {name!r}: expected one of "
+                         f"{program_names()}")
+    return _REGISTRY[name](config)
+
+
+def lint_program(name, config="tiny", allow=()):
+    """Build and lint one registered program."""
+    return build_program(name, config).run(allow=allow)
+
+
+def lint_all(config="tiny", allow=()):
+    """Lint every registered program; returns ``{name: [Diagnostic]}``."""
+    return {name: lint_program(name, config, allow)
+            for name in program_names()}

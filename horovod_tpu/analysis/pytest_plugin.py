@@ -1,0 +1,52 @@
+"""Pytest plugin: lint fixtures for hvdlint.
+
+Registered by ``tests/conftest.py`` (``pytest_plugins``); gives every
+test file two fixtures:
+
+- ``hvdlint`` — assert one program is collective-consistent::
+
+      def test_my_step(hvdlint):
+          diags = hvdlint(step_fn, (carry, batch), mesh=mesh)
+
+  Raises (pytest-fails) on any error-severity diagnostic; returns the
+  full diagnostic list so tests can additionally assert on warnings.
+
+- ``hvdlint_shipped`` — the registry hook: lints one named shipped
+  program from ``analysis.programs`` and asserts it clean. The
+  quick-lane model tests run their programs through this, so every
+  future PR's programs are linted for free.
+"""
+
+import pytest
+
+
+@pytest.fixture()
+def hvdlint():
+    from horovod_tpu import analysis
+    from horovod_tpu.analysis.api import lint
+
+    def check(fn, args=(), allow=(), **kw):
+        diags = lint(fn, args, allow=allow, **kw)
+        errs = analysis.errors(diags)
+        if errs:
+            pytest.fail(
+                "hvdlint found collective-consistency errors:\n"
+                + "\n".join(d.format() for d in errs))
+        return diags
+
+    return check
+
+
+@pytest.fixture()
+def hvdlint_shipped():
+    from horovod_tpu.analysis import programs
+
+    def check(name, config="tiny", allow=()):
+        diags = programs.lint_program(name, config=config, allow=allow)
+        if diags:
+            pytest.fail(
+                f"hvdlint: shipped program {name!r} is not clean:\n"
+                + "\n".join(d.format() for d in diags))
+        return diags
+
+    return check

@@ -15,8 +15,13 @@ _LIB_NAME = "libhvdtpu_core.so"
 
 
 def _lib_path():
+    # HVDTPU_CORE_LIB selects an alternate core build by file name —
+    # the sanitizer smoke test (tests/single/test_sanitizer_smoke.py)
+    # points it at libhvdtpu_core_tsan.so under an LD_PRELOADed
+    # libtsan runtime (make core-tsan / core-asan).
+    name = os.environ.get("HVDTPU_CORE_LIB", _LIB_NAME)
     return os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                        "lib", _LIB_NAME)
+                        "lib", name)
 
 
 def _repo_root():

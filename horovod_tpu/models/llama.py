@@ -696,6 +696,39 @@ def _token_nll(logits, targets):
     return lse - picked
 
 
+def llama_pipeline_programs(config, mesh=None, seq_axis="seq", *,
+                            microbatches=1, denom=1.0):
+    """Build ``(stage_fn, loss_fn, aux_cotangent)`` — the exact per-
+    stage program and last-stage loss head the 1F1B pipeline engines
+    run (also the gpipe stage body via the same ``_stage_scan``).
+
+    This is the program-builder hook hvdlint traces: combined with
+    ``parallel.pipeline.build_pipeline_inner`` it reconstructs the real
+    per-device pipeline program for static analysis (C5 schedule
+    conformance — see ``horovod_tpu/analysis/``) without needing a
+    mesh, devices, or shard_map. ``denom`` is the global mask-token
+    denominator folded into each microbatch's loss numerator (a traced
+    value inside the real step; any static float for lint purposes).
+    Used by :func:`_llama_loss_1f1b` itself so the two can never drift.
+    """
+    c = config
+    dt = c.compute_dtype
+    stage_fn = _stage_scan(
+        _build_layer_body(c, mesh, seq_axis, constrain_acts=False))
+
+    def loss_fn(hp, y_mb, la):
+        final_norm, lm_head = hp
+        tgt, m = la
+        h = _rmsnorm(y_mb, final_norm.astype(dt), c.norm_eps)
+        logits = jnp.matmul(h, lm_head.astype(dt),
+                            preferred_element_type=jnp.float32)
+        return jnp.sum(_token_nll(logits, tgt) * m) / denom
+
+    aux_ct = (c.moe_aux_weight / (c.n_layers * microbatches)
+              if c.n_experts > 0 else 0.0)
+    return stage_fn, loss_fn, aux_ct
+
+
 def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
     """Training loss through a fused-backward pipeline schedule —
     lockstep "1f1b" or the virtual-stage "interleaved_1f1b".
@@ -721,8 +754,6 @@ def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
     dt = c.compute_dtype
     b, t = batch["tokens"].shape
     M = _validate_pipeline(c, b, mesh, seq_axis, n_stages)
-    stage_fn = _stage_scan(
-        _build_layer_body(c, mesh, seq_axis, constrain_acts=False))
 
     tokens = batch["tokens"]
     if mesh is not None:
@@ -739,16 +770,8 @@ def _llama_loss_1f1b(params, batch, c, mesh, seq_axis, n_stages):
     # loss numerator (mask is data, not a differentiated value).
     denom = jnp.maximum(jnp.sum(mask), 1.0)
 
-    def loss_fn(hp, y_mb, la):
-        final_norm, lm_head = hp
-        tgt, m = la
-        h = _rmsnorm(y_mb, final_norm.astype(dt), c.norm_eps)
-        logits = jnp.matmul(h, lm_head.astype(dt),
-                            preferred_element_type=jnp.float32)
-        return jnp.sum(_token_nll(logits, tgt) * m) / denom
-
-    aux_ct = (c.moe_aux_weight / (c.n_layers * M)
-              if c.n_experts > 0 else 0.0)
+    stage_fn, loss_fn, aux_ct = llama_pipeline_programs(
+        c, mesh, seq_axis, microbatches=M, denom=denom)
 
     def schedule_fwd(sp, hp, xs, largs):
         if c.pipeline_schedule == "interleaved_1f1b":

@@ -32,9 +32,11 @@ from horovod_tpu.parallel.ops import (  # noqa: F401
 )
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
     build_interleaved_schedule,
+    build_pipeline_inner,
     gpipe,
     interleaved_one_f_one_b,
     one_f_one_b,
+    predicted_collectives,
 )
 from horovod_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,

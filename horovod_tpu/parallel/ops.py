@@ -10,7 +10,6 @@ Use under ``jax.shard_map`` (or inside ``jax.jit`` with sharding
 constraints, where XLA inserts them implicitly).
 """
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -82,21 +82,13 @@ def _cast_f32_on_cpu(mesh, xs):
     return xs, None
 
 
-def gpipe(stage_fn, stage_params, xs, mesh, axis="pipe"):
-    """Run microbatches through the pipeline.
-
-    ``stage_fn(stage_params_block, x_mb) -> (y_mb, aux)`` applies ONE
-    stage's slice of the network (aux is a scalar, e.g. an MoE balance
-    loss; return 0.0 if unused). ``stage_params`` is a pytree whose
-    leaves have a leading stacked-layer axis of length divisible by the
-    pipe size — ``shard_map`` splits it into per-stage blocks.
-    ``xs`` is ``[M, ...]`` microbatches. Returns ``(ys [M, ...],
-    aux_sum)`` where aux_sum totals stage_fn aux over all (stage,
-    microbatch) pairs.
-    """
-    S = mesh.shape[axis]
-    M = xs.shape[0]
-    xs, cast_dt = _cast_f32_on_cpu(mesh, xs)
+def _gpipe_inner(stage_fn, S, M, axis):
+    """The per-device GPipe program (manual over ``axis``): a
+    ``[M + S - 1]``-step scan with one activation ``ppermute`` per step,
+    then the result/aux ``psum`` pair. Factored out of :func:`gpipe` so
+    hvdlint can trace it standalone (``jax.make_jaxpr`` with
+    ``axis_env=[(axis, S)]``) and check it against
+    :func:`predicted_collectives` — see ``horovod_tpu/analysis/``."""
 
     def inner(sp, xs_):
         stage = lax.axis_index(axis)
@@ -137,6 +129,26 @@ def gpipe(stage_fn, stage_params, xs, mesh, axis="pipe"):
         aux = lax.psum(aux, axis)
         return buf, aux
 
+    return inner
+
+
+def gpipe(stage_fn, stage_params, xs, mesh, axis="pipe"):
+    """Run microbatches through the pipeline.
+
+    ``stage_fn(stage_params_block, x_mb) -> (y_mb, aux)`` applies ONE
+    stage's slice of the network (aux is a scalar, e.g. an MoE balance
+    loss; return 0.0 if unused). ``stage_params`` is a pytree whose
+    leaves have a leading stacked-layer axis of length divisible by the
+    pipe size — ``shard_map`` splits it into per-stage blocks.
+    ``xs`` is ``[M, ...]`` microbatches. Returns ``(ys [M, ...],
+    aux_sum)`` where aux_sum totals stage_fn aux over all (stage,
+    microbatch) pairs.
+    """
+    S = mesh.shape[axis]
+    M = xs.shape[0]
+    xs, cast_dt = _cast_f32_on_cpu(mesh, xs)
+
+    inner = _gpipe_inner(stage_fn, S, M, axis)
     ys, aux = _pipe_spmd(inner, mesh, axis, (True, False),
                          (False, False))(stage_params, xs)
     if cast_dt is not None:
@@ -184,9 +196,28 @@ def one_f_one_b(stage_fn, loss_fn, stage_params, head_params, xs,
     """
     S = mesh.shape[axis]
     M = xs.shape[0]
+    xs, cast_dt = _cast_f32_on_cpu(mesh, xs)
+
+    inner = _one_f_one_b_inner(stage_fn, loss_fn, S, M, axis,
+                               aux_cotangent)
+    d_sp, d_hp, d_xs, loss, aux = _pipe_spmd(
+        inner, mesh, axis, (True, False, False, False),
+        (True, False, False, False, False))(
+            stage_params, head_params, xs, loss_args)
+    if cast_dt is not None:
+        d_xs = d_xs.astype(cast_dt)
+    return loss, aux, d_sp, d_hp, d_xs
+
+
+def _one_f_one_b_inner(stage_fn, loss_fn, S, M, axis, aux_cotangent):
+    """The per-device lockstep-1F1B program (manual over ``axis``): a
+    ``[M + 2(S-1)]``-slot scan with one forward and one backward
+    activation ``ppermute`` per slot, then the shared-gradient ``psum``
+    tail (head-param leaves, d_xs, loss, aux — stage params stay
+    local). Factored out of :func:`one_f_one_b` so hvdlint can trace it
+    standalone against :func:`predicted_collectives`."""
     Q = min(M, 2 * S - 1)                       # stash depth per stage
     U = M + 2 * (S - 1)                         # total slots
-    xs, cast_dt = _cast_f32_on_cpu(mesh, xs)
 
     def inner(sp, hp, xs_, largs_):
         stage = lax.axis_index(axis)
@@ -284,13 +315,7 @@ def one_f_one_b(stage_fn, loss_fn, stage_params, head_params, xs,
         aux = lax.psum(aux, axis)
         return d_sp, d_hp, d_xs, loss, aux
 
-    d_sp, d_hp, d_xs, loss, aux = _pipe_spmd(
-        inner, mesh, axis, (True, False, False, False),
-        (True, False, False, False, False))(
-            stage_params, head_params, xs, loss_args)
-    if cast_dt is not None:
-        d_xs = d_xs.astype(cast_dt)
-    return loss, aux, d_sp, d_hp, d_xs
+    return inner
 
 
 # ---- interleaved (virtual-stage) 1F1B --------------------------------
@@ -731,3 +756,69 @@ def interleaved_one_f_one_b(stage_fn, loss_fn, stage_params, head_params,
     if cast_dt is not None:
         d_xs = d_xs.astype(cast_dt)
     return loss, aux, d_sp, d_hp, d_xs
+
+
+# ---- static-analysis hooks (hvdlint) ---------------------------------
+
+SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b")
+
+
+def build_pipeline_inner(schedule, stage_fn, loss_fn=None, *, S, M,
+                         num_virtual=1, axis="pipe", aux_cotangent=0.0):
+    """Build a schedule's per-device manual program WITHOUT running it.
+
+    This is the program-builder hook ``horovod_tpu.analysis`` (hvdlint)
+    traces: the returned ``inner`` is exactly what the engines hand to
+    ``_pipe_spmd``, so linting it covers the real collective sequence —
+    and because it is traced with ``jax.make_jaxpr(axis_env=[(axis,
+    S)])`` rather than ``shard_map``, the check runs identically on
+    jax 0.4.x boxes (where the engines execute under vmap emulation).
+
+    ``schedule="gpipe"`` returns ``inner(sp, xs)``; the 1F1B variants
+    return ``inner(sp, hp, xs, largs)`` and require ``loss_fn``.
+    """
+    if schedule == "gpipe":
+        return _gpipe_inner(stage_fn, S, M, axis)
+    if loss_fn is None:
+        raise ValueError(f"schedule {schedule!r} requires loss_fn")
+    if schedule == "1f1b":
+        return _one_f_one_b_inner(stage_fn, loss_fn, S, M, axis,
+                                  aux_cotangent)
+    if schedule == "interleaved_1f1b":
+        sched = build_interleaved_schedule(S, int(num_virtual), M)
+        return _interleaved_inner(stage_fn, loss_fn, sched,
+                                  aux_cotangent, axis)
+    raise ValueError(f"unknown schedule {schedule!r}: expected one of "
+                     f"{SCHEDULES}")
+
+
+def predicted_collectives(schedule, *, S, M, num_virtual=1, axis="pipe",
+                          n_head_leaves=2):
+    """The ordered collective sequence a schedule's inner program MUST
+    emit, predicted from the host-side schedule structure — the ground
+    truth for hvdlint's C5 schedule-conformance check.
+
+    - gpipe: one activation ``ppermute`` per scan step (``M + S - 1``
+      steps), then the result and aux ``psum`` pair;
+    - 1f1b: one forward and one backward ``ppermute`` per lockstep slot
+      (``M + 2(S-1)`` slots), then the shared-gradient ``psum`` tail;
+    - interleaved_1f1b: two ``ppermute`` ring hops per slot, with the
+      slot count taken from :func:`build_interleaved_schedule` — the
+      SAME table the engine executes, so any engine/table drift is a
+      C5 error before launch.
+
+    ``n_head_leaves`` is the leaf count of the loss-head param tree
+    (llama: final_norm + lm_head = 2); the psum tail is those leaves
+    plus d_xs, loss, and aux. Returns ``[(prim_name, (axis,)), ...]``.
+    """
+    pp, ps = ("ppermute", (axis,)), ("psum", (axis,))
+    if schedule == "gpipe":
+        return [pp] * (M + S - 1) + [ps] * 2
+    tail = [ps] * (n_head_leaves + 3)
+    if schedule == "1f1b":
+        return [pp] * (2 * (M + 2 * (S - 1))) + tail
+    if schedule == "interleaved_1f1b":
+        sched = build_interleaved_schedule(S, int(num_virtual), M)
+        return [pp] * (2 * sched.n_slots) + tail
+    raise ValueError(f"unknown schedule {schedule!r}: expected one of "
+                     f"{SCHEDULES}")

@@ -27,6 +27,10 @@ import jax  # noqa: E402  (after the env setup above, before any backend use)
 
 jax.config.update("jax_platforms", "cpu")
 
+# hvdlint fixtures (hvdlint / hvdlint_shipped) for every test file —
+# see horovod_tpu/analysis/pytest_plugin.py.
+pytest_plugins = ("horovod_tpu.analysis.pytest_plugin",)
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -1,0 +1,202 @@
+"""hvdlint (horovod_tpu/analysis): seeded-bug detection + shipped-
+program cleanliness.
+
+Four deliberately-broken programs — one per static check class the
+last rounds' bugs motivated — must each fire the EXACT diagnostic
+(id + location); every shipped train-step/pipeline/optimizer
+combination must lint clean. The whole suite runs on jaxpr tracing
+with ``axis_env`` only: no shard_map, no multi-device mesh — which is
+precisely what keeps it green on the old-jax (0.4.x) CPU boxes where
+the pipeline engines execute under vmap emulation
+(``test_full_suite_without_shard_map`` pins that).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from horovod_tpu import analysis
+from horovod_tpu.analysis import programs
+
+pytestmark = pytest.mark.quick
+
+_ENV = [("data", 2), ("pipe", 2)]
+
+
+# ---- seeded bugs: each must fire its exact diagnostic ----------------
+
+def test_c1_cond_branches_with_divergent_collectives():
+    def prog(x):
+        return lax.cond(x.sum() > 0,
+                        lambda y: lax.psum(y, "data"),
+                        lambda y: y * 2.0, x)
+
+    diags = analysis.lint(prog, (jnp.ones(4),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C1"]
+    assert diags[0].severity == analysis.ERROR
+    assert "cond" in diags[0].path
+    assert "test_analysis_lint" in diags[0].source
+
+
+def test_c1_rank_dependent_switch_is_called_out():
+    """A switch predicate derived from lax.axis_index GUARANTEES ranks
+    take different branches — the diagnostic must say so."""
+    def prog(x):
+        return lax.switch(lax.axis_index("data") % 2,
+                          [lambda y: lax.psum(y, "data"),
+                           lambda y: y], x)
+
+    diags = analysis.lint(prog, (jnp.ones(4),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C1"]
+    assert "axis_index" in diags[0].message
+
+
+def test_c2_psum_over_undeclared_axis():
+    def prog(x):
+        return lax.psum(x, "rank")  # not a mesh axis
+
+    diags = analysis.lint(prog, (jnp.ones(4),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C2"]
+    assert "rank" in diags[0].message
+    # Auto-binding the unknown axis keeps the real trace location.
+    assert "test_analysis_lint" in diags[0].source
+
+
+def test_c2_fires_with_no_declared_axes_at_all():
+    """A collective over a typo'd axis in a program linted WITHOUT any
+    mesh/axis_env must still flag C2 (the auto-bound undeclared name is
+    ground truth enough); only a program with no collective axes at all
+    skips the check."""
+    d = analysis.lint(lambda x: lax.psum(x, "typo_axis"),
+                      (jnp.ones(4),))
+    assert [x.id for x in d] == ["C2"]
+    assert analysis.lint(lambda x: x * 2.0, (jnp.ones(4),)) == []
+
+
+def test_c1_taint_survives_scan_outputs():
+    """Rank taint must propagate through loop outputs: a switch
+    predicate accumulated from lax.axis_index inside a scan is still a
+    GUARANTEED divergence."""
+    def prog(x):
+        def step(c, _):
+            return c + lax.axis_index("data"), None
+        acc, _ = lax.scan(step, jnp.int32(0), jnp.arange(3))
+        return lax.switch(acc % 2,
+                          [lambda y: lax.psum(y, "data"),
+                           lambda y: y], x)
+
+    diags = analysis.lint(prog, (jnp.ones(4),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C1"]
+    assert "axis_index" in diags[0].message
+
+
+def test_c3_fp32_allreduce_of_bf16():
+    def prog(x):
+        return lax.psum(x.astype(jnp.float32), "data")  # stays f32
+
+    diags = analysis.lint(prog, (jnp.ones(64, jnp.bfloat16),),
+                          axis_env=_ENV)
+    assert [d.id for d in diags] == ["C3"]
+    assert diags[0].severity == analysis.WARNING
+    assert "bfloat16" in diags[0].message
+
+
+def test_c3_exempts_f32_accumulate_roundtrip():
+    """bf16 -> f32 -> psum -> bf16 is the recommended accumulate
+    pattern (and what the pipeline share() does) — NOT a finding."""
+    def prog(x):
+        return lax.psum(x.astype(jnp.float32),
+                        "data").astype(jnp.bfloat16)
+
+    assert analysis.lint(prog, (jnp.ones(64, jnp.bfloat16),),
+                         axis_env=_ENV) == []
+
+
+def test_c4_apply_jit_donating_unusable_buffer():
+    """The r6/r7 bug class: grads donated into an apply program whose
+    outputs are exactly params+opt — the donated grads can never alias
+    an output ('donated buffers were not usable')."""
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def apply_fn(grads, params, opt):
+        return params - 0.1 * grads, opt + 1.0
+
+    diags = analysis.lint(apply_fn, (jnp.ones(8),) * 3)
+    assert [d.id for d in diags] == ["C4"]
+    assert diags[0].path == "pjit:apply_fn"
+    assert "cannot alias any output" in diags[0].message
+
+
+def test_c4_clean_when_only_params_and_opt_donated():
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def apply_fn(grads, params, opt):
+        return params - 0.1 * grads, opt + 1.0
+
+    assert analysis.lint(apply_fn, (jnp.ones(8),) * 3) == []
+
+
+def test_c5_schedule_sequence_mismatch():
+    """An engine emitting one more ring hop than its host schedule
+    table predicts must be a C5 error."""
+    def prog(x):
+        def step(c, _):
+            return lax.ppermute(c, "pipe", [(0, 1), (1, 0)]), None
+        c, _ = lax.scan(step, x, jnp.arange(4))  # 4 hops...
+        return lax.psum(c, "pipe")
+
+    expect = [("ppermute", ("pipe",))] * 3 + [("psum", ("pipe",))]
+    diags = analysis.lint(prog, (jnp.ones(4),),
+                          axis_env=[("pipe", 2)],
+                          expect_collectives=expect)
+    assert [d.id for d in diags] == ["C5"]
+    assert "deviates" in diags[0].message
+
+
+def test_allowlist_suppresses_by_id_and_path():
+    def prog(x):
+        return lax.psum(x.astype(jnp.float32), "data")
+
+    x = jnp.ones(8, jnp.bfloat16)
+    assert analysis.lint(prog, (x,), axis_env=_ENV, allow=("C3",)) == []
+    [d] = analysis.lint(prog, (x,), axis_env=_ENV)
+    assert analysis.lint(prog, (x,), axis_env=_ENV,
+                         allow=(f"C3:{d.path}",)) == []
+
+
+# ---- shipped programs: every combination must lint clean -------------
+
+@pytest.mark.parametrize("name", programs.program_names())
+def test_shipped_program_is_clean(hvdlint_shipped, name):
+    hvdlint_shipped(name)
+
+
+@pytest.mark.parametrize("name", ["llama_train_step",
+                                  "pipeline_interleaved_1f1b"])
+def test_shipped_moe_program_is_clean(hvdlint_shipped, name):
+    hvdlint_shipped(name, config="tiny_moe")
+
+
+def test_full_suite_without_shard_map(monkeypatch):
+    """The analyzer must run end-to-end on boxes whose jax lacks
+    ``jax.shard_map`` (the 0.4.x CPU substrate, where pipelines execute
+    under vmap emulation). Force the attribute away and run the whole
+    shipped-program sweep."""
+    if hasattr(jax, "shard_map"):
+        monkeypatch.delattr(jax, "shard_map")
+    results = programs.lint_all()
+    assert set(results) == set(programs.program_names())
+    bad = {n: [d.format() for d in ds]
+           for n, ds in results.items() if ds}
+    assert not bad, bad
+
+
+def test_cli_single_program_and_exit_codes(capsys):
+    from horovod_tpu.analysis.lint import main
+
+    assert main(["--program", "pipeline_gpipe"]) == 0
+    out = capsys.readouterr().out
+    assert "pipeline_gpipe: clean" in out
+    assert main(["--list"]) == 0
+    assert "llama_train_step" in capsys.readouterr().out

@@ -658,14 +658,16 @@ def test_split_step_with_fused_master_trains():
     assert losses[-1] < losses[0], losses
 
 
-def test_apply_jit_emits_no_donation_warning():
+def test_apply_jit_emits_no_donation_warning(hvdlint):
     """The split step's apply jit must donate ONLY buffers XLA can
     actually alias (params + optimizer state; gradients have no
     matching output). The fp32-master path used to warn "Some donated
     buffers were not usable" on every compute-cast leaf (BENCH r5
     tail); this pins the r6 argument-layout fix for BOTH the fused
     master-adam apply and the optax split apply, on bf16-param
-    configs where grads/params/master dtypes actually differ."""
+    configs where grads/params/master dtypes actually differ — at
+    runtime (the XLA warning) AND statically (hvdlint's C4 check over
+    the same step program, the pre-commit form of this class)."""
     import warnings
 
     from horovod_tpu.parallel import (
@@ -683,6 +685,8 @@ def test_apply_jit_emits_no_donation_warning():
     for tx in (fused_master_adam(1e-2), optax.adam(1e-2)):
         ts = make_split_train_step(
             lambda p, d: llama_loss(p, d, cfg), tx, microbatches=2)
+        carry0 = jax.eval_shape(ts.init, params)
+        hvdlint(ts.step, (carry0, batch))
         with warnings.catch_warnings(record=True) as caught:
             warnings.simplefilter("always")
             loss, carry = ts.step(ts.init(params), batch)

@@ -1,0 +1,121 @@
+"""ThreadSanitizer smoke of the native core's multi-threaded paths.
+
+``make core-tsan`` builds ``horovod_tpu/lib/libhvdtpu_core_tsan.so``
+(``-fsanitize=thread``); when that .so is present this test drives
+controller + tensor_queue through a multi-threaded allreduce workload
+in a subprocess (the TSan runtime must be LD_PRELOADed before python
+starts, hence the subprocess) and fails on any data-race report.
+
+When the sanitized .so has not been built — the normal tier-1 state,
+since the build costs ~25 s — the test SKIPS: sanitizer runs are an
+opt-in lane (``make core-tsan && pytest tests/single/
+test_sanitizer_smoke.py``). The knob/counter surfaces the workload
+hammers (timeline start/stop churn, fusion-threshold and cycle-time
+setters, response-cache stats) are exactly the spots a runtime rebuild
+tends to leave racy; the current core passes because they are atomics
+or mutex-protected by design, and this test pins that property.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+TSAN_LIB = os.path.join(REPO, "horovod_tpu", "lib",
+                        "libhvdtpu_core_tsan.so")
+
+_DRIVER = textwrap.dedent("""
+    import os, threading
+    import numpy as np
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+              "HOROVOD_LOCAL_SIZE"):
+        os.environ.pop(k, None)
+    from horovod_tpu.common import basics
+    from horovod_tpu.common import eager_ops as ops
+
+    b = basics.HorovodBasics()
+    b.init()
+    stop = threading.Event()
+
+    def churner():
+        # Timeline lifecycle + runtime knobs + cache counters from a
+        # non-loop thread, concurrent with enqueues below.
+        i = 0
+        while not stop.is_set():
+            try:
+                b.start_timeline("/tmp/hvdtpu_tsan_timeline.json")
+            except ValueError:
+                pass
+            b.lib.hvdtpu_set_fusion_threshold_bytes((1 << 20) + i)
+            b.lib.hvdtpu_set_cycle_time_ms(0.5 + (i % 3))
+            b.response_cache_stats()
+            b.stop_timeline()
+            i += 1
+
+    def worker(tid):
+        for i in range(15):
+            x = np.full((256,), tid, np.float32)
+            ops.allreduce_async(x, f"w{tid}_i{i}").synchronize()
+            ops.allgather_async(x, f"ag{tid}_i{i}").synchronize()
+
+    c = threading.Thread(target=churner)
+    c.start()
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    c.join()
+    b.shutdown()
+    print("SMOKE_OK")
+""")
+
+
+def _find_tsan_runtime():
+    """The libtsan.so to LD_PRELOAD (the host python is uninstrumented,
+    so the runtime must come in before interpreter start)."""
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libtsan.so"],
+            capture_output=True, text=True, timeout=30).stdout.strip()
+        if out and os.sep in out and os.path.exists(out):
+            return out
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    for cand in ("/usr/lib/x86_64-linux-gnu/libtsan.so.0",
+                 "/usr/lib/x86_64-linux-gnu/libtsan.so"):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def test_tsan_multithreaded_allreduce_smoke():
+    if not os.path.exists(TSAN_LIB):
+        pytest.skip("TSan core not built (run `make core-tsan`)")
+    runtime = _find_tsan_runtime()
+    if runtime is None:
+        pytest.skip("no libtsan runtime on this host")
+
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": runtime,
+        "HVDTPU_CORE_LIB": os.path.basename(TSAN_LIB),
+        "TSAN_OPTIONS": "exitcode=66 halt_on_error=0",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    proc = subprocess.run([sys.executable, "-c", _DRIVER],
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+    out = proc.stdout + proc.stderr
+    if proc.returncode != 0 and "ThreadSanitizer" not in out:
+        pytest.skip(f"TSan subprocess unusable on this host: "
+                    f"rc={proc.returncode} {out[-400:]}")
+    assert "WARNING: ThreadSanitizer" not in out, out[-4000:]
+    assert proc.returncode == 0, out[-2000:]
+    assert "SMOKE_OK" in out
