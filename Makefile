@@ -21,7 +21,8 @@ PYTHON ?= python3
 TSAN_OUT := horovod_tpu/lib/libhvdtpu_core_tsan.so
 ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
-.PHONY: core tf clean test test-quick lint lint-csrc core-tsan core-asan
+.PHONY: core tf clean test test-quick lint lint-csrc core-tsan core-asan \
+  metrics-smoke
 
 core: $(OUT)
 
@@ -94,3 +95,9 @@ test: core
 # and the elastic driver path (the full suite is ~25 min).
 test-quick: core
 	python -m pytest tests/ -m quick -x -q
+
+# Telemetry smoke: 2 real eager ranks, exact byte accounting in the
+# metrics snapshot, cache steady state, per-rank timelines merged with
+# straggler attribution (horovod_tpu/telemetry/smoke.py; ~10 s).
+metrics-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.smoke

@@ -386,13 +386,46 @@ def run_eager(cfg, batch, seq, steps, label):
         xla_ici.enable()
 
     step, carry, n_params = make_eager_step(cfg)
+    data = _data(cfg, batch, seq)
     try:
-        dt = _timed(step, carry, _data(cfg, batch, seq), steps,
+        from horovod_tpu import telemetry
+        from horovod_tpu.telemetry import predict
+
+        # Static predictor: the SAME grad-tree byte volume the
+        # telemetry tests reconcile against (dtype-exact — eval_shape
+        # of the true grad tree, not n_params x an assumed width).
+        predicted = predict.grad_tree_bytes(
+            lambda p, d: llama_loss(p, d, cfg), carry[0], data)
+        # Wire-goodput rides along for free: the loop runs steps+1
+        # steps (compile step included) and the core's byte counters
+        # are read before/after (telemetry row below).
+        snap0 = telemetry.total_collective_bytes()
+        dt = _timed(step, carry, data, steps,
                     "llama_train_step_mfu_eager")
+        moved = telemetry.total_collective_bytes() - snap0
+        snap = telemetry.snapshot()
     finally:
         hvd.shutdown()
-    return _mfu_row("llama_train_step_mfu_eager", label, n_params, cfg,
-                    batch, seq, dt)
+    per_step = moved / (steps + 1) if steps else moved
+    telemetry_row = {
+        "metric": "telemetry_eager",
+        # Steady-state goodput: per-step payload over the post-compile
+        # step time _timed measured (wall including the compile step
+        # would underreport by the compile/step ratio).
+        "wire_goodput_gbps": round(per_step / dt / 1e9, 4),
+        "bytes_per_step": per_step,
+        "predicted_bytes_per_step": predicted,
+        "byte_reconciliation": round(per_step / predicted, 4)
+        if predicted else None,
+        "cache_hit_rate": round(snap["cache"]["hit_rate"], 4),
+        "cycle_stalls": snap["cycle"]["stalls"],
+        "unit": "steady-state collective payload GB/s, eager lane "
+                "(hvd.metrics() deltas; predicted = grad-tree bytes "
+                "via telemetry.predict)",
+    }
+    return [telemetry_row,
+            _mfu_row("llama_train_step_mfu_eager", label, n_params, cfg,
+                     batch, seq, dt)]
 
 
 def full_run_plan(batch, seq, steps):
@@ -678,8 +711,10 @@ def main():
         # Print each row AS PRODUCED: a later config failing must not
         # discard minutes of already-measured rows. gc between rows
         # returns every stale device buffer before the next config
-        # allocates.
-        print(json.dumps(row), flush=True)
+        # allocates. A list is several rows (run_eager yields its
+        # telemetry goodput row alongside the MFU headline).
+        for r in (row if isinstance(row, list) else [row]):
+            print(json.dumps(r), flush=True)
         gc.collect()
 
     if "--lint" in argv:

@@ -139,6 +139,10 @@ struct TensorTableEntry {
   std::vector<int64_t> output_shape;
   // received splits for alltoall
   std::vector<int64_t> recv_splits;
+  // Steady-clock enqueue time (us), set by EnqueueEntry; 0 on entries the
+  // core synthesizes itself (joined-rank zeros). Feeds the queue-latency
+  // histogram in the metrics registry (metrics.h).
+  int64_t enqueue_us = 0;
 
   int64_t NumElements() const {
     int64_t n = 1;

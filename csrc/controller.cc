@@ -5,6 +5,7 @@
 #include <sstream>
 
 #include "logging.h"
+#include "metrics.h"
 #include "wire.h"
 
 namespace hvdtpu {
@@ -251,11 +252,28 @@ void Controller::HandleRequestList(const RequestList& list, int from_rank) {
     auto& pt = message_table_[TableKey(req)];
     if (pt.ranks_seen.empty()) {
       pt.first_seen = std::chrono::steady_clock::now();
+      pt.first_round = round_;
     }
     if (pt.ranks_seen.count(req.request_rank)) continue;  // duplicate
     pt.ranks_seen.insert(req.request_rank);
     pt.requests.push_back(req);
+    bool was_queued = pt.queued;
     MaybePromote(TableKey(req), pt);
+    if (!was_queued && pt.queued && pt.ranks_seen.size() > 1 &&
+        round_ > pt.first_round) {
+      // This request completed readiness in a LATER round than the
+      // first arrival: its rank genuinely kept the tensor waiting, and
+      // first->last spread is the negotiation skew. Same-round
+      // completions are not attributable (the gather's fixed rank
+      // order would masquerade as lateness). Aggregated per rank this
+      // is the coordinator's live straggler table (the trace-merge
+      // report computes the same offline).
+      GlobalMetrics().RecordStraggler(
+          req.request_rank,
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              std::chrono::steady_clock::now() - pt.first_seen)
+              .count());
+    }
   }
   if (new_join) {
     // A new join can complete readiness for any pending tensor.
@@ -527,8 +545,11 @@ void Controller::HandleCacheBits(const RequestList& list, int from_rank,
       continue;
     }
     auto& pb = bit_table_[(int32_t)pos];
-    if (pb.ranks.empty()) pb.first_seen = std::chrono::steady_clock::now();
-    pb.ranks.insert(from_rank);
+    if (pb.ranks.empty()) {
+      pb.first_seen = std::chrono::steady_clock::now();
+      pb.first_round = round_;
+    }
+    if (pb.ranks.insert(from_rank).second) pb.last_rank = from_rank;
   }
 }
 
@@ -548,7 +569,20 @@ void Controller::CollectCacheHits(ResponseList* list) {
         break;
       }
     }
-    if (done) completed.push_back(pos);
+    if (done) {
+      completed.push_back(pos);
+      const PendingBits& pb = bit_table_[pos];
+      if (pb.ranks.size() > 1 && round_ > pb.first_round) {
+        // Steady-state (bitvector) stragglers matter most: a training
+        // loop spends nearly every cycle here, so skew measured only on
+        // full negotiations would go blind after warmup.
+        GlobalMetrics().RecordStraggler(
+            pb.last_rank,
+            std::chrono::duration_cast<std::chrono::microseconds>(
+                std::chrono::steady_clock::now() - pb.first_seen)
+                .count());
+      }
+    }
   }
   // Group consecutive fusable allreduce hits; every rank rebuilds the same
   // fused Response from the group. Reference analog: cached responses join
@@ -697,6 +731,7 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
   RequestList my_list = BuildRequestList(std::move(requests), should_shutdown);
 
   if (cfg_.rank == 0) {
+    round_++;
     std::vector<int64_t> evictions;
     HandleCacheBits(my_list, 0, &evictions);
     HandleRequestList(my_list, 0);

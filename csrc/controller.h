@@ -108,6 +108,7 @@ class Controller {
     std::vector<Request> requests;          // one per reporting rank
     std::unordered_set<int32_t> ranks_seen;
     std::chrono::steady_clock::time_point first_seen;
+    int64_t first_round = 0;  // negotiation round of the first request
     bool queued = false;  // already pushed on ready_queue_
   };
   // A tensor is ready once every member of its process set has either
@@ -147,8 +148,15 @@ class Controller {
   struct PendingBits {
     std::unordered_set<int32_t> ranks;
     std::chrono::steady_clock::time_point first_seen;
+    int64_t first_round = 0;  // negotiation round of the first bit
+    int32_t last_rank = -1;  // most recent bit's sender (straggler table)
   };
   std::unordered_map<int32_t, PendingBits> bit_table_;
+  // Coordinator negotiation-round counter: straggler attribution only
+  // records arrivals that completed in a LATER round than they opened —
+  // within one round the gather processes ranks in fixed order, so
+  // "last arrival" would just mean "highest rank number".
+  int64_t round_ = 0;
 };
 
 }  // namespace hvdtpu

@@ -1,0 +1,229 @@
+#include "metrics.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdarg>
+#include <cstdio>
+#include <cstring>
+
+namespace hvdtpu {
+
+int64_t MetricsNowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+namespace {
+
+int BucketOf(int64_t us) {
+  int b = 0;
+  while (us > 1 && b < LatencyHistogram::kBuckets - 1) {
+    us >>= 1;
+    b++;
+  }
+  return b;
+}
+
+void AtomicMin(std::atomic<int64_t>& a, int64_t v) {
+  int64_t cur = a.load(std::memory_order_relaxed);
+  while (v < cur &&
+         !a.compare_exchange_weak(cur, v, std::memory_order_relaxed)) {
+  }
+}
+
+void AtomicMax(std::atomic<int64_t>& a, int64_t v) {
+  int64_t cur = a.load(std::memory_order_relaxed);
+  while (v > cur &&
+         !a.compare_exchange_weak(cur, v, std::memory_order_relaxed)) {
+  }
+}
+
+void Append(std::string& out, const char* fmt, ...) {
+  char buf[256];
+  va_list args;
+  va_start(args, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, args);
+  va_end(args);
+  out += buf;
+}
+
+// Op-class names aligned with Response::ResponseType values.
+const char* kOpNames[Metrics::kOpClasses] = {
+    "allreduce", "allgather", "broadcast", "alltoall",
+    "reducescatter", "join", "barrier", "error"};
+
+void AppendOps(std::string& out, const char* key,
+               const OpCounters (&ops)[Metrics::kOpClasses]) {
+  Append(out, "\"%s\":{", key);
+  bool first = true;
+  for (int i = 0; i < Metrics::kOpClasses; i++) {
+    int64_t r = ops[i].responses.load(std::memory_order_relaxed);
+    int64_t t = ops[i].tensors.load(std::memory_order_relaxed);
+    int64_t b = ops[i].bytes.load(std::memory_order_relaxed);
+    if (r == 0 && t == 0 && b == 0) continue;  // keep snapshots compact
+    Append(out, "%s\"%s\":{\"responses\":%lld,\"tensors\":%lld,"
+                "\"bytes\":%lld}",
+           first ? "" : ",", kOpNames[i], (long long)r, (long long)t,
+           (long long)b);
+    first = false;
+  }
+  out += "},";
+}
+
+}  // namespace
+
+void LatencyHistogram::Record(int64_t us) {
+  if (us < 0) us = 0;
+  count_.fetch_add(1, std::memory_order_relaxed);
+  sum_.fetch_add(us, std::memory_order_relaxed);
+  if (count_.load(std::memory_order_relaxed) == 1) {
+    min_.store(us, std::memory_order_relaxed);
+  }
+  AtomicMin(min_, us);
+  AtomicMax(max_, us);
+  buckets_[BucketOf(us)].fetch_add(1, std::memory_order_relaxed);
+}
+
+void LatencyHistogram::Reset() {
+  count_.store(0);
+  sum_.store(0);
+  min_.store(0);
+  max_.store(0);
+  for (auto& b : buckets_) b.store(0);
+}
+
+int64_t LatencyHistogram::Percentile(double q, const int64_t* b,
+                                     int64_t total) const {
+  if (total <= 0) return 0;
+  int64_t target = (int64_t)(q * (double)total);
+  if (target < 1) target = 1;
+  int64_t seen = 0;
+  int64_t mx = max_.load(std::memory_order_relaxed);
+  for (int i = 0; i < kBuckets; i++) {
+    seen += b[i];
+    if (seen >= target) {
+      // Upper bound of bucket i (2^(i+1) us; bucket 0 covers [0,2)),
+      // clamped to the observed max so p50 can never exceed it.
+      int64_t bound = i >= 62 ? INT64_MAX : ((int64_t)1 << (i + 1));
+      return bound < mx ? bound : mx;
+    }
+  }
+  return mx;
+}
+
+std::string LatencyHistogram::Json() const {
+  // Copy buckets once so count/percentiles come from one view.
+  int64_t b[kBuckets];
+  int64_t total = 0;
+  for (int i = 0; i < kBuckets; i++) {
+    b[i] = buckets_[i].load(std::memory_order_relaxed);
+    total += b[i];
+  }
+  std::string out = "{";
+  Append(out, "\"count\":%lld,\"sum_us\":%lld,\"min_us\":%lld,"
+              "\"max_us\":%lld,\"p50_us\":%lld,\"p90_us\":%lld,"
+              "\"p99_us\":%lld}",
+         (long long)total, (long long)sum_.load(std::memory_order_relaxed),
+         (long long)(total ? min_.load(std::memory_order_relaxed) : 0),
+         (long long)max_.load(std::memory_order_relaxed),
+         (long long)Percentile(0.50, b, total),
+         (long long)Percentile(0.90, b, total),
+         (long long)Percentile(0.99, b, total));
+  return out;
+}
+
+void Metrics::RecordStraggler(int rank, int64_t skew_us) {
+  {
+    std::lock_guard<std::mutex> lk(straggler_mutex_);
+    if ((int)straggler_counts_.size() <= rank) {
+      straggler_counts_.resize(rank + 1, 0);
+    }
+    straggler_counts_[rank]++;
+  }
+  straggler_skew_us.Record(skew_us);
+}
+
+void Metrics::Reset() {
+  for (auto& o : host_ops) {
+    o.responses.store(0);
+    o.tensors.store(0);
+    o.bytes.store(0);
+  }
+  for (auto& o : device_ops) {
+    o.responses.store(0);
+    o.tensors.store(0);
+    o.bytes.store(0);
+  }
+  negotiation_us.Reset();
+  queue_us.Reset();
+  wire_us.Reset();
+  straggler_skew_us.Reset();
+  cycles.store(0);
+  cycle_stalls.store(0);
+  cycle_overrun_us.store(0);
+  fused_responses.store(0);
+  fusion_fill_bytes.store(0);
+  fusion_capacity_bytes.store(0);
+  errors.store(0);
+  std::lock_guard<std::mutex> lk(straggler_mutex_);
+  straggler_counts_.clear();
+}
+
+std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
+  std::string out = "{";
+  Append(out, "\"initialized\":%s,\"rank\":%d,\"size\":%d,",
+         info.initialized ? "true" : "false", info.rank, info.size);
+
+  AppendOps(out, "ops", host_ops);
+  AppendOps(out, "device_ops", device_ops);
+
+  out += "\"negotiation_us\":" + negotiation_us.Json() + ",";
+  out += "\"queue_us\":" + queue_us.Json() + ",";
+  out += "\"wire_us\":" + wire_us.Json() + ",";
+
+  int64_t fr = fused_responses.load(std::memory_order_relaxed);
+  int64_t fb = fusion_fill_bytes.load(std::memory_order_relaxed);
+  int64_t fc = fusion_capacity_bytes.load(std::memory_order_relaxed);
+  Append(out, "\"fusion\":{\"fused_responses\":%lld,\"fill_bytes\":%lld,"
+              "\"capacity_bytes\":%lld,\"fill_ratio\":%.6f},",
+         (long long)fr, (long long)fb, (long long)fc,
+         fc > 0 ? (double)fb / (double)fc : 0.0);
+
+  Append(out, "\"cycle\":{\"count\":%lld,\"stalls\":%lld,"
+              "\"overrun_us\":%lld},",
+         (long long)cycles.load(std::memory_order_relaxed),
+         (long long)cycle_stalls.load(std::memory_order_relaxed),
+         (long long)cycle_overrun_us.load(std::memory_order_relaxed));
+
+  double lookups = (double)(info.cache_hits + info.cache_misses);
+  Append(out, "\"cache\":{\"hits\":%lld,\"misses\":%lld,\"entries\":%lld,"
+              "\"hit_bytes\":%lld,\"hit_rate\":%.6f},",
+         (long long)info.cache_hits, (long long)info.cache_misses,
+         (long long)info.cache_entries, (long long)info.cache_hit_bytes,
+         lookups > 0 ? (double)info.cache_hits / lookups : 0.0);
+
+  {
+    std::lock_guard<std::mutex> lk(straggler_mutex_);
+    out += "\"straggler\":{\"last_rank_counts\":[";
+    for (size_t i = 0; i < straggler_counts_.size(); i++) {
+      Append(out, "%s%lld", i ? "," : "",
+             (long long)straggler_counts_[i]);
+    }
+    out += "],\"skew_us\":" + straggler_skew_us.Json() + "},";
+  }
+
+  Append(out, "\"errors\":%lld,",
+         (long long)errors.load(std::memory_order_relaxed));
+  Append(out, "\"knobs\":{\"fusion_threshold_bytes\":%lld,"
+              "\"cycle_time_ms\":%.6f}}",
+         (long long)info.fusion_threshold_bytes, info.cycle_time_ms);
+  return out;
+}
+
+Metrics& GlobalMetrics() {
+  static Metrics* m = new Metrics();  // never destroyed: API threads may
+  return *m;                          // record during process teardown
+}
+
+}  // namespace hvdtpu

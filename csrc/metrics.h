@@ -1,0 +1,126 @@
+// Runtime metrics registry for the native core: per-op-class counters,
+// latency histograms, fusion/cycle/cache accounting, and coordinator-side
+// straggler attribution, exported as one JSON snapshot through
+// hvdtpu_metrics_snapshot() (operations.cc).
+//
+// Reference analog: none in-core — upstream Horovod's only windows are the
+// Chrome timeline and the autotune log. This registry is the live-counter
+// layer those artifacts lack: everything the background loop already
+// computes to make decisions (response-cache verdicts, fusion packing,
+// cycle pacing, arrival order at the coordinator) becomes observable.
+//
+// Concurrency: recorders are called from the background coordination
+// thread and (enqueue timestamps aside) never from API threads; the
+// snapshot reader runs on an arbitrary API thread. All counters are
+// relaxed atomics — a snapshot is a consistent-enough view, not a
+// linearizable one — except the per-rank straggler table, which is small
+// and mutex-guarded.
+
+#ifndef HVDTPU_METRICS_H
+#define HVDTPU_METRICS_H
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+int64_t MetricsNowUs();  // steady-clock microseconds (monotonic)
+
+// Log2-bucketed microsecond histogram: bucket i holds values in
+// [2^i, 2^(i+1)). Percentiles are read off the bucket CDF at upper bucket
+// bounds — exact enough for latency triage, constant memory, lock-free.
+class LatencyHistogram {
+ public:
+  static constexpr int kBuckets = 40;  // covers ~2^39 us (~6 days)
+
+  void Record(int64_t us);
+  void Reset();
+  int64_t count() const { return count_.load(std::memory_order_relaxed); }
+  // {"count":..,"sum_us":..,"min_us":..,"max_us":..,"p50_us":..,
+  //  "p90_us":..,"p99_us":..}
+  std::string Json() const;
+
+ private:
+  int64_t Percentile(double q, const int64_t* b, int64_t total) const;
+
+  std::atomic<int64_t> count_{0};
+  std::atomic<int64_t> sum_{0};
+  std::atomic<int64_t> min_{0};  // valid only when count_ > 0
+  std::atomic<int64_t> max_{0};
+  std::atomic<int64_t> buckets_[kBuckets] = {};
+};
+
+// Counts for one op class on one plane (host ring / device XLA).
+struct OpCounters {
+  std::atomic<int64_t> responses{0};  // fused responses executed
+  std::atomic<int64_t> tensors{0};    // tensors covered (>= responses)
+  std::atomic<int64_t> bytes{0};      // payload bytes moved
+};
+
+class Metrics {
+ public:
+  // Indexed by Response::ResponseType (0..7; 7 = ERROR).
+  static constexpr int kOpClasses = 8;
+
+  OpCounters host_ops[kOpClasses];
+  OpCounters device_ops[kOpClasses];
+
+  LatencyHistogram negotiation_us;  // per-cycle ComputeResponseList wall
+  LatencyHistogram queue_us;        // tensor enqueue -> execution start
+  LatencyHistogram wire_us;         // one host transport call (ring span)
+  LatencyHistogram straggler_skew_us;  // coordinator: first->last arrival
+
+  std::atomic<int64_t> cycles{0};
+  std::atomic<int64_t> cycle_stalls{0};      // loop overran its budget
+  std::atomic<int64_t> cycle_overrun_us{0};  // total overrun beyond budget
+
+  std::atomic<int64_t> fused_responses{0};   // multi-tensor allreduces
+  std::atomic<int64_t> fusion_fill_bytes{0};     // packed payload
+  std::atomic<int64_t> fusion_capacity_bytes{0};  // threshold at pack time
+
+  std::atomic<int64_t> errors{0};  // ERROR responses surfaced
+
+  void RecordStraggler(int rank, int64_t skew_us);
+  void Reset();
+
+  // Runtime context the snapshot embeds alongside the counters (the
+  // registry itself outlives init/shutdown; these belong to GlobalState).
+  struct RuntimeInfo {
+    bool initialized = false;
+    int rank = -1, size = 0;
+    int64_t fusion_threshold_bytes = 0;
+    double cycle_time_ms = 0;
+    int64_t cache_hits = 0, cache_misses = 0, cache_entries = 0;
+    int64_t cache_hit_bytes = 0;
+  };
+  std::string SnapshotJson(const RuntimeInfo& info) const;
+
+ private:
+  mutable std::mutex straggler_mutex_;
+  std::vector<int64_t> straggler_counts_;  // index = rank arriving last
+};
+
+// Process-wide registry; survives shutdown/re-init so counters stay
+// monotonic for the lifetime of the process (scrapers diff snapshots).
+Metrics& GlobalMetrics();
+
+// RAII wall-clock span recorded into a histogram on destruction.
+class ScopedLatency {
+ public:
+  explicit ScopedLatency(LatencyHistogram& h)
+      : hist_(h), start_us_(MetricsNowUs()) {}
+  ~ScopedLatency() { hist_.Record(MetricsNowUs() - start_us_); }
+  ScopedLatency(const ScopedLatency&) = delete;
+  ScopedLatency& operator=(const ScopedLatency&) = delete;
+
+ private:
+  LatencyHistogram& hist_;
+  int64_t start_us_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_METRICS_H

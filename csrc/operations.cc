@@ -5,6 +5,7 @@
 
 #include "operations.h"
 
+#include <algorithm>
 #include <atomic>
 #include <unordered_set>
 #include <condition_variable>
@@ -16,6 +17,7 @@
 #include "controller.h"
 #include "logging.h"
 #include "message.h"
+#include "metrics.h"
 #include "parameter_manager.h"
 #include "process_set.h"
 #include "ring_ops.h"
@@ -190,8 +192,12 @@ Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
     }
     ScaleBuffer(e.output, e.NumElements(), e.dtype, e.prescale_factor);
     st.timeline.ActivityStart(e.name, "RING_ALLREDUCE");
-    Status s = RingAllreduce(st, dp, e.output, e.NumElements(), e.dtype,
-                             e.reduce_op);
+    Status s;
+    {
+      ScopedLatency wire(GlobalMetrics().wire_us);
+      s = RingAllreduce(st, dp, e.output, e.NumElements(), e.dtype,
+                        e.reduce_op);
+    }
     st.timeline.ActivityEnd(e.name);
     if (!s.ok()) return s;
     ApplyPostOp(e, e.output, e.NumElements(), dp->size());
@@ -212,10 +218,24 @@ Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
     st.timeline.ActivityEnd(e.name);
     off += e.SizeBytes();
   }
+  // Fusion-buffer fill accounting: how much of the threshold one fused
+  // round actually packed (a persistently low ratio means the cycle time
+  // is draining the queue before the buffer fills — an autotune signal).
+  {
+    Metrics& m = GlobalMetrics();
+    m.fused_responses.fetch_add(1, std::memory_order_relaxed);
+    m.fusion_fill_bytes.fetch_add(total, std::memory_order_relaxed);
+    m.fusion_capacity_bytes.fetch_add(st.fusion_threshold.load(),
+                                      std::memory_order_relaxed);
+  }
   DataType dt = entries[0].dtype;
   int64_t count = total / DataTypeSize(dt);
   for (auto& e : entries) st.timeline.ActivityStart(e.name, "RING_ALLREDUCE");
-  Status s = RingAllreduce(st, dp, base, count, dt, entries[0].reduce_op);
+  Status s;
+  {
+    ScopedLatency wire(GlobalMetrics().wire_us);
+    s = RingAllreduce(st, dp, base, count, dt, entries[0].reduce_op);
+  }
   for (auto& e : entries) st.timeline.ActivityEnd(e.name);
   if (!s.ok()) return s;
   off = 0;
@@ -245,8 +265,12 @@ Status ExecuteEntry(GlobalState& st, DataPlane* dp,
       }
       e.managed_output.resize((size_t)total);
       st.timeline.ActivityStart(e.name, "RING_ALLGATHER");
-      Status s = dp->Allgatherv(e.input, e.managed_output.data(),
-                                bytes_per_rank);
+      Status s;
+      {
+        ScopedLatency wire(GlobalMetrics().wire_us);
+        s = dp->Allgatherv(e.input, e.managed_output.data(),
+                           bytes_per_rank);
+      }
       st.timeline.ActivityEnd(e.name);
       if (!s.ok()) return s;
       e.output_shape = e.shape;
@@ -266,7 +290,11 @@ Status ExecuteEntry(GlobalState& st, DataPlane* dp,
             std::to_string(e.process_set_id));
       }
       st.timeline.ActivityStart(e.name, "RING_BCAST");
-      Status s = dp->Broadcast(e.output, e.SizeBytes(), root);
+      Status s;
+      {
+        ScopedLatency wire(GlobalMetrics().wire_us);
+        s = dp->Broadcast(e.output, e.SizeBytes(), root);
+      }
       st.timeline.ActivityEnd(e.name);
       return s;
     }
@@ -299,8 +327,11 @@ Status ExecuteEntry(GlobalState& st, DataPlane* dp,
       }
       e.managed_output.resize((size_t)total_recv_bytes);
       st.timeline.ActivityStart(e.name, "ALLTOALL");
-      s = dp->Alltoallv(e.input, send_bytes, e.managed_output.data(),
-                        recv_bytes);
+      {
+        ScopedLatency wire(GlobalMetrics().wire_us);
+        s = dp->Alltoallv(e.input, send_bytes, e.managed_output.data(),
+                          recv_bytes);
+      }
       st.timeline.ActivityEnd(e.name);
       if (!s.ok()) return s;
       e.output_shape = e.shape;
@@ -337,8 +368,12 @@ Status ExecuteEntry(GlobalState& st, DataPlane* dp,
         in = scaled.data();
       }
       st.timeline.ActivityStart(e.name, "RING_REDUCESCATTER");
-      Status s = dp->ReduceScatterv(in, e.managed_output.data(),
-                                    elems_per_rank, e.dtype, e.reduce_op);
+      Status s;
+      {
+        ScopedLatency wire(GlobalMetrics().wire_us);
+        s = dp->ReduceScatterv(in, e.managed_output.data(),
+                               elems_per_rank, e.dtype, e.reduce_op);
+      }
       st.timeline.ActivityEnd(e.name);
       if (!s.ok()) return s;
       ApplyPostOp(e, e.managed_output.data(), elems_per_rank[dp->rank()],
@@ -481,6 +516,45 @@ Status ExecuteDeviceResponse(GlobalState& st, const Response& response) {
   return Status::OK();
 }
 
+// Fold one executed response into the metrics registry: op-class
+// counts/tensors, payload bytes per plane, and per-entry queue latency.
+void AccountResponse(const Response& response,
+                     const std::vector<TensorTableEntry>& entries,
+                     const Status& status) {
+  Metrics& m = GlobalMetrics();
+  int rt = (int)response.response_type;
+  if (rt < 0 || rt >= Metrics::kOpClasses) return;
+  OpCounters& oc =
+      (response.device == 1 ? m.device_ops : m.host_ops)[rt];
+  oc.responses.fetch_add(1, std::memory_order_relaxed);
+  oc.tensors.fetch_add((int64_t)response.tensor_names.size(),
+                       std::memory_order_relaxed);
+  int64_t bytes = 0;
+  if (response.device == 1) {
+    // Device payloads never touch host buffers; the negotiated shapes
+    // are the source of truth for what moved over ICI.
+    bytes = ShapesTotalBytes(response);
+  } else {
+    switch (response.response_type) {
+      case Response::ResponseType::ALLREDUCE:
+      case Response::ResponseType::BROADCAST:
+      case Response::ResponseType::REDUCESCATTER:
+        for (auto& e : entries) bytes += e.SizeBytes();
+        break;
+      case Response::ResponseType::ALLGATHER:
+      case Response::ResponseType::ALLTOALL:
+        for (auto& e : entries) {
+          bytes += (int64_t)e.managed_output.size();
+        }
+        break;
+      default:
+        break;
+    }
+  }
+  oc.bytes.fetch_add(bytes, std::memory_order_relaxed);
+  if (!status.ok()) m.errors.fetch_add(1, std::memory_order_relaxed);
+}
+
 void ExecuteResponse(GlobalState& st, const Response& response) {
   if (response.response_type == Response::ResponseType::JOIN) {
     auto join_entries = st.tensor_queue.GetTensorEntriesFromResponse(response);
@@ -529,6 +603,15 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
     // on-device for names this rank never enqueued.
     SynthesizeJoinedEntries(st, response, &entries, &zero_bufs);
   }
+  {
+    // Queue latency: caller enqueue -> execution start (covers local
+    // waiting plus the coordinator holding out for straggler ranks).
+    int64_t now = MetricsNowUs();
+    Metrics& m = GlobalMetrics();
+    for (auto& e : entries) {
+      if (e.enqueue_us > 0) m.queue_us.Record(now - e.enqueue_us);
+    }
+  }
   Status status = Status::OK();
   if (!ps_status.ok()) {
     status = ps_status;
@@ -545,6 +628,7 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
       if (!status.ok()) break;
     }
   }
+  AccountResponse(response, entries, status);
   for (auto& e : entries) {
     st.timeline.EntryDone(e.name);
     st.handles.MarkDone(e.handle, status, &e);
@@ -566,9 +650,17 @@ void BackgroundThreadLoop(GlobalState& st) {
     if (st.timeline_mark_cycles) st.timeline.MarkCycle();
     std::vector<Request> requests = st.tensor_queue.PopMessages();
     for (auto& r : requests) st.timeline.NegotiateStart(r.tensor_name);
+    bool had_requests = !requests.empty();
+    int64_t negotiate_start_us = MetricsNowUs();
     ResponseList response_list;
     Status s = st.controller->ComputeResponseList(
         std::move(requests), st.shutdown_requested.load(), &response_list);
+    // Negotiation latency per ACTIVE cycle (idle gather/bcast rounds
+    // would swamp the histogram with sub-cycle-time noise).
+    if (had_requests || !response_list.responses.empty()) {
+      GlobalMetrics().negotiation_us.Record(MetricsNowUs() -
+                                            negotiate_start_us);
+    }
     if (!s.ok()) {
       LOG_ERROR("control plane failure: %s", s.reason().c_str());
       st.loop_failed = true;
@@ -601,6 +693,23 @@ void BackgroundThreadLoop(GlobalState& st) {
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
     auto cycle =
         std::chrono::duration<double, std::milli>(st.cycle_time_ms.load());
+    {
+      Metrics& m = GlobalMetrics();
+      m.cycles.fetch_add(1, std::memory_order_relaxed);
+      if (elapsed > cycle) {
+        // The loop overran its budget: negotiation+execution consumed
+        // the whole cycle, so enqueues arriving now wait a full extra
+        // round. A rising stall count is the "cycle time too low /
+        // fusion buffer too big" autotune smell, now countable.
+        m.cycle_stalls.fetch_add(1, std::memory_order_relaxed);
+        m.cycle_overrun_us.fetch_add(
+            std::chrono::duration_cast<std::chrono::microseconds>(
+                elapsed - std::chrono::duration_cast<
+                              std::chrono::nanoseconds>(cycle))
+                .count(),
+            std::memory_order_relaxed);
+      }
+    }
     if (elapsed < cycle) {
       std::this_thread::sleep_for(cycle - elapsed);
     }
@@ -619,6 +728,7 @@ int EnqueueEntry(TensorTableEntry entry, Request message) {
   if (!st.initialized.load() || st.loop_exited.load()) return -1;
   int handle = st.handles.Allocate();
   entry.handle = handle;
+  entry.enqueue_us = MetricsNowUs();
   message.request_rank = st.rank;
   st.timeline.EntryQueued(entry.name);
   Status s = st.tensor_queue.AddToTensorQueue(std::move(entry),
@@ -1203,6 +1313,43 @@ int64_t hvdtpu_response_cache_misses() {
 int64_t hvdtpu_response_cache_entries() {
   CHECK_INIT(-1)
   return g_state->controller->response_cache().entries();
+}
+
+int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap) {
+  // JSON snapshot of the metrics registry. Two-call pattern: pass
+  // (nullptr, 0) to size, then a buffer; returns the full JSON length
+  // (excluding the NUL) either way. Valid before init (counters zeroed,
+  // "initialized": false) — the registry outlives init/shutdown.
+  Metrics::RuntimeInfo info;
+  {
+    // g_init_mutex orders this against hvdtpu_shutdown's
+    // controller.reset(): never read cache stats off a dying controller.
+    std::lock_guard<std::mutex> lk(g_init_mutex);
+    if (g_state && g_state->initialized.load() && g_state->controller) {
+      info.initialized = true;
+      info.rank = g_state->rank;
+      info.size = g_state->size;
+      info.fusion_threshold_bytes = g_state->fusion_threshold.load();
+      info.cycle_time_ms = g_state->cycle_time_ms.load();
+      const ResponseCache& c = g_state->controller->response_cache();
+      info.cache_hits = c.hits();
+      info.cache_misses = c.misses();
+      info.cache_entries = c.entries();
+      info.cache_hit_bytes = c.hit_bytes();
+    }
+  }
+  std::string json = GlobalMetrics().SnapshotJson(info);
+  if (buf != nullptr && cap > 0) {
+    int64_t n = std::min<int64_t>((int64_t)json.size(), cap - 1);
+    std::memcpy(buf, json.data(), (size_t)n);
+    buf[n] = '\0';
+  }
+  return (int64_t)json.size();
+}
+
+int hvdtpu_metrics_reset() {
+  GlobalMetrics().Reset();
+  return 0;
 }
 
 int hvdtpu_start_timeline(const char* path) {

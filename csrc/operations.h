@@ -107,6 +107,16 @@ void hvdtpu_set_cycle_time_ms(double v);
 int64_t hvdtpu_response_cache_hits();
 int64_t hvdtpu_response_cache_misses();
 int64_t hvdtpu_response_cache_entries();
+
+// Metrics registry (csrc/metrics.h): one JSON snapshot of every core
+// counter — per-op-class counts/bytes, negotiation/queue/wire latency
+// histograms, fusion fill, cycle stalls, cache hit rate, coordinator
+// straggler attribution. Two-call pattern: (nullptr, 0) returns the JSON
+// length; a second call with a buffer of at least len+1 copies it
+// NUL-terminated. Usable before init (zeroed counters). Surfaced as
+// hvd.metrics() through horovod_tpu/telemetry.
+int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap);
+int hvdtpu_metrics_reset();
 }
 
 #endif  // HVDTPU_OPERATIONS_H

@@ -90,6 +90,7 @@ ResponseCache::LookupResult ResponseCache::Lookup(const Request& req,
   }
   if (match) {
     hits_++;
+    hit_bytes_ += ShapesTotalBytes(r);
     return LookupResult::HIT;
   }
   // Metadata changed (new shape/dtype/op under an old name): coordinate a

@@ -48,6 +48,10 @@ class ResponseCache {
   int64_t hits() const { return hits_.load(); }
   int64_t misses() const { return misses_.load(); }
   int64_t entries() const { return entries_count_.load(); }
+  // Payload bytes whose negotiation was skipped by a cache hit — the
+  // wire traffic the bitvector path saved from full renegotiation
+  // (metrics snapshot: cache.hit_bytes).
+  int64_t hit_bytes() const { return hit_bytes_.load(); }
 
  private:
   struct Slot {
@@ -63,6 +67,7 @@ class ResponseCache {
   std::vector<int32_t> free_positions_;  // ascending; reuse smallest first
   std::unordered_map<std::string, int32_t> index_;
   std::atomic<int64_t> hits_{0}, misses_{0}, entries_count_{0};
+  std::atomic<int64_t> hit_bytes_{0};
   bool warned_full_ = false;
 };
 

@@ -40,6 +40,21 @@ void Timeline::Initialize(const std::string& path, int rank) {
   rank_ = rank;
   start_us_ = NowMicros();
   fputs("[\n", file_);
+  // Header events, written before the async writer starts:
+  // - process_name metadata so Perfetto labels each pid as its rank;
+  // - CLOCK_SYNC carrying this trace's t=0 as wall-clock unix us, the
+  //   anchor horovod_tpu.telemetry.report uses to put per-rank traces
+  //   (whose ts are steady-clock-relative) on one time axis.
+  int64_t unix_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                        std::chrono::system_clock::now().time_since_epoch())
+                        .count();
+  fprintf(file_,
+          "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": %d, "
+          "\"args\": {\"name\": \"rank %d\"}},\n"
+          "{\"name\": \"CLOCK_SYNC\", \"ph\": \"i\", \"ts\": 0, "
+          "\"pid\": %d, \"tid\": 0, \"s\": \"p\", "
+          "\"args\": {\"unix_us\": %lld, \"rank\": %d}},\n",
+          rank, rank, rank, (long long)unix_us, rank);
   enabled_ = true;
   stop_ = false;
   writer_ = std::thread(&Timeline::WriterLoop, this);

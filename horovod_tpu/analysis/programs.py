@@ -121,6 +121,28 @@ def _split(config, optimizer_name):
                     mesh=mesh)
 
 
+def _split_telemetry(config):
+    """The telemetry-instrumented split step: identical jitted programs
+    with a StepTimer wrapped around them. Registered so ``make lint``
+    proves the host-side instrumentation never perturbs the traced
+    collective signature (the StepTimer lives entirely outside jit)."""
+    import optax
+
+    from horovod_tpu.parallel.train_step import make_split_train_step
+    from horovod_tpu.telemetry import StepTimer
+
+    cfg = _config(config)
+    mesh = _mesh()
+    # flops preset: lint traces with abstract args, so the first-call
+    # cost-analysis registration must not trigger (it lowers programs).
+    timer = StepTimer(flops_per_step=1.0, block=False)
+    ts = make_split_train_step(_loss_fn(cfg, mesh), optax.adam(1e-3),
+                               microbatches=2, telemetry=timer)
+    carry = jax.eval_shape(ts.init, _abstract_params(cfg))
+    return LintSpec(fn=ts.step, args=(carry, _abstract_batch()),
+                    mesh=mesh)
+
+
 def _pipeline(config, schedule):
     from horovod_tpu.models.llama import llama_pipeline_programs
     from horovod_tpu.parallel.pipeline import (
@@ -166,6 +188,7 @@ _REGISTRY = {
         functools.partial(_split, optimizer_name="fused_adam"),
     "llama_train_step_split_fused_master_adam":
         functools.partial(_split, optimizer_name="fused_master_adam"),
+    "llama_train_step_split_telemetry": _split_telemetry,
     "pipeline_gpipe":
         functools.partial(_pipeline, schedule="gpipe"),
     "pipeline_1f1b":

@@ -91,6 +91,20 @@ def decode_pytree(tree):
     return jax.tree.map(dec, tree, is_leaf=is_marker)
 
 
+def _sanitize_scalars(state):
+    """Orbax's StandardCheckpointHandler restricts leaves to
+    ``(int, float, np.ndarray, jax.Array)`` on recent versions (0.7.x
+    validates on save); numpy SCALARS (``np.int64(7)`` — the natural
+    type of a step counter) fail that check. Promote them to 0-d
+    ndarrays, which round-trip equivalently (``int(x)``/``float(x)``
+    and arithmetic behave the same on restore)."""
+    import numpy as np
+
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+        state)
+
+
 def save(path, state, force=True, sync=False):
     """Synchronous one-shot save of a pytree (jax arrays, numpy, scalars).
 
@@ -104,7 +118,8 @@ def save(path, state, force=True, sync=False):
     if _i_write():
         ocp = _ocp()
         with ocp.StandardCheckpointer() as cp:
-            cp.save(os.path.abspath(os.fspath(path)), state, force=force)
+            cp.save(os.path.abspath(os.fspath(path)),
+                    _sanitize_scalars(state), force=force)
     if sync and _basics.is_initialized() and _basics.size() > 1:
         from horovod_tpu.common import eager_ops
 
@@ -162,7 +177,8 @@ class CheckpointManager:
         if self._ensure_role() is None:
             return False
         ocp = _ocp()
-        saved = self._mgr.save(int(step), args=ocp.args.StandardSave(state))
+        saved = self._mgr.save(
+            int(step), args=ocp.args.StandardSave(_sanitize_scalars(state)))
         if wait:
             self._mgr.wait_until_finished()
         return saved
@@ -190,10 +206,14 @@ class CheckpointManager:
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoint found under {self._dir}")
-            if target is not None:
-                return mgr.restore(
-                    int(step), args=ocp.args.StandardRestore(target))
-            return mgr.restore(int(step))
+            # args ALWAYS passed (StandardRestore(None) = saved
+            # structure): a bare mgr.restore(step) only works when the
+            # SAME manager object did the save — a fresh manager (the
+            # resume-after-restart path) has no handler registered for
+            # the item and orbax >= 0.7 raises KeyError asking for a
+            # CheckpointArgs subclass.
+            return mgr.restore(
+                int(step), args=ocp.args.StandardRestore(target))
         finally:
             if own:
                 mgr.close()

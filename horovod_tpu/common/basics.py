@@ -122,6 +122,10 @@ def load_library():
         lib.hvdtpu_release.restype = i32
         lib.hvdtpu_release.argtypes = [i32]
 
+        lib.hvdtpu_metrics_snapshot.restype = i64
+        lib.hvdtpu_metrics_snapshot.argtypes = [p, i64]
+        lib.hvdtpu_metrics_reset.restype = i32
+        lib.hvdtpu_metrics_reset.argtypes = []
         lib.hvdtpu_start_timeline.restype = i32
         lib.hvdtpu_start_timeline.argtypes = [cstr]
         lib.hvdtpu_stop_timeline.restype = i32
@@ -237,6 +241,37 @@ class HorovodBasics:
     def stop_timeline(self):
         """Stop a runtime-started timeline and flush the JSON file."""
         self.lib.hvdtpu_stop_timeline()
+
+    def metrics_snapshot(self):
+        """One JSON snapshot of the native core's metrics registry.
+
+        Returns a dict (see ``docs/metrics.md`` for the counter catalog).
+        Works before ``init()`` too — counters are process-lifetime and
+        the snapshot then carries ``initialized: False``. The parsed
+        surface for operators is ``horovod_tpu.telemetry.snapshot()`` /
+        ``hvd.metrics()``; this is the raw binding they share.
+        """
+        import ctypes as _ct
+        import json as _json
+
+        lib = self.lib
+        # Two-call pattern with a retry loop: counters move between the
+        # sizing call and the copy, so the JSON can grow a few bytes.
+        cap = int(lib.hvdtpu_metrics_snapshot(None, 0)) + 256
+        while True:
+            buf = _ct.create_string_buffer(cap)
+            need = int(lib.hvdtpu_metrics_snapshot(buf, cap))
+            if need < cap:
+                return _json.loads(buf.value.decode())
+            cap = need + 256
+
+    def metrics_reset(self):
+        """Zero every counter in the metrics registry (histograms too).
+
+        Scrapers normally diff monotonic snapshots instead; reset exists
+        for test isolation and interactive sessions.
+        """
+        self.lib.hvdtpu_metrics_reset()
 
     def response_cache_stats(self):
         """(hits, misses, entries) of the negotiation response cache.

@@ -64,6 +64,8 @@ from horovod_tpu.jax.mpi_ops import (  # noqa: F401
     is_initialized,
     local_rank,
     local_size,
+    metrics,
+    metrics_reset,
     poll,
     rank,
     reducescatter,

@@ -122,6 +122,30 @@ def stop_timeline():
     _basics.stop_timeline()
 
 
+def metrics():
+    """Live snapshot of the native core's metrics registry, as a dict.
+
+    Counter catalog in ``docs/metrics.md``: per-op-class counts/bytes
+    (host ring and device plane), negotiation/queue/wire latency
+    histograms, fusion-buffer fill, cycle stalls, response-cache hit
+    rate, and the coordinator's per-rank straggler table. Counters are
+    process-lifetime monotonic — diff snapshots to rate. For periodic
+    export (JSONL flight recorder, Prometheus textfile, console) see
+    ``horovod_tpu.telemetry.MetricsScraper``; for per-step MFU/goodput
+    accounting see ``horovod_tpu.telemetry.StepTimer``.
+    """
+    from horovod_tpu import telemetry
+
+    return telemetry.snapshot()
+
+
+def metrics_reset():
+    """Zero the metrics registry (tests / interactive use)."""
+    from horovod_tpu import telemetry
+
+    telemetry.metrics_reset()
+
+
 is_initialized = _basics.is_initialized
 rank = _basics.rank
 size = _basics.size

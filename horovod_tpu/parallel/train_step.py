@@ -81,8 +81,19 @@ def _split_microbatches(batch, n):
             for i in range(n)]
 
 
+def _register_split_flops(timer, programs):
+    """Fill ``timer.flops_per_step`` from compiled cost analysis:
+    ``programs`` is ``[(jitted_fn, abstract_args, calls_per_step)]``.
+    Uses the AOT lower/compile path with the SAME abstract signatures
+    the step dispatches, so the executables land in (or come from) the
+    jit cache that first step populates."""
+    for fn, args, calls in programs:
+        compiled = fn.lower(*args).compile()
+        timer.add_flops_from_compiled(compiled, calls=calls)
+
+
 def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
-                          jit_kwargs=None):
+                          jit_kwargs=None, telemetry=None):
     """Build the split-program step for ``loss_fn(params, batch)``.
 
     ``optimizer`` is either an optax ``GradientTransformation``
@@ -92,6 +103,17 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
     (``init``/``apply`` — the single-pass FUSED apply). For the master
     variant the carry's params are the COMPUTE-dtype cast (built by
     ``init``); the fp32 master lives inside the optimizer state.
+
+    ``telemetry`` (optional) is a
+    :class:`horovod_tpu.telemetry.StepTimer`: every ``step`` call is
+    then timed into it, and — unless the timer already carries
+    ``flops_per_step`` — the first call registers per-step FLOPs from
+    ``lowered.compile().cost_analysis()`` over the grad program(s)
+    x microbatches plus the apply program, so ``timer.mfu()`` works
+    with zero extra bookkeeping. The wrapper lives entirely OUTSIDE
+    the jitted programs: traced jaxprs (and therefore hvdlint results
+    — see ``analysis/programs.py``'s instrumented registration) are
+    identical with and without it.
 
     Returns ``TrainStep(init, step)`` with
     ``init(params) -> carry`` and ``step(carry, batch) -> (loss,
@@ -168,6 +190,35 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
                 loss, grads = grad_acc(params, loss, grads, mb)
             params, opt = apply_fn(grads, params, opt)
             return loss, (params, opt)
+
+    if telemetry is not None:
+        inner_step = step
+        flops_pending = [telemetry.flops_per_step is None]
+
+        def _flops_programs(carry, batch):
+            params, opt = carry
+            if n == 1:
+                g_abs = jax.eval_shape(grad_fn, params, batch)
+                return [(grad_fn, (params, batch), 1),
+                        (apply_fn, (g_abs[1], params, opt), 1)]
+            mb0 = _split_microbatches(batch, n)[0]
+            l_abs, g_abs = jax.eval_shape(grad_first, params, mb0)
+            return [(grad_first, (params, mb0), 1),
+                    (grad_acc, (params, l_abs, g_abs, mb0), n - 1),
+                    (apply_fn, (g_abs, params, opt), 1)]
+
+        def step(carry, batch):  # noqa: F811 — deliberate shadowing
+            if flops_pending[0]:
+                flops_pending[0] = False
+                try:
+                    _register_split_flops(telemetry,
+                                          _flops_programs(carry, batch))
+                except Exception:  # noqa: BLE001 — cost analysis is
+                    pass           # best-effort (backend-dependent)
+            telemetry.start_step()
+            out = inner_step(carry, batch)
+            telemetry.end_step(out)
+            return out
 
     def init(params):
         opt = optimizer.init(params)

@@ -1,0 +1,41 @@
+"""horovod_tpu.telemetry — live metrics for the runtime.
+
+The observability layer the trace files never gave the operator
+(reference analog: none in-core — upstream Horovod's only windows are
+the Chrome timeline and the autotune log). Three pieces:
+
+1. **Core counters** — :func:`snapshot` parses the native core's
+   ``hvdtpu_metrics_snapshot()`` JSON (per-op-class counts/bytes,
+   negotiation/queue/wire latency histograms, fusion fill, cycle
+   stalls, cache hit rate, coordinator straggler table); surfaced to
+   frontends as ``hvd.metrics()``. :class:`MetricsScraper` runs a
+   background exporter loop (JSONL flight recorder, Prometheus
+   textfile, console table).
+
+2. **Step accounting** — :class:`StepTimer` turns per-step wall time
+   into MFU (FLOPs from ``lowered.compile().cost_analysis()``), wire
+   goodput, and measured-vs-predicted collective bytes, with the
+   static predictor reusing the ``analysis/extract`` jaxpr walker
+   (:mod:`horovod_tpu.telemetry.predict`). Pipeline bubble helpers
+   compare measured idle fractions against ``parallel.pipeline``'s
+   analytic schedules.
+
+3. **Cross-rank merge** — ``python -m horovod_tpu.telemetry.report``
+   merges per-rank timeline JSONs into one Perfetto-loadable trace
+   with clock alignment and per-tensor straggler attribution.
+
+See ``docs/metrics.md`` for the counter catalog and walkthroughs.
+"""
+
+from horovod_tpu.telemetry.core import (  # noqa: F401
+    metrics_reset,
+    snapshot,
+    total_collective_bytes,
+)
+from horovod_tpu.telemetry.exporters import MetricsScraper  # noqa: F401
+from horovod_tpu.telemetry.step_timer import (  # noqa: F401
+    StepTimer,
+    analytic_bubble,
+    bubble_report,
+    measured_bubble,
+)

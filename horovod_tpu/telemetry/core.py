@@ -1,0 +1,46 @@
+"""Parsed access to the native core's metrics registry.
+
+Thin on purpose: the counters live in C++ (``csrc/metrics.h``) where
+the background loop records them for free; this module only parses the
+JSON snapshot and derives the handful of aggregates the exporters and
+:class:`~horovod_tpu.telemetry.step_timer.StepTimer` need.
+"""
+
+from horovod_tpu.common.basics import HorovodBasics
+
+_basics = HorovodBasics()
+
+
+def snapshot():
+    """One point-in-time dict of every core counter.
+
+    Safe to call at any moment (before ``hvd.init()`` it returns zeroed
+    counters with ``initialized: False``); cheap enough for per-step
+    use — one ctypes call plus a small ``json.loads``. Counters are
+    monotonic for the process lifetime: consumers diff snapshots rather
+    than resetting (see ``docs/metrics.md`` for the catalog).
+    """
+    return _basics.metrics_snapshot()
+
+
+def metrics_reset():
+    """Zero the registry (tests / interactive sessions only)."""
+    _basics.metrics_reset()
+
+
+def total_collective_bytes(snap=None, planes=("ops", "device_ops"),
+                           op_classes=None):
+    """Sum payload bytes across op classes and planes of a snapshot.
+
+    ``op_classes`` restricts the sum (e.g. ``("allreduce",)`` for
+    gradient-traffic accounting); default is everything that moved.
+    """
+    if snap is None:
+        snap = snapshot()
+    total = 0
+    for plane in planes:
+        for op, counters in snap.get(plane, {}).items():
+            if op_classes is not None and op not in op_classes:
+                continue
+            total += counters.get("bytes", 0)
+    return total

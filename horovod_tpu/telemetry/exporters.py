@@ -1,0 +1,173 @@
+"""Background metrics scraper with pluggable exporters.
+
+:class:`MetricsScraper` snapshots the core registry on an interval and
+fans each snapshot out to any of three sinks:
+
+- **JSONL flight recorder** — one line per scrape (timestamped, rank-
+  tagged), size-capped by rotating to ``<path>.1`` — the post-mortem
+  artifact: when a run dies, the tail holds the last known counters.
+- **Prometheus textfile** — ``hvdtpu_*`` samples written atomically
+  (tmp + rename) for the node-exporter textfile collector.
+- **Console table** — a compact operator view on stderr.
+
+All sinks also work one-shot via :meth:`MetricsScraper.scrape_once`.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+from horovod_tpu.telemetry import core as _core
+
+
+def _flatten_prom(snap, rank):
+    """Flatten a snapshot into Prometheus text-format lines."""
+    lines = [
+        "# HELP hvdtpu_op_bytes_total payload bytes moved per op class",
+        "# TYPE hvdtpu_op_bytes_total counter",
+    ]
+    label = f'rank="{rank}"'
+    for plane_key, plane in (("host", "ops"), ("device", "device_ops")):
+        for op, c in snap.get(plane, {}).items():
+            for field in ("responses", "tensors", "bytes"):
+                lines.append(
+                    f'hvdtpu_op_{field}_total{{op="{op}",'
+                    f'plane="{plane_key}",{label}}} {c.get(field, 0)}')
+    for hist in ("negotiation_us", "queue_us", "wire_us"):
+        h = snap.get(hist, {})
+        for field in ("count", "sum_us", "p50_us", "p99_us", "max_us"):
+            lines.append(
+                f'hvdtpu_{hist}_{field}{{{label}}} {h.get(field, 0)}')
+    cache = snap.get("cache", {})
+    for field in ("hits", "misses", "entries", "hit_bytes"):
+        lines.append(f'hvdtpu_cache_{field}{{{label}}} '
+                     f'{cache.get(field, 0)}')
+    lines.append(f'hvdtpu_cache_hit_rate{{{label}}} '
+                 f'{cache.get("hit_rate", 0.0)}')
+    cyc = snap.get("cycle", {})
+    for field in ("count", "stalls", "overrun_us"):
+        lines.append(f'hvdtpu_cycle_{field}{{{label}}} '
+                     f'{cyc.get(field, 0)}')
+    fus = snap.get("fusion", {})
+    for field in ("fused_responses", "fill_bytes", "capacity_bytes"):
+        lines.append(f'hvdtpu_fusion_{field}{{{label}}} '
+                     f'{fus.get(field, 0)}')
+    lines.append(f'hvdtpu_fusion_fill_ratio{{{label}}} '
+                 f'{fus.get("fill_ratio", 0.0)}')
+    for r, n in enumerate(
+            snap.get("straggler", {}).get("last_rank_counts", [])):
+        lines.append(
+            f'hvdtpu_straggler_last_total{{{label},'
+            f'straggler="{r}"}} {n}')
+    lines.append(f'hvdtpu_errors_total{{{label}}} '
+                 f'{snap.get("errors", 0)}')
+    return "\n".join(lines) + "\n"
+
+
+def _console_table(snap, stream):
+    ops = snap.get("ops", {})
+    dev = snap.get("device_ops", {})
+    cache = snap.get("cache", {})
+    cyc = snap.get("cycle", {})
+    q = snap.get("queue_us", {})
+    print(f"-- hvdtpu metrics (rank {snap.get('rank')}/"
+          f"{snap.get('size')}) --", file=stream)
+    print(f"{'op':<14}{'plane':<8}{'responses':>10}{'tensors':>10}"
+          f"{'bytes':>14}", file=stream)
+    for plane_name, plane in (("host", ops), ("device", dev)):
+        for op, c in plane.items():
+            print(f"{op:<14}{plane_name:<8}{c['responses']:>10}"
+                  f"{c['tensors']:>10}{c['bytes']:>14}", file=stream)
+    print(f"queue p50/p99: {q.get('p50_us', 0)}/{q.get('p99_us', 0)} us"
+          f"  cache hit rate: {cache.get('hit_rate', 0.0):.3f}"
+          f"  cycles: {cyc.get('count', 0)}"
+          f" (stalls {cyc.get('stalls', 0)})", file=stream)
+
+
+class MetricsScraper:
+    """Periodic snapshot -> exporters, on a daemon thread.
+
+    ``jsonl_path`` / ``prom_path`` / ``console`` pick the sinks (any
+    subset). ``start()`` launches the loop; ``stop()`` flushes one last
+    scrape so short runs still leave a record.
+    """
+
+    def __init__(self, interval_s=10.0, jsonl_path=None, prom_path=None,
+                 console=False, console_stream=None,
+                 jsonl_max_bytes=16 << 20):
+        self.interval_s = float(interval_s)
+        self.jsonl_path = jsonl_path
+        self.prom_path = prom_path
+        self.console = console
+        self.console_stream = console_stream or sys.stderr
+        self.jsonl_max_bytes = jsonl_max_bytes
+        self._stop = threading.Event()
+        self._thread = None
+        self.scrapes = 0
+
+    def scrape_once(self):
+        snap = _core.snapshot()
+        rank = snap.get("rank", -1)
+        row = {"ts": time.time(), **snap}
+        if self.jsonl_path:
+            self._write_jsonl(row)
+        if self.prom_path:
+            self._write_prom(snap, rank)
+        if self.console:
+            _console_table(snap, self.console_stream)
+        self.scrapes += 1
+        return row
+
+    def _write_jsonl(self, row):
+        path = self.jsonl_path
+        try:
+            if (os.path.exists(path)
+                    and os.path.getsize(path) > self.jsonl_max_bytes):
+                os.replace(path, path + ".1")  # keep one generation
+        except OSError:
+            pass
+        with open(path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def _write_prom(self, snap, rank):
+        tmp = self.prom_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(_flatten_prom(snap, rank))
+        os.replace(tmp, self.prom_path)  # textfile collector needs atomic
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception as e:  # noqa: BLE001 — the scraper must
+                # never take the training process down with it
+                print(f"hvdtpu metrics scraper error: {e}",
+                      file=sys.stderr)
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("scraper already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvdtpu-metrics-scraper")
+        self._thread.start()
+        return self
+
+    def stop(self, final_scrape=True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 5)
+            self._thread = None
+        if final_scrape:
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,91 @@
+"""Static collective-byte predictors, built on the hvdlint jaxpr walker.
+
+The lint world (``analysis/extract``) already knows how to turn any
+traced program into its ordered collective signature; telemetry reuses
+that walker as the *expected* side of the expected-vs-actual byte
+reconciliation — one extractor, so the static analyzer and the runtime
+counters can never disagree about what a program was supposed to move.
+
+Two entry points:
+
+- :func:`collective_bytes` — per-step bytes of any traceable SPMD
+  program (psum/all_gather/... volumes, loops expanded by trip count).
+- :func:`eager_allreduce_bytes` — the eager data-parallel step: one
+  allreduce per gradient leaf. The gradient tree is traced as its
+  in-graph equivalent (``psum`` of every ``grad`` leaf over a
+  synthetic axis) and walked by the same extractor, so the predicted
+  volume is literally the walker's sum over that signature.
+"""
+
+import numpy as np
+
+from horovod_tpu.analysis.extract import extract, linearize
+
+
+def _dtype_bytes(dtype_str):
+    """Per-element bytes of a Collective's dtype tag. Mixed-dtype
+    collectives join sorted names with commas; all repo collectives are
+    homogeneous, so taking the first is exact today and a documented
+    approximation otherwise."""
+    name = dtype_str.split(",")[0] if dtype_str else "float32"
+    try:
+        return np.dtype(name).itemsize
+    except TypeError:
+        try:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name)).itemsize
+        except (ImportError, AttributeError, TypeError):
+            return 4
+
+
+def signature_bytes(signature):
+    """Sum payload bytes over a linearized collective signature."""
+    return sum(c.nelems * _dtype_bytes(c.dtype)
+               for c in linearize(signature))
+
+
+def collective_bytes(fn, *args, axis_env=None):
+    """Predicted per-call collective payload bytes of ``fn(*args)``.
+
+    ``axis_env`` is a list of ``(axis_name, size)`` pairs binding the
+    collective axes (same contract as ``analysis.lint``); args may be
+    abstract (``jax.ShapeDtypeStruct``). Traced with ``jax.make_jaxpr``
+    — no devices, mesh, or shard_map needed, so the predictor runs on
+    the jax 0.4.x boxes too.
+    """
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn, axis_env=tuple(axis_env or ()))(*args)
+    return signature_bytes(extract(jaxpr).signature)
+
+
+def eager_allreduce_bytes(loss_fn, params, batch, size=2, axis="hvd"):
+    """Predicted per-step wire bytes of the eager data-parallel step.
+
+    The eager path allreduces every gradient leaf (grouped or not, the
+    payload volume is the same); its in-graph equivalent is a ``psum``
+    of each leaf over one axis, which is what gets traced and walked
+    here. ``size`` only names the axis width for tracing — the
+    per-rank payload volume (what the core's byte counters record on
+    this rank) does not depend on it.
+    """
+    import jax
+
+    def step_signature(p, b):
+        grads = jax.grad(loss_fn)(p, b)
+        return jax.tree.map(lambda g: jax.lax.psum(g, axis), grads)
+
+    return collective_bytes(step_signature, params, batch,
+                            axis_env=[(axis, size)])
+
+
+def grad_tree_bytes(loss_fn, params, batch):
+    """Gradient-tree byte volume via ``jax.eval_shape`` — the
+    walker-free cross-check for :func:`eager_allreduce_bytes` (the two
+    must agree exactly; the telemetry tests pin it)."""
+    import jax
+
+    shapes = jax.eval_shape(jax.grad(loss_fn), params, batch)
+    return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+               for l in jax.tree.leaves(shapes))

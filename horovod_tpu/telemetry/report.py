@@ -1,0 +1,236 @@
+"""Cross-rank timeline merge with straggler attribution.
+
+Each rank records its own Chrome-trace timeline
+(``hvd.start_timeline(f"/tmp/tl.{rank}.json")``); this module merges
+them into ONE Perfetto-loadable trace and attributes negotiation
+stragglers::
+
+    python -m horovod_tpu.telemetry.report /tmp/tl.*.json \
+        -o merged.json --skew-json skew.json
+
+Clock alignment: per-rank timestamps are steady-clock-relative to each
+rank's own start. The ``CLOCK_SYNC`` header event (``csrc/timeline.cc``)
+carries each trace's t=0 as wall-clock unix microseconds, which puts
+all ranks on one axis up to NTP skew; without it (older traces) the
+fallback aligns on ``NEGOTIATE`` end events — the coordinator's
+response broadcast reaches every rank near-simultaneously, so the
+median per-rank offset over matched events is a robust clock estimate.
+
+Straggler attribution: a tensor's ``NEGOTIATE`` begin marks the moment
+that rank submitted the request. After alignment, the last begin among
+ranks for each (tensor, occurrence) is the rank the collective waited
+for; aggregated, that is the per-rank skew table (the live counterpart
+is the coordinator's ``straggler`` section in ``hvd.metrics()``).
+"""
+
+import argparse
+import json
+import statistics
+import sys
+from collections import defaultdict
+
+
+def load_timeline(path):
+    """Load one rank's timeline; returns (rank, events). Tolerates the
+    writer's trailing ``{}`` sentinel and in-progress traces (truncated
+    final line)."""
+    try:
+        with open(path) as f:
+            events = json.load(f)
+    except json.JSONDecodeError:
+        # Trace still being written (no closing "]"): recover line-wise.
+        events = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip().rstrip(",")
+                if not line or line in ("[", "]"):
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if ev:
+                    events.append(ev)
+    events = [e for e in events if e]  # drop the {} sentinel
+    rank = None
+    for e in events:
+        if e.get("name") == "CLOCK_SYNC":
+            rank = e.get("args", {}).get("rank")
+            break
+    if rank is None:
+        ranks = {e.get("pid") for e in events if "pid" in e}
+        rank = min(ranks) if ranks else 0
+    return rank, events
+
+
+def _clock_sync_us(events):
+    for e in events:
+        if e.get("name") == "CLOCK_SYNC":
+            return e.get("args", {}).get("unix_us")
+    return None
+
+
+def _negotiate_occurrences(events, phase):
+    """{(tensor, k): ts} for the k-th NEGOTIATE begin/end per tensor."""
+    per_tensor = defaultdict(list)
+    for e in events:
+        if e.get("name") == "NEGOTIATE" and e.get("ph") == phase:
+            tensor = e.get("args", {}).get("tensor")
+            if tensor is not None:
+                per_tensor[tensor].append(e["ts"])
+    out = {}
+    for tensor, times in per_tensor.items():
+        for k, ts in enumerate(sorted(times)):
+            out[(tensor, k)] = ts
+    return out
+
+
+def compute_offsets(traces):
+    """Per-rank offsets (added to each rank's ts) onto a common axis.
+
+    Returns ``{rank: offset_us}`` with the earliest-starting rank at
+    its original coordinates. Prefers CLOCK_SYNC; falls back to the
+    NEGOTIATE-end median match.
+    """
+    syncs = {rank: _clock_sync_us(events) for rank, events in traces}
+    if all(s is not None for s in syncs.values()) and syncs:
+        base = min(syncs.values())
+        return {rank: s - base for rank, s in syncs.items()}
+
+    ranks = [rank for rank, _ in traces]
+    ref_rank = ranks[0]
+    ref_ends = _negotiate_occurrences(dict(traces)[ref_rank], "E")
+    offsets = {ref_rank: 0}
+    for rank, events in traces:
+        if rank == ref_rank:
+            continue
+        ends = _negotiate_occurrences(events, "E")
+        deltas = [ref_ends[key] - ts for key, ts in ends.items()
+                  if key in ref_ends]
+        offsets[rank] = int(statistics.median(deltas)) if deltas else 0
+    base = min(offsets.values(), default=0)
+    return {rank: off - base for rank, off in offsets.items()}
+
+
+def straggler_table(traces, offsets, top_n=10):
+    """Per-rank skew aggregation over aligned NEGOTIATE begins.
+
+    Returns ``{"per_rank": {rank: {last_count, mean_skew_us,
+    max_skew_us, events}}, "worst_tensors": [...]}`` where a rank's
+    skew on one collective is its submit time minus the earliest
+    rank's.
+    """
+    begins = {rank: _negotiate_occurrences(events, "B")
+              for rank, events in traces}
+    keys = None
+    for rank, occ in begins.items():
+        keys = set(occ) if keys is None else keys & set(occ)
+    keys = keys or set()
+
+    per_rank = {rank: {"last_count": 0, "skews": []} for rank in begins}
+    spreads = []
+    for key in keys:
+        arrivals = {rank: begins[rank][key] + offsets[rank]
+                    for rank in begins}
+        first = min(arrivals.values())
+        last_rank = max(arrivals, key=arrivals.get)
+        spread = arrivals[last_rank] - first
+        per_rank[last_rank]["last_count"] += 1
+        for rank, ts in arrivals.items():
+            per_rank[rank]["skews"].append(ts - first)
+        spreads.append((spread, key[0], key[1], last_rank))
+
+    table = {}
+    for rank, d in sorted(per_rank.items()):
+        skews = d["skews"]
+        table[rank] = {
+            "last_count": d["last_count"],
+            "events": len(skews),
+            "mean_skew_us": (sum(skews) / len(skews)) if skews else 0.0,
+            "max_skew_us": max(skews) if skews else 0,
+        }
+    spreads.sort(reverse=True)
+    worst = [{"tensor": t, "occurrence": k, "spread_us": s,
+              "last_rank": r} for s, t, k, r in spreads[:top_n]]
+    return {"per_rank": table, "worst_tensors": worst,
+            "matched_events": len(keys)}
+
+
+def merge(paths, align=True):
+    """Merge per-rank timeline files.
+
+    Returns ``(merged_events, skew)``: one Chrome-trace event list
+    (per-rank ts shifted onto the common axis, pid = rank, process
+    names labeled) and the straggler table.
+    """
+    traces = [load_timeline(p) for p in paths]
+    seen = set()
+    for rank, _ in traces:
+        if rank in seen:
+            raise ValueError(f"duplicate rank {rank} across input "
+                             "traces — pass one timeline per rank")
+        seen.add(rank)
+    offsets = compute_offsets(traces) if align else \
+        {rank: 0 for rank, _ in traces}
+    merged = []
+    for rank, events in traces:
+        named = False
+        for e in events:
+            e = dict(e)
+            e["pid"] = rank
+            if "ts" in e:
+                e["ts"] = e["ts"] + offsets[rank]
+            if e.get("name") == "process_name":
+                named = True
+            merged.append(e)
+        if not named:
+            merged.append({"name": "process_name", "ph": "M",
+                           "pid": rank,
+                           "args": {"name": f"rank {rank}"}})
+    merged.sort(key=lambda e: e.get("ts", 0))
+    skew = straggler_table(traces, offsets)
+    return merged, skew
+
+
+def format_skew_table(skew):
+    lines = [f"{'rank':>5} {'last':>7} {'events':>7} "
+             f"{'mean skew us':>13} {'max skew us':>12}"]
+    for rank, d in sorted(skew["per_rank"].items()):
+        lines.append(f"{rank:>5} {d['last_count']:>7} {d['events']:>7} "
+                     f"{d['mean_skew_us']:>13.1f} {d['max_skew_us']:>12}")
+    for w in skew["worst_tensors"][:5]:
+        lines.append(f"  worst: {w['tensor']}#{w['occurrence']} "
+                     f"spread {w['spread_us']} us "
+                     f"(last: rank {w['last_rank']})")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.telemetry.report",
+        description="Merge per-rank hvdtpu timelines into one "
+                    "Perfetto-loadable trace with straggler attribution")
+    ap.add_argument("timelines", nargs="+",
+                    help="per-rank timeline JSON files")
+    ap.add_argument("-o", "--output", default="merged_timeline.json",
+                    help="merged trace output path")
+    ap.add_argument("--skew-json", default=None,
+                    help="also write the straggler table as JSON")
+    ap.add_argument("--no-align", action="store_true",
+                    help="skip clock alignment (trust raw timestamps)")
+    args = ap.parse_args(argv)
+
+    merged, skew = merge(args.timelines, align=not args.no_align)
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    print(f"wrote {args.output} ({len(merged)} events, "
+          f"{len(args.timelines)} ranks)")
+    print(format_skew_table(skew))
+    if args.skew_json:
+        with open(args.skew_json, "w") as f:
+            json.dump(skew, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

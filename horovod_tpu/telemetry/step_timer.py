@@ -1,0 +1,286 @@
+"""Per-step wall-time, MFU, goodput, and bubble accounting.
+
+:class:`StepTimer` is the step-level half of the telemetry subsystem:
+the core registry (``telemetry.snapshot()``) counts what the runtime
+moved; the timer relates those counters to *steps* — wall time per
+step, model-FLOPs utilization from compiled cost analysis, wire
+goodput, and measured-vs-predicted collective byte reconciliation
+(predictions from :mod:`horovod_tpu.telemetry.predict`).
+
+The bubble helpers compare a *measured* pipeline idle fraction against
+``parallel.pipeline``'s analytic schedules (gpipe ``2(S-1)/(2M+2(S-1))``,
+lockstep/true 1F1B, interleaved ``2(S-1)/(2MV+2(S-1))`` straight from
+``build_interleaved_schedule``) so a perf PR can show its bubble win as
+a number instead of an equation.
+"""
+
+import time
+
+from horovod_tpu.telemetry import core as _core
+
+# Peak dense-matmul FLOP/s by accelerator generation (same table the
+# bench uses; substring-matched against device_kind, longest key first).
+_PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5 lite": 197e12,
+               "v5": 459e12, "v6e": 918e12, "trillium": 918e12,
+               "axon": 918e12, "cpu": 1e12}
+
+
+def _device_peak_flops():
+    try:
+        import jax
+
+        kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+        for key, val in sorted(_PEAK_FLOPS.items(),
+                               key=lambda kv: -len(kv[0])):
+            if key in kind:
+                return val
+    except Exception:  # noqa: BLE001 — no jax / no backend: caller
+        pass           # must pass peak_flops explicitly for MFU
+    return _PEAK_FLOPS["cpu"]
+
+
+def compiled_flops(compiled):
+    """Total FLOPs of one execution of a compiled jax program.
+
+    ``compiled`` is the result of ``fn.lower(*args).compile()``;
+    ``cost_analysis()`` returns a dict on current jax and a one-element
+    list of dicts on older versions. Returns ``None`` when the backend
+    does not report flops (some CPU paths).
+    """
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = cost.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        return None
+
+
+class StepTimer:
+    """Accumulates per-step measurements; renders one summary row.
+
+    Usage::
+
+        timer = StepTimer(flops_per_step=..., predicted_bytes_per_step=...)
+        for batch in data:
+            with timer.step():
+                loss, carry = step(carry, batch)
+        row = timer.summary()
+
+    ``block=True`` (default) blocks on the step outputs inside
+    :meth:`end_step` so wall times mean what they say; pass ``False``
+    when the surrounding harness already paces dispatch (then only the
+    aggregate over many steps is meaningful).
+
+    Collective bytes per step come from diffing the core metrics
+    snapshot at step boundaries — zero instrumentation inside the step
+    — and reconcile against ``predicted_bytes_per_step`` (from
+    ``telemetry.predict``; the acceptance bar is 1%).
+    """
+
+    def __init__(self, flops_per_step=None, peak_flops=None,
+                 predicted_bytes_per_step=None, block=True,
+                 byte_op_classes=None):
+        self.flops_per_step = flops_per_step
+        self.peak_flops = peak_flops
+        self.predicted_bytes_per_step = predicted_bytes_per_step
+        self.block = block
+        self.byte_op_classes = byte_op_classes
+        self.step_times = []
+        self.bytes_per_step = []
+        self._t0 = None
+        self._bytes0 = None
+        self._outputs = None
+
+    # -- flops sources --------------------------------------------------
+
+    def add_flops_from_compiled(self, compiled, calls=1):
+        """Accumulate ``calls`` executions of a compiled program into
+        ``flops_per_step`` (e.g. grad program x microbatches + apply)."""
+        f = compiled_flops(compiled)
+        if f is not None:
+            self.flops_per_step = (self.flops_per_step or 0.0) + f * calls
+        return f
+
+    # -- per-step recording ---------------------------------------------
+
+    def _read_bytes(self):
+        try:
+            return _core.total_collective_bytes(
+                op_classes=self.byte_op_classes)
+        except Exception:  # noqa: BLE001 — core not built/loaded: the
+            return None    # timer still measures wall time and MFU
+
+    def start_step(self):
+        self._bytes0 = self._read_bytes()
+        self._t0 = time.perf_counter()
+
+    def end_step(self, outputs=None):
+        if self._t0 is None:
+            raise RuntimeError("end_step() without start_step()")
+        if self.block and outputs is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(outputs)
+            except Exception:  # noqa: BLE001 — non-jax outputs
+                pass
+        self.step_times.append(time.perf_counter() - self._t0)
+        b1 = self._read_bytes()
+        if self._bytes0 is not None and b1 is not None:
+            self.bytes_per_step.append(b1 - self._bytes0)
+        self._t0 = None
+
+    class _Step:
+        def __init__(self, timer):
+            self._timer = timer
+
+        def __enter__(self):
+            self._timer.start_step()
+            return self._timer
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc_type is None:
+                self._timer.end_step(self._timer._outputs)
+            self._timer._outputs = None
+            return False
+
+    def step(self):
+        """Context manager timing one step. To block on the step's
+        outputs, hand them over via :meth:`set_outputs` inside the
+        ``with`` body (or call start/end explicitly)."""
+        return StepTimer._Step(self)
+
+    def set_outputs(self, outputs):
+        self._outputs = outputs
+        return outputs
+
+    def wrap(self, step_fn):
+        """Instrument ``step_fn(carry, batch) -> (loss, carry)``: every
+        call is timed (and, with ``block=True``, synchronized)."""
+        def timed_step(carry, batch):
+            self.start_step()
+            out = step_fn(carry, batch)
+            self.end_step(out)
+            return out
+
+        return timed_step
+
+    # -- aggregates ------------------------------------------------------
+
+    @property
+    def steps(self):
+        return len(self.step_times)
+
+    def mean_step_s(self, skip_first=True):
+        """Mean step wall time; the first recorded step is dropped by
+        default (it carries compilation)."""
+        times = self.step_times
+        if skip_first and len(times) > 1:
+            times = times[1:]
+        return sum(times) / len(times) if times else None
+
+    def mfu(self, skip_first=True):
+        dt = self.mean_step_s(skip_first)
+        if not dt or not self.flops_per_step:
+            return None
+        peak = self.peak_flops or _device_peak_flops()
+        return self.flops_per_step / dt / peak
+
+    def measured_bytes_per_step(self, skip_first=True):
+        vals = self.bytes_per_step
+        if skip_first and len(vals) > 1:
+            vals = vals[1:]
+        return sum(vals) / len(vals) if vals else None
+
+    def byte_reconciliation(self):
+        """measured / predicted collective bytes per step (1.0 = the
+        static predictor and the runtime counters agree)."""
+        measured = self.measured_bytes_per_step()
+        if not measured or not self.predicted_bytes_per_step:
+            return None
+        return measured / self.predicted_bytes_per_step
+
+    def wire_goodput_gbps(self, skip_first=True):
+        """Collective payload moved per second of step wall time, in
+        GB/s — the goodput column (payload only: negotiation frames and
+        protocol overhead excluded by construction)."""
+        dt = self.mean_step_s(skip_first)
+        bytes_ = self.measured_bytes_per_step(skip_first)
+        if not dt or bytes_ is None:
+            return None
+        return bytes_ / dt / 1e9
+
+    def summary(self):
+        """One JSON-ready row of everything the timer knows."""
+        snap = None
+        try:
+            snap = _core.snapshot()
+        except Exception:  # noqa: BLE001
+            pass
+        row = {
+            "steps": self.steps,
+            "mean_step_s": self.mean_step_s(),
+            "mfu": self.mfu(),
+            "flops_per_step": self.flops_per_step,
+            "bytes_per_step": self.measured_bytes_per_step(),
+            "predicted_bytes_per_step": self.predicted_bytes_per_step,
+            "byte_reconciliation": self.byte_reconciliation(),
+            "wire_goodput_gbps": self.wire_goodput_gbps(),
+        }
+        if snap and snap.get("initialized"):
+            row["cache_hit_rate"] = snap["cache"]["hit_rate"]
+            row["cycle_stalls"] = snap["cycle"]["stalls"]
+        return row
+
+
+# ---- pipeline bubble accounting ---------------------------------------
+
+
+def analytic_bubble(schedule, S, M, num_virtual=1):
+    """The schedule's predicted idle fraction, from the same closed
+    forms / tables the engines execute (``parallel.pipeline``; same
+    numbers bench.py's ``pipeline_bubble`` rows emit). Schedules:
+    ``gpipe``, ``1f1b`` (lockstep), ``interleaved_1f1b``."""
+    if schedule == "gpipe":
+        return 2 * (S - 1) / (2 * M + 2 * (S - 1))
+    if schedule == "1f1b":
+        return 2 * (S - 1) / (M + 2 * (S - 1))
+    if schedule == "interleaved_1f1b":
+        from horovod_tpu.parallel.pipeline import build_interleaved_schedule
+
+        return build_interleaved_schedule(S, num_virtual, M).bubble_fraction
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def measured_bubble(step_time_s, subtick_time_s, M, num_virtual=1):
+    """Measured idle fraction: each device runs ``2*M*V`` useful
+    fwd/bwd subticks per step, so work time is ``2*M*V*subtick`` and
+    everything else in the step wall time is bubble (plus comms — on
+    hardware, measure ``subtick_time_s`` by timing the stage program
+    standalone)."""
+    work = 2.0 * M * num_virtual * subtick_time_s
+    if step_time_s <= 0:
+        raise ValueError("step_time_s must be positive")
+    return max(0.0, 1.0 - work / step_time_s)
+
+
+def bubble_report(schedule, S, M, num_virtual, step_time_s,
+                  subtick_time_s):
+    """Measured vs analytic bubble for one pipeline configuration.
+
+    ``excess`` is the gap the analytic model cannot explain —
+    scheduling overhead, comms not overlapped, stragglers — i.e. the
+    actionable number."""
+    measured = measured_bubble(step_time_s, subtick_time_s, M,
+                               num_virtual)
+    analytic = analytic_bubble(schedule, S, M, num_virtual)
+    return {
+        "schedule": schedule, "S": S, "M": M, "V": num_virtual,
+        "measured_bubble": round(measured, 4),
+        "analytic_bubble": round(analytic, 4),
+        "excess": round(measured - analytic, 4),
+        "step_time_s": step_time_s,
+        "subtick_time_s": subtick_time_s,
+    }

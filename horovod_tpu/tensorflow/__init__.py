@@ -43,6 +43,8 @@ from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
     join,
     local_rank,
     local_size,
+    metrics,
+    metrics_reset,
     rank,
     reducescatter,
     shutdown,

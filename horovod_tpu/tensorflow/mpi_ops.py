@@ -59,6 +59,10 @@ for _cap in _basics.CAPABILITY_NAMES:
     globals()[_cap] = getattr(_basics, _cap)
 start_timeline = _basics.start_timeline
 stop_timeline = _basics.stop_timeline
+# Metrics registry snapshot (docs/metrics.md) — same surface on
+# every frontend.
+metrics = _basics.metrics_snapshot
+metrics_reset = _basics.metrics_reset
 
 from horovod_tpu.common.auto_name import make_auto_namer
 

@@ -58,6 +58,8 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     init,
     is_initialized,
     local_rank,
+    metrics,
+    metrics_reset,
     local_size,
     poll,
     rank,

@@ -22,6 +22,16 @@ def _interpret_mode():
     fa_mod._INTERPRET = False
 
 
+def _skip_without_shard_map():
+    """The ring/ulysses mesh comparisons drive jax.shard_map directly
+    (same gate as tests/single/test_llama.py): on jax 0.4.x boxes only
+    jax.experimental.shard_map exists, with check_rep instead of
+    check_vma — skip rather than fail there; the driver's newer-jax box
+    runs them."""
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("needs jax.shard_map (jax >= 0.6)")
+
+
 def _qkv(seed=0, B=1, T=32, H=2, D=8):
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
     shape = (B, H, T, D)  # kernel layout
@@ -168,6 +178,7 @@ def test_ring_attention_flash_path_matches_blockwise(causal):
     """The flash ring path (pallas chunk kernel + logsumexp merge,
     interpret mode) must match the XLA blockwise ring on a real
     sharded mesh — values and grads, including GQA kv heads."""
+    _skip_without_shard_map()
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -212,6 +223,7 @@ def test_ulysses_flash_path_matches_blockwise(causal):
     """Ulysses' post-all-to-all local attention through the pallas
     kernels (interpret) must match its blockwise path — incl. the GQA
     grouping that survives the head split."""
+    _skip_without_shard_map()
     from jax.sharding import Mesh, PartitionSpec as P
 
     from horovod_tpu.parallel.ulysses import ulysses_attention

@@ -53,6 +53,9 @@ _DRIVER = textwrap.dedent("""
             b.lib.hvdtpu_set_fusion_threshold_bytes((1 << 20) + i)
             b.lib.hvdtpu_set_cycle_time_ms(0.5 + (i % 3))
             b.response_cache_stats()
+            # Metrics snapshot from an API thread while the background
+            # loop records into the registry (the r9 read path).
+            b.metrics_snapshot()
             b.stop_timeline()
             i += 1
 
