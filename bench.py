@@ -584,6 +584,119 @@ def _smoke_row():
     return run_spmd(cfg, 2, 128, 3, "llama_train_step_mfu", "cpu smoke")
 
 
+# Child body for one ring_busbw rank: pure host — numpy + the native
+# core over TCP loopback, no jax import, so children are safe to run
+# before the flagship subprocess claims the virgin device heap.
+_RING_BUSBW_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, os.environ["HVDTPU_REPO"])
+from horovod_tpu.common import basics, eager_ops
+b = basics.HorovodBasics()
+b.init()
+rank, size = b.rank(), b.size()
+points = []
+try:
+    for nbytes in json.loads(os.environ["RING_BUSBW_SIZES"]):
+        elems = max(nbytes // 4, 1)
+        x = np.full(elems, float(rank + 1), np.float32)
+        iters = max(2, min(20, (1 << 24) // nbytes))
+        eager_ops.allreduce_async(x, f"bw.{nbytes}.warm").synchronize()
+        snap0 = b.metrics_snapshot()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            eager_ops.allreduce_async(x, f"bw.{nbytes}.{i}").synchronize()
+        dt = (time.perf_counter() - t0) / iters
+        snap1 = b.metrics_snapshot()
+        tx = snap1["wire"]["tx_bytes"] - snap0["wire"]["tx_bytes"]
+        txl = (snap1["wire"]["tx_logical_bytes"]
+               - snap0["wire"]["tx_logical_bytes"])
+        points.append({
+            "payload_bytes": nbytes,
+            "busbw_gbps": round(2 * (size - 1) / size * nbytes / dt / 1e9,
+                                4),
+            "step_s": round(dt, 6),
+            "wire_ratio": round(tx / txl, 4) if txl else None,
+        })
+finally:
+    b.shutdown()
+if rank == 0:
+    print("RING_BUSBW_POINTS " + json.dumps(points), flush=True)
+"""
+
+
+def _ring_busbw_rows(ranks=4):
+    """Host-ring allreduce bus-bandwidth sweep, one JSON row per
+    transport config: bulk-synchronous (chunk knob 0 — the pre-r10
+    engine), chunk-overlapped (default 256 KiB double-buffered
+    pipeline), and chunk-overlapped + bf16 wire compression. 1 KiB to
+    64 MiB payloads over `ranks` local processes on TCP loopback —
+    substrate-independent, so the driver's bench capture gets the
+    overlap and compression wins as numbers on any box. busbw follows
+    the NCCL-tests convention (2(N-1)/N x payload / time); wire_ratio
+    is the measured transport/full-width byte quotient (~0.5 when
+    compression engages — the core's wire-vs-logical counters)."""
+    import os
+    import socket
+    import subprocess
+
+    sizes = [1 << 10, 1 << 15, 1 << 20, 1 << 24, 1 << 26]
+    configs = [
+        ("bulk", {"HOROVOD_RING_CHUNK_BYTES": "0",
+                  "HOROVOD_WIRE_COMPRESSION": "0"}),
+        ("overlap", {"HOROVOD_RING_CHUNK_BYTES": str(256 * 1024),
+                     "HOROVOD_WIRE_COMPRESSION": "0"}),
+        ("overlap+bf16", {"HOROVOD_RING_CHUNK_BYTES": str(256 * 1024),
+                          "HOROVOD_WIRE_COMPRESSION": "1"}),
+    ]
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rows = []
+    for name, knobs in configs:
+        row = {"metric": "ring_busbw", "config": name, "ranks": ranks,
+               "unit": "host-ring allreduce bus GB/s (2(N-1)/N x "
+                       "payload/time), TCP loopback; wire_ratio = "
+                       "transport/full-width bytes"}
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        procs = []
+        try:
+            for r in range(ranks):
+                env = dict(os.environ)
+                env.update({
+                    "HOROVOD_RANK": str(r), "HOROVOD_SIZE": str(ranks),
+                    "HOROVOD_LOCAL_RANK": str(r),
+                    "HOROVOD_LOCAL_SIZE": str(ranks),
+                    "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+                    "HOROVOD_CONTROLLER_PORT": str(port),
+                    "HVDTPU_REPO": repo,
+                    "RING_BUSBW_SIZES": json.dumps(sizes),
+                })
+                env.update(knobs)
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", _RING_BUSBW_CHILD],
+                    stdout=subprocess.PIPE if r == 0 else subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL, text=True, env=env))
+            out, _ = procs[0].communicate(timeout=600)
+            for p in procs[1:]:
+                p.wait(timeout=60)
+            points = None
+            for line in out.splitlines():
+                if line.startswith("RING_BUSBW_POINTS "):
+                    points = json.loads(line.split(" ", 1)[1])
+            if points is None:
+                raise RuntimeError("rank 0 emitted no points")
+            row["points"] = points
+        except Exception as e:  # noqa: BLE001 — a failed transport
+            # config yields an error row; the sweep continues.
+            for p in procs:
+                p.kill()
+            row["error"] = f"{type(e).__name__}: {e}"
+        rows.append(row)
+    return rows
+
+
 def _sweep_points(batch):
     """The --sweep point table: (name, config, run_spmd kwargs)."""
     import dataclasses
@@ -731,6 +844,11 @@ def main():
         argv = [a for a in argv if a != "--lint"]
         if not argv:
             return
+    if "--ring-busbw" in argv:
+        # Standalone host-ring transport sweep (no accelerator needed).
+        for row in _ring_busbw_rows():
+            emit(row)
+        return
     if "--quick" in argv:
         if jax.devices()[0].platform == "cpu":
             emit(_smoke_row())
@@ -768,8 +886,15 @@ def main():
     # FIRST client to touch the chip (virgin-heap requirement for the
     # split step — see _flagship_row).
     if _probe_platform() == "cpu":  # CI / no-accelerator smoke path
+        for row in _ring_busbw_rows():
+            emit(row)
         emit(_smoke_row())
         return
+
+    # Host-ring transport rows first: loopback subprocesses that never
+    # import jax, so the flagship subprocess still gets a virgin heap.
+    for row in _ring_busbw_rows():
+        emit(row)
 
     flagship_row, flagship_extras = _flagship_row()
 

@@ -5,16 +5,27 @@
 
 namespace hvdtpu {
 
-BayesOpt::BayesOpt(std::vector<std::array<double, 2>> candidates,
+BayesOpt::BayesOpt(std::vector<std::vector<double>> candidates,
                    double length_scale, double noise)
     : cand_(std::move(candidates)),
       ls2_(2.0 * length_scale * length_scale),
       noise_(noise) {}
 
-double BayesOpt::Kernel(const std::array<double, 2>& a,
-                        const std::array<double, 2>& b) const {
-  double d0 = a[0] - b[0], d1 = a[1] - b[1];
-  return std::exp(-(d0 * d0 + d1 * d1) / ls2_);
+BayesOpt::BayesOpt(std::vector<std::array<double, 2>> candidates,
+                   double length_scale, double noise)
+    : ls2_(2.0 * length_scale * length_scale), noise_(noise) {
+  cand_.reserve(candidates.size());
+  for (auto& c : candidates) cand_.push_back({c[0], c[1]});
+}
+
+double BayesOpt::Kernel(const std::vector<double>& a,
+                        const std::vector<double>& b) const {
+  double sq = 0;
+  for (size_t i = 0; i < a.size(); i++) {
+    double d = a[i] - b[i];
+    sq += d * d;
+  }
+  return std::exp(-sq / ls2_);
 }
 
 void BayesOpt::AddSample(size_t idx, double y) {

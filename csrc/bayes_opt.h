@@ -19,6 +19,11 @@ namespace hvdtpu {
 class BayesOpt {
  public:
   // candidates: points in the (already normalized, ~[0,1]^d) knob space.
+  // Arbitrary dimension — the r10 ring-knob grid is 4-D (fusion, cycle,
+  // chunk, compression); all points must share one length.
+  explicit BayesOpt(std::vector<std::vector<double>> candidates,
+                    double length_scale = 0.3, double noise = 1e-3);
+  // Convenience for the original 2-D (fusion, cycle) grids.
   explicit BayesOpt(std::vector<std::array<double, 2>> candidates,
                     double length_scale = 0.3, double noise = 1e-3);
 
@@ -39,10 +44,10 @@ class BayesOpt {
   size_t num_samples() const { return xs_.size(); }
 
  private:
-  double Kernel(const std::array<double, 2>& a,
-                const std::array<double, 2>& b) const;
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
 
-  std::vector<std::array<double, 2>> cand_;
+  std::vector<std::vector<double>> cand_;
   double ls2_;    // 2 * length_scale^2
   double noise_;
   std::vector<size_t> xs_;   // sampled candidate indices

@@ -66,10 +66,17 @@ class Controller {
 
   // Coordinator only: adopt autotuned knobs locally (fusion decisions are
   // made here) and piggyback them on every subsequent ResponseList.
-  void SetAutotunedParams(int64_t fusion_bytes, double cycle_ms) {
+  // ring_chunk_bytes/wire_compression keep their unset sentinels (-1)
+  // until the tuner actually moves them, so non-autotuned runs
+  // broadcast nothing and workers keep their env-derived values.
+  void SetAutotunedParams(int64_t fusion_bytes, double cycle_ms,
+                          int64_t ring_chunk_bytes = -1,
+                          int32_t wire_compression = -1) {
     cfg_.fusion_threshold_bytes = fusion_bytes;
     bcast_fusion_bytes_ = fusion_bytes;
     bcast_cycle_ms_ = cycle_ms;
+    bcast_ring_chunk_bytes_ = ring_chunk_bytes;
+    bcast_wire_compression_ = wire_compression;
   }
 
  private:
@@ -135,6 +142,8 @@ class Controller {
   int32_t last_joined_rank_ = -1;
   int64_t bcast_fusion_bytes_ = 0;  // 0 = nothing to broadcast
   double bcast_cycle_ms_ = 0;
+  int64_t bcast_ring_chunk_bytes_ = -1;  // -1 = nothing to broadcast
+  int32_t bcast_wire_compression_ = -1;
   std::chrono::steady_clock::time_point last_stall_check_;
 
   // --- Response cache (all ranks; state bit-identical by construction) ---

@@ -190,6 +190,8 @@ std::string SerializeResponseList(const ResponseList& list) {
   w.Put<uint8_t>(list.shutdown ? 1 : 0);
   w.Put<int64_t>(list.fusion_threshold_bytes);
   w.Put<double>(list.cycle_time_ms);
+  w.Put<int64_t>(list.ring_chunk_bytes);
+  w.Put<int32_t>(list.wire_compression);
   w.PutI64Vec(list.cache_hit_positions);
   w.PutI64Vec(list.cache_hit_group_sizes);
   w.PutI64Vec(list.cache_evictions);
@@ -205,6 +207,10 @@ Status ParseResponseList(const std::string& buf, ResponseList* list) {
   list->shutdown = shutdown != 0;
   if (!rd.Get(&list->fusion_threshold_bytes) ||
       !rd.Get(&list->cycle_time_ms)) {
+    return Status::Error("truncated ResponseList");
+  }
+  if (!rd.Get(&list->ring_chunk_bytes) ||
+      !rd.Get(&list->wire_compression)) {
     return Status::Error("truncated ResponseList");
   }
   if (!rd.GetI64Vec(&list->cache_hit_positions) ||

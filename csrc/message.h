@@ -135,6 +135,13 @@ struct ResponseList {
   // Reference analog: parameter_manager.cc values synced via the controller.
   int64_t fusion_threshold_bytes = 0;
   double cycle_time_ms = 0;
+  // Ring transport knobs (-1 = unset; chunk 0 is a legal value — the
+  // bulk-synchronous path). These MUST stay rank-uniform: the chunk
+  // split is the message framing on the external transport, and the
+  // compression flag decides the per-hop wire width, so the autotuner
+  // syncs them the same way it syncs fusion/cycle.
+  int64_t ring_chunk_bytes = -1;
+  int32_t wire_compression = -1;  // -1 unset, 0 off, 1 on
   // Response-cache verdicts. Positions ready on every member rank this
   // cycle, grouped for fusion: group_sizes partitions cache_hit_positions
   // (e.g. [3,1] = first three fuse into one allreduce, next is alone).

@@ -133,6 +133,14 @@ std::string LatencyHistogram::Json() const {
   return out;
 }
 
+void Metrics::AccountWire(int64_t tx, int64_t rx, int64_t tx_logical,
+                          int64_t rx_logical) {
+  wire_tx_bytes.fetch_add(tx, std::memory_order_relaxed);
+  wire_rx_bytes.fetch_add(rx, std::memory_order_relaxed);
+  wire_tx_logical_bytes.fetch_add(tx_logical, std::memory_order_relaxed);
+  wire_rx_logical_bytes.fetch_add(rx_logical, std::memory_order_relaxed);
+}
+
 void Metrics::RecordStraggler(int rank, int64_t skew_us) {
   {
     std::lock_guard<std::mutex> lk(straggler_mutex_);
@@ -166,6 +174,10 @@ void Metrics::Reset() {
   fusion_fill_bytes.store(0);
   fusion_capacity_bytes.store(0);
   errors.store(0);
+  wire_tx_bytes.store(0);
+  wire_rx_bytes.store(0);
+  wire_tx_logical_bytes.store(0);
+  wire_rx_logical_bytes.store(0);
   std::lock_guard<std::mutex> lk(straggler_mutex_);
   straggler_counts_.clear();
 }
@@ -213,11 +225,24 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
     out += "],\"skew_us\":" + straggler_skew_us.Json() + "},";
   }
 
+  int64_t wtx = wire_tx_bytes.load(std::memory_order_relaxed);
+  int64_t wrx = wire_rx_bytes.load(std::memory_order_relaxed);
+  int64_t wtxl = wire_tx_logical_bytes.load(std::memory_order_relaxed);
+  int64_t wrxl = wire_rx_logical_bytes.load(std::memory_order_relaxed);
+  Append(out, "\"wire\":{\"tx_bytes\":%lld,\"rx_bytes\":%lld,"
+              "\"tx_logical_bytes\":%lld,\"rx_logical_bytes\":%lld,"
+              "\"compression_ratio\":%.6f},",
+         (long long)wtx, (long long)wrx, (long long)wtxl, (long long)wrxl,
+         wtxl > 0 ? (double)wtx / (double)wtxl : 1.0);
+
   Append(out, "\"errors\":%lld,",
          (long long)errors.load(std::memory_order_relaxed));
   Append(out, "\"knobs\":{\"fusion_threshold_bytes\":%lld,"
-              "\"cycle_time_ms\":%.6f}}",
-         (long long)info.fusion_threshold_bytes, info.cycle_time_ms);
+              "\"cycle_time_ms\":%.6f,\"ring_chunk_bytes\":%lld,"
+              "\"wire_compression\":%s}}",
+         (long long)info.fusion_threshold_bytes, info.cycle_time_ms,
+         (long long)info.ring_chunk_bytes,
+         info.wire_compression ? "true" : "false");
   return out;
 }
 

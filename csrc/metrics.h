@@ -83,6 +83,21 @@ class Metrics {
 
   std::atomic<int64_t> errors{0};  // ERROR responses surfaced
 
+  // Host-ring transport accounting, kept SEPARATE from the per-op-class
+  // logical payload bytes above: `wire_*_bytes` is what actually
+  // crossed the transport, `wire_*_logical_bytes` what the same
+  // traffic would be at full tensor width. They differ exactly by the
+  // wire-compression saving (bf16-on-wire halves fp32 hops) — the pair
+  // telemetry needs to keep wire_goodput_gbps and byte reconciliation
+  // honest when HOROVOD_WIRE_COMPRESSION is on. Note the ring moves
+  // ~2(N-1)/N x payload per rank, so wire_logical != ops.bytes either.
+  std::atomic<int64_t> wire_tx_bytes{0};
+  std::atomic<int64_t> wire_rx_bytes{0};
+  std::atomic<int64_t> wire_tx_logical_bytes{0};
+  std::atomic<int64_t> wire_rx_logical_bytes{0};
+
+  void AccountWire(int64_t tx, int64_t rx, int64_t tx_logical,
+                   int64_t rx_logical);
   void RecordStraggler(int rank, int64_t skew_us);
   void Reset();
 
@@ -93,6 +108,8 @@ class Metrics {
     int rank = -1, size = 0;
     int64_t fusion_threshold_bytes = 0;
     double cycle_time_ms = 0;
+    int64_t ring_chunk_bytes = 0;
+    bool wire_compression = false;
     int64_t cache_hits = 0, cache_misses = 0, cache_entries = 0;
     int64_t cache_hit_bytes = 0;
   };

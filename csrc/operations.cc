@@ -173,14 +173,27 @@ void ApplyPostOp(TensorTableEntry& e, void* buf, int64_t count, int size) {
 // Reference analog: the NCCLAllreduce vs NCCLHierarchicalAllreduce pick
 // under HOROVOD_HIERARCHICAL_ALLREDUCE.
 Status RingAllreduce(GlobalState& st, DataPlane* dp, void* buf,
-                     int64_t count, DataType dt, ReduceOp op) {
+                     int64_t count, DataType dt, ReduceOp op,
+                     double postscale = 1.0) {
   // st.hierarchical is only true after the collective eligibility check
   // at init (homogeneous host-major layout) — so the remaining per-call
   // condition is just "global process set".
   if (st.hierarchical && dp->size() == st.size) {
-    return dp->HierarchicalAllreduce(buf, count, dt, op, st.local_size);
+    return dp->HierarchicalAllreduce(buf, count, dt, op, st.local_size,
+                                     postscale);
   }
-  return dp->Allreduce(buf, count, dt, op);
+  return dp->Allreduce(buf, count, dt, op, postscale);
+}
+
+// Effective post-ring scale for one entry (AVERAGE divides by size).
+double PostFactor(const TensorTableEntry& e, int size) {
+  double post = e.postscale_factor;
+  if (e.reduce_op == ReduceOp::AVERAGE) post /= (double)size;
+  return post;
+}
+
+bool IsLinearOp(ReduceOp op) {
+  return op == ReduceOp::SUM || op == ReduceOp::AVERAGE;
 }
 
 Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
@@ -190,18 +203,31 @@ Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
     if (e.output != e.input) {
       std::memcpy(e.output, e.input, (size_t)e.SizeBytes());
     }
-    ScaleBuffer(e.output, e.NumElements(), e.dtype, e.prescale_factor);
+    double post = PostFactor(e, dp->size());
+    if (e.prescale_factor != 1.0 &&
+        e.dtype == DataType::HVDTPU_BFLOAT16 && IsLinearOp(e.reduce_op)) {
+      // bf16 pre/postscale fold: sum(pre*x) == pre*sum(x) for linear
+      // ops, so the pre-ring pass — which would round every element to
+      // bf16 once more AND traverse the buffer — folds into the single
+      // post-ring scale. bf16 shares f32's exponent range, so deferring
+      // the scale cannot overflow a partial the prescaled run would
+      // have kept finite (fp16 keeps its overflow-guard prescale).
+      post *= e.prescale_factor;
+    } else {
+      ScaleBuffer(e.output, e.NumElements(), e.dtype, e.prescale_factor);
+    }
     st.timeline.ActivityStart(e.name, "RING_ALLREDUCE");
     Status s;
     {
       ScopedLatency wire(GlobalMetrics().wire_us);
+      // The postscale rides into the ring: the compressed engine folds
+      // it into its final bf16->f32 decode pass, the uncompressed ring
+      // applies it after the allgather phase — bit-identical either way.
       s = RingAllreduce(st, dp, e.output, e.NumElements(), e.dtype,
-                        e.reduce_op);
+                        e.reduce_op, post);
     }
     st.timeline.ActivityEnd(e.name);
-    if (!s.ok()) return s;
-    ApplyPostOp(e, e.output, e.NumElements(), dp->size());
-    return Status::OK();
+    return s;
   }
   // Fused path: pack into the fusion buffer, one ring allreduce, unpack.
   // Reference analog: MemcpyInFusionBuffer / MemcpyOutFusionBuffer
@@ -230,18 +256,34 @@ Status ExecuteAllreduce(GlobalState& st, DataPlane* dp,
   }
   DataType dt = entries[0].dtype;
   int64_t count = total / DataTypeSize(dt);
+  // Uniform postscale folding: the common eager case — every gradient
+  // averaged, no prescale — applies ONE postscale across the whole
+  // fusion buffer inside the ring (the compressed engine does it for
+  // free during the final decode pass) instead of per-entry passes.
+  bool uniform_post = true;
+  for (auto& e : entries) {
+    if (e.prescale_factor != 1.0 ||
+        e.postscale_factor != entries[0].postscale_factor ||
+        e.reduce_op != entries[0].reduce_op) {
+      uniform_post = false;
+      break;
+    }
+  }
+  double ring_post =
+      uniform_post ? PostFactor(entries[0], dp->size()) : 1.0;
   for (auto& e : entries) st.timeline.ActivityStart(e.name, "RING_ALLREDUCE");
   Status s;
   {
     ScopedLatency wire(GlobalMetrics().wire_us);
-    s = RingAllreduce(st, dp, base, count, dt, entries[0].reduce_op);
+    s = RingAllreduce(st, dp, base, count, dt, entries[0].reduce_op,
+                      ring_post);
   }
   for (auto& e : entries) st.timeline.ActivityEnd(e.name);
   if (!s.ok()) return s;
   off = 0;
   for (auto& e : entries) {
     st.timeline.ActivityStart(e.name, "MEMCPY_OUT_FUSION_BUFFER");
-    ApplyPostOp(e, base + off, e.NumElements(), dp->size());
+    if (!uniform_post) ApplyPostOp(e, base + off, e.NumElements(), dp->size());
     std::memcpy(e.output, base + off, (size_t)e.SizeBytes());
     st.timeline.ActivityEnd(e.name);
     off += e.SizeBytes();
@@ -676,6 +718,16 @@ void BackgroundThreadLoop(GlobalState& st) {
     if (response_list.cycle_time_ms > 0 && st.rank != 0) {
       st.cycle_time_ms = response_list.cycle_time_ms;
     }
+    // Ring knobs must flip on every rank in the SAME cycle (the chunk
+    // split is the wire framing; compression is the wire width): the
+    // coordinator adopted these at the END of the previous cycle, and
+    // workers adopt here before executing this cycle's responses.
+    if (response_list.ring_chunk_bytes >= 0 && st.rank != 0) {
+      SetRingChunkBytes(response_list.ring_chunk_bytes);
+    }
+    if (response_list.wire_compression >= 0 && st.rank != 0) {
+      SetWireCompression(response_list.wire_compression != 0);
+    }
     int64_t cycle_bytes = 0;
     for (auto& response : response_list.responses) {
       for (auto& n : response.tensor_names) st.timeline.NegotiateEnd(n);
@@ -686,8 +738,12 @@ void BackgroundThreadLoop(GlobalState& st) {
         st.param_manager->Update(cycle_bytes)) {
       st.fusion_threshold = st.param_manager->fusion_threshold_bytes();
       st.cycle_time_ms = st.param_manager->cycle_time_ms();
-      st.controller->SetAutotunedParams(st.fusion_threshold.load(),
-                                        st.cycle_time_ms.load());
+      SetRingChunkBytes(st.param_manager->ring_chunk_bytes());
+      SetWireCompression(st.param_manager->wire_compression());
+      st.controller->SetAutotunedParams(
+          st.fusion_threshold.load(), st.cycle_time_ms.load(),
+          st.param_manager->ring_chunk_bytes(),
+          st.param_manager->wire_compression() ? 1 : 0);
     }
     if (response_list.shutdown) break;
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
@@ -779,6 +835,12 @@ int hvdtpu_init() {
       EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
   st->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
   st->hierarchical = EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0;
+  // Ring transport knobs (docs/wire.md). Re-read on every (elastic)
+  // re-init so a respawned worker matches its peers' env-derived
+  // framing even if a prior life's autotuner had moved the globals.
+  SetRingChunkBytes(
+      EnvInt64("HOROVOD_RING_CHUNK_BYTES", kDefaultRingChunkBytes));
+  SetWireCompression(EnvInt64("HOROVOD_WIRE_COMPRESSION", 0) != 0);
 
   st->process_sets = std::make_unique<ProcessSetTable>(st->size);
 
@@ -852,7 +914,12 @@ int hvdtpu_init() {
         EnvStr("HOROVOD_AUTOTUNE_LOG", ""),
         (int)EnvInt64("HOROVOD_AUTOTUNE_STEPS", 20),
         EnvInt64("HOROVOD_AUTOTUNE_WINDOW_BYTES", 1 << 20),
-        (int)EnvInt64("HOROVOD_AUTOTUNE_WINDOW_CYCLES", 20));
+        (int)EnvInt64("HOROVOD_AUTOTUNE_WINDOW_CYCLES", 20),
+        RingChunkBytes(), WireCompression(),
+        // Compression joins the grid only when the user opted into
+        // compressed numerics; the tuner may still settle on OFF
+        // (strictly more accurate), never the other way around.
+        /*tune_wire_compression=*/WireCompression());
   } else {
     st->param_manager.reset();
   }
@@ -1300,6 +1367,18 @@ void hvdtpu_set_cycle_time_ms(double v) {
   if (g_state) g_state->cycle_time_ms = v;
 }
 
+// Ring transport knobs (process-global, valid before init — the ring
+// selftest drives them without a controller). MUST be set identically
+// on every rank of a live job: the chunk split is the message framing
+// and compression the wire width (docs/wire.md).
+int64_t hvdtpu_ring_chunk_bytes() { return RingChunkBytes(); }
+
+void hvdtpu_set_ring_chunk_bytes(int64_t v) { SetRingChunkBytes(v); }
+
+int hvdtpu_wire_compression() { return WireCompression() ? 1 : 0; }
+
+void hvdtpu_set_wire_compression(int v) { SetWireCompression(v != 0); }
+
 int64_t hvdtpu_response_cache_hits() {
   CHECK_INIT(-1)
   return g_state->controller->response_cache().hits();
@@ -1331,6 +1410,8 @@ int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap) {
       info.size = g_state->size;
       info.fusion_threshold_bytes = g_state->fusion_threshold.load();
       info.cycle_time_ms = g_state->cycle_time_ms.load();
+      info.ring_chunk_bytes = RingChunkBytes();
+      info.wire_compression = WireCompression();
       const ResponseCache& c = g_state->controller->response_cache();
       info.cache_hits = c.hits();
       info.cache_misses = c.misses();

@@ -14,27 +14,62 @@ constexpr double kMaxWindowSecs = 5.0;
 void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
                                   const std::string& log_path,
                                   int max_samples, int64_t window_bytes,
-                                  int window_cycles) {
+                                  int window_cycles,
+                                  int64_t ring_chunk_bytes,
+                                  bool wire_compression,
+                                  bool tune_wire_compression) {
   min_window_bytes_ = std::max<int64_t>(window_bytes, 1);
   min_window_cycles_ = std::max(window_cycles, 1);
   for (int64_t v = 1 << 20; v <= (64 << 20); v *= 2) {
     fusion_values_.push_back(v);
   }
   cycle_values_ = {0.5, 1.0, 2.5, 5.0, 10.0};
+  if (ring_chunk_bytes > 0) {
+    chunk_values_ = {64 << 10, 256 << 10, 1 << 20, 4 << 20};
+  } else {
+    // The user explicitly configured the legacy bulk path (chunk
+    // <= 0): it has no point on a log-scaled grid, so pin the
+    // dimension rather than silently abandon an explicit choice
+    // (same philosophy as the compression guard below).
+    chunk_values_ = {ring_chunk_bytes};
+  }
+  // Compression flips numerics: only the user's enablement puts the
+  // on/off choice on the grid; otherwise the dimension is a single
+  // fixed point and the GP never varies it.
+  if (tune_wire_compression) {
+    comp_values_ = {0, 1};
+  } else {
+    comp_values_ = {wire_compression ? 1 : 0};
+  }
   max_samples_ = std::max(max_samples, 2);
 
-  // Candidate grid in a normalized space: log2(fusion MB) and log2(cycle)
-  // both scaled to [0,1] so one RBF length scale covers both knobs.
-  std::vector<std::array<double, 2>> cands;
+  // Candidate grid in a normalized space: log2 of each byte/ms knob
+  // scaled to [0,1] (compression is already {0,1}) so one RBF length
+  // scale covers every dimension.
+  std::vector<std::vector<double>> cands;
   double f_lo = std::log2((double)fusion_values_.front());
   double f_hi = std::log2((double)fusion_values_.back());
   double c_lo = std::log2(cycle_values_.front());
   double c_hi = std::log2(cycle_values_.back());
+  // A pinned (single-value) dimension gets the constant coordinate 0
+  // — no log2 of a possibly-non-positive pinned value.
+  bool chunk_pinned = chunk_values_.size() == 1;
+  double k_lo = chunk_pinned ? 0 : std::log2((double)chunk_values_.front());
+  double k_hi = chunk_pinned ? 1 : std::log2((double)chunk_values_.back());
   for (size_t fi = 0; fi < fusion_values_.size(); fi++) {
     for (size_t ci = 0; ci < cycle_values_.size(); ci++) {
-      cands.push_back({
-          (std::log2((double)fusion_values_[fi]) - f_lo) / (f_hi - f_lo),
-          (std::log2(cycle_values_[ci]) - c_lo) / (c_hi - c_lo)});
+      for (size_t ki = 0; ki < chunk_values_.size(); ki++) {
+        for (size_t mi = 0; mi < comp_values_.size(); mi++) {
+          cands.push_back(
+              {(std::log2((double)fusion_values_[fi]) - f_lo) / (f_hi - f_lo),
+               (std::log2(cycle_values_[ci]) - c_lo) / (c_hi - c_lo),
+               chunk_pinned
+                   ? 0.0
+                   : (std::log2((double)chunk_values_[ki]) - k_lo) /
+                         (k_hi - k_lo),
+               (double)comp_values_[mi]});
+        }
+      }
     }
   }
   opt_ = std::make_unique<BayesOpt>(std::move(cands));
@@ -48,12 +83,27 @@ void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
   for (size_t i = 0; i < cycle_values_.size(); i++) {
     if (cycle_values_[i] <= cycle_ms) cycle_idx_ = i;
   }
-  current_candidate_ = fusion_idx_ * cycle_values_.size() + cycle_idx_;
+  chunk_idx_ = 0;
+  for (size_t i = 0; i < chunk_values_.size(); i++) {
+    if (chunk_values_[i] <= ring_chunk_bytes) chunk_idx_ = i;
+  }
+  comp_idx_ = 0;
+  for (size_t i = 0; i < comp_values_.size(); i++) {
+    if (comp_values_[i] == (wire_compression ? 1 : 0)) comp_idx_ = i;
+  }
+  current_candidate_ =
+      ((fusion_idx_ * cycle_values_.size() + cycle_idx_) *
+           chunk_values_.size() +
+       chunk_idx_) *
+          comp_values_.size() +
+      comp_idx_;
 
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_) {
-      fprintf(log_, "fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n");
+      fprintf(log_, "fusion_threshold_bytes,cycle_time_ms,"
+                    "ring_chunk_bytes,wire_compression,"
+                    "score_bytes_per_sec\n");
       fflush(log_);
     }
   }
@@ -66,15 +116,21 @@ ParameterManager::~ParameterManager() {
 
 void ParameterManager::Log(double score) {
   if (!log_) return;
-  fprintf(log_, "%lld,%.3f,%.0f\n",
-          (long long)fusion_threshold_bytes(), cycle_time_ms(), score);
+  fprintf(log_, "%lld,%.3f,%lld,%d,%.0f\n",
+          (long long)fusion_threshold_bytes(), cycle_time_ms(),
+          (long long)ring_chunk_bytes(), wire_compression() ? 1 : 0,
+          score);
   fflush(log_);
 }
 
 void ParameterManager::MoveTo(size_t candidate) {
   current_candidate_ = candidate;
-  fusion_idx_ = candidate / cycle_values_.size();
+  comp_idx_ = candidate % comp_values_.size();
+  candidate /= comp_values_.size();
+  chunk_idx_ = candidate % chunk_values_.size();
+  candidate /= chunk_values_.size();
   cycle_idx_ = candidate % cycle_values_.size();
+  fusion_idx_ = candidate / cycle_values_.size();
 }
 
 void ParameterManager::Score(double bytes_per_sec) {
@@ -88,8 +144,10 @@ void ParameterManager::Score(double bytes_per_sec) {
     // observed score), not the 20th sampled candidate — consumers
     // read rows[-1] as "what the tuner settled on".
     Log(opt_->MeanScore(current_candidate_));
-    LOG_INFO("autotune converged: fusion=%lld bytes, cycle=%.2f ms",
-             (long long)fusion_threshold_bytes(), cycle_time_ms());
+    LOG_INFO("autotune converged: fusion=%lld bytes, cycle=%.2f ms, "
+             "ring_chunk=%lld bytes, wire_compression=%d",
+             (long long)fusion_threshold_bytes(), cycle_time_ms(),
+             (long long)ring_chunk_bytes(), wire_compression() ? 1 : 0);
     return;
   }
   MoveTo(opt_->Suggest());
@@ -134,6 +192,8 @@ bool ParameterManager::Update(int64_t bytes) {
   if (!window_full || secs <= 0) return false;
   int64_t prev_fusion = fusion_threshold_bytes();
   double prev_cycle = cycle_time_ms();
+  int64_t prev_chunk = ring_chunk_bytes();
+  bool prev_comp = wire_compression();
   if (warmup_windows_ > 0) {
     warmup_windows_--;  // discard: startup warmup pollutes the score
   } else if (window_bytes_ >= min_window_bytes_ ||
@@ -150,7 +210,9 @@ bool ParameterManager::Update(int64_t bytes) {
   window_end_ = now;
   window_ended_ = true;
   return fusion_threshold_bytes() != prev_fusion ||
-         cycle_time_ms() != prev_cycle;
+         cycle_time_ms() != prev_cycle ||
+         ring_chunk_bytes() != prev_chunk ||
+         wire_compression() != prev_comp;
 }
 
 }  // namespace hvdtpu

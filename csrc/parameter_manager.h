@@ -1,11 +1,12 @@
-// Online autotuning of fusion threshold + cycle time.
+// Online autotuning of fusion threshold + cycle time + ring transport
+// knobs (chunk granularity, wire compression).
 // Reference analog: horovod/common/parameter_manager.h (ParameterManager,
 // driven by HOROVOD_AUTOTUNE) with the same optimizer family: Bayesian
 // optimization (GP + Expected Improvement — csrc/bayes_opt.h, the analog
 // of common/optim/bayesian_optimization.cc) over the discrete
-// (fusion threshold, cycle time) grid, scoring sample windows by
-// allreduced bytes/sec. Runs on the coordinator only; chosen values ride
-// to workers on every ResponseList.
+// (fusion threshold, cycle time, ring chunk bytes[, wire compression])
+// grid, scoring sample windows by allreduced bytes/sec. Runs on the
+// coordinator only; chosen values ride to workers on every ResponseList.
 
 #ifndef HVDTPU_PARAMETER_MANAGER_H
 #define HVDTPU_PARAMETER_MANAGER_H
@@ -30,15 +31,25 @@ class ParameterManager {
   // scored: bursty eager workloads want windows spanning SEVERAL
   // steps, or per-window bytes/sec is dominated by where in the
   // compute/allreduce burst cycle the window boundary lands.
+  // ring_chunk_bytes seeds the chunk-granularity grid dimension.
+  // tune_wire_compression adds the on/off compression dimension — only
+  // set when the USER enabled HOROVOD_WIRE_COMPRESSION (the tuner may
+  // then fall back to the strictly-more-accurate uncompressed wire,
+  // but never silently narrows a run the user wanted full-width).
   void Initialize(int64_t fusion_bytes, double cycle_ms,
                   const std::string& log_path, int max_samples = 20,
                   int64_t window_bytes = 1 << 20,
-                  int window_cycles = 20);
+                  int window_cycles = 20,
+                  int64_t ring_chunk_bytes = 256 * 1024,
+                  bool wire_compression = false,
+                  bool tune_wire_compression = false);
   ~ParameterManager();
 
   bool active() const { return active_; }
   int64_t fusion_threshold_bytes() const { return fusion_values_[fusion_idx_]; }
   double cycle_time_ms() const { return cycle_values_[cycle_idx_]; }
+  int64_t ring_chunk_bytes() const { return chunk_values_[chunk_idx_]; }
+  bool wire_compression() const { return comp_values_[comp_idx_] != 0; }
 
   // Record bytes moved by allreduce responses this cycle; returns true when
   // a tuning window closed and the recommended parameters may have changed.
@@ -54,10 +65,13 @@ class ParameterManager {
 
   std::vector<int64_t> fusion_values_;
   std::vector<double> cycle_values_;
-  size_t fusion_idx_ = 0, cycle_idx_ = 0;
+  std::vector<int64_t> chunk_values_;
+  std::vector<int> comp_values_;  // {0} / {1} fixed, or {0,1} tuned
+  size_t fusion_idx_ = 0, cycle_idx_ = 0, chunk_idx_ = 0, comp_idx_ = 0;
 
   // Bayesian optimization over the flattened grid: candidate index
-  // c = fusion_i * |cycle| + cycle_i.
+  // c = ((fusion_i * |cycle| + cycle_i) * |chunk| + chunk_i) * |comp|
+  //     + comp_i.
   std::unique_ptr<BayesOpt> opt_;
   size_t current_candidate_ = 0;
   int max_samples_ = 20;

@@ -1,14 +1,24 @@
 #include "ring_ops.h"
 
 #include <algorithm>
+#include <atomic>
+#include <condition_variable>
 #include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
 
 #include "half.h"
+#include "metrics.h"
 #include "wire.h"
 
 namespace hvdtpu {
 
 namespace {
+
+std::atomic<int64_t> g_ring_chunk_bytes{kDefaultRingChunkBytes};
+std::atomic<bool> g_wire_compression{false};
 
 template <typename T, typename Acc = T>
 void ReduceTyped(T* dst, const T* src, int64_t count, ReduceOp op) {
@@ -51,7 +61,156 @@ void ReduceHalfLike(uint16_t* dst, const uint16_t* src, int64_t count,
   }
 }
 
+template <uint16_t (*ToBits)(float), float (*FromBits)(uint16_t)>
+void ScaleHalfLike(uint16_t* p, int64_t count, double factor) {
+  // Blocked decode -> scale -> encode through an f32 staging array: the
+  // three narrow loops vectorize, where the old fused per-element loop
+  // serialized a decode/multiply/encode dependency chain per lane.
+  // Values are bit-identical to the fused form (decode is exact, one
+  // f32-rounded multiply, one encode rounding).
+  constexpr int64_t kBlock = 256;
+  float tmp[kBlock];
+  for (int64_t i = 0; i < count; i += kBlock) {
+    int64_t n = std::min(kBlock, count - i);
+    for (int64_t j = 0; j < n; j++) tmp[j] = FromBits(p[i + j]);
+    for (int64_t j = 0; j < n; j++) tmp[j] = (float)(tmp[j] * factor);
+    for (int64_t j = 0; j < n; j++) p[i + j] = ToBits(tmp[j]);
+  }
+}
+
+// ---- bf16 wire codec (compressed allreduce) --------------------------
+
+void EncodeBF16(uint16_t* dst, const float* src, int64_t n) {
+  for (int64_t i = 0; i < n; i++) dst[i] = FloatToBF16Bits(src[i]);
+}
+
+void DecodeAccumBF16(float* dst, const uint16_t* src, int64_t n) {
+  // Full-precision accumulation: the bf16 hop payload is widened back
+  // to f32 before the add, so only the WIRE is narrow (EQuARX recipe).
+  for (int64_t i = 0; i < n; i++) dst[i] += BF16BitsToFloat(src[i]);
+}
+
+void DecodeScaleBF16(float* dst, const uint16_t* src, int64_t n,
+                     double post) {
+  if (post == 1.0) {
+    for (int64_t i = 0; i < n; i++) dst[i] = BF16BitsToFloat(src[i]);
+  } else {
+    // Same rounding as ScaleBuffer's f32 case (double multiply, one
+    // f32 cast) so folding the postscale here is bit-identical to
+    // scaling after the decode — it only saves the extra memory pass.
+    for (int64_t i = 0; i < n; i++) {
+      dst[i] = (float)((double)BF16BitsToFloat(src[i]) * post);
+    }
+  }
+}
+
+// Identical clamped chunk spans over the two directions of one hop:
+// fn(i, soff, slen, roff, rlen) per chunk index, offsets/lengths in
+// the caller's units. Both ends of a hop share the segment lengths,
+// so this span table IS the external transport's message framing —
+// every chunked path must slice through here.
+template <typename Fn>
+Status ForEachChunkSpan(int64_t send_len, int64_t recv_len, int64_t chunk,
+                        Fn&& fn) {
+  const int64_t nchunks = (std::max(send_len, recv_len) + chunk - 1) / chunk;
+  for (int64_t i = 0; i < nchunks; i++) {
+    int64_t soff = std::min(i * chunk, send_len);
+    int64_t slen = std::min(chunk, send_len - soff);
+    int64_t roff = std::min(i * chunk, recv_len);
+    int64_t rlen = std::min(chunk, recv_len - roff);
+    Status s = fn(i, soff, slen, roff, rlen);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
 }  // namespace
+
+int64_t RingChunkBytes() {
+  return g_ring_chunk_bytes.load(std::memory_order_relaxed);
+}
+
+void SetRingChunkBytes(int64_t bytes) {
+  g_ring_chunk_bytes.store(bytes, std::memory_order_relaxed);
+}
+
+bool WireCompression() {
+  return g_wire_compression.load(std::memory_order_relaxed);
+}
+
+void SetWireCompression(bool on) {
+  g_wire_compression.store(on, std::memory_order_relaxed);
+}
+
+// Overlap worker: one thread, FIFO tasks, started lazily on first
+// Submit so planes that never run a chunked reduce cost nothing. The
+// caller thread owns the transport (wire.h contract); the worker only
+// touches host memory (ReduceInto / bf16 decode), and every public
+// collective drains the queue before returning, so no task outlives
+// the buffers it references.
+class ReduceWorker {
+ public:
+  ~ReduceWorker() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    if (thread_.joinable()) thread_.join();
+  }
+
+  void Submit(std::function<void()> fn) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!thread_.joinable()) thread_ = std::thread(&ReduceWorker::Loop, this);
+    tasks_.push_back(std::move(fn));
+    pending_++;
+    cv_.notify_one();
+  }
+
+  void Drain() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this] { return pending_ == 0; });
+  }
+
+ private:
+  void Loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      cv_.wait(lk, [this] { return stop_ || !tasks_.empty(); });
+      while (!tasks_.empty()) {
+        std::function<void()> fn = std::move(tasks_.front());
+        tasks_.pop_front();
+        lk.unlock();
+        fn();
+        lk.lock();
+        pending_--;
+        if (pending_ == 0) done_cv_.notify_all();
+      }
+      if (stop_) return;
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  std::deque<std::function<void()>> tasks_;
+  int pending_ = 0;  // queued + running
+  bool stop_ = false;
+  std::thread thread_;
+};
+
+// Per-collective wire accounting, flushed into the metrics registry on
+// scope exit (error paths included): `tx/rx` are bytes that actually
+// crossed the transport, `*_logical` what they would be at full tensor
+// width — the pair the wire-vs-logical reconciliation in telemetry
+// reads (compression_ratio = tx / tx_logical).
+struct DataPlane::WireTally {
+  int64_t tx = 0, rx = 0, tx_logical = 0, rx_logical = 0;
+  ~WireTally() {
+    if (tx || rx || tx_logical || rx_logical) {
+      GlobalMetrics().AccountWire(tx, rx, tx_logical, rx_logical);
+    }
+  }
+};
 
 void ReduceInto(void* dst, const void* src, int64_t count, DataType dt,
                 ReduceOp op) {
@@ -114,20 +273,14 @@ void ScaleBuffer(void* buf, int64_t count, DataType dt, double factor) {
       for (int64_t i = 0; i < count; i++) p[i] *= factor;
       break;
     }
-    case DataType::HVDTPU_FLOAT16: {
-      auto* p = (uint16_t*)buf;
-      for (int64_t i = 0; i < count; i++) {
-        p[i] = FloatToHalfBits((float)(HalfBitsToFloat(p[i]) * factor));
-      }
+    case DataType::HVDTPU_FLOAT16:
+      ScaleHalfLike<FloatToHalfBits, HalfBitsToFloat>((uint16_t*)buf, count,
+                                                      factor);
       break;
-    }
-    case DataType::HVDTPU_BFLOAT16: {
-      auto* p = (uint16_t*)buf;
-      for (int64_t i = 0; i < count; i++) {
-        p[i] = FloatToBF16Bits((float)(BF16BitsToFloat(p[i]) * factor));
-      }
+    case DataType::HVDTPU_BFLOAT16:
+      ScaleHalfLike<FloatToBF16Bits, BF16BitsToFloat>((uint16_t*)buf, count,
+                                                      factor);
       break;
-    }
     case DataType::HVDTPU_INT32: {
       auto* p = (int32_t*)buf;
       for (int64_t i = 0; i < count; i++) p[i] = (int32_t)(p[i] * factor);
@@ -149,7 +302,7 @@ DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds)
 DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds,
                      bool owns_fds)
     : rank_(rank), size_(size), peer_fds_(std::move(peer_fds)),
-      owns_fds_(owns_fds) {
+      owns_fds_(owns_fds), worker_(std::make_shared<ReduceWorker>()) {
   global_ranks_.resize(size_);
   for (int i = 0; i < size_; i++) global_ranks_[i] = i;
 }
@@ -174,18 +327,26 @@ DataPlane DataPlane::Subset(const std::vector<int32_t>& members) const {
   DataPlane sub(my_idx, (int)members.size(), std::move(fds),
                 /*owns_fds=*/false);
   sub.global_ranks_ = members;
+  // Share the parent's overlap worker: the core's single background
+  // thread is the only caller on both, so per-response subset views
+  // never spawn (and tear down) their own thread.
+  sub.worker_ = worker_;
   return sub;
 }
 
 Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
-                                        ReduceOp op, int local_size) {
-  if (size_ == 1 || count == 0) return Status::OK();
+                                        ReduceOp op, int local_size,
+                                        double postscale) {
+  if (size_ == 1 || count == 0) {
+    ScaleBuffer(buf, count, dt, postscale);
+    return Status::OK();
+  }
   if (local_size <= 1 || size_ % local_size != 0 ||
       op == ReduceOp::ADASUM) {
-    return Allreduce(buf, count, dt, op);
+    return Allreduce(buf, count, dt, op, postscale);
   }
   const int cross_size = size_ / local_size;
-  if (cross_size <= 1) return Allreduce(buf, count, dt, op);
+  if (cross_size <= 1) return Allreduce(buf, count, dt, op, postscale);
   const int local_rank = rank_ % local_size;
   const int node = rank_ / local_size;
   const int64_t elem = DataTypeSize(dt);
@@ -216,8 +377,10 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
   if (!s.ok()) return s;
 
   // Phase 2: allreduce the segment across nodes (1/local_size of the
-  // payload crosses the node boundary).
-  s = cross.Allreduce(my_seg.data(), seg[local_rank], dt, op);
+  // payload crosses the node boundary). The postscale rides here: each
+  // element passes through exactly one cross-allreduce, so it is
+  // applied exactly once before the allgather distributes it.
+  s = cross.Allreduce(my_seg.data(), seg[local_rank], dt, op, postscale);
   if (!s.ok()) return s;
 
   // Phase 3: local allgather of the fully-reduced segments — rank-order
@@ -227,10 +390,276 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
   return local.Allgatherv(my_seg.data(), buf, seg_bytes);
 }
 
+Status DataPlane::PipelinedReduceChunks(int send_fd, const uint8_t* send_buf,
+                                        int64_t send_bytes, int recv_fd,
+                                        uint8_t* reduce_dst,
+                                        int64_t recv_count, DataType dt,
+                                        ReduceOp op, int64_t chunk_bytes,
+                                        WireTally* tally) {
+  const int64_t elem = DataTypeSize(dt);
+  const int64_t recv_bytes = recv_count * elem;
+  tally->tx += send_bytes;
+  tally->tx_logical += send_bytes;
+  tally->rx += recv_bytes;
+  tally->rx_logical += recv_bytes;
+  if (chunk_bytes <= 0 ||
+      (send_bytes <= chunk_bytes && recv_bytes <= chunk_bytes)) {
+    // Bulk path: one whole-segment transfer, then a serial reduce —
+    // same framing and bit-identical results as the pre-chunking ring.
+    if ((int64_t)scratch_.size() < recv_bytes) scratch_.resize(recv_bytes);
+    Status s = DuplexTransfer(send_fd, send_buf, (size_t)send_bytes, recv_fd,
+                              scratch_.data(), (size_t)recv_bytes);
+    if (!s.ok()) return s;
+    ReduceInto(reduce_dst, scratch_.data(), recv_count, dt, op);
+    return Status::OK();
+  }
+  // Chunk on element boundaries (ReduceInto takes whole elements).
+  const int64_t chunk_elems = std::max<int64_t>(chunk_bytes / elem, 1);
+  const int64_t cbytes = chunk_elems * elem;
+  if (!IsExtFd(send_fd) && !IsExtFd(recv_fd)) {
+    // TCP: ONE continuous duplex for the whole segment — the send
+    // streams with no per-chunk lockstep or fcntl churn (byte-stream
+    // framing is unchanged vs the bulk path), while every completed
+    // recv chunk fires a ReduceInto on the worker, overlapping the
+    // reduction with the rest of the transfer.
+    if ((int64_t)scratch_.size() < recv_bytes) scratch_.resize(recv_bytes);
+    uint8_t* rbuf = scratch_.data();
+    Status s = DuplexTransferChunked(
+        send_fd, send_buf, (size_t)send_bytes, recv_fd, rbuf,
+        (size_t)recv_bytes, (size_t)cbytes,
+        [&](size_t off, size_t len) {
+          uint8_t* dst = reduce_dst + off;
+          const uint8_t* src = rbuf + off;
+          const int64_t n = (int64_t)len / elem;
+          worker_->Submit(
+              [dst, src, n, dt, op] { ReduceInto(dst, src, n, dt, op); });
+        });
+    worker_->Drain();  // the segment is fully reduced before the caller
+    return s;          // forwards it on the next ring step
+  }
+  // External (message) transport: the mailbox preserves boundaries, so
+  // both ends cut identical chunk spans into equal-length paired
+  // messages, double-buffered so the reduce of chunk i-1 overlaps the
+  // exchange of chunk i.
+  if ((int64_t)chunk_scratch_.size() < 2 * cbytes) {
+    chunk_scratch_.resize((size_t)(2 * cbytes));
+  }
+  Status s = ForEachChunkSpan(
+      send_bytes, recv_bytes, cbytes,
+      [&](int64_t i, int64_t soff, int64_t slen, int64_t roff,
+          int64_t rlen) {
+        uint8_t* rscratch = chunk_scratch_.data() + (i & 1) * cbytes;
+        // While this transfer runs, the worker reduces chunk i-1
+        // (submitted below last iteration) out of the other half.
+        Status t = DuplexTransfer(send_fd, send_buf + soff, (size_t)slen,
+                                  recv_fd, rscratch, (size_t)rlen);
+        worker_->Drain();  // chunk i-1 reduced; its scratch half is free
+        if (!t.ok()) return t;
+        if (rlen > 0) {
+          uint8_t* dst = reduce_dst + roff;
+          const int64_t n = rlen / elem;
+          worker_->Submit([dst, rscratch, n, dt, op] {
+            ReduceInto(dst, rscratch, n, dt, op);
+          });
+        }
+        return Status::OK();
+      });
+  worker_->Drain();
+  return s;
+}
+
+Status DataPlane::ChunkedDuplex(int send_fd, const uint8_t* send_buf,
+                                int64_t send_bytes, int recv_fd,
+                                uint8_t* recv_buf, int64_t recv_bytes,
+                                int64_t chunk_bytes, WireTally* tally) {
+  tally->tx += send_bytes;
+  tally->tx_logical += send_bytes;
+  tally->rx += recv_bytes;
+  tally->rx_logical += recv_bytes;
+  // No reduction to overlap here, so the knob only matters where the
+  // transport frames messages: on TCP the byte stream hides chunk
+  // boundaries and one duplex is strictly cheaper.
+  if (chunk_bytes <= 0 ||
+      (send_bytes <= chunk_bytes && recv_bytes <= chunk_bytes) ||
+      (!IsExtFd(send_fd) && !IsExtFd(recv_fd))) {
+    return DuplexTransfer(send_fd, send_buf, (size_t)send_bytes, recv_fd,
+                          recv_buf, (size_t)recv_bytes);
+  }
+  return ForEachChunkSpan(
+      send_bytes, recv_bytes, chunk_bytes,
+      [&](int64_t, int64_t soff, int64_t slen, int64_t roff, int64_t rlen) {
+        return DuplexTransfer(send_fd, send_buf + soff, (size_t)slen,
+                              recv_fd, recv_buf + roff, (size_t)rlen);
+      });
+}
+
+Status DataPlane::CompressedRingAllreduce(
+    float* base, const std::vector<int64_t>& seg_count,
+    const std::vector<int64_t>& seg_off, double postscale,
+    int64_t chunk_bytes, WireTally* tally) {
+  int64_t max_seg = 0;
+  for (int i = 0; i < size_; i++) max_seg = std::max(max_seg, seg_count[i]);
+  // Chunk in elements derived from the LOGICAL byte knob, so the
+  // tunable keeps one meaning whether or not compression is on.
+  const int64_t chunk_elems =
+      chunk_bytes > 0 ? std::max<int64_t>(chunk_bytes / 4, 1)
+                      : std::max<int64_t>(max_seg, 1);
+  const bool tcp = !IsExtFd(right_fd()) && !IsExtFd(left_fd());
+  // Scratch: the TCP path encodes/receives whole segments (one
+  // streaming duplex per step); the external path works chunk-by-chunk
+  // with a double-buffered recv half.
+  const int64_t send_scratch_elems = tcp ? max_seg : chunk_elems;
+  const int64_t recv_scratch_elems =
+      tcp ? max_seg : 2 * chunk_elems;
+  if ((int64_t)comp_send_scratch_.size() < send_scratch_elems * 2) {
+    comp_send_scratch_.resize((size_t)(send_scratch_elems * 2));
+  }
+  if ((int64_t)chunk_scratch_.size() < recv_scratch_elems * 2) {
+    chunk_scratch_.resize((size_t)(recv_scratch_elems * 2));
+  }
+  // Phase 1: ring reduce-scatter. Each hop ships the current f32
+  // partial as bf16; the receiver widens back to f32 and accumulates at
+  // full precision, overlapped with the remaining transfer.
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_seg = (rank_ - step + size_) % size_;
+    int recv_seg = (rank_ - step - 1 + size_) % size_;
+    const float* sbase = base + seg_off[send_seg];
+    float* rbase = base + seg_off[recv_seg];
+    const int64_t scount = seg_count[send_seg];
+    const int64_t rcount = seg_count[recv_seg];
+    tally->tx += scount * 2;
+    tally->tx_logical += scount * 4;
+    tally->rx += rcount * 2;
+    tally->rx_logical += rcount * 4;
+    if (tcp) {
+      // Encode the whole outgoing segment once, then stream it in one
+      // duplex while completed recv chunks decode+accumulate on the
+      // worker.
+      auto* senc = (uint16_t*)comp_send_scratch_.data();
+      EncodeBF16(senc, sbase, scount);
+      auto* rdec = (uint16_t*)chunk_scratch_.data();
+      Status s = DuplexTransferChunked(
+          right_fd(), senc, (size_t)(scount * 2), left_fd(), rdec,
+          (size_t)(rcount * 2), (size_t)(chunk_elems * 2),
+          [&](size_t off, size_t len) {
+            float* dst = rbase + off / 2;
+            const uint16_t* src = rdec + off / 2;
+            const int64_t n = (int64_t)len / 2;
+            worker_->Submit([dst, src, n] { DecodeAccumBF16(dst, src, n); });
+          });
+      worker_->Drain();  // next step sends what this step accumulated
+      if (!s.ok()) return s;
+      continue;
+    }
+    Status s = ForEachChunkSpan(
+        scount, rcount, chunk_elems,
+        [&](int64_t i, int64_t soff, int64_t sn, int64_t roff, int64_t rn) {
+          auto* senc = (uint16_t*)comp_send_scratch_.data();
+          EncodeBF16(senc, sbase + soff, sn);
+          auto* rdec =
+              (uint16_t*)chunk_scratch_.data() + (i & 1) * chunk_elems;
+          Status t = DuplexTransfer(right_fd(), senc, (size_t)(sn * 2),
+                                    left_fd(), rdec, (size_t)(rn * 2));
+          worker_->Drain();  // chunk i-1 accumulated; its half is free
+          if (!t.ok()) return t;
+          if (rn > 0) {
+            float* dst = rbase + roff;
+            worker_->Submit(
+                [dst, rdec, rn] { DecodeAccumBF16(dst, rdec, rn); });
+          }
+          return Status::OK();
+        });
+    worker_->Drain();  // next step sends what this step accumulated
+    if (!s.ok()) return s;
+  }
+  // Phase 2: ring allgather of the finalized segments, compressed. The
+  // bf16 wire image is forwarded verbatim (re-encoding a decoded bf16
+  // value is lossless, so no rounding compounds across hops), and every
+  // rank — the owner included — decodes the SAME bits, so the result is
+  // rank-consistent: each element is exactly one bf16 rounding of its
+  // full-precision f32 reduction, times the postscale.
+  const int64_t total = seg_off[size_ - 1] + seg_count[size_ - 1];
+  if ((int64_t)comp_plane_.size() < total * 2) {
+    comp_plane_.resize((size_t)(total * 2));
+  }
+  auto* comp = (uint16_t*)comp_plane_.data();
+  // After size-1 reduce-scatter steps the fully-accumulated segment at
+  // rank r is (r+1) mod size — exactly the first segment phase 2 sends.
+  const int own_seg = (rank_ + 1) % size_;
+  EncodeBF16(comp + seg_off[own_seg], base + seg_off[own_seg],
+             seg_count[own_seg]);
+  DecodeScaleBF16(base + seg_off[own_seg], comp + seg_off[own_seg],
+                  seg_count[own_seg], postscale);
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_seg = (rank_ - step + 1 + size_) % size_;
+    int recv_seg = (rank_ - step + size_) % size_;
+    const int64_t scount = seg_count[send_seg];
+    const int64_t rcount = seg_count[recv_seg];
+    tally->tx += scount * 2;
+    tally->tx_logical += scount * 4;
+    tally->rx += rcount * 2;
+    tally->rx_logical += rcount * 4;
+    // Receive straight into the compressed plane (it is forwarded next
+    // step); the f32 decode overlaps the remaining transfer. No
+    // per-step drain: every chunk decodes from its own plane region.
+    if (tcp) {
+      uint16_t* rplane = comp + seg_off[recv_seg];
+      float* rbase = base + seg_off[recv_seg];
+      Status s = DuplexTransferChunked(
+          right_fd(), comp + seg_off[send_seg], (size_t)(scount * 2),
+          left_fd(), rplane, (size_t)(rcount * 2),
+          (size_t)(chunk_elems * 2),
+          [&](size_t off, size_t len) {
+            float* dst = rbase + off / 2;
+            const uint16_t* src = rplane + off / 2;
+            const int64_t n = (int64_t)len / 2;
+            worker_->Submit([dst, src, n, postscale] {
+              DecodeScaleBF16(dst, src, n, postscale);
+            });
+          });
+      if (!s.ok()) {
+        worker_->Drain();
+        return s;
+      }
+      continue;
+    }
+    Status s = ForEachChunkSpan(
+        scount, rcount, chunk_elems,
+        [&](int64_t, int64_t soff, int64_t sn, int64_t roff, int64_t rn) {
+          Status t = DuplexTransfer(
+              right_fd(), comp + seg_off[send_seg] + soff,
+              (size_t)(sn * 2), left_fd(),
+              comp + seg_off[recv_seg] + roff, (size_t)(rn * 2));
+          if (!t.ok()) return t;
+          if (rn > 0) {
+            float* dst = base + seg_off[recv_seg] + roff;
+            const uint16_t* src = comp + seg_off[recv_seg] + roff;
+            worker_->Submit([dst, src, rn, postscale] {
+              DecodeScaleBF16(dst, src, rn, postscale);
+            });
+          }
+          return Status::OK();
+        });
+    if (!s.ok()) {
+      worker_->Drain();
+      return s;
+    }
+  }
+  worker_->Drain();
+  return Status::OK();
+}
+
 Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
-                            ReduceOp op) {
-  if (size_ == 1 || count == 0) return Status::OK();
-  if (op == ReduceOp::ADASUM) return AdasumAllreduce(buf, count, dt);
+                            ReduceOp op, double postscale) {
+  if (size_ == 1 || count == 0) {
+    ScaleBuffer(buf, count, dt, postscale);
+    return Status::OK();
+  }
+  if (op == ReduceOp::ADASUM) {
+    Status s = AdasumAllreduce(buf, count, dt);
+    if (s.ok()) ScaleBuffer(buf, count, dt, postscale);
+    return s;
+  }
   const int64_t elem = DataTypeSize(dt);
   auto* base = (uint8_t*)buf;
   // Segment the buffer into `size_` near-equal chunks.
@@ -241,29 +670,39 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
     seg_off[i] = off;
     off += seg_count[i];
   }
-  int64_t max_seg_bytes = (q + (r ? 1 : 0)) * elem;
-  if ((int64_t)scratch_.size() < max_seg_bytes) scratch_.resize(max_seg_bytes);
-
-  // Phase 1: ring reduce-scatter.
+  const int64_t chunk = RingChunkBytes();
+  WireTally tally;
+  if (WireCompression() && dt == DataType::HVDTPU_FLOAT32 &&
+      (op == ReduceOp::SUM || op == ReduceOp::AVERAGE)) {
+    // Linear ops only: the per-hop bf16 rounding composes with sums
+    // (full-precision accumulate), and AVERAGE is sum + postscale.
+    return CompressedRingAllreduce((float*)buf, seg_count, seg_off,
+                                   postscale, chunk, &tally);
+  }
+  // Phase 1: ring reduce-scatter, chunk-pipelined (reduce of chunk i-1
+  // overlaps the transfer of chunk i on the worker thread).
   for (int step = 0; step < size_ - 1; step++) {
     int send_seg = (rank_ - step + size_) % size_;
     int recv_seg = (rank_ - step - 1 + size_) % size_;
-    Status s = DuplexTransfer(
-        right_fd(), base + seg_off[send_seg] * elem, seg_count[send_seg] * elem,
-        left_fd(), scratch_.data(), seg_count[recv_seg] * elem);
+    Status s = PipelinedReduceChunks(
+        right_fd(), base + seg_off[send_seg] * elem,
+        seg_count[send_seg] * elem, left_fd(),
+        base + seg_off[recv_seg] * elem, seg_count[recv_seg], dt, op, chunk,
+        &tally);
     if (!s.ok()) return s;
-    ReduceInto(base + seg_off[recv_seg] * elem, scratch_.data(),
-               seg_count[recv_seg], dt, op);
   }
   // Phase 2: ring allgather of the reduced segments.
   for (int step = 0; step < size_ - 1; step++) {
     int send_seg = (rank_ - step + 1 + size_) % size_;
     int recv_seg = (rank_ - step + size_) % size_;
-    Status s = DuplexTransfer(
-        right_fd(), base + seg_off[send_seg] * elem, seg_count[send_seg] * elem,
-        left_fd(), base + seg_off[recv_seg] * elem, seg_count[recv_seg] * elem);
+    Status s = ChunkedDuplex(
+        right_fd(), base + seg_off[send_seg] * elem,
+        seg_count[send_seg] * elem, left_fd(),
+        base + seg_off[recv_seg] * elem, seg_count[recv_seg] * elem, chunk,
+        &tally);
     if (!s.ok()) return s;
   }
+  ScaleBuffer(buf, count, dt, postscale);
   return Status::OK();
 }
 
@@ -278,13 +717,15 @@ Status DataPlane::Allgatherv(const void* input, void* output,
   }
   std::memcpy(out + offs[rank_], input, (size_t)bytes_per_rank[rank_]);
   if (size_ == 1) return Status::OK();
+  const int64_t chunk = RingChunkBytes();
+  WireTally tally;
   for (int step = 0; step < size_ - 1; step++) {
     int send_blk = (rank_ - step + size_) % size_;
     int recv_blk = (rank_ - step - 1 + size_) % size_;
-    Status s = DuplexTransfer(right_fd(), out + offs[send_blk],
-                              (size_t)bytes_per_rank[send_blk], left_fd(),
-                              out + offs[recv_blk],
-                              (size_t)bytes_per_rank[recv_blk]);
+    Status s = ChunkedDuplex(right_fd(), out + offs[send_blk],
+                             bytes_per_rank[send_blk], left_fd(),
+                             out + offs[recv_blk], bytes_per_rank[recv_blk],
+                             chunk, &tally);
     if (!s.ok()) return s;
   }
   return Status::OK();
@@ -295,11 +736,23 @@ Status DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
   // Pipelined ring from root: each rank receives from the left and forwards
   // to the right (unless the right neighbor is the root). Chunked so the
   // pipeline overlaps recv(i) with forward(i-1) via the duplex primitive.
-  const int64_t CHUNK = 1 << 20;
+  // Granularity comes from the one shared knob (HOROVOD_RING_CHUNK_BYTES;
+  // <= 0 degrades to a single whole-buffer chunk).
+  const int64_t knob = RingChunkBytes();
+  const int64_t CHUNK = knob > 0 ? knob : bytes;
   auto* base = (uint8_t*)buf;
   int right = (rank_ + 1) % size_;
   bool is_root = rank_ == root;
   bool forwards = !is_root && right != root;
+  WireTally tally;
+  if (is_root || forwards) {
+    tally.tx += bytes;
+    tally.tx_logical += bytes;
+  }
+  if (!is_root) {
+    tally.rx += bytes;
+    tally.rx_logical += bytes;
+  }
   int64_t nchunks = (bytes + CHUNK - 1) / CHUNK;
   auto chunk_span = [&](int64_t i, int64_t* off, int64_t* len) {
     *off = i * CHUNK;
@@ -356,6 +809,8 @@ Status DataPlane::Alltoallv(const void* input,
   }
   std::memcpy(out + recv_off[rank_], in + send_off[rank_],
               (size_t)send_bytes[rank_]);
+  const int64_t chunk = RingChunkBytes();
+  WireTally tally;
   // Symmetric pairing: in round r, rank i partners with (r - i) mod size —
   // an involution, so each unordered pair {i, j} exchanges exactly once, in
   // round (i + j) mod size.
@@ -363,10 +818,9 @@ Status DataPlane::Alltoallv(const void* input,
     int partner = (round - rank_ + size_) % size_;
     if (partner == rank_) continue;
     int fd = peer_fds_[partner];
-    Status s = DuplexTransfer(fd, in + send_off[partner],
-                              (size_t)send_bytes[partner], fd,
-                              out + recv_off[partner],
-                              (size_t)recv_bytes[partner]);
+    Status s = ChunkedDuplex(fd, in + send_off[partner], send_bytes[partner],
+                             fd, out + recv_off[partner],
+                             recv_bytes[partner], chunk, &tally);
     if (!s.ok()) return s;
   }
   return Status::OK();
@@ -381,11 +835,10 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
     return Status::OK();
   }
   std::vector<int64_t> seg_off(size_);
-  int64_t off = 0, max_seg = 0;
+  int64_t off = 0;
   for (int i = 0; i < size_; i++) {
     seg_off[i] = off;
     off += elems_per_rank[i];
-    max_seg = std::max(max_seg, elems_per_rank[i]);
   }
   // Destructive mode clobbers the caller's buffer in place (hierarchical
   // allreduce rewrites it in phase 3 anyway); otherwise work in a
@@ -398,21 +851,19 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
     work.assign((const uint8_t*)input, (const uint8_t*)input + off * elem);
     base = work.data();
   }
-  if ((int64_t)scratch_.size() < max_seg * elem) {
-    scratch_.resize((size_t)(max_seg * elem));
-  }
+  const int64_t chunk = RingChunkBytes();
+  WireTally tally;
   // Segment rotation offset of -1: after size-1 steps the segment that has
   // accumulated all `size` contributions at rank r is exactly segment r.
   for (int step = 0; step < size_ - 1; step++) {
     int send_seg = (rank_ - step - 1 + 2 * size_) % size_;
     int recv_seg = (rank_ - step - 2 + 2 * size_) % size_;
-    Status s = DuplexTransfer(
+    Status s = PipelinedReduceChunks(
         right_fd(), base + seg_off[send_seg] * elem,
-        (size_t)(elems_per_rank[send_seg] * elem), left_fd(), scratch_.data(),
-        (size_t)(elems_per_rank[recv_seg] * elem));
+        elems_per_rank[send_seg] * elem, left_fd(),
+        base + seg_off[recv_seg] * elem, elems_per_rank[recv_seg], dt, op,
+        chunk, &tally);
     if (!s.ok()) return s;
-    ReduceInto(base + seg_off[recv_seg] * elem, scratch_.data(),
-               elems_per_rank[recv_seg], dt, op);
   }
   std::memcpy(output, base + seg_off[rank_] * elem,
               (size_t)(elems_per_rank[rank_] * elem));

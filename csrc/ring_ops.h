@@ -4,10 +4,20 @@
 // the Horovod paper (arXiv:1802.05799 §3: reduce-scatter + allgather,
 // 2(N-1)/N bandwidth factor). Rebuilt on the wire.h duplex primitive; on TPU
 // the analogous data plane is XLA collectives over ICI (horovod_tpu/parallel).
+//
+// The hot path is pipelined and chunked (HOROVOD_RING_CHUNK_BYTES): each
+// ring segment moves in chunks through a double-buffered scratch, and a
+// per-plane worker thread reduces chunk i-1 while the caller thread
+// transfers chunk i, so the wire never idles during reduction (the
+// chunk-pipelining result of arXiv:1810.11112). Opt-in wire compression
+// (HOROVOD_WIRE_COMPRESSION) ships fp32 allreduce payloads as bf16 per
+// hop with full-precision f32 accumulation (the EQuARX recipe,
+// arXiv:2506.17615), halving wire bytes for the dominant gradient dtype.
 
 #ifndef HVDTPU_RING_OPS_H
 #define HVDTPU_RING_OPS_H
 
+#include <memory>
 #include <vector>
 
 #include "common.h"
@@ -22,22 +32,55 @@ void ReduceInto(void* dst, const void* src, int64_t count, DataType dt,
 // Multiply `count` elements in-place by `factor` (pre/postscale).
 void ScaleBuffer(void* buf, int64_t count, DataType dt, double factor);
 
+// ---- ring transport knobs (process-global, relaxed atomics) ----------
+// Chunk granularity of every chunked host-ring path (allreduce,
+// reduce-scatter, broadcast, allgather, alltoall). <= 0 selects the
+// legacy bulk-synchronous path (one whole-segment transfer per ring
+// step, no overlap). Must be uniform across ranks: the chunk split is
+// the message framing on the external (message) transport, and the
+// autotuner keeps it in sync by riding new values on the ResponseList.
+constexpr int64_t kDefaultRingChunkBytes = 256 * 1024;
+int64_t RingChunkBytes();
+void SetRingChunkBytes(int64_t bytes);
+
+// fp32 allreduce payloads cross the wire as bf16 (decode + accumulate
+// in f32 on receive); see docs/wire.md for the numerics contract.
+bool WireCompression();
+void SetWireCompression(bool on);
+
+// Overlap worker: runs ReduceInto / bf16-decode tasks for one data
+// plane while the plane's single caller thread drives the next chunk's
+// DuplexTransfer. The worker never touches the transport, so the
+// wire.h single-caller-thread contract is preserved. Shared between a
+// root DataPlane and its Subset views (one thread per root plane).
+class ReduceWorker;
+
 class DataPlane {
  public:
   // peer_fds[r] = connected socket to rank r (-1 at index `rank`).
   DataPlane(int rank, int size, std::vector<int> peer_fds);
   ~DataPlane();
 
+  DataPlane(DataPlane&&) = default;
+  DataPlane& operator=(DataPlane&&) = default;
+  DataPlane(const DataPlane&) = delete;
+  DataPlane& operator=(const DataPlane&) = delete;
+
   // Non-owning view over a subgroup (global ranks, must contain this rank):
   // collectives on the view run over only those ranks, with this rank's
   // position in `members` as its group rank. The view shares the parent's
-  // sockets; destroying it closes nothing.
+  // sockets AND overlap worker; destroying it closes nothing.
   // Reference analog: per-process-set communicators (process_set.h).
   DataPlane Subset(const std::vector<int32_t>& members) const;
 
   // In-place ring allreduce over a contiguous buffer. op == ADASUM routes
-  // to AdasumAllreduce.
-  Status Allreduce(void* buf, int64_t count, DataType dt, ReduceOp op);
+  // to AdasumAllreduce. `postscale` (e.g. 1/size for AVERAGE) is applied
+  // exactly once before returning; the compressed engine folds it into
+  // the final bf16->f32 decode pass so averaging costs no extra
+  // traversal (bit-identical to scaling afterwards — both round once in
+  // f32 — it only saves the memory pass).
+  Status Allreduce(void* buf, int64_t count, DataType dt, ReduceOp op,
+                   double postscale = 1.0);
 
   // Hierarchical allreduce (HOROVOD_HIERARCHICAL_ALLREDUCE): local
   // reduce-scatter -> cross-node allreduce of each segment among
@@ -46,7 +89,8 @@ class DataPlane {
   // (rank = cross_rank * local_size + local_rank) on the GLOBAL plane.
   // Reference analog: NCCLHierarchicalAllreduce (ops/nccl_operations.cc).
   Status HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
-                               ReduceOp op, int local_size);
+                               ReduceOp op, int local_size,
+                               double postscale = 1.0);
 
   // Adaptive-summation allreduce (recursive doubling, floats only).
   // Reference analog: ops/adasum/ (see csrc/adasum.cc).
@@ -89,12 +133,42 @@ class DataPlane {
  private:
   DataPlane(int rank, int size, std::vector<int> peer_fds, bool owns_fds);
 
+  struct WireTally;  // per-collective wire/logical byte accumulator
+
+  // One reduce-scatter ring step: send `send_bytes` from `send_buf` while
+  // receiving `recv_count` elements and reducing them into `reduce_dst`,
+  // chunked with the reduce of chunk i-1 overlapped on the worker.
+  Status PipelinedReduceChunks(int send_fd, const uint8_t* send_buf,
+                               int64_t send_bytes, int recv_fd,
+                               uint8_t* reduce_dst, int64_t recv_count,
+                               DataType dt, ReduceOp op, int64_t chunk_bytes,
+                               WireTally* tally);
+
+  // Plain chunked duplex (no reduction): allgather phases, alltoall.
+  Status ChunkedDuplex(int send_fd, const uint8_t* send_buf, int64_t send_bytes,
+                       int recv_fd, uint8_t* recv_buf, int64_t recv_bytes,
+                       int64_t chunk_bytes, WireTally* tally);
+
+  // fp32 allreduce with bf16 wire encoding: reduce-scatter accumulates
+  // in f32 from per-hop bf16 partials; allgather ships the finalized
+  // (bf16-rounded) segments compressed. `postscale` folds into the
+  // final decode.
+  Status CompressedRingAllreduce(float* base,
+                                 const std::vector<int64_t>& seg_count,
+                                 const std::vector<int64_t>& seg_off,
+                                 double postscale, int64_t chunk_bytes,
+                                 WireTally* tally);
+
   int rank_;
   int size_;
   std::vector<int> peer_fds_;
   std::vector<int32_t> global_ranks_;  // group index -> global rank
   bool owns_fds_ = true;
-  std::vector<uint8_t> scratch_;
+  std::vector<uint8_t> scratch_;        // bulk-path recv segment
+  std::vector<uint8_t> chunk_scratch_;  // 2 chunks (double-buffered recv)
+  std::vector<uint8_t> comp_send_scratch_;  // bf16-encoded send chunk
+  std::vector<uint8_t> comp_plane_;  // bf16 allgather plane (count*2 bytes)
+  std::shared_ptr<ReduceWorker> worker_;
 
   int right_fd() const { return peer_fds_[(rank_ + 1) % size_]; }
   int left_fd() const { return peer_fds_[(rank_ - 1 + size_) % size_]; }

@@ -1,0 +1,234 @@
+// In-process loopback harness for the chunked/compressed ring engine.
+// Builds a full socketpair mesh, runs one DataPlane per rank on its own
+// thread (each plane keeps the single-caller transport contract), and
+// checks the allreduce result against a bulk ring-order reference
+// computed with the same ReduceInto primitive — so "pass" means
+// BIT-IDENTICAL to the pre-chunking bulk-synchronous ring for every
+// dtype/op, independent of chunk size. With compression on it reports
+// the max absolute error vs the exact-f32 reference instead (callers
+// assert the documented bf16-on-wire bound, docs/wire.md) and still
+// requires every rank to hold bitwise-identical results.
+//
+// Exposed as a C-ABI entry (no controller/init needed) so the python
+// test matrix and the TSan smoke can hammer the overlap worker and the
+// compressed path directly. Reference analog: none upstream — the
+// reference trusts MPI/Gloo; our transport is ours to prove.
+
+#include <sys/socket.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "half.h"
+#include "ring_ops.h"
+#include "wire.h"
+
+namespace hvdtpu {
+namespace {
+
+// Deterministic per-(rank, element) fill in [-2, 2] — sign changes and
+// non-dyadic values so rounding bugs cannot hide behind exact sums.
+double FillValue(int rank, int64_t e) {
+  uint64_t h = (uint64_t)(rank + 1) * 1315423911ull +
+               (uint64_t)(e + 1) * 2654435761ull;
+  return (double)(h % 2001) / 500.0 - 2.0;
+}
+
+void StoreAs(DataType dt, uint8_t* buf, int64_t idx, double v) {
+  switch (dt) {
+    case DataType::HVDTPU_UINT8: ((uint8_t*)buf)[idx] = (uint8_t)((int)v & 7); break;
+    case DataType::HVDTPU_INT8: ((int8_t*)buf)[idx] = (int8_t)v; break;
+    case DataType::HVDTPU_INT32: ((int32_t*)buf)[idx] = (int32_t)(v * 4); break;
+    case DataType::HVDTPU_INT64: ((int64_t*)buf)[idx] = (int64_t)(v * 4); break;
+    case DataType::HVDTPU_FLOAT16:
+      ((uint16_t*)buf)[idx] = FloatToHalfBits((float)v);
+      break;
+    case DataType::HVDTPU_BFLOAT16:
+      ((uint16_t*)buf)[idx] = FloatToBF16Bits((float)v);
+      break;
+    case DataType::HVDTPU_FLOAT32: ((float*)buf)[idx] = (float)v; break;
+    case DataType::HVDTPU_FLOAT64: ((double*)buf)[idx] = v; break;
+    case DataType::HVDTPU_BOOL: ((uint8_t*)buf)[idx] = ((int64_t)v) & 1; break;
+    case DataType::HVDTPU_UINT16: ((uint16_t*)buf)[idx] = (uint16_t)(v * 4 + 8); break;
+  }
+}
+
+double LoadAs(DataType dt, const uint8_t* buf, int64_t idx) {
+  switch (dt) {
+    case DataType::HVDTPU_UINT8: return ((const uint8_t*)buf)[idx];
+    case DataType::HVDTPU_INT8: return ((const int8_t*)buf)[idx];
+    case DataType::HVDTPU_INT32: return ((const int32_t*)buf)[idx];
+    case DataType::HVDTPU_INT64: return (double)((const int64_t*)buf)[idx];
+    case DataType::HVDTPU_FLOAT16:
+      return HalfBitsToFloat(((const uint16_t*)buf)[idx]);
+    case DataType::HVDTPU_BFLOAT16:
+      return BF16BitsToFloat(((const uint16_t*)buf)[idx]);
+    case DataType::HVDTPU_FLOAT32: return ((const float*)buf)[idx];
+    case DataType::HVDTPU_FLOAT64: return ((const double*)buf)[idx];
+    case DataType::HVDTPU_BOOL: return ((const uint8_t*)buf)[idx];
+    case DataType::HVDTPU_UINT16: return ((const uint16_t*)buf)[idx];
+  }
+  return 0;
+}
+
+// The ring accumulation order for segment j (owner = rank j): the
+// partial starts as rank j's own values and each later owner computes
+// dst(own) OP src(partial) — replayed here with the SAME ReduceInto so
+// the reference captures the exact rounding sequence.
+void RingOrderReference(int ranks, int64_t count, DataType dt, ReduceOp op,
+                        double postscale,
+                        const std::vector<std::vector<uint8_t>>& inputs,
+                        std::vector<uint8_t>* ref) {
+  const int64_t elem = DataTypeSize(dt);
+  ref->resize((size_t)(count * elem));
+  std::vector<int64_t> seg_count(ranks), seg_off(ranks);
+  int64_t q = count / ranks, r = count % ranks, off = 0;
+  for (int i = 0; i < ranks; i++) {
+    seg_count[i] = q + (i < r ? 1 : 0);
+    seg_off[i] = off;
+    off += seg_count[i];
+  }
+  for (int j = 0; j < ranks; j++) {
+    const int64_t n = seg_count[j], o = seg_off[j] * elem;
+    std::vector<uint8_t> acc(inputs[j].begin() + o,
+                             inputs[j].begin() + o + n * elem);
+    for (int t = 1; t < ranks; t++) {
+      int owner = (j + t) % ranks;
+      std::vector<uint8_t> own(inputs[owner].begin() + o,
+                               inputs[owner].begin() + o + n * elem);
+      ReduceInto(own.data(), acc.data(), n, dt, op);
+      acc = std::move(own);
+    }
+    std::memcpy(ref->data() + o, acc.data(), (size_t)(n * elem));
+  }
+  // DataPlane::Allreduce applies `postscale` verbatim (the AVERAGE
+  // 1/size division happens in operations.cc, above this layer).
+  ScaleBuffer(ref->data(), count, dt, postscale);
+}
+
+// Serializes concurrent selftests: the ring knobs are process-global,
+// and two overlapping runs with different framing would cross wires.
+std::mutex g_selftest_mutex;
+
+}  // namespace
+}  // namespace hvdtpu
+
+using namespace hvdtpu;
+
+extern "C" {
+
+// Run one in-process allreduce over `ranks` socketpair-connected data
+// planes with explicit knobs. Returns 0 on success; negative codes:
+//   -1 bad arguments      -2 socketpair() failed
+//   -3 a rank's Allreduce returned an error status
+//   -4 uncompressed result not bit-identical to the ring-order reference
+//   -5 compressed results differ BETWEEN ranks (must be rank-consistent)
+// `max_abs_err_out` (optional) receives the max |result - reference|
+// across all ranks and elements; with compression OFF a passing run
+// always writes 0.0.
+int hvdtpu_ring_selftest(int ranks, int64_t count, int dtype, int reduce_op,
+                         int64_t chunk_bytes, int compression,
+                         double postscale, double* max_abs_err_out) {
+  if (max_abs_err_out != nullptr) *max_abs_err_out = 0.0;
+  if (ranks < 1 || ranks > 64 || count < 0 || dtype < 0 || dtype > 9) {
+    return -1;
+  }
+  DataType dt = (DataType)dtype;
+  ReduceOp op = (ReduceOp)reduce_op;
+  const int64_t elem = DataTypeSize(dt);
+
+  std::lock_guard<std::mutex> lock(g_selftest_mutex);
+  const int64_t saved_chunk = RingChunkBytes();
+  const bool saved_comp = WireCompression();
+  SetRingChunkBytes(chunk_bytes);
+  SetWireCompression(compression != 0);
+
+  // Full socketpair mesh (the ring only uses neighbors, but Subset and
+  // future paths index arbitrary peers).
+  std::vector<std::vector<int>> fds(ranks, std::vector<int>(ranks, -1));
+  bool sock_ok = true;
+  for (int i = 0; i < ranks && sock_ok; i++) {
+    for (int j = i + 1; j < ranks; j++) {
+      int sv[2];
+      if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        sock_ok = false;
+        break;
+      }
+      fds[i][j] = sv[0];
+      fds[j][i] = sv[1];
+    }
+  }
+  if (!sock_ok) {
+    for (auto& row : fds) {
+      for (int fd : row) TcpClose(fd);
+    }
+    SetRingChunkBytes(saved_chunk);
+    SetWireCompression(saved_comp);
+    return -2;
+  }
+
+  std::vector<std::vector<uint8_t>> inputs(ranks);
+  for (int r = 0; r < ranks; r++) {
+    inputs[r].resize((size_t)(count * elem));
+    for (int64_t e = 0; e < count; e++) {
+      StoreAs(dt, inputs[r].data(), e, FillValue(r, e));
+    }
+  }
+  std::vector<uint8_t> ref;
+  RingOrderReference(ranks, count, dt, op, postscale, inputs, &ref);
+
+  std::vector<std::vector<uint8_t>> results = inputs;  // reduced in place
+  std::vector<Status> statuses(ranks);
+  {
+    // Each plane owns its fd row and its own overlap worker; threads
+    // join (and workers drain) before the results are inspected.
+    std::vector<std::thread> threads;
+    threads.reserve(ranks);
+    for (int r = 0; r < ranks; r++) {
+      threads.emplace_back([&, r] {
+        DataPlane dp(r, ranks, std::move(fds[r]));
+        statuses[r] =
+            dp.Allreduce(results[r].data(), count, dt, op, postscale);
+      });
+    }
+    for (auto& t : threads) t.join();
+  }
+  SetRingChunkBytes(saved_chunk);
+  SetWireCompression(saved_comp);
+
+  for (int r = 0; r < ranks; r++) {
+    if (!statuses[r].ok()) return -3;
+  }
+  double max_err = 0.0;
+  int rc = 0;
+  for (int r = 0; r < ranks; r++) {
+    for (int64_t e = 0; e < count; e++) {
+      double err =
+          std::fabs(LoadAs(dt, results[r].data(), e) -
+                    LoadAs(dt, ref.data(), e));
+      max_err = std::max(max_err, err);
+    }
+    if (std::memcmp(results[r].data(), ref.data(), ref.size()) != 0) {
+      // The compressed path is bf16-rounded by design; every other
+      // configuration must be bit-identical to the reference.
+      bool compressed_path = compression != 0 &&
+                             dt == DataType::HVDTPU_FLOAT32 &&
+                             (op == ReduceOp::SUM ||
+                              op == ReduceOp::AVERAGE);
+      if (!compressed_path) rc = -4;
+    }
+    if (r > 0 && std::memcmp(results[r].data(), results[0].data(),
+                             results[r].size()) != 0) {
+      rc = -5;  // ranks must agree bitwise, compressed or not
+    }
+  }
+  if (max_abs_err_out != nullptr) *max_abs_err_out = max_err;
+  return rc;
+}
+
+}  // extern "C"

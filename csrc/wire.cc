@@ -218,10 +218,28 @@ Status DuplexTransfer(int send_fd, const void* send_buf, size_t send_len,
     if (recv_len > 0) return RecvAll(recv_fd, recv_buf, recv_len);
     return Status::OK();
   }
+  return DuplexTransferChunked(send_fd, send_buf, send_len, recv_fd,
+                               recv_buf, recv_len, 0, nullptr);
+}
+
+Status DuplexTransferChunked(
+    int send_fd, const void* send_buf, size_t send_len, int recv_fd,
+    void* recv_buf, size_t recv_len, size_t chunk,
+    const std::function<void(size_t off, size_t len)>& on_chunk) {
+  if (IsExtFd(send_fd) || IsExtFd(recv_fd)) {
+    // Message transports frame per send: chunk boundaries there are the
+    // CALLER's business (equal-length paired messages); this fallback
+    // keeps the entry safe if one slips through.
+    Status s =
+        DuplexTransfer(send_fd, send_buf, send_len, recv_fd, recv_buf,
+                       recv_len);
+    if (s.ok() && on_chunk && recv_len > 0) on_chunk(0, recv_len);
+    return s;
+  }
   ScopedNonblock nb(send_fd, recv_fd);
   const char* sp = (const char*)send_buf;
   char* rp = (char*)recv_buf;
-  size_t sent = 0, recvd = 0;
+  size_t sent = 0, recvd = 0, fired = 0;
   while (sent < send_len || recvd < recv_len) {
     pollfd fds[2];
     int n = 0;
@@ -256,8 +274,15 @@ Status DuplexTransfer(int send_fd, const void* send_buf, size_t send_len,
         return Status::Error(std::string("recv failed: ") + strerror(errno));
       }
       if (k > 0) recvd += (size_t)k;
+      if (chunk > 0 && on_chunk) {
+        while (recvd - fired >= chunk) {
+          on_chunk(fired, chunk);
+          fired += chunk;
+        }
+      }
     }
   }
+  if (on_chunk && recvd > fired) on_chunk(fired, recvd - fired);
   return Status::OK();
 }
 

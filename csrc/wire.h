@@ -8,6 +8,7 @@
 #define HVDTPU_WIRE_H
 
 #include <cstdint>
+#include <functional>
 #include <string>
 #include <vector>
 
@@ -42,6 +43,21 @@ Status RecvFrame(int fd, std::string* payload);
 Status DuplexTransfer(int send_fd, const void* send_buf, size_t send_len,
                       int recv_fd, void* recv_buf, size_t recv_len);
 
+// DuplexTransfer plus receive-side chunk completion callbacks: ONE
+// nonblocking poll loop for the whole segment (the send streams freely,
+// with no per-chunk lockstep or fcntl churn), invoking
+// `on_chunk(offset, len)` from the caller thread each time `chunk` more
+// bytes of recv_buf are complete (final partial chunk included). The
+// chunk-pipelined ring hangs its overlapped ReduceInto/decode work off
+// these callbacks. chunk == 0 or a null callback degrades to one
+// callback-free DuplexTransfer. On external (message) fds the caller is
+// expected to frame chunks itself (chunk-paired messages); this entry
+// falls back to one whole-segment exchange + one callback there.
+Status DuplexTransferChunked(
+    int send_fd, const void* send_buf, size_t send_len, int recv_fd,
+    void* recv_buf, size_t recv_len, size_t chunk,
+    const std::function<void(size_t off, size_t len)>& on_chunk);
+
 // Best local IP for peers to reach us (first non-loopback, else 127.0.0.1).
 std::string LocalAddress();
 
@@ -66,9 +82,13 @@ std::string LocalAddress();
 //   planes share one caller, and the two-phase recv (length probe,
 //   then copy-out) of one message is never interleaved with another
 //   call. Implementations may therefore keep per-transport state
-//   without synchronization; any future threaded data plane must
-//   revisit this clause (the python mpi4py transport guards its state
-//   with a lock regardless — common/mpi_bootstrap.py).
+//   without synchronization. The chunk-pipelined ring (ring_ops.cc)
+//   deliberately preserves this: its overlap worker thread only runs
+//   ReduceInto / bf16-decode over host memory, never a transport
+//   call — every send/recv stays on the background thread. Any future
+//   plane that moves TRANSPORT calls off that thread must revisit
+//   this clause (the python mpi4py transport guards its state with a
+//   lock regardless — common/mpi_bootstrap.py).
 typedef int (*ExternalSendFn)(int peer, int tag, const void* buf,
                               long long len);
 typedef long long (*ExternalRecvFn)(int peer, int tag, void* buf,

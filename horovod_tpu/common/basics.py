@@ -134,6 +134,14 @@ def load_library():
         lib.hvdtpu_cycle_time_ms.restype = dbl
         lib.hvdtpu_set_fusion_threshold_bytes.argtypes = [i64]
         lib.hvdtpu_set_cycle_time_ms.argtypes = [dbl]
+        lib.hvdtpu_ring_chunk_bytes.restype = i64
+        lib.hvdtpu_set_ring_chunk_bytes.argtypes = [i64]
+        lib.hvdtpu_wire_compression.restype = i32
+        lib.hvdtpu_set_wire_compression.argtypes = [i32]
+        lib.hvdtpu_ring_selftest.restype = i32
+        lib.hvdtpu_ring_selftest.argtypes = [
+            i32, i64, i32, i32, i64, i32, dbl,
+            ctypes.POINTER(ctypes.c_double)]
         for fn in ("response_cache_hits", "response_cache_misses",
                    "response_cache_entries"):
             getattr(lib, f"hvdtpu_{fn}").restype = i64
@@ -272,6 +280,49 @@ class HorovodBasics:
         for test isolation and interactive sessions.
         """
         self.lib.hvdtpu_metrics_reset()
+
+    def ring_chunk_bytes(self):
+        """Chunk granularity of the chunk-pipelined host ring
+        (``HOROVOD_RING_CHUNK_BYTES``; <= 0 = bulk-synchronous path).
+        See ``docs/wire.md``."""
+        return self.lib.hvdtpu_ring_chunk_bytes()
+
+    def set_ring_chunk_bytes(self, nbytes):
+        """Set the ring chunk granularity. Must be set identically on
+        every rank — the chunk split is the wire framing."""
+        self.lib.hvdtpu_set_ring_chunk_bytes(int(nbytes))
+
+    def wire_compression(self):
+        """Whether fp32 allreduce payloads cross the wire as bf16
+        (``HOROVOD_WIRE_COMPRESSION``); accumulation stays f32."""
+        return bool(self.lib.hvdtpu_wire_compression())
+
+    def set_wire_compression(self, on):
+        """Toggle bf16-on-wire compression (rank-uniform, like the
+        chunk knob; numerics contract in ``docs/wire.md``)."""
+        self.lib.hvdtpu_set_wire_compression(1 if on else 0)
+
+    def ring_selftest(self, ranks, count, dtype=6, op=1, chunk_bytes=None,
+                      compression=False, postscale=1.0):
+        """In-process loopback proof of the ring engine (no init needed).
+
+        Runs one allreduce over ``ranks`` socketpair-connected data
+        planes with explicit knobs and checks against a bulk ring-order
+        reference (``csrc/ring_selftest.cc``). Returns ``(rc,
+        max_abs_err)``: rc 0 = pass; uncompressed passes are
+        bit-identical (err 0.0), compressed passes report the bf16
+        wire-rounding error for the caller to bound. ``dtype``/``op``
+        take the core enums (6 = float32, 1 = SUM).
+        """
+        import ctypes as _ct
+
+        if chunk_bytes is None:
+            chunk_bytes = self.ring_chunk_bytes()
+        err = _ct.c_double()
+        rc = self.lib.hvdtpu_ring_selftest(
+            int(ranks), int(count), int(dtype), int(op), int(chunk_bytes),
+            1 if compression else 0, float(postscale), _ct.byref(err))
+        return rc, err.value
 
     def response_cache_stats(self):
         """(hits, misses, entries) of the negotiation response cache.

@@ -445,8 +445,15 @@ class XlaIciDataPlane:
 def _shard_map(fn, mesh, in_specs, out_specs):
     # check_vma off: outputs ARE replicated (psum/pmin/... results), but
     # the checker can't always prove it through the slice/scale epilogue.
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    # jax 0.4.x boxes: the experimental spelling, where the replication
+    # checker is still called check_rep.
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def _adasum_combine(x, group):

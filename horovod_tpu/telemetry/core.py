@@ -28,6 +28,22 @@ def metrics_reset():
     _basics.metrics_reset()
 
 
+def wire_bytes(snap=None):
+    """``(tx_bytes, tx_logical_bytes)`` of the host-ring transport.
+
+    ``tx_bytes`` is what actually crossed the wire; ``tx_logical_bytes``
+    the same traffic at full tensor width. They diverge exactly by the
+    bf16 wire-compression saving (``HOROVOD_WIRE_COMPRESSION``, see
+    ``docs/wire.md``) — and both differ from :func:`total_collective_bytes`,
+    which counts logical PAYLOAD (the ring moves ~2(N-1)/N x payload
+    per rank).
+    """
+    if snap is None:
+        snap = snapshot()
+    w = snap.get("wire", {})
+    return w.get("tx_bytes", 0), w.get("tx_logical_bytes", 0)
+
+
 def total_collective_bytes(snap=None, planes=("ops", "device_ops"),
                            op_classes=None):
     """Sum payload bytes across op classes and planes of a snapshot.

@@ -56,6 +56,13 @@ def _flatten_prom(snap, rank):
                      f'{fus.get(field, 0)}')
     lines.append(f'hvdtpu_fusion_fill_ratio{{{label}}} '
                  f'{fus.get("fill_ratio", 0.0)}')
+    wire = snap.get("wire", {})
+    for field in ("tx_bytes", "rx_bytes", "tx_logical_bytes",
+                  "rx_logical_bytes"):
+        lines.append(f'hvdtpu_wire_{field}_total{{{label}}} '
+                     f'{wire.get(field, 0)}')
+    lines.append(f'hvdtpu_wire_compression_ratio{{{label}}} '
+                 f'{wire.get("compression_ratio", 1.0)}')
     for r, n in enumerate(
             snap.get("straggler", {}).get("last_rank_counts", [])):
         lines.append(

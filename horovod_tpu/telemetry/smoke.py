@@ -62,6 +62,13 @@ def worker(tmpdir):
         assert snap["cycle"]["count"] > 0
         assert snap["queue_us"]["count"] >= STEPS * TENSORS
         assert snap["wire_us"]["count"] > 0
+        # Wire-vs-logical reconciliation (docs/wire.md): no compression
+        # here, so transport bytes == full-width bytes, and the ring
+        # moved at least 2(N-1)/N x payload (plus barrier/bookkeeping).
+        wire = snap["wire"]
+        assert wire["tx_bytes"] == wire["tx_logical_bytes"], wire
+        assert wire["tx_bytes"] >= 2 * (size - 1) // size * want_bytes, (
+            wire, want_bytes)
         # Steady state: repeated names ride the response-cache bitvector.
         assert snap["cache"]["hits"] > 0, snap["cache"]
         assert snap["cache"]["hit_rate"] > 0

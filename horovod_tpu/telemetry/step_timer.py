@@ -89,8 +89,12 @@ class StepTimer:
         self.byte_op_classes = byte_op_classes
         self.step_times = []
         self.bytes_per_step = []
+        # (tx, tx_logical) transport-byte deltas per step — diverge
+        # only under wire compression (core.wire_bytes).
+        self.wire_bytes_per_step = []
         self._t0 = None
         self._bytes0 = None
+        self._wire0 = None
         self._outputs = None
 
     # -- flops sources --------------------------------------------------
@@ -106,14 +110,18 @@ class StepTimer:
     # -- per-step recording ---------------------------------------------
 
     def _read_bytes(self):
+        # One snapshot serves both the logical-payload and the
+        # wire-vs-logical counters.
         try:
-            return _core.total_collective_bytes(
-                op_classes=self.byte_op_classes)
+            snap = _core.snapshot()
         except Exception:  # noqa: BLE001 — core not built/loaded: the
-            return None    # timer still measures wall time and MFU
+            return None, None  # timer still measures wall time and MFU
+        return (_core.total_collective_bytes(
+                    snap, op_classes=self.byte_op_classes),
+                _core.wire_bytes(snap))
 
     def start_step(self):
-        self._bytes0 = self._read_bytes()
+        self._bytes0, self._wire0 = self._read_bytes()
         self._t0 = time.perf_counter()
 
     def end_step(self, outputs=None):
@@ -127,9 +135,12 @@ class StepTimer:
             except Exception:  # noqa: BLE001 — non-jax outputs
                 pass
         self.step_times.append(time.perf_counter() - self._t0)
-        b1 = self._read_bytes()
+        b1, w1 = self._read_bytes()
         if self._bytes0 is not None and b1 is not None:
             self.bytes_per_step.append(b1 - self._bytes0)
+        if self._wire0 is not None and w1 is not None:
+            self.wire_bytes_per_step.append(
+                (w1[0] - self._wire0[0], w1[1] - self._wire0[1]))
         self._t0 = None
 
     class _Step:
@@ -205,12 +216,27 @@ class StepTimer:
     def wire_goodput_gbps(self, skip_first=True):
         """Collective payload moved per second of step wall time, in
         GB/s — the goodput column (payload only: negotiation frames and
-        protocol overhead excluded by construction)."""
+        protocol overhead excluded by construction). LOGICAL bytes by
+        design: compression makes the wire cheaper, not the payload
+        smaller — see :meth:`wire_compression_ratio` for the wire side."""
         dt = self.mean_step_s(skip_first)
         bytes_ = self.measured_bytes_per_step(skip_first)
         if not dt or bytes_ is None:
             return None
         return bytes_ / dt / 1e9
+
+    def wire_compression_ratio(self, skip_first=True):
+        """Transport bytes / full-width bytes over the recorded steps:
+        1.0 uncompressed, ~0.5 with bf16-on-wire fp32 traffic (the
+        wire-vs-logical reconciliation of ``docs/wire.md``). The first
+        step is dropped by default, matching every other aggregate (its
+        compile-time one-off traffic would dilute the quotient)."""
+        vals = self.wire_bytes_per_step
+        if skip_first and len(vals) > 1:
+            vals = vals[1:]
+        tx = sum(w[0] for w in vals)
+        txl = sum(w[1] for w in vals)
+        return tx / txl if txl else None
 
     def summary(self):
         """One JSON-ready row of everything the timer knows."""
@@ -228,6 +254,7 @@ class StepTimer:
             "predicted_bytes_per_step": self.predicted_bytes_per_step,
             "byte_reconciliation": self.byte_reconciliation(),
             "wire_goodput_gbps": self.wire_goodput_gbps(),
+            "wire_compression_ratio": self.wire_compression_ratio(),
         }
         if snap and snap.get("initialized"):
             row["cache_hit_rate"] = snap["cache"]["hit_rate"]

@@ -1,0 +1,125 @@
+"""Chunked/compressed ring engine matrix, at the native level.
+
+Drives ``hvdtpu_ring_selftest`` (csrc/ring_selftest.cc): N
+socketpair-connected ``DataPlane``s on N threads — no controller, no
+init — with explicit chunk/compression knobs. The core checks the
+result against a bulk ring-order reference built from the very same
+``ReduceInto`` primitive, so an rc of 0 with compression OFF pins
+BIT-IDENTITY with the pre-chunking bulk-synchronous ring, for every
+chunk size and every ragged count; compressed runs must stay
+rank-consistent (bitwise equal across ranks) and inside the
+documented bf16-on-wire error bound (docs/wire.md).
+"""
+
+import pytest
+
+from horovod_tpu.common import basics
+
+pytestmark = pytest.mark.quick
+
+# csrc/common.h DataType / ReduceOp enums.
+U8, I8, I32, I64, F16, BF16, F32, F64, BOOL, U16 = range(10)
+AVG, SUM, MIN, MAX, PROD = 0, 1, 2, 3, 4
+
+
+@pytest.fixture(scope="module")
+def b():
+    return basics.HorovodBasics()
+
+
+def _bound(ranks):
+    # docs/wire.md: each of the <= N accumulation hops contributes one
+    # bf16 rounding (rel 2^-9) of a partial bounded by 2N here (inputs
+    # in [-2, 2]), plus the final segment rounding — a generous
+    # envelope that still fails loudly on e.g. fp16-width wire bugs.
+    return ranks * ranks * 2 ** -7
+
+
+def _ragged_counts(ranks):
+    # Zero-length segments (count < ranks), exact fit, off-by-remainder,
+    # and a multi-chunk payload.
+    return [0, 1, 3, ranks - 1, ranks, ranks + 3, 1025]
+
+
+@pytest.mark.parametrize("ranks", [2, 4, 5])
+def test_uncompressed_bit_identity_across_chunk_sizes(b, ranks):
+    for count in _ragged_counts(ranks):
+        for chunk in (0, 64, 4096):  # bulk, many-chunk, few-chunk
+            rc, err = b.ring_selftest(ranks, count, dtype=F32, op=SUM,
+                                      chunk_bytes=chunk, compression=False)
+            assert rc == 0, (ranks, count, chunk, rc)
+            assert err == 0.0, (ranks, count, chunk, err)
+
+
+def test_large_multichunk_payload(b):
+    # ~1.2 MB per rank at 4 ranks, 4 KiB chunks: hundreds of chunks per
+    # segment, both scratch halves and the overlap worker in play.
+    rc, err = b.ring_selftest(4, 300001, dtype=F32, op=SUM,
+                              chunk_bytes=4096, compression=False)
+    assert rc == 0 and err == 0.0
+    rc, err = b.ring_selftest(4, 300001, dtype=F32, op=SUM,
+                              chunk_bytes=4096, compression=True)
+    assert rc == 0
+    assert 0 < err <= _bound(4)  # compression really engaged, inside bound
+
+
+@pytest.mark.parametrize("ranks", [2, 4])
+def test_compressed_error_bound(b, ranks):
+    for count in (1, 5, 1025, 100003):
+        for chunk in (0, 256, 65536):
+            rc, err = b.ring_selftest(ranks, count, dtype=F32, op=SUM,
+                                      chunk_bytes=chunk, compression=True)
+            assert rc == 0, (ranks, count, chunk, rc)
+            assert err <= _bound(ranks), (ranks, count, chunk, err)
+
+
+def test_compression_bypasses_ineligible_dtypes_and_ops(b):
+    # Compression requested, but only (f32, SUM/AVERAGE) may round:
+    # every other dtype/op must take the exact path bit-identically.
+    for dtype in (U8, I32, I64, F16, BF16, F64, U16):
+        for op in (SUM, MIN, MAX, PROD):
+            rc, err = b.ring_selftest(4, 1000, dtype=dtype, op=op,
+                                      chunk_bytes=128, compression=True)
+            assert rc == 0, (dtype, op, rc)
+            assert err == 0.0, (dtype, op, err)
+    for op in (MIN, MAX, PROD):  # f32 but non-linear: also exact
+        rc, err = b.ring_selftest(4, 1000, dtype=F32, op=op,
+                                  chunk_bytes=128, compression=True)
+        assert rc == 0 and err == 0.0, (op, rc, err)
+
+
+def test_half_precision_chunked_exact(b):
+    # fp16/bf16 ride the chunked engine uncompressed (their wire is
+    # already half-width); chunk boundaries must not move the per-hop
+    # f32-accumulate-then-round sequence.
+    for dtype in (F16, BF16):
+        for count in (7, 1024, 4099):
+            rc, err = b.ring_selftest(5, count, dtype=dtype, op=SUM,
+                                      chunk_bytes=64, compression=False)
+            assert rc == 0 and err == 0.0, (dtype, count, rc, err)
+
+
+def test_postscale_fold_matches_reference(b):
+    # postscale folds into the compressed decode / uncompressed tail —
+    # both must match ScaleBuffer-after-the-ring semantics exactly.
+    rc, err = b.ring_selftest(4, 5000, dtype=F32, op=AVG,
+                              chunk_bytes=4096, compression=False,
+                              postscale=0.25)
+    assert rc == 0 and err == 0.0
+    rc, err = b.ring_selftest(4, 5000, dtype=F32, op=AVG,
+                              chunk_bytes=4096, compression=True,
+                              postscale=0.25)
+    assert rc == 0 and err <= _bound(4) * 0.25
+
+
+def test_knob_surface_roundtrip(b):
+    # The get/set pair basics exposes (and the autotuner drives).
+    old_chunk, old_comp = b.ring_chunk_bytes(), b.wire_compression()
+    try:
+        b.set_ring_chunk_bytes(12345)
+        assert b.ring_chunk_bytes() == 12345
+        b.set_wire_compression(True)
+        assert b.wire_compression() is True
+    finally:
+        b.set_ring_chunk_bytes(old_chunk)
+        b.set_wire_compression(old_comp)

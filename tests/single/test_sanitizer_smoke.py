@@ -65,10 +65,25 @@ _DRIVER = textwrap.dedent("""
             ops.allreduce_async(x, f"w{tid}_i{i}").synchronize()
             ops.allgather_async(x, f"ag{tid}_i{i}").synchronize()
 
+    def ring_hammer(tid):
+        # The chunked/compressed ring engine under TSan: each selftest
+        # spins up 4 in-process rank planes, each with its own caller
+        # thread + overlap worker (csrc/ring_selftest.cc), alternating
+        # bf16-compressed and exact passes — concurrent with the
+        # metrics-snapshot churner reading the wire counters the
+        # engine's tally writes.
+        for i in range(6):
+            rc, _err = b.ring_selftest(4, 20000, dtype=6, op=1,
+                                       chunk_bytes=2048,
+                                       compression=(i % 2 == 1))
+            assert rc == 0, (tid, i, rc)
+
     c = threading.Thread(target=churner)
     c.start()
     threads = [threading.Thread(target=worker, args=(t,))
                for t in range(4)]
+    threads += [threading.Thread(target=ring_hammer, args=(t,))
+                for t in range(2)]
     for t in threads:
         t.start()
     for t in threads:
