@@ -22,7 +22,7 @@ TSAN_OUT := horovod_tpu/lib/libhvdtpu_core_tsan.so
 ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
 .PHONY: core tf clean test test-quick lint lint-csrc core-tsan core-asan \
-  metrics-smoke
+  metrics-smoke zero-smoke
 
 core: $(OUT)
 
@@ -101,3 +101,10 @@ test-quick: core
 # straggler attribution (horovod_tpu/telemetry/smoke.py; ~10 s).
 metrics-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.smoke
+
+# ZeRO-1 smoke: 2 real eager ranks drive the sharded-optimizer lane
+# end to end — sharded-vs-replicated parity, 1/N per-rank optimizer
+# bytes, reduce-scatter/allgather byte reconciliation (docs/zero.md;
+# horovod_tpu/jax/zero_smoke.py; ~30 s).
+zero-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.jax.zero_smoke

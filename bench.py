@@ -625,6 +625,55 @@ if rank == 0:
 """
 
 
+def _run_loopback_ranks(child_src, sentinel, ranks, env_extra,
+                        timeout=600):
+    """Spawn ``ranks`` local subprocesses wired as ONE Horovod job over
+    a fresh loopback port, run ``child_src`` in each, and return rank
+    0's ``sentinel``-prefixed JSON payload. The shared launcher behind
+    both subprocess-grid benches (`ring_busbw`, `zero_sweep`) — one
+    place for the port probe, env plumbing, drain, and kill-on-error."""
+    import os
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    procs = []
+    try:
+        for r in range(ranks):
+            env = dict(os.environ)
+            env.update({
+                "HOROVOD_RANK": str(r), "HOROVOD_SIZE": str(ranks),
+                "HOROVOD_LOCAL_RANK": str(r),
+                "HOROVOD_LOCAL_SIZE": str(ranks),
+                "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+                "HOROVOD_CONTROLLER_PORT": str(port),
+                "HVDTPU_REPO": repo,
+            })
+            env.update(env_extra)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", child_src],
+                stdout=subprocess.PIPE if r == 0 else subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, text=True, env=env))
+        out, _ = procs[0].communicate(timeout=timeout)
+        for p in procs[1:]:
+            p.wait(timeout=60)
+        payload = None
+        for line in out.splitlines():
+            if line.startswith(sentinel + " "):
+                payload = json.loads(line.split(" ", 1)[1])
+        if payload is None:
+            raise RuntimeError(f"rank 0 emitted no {sentinel}")
+        return payload
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
+
+
 def _ring_busbw_rows(ranks=4):
     """Host-ring allreduce bus-bandwidth sweep, one JSON row per
     transport config: bulk-synchronous (chunk knob 0 — the pre-r10
@@ -636,10 +685,6 @@ def _ring_busbw_rows(ranks=4):
     the NCCL-tests convention (2(N-1)/N x payload / time); wire_ratio
     is the measured transport/full-width byte quotient (~0.5 when
     compression engages — the core's wire-vs-logical counters)."""
-    import os
-    import socket
-    import subprocess
-
     sizes = [1 << 10, 1 << 15, 1 << 20, 1 << 24, 1 << 26]
     configs = [
         ("bulk", {"HOROVOD_RING_CHUNK_BYTES": "0",
@@ -649,49 +694,148 @@ def _ring_busbw_rows(ranks=4):
         ("overlap+bf16", {"HOROVOD_RING_CHUNK_BYTES": str(256 * 1024),
                           "HOROVOD_WIRE_COMPRESSION": "1"}),
     ]
-    repo = os.path.dirname(os.path.abspath(__file__))
     rows = []
     for name, knobs in configs:
         row = {"metric": "ring_busbw", "config": name, "ranks": ranks,
                "unit": "host-ring allreduce bus GB/s (2(N-1)/N x "
                        "payload/time), TCP loopback; wire_ratio = "
                        "transport/full-width bytes"}
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        procs = []
         try:
-            for r in range(ranks):
-                env = dict(os.environ)
-                env.update({
-                    "HOROVOD_RANK": str(r), "HOROVOD_SIZE": str(ranks),
-                    "HOROVOD_LOCAL_RANK": str(r),
-                    "HOROVOD_LOCAL_SIZE": str(ranks),
-                    "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
-                    "HOROVOD_CONTROLLER_PORT": str(port),
-                    "HVDTPU_REPO": repo,
-                    "RING_BUSBW_SIZES": json.dumps(sizes),
-                })
-                env.update(knobs)
-                procs.append(subprocess.Popen(
-                    [sys.executable, "-c", _RING_BUSBW_CHILD],
-                    stdout=subprocess.PIPE if r == 0 else subprocess.DEVNULL,
-                    stderr=subprocess.DEVNULL, text=True, env=env))
-            out, _ = procs[0].communicate(timeout=600)
-            for p in procs[1:]:
-                p.wait(timeout=60)
-            points = None
-            for line in out.splitlines():
-                if line.startswith("RING_BUSBW_POINTS "):
-                    points = json.loads(line.split(" ", 1)[1])
-            if points is None:
-                raise RuntimeError("rank 0 emitted no points")
-            row["points"] = points
+            row["points"] = _run_loopback_ranks(
+                _RING_BUSBW_CHILD, "RING_BUSBW_POINTS", ranks,
+                dict(knobs, RING_BUSBW_SIZES=json.dumps(sizes)))
         except Exception as e:  # noqa: BLE001 — a failed transport
             # config yields an error row; the sweep continues.
-            for p in procs:
-                p.kill()
+            row["error"] = f"{type(e).__name__}: {e}"
+        rows.append(row)
+    return rows
+
+
+# Child body for one zero_sweep rank: jax pinned to CPU (subprocess, so
+# the parent's device heap is untouched), the eager ZeRO lane against
+# its replicated baseline at a synthetic ~8 MB f32 geometry.
+_ZERO_SWEEP_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, os.environ["HVDTPU_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu.jax as hvd
+from horovod_tpu.jax.compression import Compression
+from horovod_tpu.parallel.zero import (
+    optimizer_state_bytes, zero_bucket_layout)
+from horovod_tpu.telemetry.predict import zero_layout_bytes
+
+knobs = json.loads(os.environ["ZERO_SWEEP_KNOBS"])
+steps = knobs["steps"]
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+# ~2M f32 elements over a dozen leaves (layer-ish shapes, one ragged).
+shapes = [(512, 256)] * 8 + [(256, 512)] * 6 + [(4099,), (257,)]
+params = {f"p{i}": jnp.zeros(s, jnp.float32) + 0.1 * i
+          for i, s in enumerate(shapes)}
+grads = {f"p{i}": jnp.full(s, 0.01 * ((rank + i) % 5 - 2), jnp.float32)
+         for i, s in enumerate(shapes)}
+n_elems = sum(int(np.prod(s)) for s in shapes)
+if knobs["zero"]:
+    opt = hvd.DistributedFusedAdam(
+        1e-3, zero=True, bucket_bytes=knobs["bucket_bytes"],
+        overlap=knobs["overlap"],
+        compression=getattr(Compression, knobs["compression"]))
+    layout = zero_bucket_layout(list(params.values()), size,
+                                knobs["bucket_bytes"])
+    if knobs["compression"] == "bf16":
+        # The param allgather's LOGICAL payload is genuinely bf16 wide
+        # (the op ships a 2-byte tensor); only the reduce-scatter stays
+        # f32-logical (bf16 on the wire rides below the op accounting).
+        predicted = sum(b.padded * (4 + 2) for b in layout.buckets)
+    else:
+        predicted = zero_layout_bytes(layout)
+else:
+    opt = hvd.DistributedFusedAdam(1e-3)
+    # allreduce logical volume per step: the full gradient tree.
+    predicted = n_elems * 4
+state = opt.init(params)
+try:
+    params, state = opt.apply(params, grads, state)  # warm (compiles)
+    from horovod_tpu import telemetry
+    snap0 = telemetry.snapshot()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, state = opt.apply(params, grads, state)
+    dt = (time.perf_counter() - t0) / steps
+    snap1 = telemetry.snapshot()
+    wire = (snap1["wire"]["tx_bytes"] - snap0["wire"]["tx_bytes"]) / steps
+    ops = 0
+    for op_name in ("allreduce", "reducescatter", "allgather"):
+        ops += (snap1["ops"].get(op_name, {}).get("bytes", 0)
+                - snap0["ops"].get(op_name, {}).get("bytes", 0))
+    ops /= steps
+    row = {
+        "step_s": round(dt, 6),
+        "per_rank_opt_bytes": optimizer_state_bytes(state),
+        "param_bytes": n_elems * 4,
+        "wire_tx_bytes_per_step": wire,
+        "ops_logical_bytes_per_step": ops,
+        "predicted_logical_bytes": predicted,
+        "byte_reconciliation": round(ops / predicted, 4) if predicted
+        else None,
+    }
+finally:
+    hvd.shutdown()
+if rank == 0:
+    print("ZERO_SWEEP_ROW " + json.dumps(row), flush=True)
+"""
+
+
+def _zero_sweep_rows(ranks=4, steps=5):
+    """The zero on/off x bucket-size tuning grid (`zero_sweep` JSON
+    rows): the eager replicated-allreduce baseline vs ZeRO-1 sharded
+    (phase-separated), ZeRO-1 overlapped (per-bucket reduce-scatter /
+    allgather pipelined under the shard updates), and overlapped +
+    bf16 wire (compressed reduce-scatter in the core + bf16 param
+    allgather) — each zero config at two bucket granularities. Local
+    CPU subprocesses over TCP loopback, so the grid runs on any box;
+    rows carry per-rank optimizer bytes (the N-fold ZeRO-1 cut), step
+    time (the overlap win), measured wire bytes (the ~0.5x compressed
+    quotient vs the allreduce baseline), and the predicted-vs-measured
+    logical-byte reconciliation (docs/zero.md)."""
+    bucket_grid = [256 * 1024, 4 * 1024 * 1024]
+    configs = [("replicated", {"zero": False}, None)]
+    for bb in bucket_grid:
+        configs += [
+            ("zero1", {"zero": True, "overlap": False}, bb),
+            ("zero1+overlap", {"zero": True, "overlap": True}, bb),
+            ("zero1+overlap+bf16",
+             {"zero": True, "overlap": True, "compression": "bf16",
+              "wire": "1"}, bb),
+        ]
+    rows, base_wire = [], None
+    for name, knobs, bb in configs:
+        payload = {"zero": knobs.get("zero", False),
+                   "overlap": knobs.get("overlap", False),
+                   "compression": knobs.get("compression", "none"),
+                   "bucket_bytes": bb or 0, "steps": steps}
+        row = {"metric": "zero_sweep", "config": name, "ranks": ranks,
+               "bucket_bytes": bb,
+               "unit": "eager optimizer lane over TCP loopback; wire = "
+                       "transport tx bytes/step (hvd.metrics), "
+                       "reconciliation = ops-logical vs layout-"
+                       "predicted bytes"}
+        try:
+            row.update(_run_loopback_ranks(
+                _ZERO_SWEEP_CHILD, "ZERO_SWEEP_ROW", ranks,
+                {"HOROVOD_WIRE_COMPRESSION": knobs.get("wire", "0"),
+                 "JAX_PLATFORMS": "cpu",
+                 "ZERO_SWEEP_KNOBS": json.dumps(payload)}))
+            if name == "replicated":
+                base_wire = row["wire_tx_bytes_per_step"]
+            if base_wire:
+                row["wire_ratio_vs_replicated"] = round(
+                    row["wire_tx_bytes_per_step"] / base_wire, 4)
+        except Exception as e:  # noqa: BLE001 — a failed grid point
+            # yields an error row; the sweep continues.
             row["error"] = f"{type(e).__name__}: {e}"
         rows.append(row)
     return rows
@@ -849,6 +993,11 @@ def main():
         for row in _ring_busbw_rows():
             emit(row)
         return
+    if "--zero-sweep" in argv:
+        # Standalone ZeRO grid (CPU loopback subprocesses; any box).
+        for row in _zero_sweep_rows():
+            emit(row)
+        return
     if "--quick" in argv:
         if jax.devices()[0].platform == "cpu":
             emit(_smoke_row())
@@ -872,12 +1021,17 @@ def main():
         return
     if "--sweep" in argv:
         # Pipeline (schedule, V, accum) bubble rows are host math —
-        # emitted on every substrate, before the measured lane.
+        # emitted on every substrate, before the measured lane. The
+        # ZeRO grid (zero on/off x bucket size — docs/zero.md) runs on
+        # CPU loopback subprocesses, so it is substrate-independent too.
         for row in _bubble_rows():
+            emit(row)
+        for row in _zero_sweep_rows():
             emit(row)
         if _probe_platform() == "cpu":
             print("--sweep: no accelerator; emitted the schedule-"
-                  "derived pipeline rows only", file=sys.stderr)
+                  "derived pipeline and loopback zero_sweep rows only",
+                  file=sys.stderr)
             return
         _run_sweep(batch, seq, steps, emit)
         return

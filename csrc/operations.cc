@@ -1379,6 +1379,21 @@ int hvdtpu_wire_compression() { return WireCompression() ? 1 : 0; }
 
 void hvdtpu_set_wire_compression(int v) { SetWireCompression(v != 0); }
 
+// Ring segment-ownership rotation (pure, valid before init): the ONE
+// encoding of "after the reduce phase at rotation `rot`, which segment
+// does rank r own / send at step s" — see ring_ops.h. Exposed so
+// Python-side shard-boundary math and the tests pin the SAME helper
+// the ring engine executes instead of re-deriving the off-by-one.
+int hvdtpu_ring_owned_segment(int rank, int size, int rot) {
+  if (size <= 0 || rank < 0 || rank >= size) return -1;
+  return RingOwnedSegment(rank, size, rot);
+}
+
+int hvdtpu_ring_send_segment(int rank, int step, int size, int rot) {
+  if (size <= 0 || rank < 0 || rank >= size) return -1;
+  return RingSendSegment(rank, step, size, rot);
+}
+
 int64_t hvdtpu_response_cache_hits() {
   CHECK_INIT(-1)
   return g_state->controller->response_cache().hits();

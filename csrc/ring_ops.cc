@@ -493,17 +493,12 @@ Status DataPlane::ChunkedDuplex(int send_fd, const uint8_t* send_buf,
       });
 }
 
-Status DataPlane::CompressedRingAllreduce(
+Status DataPlane::CompressedReducePhase(
     float* base, const std::vector<int64_t>& seg_count,
-    const std::vector<int64_t>& seg_off, double postscale,
-    int64_t chunk_bytes, WireTally* tally) {
+    const std::vector<int64_t>& seg_off, int64_t chunk_elems, int rot,
+    WireTally* tally) {
   int64_t max_seg = 0;
   for (int i = 0; i < size_; i++) max_seg = std::max(max_seg, seg_count[i]);
-  // Chunk in elements derived from the LOGICAL byte knob, so the
-  // tunable keeps one meaning whether or not compression is on.
-  const int64_t chunk_elems =
-      chunk_bytes > 0 ? std::max<int64_t>(chunk_bytes / 4, 1)
-                      : std::max<int64_t>(max_seg, 1);
   const bool tcp = !IsExtFd(right_fd()) && !IsExtFd(left_fd());
   // Scratch: the TCP path encodes/receives whole segments (one
   // streaming duplex per step); the external path works chunk-by-chunk
@@ -517,12 +512,13 @@ Status DataPlane::CompressedRingAllreduce(
   if ((int64_t)chunk_scratch_.size() < recv_scratch_elems * 2) {
     chunk_scratch_.resize((size_t)(recv_scratch_elems * 2));
   }
-  // Phase 1: ring reduce-scatter. Each hop ships the current f32
-  // partial as bf16; the receiver widens back to f32 and accumulates at
-  // full precision, overlapped with the remaining transfer.
+  // N-1 ring reduce steps at rotation `rot`. Each hop ships the current
+  // f32 partial as bf16; the receiver widens back to f32 and
+  // accumulates at full precision, overlapped with the remaining
+  // transfer.
   for (int step = 0; step < size_ - 1; step++) {
-    int send_seg = (rank_ - step + size_) % size_;
-    int recv_seg = (rank_ - step - 1 + size_) % size_;
+    int send_seg = RingSendSegment(rank_, step, size_, rot);
+    int recv_seg = RingRecvSegment(rank_, step, size_, rot);
     const float* sbase = base + seg_off[send_seg];
     float* rbase = base + seg_off[recv_seg];
     const int64_t scount = seg_count[send_seg];
@@ -572,6 +568,41 @@ Status DataPlane::CompressedRingAllreduce(
     worker_->Drain();  // next step sends what this step accumulated
     if (!s.ok()) return s;
   }
+  return Status::OK();
+}
+
+static int64_t CompressedChunkElems(int64_t chunk_bytes,
+                                    const std::vector<int64_t>& seg_count) {
+  // Chunk in elements derived from the LOGICAL byte knob, so the
+  // tunable keeps one meaning whether or not compression is on.
+  int64_t max_seg = 0;
+  for (int64_t c : seg_count) max_seg = std::max(max_seg, c);
+  return chunk_bytes > 0 ? std::max<int64_t>(chunk_bytes / 4, 1)
+                         : std::max<int64_t>(max_seg, 1);
+}
+
+Status DataPlane::CompressedRingReduceScatter(
+    float* base, const std::vector<int64_t>& seg_count,
+    const std::vector<int64_t>& seg_off, int64_t chunk_bytes,
+    WireTally* tally) {
+  // rot = -1: rank r's fully-accumulated segment is its own segment r —
+  // the reduce-scatter output contract (see RingOwnedSegment).
+  return CompressedReducePhase(base, seg_count, seg_off,
+                               CompressedChunkElems(chunk_bytes, seg_count),
+                               /*rot=*/-1, tally);
+}
+
+Status DataPlane::CompressedRingAllreduce(
+    float* base, const std::vector<int64_t>& seg_count,
+    const std::vector<int64_t>& seg_off, double postscale,
+    int64_t chunk_bytes, WireTally* tally) {
+  const int64_t chunk_elems = CompressedChunkElems(chunk_bytes, seg_count);
+  // Phase 1: ring reduce-scatter (rot = 0 — rank r ends owning segment
+  // (r+1)%N, which phase 2 sends first).
+  Status ph1 = CompressedReducePhase(base, seg_count, seg_off, chunk_elems,
+                                     /*rot=*/0, tally);
+  if (!ph1.ok()) return ph1;
+  const bool tcp = !IsExtFd(right_fd()) && !IsExtFd(left_fd());
   // Phase 2: ring allgather of the finalized segments, compressed. The
   // bf16 wire image is forwarded verbatim (re-encoding a decoded bf16
   // value is lossless, so no rounding compounds across hops), and every
@@ -585,14 +616,14 @@ Status DataPlane::CompressedRingAllreduce(
   auto* comp = (uint16_t*)comp_plane_.data();
   // After size-1 reduce-scatter steps the fully-accumulated segment at
   // rank r is (r+1) mod size — exactly the first segment phase 2 sends.
-  const int own_seg = (rank_ + 1) % size_;
+  const int own_seg = RingOwnedSegment(rank_, size_);
   EncodeBF16(comp + seg_off[own_seg], base + seg_off[own_seg],
              seg_count[own_seg]);
   DecodeScaleBF16(base + seg_off[own_seg], comp + seg_off[own_seg],
                   seg_count[own_seg], postscale);
   for (int step = 0; step < size_ - 1; step++) {
-    int send_seg = (rank_ - step + 1 + size_) % size_;
-    int recv_seg = (rank_ - step + size_) % size_;
+    int send_seg = RingSendSegment(rank_, step, size_, /*rot=*/1);
+    int recv_seg = RingSendSegment(rank_, step, size_, /*rot=*/0);
     const int64_t scount = seg_count[send_seg];
     const int64_t rcount = seg_count[recv_seg];
     tally->tx += scount * 2;
@@ -682,8 +713,8 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
   // Phase 1: ring reduce-scatter, chunk-pipelined (reduce of chunk i-1
   // overlaps the transfer of chunk i on the worker thread).
   for (int step = 0; step < size_ - 1; step++) {
-    int send_seg = (rank_ - step + size_) % size_;
-    int recv_seg = (rank_ - step - 1 + size_) % size_;
+    int send_seg = RingSendSegment(rank_, step, size_);
+    int recv_seg = RingRecvSegment(rank_, step, size_);
     Status s = PipelinedReduceChunks(
         right_fd(), base + seg_off[send_seg] * elem,
         seg_count[send_seg] * elem, left_fd(),
@@ -691,10 +722,11 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
         &tally);
     if (!s.ok()) return s;
   }
-  // Phase 2: ring allgather of the reduced segments.
+  // Phase 2: ring allgather of the reduced segments, starting from the
+  // segment this rank just finished owning (RingOwnedSegment).
   for (int step = 0; step < size_ - 1; step++) {
-    int send_seg = (rank_ - step + 1 + size_) % size_;
-    int recv_seg = (rank_ - step + size_) % size_;
+    int send_seg = RingSendSegment(rank_, step, size_, /*rot=*/1);
+    int recv_seg = RingSendSegment(rank_, step, size_, /*rot=*/0);
     Status s = ChunkedDuplex(
         right_fd(), base + seg_off[send_seg] * elem,
         seg_count[send_seg] * elem, left_fd(),
@@ -853,11 +885,25 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
   }
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
-  // Segment rotation offset of -1: after size-1 steps the segment that has
-  // accumulated all `size` contributions at rank r is exactly segment r.
+  // rot = -1: after size-1 steps the segment that has accumulated all
+  // `size` contributions at rank r is exactly segment r (the API output
+  // segment — see RingOwnedSegment).
+  const int own = RingOwnedSegment(rank_, size_, /*rot=*/-1);
+  if (WireCompression() && dt == DataType::HVDTPU_FLOAT32 &&
+      (op == ReduceOp::SUM || op == ReduceOp::AVERAGE)) {
+    // Linear ops only, same contract as the compressed allreduce: the
+    // per-hop bf16 rounding composes with sums (full-precision f32
+    // accumulate), AVERAGE is sum + the caller's postscale.
+    Status s = CompressedRingReduceScatter((float*)base, elems_per_rank,
+                                           seg_off, chunk, &tally);
+    if (!s.ok()) return s;
+    std::memcpy(output, base + seg_off[own] * elem,
+                (size_t)(elems_per_rank[own] * elem));
+    return Status::OK();
+  }
   for (int step = 0; step < size_ - 1; step++) {
-    int send_seg = (rank_ - step - 1 + 2 * size_) % size_;
-    int recv_seg = (rank_ - step - 2 + 2 * size_) % size_;
+    int send_seg = RingSendSegment(rank_, step, size_, /*rot=*/-1);
+    int recv_seg = RingRecvSegment(rank_, step, size_, /*rot=*/-1);
     Status s = PipelinedReduceChunks(
         right_fd(), base + seg_off[send_seg] * elem,
         elems_per_rank[send_seg] * elem, left_fd(),
@@ -865,8 +911,8 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
         chunk, &tally);
     if (!s.ok()) return s;
   }
-  std::memcpy(output, base + seg_off[rank_] * elem,
-              (size_t)(elems_per_rank[rank_] * elem));
+  std::memcpy(output, base + seg_off[own] * elem,
+              (size_t)(elems_per_rank[own] * elem));
   return Status::OK();
 }
 

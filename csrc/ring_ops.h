@@ -48,6 +48,31 @@ void SetRingChunkBytes(int64_t bytes);
 bool WireCompression();
 void SetWireCompression(bool on);
 
+// ---- ring segment-ownership rotation (ONE place, by design) ----------
+// Every ring reduce phase here walks the same rotation: at step s a rank
+// sends segment (rank - s + rot) mod N and receives segment
+// (rank - s + rot - 1) mod N, reducing into it. After the N-1 steps the
+// segment holding EVERY rank's contribution at rank r is therefore
+// (r + 1 + rot) mod N:
+//   - Allreduce / CompressedRingAllreduce run rot = 0: rank r finishes
+//     owning segment (r+1)%N — exactly the first segment its allgather
+//     phase sends, and the ONLY segment the compressed finalize may
+//     bf16-round locally (the r10 off-by-one trap);
+//   - ReduceScatterv / CompressedRingReduceScatter run rot = -1: rank r
+//     finishes owning segment r, its API output.
+// Do not re-derive these indices inline — use the helpers (pinned by
+// tests/single/test_zero.py via the hvdtpu_ring_* C ABI and replayed
+// against numpy ring order in tests/parallel/test_ring_wire.py).
+inline int RingSendSegment(int rank, int step, int size, int rot = 0) {
+  return ((rank - step + rot) % size + 2 * size) % size;
+}
+inline int RingRecvSegment(int rank, int step, int size, int rot = 0) {
+  return RingSendSegment(rank, step + 1, size, rot);
+}
+inline int RingOwnedSegment(int rank, int size, int rot = 0) {
+  return ((rank + 1 + rot) % size + size) % size;
+}
+
 // Overlap worker: runs ReduceInto / bf16-decode tasks for one data
 // plane while the plane's single caller thread drives the next chunk's
 // DuplexTransfer. The worker never touches the transport, so the
@@ -158,6 +183,26 @@ class DataPlane {
                                  const std::vector<int64_t>& seg_off,
                                  double postscale, int64_t chunk_bytes,
                                  WireTally* tally);
+
+  // fp32 reduce-scatter with bf16 wire encoding: the N-1 reduce steps of
+  // CompressedRingAllreduce, run at the reduce-scatter rotation (rot=-1,
+  // so rank r finishes owning segment r) and WITHOUT the allgather
+  // phase — the ZeRO gradient-shard path (docs/zero.md). Accumulation
+  // is full-precision f32 from per-hop bf16 partials; `base` is the
+  // caller's working copy and ends with this rank's segment finalized.
+  Status CompressedRingReduceScatter(float* base,
+                                     const std::vector<int64_t>& seg_count,
+                                     const std::vector<int64_t>& seg_off,
+                                     int64_t chunk_bytes, WireTally* tally);
+
+  // Shared N-1-step compressed reduce phase at rotation `rot` (see
+  // RingSendSegment): bf16 per hop, f32 accumulate, decode overlapped
+  // on the worker. Both compressed engines slice through here.
+  Status CompressedReducePhase(float* base,
+                               const std::vector<int64_t>& seg_count,
+                               const std::vector<int64_t>& seg_off,
+                               int64_t chunk_elems, int rot,
+                               WireTally* tally);
 
   int rank_;
   int size_;

@@ -197,12 +197,60 @@ def _bucket(v):
     return (tuple(int(d) for d in aval.shape), str(aval.dtype))
 
 
+#: the reduce-scatter primitive spellings across jax versions
+_SCATTER_PRIMS = ("psum_scatter", "reduce_scatter")
+
+
+def check_shard_collective_pairing(ex, ctx):
+    """C6: every reduce-scatter must pair with an allgather over the
+    SAME axes, AT OR AFTER it in program order — the ZeRO invariant
+    (docs/zero.md): a program that scatters a tensor into shards and
+    never gathers anything back on that axis leaves state silently
+    sharded, which downstream replicated-semantics consumers read as
+    garbage on N-1 ranks (and in the split-step shape means updated
+    params never reassemble). Order matters: an FSDP-style param
+    gather BEFORE the scatter cannot reassemble the scatter's result,
+    so it must not mask the finding (pure per-axis counting would).
+    Walked over the linearized signature so loop trip counts weigh in;
+    extra allgathers alone are fine (they have no scatter side)."""
+    pending = collections.Counter()   # axes -> scatters awaiting gather
+    total = collections.Counter()
+    sites = {}
+    for c in linearize(ex.signature):
+        if c.prim in _SCATTER_PRIMS:
+            pending[c.axes] += 1
+            total[c.axes] += 1
+            sites.setdefault(c.axes, c)
+        elif c.prim == "all_gather" and pending.get(c.axes, 0) > 0:
+            pending[c.axes] -= 1
+    out = []
+    for axes, n_unpaired in sorted(pending.items()):
+        if n_unpaired <= 0:
+            continue
+        site = sites[axes]
+        out.append(D.make(
+            "C6", site.path,
+            f"{total[axes]} reduce-scatter(s) over axis {list(axes)} "
+            f"but only {total[axes] - n_unpaired} subsequent "
+            f"allgather(s) on that axis — {n_unpaired} shard "
+            "collective(s) unpaired; the scattered result stays "
+            "sharded while the program's consumers expect replicated "
+            "values",
+            hint="pair every reduce-scatter with an all_gather on the "
+                 "same axis (the ZeRO apply shape: scatter grads, "
+                 "update shards, gather params), or allowlist C6 if "
+                 "the program deliberately keeps that state sharded",
+            source=site.source))
+    return out
+
+
 ALL_CHECKS = (
     check_collective_divergence,
     check_axis_validity,
     check_width_waste,
     check_donation_hazards,
     check_schedule_conformance,
+    check_shard_collective_pairing,
 )
 
 

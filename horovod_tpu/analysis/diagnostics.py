@@ -21,6 +21,10 @@ check ids are stable API (tests assert them, allowlists name them):
 - **C5** schedule conformance — a pipeline program whose traced
   ppermute/psum sequence deviates from the host-built schedule table's
   prediction.
+- **C6** shard-collective pairing — a reduce-scatter with no matching
+  allgather on the same axis (the ZeRO apply invariant: scatter grads,
+  update shards, gather params — docs/zero.md); unpaired scatters
+  leave state silently sharded under replicated-semantics consumers.
 """
 
 import dataclasses
@@ -35,6 +39,7 @@ SEVERITIES = {
     "C3": WARNING,
     "C4": ERROR,
     "C5": ERROR,
+    "C6": ERROR,
 }
 
 
@@ -48,7 +53,7 @@ class Diagnostic:
     available.
     """
 
-    id: str              # "C1".."C5"
+    id: str              # "C1".."C6"
     severity: str        # ERROR or WARNING
     path: str            # structural jaxpr path
     message: str         # what is wrong

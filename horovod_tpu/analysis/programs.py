@@ -143,6 +143,63 @@ def _split_telemetry(config):
                     mesh=mesh)
 
 
+_ZERO_SHARDS = 4
+
+
+def _split_zero(config):
+    """The ZeRO-1 split step (``make_split_train_step(zero=...)``),
+    traced end-to-end under the vmap emulation: proves the restructured
+    step traces cleanly and that its apply program's donations (full
+    params + sharded opt state) alias 1:1 (C4). The vmap emulation
+    lowers the named-axis collectives away at trace time, so the REAL
+    collective signature is linted separately via
+    ``zero1_shard_apply``."""
+    from horovod_tpu.parallel.precision import fused_adam
+    from horovod_tpu.parallel.train_step import make_split_train_step
+    from horovod_tpu.parallel.zero import ZeroConfig
+
+    cfg = _config(config)
+    mesh = _mesh()
+    ts = make_split_train_step(
+        _loss_fn(cfg, mesh), fused_adam(1e-3), microbatches=2,
+        zero=ZeroConfig(axis="data", size=_ZERO_SHARDS,
+                        bucket_bytes=1 << 20))
+    carry = jax.eval_shape(ts.init, _abstract_params(cfg))
+    return LintSpec(fn=ts.step, args=(carry, _abstract_batch()),
+                    mesh=mesh)
+
+
+def _zero_shard_apply(config):
+    """The per-rank ZeRO apply program at the llama geometry, traced
+    with ``axis_env`` exactly like the pipeline inners — psum_scatter /
+    all_gather stay visible to the walker, so C2 (axis validity), C3
+    (width), and C6 (every reduce-scatter pairs with an allgather on
+    the same axis) run against the program the TPU lanes execute."""
+    from horovod_tpu.parallel.precision import fused_adam
+    from horovod_tpu.parallel.zero import (
+        ZeroAdamState,
+        build_zero_apply_inner,
+        zero_bucket_layout,
+    )
+
+    cfg = _config(config)
+    params = _abstract_params(cfg)
+    leaves, _ = jax.tree.flatten(params)
+    layout = zero_bucket_layout(leaves, _ZERO_SHARDS, 1 << 20)
+    inner = build_zero_apply_inner(fused_adam(1e-3).hyper, layout,
+                                   "data", _ZERO_SHARDS)
+    flat = tuple(jax.ShapeDtypeStruct((b.padded,), b.dtype)
+                 for b in layout.buckets)
+    shard = tuple(
+        jax.ShapeDtypeStruct((b.shard_elems(_ZERO_SHARDS),), b.dtype)
+        for b in layout.buckets)
+    opt = ZeroAdamState(
+        count=jax.ShapeDtypeStruct((1,), jnp.int32),
+        mu=shard, nu=shard)
+    return LintSpec(fn=inner, args=(flat, flat, opt),
+                    axis_env=[("data", _ZERO_SHARDS)])
+
+
 def _pipeline(config, schedule):
     from horovod_tpu.models.llama import llama_pipeline_programs
     from horovod_tpu.parallel.pipeline import (
@@ -189,6 +246,8 @@ _REGISTRY = {
     "llama_train_step_split_fused_master_adam":
         functools.partial(_split, optimizer_name="fused_master_adam"),
     "llama_train_step_split_telemetry": _split_telemetry,
+    "llama_train_step_split_zero1": _split_zero,
+    "zero1_shard_apply": _zero_shard_apply,
     "pipeline_gpipe":
         functools.partial(_pipeline, schedule="gpipe"),
     "pipeline_1f1b":

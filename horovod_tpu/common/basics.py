@@ -142,6 +142,10 @@ def load_library():
         lib.hvdtpu_ring_selftest.argtypes = [
             i32, i64, i32, i32, i64, i32, dbl,
             ctypes.POINTER(ctypes.c_double)]
+        lib.hvdtpu_ring_owned_segment.restype = i32
+        lib.hvdtpu_ring_owned_segment.argtypes = [i32, i32, i32]
+        lib.hvdtpu_ring_send_segment.restype = i32
+        lib.hvdtpu_ring_send_segment.argtypes = [i32, i32, i32, i32]
         for fn in ("response_cache_hits", "response_cache_misses",
                    "response_cache_entries"):
             getattr(lib, f"hvdtpu_{fn}").restype = i64
@@ -301,6 +305,24 @@ class HorovodBasics:
         """Toggle bf16-on-wire compression (rank-uniform, like the
         chunk knob; numerics contract in ``docs/wire.md``)."""
         self.lib.hvdtpu_set_wire_compression(1 if on else 0)
+
+    def ring_owned_segment(self, rank, size, rot=0):
+        """Which buffer segment ``rank`` owns (holds fully reduced)
+        after the ring reduce phase at rotation ``rot`` — THE encoding
+        of the r10 segment-rotation trap, straight from the C++ engine
+        (``csrc/ring_ops.h RingOwnedSegment``). rot=0 is the allreduce
+        rotation (rank r owns segment ``(r+1) % size``, what the
+        compressed allgather finalizes); rot=-1 is the reduce-scatter
+        rotation (rank r owns its own segment r — the ZeRO shard
+        boundary contract, ``docs/zero.md``)."""
+        return self.lib.hvdtpu_ring_owned_segment(int(rank), int(size),
+                                                  int(rot))
+
+    def ring_send_segment(self, rank, step, size, rot=0):
+        """Segment ``rank`` sends at reduce-phase ``step`` under
+        rotation ``rot`` (see :meth:`ring_owned_segment`)."""
+        return self.lib.hvdtpu_ring_send_segment(int(rank), int(step),
+                                                 int(size), int(rot))
 
     def ring_selftest(self, ranks, count, dtype=6, op=1, chunk_bytes=None,
                       compression=False, postscale=1.0):

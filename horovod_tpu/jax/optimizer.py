@@ -8,6 +8,8 @@ that allreduces the incoming gradient pytree — grouped/fused in the native
 core — before handing it to the wrapped transformation.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -98,7 +100,8 @@ DistributedOptimizer = DistributedGradientTransformation
 
 def DistributedFusedAdam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
                          op=mpi_ops.Average,
-                         compression=Compression.none):
+                         compression=Compression.none,
+                         zero=False, bucket_bytes=None, overlap=True):
     """Eager-Horovod counterpart of the single-pass fused update
     (``parallel.precision.fused_adam``): allreduce the gradient pytree
     across ranks (donated — the fused device program reuses the
@@ -119,8 +122,34 @@ def DistributedFusedAdam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
     cached device-program replay), so ``apply`` itself must stay
     OUTSIDE jit; the update math runs as its own jitted program — the
     same split-program layout ``bench.py``'s eager row measures.
+
+    ``zero=True`` switches to the ZeRO-1 sharded path (docs/zero.md):
+    gradients are packed into fused buckets (``bucket_bytes``,
+    shard-aligned by construction — ``parallel.zero``) and
+    **reduce-scattered** instead of allreduced, each rank runs the
+    identical adam kernel on its 1/N (params, mu, nu) shards, and the
+    updated param shards are **allgathered** back. Per-rank optimizer
+    state drops N-fold. With ``overlap=True`` (default) the lane is
+    pipelined per bucket: every reduce-scatter is in flight before the
+    first shard update runs, and each bucket's allgather is issued the
+    moment its update finishes — wire time hides under the remaining
+    buckets' update compute (the fused computation-collective recipe of
+    arXiv:2305.06942); ``overlap=False`` runs the three phases
+    bulk-synchronously (the ``zero_sweep`` comparison point). In zero
+    mode ``compression`` applies to the param-allgather payload (e.g.
+    ``Compression.bf16`` halves the up-phase wire for fp32 params;
+    every rank — shard owners included — consumes the decompressed
+    bits, so the result stays rank-consistent), and the gradient
+    reduce-scatter rides the core's ``HOROVOD_WIRE_COMPRESSION``
+    bf16-on-wire path.
     """
     from horovod_tpu.parallel.precision import FusedOptimizer, fused_adam
+
+    if zero:
+        return _zero_fused_adam(learning_rate, b1, b2, eps, op=op,
+                                compression=compression,
+                                bucket_bytes=bucket_bytes,
+                                overlap=overlap)
 
     inner = fused_adam(learning_rate, b1=b1, b2=b2, eps=eps)
 
@@ -136,4 +165,107 @@ def DistributedFusedAdam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
                                     donate=True)
         return jitted_apply(params, grads, state)
 
-    return FusedOptimizer(init=inner.init, apply=apply)
+    return FusedOptimizer(init=inner.init, apply=apply,
+                          hyper=inner.hyper)
+
+
+def _zero_fused_adam(learning_rate, b1, b2, eps, op, compression,
+                     bucket_bytes, overlap):
+    """The eager ZeRO-1 lane behind ``DistributedFusedAdam(zero=True)``.
+
+    One negotiation name per bucket per phase (``zero.rs.i`` /
+    ``zero.ag.i``) so the steady-state response cache stays hot. The
+    pipelined order is: issue EVERY bucket's reduce-scatter first (the
+    background thread negotiates and executes them while Python works),
+    then walk the buckets in order — synchronize bucket i's shard,
+    run its jitted shard-adam, fire its allgather, move on — so bucket
+    i's allgather and bucket i+1..K's reduce-scatters overlap bucket
+    i+1's update compute. Synchronizing the allgathers last drains the
+    pipe.
+    """
+    from horovod_tpu.parallel.precision import (
+        FusedOptimizer,
+        _adam_leaf,
+        _bias_corrections,
+    )
+    from horovod_tpu.parallel.zero import (
+        DEFAULT_BUCKET_BYTES,
+        zero_bucket_layout,
+    )
+
+    bucket_bytes = bucket_bytes or DEFAULT_BUCKET_BYTES
+    cache = {}  # treedef -> layout
+
+    def _layout(leaves, treedef):
+        if treedef not in cache:
+            cache[treedef] = zero_bucket_layout(
+                leaves, mpi_ops.size(), bucket_bytes)
+        return cache[treedef]
+
+    # mu/nu are donated (replaced every step); p/g shards arrive as
+    # fresh collective outputs or slices and must stay un-donated.
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def shard_adam(p_shard, g_shard, mu, nu, count):
+        bc1, bc2 = _bias_corrections(count, b1, b2)
+        return _adam_leaf(p_shard, g_shard, mu, nu, learning_rate, b1,
+                          b2, eps, bc1, bc2, p_shard.dtype)
+
+    def init(params):
+        leaves, treedef = jax.tree.flatten(params)
+        layout = _layout(leaves, treedef)
+        n = layout.n_shards
+        shard = lambda b: jnp.zeros(  # noqa: E731
+            (b.shard_elems(n),), b.dtype)
+        return {
+            "count": jnp.zeros((), jnp.int32),
+            "mu": [shard(b) for b in layout.buckets],
+            "nu": [shard(b) for b in layout.buckets],
+        }
+
+    def apply(params, grads, state):
+        rank = mpi_ops.rank()
+        g_leaves, treedef = jax.tree.flatten(grads)
+        del grads
+        layout = _layout(g_leaves, treedef)
+        p_leaves = treedef.flatten_up_to(params)
+        count = state["count"] + 1
+        # Phase down: EVERY bucket's reduce-scatter goes in flight
+        # before any update runs (overlap) / is drained immediately
+        # (phase-separated baseline).
+        rs = []
+        for i, flat in enumerate(layout.pack(g_leaves)):
+            h = mpi_ops.reducescatter_async(flat, name=f"zero.rs.{i}",
+                                            op=op)
+            rs.append(h if overlap else h.synchronize())
+        del g_leaves
+        # Update + phase up, pipelined per bucket. The param shard is
+        # assembled directly from the overlapping leaf slices
+        # (layout.pack_shard) — packing the FULL padded bucket only to
+        # slice out 1/N of it would waste (N-1)/N of the copy on the
+        # hot eager path.
+        ag, ctxs, new_mu, new_nu = [], [], [], []
+        for i in range(len(layout.buckets)):
+            g_shard = rs[i].synchronize() if overlap else rs[i]
+            p_shard = layout.pack_shard(p_leaves, i, rank)
+            p2, mu2, nu2 = shard_adam(p_shard, g_shard, state["mu"][i],
+                                      state["nu"][i], count)
+            new_mu.append(mu2)
+            new_nu.append(nu2)
+            c, ctx = compression.compress(p2)
+            ctxs.append(ctx)
+            if overlap:
+                ag.append(mpi_ops.allgather_async(c, name=f"zero.ag.{i}"))
+            else:
+                ag.append(c)
+        if not overlap:
+            ag = mpi_ops.grouped_allgather_async(
+                ag, names=[f"zero.ag.{i}" for i in range(len(ag))])
+        new_flat = [compression.decompress(h.synchronize(), ctx)
+                    for h, ctx in zip(ag, ctxs)]
+        params = jax.tree.unflatten(treedef, layout.unpack(new_flat))
+        return params, {"count": count, "mu": new_mu, "nu": new_nu}
+
+    return FusedOptimizer(init=init, apply=apply,
+                          hyper={"kind": "adam", "zero1": True,
+                                 "learning_rate": learning_rate,
+                                 "b1": b1, "b2": b2, "eps": eps})

@@ -65,3 +65,11 @@ from horovod_tpu.parallel.train_step import (  # noqa: F401
     TrainStep,
     make_split_train_step,
 )
+from horovod_tpu.parallel.zero import (  # noqa: F401
+    ZeroAdamState,
+    ZeroConfig,
+    ZeroMasterAdamState,
+    optimizer_state_bytes,
+    ring_owned_segment,
+    zero_bucket_layout,
+)

@@ -106,9 +106,13 @@ class FusedOptimizer(NamedTuple):
     """The optimizer protocol ``make_split_train_step`` recognizes as
     fused: ``apply(params, grads, state) -> (new_params, new_state)``
     produces the updated parameters DIRECTLY (no intermediate updates
-    tree, no separate ``optax.apply_updates`` pass)."""
+    tree, no separate ``optax.apply_updates`` pass). ``hyper`` carries
+    the constructor's hyperparameters so shard-level re-expressions of
+    the same update (``parallel.zero``, the ZeRO-1 apply) can rebuild
+    the identical single-pass kernel on 1/N state."""
     init: Any
     apply: Any
+    hyper: Any = None
 
 
 def _adam_leaf(p, g, mu, nu, lr, b1, b2, eps, bc1, bc2, out_dtype):
@@ -160,7 +164,10 @@ def fused_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8):
         return unflat(0), FusedAdamState(count=count, mu=unflat(1),
                                          nu=unflat(2))
 
-    return FusedOptimizer(init=init, apply=apply)
+    return FusedOptimizer(init=init, apply=apply,
+                          hyper={"kind": "adam",
+                                 "learning_rate": learning_rate,
+                                 "b1": b1, "b2": b2, "eps": eps})
 
 
 class FusedMasterState(NamedTuple):
@@ -173,10 +180,12 @@ class FusedMasterState(NamedTuple):
 class FusedMasterOptimizer(NamedTuple):
     """FusedOptimizer protocol plus the initial-cast helper (the step
     carry holds COMPUTE-dtype params; build it as
-    ``(opt.compute_params(state), state)`` after ``init``)."""
+    ``(opt.compute_params(state), state)`` after ``init``). ``hyper``
+    as in :class:`FusedOptimizer`."""
     init: Any
     apply: Any
     compute_params: Any
+    hyper: Any = None
 
 
 def fused_master_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
@@ -233,4 +242,9 @@ def fused_master_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
         return unflat(0), state
 
     return FusedMasterOptimizer(init=init, apply=apply,
-                                compute_params=compute_params)
+                                compute_params=compute_params,
+                                hyper={"kind": "master_adam",
+                                       "learning_rate": learning_rate,
+                                       "b1": b1, "b2": b2, "eps": eps,
+                                       "compute_dtype": compute_dtype,
+                                       "master_dtype": master_dtype})

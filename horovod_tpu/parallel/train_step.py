@@ -93,7 +93,7 @@ def _register_split_flops(timer, programs):
 
 
 def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
-                          jit_kwargs=None, telemetry=None):
+                          jit_kwargs=None, telemetry=None, zero=None):
     """Build the split-program step for ``loss_fn(params, batch)``.
 
     ``optimizer`` is either an optax ``GradientTransformation``
@@ -103,6 +103,16 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
     (``init``/``apply`` — the single-pass FUSED apply). For the master
     variant the carry's params are the COMPUTE-dtype cast (built by
     ``init``); the fp32 master lives inside the optimizer state.
+
+    ``zero`` (optional) is a :class:`horovod_tpu.parallel.zero.
+    ZeroConfig`: the apply program is then the ZeRO-1 sharded form —
+    gradient buckets reduce-scattered over ``zero.axis`` so each rank
+    updates 1/N of the (fused adam / fused master-adam) optimizer
+    state, updated parameter shards allgathered back — cutting
+    per-rank optimizer memory N-fold at the same step semantics
+    (docs/zero.md; parity pinned by tests/single/test_zero.py). The
+    grad/accumulation programs are unchanged: ZeRO-1 restructures only
+    the optimizer phase.
 
     ``telemetry`` (optional) is a
     :class:`horovod_tpu.telemetry.StepTimer`: every ``step`` call is
@@ -134,7 +144,13 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
     # BENCH r5 tail — the r6 fix, pinned by
     # tests/single/test_llama.py::test_apply_jit_emits_no_donation_warning).
     # The buffers are dead the moment apply returns either way.
-    if fused:
+    zero_init = None
+    if zero is not None:
+        from horovod_tpu.parallel.zero import make_zero_apply
+
+        apply_fn, zero_init = make_zero_apply(optimizer, zero,
+                                              jit_kwargs=jk)
+    elif fused:
         @functools.partial(jax.jit, donate_argnums=(1, 2), **jk)
         def apply_fn(grads, params, opt):
             return optimizer.apply(params, grads, opt)
@@ -221,6 +237,10 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
             return out
 
     def init(params):
+        if zero_init is not None:
+            # ZeRO-1 carry: replicated params (compute cast for the
+            # master variant), optimizer state sharded over zero.axis.
+            return zero_init(params)
         opt = optimizer.init(params)
         if hasattr(optimizer, "compute_params"):
             # Master-weights variant: the carry holds the compute cast;

@@ -1,0 +1,447 @@
+"""ZeRO-1 sharded optimizer for the split train step (docs/zero.md).
+
+The r06 split step materializes FULLY REPLICATED optimizer state and
+treats the gradient reduction as a bulk allreduce phase. This module
+restructures the optimizer-apply program into the ZeRO-1 shape
+(Rajbhandari et al., arXiv:1910.02054; the fused-collective overlap
+follows arXiv:2305.06942):
+
+- the gradient buckets are **reduce-scattered** over the ``zero`` axis,
+  so rank r receives only its 1/N shard of each bucket;
+- the single-pass fused adam (``parallel.precision``) runs on 1/N
+  optimizer state — per-rank mu/nu (and fp32 master, for the
+  master-weights variant) drop N-fold;
+- the updated parameter shards are **allgathered** back to the full
+  replicated tree the next forward consumes.
+
+Wire cost per rank: (N-1)/N x grads down + (N-1)/N x params up — the
+same total as the allreduce it replaces at equal dtypes, but the two
+phases carry DIFFERENT payloads: the reduce-scatter rides the core's
+bf16 wire compression (``HOROVOD_WIRE_COMPRESSION``, extended to
+reduce-scatter in this round — csrc/ring_ops.cc), and the allgather
+ships params at their (usually narrow) storage/compute width, which is
+where the ~2x wire saving comes from on fp32-gradient runs.
+
+Shard-boundary contract: buckets are padded to a multiple of the shard
+count, so shard boundaries ALWAYS align with bucket boundaries; rank r
+owns flat segment ``[r*s, (r+1)*s)`` of every bucket — the
+reduce-scatter rotation that makes this true inside the ring engine
+(rot=-1: rank r ends owning its own segment) is pinned by
+:func:`ring_owned_segment`, the Python twin of
+``csrc/ring_ops.h RingOwnedSegment``.
+
+Two lanes share this module's layout math:
+
+- the **jitted lane**: ``make_split_train_step(..., zero=ZeroConfig())``
+  wires :func:`make_zero_apply` in as the apply program — a manual-
+  over-axis SPMD program (``jax.shard_map`` where available, the
+  pipeline package's ``vmap(axis_name=...)`` emulation on jax 0.4.x
+  boxes) whose per-bucket reduce-scatter/allgather pairs are exactly
+  what the latency-hiding scheduler overlaps with compute on TPU, and
+  what hvdlint's C6 pairing check verifies statically;
+- the **eager lane**: ``hvd.DistributedFusedAdam(zero=True)``
+  (horovod_tpu/jax/optimizer.py) issues one ``reducescatter_async``
+  per bucket and pipelines shard-update + ``allgather_async`` per
+  bucket as reductions complete, hiding wire time under update compute.
+"""
+
+import dataclasses
+import functools
+import math
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.precision import _adam_leaf, _bias_corrections
+
+#: default fused-bucket size (unpadded payload bytes); matches the
+#: core's fusion-threshold order of magnitude so one eager bucket fills
+#: one fusion buffer.
+DEFAULT_BUCKET_BYTES = 32 * 1024 * 1024
+
+
+def ring_owned_segment(rank, size, rot=0):
+    """Which ring segment ``rank`` holds fully reduced after the N-1
+    reduce steps at rotation ``rot`` — the Python twin of
+    ``csrc/ring_ops.h RingOwnedSegment`` (pinned against the C ABI by
+    ``tests/single/test_zero.py``).
+
+    ``rot=0`` is the allreduce rotation: rank r owns segment
+    ``(r+1) % size`` (the r10 trap — the compressed allgather finalizes
+    THAT segment). ``rot=-1`` is the reduce-scatter rotation: rank r
+    owns its own segment r, which is why this module's shard-boundary
+    math can use plain ``rank``-indexed slices everywhere.
+    """
+    if size <= 0 or not 0 <= rank < size:
+        raise ValueError(f"rank {rank} not in [0, {size})")
+    return (rank + 1 + rot) % size
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroConfig:
+    """How to shard the optimizer.
+
+    ``axis`` — mesh-axis name the shards live on (default ``"data"``:
+    pure data-parallel replicas are exactly the ranks whose optimizer
+    copies are redundant). ``size`` — shard count; defaults to
+    ``mesh.shape[axis]`` when ``mesh`` is given. ``mesh`` — used by the
+    real ``jax.shard_map`` path; on jax 0.4.x boxes the apply runs
+    under the vmap(axis_name) emulation and only ``size`` matters.
+    ``bucket_bytes`` — fused-bucket granularity (shard boundaries align
+    with bucket boundaries by construction).
+    """
+
+    axis: str = "data"
+    size: int = None
+    mesh: Any = None
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES
+
+    def resolved_size(self):
+        if self.size is not None:
+            return int(self.size)
+        if self.mesh is not None:
+            return int(self.mesh.shape[self.axis])
+        raise ValueError("ZeroConfig needs size= or mesh=")
+
+
+# ---- bucket layout ---------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    indices: tuple   # leaf positions (into the flattened tree)
+    sizes: tuple     # flat element count per leaf
+    offsets: tuple   # leaf offsets within the unpadded concat
+    dtype: Any
+    nelems: int      # unpadded total elements
+    padded: int      # padded to a multiple of n_shards
+
+    def shard_elems(self, n_shards):
+        return self.padded // n_shards
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Partition of a flat leaf list into dtype-homogeneous fused
+    buckets, each padded to a multiple of ``n_shards`` so every shard
+    boundary is a bucket-internal offset (never mid-leaf arithmetic on
+    the wire: the collective sees whole padded buckets)."""
+
+    buckets: tuple
+    n_shards: int
+    shapes: tuple    # per-leaf shapes (for unpack)
+    dtypes: tuple    # per-leaf dtypes
+
+    @property
+    def padded_elems(self):
+        return sum(b.padded for b in self.buckets)
+
+    def pack(self, leaves):
+        """leaves -> list of flat padded 1-D arrays, one per bucket.
+
+        Deliberately built from ``dynamic_update_slice`` writes into a
+        zeros bucket instead of ``jnp.concatenate``: on the jax-0.4.x
+        CPU substrate, GSPMD miscompiles a jitted concatenate whose
+        operand is a reshape of an axis-sharded array (the PHYSICAL
+        per-device layout leaks into the result — elements come back
+        strided; two-line repro in tests/single/test_zero.py::
+        test_pack_of_sharded_leaves_is_layout_exact). The update-slice
+        chain lowers to plain copies and is exact under every sharding;
+        XLA fuses it to the same memcpys the concat would have been.
+        """
+        out = []
+        for b in self.buckets:
+            if len(b.indices) == 1 and b.padded == b.nelems:
+                out.append(leaves[b.indices[0]].reshape(-1))
+                continue
+            flat = jnp.zeros((b.padded,), b.dtype)
+            for i, off in zip(b.indices, b.offsets):
+                flat = lax.dynamic_update_slice(
+                    flat, leaves[i].reshape(-1).astype(b.dtype), (off,))
+            out.append(flat)
+        return out
+
+    def unpack(self, flat_buckets):
+        """Inverse of :meth:`pack` (padding dropped)."""
+        leaves = [None] * len(self.shapes)
+        for b, flat in zip(self.buckets, flat_buckets):
+            for i, size, off in zip(b.indices, b.sizes, b.offsets):
+                leaves[i] = flat[off:off + size].reshape(self.shapes[i])
+        return leaves
+
+    def pack_shard(self, leaves, bucket_index, rank):
+        """Rank ``rank``'s shard of bucket ``bucket_index`` WITHOUT
+        materializing the full packed bucket: only the leaf slices that
+        overlap ``[rank*s, (rank+1)*s)`` are copied — 1/N of
+        :meth:`pack`'s work, which is what the eager per-step param
+        slice wants (the other N-1 shards of the params would be packed
+        only to be thrown away). All offsets are static, so this is
+        plain slicing; identical values to
+        ``pack(leaves)[bucket_index][rank*s:(rank+1)*s]`` (pinned by
+        tests/single/test_zero.py)."""
+        b = self.buckets[bucket_index]
+        s = b.shard_elems(self.n_shards)
+        lo, hi = rank * s, (rank + 1) * s
+        shard = jnp.zeros((s,), b.dtype)
+        for i, size, off in zip(b.indices, b.sizes, b.offsets):
+            a, z = max(off, lo), min(off + size, hi)
+            if a >= z:
+                continue
+            piece = leaves[i].reshape(-1)[a - off:z - off].astype(b.dtype)
+            shard = lax.dynamic_update_slice(shard, piece, (a - lo,))
+        return shard
+
+
+def zero_bucket_layout(leaves, n_shards, bucket_bytes=DEFAULT_BUCKET_BYTES):
+    """Build the fused-bucket partition of ``leaves`` (arrays or
+    ShapeDtypeStructs): group by dtype in tree order, close a bucket
+    when it reaches ``bucket_bytes`` (a single over-sized leaf still
+    gets exactly one bucket), pad each bucket to a multiple of
+    ``n_shards``."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    by_dtype = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    buckets = []
+    for dtype, idxs in by_dtype.items():
+        cur, cur_bytes = [], 0
+        itemsize = dtype.itemsize
+        for i in idxs:
+            n = int(math.prod(leaves[i].shape)) if leaves[i].shape else 1
+            if cur and cur_bytes + n * itemsize > bucket_bytes:
+                buckets.append((dtype, cur))
+                cur, cur_bytes = [], 0
+            cur.append((i, n))
+            cur_bytes += n * itemsize
+        if cur:
+            buckets.append((dtype, cur))
+    built = []
+    for dtype, members in buckets:
+        sizes = tuple(n for _, n in members)
+        offsets, off = [], 0
+        for n in sizes:
+            offsets.append(off)
+            off += n
+        padded = -(-off // n_shards) * n_shards
+        built.append(Bucket(indices=tuple(i for i, _ in members),
+                            sizes=sizes, offsets=tuple(offsets),
+                            dtype=dtype, nelems=off, padded=max(padded,
+                                                                n_shards)))
+    return BucketLayout(buckets=tuple(built), n_shards=n_shards,
+                        shapes=tuple(tuple(l.shape) for l in leaves),
+                        dtypes=tuple(jnp.dtype(l.dtype) for l in leaves))
+
+
+def optimizer_state_bytes(state):
+    """Total bytes of an optimizer-state pytree (the 1/N pin in tests
+    and the ``zero_sweep`` per-rank accounting)."""
+    return sum(l.size * l.dtype.itemsize
+               for l in jax.tree.leaves(state)
+               if hasattr(l, "dtype"))
+
+
+# ---- sharded optimizer state ----------------------------------------
+
+class ZeroAdamState(NamedTuple):
+    """Sharded fused-adam state with EVERY leaf's leading dim divisible
+    by the shard count, so the whole state splits uniformly over the
+    zero axis: ``count`` is the step counter tiled to ``(n_shards,)``
+    (each rank's block is its ``(1,)`` copy), ``mu``/``nu`` are tuples
+    of flat padded bucket arrays — per rank, 1/N of the replicated
+    ``FusedAdamState``."""
+
+    count: Any
+    mu: Any
+    nu: Any
+
+
+class ZeroMasterAdamState(NamedTuple):
+    """Sharded fused-master-adam state: the fp32 ``master`` shards live
+    in the state (ZeRO-1 over the master-weights recipe); ``mu``/``nu``
+    are f32, all 1/N per rank."""
+
+    count: Any
+    master: Any
+    mu: Any
+    nu: Any
+
+
+def _optimizer_hyper(optimizer):
+    hyper = getattr(optimizer, "hyper", None)
+    if not hyper or hyper.get("kind") not in ("adam", "master_adam"):
+        raise ValueError(
+            "zero= needs a fused optimizer carrying its hyperparameters "
+            "(parallel.precision.fused_adam / fused_master_adam); got "
+            f"{optimizer!r}. optax transformations have no single-pass "
+            "shard apply — wrap the update in fused form first.")
+    return hyper
+
+
+# ---- the SPMD apply program -----------------------------------------
+
+def _zero_spmd(inner, axis, size, mesh, split_in, split_out):
+    """Run ``inner`` manual over the zero axis: ``jax.shard_map`` when
+    this jax has it AND a mesh was provided, else the same
+    ``vmap(axis_name=...)`` emulation the pipeline schedules use on
+    jax 0.4.x boxes (identical collective semantics; GSPMD lays the
+    emulated program out freely). ``split_in``/``split_out`` are
+    per-argument booleans: True = leading dim splits over ``axis``
+    (every leaf of that argument), False = replicated."""
+    if mesh is not None and hasattr(jax, "shard_map"):
+        from jax.sharding import PartitionSpec as P
+
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=tuple(P(axis) if s else P() for s in split_in),
+            out_specs=tuple(P(axis) if s else P() for s in split_out),
+            axis_names={axis}, check_vma=False)
+
+    def emulated(*args):
+        split = lambda a: jax.tree.map(  # noqa: E731
+            lambda x: x.reshape((size, x.shape[0] // size) + x.shape[1:]),
+            a)
+        args = tuple(split(a) if s else a
+                     for a, s in zip(args, split_in))
+        outs = jax.vmap(inner,
+                        in_axes=tuple(0 if s else None for s in split_in),
+                        out_axes=0, axis_name=axis)(*args)
+        merge = lambda o: jax.tree.map(  # noqa: E731
+            lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+            o)
+        first = lambda o: jax.tree.map(lambda x: x[0], o)  # noqa: E731
+        return tuple(merge(o) if s else first(o)
+                     for o, s in zip(outs, split_out))
+
+    return emulated
+
+
+def build_zero_apply_inner(hyper, layout, axis, size):
+    """The per-rank apply program (manual over ``axis``):
+
+    for every bucket, ``psum_scatter`` the full gradient bucket (rank r
+    receives the mean-gradient shard it owns), run the single-pass adam
+    leaf kernel on the 1/N (params, mu, nu[, master]) shards, and
+    ``all_gather`` the updated param shards back into the replicated
+    flat bucket. Registered standalone with hvdlint (traced via
+    ``jax.make_jaxpr(axis_env=[(axis, size)])`` — no mesh or shard_map
+    needed), where check C6 verifies every reduce-scatter pairs with an
+    allgather on the same axis.
+    """
+    lr, b1 = hyper["learning_rate"], hyper["b1"]
+    b2, eps = hyper["b2"], hyper["eps"]
+    master = hyper["kind"] == "master_adam"
+    compute_dtype = hyper.get("compute_dtype")
+    inv_size = 1.0 / size
+
+    def inner(grads_flat, params_flat, opt):
+        r = lax.axis_index(axis)
+        count = opt.count + 1  # per-rank (1,) block of the tiled counter
+        bc1, bc2 = _bias_corrections(count[0], b1, b2)
+        new_params, new_mu, new_nu, new_master = [], [], [], []
+        for i, b in enumerate(layout.buckets):
+            s = b.shard_elems(size)
+            # Reduce-scatter: rank r owns flat segment [r*s, (r+1)*s) of
+            # every bucket (the rot=-1 ownership — ring_owned_segment).
+            # Runs at the gradient's native width (the wire stays
+            # narrow; the adam kernel upcasts the SHARD to f32), and the
+            # mean over the axis folds on the shard — one s-element
+            # multiply instead of a padded-bucket one.
+            g_shard = lax.psum_scatter(
+                grads_flat[i], axis, scatter_dimension=0,
+                tiled=True) * inv_size
+            if master:
+                p_shard = opt.master[i]
+            else:
+                p_shard = lax.dynamic_slice(params_flat[i], (r * s,), (s,))
+            p2, mu2, nu2 = _adam_leaf(
+                p_shard, g_shard, opt.mu[i], opt.nu[i], lr, b1, b2, eps,
+                bc1, bc2, p_shard.dtype)
+            if master:
+                new_master.append(p2)
+                out_shard = p2.astype(compute_dtype)
+            else:
+                out_shard = p2
+            # Allgather the updated shards: rank-order concatenation is
+            # exactly the packed bucket layout.
+            new_params.append(lax.all_gather(out_shard, axis, axis=0,
+                                             tiled=True))
+            new_mu.append(mu2)
+            new_nu.append(nu2)
+        if master:
+            new_opt = ZeroMasterAdamState(count=count,
+                                          master=tuple(new_master),
+                                          mu=tuple(new_mu),
+                                          nu=tuple(new_nu))
+        else:
+            new_opt = ZeroAdamState(count=count, mu=tuple(new_mu),
+                                    nu=tuple(new_nu))
+        return tuple(new_params), new_opt
+
+    return inner
+
+
+def make_zero_apply(optimizer, zero, jit_kwargs=None):
+    """Build the ZeRO apply for ``make_split_train_step``.
+
+    Returns ``(apply_fn, init)``: ``init(params) -> (params, opt)``
+    carry (optimizer state sharded N-fold over ``zero.axis``) and
+    ``apply_fn(grads, params, opt) -> (params, opt)`` — drop-in for the
+    replicated apply program, same donation contract (params/opt
+    donate 1:1 into their updated versions; grads do not).
+    """
+    hyper = _optimizer_hyper(optimizer)
+    size = zero.resolved_size()
+    master = hyper["kind"] == "master_adam"
+    jk = dict(jit_kwargs or {})
+    cache = {}  # treedef -> (layout, jitted apply)
+
+    def _programs(params):
+        leaves, treedef = jax.tree.flatten(params)
+        key = treedef
+        if key in cache:
+            return cache[key]
+        layout = zero_bucket_layout(leaves, size, zero.bucket_bytes)
+        inner = build_zero_apply_inner(hyper, layout, zero.axis, size)
+        spmd = _zero_spmd(inner, zero.axis, size, zero.mesh,
+                          split_in=(False, False, True),
+                          split_out=(False, True))
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2), **jk)
+        def jitted_apply(grads, params, opt):
+            g_flat = layout.pack(treedef.flatten_up_to(grads))
+            p_flat = layout.pack(treedef.flatten_up_to(params))
+            new_flat, opt = spmd(tuple(g_flat), tuple(p_flat), opt)
+            return (jax.tree.unflatten(treedef,
+                                       layout.unpack(list(new_flat))),
+                    opt)
+
+        cache[key] = (layout, treedef, jitted_apply)
+        return cache[key]
+
+    def init(params):
+        layout, _, _ = _programs(params)
+        flat = layout.pack(jax.tree.leaves(params))
+        count = jnp.zeros((size,), jnp.int32)
+        if master:
+            m_dtype = hyper.get("master_dtype", jnp.float32)
+            master_flat = tuple(jnp.array(f, m_dtype) for f in flat)
+            opt = ZeroMasterAdamState(
+                count=count, master=master_flat,
+                mu=tuple(jnp.zeros_like(m) for m in master_flat),
+                nu=tuple(jnp.zeros_like(m) for m in master_flat))
+            params = jax.tree.map(
+                lambda p: p.astype(hyper["compute_dtype"]), params)
+        else:
+            opt = ZeroAdamState(
+                count=count,
+                mu=tuple(jnp.zeros_like(f) for f in flat),
+                nu=tuple(jnp.zeros_like(f) for f in flat))
+        return params, opt
+
+    def apply_fn(grads, params, opt):
+        _, _, fn = _programs(params)
+        return fn(grads, params, opt)
+
+    return apply_fn, init

@@ -80,6 +80,67 @@ def eager_allreduce_bytes(loss_fn, params, batch, size=2, axis="hvd"):
                             axis_env=[(axis, size)])
 
 
+def zero_signature_bytes(signature, size):
+    """Sum payload bytes over a signature the way the RUNTIME counters
+    account the ZeRO collective mix: reduce-scatter/psum_scatter at the
+    full input width (the core books the enqueued tensor), all_gather
+    at the GATHERED output width (the core books ``managed_output`` —
+    ``size`` x the per-rank operand). One convention on both sides is
+    what lets the reconciliation hold to <1% on the mixed
+    reduce-scatter + allgather step (docs/zero.md)."""
+    total = 0
+    for c in linearize(signature):
+        n = c.nelems * _dtype_bytes(c.dtype)
+        if c.prim == "all_gather":
+            n *= size
+        total += n
+    return total
+
+
+def eager_zero_bytes(loss_fn, params, batch, size=2, axis="hvd",
+                     bucket_bytes=None):
+    """Predicted per-step wire payload bytes of the eager ZeRO-1 step
+    (``hvd.DistributedFusedAdam(zero=True)``): one reduce-scatter per
+    padded gradient bucket down, one allgather of the updated param
+    shards per bucket up. The in-graph equivalent is built from the
+    SAME ``parallel.zero.zero_bucket_layout`` the optimizer executes —
+    padding included — and walked by the same extractor, so predicted
+    and measured can only diverge if the runtime moves something the
+    layout does not know about."""
+    import jax
+
+    from horovod_tpu.parallel.zero import (
+        DEFAULT_BUCKET_BYTES,
+        zero_bucket_layout,
+    )
+
+    bucket_bytes = bucket_bytes or DEFAULT_BUCKET_BYTES
+
+    def step_signature(p, b):
+        grads = jax.grad(loss_fn)(p, b)
+        leaves, _ = jax.tree.flatten(grads)
+        layout = zero_bucket_layout(leaves, size, bucket_bytes)
+        out = []
+        for flat in layout.pack(leaves):
+            shard = jax.lax.psum_scatter(flat, axis,
+                                         scatter_dimension=0, tiled=True)
+            out.append(jax.lax.all_gather(shard, axis, axis=0,
+                                          tiled=True))
+        return out
+
+    jaxpr = jax.make_jaxpr(step_signature,
+                           axis_env=((axis, size),))(params, batch)
+    return zero_signature_bytes(extract(jaxpr).signature, size)
+
+
+def zero_layout_bytes(layout):
+    """Walker-free cross-check for :func:`eager_zero_bytes`: per step,
+    every padded bucket crosses once as a reduce-scatter input and once
+    as a gathered allgather output — ``2 x padded x itemsize`` per
+    bucket (the two must agree exactly; pinned in tests)."""
+    return sum(2 * b.padded * b.dtype.itemsize for b in layout.buckets)
+
+
 def grad_tree_bytes(loss_fn, params, batch):
     """Gradient-tree byte volume via ``jax.eval_shape`` — the
     walker-free cross-check for :func:`eager_allreduce_bytes` (the two

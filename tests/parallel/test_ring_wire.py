@@ -175,6 +175,72 @@ def test_compressed_wire_halves_bytes():
     assert all(r == results[0] for r in results)
 
 
+def _worker_compressed_reducescatter(rank, size):
+    b = _init(rank)
+    from horovod_tpu.common import eager_ops as ops
+
+    try:
+        assert b.wire_compression() is True
+        count = size * 5000 + size  # shard-even on purpose
+        inputs = [_rank_input(r, count) for r in range(size)]
+        shard = count // size
+        sl = slice(rank * shard, (rank + 1) * shard)
+
+        snap0 = b.metrics_snapshot()
+        out = ops.reducescatter_async(inputs[rank], "zrs.sum").synchronize()
+        snap1 = b.metrics_snapshot()
+        assert out.shape == (shard,)
+
+        # EXACT bf16-hop replay of the compressed engine, in ring order:
+        # seg j's chain starts at rank j+1 (the rot=-1 rotation —
+        # ring_owned_segment(r, N, -1) == r), each hop ships the current
+        # f32 partial as bf16 and the receiver accumulates in f32.
+        import ml_dtypes
+
+        bf16 = lambda x: x.astype(ml_dtypes.bfloat16).astype(  # noqa: E731
+            np.float32)
+        acc = inputs[(rank + 1) % size][sl].copy()
+        for t in range(2, size + 1):
+            acc = inputs[(rank + t) % size][sl] + bf16(acc)
+        assert np.array_equal(out.view(np.uint32), acc.view(np.uint32))
+
+        # Wire ratio ~0.5: the reduce phase ships bf16; logical volume
+        # is the (N-1)/N ring factor at full f32 width.
+        tx = snap1["wire"]["tx_bytes"] - snap0["wire"]["tx_bytes"]
+        txl = (snap1["wire"]["tx_logical_bytes"]
+               - snap0["wire"]["tx_logical_bytes"])
+        assert txl > 0
+        assert 0.45 < tx / txl < 0.55, (tx, txl)
+        expect_logical = (size - 1) / size * inputs[rank].nbytes
+        assert abs(txl - expect_logical) / expect_logical < 0.05
+        # Per-op logical accounting stays full-width.
+        rs = snap1["ops"]["reducescatter"]["bytes"] - \
+            snap0["ops"].get("reducescatter", {}).get("bytes", 0)
+        assert rs == inputs[rank].nbytes
+
+        # AVERAGE folds exactly like the uncompressed path: postscale
+        # applied once, ScaleBuffer's f32 semantics.
+        avg = ops.reducescatter_async(inputs[rank], "zrs.avg",
+                                      op=ops.ReduceOp.AVERAGE).synchronize()
+        exp = (acc.astype(np.float64) * (1.0 / size)).astype(np.float32)
+        assert np.array_equal(avg.view(np.uint32), exp.view(np.uint32))
+
+        # Bit-consistency mirror of the allreduce case: a repeat run
+        # must reproduce the identical bits (the compressed engine is
+        # deterministic, chunked or not).
+        rep = ops.reducescatter_async(inputs[rank], "zrs.rep").synchronize()
+        assert np.array_equal(rep.view(np.uint32), out.view(np.uint32))
+        return "ok"
+    finally:
+        b.shutdown()
+
+
+def test_compressed_reducescatter_wire_and_bits():
+    assert run_ranks(_worker_compressed_reducescatter, 4, timeout=180,
+                     env={"HOROVOD_RING_CHUNK_BYTES": "8192",
+                          "HOROVOD_WIRE_COMPRESSION": "1"}) == ["ok"] * 4
+
+
 def _worker_uncompressed_ratio(rank, size):
     b = _init(rank)
     from horovod_tpu.common import eager_ops as ops

@@ -1,0 +1,162 @@
+"""Eager ZeRO-1 lane (``hvd.DistributedFusedAdam(zero=True)``) over
+real OS ranks on the host ring.
+
+Pins the acceptance math of the zero round (docs/zero.md):
+
+- sharded-vs-replicated parity: the pipelined reduce-scatter ->
+  shard-adam -> allgather step equals the replicated fused adam fed the
+  rank-mean gradients, at 2 and 4 ranks;
+- per-rank optimizer state measured at 1/N of the replicated state;
+- the metrics snapshot books the new collective mix — reducescatter
+  down + allgather up, ZERO allreduces — and the logical bytes
+  reconcile with ``telemetry.predict.zero_layout_bytes`` within 1%;
+- ``overlap=False`` (phase-separated) computes bit-identical params to
+  the pipelined default — overlap is a SCHEDULE change only.
+
+Quick lane alongside tests/parallel/test_ring_wire.py.
+"""
+
+import numpy as np
+import pytest
+
+from tests.utils_mp import run_ranks
+
+pytestmark = pytest.mark.quick
+
+_SHAPES = [(48, 16), (33,), (16, 8), (65,)]
+
+
+def _worker_parity(rank, size):
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu import telemetry
+    from horovod_tpu.parallel.precision import fused_adam
+    from horovod_tpu.parallel.zero import (
+        optimizer_state_bytes,
+        zero_bucket_layout,
+    )
+    from horovod_tpu.telemetry.predict import zero_layout_bytes
+
+    hvd.init()
+    try:
+        params = {f"p{i}": jnp.full(s, 0.05 * (i + 1), jnp.float32)
+                  for i, s in enumerate(_SHAPES)}
+        grads = {f"p{i}": jnp.full(s, 0.1 * (rank + 1) * (i - 1.5),
+                                   jnp.float32)
+                 for i, s in enumerate(_SHAPES)}
+        gmean = {f"p{i}": jnp.full(s, 0.1 * (i - 1.5) * (size + 1) / 2,
+                                   jnp.float32)
+                 for i, s in enumerate(_SHAPES)}
+        bucket = 2048
+        copy = lambda t: jax.tree.map(jnp.array, t)  # noqa: E731
+
+        steps = 3
+        zopt = hvd.DistributedFusedAdam(1e-2, zero=True,
+                                        bucket_bytes=bucket)
+        sep = hvd.DistributedFusedAdam(1e-2, zero=True,
+                                       bucket_bytes=bucket,
+                                       overlap=False)
+        ref = fused_adam(1e-2)
+        zs, ss, rs = zopt.init(params), sep.init(params), ref.init(params)
+        zp, sp, rp = copy(params), copy(params), copy(params)
+
+        telemetry.metrics_reset()
+        for _ in range(steps):
+            zp, zs = zopt.apply(zp, grads, zs)
+        snap = telemetry.snapshot()
+        for _ in range(steps):
+            sp, ss = sep.apply(sp, grads, ss)
+            rp, rs = ref.apply(rp, gmean, rs)
+
+        # Parity with the replicated update on the mean gradients.
+        for k in params:
+            np.testing.assert_allclose(np.asarray(zp[k]),
+                                       np.asarray(rp[k]),
+                                       rtol=1e-5, atol=1e-7, err_msg=k)
+            # Overlap is a schedule, not a numerics, knob: bit-equal.
+            assert np.array_equal(
+                np.asarray(zp[k]).view(np.uint32),
+                np.asarray(sp[k]).view(np.uint32)), k
+
+        # 1/N optimizer state per rank (padding + counter = slack).
+        zbytes = optimizer_state_bytes(zs)
+        rbytes = optimizer_state_bytes(rs)
+        assert zbytes < rbytes / size * 1.15, (zbytes, rbytes)
+
+        # Collective mix + byte reconciliation (<1%).
+        layout = zero_bucket_layout(list(params.values()), size, bucket)
+        predicted = zero_layout_bytes(layout) * steps
+        moved = (snap["ops"].get("reducescatter", {}).get("bytes", 0)
+                 + snap["ops"].get("allgather", {}).get("bytes", 0))
+        assert snap["ops"].get("allreduce", {}).get("tensors", 0) == 0
+        assert abs(moved / predicted - 1.0) < 0.01, (moved, predicted)
+        return (zbytes, rbytes)
+    finally:
+        hvd.shutdown()
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_eager_zero_parity_and_state_cut(size):
+    results = run_ranks(_worker_parity, size, timeout=240)
+    assert all(r == results[0] for r in results)
+
+
+def _worker_compressed(rank, size):
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.jax.compression import Compression
+
+    hvd.init()
+    try:
+        b = basics.HorovodBasics()
+        assert b.wire_compression() is True
+        params = {f"p{i}": jnp.full(s, 0.05 * (i + 1), jnp.float32)
+                  for i, s in enumerate(_SHAPES)}
+        grads = {f"p{i}": jnp.full(s, 0.1 * (rank + 1) * (i - 1.5),
+                                   jnp.float32)
+                 for i, s in enumerate(_SHAPES)}
+        zopt = hvd.DistributedFusedAdam(1e-2, zero=True,
+                                        bucket_bytes=2048,
+                                        compression=Compression.bf16)
+        state = zopt.init(params)
+        zp = jax.tree.map(jnp.array, params)
+
+        snap0 = b.metrics_snapshot()
+        for _ in range(2):
+            zp, state = zopt.apply(zp, grads, state)
+        snap1 = b.metrics_snapshot()
+
+        # bf16 everywhere on the wire. Against the LOGICAL bytes the
+        # ratio is 2/3 — the compressed reduce-scatter halves its
+        # (f32-logical) phase while the bf16 allgather payload is
+        # natively narrow (tx == logical there, both already half of
+        # f32). The acceptance-shaped number is transport vs the
+        # FULL-WIDTH f32 volume the uncompressed lane would move
+        # (2 x (N-1)/N x padded x 4 per step): ~0.5.
+        from horovod_tpu.parallel.zero import zero_bucket_layout
+
+        layout = zero_bucket_layout(list(params.values()), size, 2048)
+        padded = sum(b.padded for b in layout.buckets)
+        full_f32 = 2 * 2 * (size - 1) / size * padded * 4  # 2 steps
+        tx = snap1["wire"]["tx_bytes"] - snap0["wire"]["tx_bytes"]
+        txl = (snap1["wire"]["tx_logical_bytes"]
+               - snap0["wire"]["tx_logical_bytes"])
+        assert 0.60 < tx / txl < 0.72, (tx, txl)
+        assert 0.45 < tx / full_f32 < 0.60, (tx, full_f32)
+        # Rank consistency: the decompressed params are the SAME bits
+        # on every rank (owners consume the decoded image too).
+        return [float(np.asarray(v).sum()) for v in zp.values()]
+    finally:
+        hvd.shutdown()
+
+
+def test_eager_zero_compressed_wire_halves_and_stays_consistent():
+    results = run_ranks(_worker_compressed, 4, timeout=240,
+                        env={"HOROVOD_WIRE_COMPRESSION": "1",
+                             "HOROVOD_RING_CHUNK_BYTES": "4096"})
+    assert all(r == results[0] for r in results)

@@ -154,6 +154,68 @@ def test_c5_schedule_sequence_mismatch():
     assert "deviates" in diags[0].message
 
 
+def test_c6_unpaired_reduce_scatter():
+    """The ZeRO invariant (docs/zero.md): a reduce-scatter with no
+    allgather on the same axis leaves state silently sharded — C6."""
+    def prog(x):
+        return lax.psum_scatter(x, "data", scatter_dimension=0,
+                                tiled=True)
+
+    diags = analysis.lint(prog, (jnp.ones(8),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C6"]
+    assert diags[0].severity == analysis.ERROR
+    assert "unpaired" in diags[0].message
+
+
+def test_c6_clean_when_scatter_pairs_with_gather():
+    """The ZeRO apply shape — scatter grads, update shards, gather
+    params — is exactly paired and must NOT fire; a gather on a
+    DIFFERENT axis does not count as the pair."""
+    def paired(x):
+        s = lax.psum_scatter(x, "data", scatter_dimension=0, tiled=True)
+        return lax.all_gather(s - 0.1 * s, "data", axis=0, tiled=True)
+
+    assert analysis.lint(paired, (jnp.ones(8),), axis_env=_ENV) == []
+
+    def cross_axis(x):
+        s = lax.psum_scatter(x, "data", scatter_dimension=0, tiled=True)
+        return lax.all_gather(s, "pipe", axis=0, tiled=True)
+
+    diags = analysis.lint(cross_axis, (jnp.ones(8),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C6"]
+
+
+def test_c6_gather_before_scatter_does_not_mask():
+    """Pairing is ORDERED: an FSDP-style param gather BEFORE the
+    scatter cannot reassemble the scatter's result, so a trailing
+    unpaired scatter must still fire (pure per-axis counting would be
+    blind to exactly this shape)."""
+    def prog(x):
+        g = lax.all_gather(x, "data", axis=0, tiled=True)
+        return lax.psum_scatter(g, "data", scatter_dimension=0,
+                                tiled=True)
+
+    diags = analysis.lint(prog, (jnp.ones(8),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C6"]
+    assert "unpaired" in diags[0].message
+
+
+def test_c6_counts_through_loops():
+    """Trip counts weigh in: K scatters inside a scan against one
+    gather outside is K-1 unpaired."""
+    def prog(x):
+        def step(c, _):
+            return lax.psum_scatter(c, "data", scatter_dimension=0,
+                                    tiled=True).repeat(2), None
+        c, _ = lax.scan(step, x, jnp.arange(3))
+        return lax.all_gather(c[:4], "data", axis=0, tiled=True)
+
+    diags = analysis.lint(prog, (jnp.ones(8),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C6"]
+    assert "3 reduce-scatter(s)" in diags[0].message
+    assert "only 1 subsequent allgather(s)" in diags[0].message
+
+
 def test_allowlist_suppresses_by_id_and_path():
     def prog(x):
         return lax.psum(x.astype(jnp.float32), "data")
