@@ -22,7 +22,7 @@ TSAN_OUT := horovod_tpu/lib/libhvdtpu_core_tsan.so
 ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
 .PHONY: core tf clean test test-quick lint lint-csrc core-tsan core-asan \
-  metrics-smoke zero-smoke
+  metrics-smoke zero-smoke elastic-smoke
 
 core: $(OUT)
 
@@ -108,3 +108,11 @@ metrics-smoke: core
 # horovod_tpu/jax/zero_smoke.py; ~30 s).
 zero-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.jax.zero_smoke
+
+# Elastic smoke: 2 real ranks; rank 1 is killed by deterministic fault
+# injection mid-step, rank 0 gets the typed recoverable error, re-forms
+# a 1-rank ring in place and resumes from the last commit, with the
+# fault lifecycle booked in the metrics snapshot (docs/elastic.md;
+# horovod_tpu/jax/elastic_smoke.py; ~30 s).
+elastic-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.jax.elastic_smoke

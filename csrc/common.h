@@ -83,6 +83,12 @@ enum class StatusType : int32_t {
   ABORTED = 3,
   INVALID_ARGUMENT = 4,
   IN_PROGRESS = 5,
+  // A specific peer is gone or unresponsive (EOF/RST on its socket, or
+  // no wire progress for HOROVOD_WIRE_TIMEOUT_MS). The elastic-
+  // recoverable condition: the background loop stops, records the fault
+  // at the current membership epoch, and survivors re-form the ring via
+  // hvdtpu_reinit (docs/elastic.md).
+  PEER_FAILURE = 6,
 };
 
 class Status {
@@ -101,14 +107,35 @@ class Status {
   static Status Aborted(const std::string& msg) {
     return Status(StatusType::ABORTED, msg);
   }
+  // `rank` is the GLOBAL rank this failure is attributed to (-1 when the
+  // transport cannot name one). `certain` separates PROOF from
+  // suspicion: EOF/RST/transport errors are proof the peer's process is
+  // gone (the kernel closed its sockets) — a pure stall only proves the
+  // timed-out NEIGHBOR stopped sending, and that neighbor may itself be
+  // blocked on the real casualty. The fault resolution in operations.cc
+  // combines certain attributions with a socket probe sweep so every
+  // survivor converges on the same dead set; suspected ranks are only a
+  // fallback when no proof exists anywhere (docs/elastic.md).
+  static Status PeerFailure(int rank, const std::string& msg,
+                            bool certain = false) {
+    Status s(StatusType::PEER_FAILURE, msg);
+    s.fault_rank_ = rank;
+    s.fault_certain_ = certain;
+    return s;
+  }
   bool ok() const { return type_ == StatusType::OK; }
+  bool peer_failure() const { return type_ == StatusType::PEER_FAILURE; }
   StatusType type() const { return type_; }
+  int fault_rank() const { return fault_rank_; }
+  bool fault_certain() const { return fault_certain_; }
   const std::string& reason() const { return reason_; }
 
  private:
   Status(StatusType type, std::string reason)
       : type_(type), reason_(std::move(reason)) {}
   StatusType type_ = StatusType::OK;
+  int fault_rank_ = -1;
+  bool fault_certain_ = false;
   std::string reason_;
 };
 

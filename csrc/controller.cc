@@ -12,12 +12,27 @@ namespace hvdtpu {
 
 namespace {
 
-// Hello exchanged at bootstrap: rank + data-plane listen address.
+// Hello exchanged at bootstrap: rank + data-plane listen address, plus
+// the membership epoch — the coordinator refuses hellos from any other
+// epoch, so a half-dead rank of a previous ring generation (or a
+// blacklisted straggler retrying its old assignment) can never join the
+// re-formed ring.
 struct Hello {
   int32_t rank;
+  int32_t epoch_lo;  // low/high halves keep the struct packing simple
+  int32_t epoch_hi;
   char addr[64];
   int32_t port;
 };
+
+void SetHelloEpoch(Hello* h, int64_t epoch) {
+  h->epoch_lo = (int32_t)(epoch & 0xffffffff);
+  h->epoch_hi = (int32_t)(epoch >> 32);
+}
+
+int64_t HelloEpoch(const Hello& h) {
+  return ((int64_t)h.epoch_hi << 32) | (uint32_t)h.epoch_lo;
+}
 
 bool ShapesMatch(const std::vector<int64_t>& a, const std::vector<int64_t>& b,
                  bool ignore_first_dim) {
@@ -30,6 +45,17 @@ bool ShapesMatch(const std::vector<int64_t>& a, const std::vector<int64_t>& b,
 
 // Byte size of a cached single-tensor response.
 int64_t CachedEntryBytes(const Response& r) { return ShapesTotalBytes(r); }
+
+// Scope-exit cleanup for the bootstrap's many error returns: failed
+// rendezvous attempts (reinit retries especially) must not leak the
+// data-plane listen socket or half-built peer connections.
+struct Cleanup {
+  std::function<void()> fn;
+  ~Cleanup() {
+    if (fn) fn();
+  }
+  void release() { fn = nullptr; }
+};
 
 // Shared fusion predicate for the cached and freshly-negotiated allreduce
 // paths — one site so the two fusion paths cannot diverge.
@@ -92,8 +118,27 @@ Status Controller::Initialize() {
   int data_listen = TcpListen(&data_port);
   if (data_listen < 0) return Status::Error("failed to open data-plane port");
   std::string my_addr = LocalAddress();
+  // Full-mesh peer fds, filled in step 3 (declared here so the error
+  // cleanup covers every return below; -1 entries are no-ops to close).
+  std::vector<int> peers(size, -1);
+  Cleanup cleanup{[&] {
+    TcpClose(data_listen);
+    for (int fd : peers) TcpClose(fd);
+  }};
 
-  // 2) Control-plane rendezvous + address-book broadcast.
+  // 2) Control-plane rendezvous + address-book broadcast. Bootstrap
+  // I/O runs under the start timeout (launch stragglers are expected);
+  // hellos are validated against the current epoch so stale-generation
+  // ranks are turned away at the door instead of corrupting the book.
+  const int64_t start_ms = cfg_.start_timeout_ms;
+  const auto start_deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(start_ms);
+  auto remaining_ms = [&]() -> int64_t {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    start_deadline - std::chrono::steady_clock::now())
+                    .count();
+    return left > 0 ? left : 1;  // past-deadline accepts fail fast
+  };
   std::vector<Hello> book(size);
   if (rank == 0) {
     int port = cfg_.controller_port;
@@ -103,70 +148,122 @@ Status Controller::Initialize() {
                            std::to_string(cfg_.controller_port));
     }
     control_fds_.assign(size, -1);
-    Hello mine{0, {0}, data_port};
+    Hello mine{0, 0, 0, {0}, data_port};
+    SetHelloEpoch(&mine, cfg_.epoch);
     snprintf(mine.addr, sizeof(mine.addr), "%s", my_addr.c_str());
     book[0] = mine;
-    for (int i = 1; i < size; i++) {
-      int fd = TcpAccept(lfd);
-      if (fd < 0) return Status::Error("coordinator accept failed");
+    int accepted = 0;
+    while (accepted < size - 1) {
+      // Deadline-bound: a member dying before it connects must FAIL
+      // the rendezvous (reinit returns -4), never hang the acceptor.
+      int fd = TcpAcceptTimeout(lfd, remaining_ms());
+      if (fd < 0) {
+        TcpClose(lfd);
+        return Status::Error(
+            "coordinator rendezvous timed out with " +
+            std::to_string(size - 1 - accepted) +
+            " member(s) missing (HOROVOD_START_TIMEOUT)");
+      }
       Hello h{};
-      Status s = RecvAll(fd, &h, sizeof(h));
-      if (!s.ok()) return s;
-      if (h.rank < 1 || h.rank >= size) {
-        return Status::Error("bad hello rank");
+      // remaining_ms, not the full budget: a connector that never
+      // sends its hello must not extend the rendezvous past the
+      // configured deadline.
+      Status s = RecvAll(fd, &h, sizeof(h), remaining_ms());
+      if (!s.ok()) {
+        TcpClose(fd);
+        continue;  // connector vanished mid-hello; keep waiting
+      }
+      if (HelloEpoch(h) != cfg_.epoch) {
+        LOG_WARN("rejecting hello from rank %d at stale epoch %lld "
+                 "(current %lld)",
+                 h.rank, (long long)HelloEpoch(h), (long long)cfg_.epoch);
+        TcpClose(fd);
+        continue;
+      }
+      if (h.rank < 1 || h.rank >= size || control_fds_[h.rank] != -1) {
+        LOG_WARN("rejecting bad/duplicate hello rank %d", h.rank);
+        TcpClose(fd);
+        continue;
       }
       control_fds_[h.rank] = fd;
+      RegisterFdRank(fd, h.rank);
       book[h.rank] = h;
+      accepted++;
     }
     TcpClose(lfd);
     for (int i = 1; i < size; i++) {
-      Status s = SendAll(control_fds_[i], book.data(), sizeof(Hello) * size);
+      Status s = SendAll(control_fds_[i], book.data(), sizeof(Hello) * size,
+                         remaining_ms());
       if (!s.ok()) return s;
     }
   } else {
-    int fd = TcpConnect(cfg_.controller_addr, cfg_.controller_port, 60000);
+    int fd = TcpConnect(cfg_.controller_addr, cfg_.controller_port,
+                        (int)start_ms);
     if (fd < 0) {
       return Status::Error("worker failed to reach coordinator at " +
                            cfg_.controller_addr + ":" +
                            std::to_string(cfg_.controller_port));
     }
-    Hello mine{(int32_t)rank, {0}, data_port};
+    RegisterFdRank(fd, 0);
+    Hello mine{(int32_t)rank, 0, 0, {0}, data_port};
+    SetHelloEpoch(&mine, cfg_.epoch);
     snprintf(mine.addr, sizeof(mine.addr), "%s", my_addr.c_str());
-    Status s = SendAll(fd, &mine, sizeof(mine));
-    if (!s.ok()) return s;
-    s = RecvAll(fd, book.data(), sizeof(Hello) * size);
-    if (!s.ok()) return s;
+    Status s = SendAll(fd, &mine, sizeof(mine), remaining_ms());
+    if (s.ok()) {
+      s = RecvAll(fd, book.data(), sizeof(Hello) * size, remaining_ms());
+    }
+    if (!s.ok()) {
+      TcpClose(fd);
+      return s;
+    }
     control_fds_.assign(1, fd);
   }
 
   // 3) Full-mesh data plane: rank i accepts from all j > i, connects to all
-  // j < i. Each connection is identified by a rank hello byte pair.
-  std::vector<int> peers(size, -1);
+  // j < i. Each connection is identified by a (rank, epoch) hello pair.
   for (int j = 0; j < rank; j++) {
-    int fd = TcpConnect(book[j].addr, book[j].port, 60000);
+    int fd = TcpConnect(book[j].addr, book[j].port, (int)remaining_ms());
     if (fd < 0) {
       return Status::Error("data-plane connect to rank " + std::to_string(j) +
                            " failed");
     }
-    int32_t me = rank;
-    Status s = SendAll(fd, &me, sizeof(me));
+    peers[j] = fd;  // owned by the cleanup guard from here on
+    int64_t me[2] = {(int64_t)rank, cfg_.epoch};
+    Status s = SendAll(fd, me, sizeof(me), remaining_ms());
     if (!s.ok()) return s;
-    peers[j] = fd;
+    RegisterFdRank(fd, j);
   }
-  for (int j = rank + 1; j < size; j++) {
-    int fd = TcpAccept(data_listen);
-    if (fd < 0) return Status::Error("data-plane accept failed");
-    int32_t who = -1;
-    Status s = RecvAll(fd, &who, sizeof(who));
-    if (!s.ok()) return s;
-    if (who <= rank || who >= size || peers[who] != -1) {
-      return Status::Error("bad data-plane hello");
+  int connected = 0;
+  while (connected < size - 1 - rank) {
+    int fd = TcpAcceptTimeout(data_listen, remaining_ms());
+    if (fd < 0) {
+      return Status::Error(
+          "data-plane rendezvous timed out with " +
+          std::to_string(size - 1 - rank - connected) +
+          " peer(s) missing (HOROVOD_START_TIMEOUT)");
     }
-    peers[who] = fd;
+    int64_t who[2] = {-1, -1};
+    Status s = RecvAll(fd, who, sizeof(who), remaining_ms());
+    if (!s.ok()) {
+      TcpClose(fd);
+      continue;
+    }
+    if (who[1] != cfg_.epoch || who[0] <= rank || who[0] >= size ||
+        peers[who[0]] != -1) {
+      LOG_WARN("rejecting data-plane hello from rank %lld epoch %lld",
+               (long long)who[0], (long long)who[1]);
+      TcpClose(fd);
+      continue;
+    }
+    peers[who[0]] = fd;
+    RegisterFdRank(fd, (int)who[0]);
+    connected++;
   }
+  cleanup.release();  // mesh complete: the DataPlane owns the fds now
   TcpClose(data_listen);
   data_plane_ = std::make_unique<DataPlane>(rank, size, std::move(peers));
-  LOG_DEBUG("rank %d: control+data planes up (size=%d)", rank, size);
+  LOG_DEBUG("rank %d: control+data planes up (size=%d, epoch=%lld)", rank,
+            size, (long long)cfg_.epoch);
   return Status::OK();
 }
 
@@ -725,10 +822,25 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     HandleRequestList(my_list, 0);
     *out = FuseResponses();
     out->shutdown = should_shutdown;
+    out->epoch = cfg_.epoch;
     return Status::OK();
   }
 
   RequestList my_list = BuildRequestList(std::move(requests), should_shutdown);
+  my_list.epoch = cfg_.epoch;
+  // Control-plane deadline: the per-cycle gather/bcast round IS the
+  // heartbeat (idle workers still send an empty list every cycle), so
+  // bounding each frame bounds failure detection.
+  const int64_t hb_ms = cfg_.heartbeat_timeout_ms > 0
+                            ? cfg_.heartbeat_timeout_ms
+                            : WireTimeoutMs();
+  // A worker waiting for the broadcast is implicitly waiting on EVERY
+  // other rank's frame reaching the coordinator first — the sequential
+  // gather may legitimately take up to (size-1) per-peer deadlines
+  // with benign stragglers, so the worker's recv budget scales with
+  // size (a spurious coordinator-death verdict here would tear down a
+  // healthy ring).
+  const int64_t worker_recv_ms = hb_ms <= 0 ? 0 : hb_ms * cfg_.size;
 
   if (cfg_.rank == 0) {
     round_++;
@@ -737,16 +849,31 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     HandleRequestList(my_list, 0);
     for (int r = 1; r < cfg_.size; r++) {
       std::string frame;
-      Status s = RecvFrame(control_fds_[r], &frame);
-      if (!s.ok()) return s;
+      Status s = RecvFrame(control_fds_[r], &frame, hb_ms);
       RequestList rl;
-      s = ParseRequestList(frame, &rl);
-      if (!s.ok()) return s;
+      if (s.ok()) {
+        s = ParseRequestList(frame, &rl);
+        if (s.ok() && rl.epoch != cfg_.epoch) {
+          s = Status::PeerFailure(
+              r, "rank " + std::to_string(r) + " sent a stale-epoch " +
+                     "request (epoch " + std::to_string(rl.epoch) +
+                     ", current " + std::to_string(cfg_.epoch) + ")");
+        }
+      } else if (!s.peer_failure()) {
+        s = Status::PeerFailure(r, "control-plane gather from rank " +
+                                       std::to_string(r) +
+                                       " failed: " + s.reason());
+      }
+      if (!s.ok()) {
+        BroadcastFaultNotice(s);
+        return s;
+      }
       HandleCacheBits(rl, r, &evictions);
       HandleRequestList(rl, r);
     }
     CheckForStalledTensors();
     ResponseList list;
+    list.epoch = cfg_.epoch;
     list.cache_evictions = std::move(evictions);
     // Hits must complete BEFORE FuseResponses: the all-ranks-joined cycle
     // clears joined_ranks_ there, and pending bits rely on join coverage the
@@ -764,8 +891,16 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     // then rebuilds hit responses and inserts new entries identically.
     std::string payload = SerializeResponseList(list);
     for (int r = 1; r < cfg_.size; r++) {
-      Status s = SendFrame(control_fds_[r], payload);
-      if (!s.ok()) return s;
+      Status s = SendFrame(control_fds_[r], payload, hb_ms);
+      if (!s.ok()) {
+        if (!s.peer_failure()) {
+          s = Status::PeerFailure(r, "control-plane broadcast to rank " +
+                                         std::to_string(r) +
+                                         " failed: " + s.reason());
+        }
+        BroadcastFaultNotice(s);
+        return s;
+      }
     }
     *out = std::move(list);
     ApplyCacheVerdicts(out);
@@ -773,15 +908,57 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
   }
 
   // Worker: one send + one receive per cycle (the gather/bcast round).
-  Status s = SendFrame(control_fds_[0], SerializeRequestList(my_list));
-  if (!s.ok()) return s;
-  std::string frame;
-  s = RecvFrame(control_fds_[0], &frame);
-  if (!s.ok()) return s;
-  s = ParseResponseList(frame, out);
-  if (!s.ok()) return s;
+  Status s = SendFrame(control_fds_[0], SerializeRequestList(my_list),
+                       hb_ms);
+  if (s.ok()) {
+    std::string frame;
+    s = RecvFrame(control_fds_[0], &frame, worker_recv_ms);
+    if (s.ok()) s = ParseResponseList(frame, out);
+  }
+  if (!s.ok()) {
+    // The coordinator itself is the casualty (or unreachable): a
+    // worker's only control peer is rank 0.
+    if (!s.peer_failure()) {
+      s = Status::PeerFailure(0, "control-plane round with coordinator "
+                                 "failed: " + s.reason());
+    }
+    return s;
+  }
+  if (out->epoch != cfg_.epoch) {
+    return Status::PeerFailure(
+        0, "coordinator response at stale epoch " +
+               std::to_string(out->epoch) + " (current " +
+               std::to_string(cfg_.epoch) + ")");
+  }
+  if (!out->fault_ranks.empty()) {
+    // Coordinator-relayed fault notice: fail fast with its attribution
+    // instead of waiting out our own wire deadline against the broken
+    // ring. The full set stays in out->fault_ranks for the caller.
+    return Status::PeerFailure(
+        (int)out->fault_ranks[0],
+        "coordinator reported peer failure (rank " +
+            std::to_string(out->fault_ranks[0]) + ") at epoch " +
+            std::to_string(cfg_.epoch));
+  }
   ApplyCacheVerdicts(out);
   return Status::OK();
+}
+
+void Controller::BroadcastFaultNotice(const Status& failure) {
+  // Best-effort: tell every still-reachable worker the epoch is dead so
+  // they stop within one control round instead of one wire timeout.
+  // Send errors are ignored — the target may be the casualty itself.
+  if (cfg_.rank != 0) return;
+  ResponseList notice;
+  notice.epoch = cfg_.epoch;
+  notice.fault_ranks.push_back(failure.fault_rank());
+  std::string payload = SerializeResponseList(notice);
+  for (int r = 1; r < cfg_.size; r++) {
+    if (failure.fault_rank() == r) continue;
+    // Short leash: the ring is already broken, don't stack full
+    // timeouts per peer while tearing down.
+    SendFrame(control_fds_[r], payload, /*timeout_ms=*/1000);
+  }
 }
 
 }  // namespace hvdtpu

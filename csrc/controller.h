@@ -34,6 +34,22 @@ struct ControllerConfig {
   int64_t cache_capacity = 1024;
   double stall_warning_secs = 60.0;
   bool stall_check_enabled = true;
+  // Membership epoch of this ring generation (0 = fresh init; bumped by
+  // hvdtpu_reinit). Hellos and control frames from any other epoch are
+  // rejected — the fence that keeps a half-dead previous-generation
+  // rank out of the re-formed ring (docs/elastic.md).
+  int64_t epoch = 0;
+  // Control-plane liveness deadline: every negotiation cycle doubles as
+  // a heartbeat (idle workers still send an empty RequestList each
+  // cycle), so "no control frame for this long" marks the peer dead.
+  // 0 = use HOROVOD_WIRE_TIMEOUT_MS (the common case); the separate
+  // knob (HOROVOD_HEARTBEAT_TIMEOUT_MS) lets operators detect control-
+  // plane death faster than the data-plane transfer bound.
+  int64_t heartbeat_timeout_ms = 0;
+  // Rendezvous patience at Initialize (HOROVOD_START_TIMEOUT seconds):
+  // launch stragglers are expected, so bootstrap I/O uses this instead
+  // of the steady-state wire deadline.
+  int64_t start_timeout_ms = 60000;
   // Readiness for a tensor on process set S waits only on S's members.
   // Not owned; outlives the controller (lives in GlobalState).
   const ProcessSetTable* process_sets = nullptr;
@@ -103,6 +119,12 @@ class Controller {
   ResponseList FuseResponses();
   Response BuildResponse(const std::string& name);
   void CheckForStalledTensors();  // reference: common/stall_inspector.cc
+  // Coordinator only, best-effort: push a fault-notice ResponseList
+  // (nonempty fault_ranks) to every still-reachable worker so ranks
+  // idling in the control round fail fast with the coordinator's
+  // attribution. Ranks stuck inside a data-plane transfer still detect
+  // via their own wire deadline/EOF.
+  void BroadcastFaultNotice(const Status& failure);
 
   ControllerConfig cfg_;
   std::unique_ptr<DataPlane> data_plane_;

@@ -158,6 +158,7 @@ bool ReadResponse(Reader& rd, Response* r) {
 std::string SerializeRequestList(const RequestList& list) {
   Writer w;
   w.Put<uint8_t>(list.shutdown ? 1 : 0);
+  w.Put<int64_t>(list.epoch);
   w.PutI64Vec(list.cache_hits);
   w.PutI64Vec(list.cache_invalid);
   w.Put<uint32_t>((uint32_t)list.requests.size());
@@ -170,6 +171,7 @@ Status ParseRequestList(const std::string& buf, RequestList* list) {
   uint8_t shutdown;
   if (!rd.Get(&shutdown)) return Status::Error("truncated RequestList");
   list->shutdown = shutdown != 0;
+  if (!rd.Get(&list->epoch)) return Status::Error("truncated RequestList");
   if (!rd.GetI64Vec(&list->cache_hits) ||
       !rd.GetI64Vec(&list->cache_invalid)) {
     return Status::Error("truncated RequestList");
@@ -188,6 +190,8 @@ Status ParseRequestList(const std::string& buf, RequestList* list) {
 std::string SerializeResponseList(const ResponseList& list) {
   Writer w;
   w.Put<uint8_t>(list.shutdown ? 1 : 0);
+  w.Put<int64_t>(list.epoch);
+  w.PutI64Vec(list.fault_ranks);
   w.Put<int64_t>(list.fusion_threshold_bytes);
   w.Put<double>(list.cycle_time_ms);
   w.Put<int64_t>(list.ring_chunk_bytes);
@@ -205,6 +209,9 @@ Status ParseResponseList(const std::string& buf, ResponseList* list) {
   uint8_t shutdown;
   if (!rd.Get(&shutdown)) return Status::Error("truncated ResponseList");
   list->shutdown = shutdown != 0;
+  if (!rd.Get(&list->epoch) || !rd.GetI64Vec(&list->fault_ranks)) {
+    return Status::Error("truncated ResponseList");
+  }
   if (!rd.Get(&list->fusion_threshold_bytes) ||
       !rd.Get(&list->cycle_time_ms)) {
     return Status::Error("truncated ResponseList");

@@ -117,6 +117,11 @@ inline int64_t ShapesTotalBytes(const Response& r) {
 struct RequestList {
   std::vector<Request> requests;
   bool shutdown = false;
+  // Membership epoch this worker believes it is in. The coordinator
+  // rejects frames from any other epoch, so a half-dead rank from a
+  // previous ring generation cannot poison the re-formed ring
+  // (docs/elastic.md). Bumped by hvdtpu_reinit; 0 for a fresh init.
+  int64_t epoch = 0;
   // Response-cache bitvector: positions (in the shared cache order) of
   // cache-hit tensors ready this cycle. Reference analog:
   // horovod/common/response_cache.cc CacheCoordinator bit vectors.
@@ -131,6 +136,14 @@ struct RequestList {
 struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
+  // Mirrors RequestList::epoch: workers reject responses from a stale
+  // epoch the same way the coordinator fences stale requests.
+  int64_t epoch = 0;
+  // Nonempty = fault notice: the coordinator detected these (global)
+  // ranks dead/unresponsive and is tearing this epoch down. Workers
+  // stop their loop with a typed PeerFailure instead of waiting out
+  // their own wire timeout against the broken ring.
+  std::vector<int64_t> fault_ranks;
   // Autotuned runtime knobs, pushed coordinator -> workers (0 = unset).
   // Reference analog: parameter_manager.cc values synced via the controller.
   int64_t fusion_threshold_bytes = 0;

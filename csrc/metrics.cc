@@ -167,6 +167,10 @@ void Metrics::Reset() {
   queue_us.Reset();
   wire_us.Reset();
   straggler_skew_us.Reset();
+  fault_detect_us.Reset();
+  faults_detected.store(0);
+  faults_recovered.store(0);
+  ranks_blacklisted.store(0);
   cycles.store(0);
   cycle_stalls.store(0);
   cycle_overrun_us.store(0);
@@ -235,14 +239,24 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
          (long long)wtx, (long long)wrx, (long long)wtxl, (long long)wrxl,
          wtxl > 0 ? (double)wtx / (double)wtxl : 1.0);
 
+  Append(out, "\"elastic\":{\"epoch\":%lld,\"faults_detected\":%lld,"
+              "\"faults_recovered\":%lld,\"ranks_blacklisted\":%lld,"
+              "\"detect_us\":",
+         (long long)info.epoch,
+         (long long)faults_detected.load(std::memory_order_relaxed),
+         (long long)faults_recovered.load(std::memory_order_relaxed),
+         (long long)ranks_blacklisted.load(std::memory_order_relaxed));
+  out += fault_detect_us.Json() + "},";
+
   Append(out, "\"errors\":%lld,",
          (long long)errors.load(std::memory_order_relaxed));
   Append(out, "\"knobs\":{\"fusion_threshold_bytes\":%lld,"
               "\"cycle_time_ms\":%.6f,\"ring_chunk_bytes\":%lld,"
-              "\"wire_compression\":%s}}",
+              "\"wire_compression\":%s,\"wire_timeout_ms\":%lld}}",
          (long long)info.fusion_threshold_bytes, info.cycle_time_ms,
          (long long)info.ring_chunk_bytes,
-         info.wire_compression ? "true" : "false");
+         info.wire_compression ? "true" : "false",
+         (long long)info.wire_timeout_ms);
   return out;
 }
 

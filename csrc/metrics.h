@@ -72,6 +72,9 @@ class Metrics {
   LatencyHistogram queue_us;        // tensor enqueue -> execution start
   LatencyHistogram wire_us;         // one host transport call (ring span)
   LatencyHistogram straggler_skew_us;  // coordinator: first->last arrival
+  // Elastic: how long the failing operation ran before the typed
+  // PeerFailure surfaced (EOF ~ instant; stalls ~ the wire deadline).
+  LatencyHistogram fault_detect_us;
 
   std::atomic<int64_t> cycles{0};
   std::atomic<int64_t> cycle_stalls{0};      // loop overran its budget
@@ -82,6 +85,13 @@ class Metrics {
   std::atomic<int64_t> fusion_capacity_bytes{0};  // threshold at pack time
 
   std::atomic<int64_t> errors{0};  // ERROR responses surfaced
+
+  // Elastic fault accounting (docs/elastic.md): faults the loop stopped
+  // on, successful ring re-formations (hvdtpu_reinit), and ranks fenced
+  // out of re-formed rings (dead peers dropped at an epoch bump).
+  std::atomic<int64_t> faults_detected{0};
+  std::atomic<int64_t> faults_recovered{0};
+  std::atomic<int64_t> ranks_blacklisted{0};
 
   // Host-ring transport accounting, kept SEPARATE from the per-op-class
   // logical payload bytes above: `wire_*_bytes` is what actually
@@ -110,6 +120,8 @@ class Metrics {
     double cycle_time_ms = 0;
     int64_t ring_chunk_bytes = 0;
     bool wire_compression = false;
+    int64_t wire_timeout_ms = 0;
+    int64_t epoch = 0;  // current membership epoch (bumped by reinit)
     int64_t cache_hits = 0, cache_misses = 0, cache_entries = 0;
     int64_t cache_hit_bytes = 0;
   };

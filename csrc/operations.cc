@@ -7,6 +7,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <csignal>
 #include <unordered_set>
 #include <condition_variable>
 #include <cstring>
@@ -122,10 +123,40 @@ struct GlobalState {
   std::atomic<bool> initialized{false};
   std::atomic<bool> shutdown_requested{false};
   std::atomic<bool> loop_exited{false};
-  // Loop exited because of a control-plane failure (peer lost) rather
-  // than a requested shutdown — enqueue failures in this state are the
-  // elastic-recoverable condition (HorovodInternalError in Python).
+  // Loop exited because of a control- or data-plane failure (peer
+  // lost) rather than a requested shutdown — enqueue failures in this
+  // state are the elastic-recoverable condition (HorovodInternalError
+  // in Python; details via hvdtpu_last_fault).
   std::atomic<bool> loop_failed{false};
+  // Membership epoch of the current ring generation: 0 at init, bumped
+  // by every hvdtpu_reinit. Stale-epoch traffic is fenced out by the
+  // controller (docs/elastic.md).
+  std::atomic<int64_t> epoch{0};
+  int base_controller_port = 29500;  // epoch e listens on base + e
+  // Last fault record, written once by the background loop when it
+  // stops on a peer failure; read by hvdtpu_last_fault from API
+  // threads. fault_ranks holds GLOBAL ranks (current numbering).
+  std::mutex fault_mutex;
+  bool faulted = false;
+  bool fault_recovered = false;
+  // True when every recorded rank is PROVABLY dead (EOF/RST, probe
+  // sweep, coordinator notice) — the precondition for survivors to
+  // agree on a survivor set without a coordinator. False = the record
+  // holds only a timeout suspicion; recovery must go through the
+  // driver (or full re-init), never driver-less reinit.
+  bool fault_certain = false;
+  int64_t fault_epoch = 0;
+  std::vector<int32_t> fault_ranks;
+  std::string fault_reason;
+  int64_t fault_detect_us = 0;
+  // Deterministic fault injection (HOROVOD_FAULT_INJECT="rank:op"):
+  // when this rank's op_counter reaches inject_op it dies by SIGKILL at
+  // the top of that collective's execution — the chaos-lane primitive.
+  // One-shot per ring generation (cleared at reinit so a renumbered
+  // survivor can never inherit the victim's trigger).
+  std::atomic<int32_t> inject_rank{-1};
+  std::atomic<int64_t> inject_op{-1};
+  std::atomic<int64_t> op_counter{0};  // executed collective responses
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
   std::atomic<int64_t> fusion_threshold{64 * 1024 * 1024};
@@ -159,6 +190,33 @@ struct GlobalState {
 
 GlobalState* g_state = nullptr;
 std::mutex g_init_mutex;
+
+// ONE construction site for the controller config, shared by init and
+// reinit so a knob added to one can never silently diverge in the
+// other (a re-formed ring must behave exactly like a fresh one).
+ControllerConfig MakeControllerConfig(GlobalState& st, int rank, int size,
+                                      int64_t epoch, int port) {
+  ControllerConfig cfg;
+  cfg.rank = rank;
+  cfg.size = size;
+  cfg.process_sets = st.process_sets.get();
+  cfg.controller_addr = EnvStr("HOROVOD_CONTROLLER_ADDR", "127.0.0.1");
+  cfg.controller_port = port;
+  cfg.fusion_threshold_bytes = st.fusion_threshold;
+  cfg.cache_capacity = EnvInt64("HOROVOD_CACHE_CAPACITY", 1024);
+  cfg.stall_warning_secs = EnvDouble("HOROVOD_STALL_CHECK_TIME", 60.0);
+  cfg.stall_check_enabled =
+      EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) == 0;
+  cfg.epoch = epoch;
+  cfg.heartbeat_timeout_ms = EnvInt64("HOROVOD_HEARTBEAT_TIMEOUT_MS", 0);
+  cfg.start_timeout_ms =
+      (int64_t)(EnvDouble("HOROVOD_START_TIMEOUT", 60.0) * 1000.0);
+  // HOROVOD_CONTROLLER=mpi: zero-TCP mode — control negotiation AND
+  // ring data ride the registered external transport (mpi4py
+  // point-to-point; the frontend registers callbacks before init).
+  cfg.use_external_transport = EnvStr("HOROVOD_CONTROLLER", "") == "mpi";
+  return cfg;
+}
 
 DataType ToDataType(int dtype) { return (DataType)dtype; }
 
@@ -597,7 +655,71 @@ void AccountResponse(const Response& response,
   if (!status.ok()) m.errors.fetch_add(1, std::memory_order_relaxed);
 }
 
-void ExecuteResponse(GlobalState& st, const Response& response) {
+// Write the fault record + metrics once the loop decides to stop on a
+// peer failure. Attribution = the typed status's rank, any ranks the
+// coordinator's fault notice named, plus a liveness probe over every
+// data-plane socket (SIGKILLed peers show EOF on all their fds, so
+// every survivor converges on the same dead set — the agreement the
+// driver-less re-formation path in common/elastic.py relies on).
+void RecordFault(GlobalState& st, const Status& s,
+                 const std::vector<int64_t>& notice_ranks,
+                 int64_t detect_us) {
+  // PROOF first: coordinator notices, certain (EOF/RST) attributions,
+  // and the socket probe sweep all name provably-dead processes, so
+  // every survivor converges on the same set. A timeout's SUSPECTED
+  // rank joins only when no proof exists anywhere — it may merely be a
+  // live neighbor blocked on the real casualty, and mixing it with
+  // proof would give survivors inconsistent survivor sets.
+  std::vector<int32_t> ranks;
+  for (int64_t r : notice_ranks) {
+    if (r >= 0) ranks.push_back((int32_t)r);
+  }
+  if (s.fault_rank() >= 0 && s.fault_certain()) {
+    ranks.push_back(s.fault_rank());
+  }
+  if (st.controller && st.controller->data_plane()) {
+    for (int32_t r : st.controller->data_plane()->ProbeDeadPeers()) {
+      ranks.push_back(r);
+    }
+  }
+  bool certain = !ranks.empty();
+  if (ranks.empty() && s.fault_rank() >= 0) {
+    ranks.push_back(s.fault_rank());  // best-effort fallback, suspicion
+  }
+  std::sort(ranks.begin(), ranks.end());
+  ranks.erase(std::unique(ranks.begin(), ranks.end()), ranks.end());
+  {
+    std::lock_guard<std::mutex> lk(st.fault_mutex);
+    st.faulted = true;
+    st.fault_recovered = false;
+    st.fault_certain = certain;
+    st.fault_epoch = st.epoch.load();
+    st.fault_ranks = ranks;
+    st.fault_reason = s.reason();
+    st.fault_detect_us = detect_us;
+  }
+  Metrics& m = GlobalMetrics();
+  m.faults_detected.fetch_add(1, std::memory_order_relaxed);
+  m.fault_detect_us.Record(detect_us);
+}
+
+// HOROVOD_FAULT_INJECT: die by SIGKILL at the top of the inject_op-th
+// executed collective on the matching rank. Responses are negotiated
+// identically on every rank, so the counter indexes the same collective
+// everywhere — the precision the chaos lane needs. Counted classes:
+// everything that executes (JOIN bookkeeping and ERROR verdicts are
+// skipped on every rank alike).
+void MaybeInjectFault(GlobalState& st) {
+  int64_t idx = st.op_counter.fetch_add(1, std::memory_order_relaxed);
+  if (st.inject_rank.load(std::memory_order_relaxed) == st.rank &&
+      st.inject_op.load(std::memory_order_relaxed) == idx) {
+    LOG_WARN("HOROVOD_FAULT_INJECT: rank %d dying at collective %lld",
+             st.rank, (long long)idx);
+    raise(SIGKILL);
+  }
+}
+
+Status ExecuteResponse(GlobalState& st, const Response& response) {
   if (response.response_type == Response::ResponseType::JOIN) {
     auto join_entries = st.tensor_queue.GetTensorEntriesFromResponse(response);
     st.last_joined_rank = response.last_joined_rank;
@@ -607,8 +729,12 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
       st.timeline.EntryDone(e.name);
       st.handles.MarkDone(e.handle, ok, &e);
     }
-    return;
+    return ok;
   }
+  if (response.response_type != Response::ResponseType::ERROR) {
+    MaybeInjectFault(st);
+  }
+  const int64_t exec_start_us = MetricsNowUs();
   // Resolve the data plane for this response's process set BEFORE touching
   // the local tensor queue: non-members get the broadcast ResponseList too,
   // and a same-named tensor of a different set may be in their queue.
@@ -628,7 +754,7 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
       for (int32_t r : members) member = member || r == st.rank;
       if (!member) {
         // Not a participant: nothing to execute, nothing to resolve.
-        return;
+        return Status::OK();
       }
       sub = dp->Subset(members);
       dp = &sub;
@@ -671,10 +797,18 @@ void ExecuteResponse(GlobalState& st, const Response& response) {
     }
   }
   AccountResponse(response, entries, status);
+  if (status.peer_failure()) {
+    // Record the fault BEFORE any handle wakes an API thread: the
+    // Python error path reads hvdtpu_last_fault to type the exception,
+    // so the record must already exist when synchronize() returns.
+    RecordFault(st, status, {}, MetricsNowUs() - exec_start_us);
+    st.loop_failed = true;
+  }
   for (auto& e : entries) {
     st.timeline.EntryDone(e.name);
     st.handles.MarkDone(e.handle, status, &e);
   }
+  return status;
 }
 
 // Payload bytes a response moves (autotune scoring input).
@@ -705,6 +839,12 @@ void BackgroundThreadLoop(GlobalState& st) {
     }
     if (!s.ok()) {
       LOG_ERROR("control plane failure: %s", s.reason().c_str());
+      if (s.peer_failure()) {
+        // fault_ranks rides the coordinator's fault notice when one was
+        // received; detection latency = how long this round stalled.
+        RecordFault(st, s, response_list.fault_ranks,
+                    MetricsNowUs() - negotiate_start_us);
+      }
       st.loop_failed = true;
       auto orphans = st.tensor_queue.RemoveAllEntries();
       for (auto& e : orphans) st.handles.MarkDone(e.handle, s, nullptr);
@@ -729,11 +869,25 @@ void BackgroundThreadLoop(GlobalState& st) {
       SetWireCompression(response_list.wire_compression != 0);
     }
     int64_t cycle_bytes = 0;
+    bool faulted = false;
     for (auto& response : response_list.responses) {
       for (auto& n : response.tensor_names) st.timeline.NegotiateEnd(n);
-      ExecuteResponse(st, response);
+      Status es = ExecuteResponse(st, response);
       cycle_bytes += ResponseBytes(response);
+      if (es.peer_failure()) {
+        // A peer died mid-collective: the ring is unrecoverable at this
+        // epoch. ExecuteResponse already recorded the fault (before any
+        // handle woke an API thread); drain everything still pending
+        // with the typed status (no caller may hang) and stop —
+        // survivors re-form via hvdtpu_reinit (docs/elastic.md).
+        LOG_ERROR("data plane peer failure: %s", es.reason().c_str());
+        auto orphans = st.tensor_queue.RemoveAllEntries();
+        for (auto& e : orphans) st.handles.MarkDone(e.handle, es, nullptr);
+        faulted = true;
+        break;
+      }
     }
+    if (faulted) break;
     if (st.rank == 0 && st.param_manager &&
         st.param_manager->Update(cycle_bytes)) {
       st.fusion_threshold = st.param_manager->fusion_threshold_bytes();
@@ -841,24 +995,55 @@ int hvdtpu_init() {
   SetRingChunkBytes(
       EnvInt64("HOROVOD_RING_CHUNK_BYTES", kDefaultRingChunkBytes));
   SetWireCompression(EnvInt64("HOROVOD_WIRE_COMPRESSION", 0) != 0);
+  SetWireTimeoutMs(
+      EnvInt64("HOROVOD_WIRE_TIMEOUT_MS", kDefaultWireTimeoutMs));
+
+  // Fresh world: epoch 0, no fault on record, injection from env.
+  st->epoch = 0;
+  st->op_counter = 0;
+  {
+    std::lock_guard<std::mutex> lk(st->fault_mutex);
+    st->faulted = false;
+    st->fault_recovered = false;
+    st->fault_certain = false;
+    st->fault_ranks.clear();
+    st->fault_reason.clear();
+  }
+  st->inject_rank = -1;
+  st->inject_op = -1;
+  {
+    // HOROVOD_FAULT_INJECT="<rank>:<op_index>": deterministic chaos —
+    // that rank SIGKILLs itself at the top of its op_index-th executed
+    // collective (docs/elastic.md). Strictly parsed: a malformed spec
+    // must stay DISARMED (a lenient strtol would read garbage as 0:0
+    // and kill rank 0 at its first collective).
+    std::string spec = EnvStr("HOROVOD_FAULT_INJECT", "");
+    size_t colon = spec.find(':');
+    if (colon != std::string::npos) {
+      char* end1 = nullptr;
+      char* end2 = nullptr;
+      long rank_v = strtol(spec.c_str(), &end1, 10);
+      long long op_v = strtoll(spec.c_str() + colon + 1, &end2, 10);
+      if (end1 == spec.c_str() + colon && end2 != nullptr &&
+          *end2 == '\0' && rank_v >= 0 && op_v >= 0) {
+        st->inject_rank = (int32_t)rank_v;
+        st->inject_op = op_v;
+      } else {
+        LOG_WARN("ignoring malformed HOROVOD_FAULT_INJECT=%s "
+                 "(expected <rank>:<op_index>)", spec.c_str());
+      }
+    } else if (!spec.empty()) {
+      LOG_WARN("ignoring malformed HOROVOD_FAULT_INJECT=%s "
+               "(expected <rank>:<op_index>)", spec.c_str());
+    }
+  }
 
   st->process_sets = std::make_unique<ProcessSetTable>(st->size);
 
-  ControllerConfig cfg;
-  cfg.rank = st->rank;
-  cfg.size = st->size;
-  cfg.process_sets = st->process_sets.get();
-  cfg.controller_addr = EnvStr("HOROVOD_CONTROLLER_ADDR", "127.0.0.1");
-  cfg.controller_port = (int)EnvInt64("HOROVOD_CONTROLLER_PORT", 29500);
-  cfg.fusion_threshold_bytes = st->fusion_threshold;
-  cfg.cache_capacity = EnvInt64("HOROVOD_CACHE_CAPACITY", 1024);
-  cfg.stall_warning_secs = EnvDouble("HOROVOD_STALL_CHECK_TIME", 60.0);
-  cfg.stall_check_enabled =
-      EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) == 0;
-  // HOROVOD_CONTROLLER=mpi: zero-TCP mode — control negotiation AND
-  // ring data ride the registered external transport (mpi4py
-  // point-to-point; the frontend registers callbacks before init).
-  cfg.use_external_transport = EnvStr("HOROVOD_CONTROLLER", "") == "mpi";
+  st->base_controller_port =
+      (int)EnvInt64("HOROVOD_CONTROLLER_PORT", 29500);
+  ControllerConfig cfg = MakeControllerConfig(
+      *st, st->rank, st->size, /*epoch=*/0, st->base_controller_port);
   st->controller = std::make_unique<Controller>(cfg);
   Status s = st->controller->Initialize();
   if (!s.ok()) {
@@ -931,6 +1116,198 @@ int hvdtpu_init() {
 
 int hvdtpu_loop_failed() {
   return (g_state != nullptr && g_state->loop_failed.load()) ? 1 : 0;
+}
+
+int64_t hvdtpu_epoch() {
+  return g_state != nullptr ? g_state->epoch.load() : 0;
+}
+
+// Wire progress deadline (HOROVOD_WIRE_TIMEOUT_MS): process-global like
+// the ring knobs, valid before init. <= 0 disables the deadline.
+int64_t hvdtpu_wire_timeout_ms() { return WireTimeoutMs(); }
+
+void hvdtpu_set_wire_timeout_ms(int64_t ms) { SetWireTimeoutMs(ms); }
+
+// Runtime fault-injection arm/disarm (the env knob's programmatic twin;
+// rank < 0 disarms). Exposed through basics.py for the chaos tests.
+int hvdtpu_set_fault_inject(int rank, int64_t op_index) {
+  if (g_state == nullptr) return -1;
+  g_state->inject_rank = rank;
+  g_state->inject_op = op_index;
+  return 0;
+}
+
+static void JsonEscapeInto(std::string& out, const std::string& s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\') out += '\\';
+    if ((unsigned char)c < 0x20) {
+      out += ' ';
+      continue;
+    }
+    out += c;
+  }
+}
+
+// Last fault record as JSON, two-call pattern like the metrics
+// snapshot: {"faulted":bool} or {"faulted":true,"epoch":E,
+// "ranks":[...],"reason":"...","detect_ms":D,"recovered":bool}.
+// "ranks" are GLOBAL ranks in the numbering of the epoch that faulted.
+int64_t hvdtpu_last_fault(char* buf, int64_t cap) {
+  std::string json;
+  if (g_state == nullptr) {
+    json = "{\"faulted\":false}";
+  } else {
+    std::lock_guard<std::mutex> lk(g_state->fault_mutex);
+    if (!g_state->faulted) {
+      json = "{\"faulted\":false}";
+    } else {
+      json = "{\"faulted\":true,\"epoch\":" +
+             std::to_string(g_state->fault_epoch) + ",\"ranks\":[";
+      for (size_t i = 0; i < g_state->fault_ranks.size(); i++) {
+        if (i) json += ',';
+        json += std::to_string(g_state->fault_ranks[i]);
+      }
+      json += "],\"certain\":";
+      json += g_state->fault_certain ? "true" : "false";
+      json += ",\"reason\":\"";
+      JsonEscapeInto(json, g_state->fault_reason);
+      json += "\",\"detect_ms\":" +
+              std::to_string(g_state->fault_detect_us / 1000) +
+              ",\"recovered\":" +
+              (g_state->fault_recovered ? "true" : "false") + "}";
+    }
+  }
+  if (buf != nullptr && cap > 0) {
+    int64_t n = std::min<int64_t>((int64_t)json.size(), cap - 1);
+    std::memcpy(buf, json.data(), (size_t)n);
+    buf[n] = '\0';
+  }
+  return (int64_t)json.size();
+}
+
+// Re-form the ring over `ranks` (OLD global rank numbers, every member
+// listing them identically) at membership epoch `epoch` WITHOUT process
+// restart: rejoin the dead loop, rebuild controller + full-mesh data
+// plane among survivors (the N-1 ring reuses the same ring_ops.h
+// rotation helpers, so results are bit-identical to a fresh N-1 world),
+// and fence the old generation out via the epoch (stale hellos and
+// frames are rejected; epoch e rendezvouses on base_port + e so the
+// half-dead stragglers' retries knock on a dead door). Returns 0 on
+// success; -1 bad args / not initialized, -2 loop still healthy (only a
+// faulted or exited loop may re-form), -3 this rank is not a survivor,
+// -4 re-formation rendezvous failed.
+int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
+  std::lock_guard<std::mutex> lk(g_init_mutex);
+  if (g_state == nullptr || !g_state->initialized.load() ||
+      ranks == nullptr || nranks <= 0) {
+    return -1;
+  }
+  GlobalState* st = g_state;
+  if (!st->loop_failed.load() && !st->loop_exited.load()) return -2;
+  if (EnvStr("HOROVOD_CONTROLLER", "") == "mpi") {
+    // External-transport fds encode the launcher's fixed peer ranks;
+    // an in-process renumbering would address the wrong mailboxes (and
+    // an MPI world cannot shrink anyway). Recover through the driver.
+    LOG_ERROR("reinit is not supported on the external (MPI) "
+              "transport; use the elastic driver path");
+    return -5;
+  }
+  int new_rank = -1;
+  for (int i = 0; i < nranks; i++) {
+    if (ranks[i] == st->rank) new_rank = i;
+  }
+  if (new_rank < 0) return -3;  // this rank was declared dead
+  if (st->background_thread.joinable()) st->background_thread.join();
+  const int old_size = st->size;
+  const int old_rank = st->rank;
+  const int old_local_rank = st->local_rank;
+  const int old_local_size = st->local_size;
+  const int old_cross_rank = st->cross_rank;
+  const int old_cross_size = st->cross_size;
+  const bool old_hierarchical = st->hierarchical;
+  const int64_t old_epoch = st->epoch.load();
+  // Keep the old generation's sockets OPEN until the new ring is up:
+  // closing them now would feed other survivors an EOF on a live
+  // rank's fd while they are still classifying their own failure —
+  // they would blame this rank and re-form a smaller (wrong) world.
+  // The re-formation rendezvous only completes once every survivor has
+  // connected (i.e. has finished recording its fault), so deferring
+  // the close past Initialize() makes the teardown unobservable. The
+  // old process-set table must outlive it too (the old controller
+  // holds a non-owning pointer).
+  std::unique_ptr<Controller> old_controller = std::move(st->controller);
+  std::unique_ptr<ProcessSetTable> old_process_sets =
+      std::move(st->process_sets);
+  st->rank = new_rank;
+  st->size = nranks;
+  // Post-reformation layout is flat: host-locality bookkeeping from the
+  // launcher no longer matches the renumbered world, and hierarchical
+  // allreduce requires it — the driver path (full re-rendezvous)
+  // restores locality-aware layouts.
+  st->local_rank = new_rank;
+  st->local_size = nranks;
+  st->cross_rank = 0;
+  st->cross_size = 1;
+  st->hierarchical = false;
+  st->epoch = epoch;
+  st->joined = false;
+  st->last_joined_rank = -1;
+  g_next_group_id = 0;
+  st->op_counter = 0;
+  st->inject_rank = -1;  // one-shot: a renumbered survivor must never
+  st->inject_op = -1;    // inherit the dead rank's trigger
+  {
+    std::lock_guard<std::mutex> blk(st->barrier_mutex);
+    st->barrier_counters.clear();
+  }
+  // The old world's process sets name dead ranks in dead numbering;
+  // Python-side ProcessSet objects must be re-registered.
+  st->process_sets = std::make_unique<ProcessSetTable>(nranks);
+
+  ControllerConfig cfg = MakeControllerConfig(
+      *st, new_rank, nranks, epoch,
+      st->base_controller_port + (int)(epoch % 512));
+  st->controller = std::make_unique<Controller>(cfg);
+  Status s = st->controller->Initialize();
+  if (!s.ok()) {
+    LOG_ERROR("reinit failed at epoch %lld: %s", (long long)epoch,
+              s.reason().c_str());
+    // Restore the old (dead) world wholesale — controller, process
+    // sets, identity, epoch — so metrics reads stay safe and a
+    // follow-up driver-path recovery sees the pre-attempt state.
+    st->controller = std::move(old_controller);
+    st->process_sets = std::move(old_process_sets);
+    st->rank = old_rank;
+    st->size = old_size;
+    st->local_rank = old_local_rank;
+    st->local_size = old_local_size;
+    st->cross_rank = old_cross_rank;
+    st->cross_size = old_cross_size;
+    st->hierarchical = old_hierarchical;
+    st->epoch = old_epoch;
+    return -4;
+  }
+  old_controller.reset();  // the new ring is up; now drop the old fds
+  old_process_sets.reset();
+  {
+    std::lock_guard<std::mutex> flk(st->fault_mutex);
+    st->fault_recovered = true;
+  }
+  {
+    Metrics& m = GlobalMetrics();
+    m.faults_recovered.fetch_add(1, std::memory_order_relaxed);
+    if (old_size > nranks) {
+      m.ranks_blacklisted.fetch_add(old_size - nranks,
+                                    std::memory_order_relaxed);
+    }
+  }
+  st->shutdown_requested = false;
+  st->loop_exited = false;
+  st->loop_failed = false;
+  st->background_thread = std::thread(BackgroundThreadLoop, std::ref(*st));
+  LOG_INFO("re-formed ring: rank %d/%d at epoch %lld", new_rank, nranks,
+           (long long)epoch);
+  return 0;
 }
 
 int hvdtpu_shutdown() {
@@ -1427,6 +1804,8 @@ int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap) {
       info.cycle_time_ms = g_state->cycle_time_ms.load();
       info.ring_chunk_bytes = RingChunkBytes();
       info.wire_compression = WireCompression();
+      info.wire_timeout_ms = WireTimeoutMs();
+      info.epoch = g_state->epoch.load();
       const ResponseCache& c = g_state->controller->response_cache();
       info.cache_hits = c.hits();
       info.cache_misses = c.misses();

@@ -16,9 +16,30 @@ extern "C" {
 int hvdtpu_init();
 int hvdtpu_shutdown();
 int hvdtpu_is_initialized();
-// 1 when the background loop exited on a control-plane failure (peer
-// lost) — the elastic-recoverable state; 0 otherwise.
+// 1 when the background loop exited on a control- or data-plane
+// failure (peer lost) — the elastic-recoverable state; 0 otherwise.
 int hvdtpu_loop_failed();
+
+// ---- elastic fault surface (docs/elastic.md) ------------------------
+// Membership epoch of the current ring generation (0 = fresh init;
+// bumped by hvdtpu_reinit). Stale-epoch traffic is fenced out.
+int64_t hvdtpu_epoch();
+// Last fault record as JSON, two-call pattern like the metrics
+// snapshot: (nullptr, 0) sizes it, a second call copies NUL-terminated.
+// {"faulted":false} until the loop has stopped on a peer failure.
+int64_t hvdtpu_last_fault(char* buf, int64_t cap);
+// Re-form the ring over the surviving OLD ranks at a new epoch without
+// process restart. Collective among survivors; requires a faulted (or
+// exited) loop. 0 on success, negative codes in operations.cc.
+int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch);
+// Wire progress deadline (HOROVOD_WIRE_TIMEOUT_MS; <= 0 disables).
+// Process-global, valid before init, like the ring knobs.
+int64_t hvdtpu_wire_timeout_ms();
+void hvdtpu_set_wire_timeout_ms(int64_t ms);
+// Deterministic fault injection (HOROVOD_FAULT_INJECT's programmatic
+// twin): `rank` SIGKILLs itself at its op_index-th executed collective.
+// rank < 0 disarms. One-shot per ring generation.
+int hvdtpu_set_fault_inject(int rank, int64_t op_index);
 int hvdtpu_rank();
 int hvdtpu_size();
 int hvdtpu_local_rank();

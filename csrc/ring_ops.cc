@@ -1,5 +1,9 @@
 #include "ring_ops.h"
 
+#include <errno.h>
+#include <poll.h>
+#include <sys/socket.h>
+
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
@@ -305,6 +309,42 @@ DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds,
       owns_fds_(owns_fds), worker_(std::make_shared<ReduceWorker>()) {
   global_ranks_.resize(size_);
   for (int i = 0; i < size_; i++) global_ranks_[i] = i;
+  if (owns_fds_) {
+    // Peer attribution for wire timeouts/EOF (see wire.h). Subset views
+    // share fds the root already registered with GLOBAL ranks. The fd
+    // table may be empty (placeholder planes for unknown process sets).
+    for (size_t i = 0; i < peer_fds_.size(); i++) {
+      if (peer_fds_[i] >= 0) RegisterFdRank(peer_fds_[i], (int)i);
+    }
+  }
+}
+
+std::vector<int32_t> DataPlane::ProbeDeadPeers() const {
+  std::vector<int32_t> dead;
+  for (int i = 0; i < (int)peer_fds_.size() && i < size_; i++) {
+    int fd = peer_fds_[i];
+    if (fd < 0 || i == rank_) continue;  // self / external / absent
+    pollfd p{};
+    p.fd = fd;
+    p.events = POLLIN;
+    int rc = poll(&p, 1, 0);
+    if (rc <= 0) continue;  // no events pending -> no evidence of death
+    if (p.revents & (POLLERR | POLLNVAL)) {
+      dead.push_back(global_ranks_[i]);
+      continue;
+    }
+    if (p.revents & (POLLIN | POLLHUP)) {
+      // Distinguish EOF from pending (stale) ring bytes without
+      // consuming them: a live-but-stalled peer's stream peeks > 0.
+      char probe;
+      ssize_t n = recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
+      if (n == 0 || (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                     errno != EINTR)) {
+        dead.push_back(global_ranks_[i]);
+      }
+    }
+  }
+  return dead;
 }
 
 DataPlane::~DataPlane() {

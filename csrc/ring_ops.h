@@ -142,6 +142,16 @@ class DataPlane {
 
   Status Barrier();
 
+  // Fault sweep (elastic): poll every TCP peer fd for EOF/RST without
+  // consuming ring bytes (MSG_PEEK) and return the GLOBAL ranks whose
+  // processes are provably gone — the kernel closes a SIGKILLed peer's
+  // sockets, so every survivor sees the same dead set and can agree on
+  // the N-1 membership without a coordinator round (docs/elastic.md).
+  // Silent failures (partition, SIGSTOP) do not show here; those are
+  // only caught by the wire deadline with neighbor-level attribution.
+  // External (message-transport) fds cannot be probed and are skipped.
+  std::vector<int32_t> ProbeDeadPeers() const;
+
   int rank() const { return rank_; }
   int size() const { return size_; }
 

@@ -11,9 +11,13 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <chrono>
+#include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <thread>
+#include <unordered_map>
 
 namespace hvdtpu {
 
@@ -21,12 +25,28 @@ namespace {
 ExternalSendFn g_ext_send = nullptr;
 ExternalRecvFn g_ext_recv = nullptr;
 
+// Wire progress deadline (see wire.h). -1 in the atomic = not yet
+// initialized from env; first reader folds HOROVOD_WIRE_TIMEOUT_MS in,
+// so the ring selftest and other pre-init paths honor the knob too.
+std::atomic<int64_t> g_wire_timeout_ms{-1};
+
+// fd -> global rank, for peer attribution in timeout/EOF statuses.
+// Registered by the controller (control fds) and the root data plane;
+// small and cold (touched at plane setup and on failure paths only).
+std::mutex g_fd_rank_mutex;
+std::unordered_map<int, int> g_fd_ranks;
+
+// External-transport failures name the peer directly from the fd
+// encoding: a callback error means that peer's mailbox is gone.
 Status ExtSend(int fd, const void* buf, size_t len) {
   if (!g_ext_send) return Status::Error("external transport not set");
   int rc = g_ext_send(ExtFdPeer(fd), ExtFdTag(fd), buf, (long long)len);
   if (rc != 0) {
-    return Status::Error("external transport send failed rc=" +
-                         std::to_string(rc));
+    return Status::PeerFailure(
+        ExtFdPeer(fd), "external transport send to rank " +
+                           std::to_string(ExtFdPeer(fd)) +
+                           " failed rc=" + std::to_string(rc),
+        /*certain=*/true);
   }
   return Status::OK();
 }
@@ -38,7 +58,12 @@ Status ExtRecvExact(int fd, void* buf, size_t len) {
   if (!g_ext_recv) return Status::Error("external transport not set");
   long long got = g_ext_recv(ExtFdPeer(fd), ExtFdTag(fd), buf,
                              (long long)len);
-  if (got < 0) return Status::Error("external transport recv failed");
+  if (got < 0) {
+    return Status::PeerFailure(
+        ExtFdPeer(fd), "external transport recv from rank " +
+                           std::to_string(ExtFdPeer(fd)) + " failed",
+        /*certain=*/true);
+  }
   if ((size_t)got != len) {
     return Status::Error("external transport message length mismatch: "
                          "expected " + std::to_string(len) + ", got " +
@@ -46,7 +71,93 @@ Status ExtRecvExact(int fd, void* buf, size_t len) {
   }
   return Status::OK();
 }
+
+int64_t ResolveTimeout(int64_t timeout_ms) {
+  return timeout_ms == kWireTimeoutGlobal ? WireTimeoutMs() : timeout_ms;
+}
+
+Status PeerTimeout(int fd, const char* what, int64_t stalled_ms) {
+  int rank = FdRank(fd);
+  return Status::PeerFailure(
+      rank, std::string(what) + " made no progress for " +
+                std::to_string(stalled_ms) + " ms waiting on rank " +
+                (rank >= 0 ? std::to_string(rank) : "<unknown>") +
+                " (HOROVOD_WIRE_TIMEOUT_MS)");
+}
+
+Status PeerClosed(int fd) {
+  int rank = FdRank(fd);
+  return Status::PeerFailure(
+      rank, "peer" + (rank >= 0 ? " rank " + std::to_string(rank)
+                                : std::string("")) +
+                " closed connection",
+      /*certain=*/true);
+}
+
+Status PeerIoError(int fd, const char* what) {
+  int rank = FdRank(fd);
+  return Status::PeerFailure(
+      rank, std::string(what) + " to rank " +
+                (rank >= 0 ? std::to_string(rank) : "<unknown>") +
+                " failed: " + strerror(errno),
+      /*certain=*/true);
+}
+
+// Wait for `events` on fd for up to timeout_ms (<= 0 = forever).
+// Returns 1 ready, 0 timed out, -1 poll error (errno set).
+int WaitFd(int fd, short events, int64_t timeout_ms) {
+  pollfd p{};
+  p.fd = fd;
+  p.events = events;
+  while (true) {
+    int rc = poll(&p, 1, timeout_ms <= 0 ? -1 : (int)timeout_ms);
+    if (rc < 0 && errno == EINTR) continue;
+    return rc < 0 ? -1 : (rc == 0 ? 0 : 1);
+  }
+}
 }  // namespace
+
+int64_t WireTimeoutMs() {
+  int64_t v = g_wire_timeout_ms.load(std::memory_order_relaxed);
+  if (v == -1) {
+    const char* env = std::getenv("HOROVOD_WIRE_TIMEOUT_MS");
+    v = kDefaultWireTimeoutMs;
+    if (env != nullptr) {
+      char* end = nullptr;
+      int64_t parsed = strtoll(env, &end, 10);
+      if (end != env) v = parsed;  // non-numeric keeps the default
+    }
+    if (v == -1) v = 0;  // same normalization as SetWireTimeoutMs
+    g_wire_timeout_ms.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+void SetWireTimeoutMs(int64_t ms) {
+  // -1 is the "uninitialized" sentinel; normalize a literal -1 to the
+  // equivalent "no deadline" 0.
+  g_wire_timeout_ms.store(ms == -1 ? 0 : ms, std::memory_order_relaxed);
+}
+
+void RegisterFdRank(int fd, int rank) {
+  if (fd < 0) return;  // external fds self-encode their peer
+  std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
+  g_fd_ranks[fd] = rank;
+}
+
+void UnregisterFdRank(int fd) {
+  if (fd < 0) return;
+  std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
+  g_fd_ranks.erase(fd);
+}
+
+int FdRank(int fd) {
+  if (IsExtFd(fd)) return ExtFdPeer(fd);
+  if (fd < 0) return -1;
+  std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
+  auto it = g_fd_ranks.find(fd);
+  return it == g_fd_ranks.end() ? -1 : it->second;
+}
 
 void SetExternalTransport(ExternalSendFn send, ExternalRecvFn recv) {
   g_ext_send = send;
@@ -89,6 +200,14 @@ int TcpAccept(int listen_fd) {
   return fd;
 }
 
+int TcpAcceptTimeout(int listen_fd, int64_t timeout_ms) {
+  if (timeout_ms > 0) {
+    int w = WaitFd(listen_fd, POLLIN, timeout_ms);
+    if (w <= 0) return -1;
+  }
+  return TcpAccept(listen_fd);
+}
+
 int TcpConnect(const std::string& host, int port, int timeout_ms) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
@@ -116,17 +235,34 @@ int TcpConnect(const std::string& host, int port, int timeout_ms) {
 }
 
 void TcpClose(int fd) {
-  if (fd >= 0) close(fd);  // external fds (< 0) have nothing to close
+  if (fd >= 0) {  // external fds (< 0) have nothing to close
+    UnregisterFdRank(fd);
+    close(fd);
+  }
 }
 
-Status SendAll(int fd, const void* buf, size_t len) {
+// Deadline-bound exact-length I/O: MSG_DONTWAIT attempts with a poll()
+// wait between them, so "no progress for timeout_ms" surfaces as a
+// typed PeerFailure naming the fd's registered peer instead of blocking
+// the background thread forever on a dead rank.
+Status SendAll(int fd, const void* buf, size_t len, int64_t timeout_ms) {
   if (IsExtFd(fd)) return ExtSend(fd, buf, len);
+  timeout_ms = ResolveTimeout(timeout_ms);
   const char* p = (const char*)buf;
   while (len > 0) {
-    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
+    ssize_t n = send(fd, p, len, MSG_NOSIGNAL | MSG_DONTWAIT);
     if (n < 0) {
       if (errno == EINTR) continue;
-      return Status::Error(std::string("send failed: ") + strerror(errno));
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        int w = WaitFd(fd, POLLOUT, timeout_ms);
+        if (w == 0) return PeerTimeout(fd, "send", timeout_ms);
+        if (w < 0) {
+          return Status::Error(std::string("poll failed: ") +
+                               strerror(errno));
+        }
+        continue;
+      }
+      return PeerIoError(fd, "send");
     }
     p += n;
     len -= (size_t)n;
@@ -134,51 +270,66 @@ Status SendAll(int fd, const void* buf, size_t len) {
   return Status::OK();
 }
 
-Status RecvAll(int fd, void* buf, size_t len) {
+Status RecvAll(int fd, void* buf, size_t len, int64_t timeout_ms) {
   if (IsExtFd(fd)) return ExtRecvExact(fd, buf, len);
+  timeout_ms = ResolveTimeout(timeout_ms);
   char* p = (char*)buf;
   while (len > 0) {
-    ssize_t n = recv(fd, p, len, 0);
+    ssize_t n = recv(fd, p, len, MSG_DONTWAIT);
     if (n < 0) {
       if (errno == EINTR) continue;
-      return Status::Error(std::string("recv failed: ") + strerror(errno));
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        int w = WaitFd(fd, POLLIN, timeout_ms);
+        if (w == 0) return PeerTimeout(fd, "recv", timeout_ms);
+        if (w < 0) {
+          return Status::Error(std::string("poll failed: ") +
+                               strerror(errno));
+        }
+        continue;
+      }
+      return PeerIoError(fd, "recv");
     }
-    if (n == 0) return Status::Aborted("peer closed connection");
+    if (n == 0) return PeerClosed(fd);
     p += n;
     len -= (size_t)n;
   }
   return Status::OK();
 }
 
-Status SendFrame(int fd, const std::string& payload) {
+Status SendFrame(int fd, const std::string& payload, int64_t timeout_ms) {
   if (IsExtFd(fd)) {
     // One message per frame: the transport preserves boundaries, so no
     // length prefix is needed.
     return ExtSend(fd, payload.data(), payload.size());
   }
   uint64_t len = payload.size();
-  Status s = SendAll(fd, &len, sizeof(len));
+  Status s = SendAll(fd, &len, sizeof(len), timeout_ms);
   if (!s.ok()) return s;
-  return SendAll(fd, payload.data(), payload.size());
+  return SendAll(fd, payload.data(), payload.size(), timeout_ms);
 }
 
-Status RecvFrame(int fd, std::string* payload) {
+Status RecvFrame(int fd, std::string* payload, int64_t timeout_ms) {
   if (IsExtFd(fd)) {
     if (!g_ext_recv) return Status::Error("external transport not set");
     // Two-phase: probe the next message's length (cap 0 holds it on
     // the Python side), then copy it out.
     long long len = g_ext_recv(ExtFdPeer(fd), ExtFdTag(fd), nullptr, 0);
-    if (len < 0) return Status::Error("external transport recv failed");
+    if (len < 0) {
+      return Status::PeerFailure(
+          ExtFdPeer(fd), "external transport recv from rank " +
+                             std::to_string(ExtFdPeer(fd)) + " failed",
+          /*certain=*/true);
+    }
     payload->resize((size_t)len);
     if (len == 0) return Status::OK();
     return ExtRecvExact(fd, payload->data(), (size_t)len);
   }
   uint64_t len = 0;
-  Status s = RecvAll(fd, &len, sizeof(len));
+  Status s = RecvAll(fd, &len, sizeof(len), timeout_ms);
   if (!s.ok()) return s;
   payload->resize(len);
   if (len == 0) return Status::OK();
-  return RecvAll(fd, payload->data(), len);
+  return RecvAll(fd, payload->data(), len, timeout_ms);
 }
 
 namespace {
@@ -237,6 +388,7 @@ Status DuplexTransferChunked(
     return s;
   }
   ScopedNonblock nb(send_fd, recv_fd);
+  const int64_t timeout_ms = WireTimeoutMs();
   const char* sp = (const char*)send_buf;
   char* rp = (char*)recv_buf;
   size_t sent = 0, recvd = 0, fired = 0;
@@ -254,24 +406,30 @@ Status DuplexTransferChunked(
       fds[n].events = POLLIN;
       recv_idx = n++;
     }
-    int rc = poll(fds, (nfds_t)n, 60000);
+    int rc = poll(fds, (nfds_t)n, timeout_ms <= 0 ? -1 : (int)timeout_ms);
     if (rc < 0) {
       if (errno == EINTR) continue;
       return Status::Error(std::string("poll failed: ") + strerror(errno));
     }
-    if (rc == 0) return Status::Error("duplex transfer timed out (60s)");
+    if (rc == 0) {
+      // Attribute the stall to the inbound peer when we are waiting on
+      // one (data starvation is the usual failure shape); otherwise the
+      // outbound peer stopped draining its side.
+      return PeerTimeout(recv_idx >= 0 ? recv_fd : send_fd,
+                         "duplex transfer", timeout_ms);
+    }
     if (send_idx >= 0 && (fds[send_idx].revents & (POLLOUT | POLLERR))) {
       ssize_t k = send(send_fd, sp + sent, send_len - sent, MSG_NOSIGNAL);
       if (k < 0 && errno != EINTR && errno != EAGAIN) {
-        return Status::Error(std::string("send failed: ") + strerror(errno));
+        return PeerIoError(send_fd, "duplex send");
       }
       if (k > 0) sent += (size_t)k;
     }
     if (recv_idx >= 0 && (fds[recv_idx].revents & (POLLIN | POLLHUP))) {
       ssize_t k = recv(recv_fd, rp + recvd, recv_len - recvd, 0);
-      if (k == 0) return Status::Aborted("peer closed connection");
+      if (k == 0) return PeerClosed(recv_fd);
       if (k < 0 && errno != EINTR && errno != EAGAIN) {
-        return Status::Error(std::string("recv failed: ") + strerror(errno));
+        return PeerIoError(recv_fd, "duplex recv");
       }
       if (k > 0) recvd += (size_t)k;
       if (chunk > 0 && on_chunk) {

@@ -23,19 +23,52 @@ int TcpListen(int* port);
 // Accept one connection (blocking). Returns fd.
 int TcpAccept(int listen_fd);
 
+// Accept with a deadline: returns fd, or -1 if no connection arrives
+// within timeout_ms (<= 0 = block forever). Bootstrap/re-formation
+// rendezvous uses this so a peer dying before it connects fails the
+// rendezvous instead of hanging the acceptor.
+int TcpAcceptTimeout(int listen_fd, int64_t timeout_ms);
+
 // Connect to host:port, retrying for up to `timeout_ms` (rendezvous races are
 // expected at launch). Returns fd or -1.
 int TcpConnect(const std::string& host, int port, int timeout_ms = 30000);
 
 void TcpClose(int fd);
 
-// Blocking exact-length send/recv. Return OK or an error Status.
-Status SendAll(int fd, const void* buf, size_t len);
-Status RecvAll(int fd, void* buf, size_t len);
+// ---- wire deadline (HOROVOD_WIRE_TIMEOUT_MS) -------------------------
+// Every wire primitive below is deadline-bound: "no progress on this fd
+// for timeout_ms" returns a typed Status::PeerFailure(rank) naming the
+// offending peer and the stalled milliseconds, instead of blocking the
+// ring forever on a dead peer. The deadline is a PROGRESS bound, not a
+// whole-transfer bound — a slow but live link that keeps moving bytes
+// never trips it. <= 0 disables the deadline (legacy blocking).
+// Process-global (like the ring knobs); env-read lazily and re-read at
+// every (re)init.
+constexpr int64_t kDefaultWireTimeoutMs = 60000;
+// Sentinel for the timeout_ms parameters below: use the global knob.
+constexpr int64_t kWireTimeoutGlobal = -2;
+int64_t WireTimeoutMs();
+void SetWireTimeoutMs(int64_t ms);
+
+// Peer attribution: planes register which GLOBAL rank sits behind each
+// connected fd so timeout/EOF statuses can name the casualty. External
+// (message-transport) fds encode the peer directly and need no entry.
+void RegisterFdRank(int fd, int rank);
+void UnregisterFdRank(int fd);  // TcpClose calls this itself
+int FdRank(int fd);             // -1 when unknown
+
+// Exact-length send/recv, deadline-bound (see above). timeout_ms:
+// kWireTimeoutGlobal = the knob, <= 0 = block forever, else explicit.
+Status SendAll(int fd, const void* buf, size_t len,
+               int64_t timeout_ms = kWireTimeoutGlobal);
+Status RecvAll(int fd, void* buf, size_t len,
+               int64_t timeout_ms = kWireTimeoutGlobal);
 
 // Length-framed messages (uint64 LE length + payload) for the control plane.
-Status SendFrame(int fd, const std::string& payload);
-Status RecvFrame(int fd, std::string* payload);
+Status SendFrame(int fd, const std::string& payload,
+                 int64_t timeout_ms = kWireTimeoutGlobal);
+Status RecvFrame(int fd, std::string* payload,
+                 int64_t timeout_ms = kWireTimeoutGlobal);
 
 // Full-duplex transfer: simultaneously send `send_len` bytes to `send_fd` and
 // receive `recv_len` bytes from `recv_fd`, multiplexed with poll() so the

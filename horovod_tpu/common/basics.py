@@ -138,6 +138,19 @@ def load_library():
         lib.hvdtpu_set_ring_chunk_bytes.argtypes = [i64]
         lib.hvdtpu_wire_compression.restype = i32
         lib.hvdtpu_set_wire_compression.argtypes = [i32]
+        lib.hvdtpu_wire_timeout_ms.restype = i64
+        lib.hvdtpu_wire_timeout_ms.argtypes = []
+        lib.hvdtpu_set_wire_timeout_ms.restype = None
+        lib.hvdtpu_set_wire_timeout_ms.argtypes = [i64]
+        lib.hvdtpu_epoch.restype = i64
+        lib.hvdtpu_epoch.argtypes = []
+        lib.hvdtpu_last_fault.restype = i64
+        lib.hvdtpu_last_fault.argtypes = [p, i64]
+        lib.hvdtpu_reinit.restype = i32
+        lib.hvdtpu_reinit.argtypes = [ctypes.POINTER(ctypes.c_int32), i32,
+                                      i64]
+        lib.hvdtpu_set_fault_inject.restype = i32
+        lib.hvdtpu_set_fault_inject.argtypes = [i32, i64]
         lib.hvdtpu_ring_selftest.restype = i32
         lib.hvdtpu_ring_selftest.argtypes = [
             i32, i64, i32, i32, i64, i32, dbl,
@@ -305,6 +318,77 @@ class HorovodBasics:
         """Toggle bf16-on-wire compression (rank-uniform, like the
         chunk knob; numerics contract in ``docs/wire.md``)."""
         self.lib.hvdtpu_set_wire_compression(1 if on else 0)
+
+    def wire_timeout_ms(self):
+        """Wire progress deadline (``HOROVOD_WIRE_TIMEOUT_MS``): a peer
+        making no wire progress for this long is declared failed with a
+        typed, recoverable error instead of hanging the ring. <= 0
+        disables the deadline. See ``docs/elastic.md``."""
+        return self.lib.hvdtpu_wire_timeout_ms()
+
+    def set_wire_timeout_ms(self, ms):
+        """Set the wire progress deadline (process-global, like the ring
+        knobs; valid before init)."""
+        self.lib.hvdtpu_set_wire_timeout_ms(int(ms))
+
+    def epoch(self):
+        """Membership epoch of the current ring generation (0 for a
+        fresh init; bumped by every :meth:`reinit`)."""
+        return self.lib.hvdtpu_epoch()
+
+    def last_fault(self):
+        """The core's last fault record, or ``None`` if no collective
+        has failed on a lost peer.
+
+        Returns a dict: ``{"epoch": int, "ranks": [int, ...],
+        "certain": bool, "reason": str, "detect_ms": int,
+        "recovered": bool}`` — ranks in the numbering of the epoch that
+        faulted. ``certain`` is True when every rank is PROVABLY dead
+        (EOF/RST/probe sweep) — the precondition for driver-less
+        re-formation; a timeout-only suspicion sets it False. See
+        ``docs/elastic.md`` for the attribution guarantees.
+        """
+        import ctypes as _ct
+        import json as _json
+
+        lib = self.lib
+        cap = int(lib.hvdtpu_last_fault(None, 0)) + 64
+        buf = _ct.create_string_buffer(cap)
+        lib.hvdtpu_last_fault(buf, cap)
+        rec = _json.loads(buf.value.decode())
+        if not rec.get("faulted"):
+            return None
+        rec.pop("faulted", None)
+        return rec
+
+    def reinit(self, ranks, epoch):
+        """Re-form the ring over surviving OLD ranks at a new epoch
+        without process restart (collective among survivors; the loop
+        must have stopped on a fault). Raises on failure with the core's
+        reason code. See ``docs/elastic.md``."""
+        import ctypes as _ct
+
+        ranks = [int(r) for r in ranks]
+        arr = (_ct.c_int32 * len(ranks))(*ranks)
+        rc = self.lib.hvdtpu_reinit(arr, len(ranks), int(epoch))
+        if rc != 0:
+            reasons = {-1: "not initialized / bad ranks",
+                       -2: "background loop still healthy",
+                       -3: "this rank is not in the survivor set",
+                       -4: "re-formation rendezvous failed",
+                       -5: "not supported on the external (MPI) "
+                           "transport — recover via the driver"}
+            raise RuntimeError(
+                f"hvdtpu_reinit(ranks={ranks}, epoch={epoch}) failed: "
+                f"{reasons.get(rc, rc)}")
+
+    def set_fault_inject(self, rank, op_index):
+        """Arm deterministic fault injection: `rank` SIGKILLs itself at
+        the top of its `op_index`-th executed collective
+        (``HOROVOD_FAULT_INJECT``'s programmatic twin; rank < 0
+        disarms). The primitive the chaos lane is built on."""
+        if self.lib.hvdtpu_set_fault_inject(int(rank), int(op_index)) != 0:
+            raise RuntimeError("set_fault_inject requires hvd.init()")
 
     def ring_owned_segment(self, rank, size, rot=0):
         """Which buffer segment ``rank`` owns (holds fully reduced)

@@ -106,7 +106,7 @@ class Handle:
             msg = err.decode() if err else "unknown error"
             lib.hvdtpu_release(self._raw)
             self._done = True
-            raise HorovodInternalError(msg)
+            raise _internal_error(msg)
         if self._gathered:
             ndim = lib.hvdtpu_result_ndim(self._raw)
             shape_buf = (ctypes.c_int64 * max(ndim, 1))()
@@ -131,16 +131,33 @@ class Handle:
 # Canonical definitions live in common/exceptions.py; re-exported here so
 # eager-op callers and elastic-mode catch blocks see the same class.
 HorovodInternalError = _exceptions.HorovodInternalError
+HorovodPeerFailureError = _exceptions.HorovodPeerFailureError
 HorovodVersionMismatchError = _exceptions.HorovodVersionMismatchError
+
+
+def _internal_error(msg):
+    """Build the recoverable error for a failed collective: the typed
+    :class:`HorovodPeerFailureError` (with the core's fault attribution)
+    when the runtime stopped on a lost peer, the plain
+    :class:`HorovodInternalError` otherwise."""
+    fault = _basics.last_fault()
+    # A recovered record belongs to a previous epoch: an ordinary error
+    # in the re-formed ring must not masquerade as a peer failure.
+    if fault is not None and not fault.get("recovered"):
+        return HorovodPeerFailureError(
+            msg, fault_ranks=fault.get("ranks", ()),
+            epoch=fault.get("epoch", 0),
+            detect_ms=fault.get("detect_ms"))
+    return HorovodInternalError(msg)
 
 
 def _check_handle(h, name):
     if h < 0:
         if _basics.lib.hvdtpu_loop_failed():
-            # The background loop died on a control-plane failure (a peer
-            # was lost): the elastic-recoverable condition, same as a
-            # collective failing in flight.
-            raise HorovodInternalError(
+            # The background loop died on a control- or data-plane
+            # failure (a peer was lost): the elastic-recoverable
+            # condition, same as a collective failing in flight.
+            raise _internal_error(
                 f"cannot enqueue {name}: collective runtime failed "
                 "(peer lost)")
         raise RuntimeError(
@@ -210,7 +227,7 @@ def grouped_allreduce_async(arrays, names, op=ReduceOp.SUM,
             except HorovodInternalError:
                 pass
         if _basics.lib.hvdtpu_loop_failed():
-            raise HorovodInternalError(
+            raise _internal_error(
                 "cannot enqueue grouped allreduce: collective runtime "
                 "failed (peer lost)")
         raise RuntimeError(

@@ -100,9 +100,7 @@ def init():
     _basics.init()
 
 
-def reset():
-    """Tear down and re-rendezvous (elastic epoch transition)."""
-    _basics.shutdown()
+def _disable_xla_ici():
     # The xla_ici device data plane binds the OLD topology (mesh size,
     # jax.distributed world); its callback must not survive into the new
     # epoch. sys.modules check so torch/tf-only elastic processes never
@@ -115,6 +113,77 @@ def reset():
     xla_ici = sys.modules.get("horovod_tpu.jax.xla_ici")
     if xla_ici is not None:
         xla_ici.disable()
+
+
+def _reinit_survivors():
+    """Driver-less recovery: survivors agree on the dead set from the
+    core's fault record (the socket probe sweep makes SIGKILLed peers
+    visible identically on every survivor), drop them, and re-form the
+    N-1 ring in place via ``hvdtpu_reinit`` at the next epoch — no
+    process restart, no checkpoint round-trip. Returns True when this
+    path applied; False defers to the full shutdown+init path.
+
+    Limits (docs/elastic.md): the coordinator of the new epoch is the
+    lowest surviving old rank, reached at the SAME
+    ``HOROVOD_CONTROLLER_ADDR`` — so without a driver, rank 0's host
+    must survive (always true on single-host jobs; the driver's
+    re-rendezvous covers host loss).
+    """
+    if not _basics.is_initialized() or not _basics.lib.hvdtpu_loop_failed():
+        return False
+    fault = _basics.last_fault()
+    if fault is None or fault.get("recovered"):
+        return False
+    dead = {int(r) for r in fault.get("ranks") or ()}
+    old_size, old_rank = _basics.size(), _basics.rank()
+    # Driver-less re-formation needs every survivor to derive the SAME
+    # survivor set. Only PROVEN attribution (EOF/RST/probe — "certain")
+    # guarantees that; a timeout suspicion may name a different live
+    # neighbor on each rank and split-brain the rendezvous. Exception:
+    # at size 2 the suspected peer is necessarily the only other rank.
+    if not dead or not (fault.get("certain") or old_size == 2):
+        return False
+    survivors = [r for r in range(old_size) if r not in dead]
+    if old_rank in dead or not survivors:
+        # Deliberately NOT a HorovodInternalError: being fenced out is
+        # terminal for this process, not a recoverable collective
+        # failure — it must escape the elastic retry loop.
+        raise RuntimeError(
+            f"rank {old_rank} was declared dead by its peers "
+            f"(fault: {fault.get('reason')}); cannot rejoin epoch "
+            f"{fault.get('epoch', 0) + 1} in-process")
+    _disable_xla_ici()
+    try:
+        _basics.reinit(survivors, int(fault.get("epoch", 0)) + 1)
+    except RuntimeError as e:
+        # The re-formation rendezvous itself failed (e.g. another
+        # survivor died mid-recovery). The core restored the
+        # pre-attempt state; fall back to the full shutdown+init path
+        # instead of killing the job.
+        import warnings
+
+        warnings.warn(f"in-place ring re-formation failed ({e}); "
+                      "falling back to full re-initialization",
+                      RuntimeWarning, stacklevel=2)
+        return False
+    return True
+
+
+def reset():
+    """Tear down and re-form/re-rendezvous (elastic epoch transition).
+
+    Three paths, in order: (1) driver mode re-rendezvouses against the
+    elastic driver (new rank/size/epoch env); (2) without a driver, a
+    core-reported peer fault re-forms the ring over survivors IN PLACE
+    (``hvdtpu_reinit`` — no process restart); (3) otherwise full
+    shutdown + init at the same world.
+    """
+    if not _is_elastic() and _reinit_survivors():
+        for hook in _post_reset_hooks:
+            hook()
+        return
+    _basics.shutdown()
+    _disable_xla_ici()
     init()
     for hook in _post_reset_hooks:
         hook()

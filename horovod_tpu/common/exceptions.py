@@ -12,6 +12,32 @@ class HorovodInternalError(RuntimeError):
     to the last committed state."""
 
 
+class HorovodPeerFailureError(HorovodInternalError):
+    """A specific peer died or went unresponsive mid-collective — the
+    typed form of :class:`HorovodInternalError` raised when the native
+    core stops on a peer failure (``hvdtpu_last_fault``).
+
+    Carries the core's attribution so recovery glue can re-form the ring
+    over survivors without a full re-rendezvous (``docs/elastic.md``):
+
+    - ``fault_ranks``: global ranks (old numbering) declared dead —
+      exact for SIGKILL/EOF (every survivor converges on the same set
+      via the socket probe sweep), best-effort for silent stalls;
+    - ``epoch``: the membership epoch that faulted;
+    - ``detect_ms``: how long the failing operation ran before the
+      typed error surfaced (bounded by ``HOROVOD_WIRE_TIMEOUT_MS``).
+
+    Still a :class:`HorovodInternalError`: every existing elastic catch
+    block recovers from it unchanged.
+    """
+
+    def __init__(self, message, fault_ranks=(), epoch=0, detect_ms=None):
+        super().__init__(message)
+        self.fault_ranks = tuple(fault_ranks)
+        self.epoch = epoch
+        self.detect_ms = detect_ms
+
+
 class HostsUpdatedInterrupt(Exception):
     """Raised in elastic mode when the discovery script reports a host
     topology change; training re-rendezvouses without state rollback.

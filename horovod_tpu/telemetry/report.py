@@ -192,12 +192,51 @@ def merge(paths, align=True):
     return merged, skew
 
 
+def attach_fault_events(skew, snapshot_paths):
+    """Fold per-rank metrics snapshots' ``elastic`` section into the
+    straggler table (docs/elastic.md): per rank, faults detected /
+    recovered, ranks it saw blacklisted, its membership epoch, and its
+    median detection latency. A rank that keeps re-detecting faults (or
+    sits at a lower epoch than its peers) is the flaky host the
+    straggler table alone cannot name — skew attributes slowness,
+    fault events attribute churn.
+    """
+    per_rank = {}
+    for path in snapshot_paths:
+        with open(path) as f:
+            snap = json.load(f)
+        el = snap.get("elastic", {})
+        per_rank[snap.get("rank", -1)] = {
+            "epoch": el.get("epoch", 0),
+            "faults_detected": el.get("faults_detected", 0),
+            "faults_recovered": el.get("faults_recovered", 0),
+            "ranks_blacklisted": el.get("ranks_blacklisted", 0),
+            "detect_p50_us": el.get("detect_us", {}).get("p50_us", 0),
+        }
+    skew["fault_events"] = per_rank
+    for rank, d in skew["per_rank"].items():
+        if rank in per_rank:
+            d["faults_detected"] = per_rank[rank]["faults_detected"]
+            d["epoch"] = per_rank[rank]["epoch"]
+    return skew
+
+
 def format_skew_table(skew):
-    lines = [f"{'rank':>5} {'last':>7} {'events':>7} "
-             f"{'mean skew us':>13} {'max skew us':>12}"]
+    faults = skew.get("fault_events") or {}
+    hdr = (f"{'rank':>5} {'last':>7} {'events':>7} "
+           f"{'mean skew us':>13} {'max skew us':>12}")
+    if faults:
+        hdr += f" {'epoch':>6} {'faults':>7} {'det p50 us':>11}"
+    lines = [hdr]
     for rank, d in sorted(skew["per_rank"].items()):
-        lines.append(f"{rank:>5} {d['last_count']:>7} {d['events']:>7} "
-                     f"{d['mean_skew_us']:>13.1f} {d['max_skew_us']:>12}")
+        row = (f"{rank:>5} {d['last_count']:>7} {d['events']:>7} "
+               f"{d['mean_skew_us']:>13.1f} {d['max_skew_us']:>12}")
+        if faults:
+            fe = faults.get(rank, {})
+            row += (f" {fe.get('epoch', '-'):>6} "
+                    f"{fe.get('faults_detected', '-'):>7} "
+                    f"{fe.get('detect_p50_us', '-'):>11}")
+        lines.append(row)
     for w in skew["worst_tensors"][:5]:
         lines.append(f"  worst: {w['tensor']}#{w['occurrence']} "
                      f"spread {w['spread_us']} us "
@@ -218,9 +257,15 @@ def main(argv=None):
                     help="also write the straggler table as JSON")
     ap.add_argument("--no-align", action="store_true",
                     help="skip clock alignment (trust raw timestamps)")
+    ap.add_argument("--snapshots", nargs="*", default=None,
+                    help="per-rank hvd.metrics() snapshot JSON files: "
+                         "folds elastic fault events (epoch, faults, "
+                         "detection latency) into the straggler table")
     args = ap.parse_args(argv)
 
     merged, skew = merge(args.timelines, align=not args.no_align)
+    if args.snapshots:
+        attach_fault_events(skew, args.snapshots)
     with open(args.output, "w") as f:
         json.dump(merged, f)
     print(f"wrote {args.output} ({len(merged)} events, "

@@ -113,6 +113,92 @@ def _find_tsan_runtime():
     return None
 
 
+# Elastic fault lane: rank 1 dies by deterministic injection at its
+# 2nd collective; rank 0 must get the typed error (API thread reading
+# the fault record the background thread wrote), re-form a 1-rank ring
+# via hvdtpu_reinit, and keep collecting metrics — the detection /
+# record / reinit handoff is exactly the cross-thread traffic a rebuild
+# tends to leave racy (docs/elastic.md).
+_FAULT_DRIVER = textwrap.dedent("""
+    import numpy as np
+    from horovod_tpu.common import basics
+    from horovod_tpu.common import eager_ops as ops
+    from horovod_tpu.common.exceptions import HorovodPeerFailureError
+
+    b = basics.HorovodBasics()
+    b.init()
+    x = np.ones(4096, np.float32)
+    ops.allreduce_async(x, "w0").synchronize()          # op 0
+    try:
+        ops.allreduce_async(x, "boom").synchronize()    # op 1: rank 1 dies
+        raise SystemExit("boom did not fail")
+    except HorovodPeerFailureError as e:
+        assert 1 in e.fault_ranks, e.fault_ranks
+    assert b.last_fault() is not None
+    b.reinit([0], 1)
+    out = ops.allreduce_async(x, "reformed").synchronize()
+    assert (out == x).all()
+    assert b.metrics_snapshot()["elastic"]["faults_recovered"] == 1
+    b.shutdown()
+    print("FAULT_SMOKE_OK")
+""")
+
+
+def _tsan_env():
+    runtime = _find_tsan_runtime()
+    if runtime is None:
+        return None
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": runtime,
+        "HVDTPU_CORE_LIB": os.path.basename(TSAN_LIB),
+        "TSAN_OPTIONS": "exitcode=66 halt_on_error=0",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    return env
+
+
+def test_tsan_fault_reinit_smoke():
+    if not os.path.exists(TSAN_LIB):
+        pytest.skip("TSan core not built (run `make core-tsan`)")
+    env = _tsan_env()
+    if env is None:
+        pytest.skip("no libtsan runtime on this host")
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for rank in range(2):
+        renv = dict(env,
+                    HOROVOD_RANK=str(rank), HOROVOD_SIZE="2",
+                    HOROVOD_LOCAL_RANK=str(rank),
+                    HOROVOD_LOCAL_SIZE="2",
+                    HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                    HOROVOD_CONTROLLER_PORT=str(port),
+                    HOROVOD_WIRE_TIMEOUT_MS="4000",
+                    HOROVOD_FAULT_INJECT="1:1")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _FAULT_DRIVER], env=renv,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    try:
+        out0, _ = procs[0].communicate(timeout=300)
+        procs[1].wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    if procs[0].returncode != 0 and "ThreadSanitizer" not in out0:
+        pytest.skip(f"TSan subprocess unusable on this host: "
+                    f"rc={procs[0].returncode} {out0[-400:]}")
+    assert "WARNING: ThreadSanitizer" not in out0, out0[-4000:]
+    assert procs[0].returncode == 0, out0[-2000:]
+    assert "FAULT_SMOKE_OK" in out0
+    assert procs[1].returncode == -9  # died at the injected collective
+
+
 def test_tsan_multithreaded_allreduce_smoke():
     if not os.path.exists(TSAN_LIB):
         pytest.skip("TSan core not built (run `make core-tsan`)")

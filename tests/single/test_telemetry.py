@@ -353,6 +353,43 @@ def test_report_cli(tmp_path, capsys):
     assert "rank" in captured and "merged.json" in captured
 
 
+def test_report_fault_events_in_straggler_table(tmp_path, capsys):
+    """Per-rank metrics snapshots fold elastic fault events (epoch,
+    fault counts, detection latency) into the straggler table — the
+    report names churny hosts, not just slow ones (docs/elastic.md)."""
+    paths = _write_traces(tmp_path)
+    snap_paths = []
+    for rank in range(4):
+        snap = {"rank": rank,
+                "elastic": {"epoch": 1, "faults_detected": 1,
+                            "faults_recovered": 1,
+                            "ranks_blacklisted": 1,
+                            "detect_us": {"count": 1, "p50_us": 2048}}}
+        if rank == 2:  # the flaky rank keeps re-detecting faults
+            snap["elastic"]["faults_detected"] = 3
+        p = tmp_path / f"snap.{rank}.json"
+        p.write_text(json.dumps(snap))
+        snap_paths.append(str(p))
+
+    _, skew = report.merge(paths)
+    report.attach_fault_events(skew, snap_paths)
+    assert skew["fault_events"][2]["faults_detected"] == 3
+    assert skew["per_rank"][2]["faults_detected"] == 3
+    assert skew["per_rank"][2]["epoch"] == 1
+    text = report.format_skew_table(skew)
+    assert "faults" in text and "epoch" in text and "2048" in text
+
+    # CLI wiring: --snapshots lands fault_events in the skew JSON.
+    out = tmp_path / "merged.json"
+    skew_out = tmp_path / "skew.json"
+    rc = report.main([*paths, "-o", str(out), "--skew-json",
+                      str(skew_out), "--snapshots", *snap_paths])
+    assert rc == 0
+    skew_json = json.loads(skew_out.read_text())
+    assert skew_json["fault_events"]["2"]["faults_detected"] == 3
+    assert "faults" in capsys.readouterr().out
+
+
 def test_real_timeline_has_clock_sync(tmp_path, hvd_core):
     """The core's runtime timeline carries the CLOCK_SYNC anchor and
     stays valid JSON (the merge's preferred alignment path)."""
