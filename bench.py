@@ -674,6 +674,97 @@ def _run_loopback_ranks(child_src, sentinel, ranks, env_extra,
         raise
 
 
+# Child body for one hier_busbw rank: like the ring child, but the
+# worker first overrides its layout env to the emulated 2-slice
+# topology (HIER_LOCAL ranks per slice) and additionally reports the
+# cross-plane wire counters the hierarchical decomposition books.
+_HIER_BUSBW_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, os.environ["HVDTPU_REPO"])
+L = int(os.environ["HIER_LOCAL"])
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+os.environ.update({
+    "HOROVOD_LOCAL_RANK": str(rank % L),
+    "HOROVOD_LOCAL_SIZE": str(L),
+    "HOROVOD_CROSS_RANK": str(rank // L),
+    "HOROVOD_CROSS_SIZE": str(size // L),
+})
+from horovod_tpu.common import basics, eager_ops
+b = basics.HorovodBasics()
+b.init()
+points = []
+try:
+    for nbytes in json.loads(os.environ["RING_BUSBW_SIZES"]):
+        elems = max(nbytes // 4, 1)
+        x = np.full(elems, float(rank + 1), np.float32)
+        iters = max(2, min(20, (1 << 24) // nbytes))
+        eager_ops.allreduce_async(x, f"bw.{nbytes}.warm").synchronize()
+        snap0 = b.metrics_snapshot()["wire"]
+        t0 = time.perf_counter()
+        for i in range(iters):
+            eager_ops.allreduce_async(x, f"bw.{nbytes}.{i}").synchronize()
+        dt = (time.perf_counter() - t0) / iters
+        snap1 = b.metrics_snapshot()["wire"]
+        d = lambda k: snap1[k] - snap0[k]
+        # Flat-ring DCN baseline: the locality-blind flat ring streams
+        # 2(N-1)/N x payload per rank with no idea where the slice
+        # boundary is, so all of it prices at DCN rates.
+        flat_dcn = 2 * (size - 1) * nbytes
+        cross = d("cross_tx_bytes") / iters
+        points.append({
+            "payload_bytes": nbytes,
+            "busbw_gbps": round(2 * (size - 1) / size * nbytes / dt / 1e9,
+                                4),
+            "step_s": round(dt, 6),
+            "wire_ratio": (round(d("tx_bytes") / d("tx_logical_bytes"), 4)
+                           if d("tx_logical_bytes") else None),
+            "cross_bytes_per_iter": int(cross),
+            "cross_ratio_vs_flat": round(size * cross / flat_dcn, 4),
+        })
+finally:
+    b.shutdown()
+if rank == 0:
+    print("HIER_BUSBW_POINTS " + json.dumps(points), flush=True)
+"""
+
+
+def _hier_busbw_rows(ranks=4, local=2):
+    """Cross-plane allreduce bus-bandwidth sweep at an emulated
+    ``ranks/local`` slices x ``local`` ranks topology: flat host ring
+    vs hierarchical vs hierarchical with the bf16 codec on the
+    cross-plane hop (docs/redistribute.md). ``cross_ratio_vs_flat`` is
+    the world cross-plane tx bytes over the locality-blind flat ring's
+    full stream — the ISSUE-8 acceptance wants <= ~(1/local + eps) at
+    16 MiB on the hier rows (the bf16 row halves it again)."""
+    sizes = [1 << 15, 1 << 20, 1 << 24]
+    configs = [
+        ("flat", {"HOROVOD_CROSS_PLANE": "ring"}),
+        ("hier", {"HOROVOD_CROSS_PLANE": "hier"}),
+        ("hier+bf16-cross", {"HOROVOD_CROSS_PLANE": "hier",
+                             "HOROVOD_CROSS_PLANE_COMPRESSION": "1"}),
+    ]
+    rows = []
+    for name, knobs in configs:
+        row = {"metric": "hier_busbw", "config": name, "ranks": ranks,
+               "slices": ranks // local,
+               "unit": "host allreduce bus GB/s at an emulated "
+                       f"{ranks // local}x{local} topology; "
+                       "cross_ratio_vs_flat = world cross-plane tx / "
+                       "flat-ring full stream"}
+        try:
+            row["points"] = _run_loopback_ranks(
+                _HIER_BUSBW_CHILD, "HIER_BUSBW_POINTS", ranks,
+                dict(knobs, HIER_LOCAL=str(local),
+                     RING_BUSBW_SIZES=json.dumps(sizes)))
+        except Exception as e:  # noqa: BLE001 — a failed transport
+            # config yields an error row; the sweep continues.
+            row["error"] = f"{type(e).__name__}: {e}"
+        rows.append(row)
+    return rows
+
+
 def _ring_busbw_rows(ranks=4):
     """Host-ring allreduce bus-bandwidth sweep, one JSON row per
     transport config: bulk-synchronous (chunk knob 0 — the pre-r10
@@ -989,8 +1080,11 @@ def main():
         if not argv:
             return
     if "--ring-busbw" in argv:
-        # Standalone host-ring transport sweep (no accelerator needed).
+        # Standalone host-ring transport sweep (no accelerator needed),
+        # including the cross-plane hierarchical rows (dense/hier lane).
         for row in _ring_busbw_rows():
+            emit(row)
+        for row in _hier_busbw_rows():
             emit(row)
         return
     if "--zero-sweep" in argv:
@@ -1042,12 +1136,16 @@ def main():
     if _probe_platform() == "cpu":  # CI / no-accelerator smoke path
         for row in _ring_busbw_rows():
             emit(row)
+        for row in _hier_busbw_rows():
+            emit(row)
         emit(_smoke_row())
         return
 
     # Host-ring transport rows first: loopback subprocesses that never
     # import jax, so the flagship subprocess still gets a virgin heap.
     for row in _ring_busbw_rows():
+        emit(row)
+    for row in _hier_busbw_rows():
         emit(row)
 
     flagship_row, flagship_extras = _flagship_row()

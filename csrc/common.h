@@ -29,6 +29,18 @@ enum class DataType : int32_t {
   HVDTPU_UINT16 = 9,
 };
 
+// Cross-plane topology descriptor (HOROVOD_CROSS_PLANE,
+// docs/redistribute.md) in enum order: 0 auto, 1 ici, 2 ring, 3 hier.
+// THE one name table — operations.cc parses against it, metrics.cc
+// labels with it, and Python's HorovodBasics.CROSS_PLANE_MODES
+// (common/basics.py) mirrors it by documented contract.
+constexpr int kCrossPlaneModeCount = 4;
+inline const char* const* CrossPlaneModeNames() {
+  static const char* const names[kCrossPlaneModeCount] = {
+      "auto", "ici", "ring", "hier"};
+  return names;
+}
+
 inline int64_t DataTypeSize(DataType dt) {
   switch (dt) {
     case DataType::HVDTPU_UINT8:

@@ -886,6 +886,7 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     list.cycle_time_ms = bcast_cycle_ms_;
     list.ring_chunk_bytes = bcast_ring_chunk_bytes_;
     list.wire_compression = bcast_wire_compression_;
+    list.hier_split = bcast_hier_split_;
     // Serialize before ApplyCacheVerdicts: the broadcast carries only
     // negotiated responses + cache verdicts; every rank (this one included)
     // then rebuilds hit responses and inserts new entries identically.

@@ -87,12 +87,14 @@ class Controller {
   // broadcast nothing and workers keep their env-derived values.
   void SetAutotunedParams(int64_t fusion_bytes, double cycle_ms,
                           int64_t ring_chunk_bytes = -1,
-                          int32_t wire_compression = -1) {
+                          int32_t wire_compression = -1,
+                          int32_t hier_split = -1) {
     cfg_.fusion_threshold_bytes = fusion_bytes;
     bcast_fusion_bytes_ = fusion_bytes;
     bcast_cycle_ms_ = cycle_ms;
     bcast_ring_chunk_bytes_ = ring_chunk_bytes;
     bcast_wire_compression_ = wire_compression;
+    bcast_hier_split_ = hier_split;
   }
 
  private:
@@ -166,6 +168,7 @@ class Controller {
   double bcast_cycle_ms_ = 0;
   int64_t bcast_ring_chunk_bytes_ = -1;  // -1 = nothing to broadcast
   int32_t bcast_wire_compression_ = -1;
+  int32_t bcast_hier_split_ = -1;
   std::chrono::steady_clock::time_point last_stall_check_;
 
   // --- Response cache (all ranks; state bit-identical by construction) ---

@@ -196,6 +196,7 @@ std::string SerializeResponseList(const ResponseList& list) {
   w.Put<double>(list.cycle_time_ms);
   w.Put<int64_t>(list.ring_chunk_bytes);
   w.Put<int32_t>(list.wire_compression);
+  w.Put<int32_t>(list.hier_split);
   w.PutI64Vec(list.cache_hit_positions);
   w.PutI64Vec(list.cache_hit_group_sizes);
   w.PutI64Vec(list.cache_evictions);
@@ -217,7 +218,8 @@ Status ParseResponseList(const std::string& buf, ResponseList* list) {
     return Status::Error("truncated ResponseList");
   }
   if (!rd.Get(&list->ring_chunk_bytes) ||
-      !rd.Get(&list->wire_compression)) {
+      !rd.Get(&list->wire_compression) ||
+      !rd.Get(&list->hier_split)) {
     return Status::Error("truncated ResponseList");
   }
   if (!rd.GetI64Vec(&list->cache_hit_positions) ||

@@ -155,6 +155,11 @@ struct ResponseList {
   // syncs them the same way it syncs fusion/cycle.
   int64_t ring_chunk_bytes = -1;
   int32_t wire_compression = -1;  // -1 unset, 0 off, 1 on
+  // Hierarchy split point of the cross-plane allreduce (-1 unset,
+  // 0 = flat ring, >= 2 = intra-slice group size). Rank-uniform for
+  // the same reason as the ring knobs: every rank must decompose the
+  // SAME collective into the SAME plane sequence in the same cycle.
+  int32_t hier_split = -1;
   // Response-cache verdicts. Positions ready on every member rank this
   // cycle, grouped for fusion: group_sizes partitions cache_hit_positions
   // (e.g. [3,1] = first three fuse into one allreduce, next is alone).

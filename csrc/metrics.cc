@@ -1,5 +1,7 @@
 #include "metrics.h"
 
+#include "common.h"
+
 #include <algorithm>
 #include <chrono>
 #include <cstdarg>
@@ -40,7 +42,10 @@ void AtomicMax(std::atomic<int64_t>& a, int64_t v) {
 }
 
 void Append(std::string& out, const char* fmt, ...) {
-  char buf[256];
+  // Sized for the largest single row (the 10-field wire section with
+  // full-width int64 values); vsnprintf truncation here would silently
+  // corrupt the snapshot JSON.
+  char buf[768];
   va_list args;
   va_start(args, fmt);
   vsnprintf(buf, sizeof(buf), fmt, args);
@@ -133,12 +138,20 @@ std::string LatencyHistogram::Json() const {
   return out;
 }
 
-void Metrics::AccountWire(int64_t tx, int64_t rx, int64_t tx_logical,
-                          int64_t rx_logical) {
+void Metrics::AccountWire(int plane, int64_t tx, int64_t rx,
+                          int64_t tx_logical, int64_t rx_logical) {
   wire_tx_bytes.fetch_add(tx, std::memory_order_relaxed);
   wire_rx_bytes.fetch_add(rx, std::memory_order_relaxed);
   wire_tx_logical_bytes.fetch_add(tx_logical, std::memory_order_relaxed);
   wire_rx_logical_bytes.fetch_add(rx_logical, std::memory_order_relaxed);
+  if (plane == 1) {
+    wire_cross_tx_bytes.fetch_add(tx, std::memory_order_relaxed);
+    wire_cross_rx_bytes.fetch_add(rx, std::memory_order_relaxed);
+    wire_cross_tx_logical_bytes.fetch_add(tx_logical,
+                                          std::memory_order_relaxed);
+    wire_cross_rx_logical_bytes.fetch_add(rx_logical,
+                                          std::memory_order_relaxed);
+  }
 }
 
 void Metrics::RecordStraggler(int rank, int64_t skew_us) {
@@ -182,6 +195,10 @@ void Metrics::Reset() {
   wire_rx_bytes.store(0);
   wire_tx_logical_bytes.store(0);
   wire_rx_logical_bytes.store(0);
+  wire_cross_tx_bytes.store(0);
+  wire_cross_rx_bytes.store(0);
+  wire_cross_tx_logical_bytes.store(0);
+  wire_cross_rx_logical_bytes.store(0);
   std::lock_guard<std::mutex> lk(straggler_mutex_);
   straggler_counts_.clear();
 }
@@ -233,11 +250,23 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
   int64_t wrx = wire_rx_bytes.load(std::memory_order_relaxed);
   int64_t wtxl = wire_tx_logical_bytes.load(std::memory_order_relaxed);
   int64_t wrxl = wire_rx_logical_bytes.load(std::memory_order_relaxed);
+  int64_t ctx = wire_cross_tx_bytes.load(std::memory_order_relaxed);
+  int64_t crx = wire_cross_rx_bytes.load(std::memory_order_relaxed);
+  int64_t ctxl =
+      wire_cross_tx_logical_bytes.load(std::memory_order_relaxed);
+  int64_t crxl =
+      wire_cross_rx_logical_bytes.load(std::memory_order_relaxed);
   Append(out, "\"wire\":{\"tx_bytes\":%lld,\"rx_bytes\":%lld,"
               "\"tx_logical_bytes\":%lld,\"rx_logical_bytes\":%lld,"
-              "\"compression_ratio\":%.6f},",
+              "\"compression_ratio\":%.6f,"
+              "\"cross_tx_bytes\":%lld,\"cross_rx_bytes\":%lld,"
+              "\"cross_tx_logical_bytes\":%lld,"
+              "\"cross_rx_logical_bytes\":%lld,"
+              "\"cross_compression_ratio\":%.6f},",
          (long long)wtx, (long long)wrx, (long long)wtxl, (long long)wrxl,
-         wtxl > 0 ? (double)wtx / (double)wtxl : 1.0);
+         wtxl > 0 ? (double)wtx / (double)wtxl : 1.0,
+         (long long)ctx, (long long)crx, (long long)ctxl, (long long)crxl,
+         ctxl > 0 ? (double)ctx / (double)ctxl : 1.0);
 
   Append(out, "\"elastic\":{\"epoch\":%lld,\"faults_detected\":%lld,"
               "\"faults_recovered\":%lld,\"ranks_blacklisted\":%lld,"
@@ -250,13 +279,21 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
 
   Append(out, "\"errors\":%lld,",
          (long long)errors.load(std::memory_order_relaxed));
+  const char* cp =
+      (info.cross_plane >= 0 && info.cross_plane < kCrossPlaneModeCount)
+          ? CrossPlaneModeNames()[info.cross_plane]
+          : "auto";
   Append(out, "\"knobs\":{\"fusion_threshold_bytes\":%lld,"
               "\"cycle_time_ms\":%.6f,\"ring_chunk_bytes\":%lld,"
-              "\"wire_compression\":%s,\"wire_timeout_ms\":%lld}}",
+              "\"wire_compression\":%s,\"wire_timeout_ms\":%lld,"
+              "\"cross_plane\":\"%s\",\"hier_split\":%lld,"
+              "\"cross_compression\":%s}}",
          (long long)info.fusion_threshold_bytes, info.cycle_time_ms,
          (long long)info.ring_chunk_bytes,
          info.wire_compression ? "true" : "false",
-         (long long)info.wire_timeout_ms);
+         (long long)info.wire_timeout_ms, cp,
+         (long long)info.hier_split,
+         info.cross_compression ? "true" : "false");
   return out;
 }
 

@@ -106,7 +106,17 @@ class Metrics {
   std::atomic<int64_t> wire_tx_logical_bytes{0};
   std::atomic<int64_t> wire_rx_logical_bytes{0};
 
-  void AccountWire(int64_t tx, int64_t rx, int64_t tx_logical,
+  // Cross-plane slice of the wire counters above (already included in
+  // them): bytes that crossed the INTER-SLICE hop of the hierarchical
+  // decomposition (DataPlane wire plane 1 — the DCN-priced fabric).
+  // intra = total - cross; the pair is what lets telemetry reconcile
+  // per-plane logical-vs-wire exactly (docs/redistribute.md).
+  std::atomic<int64_t> wire_cross_tx_bytes{0};
+  std::atomic<int64_t> wire_cross_rx_bytes{0};
+  std::atomic<int64_t> wire_cross_tx_logical_bytes{0};
+  std::atomic<int64_t> wire_cross_rx_logical_bytes{0};
+
+  void AccountWire(int plane, int64_t tx, int64_t rx, int64_t tx_logical,
                    int64_t rx_logical);
   void RecordStraggler(int rank, int64_t skew_us);
   void Reset();
@@ -121,6 +131,10 @@ class Metrics {
     int64_t ring_chunk_bytes = 0;
     bool wire_compression = false;
     int64_t wire_timeout_ms = 0;
+    int cross_plane = 0;       // HOROVOD_CROSS_PLANE (0 auto, 1 ici,
+                               // 2 ring, 3 hier)
+    int64_t hier_split = 0;    // active hierarchy split (0 = flat)
+    bool cross_compression = false;  // bf16 on the cross hop only
     int64_t epoch = 0;  // current membership epoch (bumped by reinit)
     int64_t cache_hits = 0, cache_misses = 0, cache_entries = 0;
     int64_t cache_hit_bytes = 0;

@@ -7,6 +7,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cctype>
 #include <csignal>
 #include <unordered_set>
 #include <condition_variable>
@@ -167,9 +168,26 @@ struct GlobalState {
   // never enqueued. Reference analog: global_state.h joined flag.
   std::atomic<bool> joined{false};
   std::atomic<int> last_joined_rank{-1};
-  // HOROVOD_HIERARCHICAL_ALLREDUCE: three-phase allreduce keeping most
-  // of the payload on the intra-node transport.
-  bool hierarchical = false;
+  // Cross-plane collective engine (HOROVOD_CROSS_PLANE, docs/
+  // redistribute.md): how host allreduces decompose over the two
+  // transport planes. 0 auto (hierarchical when the layout tiles),
+  // 1 ici (device plane preferred — enforced by the Python frontends;
+  // host ops stay flat), 2 ring (always the flat host ring), 3 hier
+  // (hierarchical required; warn + flat when the layout cannot tile).
+  // The legacy HOROVOD_HIERARCHICAL_ALLREDUCE=1 spelling maps to hier.
+  int cross_plane_mode = 0;
+  // Active hierarchy split point: 0/1 = flat ring, s >= 2 = intra-slice
+  // reduce-scatter over contiguous groups of s ranks, inter-slice
+  // allreduce of the 1/s shards among same-local-rank peers, intra-
+  // slice allgather. Atomic: the autotuner moves it mid-run (rides the
+  // ResponseList like the ring knobs — rank-uniform per cycle).
+  std::atomic<int32_t> hier_split{0};
+  // bf16 wire codec on the INTER-SLICE hop only
+  // (HOROVOD_CROSS_PLANE_COMPRESSION): the EQuARX cheap-wire recipe
+  // applied to the DCN-priced fabric while intra-slice hops stay full
+  // width. Independent of HOROVOD_WIRE_COMPRESSION (which compresses
+  // every hop).
+  bool cross_compression = false;
   // Barrier sequence numbers, PER process set; must stay aligned across a
   // set's members, including barriers a joined rank participated in only
   // via synthesis. A global counter would desync when only a subset of
@@ -220,25 +238,71 @@ ControllerConfig MakeControllerConfig(GlobalState& st, int rank, int size,
 
 DataType ToDataType(int dtype) { return (DataType)dtype; }
 
+// ONE construction site for the autotuner, shared by init and reinit:
+// the hier-split grid is derived from the CURRENT layout, so a re-formed
+// world tunes over ITS divisors instead of stomping the reinit-derived
+// split with a value from the dead layout's grid (and the old samples
+// scored a different world anyway — fresh sampling is the honest
+// restart).
+void InitAutotune(GlobalState& st) {
+  if (EnvInt64("HOROVOD_AUTOTUNE", 0) == 0) {
+    st.param_manager.reset();
+    return;
+  }
+  st.param_manager = std::make_unique<ParameterManager>();
+  // Hierarchy-split grid: on an eligible layout the split point is a
+  // scored knob — flat (0) plus every divisor of local_size >= 2
+  // (contiguous groups under host-major never straddle a host). An
+  // explicit HOROVOD_CROSS_PLANE=hier keeps flat OFF the grid (the
+  // user demanded the decomposition; the tuner may only move the
+  // split point), mirroring the wire-compression philosophy.
+  std::vector<int64_t> hier_values;
+  int64_t split = st.hier_split.load();
+  if (split > 1) {
+    if (st.cross_plane_mode != 3) hier_values.push_back(0);
+    for (int64_t d = 2; d <= st.local_size; d++) {
+      if (st.local_size % d == 0 && st.size % d == 0) {
+        hier_values.push_back(d);
+      }
+    }
+  }
+  st.param_manager->Initialize(
+      st.fusion_threshold.load(), st.cycle_time_ms.load(),
+      EnvStr("HOROVOD_AUTOTUNE_LOG", ""),
+      (int)EnvInt64("HOROVOD_AUTOTUNE_STEPS", 20),
+      EnvInt64("HOROVOD_AUTOTUNE_WINDOW_BYTES", 1 << 20),
+      (int)EnvInt64("HOROVOD_AUTOTUNE_WINDOW_CYCLES", 20),
+      RingChunkBytes(), WireCompression(),
+      // Compression joins the grid only when the user opted into
+      // compressed numerics; the tuner may still settle on OFF
+      // (strictly more accurate), never the other way around.
+      /*tune_wire_compression=*/WireCompression(),
+      std::move(hier_values), split);
+}
+
 void ApplyPostOp(TensorTableEntry& e, void* buf, int64_t count, int size) {
   double post = e.postscale_factor;
   if (e.reduce_op == ReduceOp::AVERAGE) post /= (double)size;
   ScaleBuffer(buf, count, e.dtype, post);
 }
 
-// Flat ring, or three-phase hierarchical when enabled and the layout
-// allows (global set, >1 node, >1 rank per node, host-major ranks).
+// Flat ring, or the three-phase cross-plane decomposition when the
+// hierarchy split is active and the layout allows (global set, >1
+// slice, >1 rank per slice, host-major ranks).
 // Reference analog: the NCCLAllreduce vs NCCLHierarchicalAllreduce pick
 // under HOROVOD_HIERARCHICAL_ALLREDUCE.
 Status RingAllreduce(GlobalState& st, DataPlane* dp, void* buf,
                      int64_t count, DataType dt, ReduceOp op,
                      double postscale = 1.0) {
-  // st.hierarchical is only true after the collective eligibility check
-  // at init (homogeneous host-major layout) — so the remaining per-call
-  // condition is just "global process set".
-  if (st.hierarchical && dp->size() == st.size) {
-    return dp->HierarchicalAllreduce(buf, count, dt, op, st.local_size,
-                                     postscale);
+  // hier_split is only > 1 after the collective eligibility check at
+  // init (homogeneous host-major layout) — so the remaining per-call
+  // condition is just "global process set". Splits smaller than
+  // local_size (autotuned intermediate points) group contiguous ranks,
+  // which under the host-major requirement never straddles a host.
+  int split = st.hier_split.load(std::memory_order_relaxed);
+  if (split > 1 && dp->size() == st.size) {
+    return dp->HierarchicalAllreduce(buf, count, dt, op, split,
+                                     postscale, st.cross_compression);
   }
   return dp->Allreduce(buf, count, dt, op, postscale);
 }
@@ -868,6 +932,12 @@ void BackgroundThreadLoop(GlobalState& st) {
     if (response_list.wire_compression >= 0 && st.rank != 0) {
       SetWireCompression(response_list.wire_compression != 0);
     }
+    // The hierarchy split decides which plane sequence every rank's
+    // next collective decomposes into — as framing-critical as the
+    // chunk knob, so it flips in the same lockstep cycle.
+    if (response_list.hier_split >= 0 && st.rank != 0) {
+      st.hier_split = response_list.hier_split;
+    }
     int64_t cycle_bytes = 0;
     bool faulted = false;
     for (auto& response : response_list.responses) {
@@ -894,10 +964,12 @@ void BackgroundThreadLoop(GlobalState& st) {
       st.cycle_time_ms = st.param_manager->cycle_time_ms();
       SetRingChunkBytes(st.param_manager->ring_chunk_bytes());
       SetWireCompression(st.param_manager->wire_compression());
+      st.hier_split = (int32_t)st.param_manager->hier_split();
       st.controller->SetAutotunedParams(
           st.fusion_threshold.load(), st.cycle_time_ms.load(),
           st.param_manager->ring_chunk_bytes(),
-          st.param_manager->wire_compression() ? 1 : 0);
+          st.param_manager->wire_compression() ? 1 : 0,
+          (int32_t)st.param_manager->hier_split());
     }
     if (response_list.shutdown) break;
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
@@ -988,7 +1060,33 @@ int hvdtpu_init() {
   st->fusion_threshold =
       EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
   st->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
-  st->hierarchical = EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0;
+  {
+    // HOROVOD_CROSS_PLANE: the topology descriptor selecting how
+    // collectives decompose over the transport planes
+    // (docs/redistribute.md). Unset falls back to the legacy
+    // HOROVOD_HIERARCHICAL_ALLREDUCE spelling (1 -> hier), else auto.
+    // Case-insensitive (the Python twin xla_ici.cross_plane_mode
+    // lowercases too — the two layers must agree on every spelling);
+    // names come from the ONE table in common.h.
+    std::string mode = EnvStr("HOROVOD_CROSS_PLANE", "");
+    for (auto& c : mode) c = (char)tolower((unsigned char)c);
+    if (mode.empty()) {
+      mode = EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0 ? "hier"
+                                                                : "auto";
+    }
+    st->cross_plane_mode = -1;
+    for (int i = 0; i < kCrossPlaneModeCount; i++) {
+      if (mode == CrossPlaneModeNames()[i]) st->cross_plane_mode = i;
+    }
+    if (st->cross_plane_mode < 0) {
+      LOG_WARN("ignoring unknown HOROVOD_CROSS_PLANE=%s "
+               "(expected auto|ici|ring|hier)", mode.c_str());
+      st->cross_plane_mode = 0;
+    }
+  }
+  st->cross_compression =
+      EnvInt64("HOROVOD_CROSS_PLANE_COMPRESSION", 0) != 0;
+  st->hier_split = 0;
   // Ring transport knobs (docs/wire.md). Re-read on every (elastic)
   // re-init so a respawned worker matches its peers' env-derived
   // framing even if a prior life's autotuner had moved the globals.
@@ -1051,12 +1149,16 @@ int hvdtpu_init() {
     st->controller.reset();
     return -1;
   }
-  if (st->hierarchical && st->size > 1) {
-    // Eligibility must be agreed COLLECTIVELY: a per-rank decision from
-    // local env alone deadlocks when ranks diverge (heterogeneous
-    // local sizes, non-host-major placement). Every rank contributes
-    // (local_size, -local_size, layout-matches-host-major) and a MIN
-    // allreduce yields the global verdict identically everywhere.
+  // Hierarchical eligibility (auto + hier modes). Must be agreed
+  // COLLECTIVELY: a per-rank decision from local env alone deadlocks
+  // when ranks diverge (heterogeneous local sizes, non-host-major
+  // placement), so the GATE is env-uniform (mode + world size only) and
+  // every rank contributes (local_size, -local_size,
+  // layout-matches-host-major) to a MIN allreduce that yields the
+  // global verdict identically everywhere.
+  bool want_hier =
+      st->cross_plane_mode == 0 || st->cross_plane_mode == 3;
+  if (want_hier && st->size > 1) {
     int64_t probe[3] = {
         st->local_size, -(int64_t)st->local_size,
         (st->local_rank == st->rank % std::max(st->local_size, 1) &&
@@ -1069,15 +1171,20 @@ int hvdtpu_init() {
     bool host_major = hs.ok() && probe[2] == 1;
     if (!hs.ok() || !homogeneous || !host_major || st->local_size <= 1 ||
         st->size % st->local_size != 0 || st->size == st->local_size) {
-      if (st->rank == 0) {
+      // auto degrades silently (flat is the correct plane for this
+      // layout); an explicit hier request warns — the user asked for a
+      // decomposition the topology cannot tile.
+      if (st->rank == 0 && st->cross_plane_mode == 3) {
         LOG_WARN(
-            "HOROVOD_HIERARCHICAL_ALLREDUCE disabled: requires a "
-            "homogeneous host-major layout with >1 rank per node on >1 "
-            "nodes (local sizes %s, layout %s)",
+            "HOROVOD_CROSS_PLANE=hier disabled: requires a "
+            "homogeneous host-major layout with >1 rank per slice on "
+            ">1 slices (local sizes %s, layout %s)",
             homogeneous ? "uniform" : "mixed",
             host_major ? "host-major" : "not host-major");
       }
-      st->hierarchical = false;
+      st->hier_split = 0;
+    } else {
+      st->hier_split = (int32_t)st->local_size;
     }
   }
   std::string timeline_path = EnvStr("HOROVOD_TIMELINE", "");
@@ -1092,22 +1199,7 @@ int hvdtpu_init() {
   }
   st->timeline_mark_cycles =
       EnvInt64("HOROVOD_TIMELINE_MARK_CYCLES", 0) != 0;
-  if (EnvInt64("HOROVOD_AUTOTUNE", 0) != 0) {
-    st->param_manager = std::make_unique<ParameterManager>();
-    st->param_manager->Initialize(
-        st->fusion_threshold.load(), st->cycle_time_ms.load(),
-        EnvStr("HOROVOD_AUTOTUNE_LOG", ""),
-        (int)EnvInt64("HOROVOD_AUTOTUNE_STEPS", 20),
-        EnvInt64("HOROVOD_AUTOTUNE_WINDOW_BYTES", 1 << 20),
-        (int)EnvInt64("HOROVOD_AUTOTUNE_WINDOW_CYCLES", 20),
-        RingChunkBytes(), WireCompression(),
-        // Compression joins the grid only when the user opted into
-        // compressed numerics; the tuner may still settle on OFF
-        // (strictly more accurate), never the other way around.
-        /*tune_wire_compression=*/WireCompression());
-  } else {
-    st->param_manager.reset();
-  }
+  InitAutotune(*st);
   st->initialized = true;
   st->background_thread = std::thread(BackgroundThreadLoop, std::ref(*st));
   LOG_INFO("initialized rank %d/%d", st->rank, st->size);
@@ -1224,7 +1316,7 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
   const int old_local_size = st->local_size;
   const int old_cross_rank = st->cross_rank;
   const int old_cross_size = st->cross_size;
-  const bool old_hierarchical = st->hierarchical;
+  const int32_t old_hier_split = st->hier_split.load();
   const int64_t old_epoch = st->epoch.load();
   // Keep the old generation's sockets OPEN until the new ring is up:
   // closing them now would feed other survivors an EOF on a live
@@ -1240,15 +1332,52 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
       std::move(st->process_sets);
   st->rank = new_rank;
   st->size = nranks;
-  // Post-reformation layout is flat: host-locality bookkeeping from the
-  // launcher no longer matches the renumbered world, and hierarchical
-  // allreduce requires it — the driver path (full re-rendezvous)
-  // restores locality-aware layouts.
-  st->local_rank = new_rank;
-  st->local_size = nranks;
-  st->cross_rank = 0;
-  st->cross_size = 1;
-  st->hierarchical = false;
+  // Re-derive the slice layout for the survivor world instead of
+  // force-flattening it: when the old world was hierarchical (so its
+  // rank numbering was PROVABLY host-major), group survivors by their
+  // OLD host (old_rank / old_local_size). If the sorted survivor list
+  // keeps every remaining host at the same contiguous count L, the
+  // renumbered world is host-major again with local_size L and the
+  // cross-plane decomposition stays on; any uneven tiling (or a world
+  // that was flat to begin with) falls back to the flat ring — the
+  // driver path (full re-rendezvous) restores launcher-grade layouts.
+  // Pure local math over the shared survivor list, so every survivor
+  // derives the SAME layout without another collective.
+  int new_local_size = nranks;
+  int32_t new_hier_split = 0;
+  if (old_hier_split > 1 && old_local_size > 0) {
+    bool tiles = true;
+    for (int i = 1; i < nranks; i++) {
+      if (ranks[i] <= ranks[i - 1]) tiles = false;  // must be sorted
+    }
+    int L = -1, count = 0, prev_host = -1;
+    for (int i = 0; i < nranks && tiles; i++) {
+      int host = ranks[i] / old_local_size;
+      if (host != prev_host) {
+        if (prev_host >= 0) {
+          if (L < 0) L = count;
+          else if (count != L) tiles = false;
+        }
+        prev_host = host;
+        count = 1;
+      } else {
+        count++;
+      }
+    }
+    if (tiles && prev_host >= 0) {
+      if (L < 0) L = count;
+      else if (count != L) tiles = false;
+    }
+    if (tiles && L > 1 && nranks % L == 0 && nranks / L > 1) {
+      new_local_size = L;
+      new_hier_split = (int32_t)L;
+    }
+  }
+  st->local_rank = new_rank % new_local_size;
+  st->local_size = new_local_size;
+  st->cross_rank = new_rank / new_local_size;
+  st->cross_size = nranks / new_local_size;
+  st->hier_split = new_hier_split;
   st->epoch = epoch;
   st->joined = false;
   st->last_joined_rank = -1;
@@ -1283,7 +1412,7 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
     st->local_size = old_local_size;
     st->cross_rank = old_cross_rank;
     st->cross_size = old_cross_size;
-    st->hierarchical = old_hierarchical;
+    st->hier_split = old_hier_split;
     st->epoch = old_epoch;
     return -4;
   }
@@ -1304,6 +1433,11 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
   st->shutdown_requested = false;
   st->loop_exited = false;
   st->loop_failed = false;
+  // Rebuild the autotuner for the re-formed world: its hier-split grid
+  // must cover the RE-DERIVED layout (a stale grid's next window would
+  // stomp the new split with a divisor of the dead layout), and the
+  // old samples scored a different world anyway.
+  InitAutotune(*st);
   st->background_thread = std::thread(BackgroundThreadLoop, std::ref(*st));
   LOG_INFO("re-formed ring: rank %d/%d at epoch %lld", new_rank, nranks,
            (long long)epoch);
@@ -1756,6 +1890,33 @@ int hvdtpu_wire_compression() { return WireCompression() ? 1 : 0; }
 
 void hvdtpu_set_wire_compression(int v) { SetWireCompression(v != 0); }
 
+// Cross-plane topology descriptor (HOROVOD_CROSS_PLANE): 0 auto, 1 ici,
+// 2 ring, 3 hier — fixed at init (the mode is a per-job choice; the
+// SPLIT within hier/auto is the runtime knob below).
+int hvdtpu_cross_plane() {
+  return g_state != nullptr ? g_state->cross_plane_mode : 0;
+}
+
+// Active hierarchy split point: 0 = flat ring, s >= 2 = intra-slice
+// group size of the three-phase decomposition. MUST be set identically
+// on every rank of a live job (the split decides which plane sequence
+// a collective decomposes into); the autotuner syncs it via the
+// ResponseList like the ring knobs.
+int hvdtpu_hier_split() {
+  CHECK_INIT(-1)
+  return g_state->hier_split.load();
+}
+
+void hvdtpu_set_hier_split(int split) {
+  if (g_state) g_state->hier_split = split;
+}
+
+// Whether the bf16 wire codec rides the inter-slice hop only
+// (HOROVOD_CROSS_PLANE_COMPRESSION; fixed at init).
+int hvdtpu_cross_compression() {
+  return (g_state != nullptr && g_state->cross_compression) ? 1 : 0;
+}
+
 // Ring segment-ownership rotation (pure, valid before init): the ONE
 // encoding of "after the reduce phase at rotation `rot`, which segment
 // does rank r own / send at step s" — see ring_ops.h. Exposed so
@@ -1805,6 +1966,9 @@ int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap) {
       info.ring_chunk_bytes = RingChunkBytes();
       info.wire_compression = WireCompression();
       info.wire_timeout_ms = WireTimeoutMs();
+      info.cross_plane = g_state->cross_plane_mode;
+      info.hier_split = g_state->hier_split.load();
+      info.cross_compression = g_state->cross_compression;
       info.epoch = g_state->epoch.load();
       const ResponseCache& c = g_state->controller->response_cache();
       info.cache_hits = c.hits();

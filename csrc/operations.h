@@ -122,6 +122,16 @@ double hvdtpu_cycle_time_ms();
 void hvdtpu_set_fusion_threshold_bytes(int64_t v);
 void hvdtpu_set_cycle_time_ms(double v);
 
+// Cross-plane collective engine (HOROVOD_CROSS_PLANE, docs/
+// redistribute.md): mode (0 auto, 1 ici, 2 ring, 3 hier), the active
+// hierarchy split point (0 flat; s >= 2 intra-slice group size;
+// rank-uniform — the autotuner syncs it via the ResponseList), and the
+// cross-hop-only bf16 wire codec flag.
+int hvdtpu_cross_plane();
+int hvdtpu_hier_split();
+void hvdtpu_set_hier_split(int split);
+int hvdtpu_cross_compression();
+
 // Response-cache introspection (reference analog: the cache stats the
 // timeline/autotune read from response_cache.h). Capacity via
 // HOROVOD_CACHE_CAPACITY (default 1024; 0 disables).

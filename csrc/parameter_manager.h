@@ -36,13 +36,19 @@ class ParameterManager {
   // set when the USER enabled HOROVOD_WIRE_COMPRESSION (the tuner may
   // then fall back to the strictly-more-accurate uncompressed wire,
   // but never silently narrows a run the user wanted full-width).
+  // hier_values is the hierarchy-split-point grid of the cross-plane
+  // allreduce (0 = flat ring, d >= 2 = intra-slice group size; the
+  // eligible divisors of local_size — operations.cc builds it). A
+  // single value pins the dimension; hier_split seeds the start point.
   void Initialize(int64_t fusion_bytes, double cycle_ms,
                   const std::string& log_path, int max_samples = 20,
                   int64_t window_bytes = 1 << 20,
                   int window_cycles = 20,
                   int64_t ring_chunk_bytes = 256 * 1024,
                   bool wire_compression = false,
-                  bool tune_wire_compression = false);
+                  bool tune_wire_compression = false,
+                  std::vector<int64_t> hier_values = {},
+                  int64_t hier_split = 0);
   ~ParameterManager();
 
   bool active() const { return active_; }
@@ -50,6 +56,7 @@ class ParameterManager {
   double cycle_time_ms() const { return cycle_values_[cycle_idx_]; }
   int64_t ring_chunk_bytes() const { return chunk_values_[chunk_idx_]; }
   bool wire_compression() const { return comp_values_[comp_idx_] != 0; }
+  int64_t hier_split() const { return hier_values_[hier_idx_]; }
 
   // Record bytes moved by allreduce responses this cycle; returns true when
   // a tuning window closed and the recommended parameters may have changed.
@@ -67,11 +74,13 @@ class ParameterManager {
   std::vector<double> cycle_values_;
   std::vector<int64_t> chunk_values_;
   std::vector<int> comp_values_;  // {0} / {1} fixed, or {0,1} tuned
+  std::vector<int64_t> hier_values_ = {0};  // {0} fixed, else split grid
   size_t fusion_idx_ = 0, cycle_idx_ = 0, chunk_idx_ = 0, comp_idx_ = 0;
+  size_t hier_idx_ = 0;
 
   // Bayesian optimization over the flattened grid: candidate index
-  // c = ((fusion_i * |cycle| + cycle_i) * |chunk| + chunk_i) * |comp|
-  //     + comp_i.
+  // c = (((fusion_i * |cycle| + cycle_i) * |chunk| + chunk_i) * |comp|
+  //     + comp_i) * |hier| + hier_i.
   std::unique_ptr<BayesOpt> opt_;
   size_t current_candidate_ = 0;
   int max_samples_ = 20;

@@ -208,10 +208,11 @@ class ReduceWorker {
 // width — the pair the wire-vs-logical reconciliation in telemetry
 // reads (compression_ratio = tx / tx_logical).
 struct DataPlane::WireTally {
+  int plane = 0;  // 0 intra/flat, 1 cross-slice (set from wire_plane_)
   int64_t tx = 0, rx = 0, tx_logical = 0, rx_logical = 0;
   ~WireTally() {
     if (tx || rx || tx_logical || rx_logical) {
-      GlobalMetrics().AccountWire(tx, rx, tx_logical, rx_logical);
+      GlobalMetrics().AccountWire(plane, tx, rx, tx_logical, rx_logical);
     }
   }
 };
@@ -367,6 +368,10 @@ DataPlane DataPlane::Subset(const std::vector<int32_t>& members) const {
   DataPlane sub(my_idx, (int)members.size(), std::move(fds),
                 /*owns_fds=*/false);
   sub.global_ranks_ = members;
+  // Views inherit the parent's wire plane + compression override;
+  // HierarchicalAllreduce re-tags its inter-slice subset explicitly.
+  sub.wire_plane_ = wire_plane_;
+  sub.force_compression_ = force_compression_;
   // Share the parent's overlap worker: the core's single background
   // thread is the only caller on both, so per-response subset views
   // never spawn (and tear down) their own thread.
@@ -376,7 +381,8 @@ DataPlane DataPlane::Subset(const std::vector<int32_t>& members) const {
 
 Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
                                         ReduceOp op, int local_size,
-                                        double postscale) {
+                                        double postscale,
+                                        bool compress_cross) {
   if (size_ == 1 || count == 0) {
     ScaleBuffer(buf, count, dt, postscale);
     return Status::OK();
@@ -391,8 +397,10 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
   const int node = rank_ / local_size;
   const int64_t elem = DataTypeSize(dt);
 
-  // Local group: the ranks on this node; cross group: same local_rank on
-  // every node (host-major layout).
+  // Local group: the ranks on this slice; cross group: same local_rank
+  // on every slice (host-major layout). The cross subset is the
+  // CROSS-PLANE hop: its wire bytes are booked under the cross
+  // counters, and `compress_cross` puts the bf16 codec on it alone.
   std::vector<int32_t> local_members(local_size);
   for (int i = 0; i < local_size; i++) {
     local_members[i] = node * local_size + i;
@@ -403,6 +411,8 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
   }
   DataPlane local = Subset(local_members);
   DataPlane cross = Subset(cross_members);
+  cross.set_wire_plane(1);
+  if (compress_cross) cross.set_force_compression(true);
 
   // Phase 1: local reduce-scatter — this rank ends with its segment
   // reduced across the node.
@@ -743,7 +753,9 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
   }
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
-  if (WireCompression() && dt == DataType::HVDTPU_FLOAT32 &&
+  tally.plane = wire_plane_;
+  if ((WireCompression() || force_compression_) &&
+      dt == DataType::HVDTPU_FLOAT32 &&
       (op == ReduceOp::SUM || op == ReduceOp::AVERAGE)) {
     // Linear ops only: the per-hop bf16 rounding composes with sums
     // (full-precision accumulate), and AVERAGE is sum + postscale.
@@ -791,6 +803,7 @@ Status DataPlane::Allgatherv(const void* input, void* output,
   if (size_ == 1) return Status::OK();
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
+  tally.plane = wire_plane_;
   for (int step = 0; step < size_ - 1; step++) {
     int send_blk = (rank_ - step + size_) % size_;
     int recv_blk = (rank_ - step - 1 + size_) % size_;
@@ -817,6 +830,7 @@ Status DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
   bool is_root = rank_ == root;
   bool forwards = !is_root && right != root;
   WireTally tally;
+  tally.plane = wire_plane_;
   if (is_root || forwards) {
     tally.tx += bytes;
     tally.tx_logical += bytes;
@@ -883,6 +897,7 @@ Status DataPlane::Alltoallv(const void* input,
               (size_t)send_bytes[rank_]);
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
+  tally.plane = wire_plane_;
   // Symmetric pairing: in round r, rank i partners with (r - i) mod size —
   // an involution, so each unordered pair {i, j} exchanges exactly once, in
   // round (i + j) mod size.
@@ -925,11 +940,13 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
   }
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
+  tally.plane = wire_plane_;
   // rot = -1: after size-1 steps the segment that has accumulated all
   // `size` contributions at rank r is exactly segment r (the API output
   // segment — see RingOwnedSegment).
   const int own = RingOwnedSegment(rank_, size_, /*rot=*/-1);
-  if (WireCompression() && dt == DataType::HVDTPU_FLOAT32 &&
+  if ((WireCompression() || force_compression_) &&
+      dt == DataType::HVDTPU_FLOAT32 &&
       (op == ReduceOp::SUM || op == ReduceOp::AVERAGE)) {
     // Linear ops only, same contract as the compressed allreduce: the
     // per-hop bf16 rounding composes with sums (full-precision f32

@@ -107,15 +107,22 @@ class DataPlane {
   Status Allreduce(void* buf, int64_t count, DataType dt, ReduceOp op,
                    double postscale = 1.0);
 
-  // Hierarchical allreduce (HOROVOD_HIERARCHICAL_ALLREDUCE): local
-  // reduce-scatter -> cross-node allreduce of each segment among
-  // same-local-rank peers -> local allgather, cutting cross-node traffic
-  // by the local group size. Requires the host-major homogeneous layout
-  // (rank = cross_rank * local_size + local_rank) on the GLOBAL plane.
+  // Hierarchical cross-plane allreduce (HOROVOD_CROSS_PLANE=hier, or
+  // the legacy HOROVOD_HIERARCHICAL_ALLREDUCE spelling): intra-slice
+  // reduce-scatter -> inter-slice allreduce of each 1/local_size shard
+  // among same-local-rank peers -> intra-slice allgather, cutting
+  // cross-slice traffic by the local group size. Requires the
+  // host-major homogeneous layout (rank = cross_rank * local_size +
+  // local_rank) on the GLOBAL plane. The inter-slice subset is tagged
+  // as the CROSS wire plane (metrics book its bytes separately), and
+  // `compress_cross` puts the bf16 wire codec on that hop alone — the
+  // EQuARX cheap-wire recipe applied to the expensive fabric only
+  // (docs/redistribute.md).
   // Reference analog: NCCLHierarchicalAllreduce (ops/nccl_operations.cc).
   Status HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
                                ReduceOp op, int local_size,
-                               double postscale = 1.0);
+                               double postscale = 1.0,
+                               bool compress_cross = false);
 
   // Adaptive-summation allreduce (recursive doubling, floats only).
   // Reference analog: ops/adasum/ (see csrc/adasum.cc).
@@ -154,6 +161,21 @@ class DataPlane {
 
   int rank() const { return rank_; }
   int size() const { return size_; }
+
+  // Wire-plane tag for metrics accounting: 0 = intra/flat (the default
+  // ring), 1 = cross (the inter-slice hop of the hierarchical
+  // decomposition). Subset views inherit the parent's tag;
+  // HierarchicalAllreduce overrides it on its inter-slice subset so
+  // telemetry can reconcile per-plane logical-vs-wire bytes exactly.
+  void set_wire_plane(int plane) { wire_plane_ = plane; }
+  int wire_plane() const { return wire_plane_; }
+
+  // Per-plane compression override: when set, fp32 SUM/AVERAGE
+  // collectives on THIS plane ride the bf16 wire codec even with the
+  // process-global knob off (used for the cross-plane hop; per-plane
+  // state, so concurrent planes — the selftest mesh — cannot race a
+  // global toggle).
+  void set_force_compression(bool on) { force_compression_ = on; }
 
   // Group index of a global rank (identity on the global plane), or -1 if
   // the rank is not in this (sub)group. Callers must translate global rank
@@ -219,6 +241,8 @@ class DataPlane {
   std::vector<int> peer_fds_;
   std::vector<int32_t> global_ranks_;  // group index -> global rank
   bool owns_fds_ = true;
+  int wire_plane_ = 0;              // 0 intra/flat, 1 cross-slice
+  bool force_compression_ = false;  // per-plane bf16-on-wire override
   std::vector<uint8_t> scratch_;        // bulk-path recv segment
   std::vector<uint8_t> chunk_scratch_;  // 2 chunks (double-buffered recv)
   std::vector<uint8_t> comp_send_scratch_;  // bf16-encoded send chunk

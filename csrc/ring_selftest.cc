@@ -111,9 +111,41 @@ void RingOrderReference(int ranks, int64_t count, DataType dt, ReduceOp op,
   ScaleBuffer(ref->data(), count, dt, postscale);
 }
 
+// Integer-valued fill in [-4, 4]: every partial sum is exact in f32
+// (and in bf16, for the magnitudes the selftests use), so ANY
+// association order — flat ring, hierarchical, compressed — must land
+// on bit-identical results. The hierarchical bit-exactness pin rides
+// this: float addition is non-associative in general, but exact
+// arithmetic erases the association, leaving only real bugs visible.
+double ExactFillValue(int rank, int64_t e) {
+  uint64_t h = (uint64_t)(rank + 1) * 1315423911ull +
+               (uint64_t)(e + 1) * 2654435761ull;
+  return (double)((int64_t)(h % 9) - 4);
+}
+
 // Serializes concurrent selftests: the ring knobs are process-global,
 // and two overlapping runs with different framing would cross wires.
 std::mutex g_selftest_mutex;
+
+// Full socketpair mesh for `ranks` planes; false on socketpair failure
+// (already-created fds closed).
+bool BuildMesh(int ranks, std::vector<std::vector<int>>* fds) {
+  fds->assign(ranks, std::vector<int>(ranks, -1));
+  for (int i = 0; i < ranks; i++) {
+    for (int j = i + 1; j < ranks; j++) {
+      int sv[2];
+      if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        for (auto& row : *fds) {
+          for (int fd : row) TcpClose(fd);
+        }
+        return false;
+      }
+      (*fds)[i][j] = sv[0];
+      (*fds)[j][i] = sv[1];
+    }
+  }
+  return true;
+}
 
 }  // namespace
 }  // namespace hvdtpu
@@ -150,23 +182,8 @@ int hvdtpu_ring_selftest(int ranks, int64_t count, int dtype, int reduce_op,
 
   // Full socketpair mesh (the ring only uses neighbors, but Subset and
   // future paths index arbitrary peers).
-  std::vector<std::vector<int>> fds(ranks, std::vector<int>(ranks, -1));
-  bool sock_ok = true;
-  for (int i = 0; i < ranks && sock_ok; i++) {
-    for (int j = i + 1; j < ranks; j++) {
-      int sv[2];
-      if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
-        sock_ok = false;
-        break;
-      }
-      fds[i][j] = sv[0];
-      fds[j][i] = sv[1];
-    }
-  }
-  if (!sock_ok) {
-    for (auto& row : fds) {
-      for (int fd : row) TcpClose(fd);
-    }
+  std::vector<std::vector<int>> fds;
+  if (!BuildMesh(ranks, &fds)) {
     SetRingChunkBytes(saved_chunk);
     SetWireCompression(saved_comp);
     return -2;
@@ -221,6 +238,101 @@ int hvdtpu_ring_selftest(int ranks, int64_t count, int dtype, int reduce_op,
                              (op == ReduceOp::SUM ||
                               op == ReduceOp::AVERAGE);
       if (!compressed_path) rc = -4;
+    }
+    if (r > 0 && std::memcmp(results[r].data(), results[0].data(),
+                             results[r].size()) != 0) {
+      rc = -5;  // ranks must agree bitwise, compressed or not
+    }
+  }
+  if (max_abs_err_out != nullptr) *max_abs_err_out = max_err;
+  return rc;
+}
+
+// In-process loopback proof of the CROSS-PLANE hierarchical allreduce
+// (DataPlane::HierarchicalAllreduce) at an emulated `ranks/local_size`
+// slices x `local_size` ranks topology. `compression`: 0 = none,
+// 1 = every hop (the global HOROVOD_WIRE_COMPRESSION path), 2 = the
+// inter-slice hop only (HOROVOD_CROSS_PLANE_COMPRESSION). `exact_fill`
+// != 0 fills with small integers whose partial sums are exact in f32
+// AND bf16 — under exact arithmetic every association order collapses
+// to the same bits, so the hierarchical result must be BIT-IDENTICAL
+// to the flat ring-order reference (rc -4 otherwise; enforced for
+// compression == 0). Ranks must agree bitwise in every configuration
+// (rc -5). `max_abs_err_out` receives max |result - flat reference|
+// for the compressed-bound assertions (docs/wire.md: N^2 * 2^-7 on
+// values in [-2, 2]).
+int hvdtpu_hier_selftest(int ranks, int local_size, int64_t count,
+                         int dtype, int reduce_op, int64_t chunk_bytes,
+                         int compression, int exact_fill,
+                         double postscale, double* max_abs_err_out) {
+  if (max_abs_err_out != nullptr) *max_abs_err_out = 0.0;
+  if (ranks < 1 || ranks > 64 || count < 0 || dtype < 0 || dtype > 9 ||
+      local_size < 1 || ranks % local_size != 0) {
+    return -1;
+  }
+  DataType dt = (DataType)dtype;
+  ReduceOp op = (ReduceOp)reduce_op;
+  const int64_t elem = DataTypeSize(dt);
+
+  std::lock_guard<std::mutex> lock(g_selftest_mutex);
+  const int64_t saved_chunk = RingChunkBytes();
+  const bool saved_comp = WireCompression();
+  SetRingChunkBytes(chunk_bytes);
+  SetWireCompression(compression == 1);
+  const bool compress_cross = compression == 2;
+
+  std::vector<std::vector<int>> fds;
+  if (!BuildMesh(ranks, &fds)) {
+    SetRingChunkBytes(saved_chunk);
+    SetWireCompression(saved_comp);
+    return -2;
+  }
+
+  std::vector<std::vector<uint8_t>> inputs(ranks);
+  for (int r = 0; r < ranks; r++) {
+    inputs[r].resize((size_t)(count * elem));
+    for (int64_t e = 0; e < count; e++) {
+      StoreAs(dt, inputs[r].data(), e,
+              exact_fill ? ExactFillValue(r, e) : FillValue(r, e));
+    }
+  }
+  // The FLAT ring-order reference: with exact fills any association is
+  // bit-identical to it; with real fills it anchors the error bound.
+  std::vector<uint8_t> ref;
+  RingOrderReference(ranks, count, dt, op, postscale, inputs, &ref);
+
+  std::vector<std::vector<uint8_t>> results = inputs;
+  std::vector<Status> statuses(ranks);
+  {
+    std::vector<std::thread> threads;
+    threads.reserve(ranks);
+    for (int r = 0; r < ranks; r++) {
+      threads.emplace_back([&, r] {
+        DataPlane dp(r, ranks, std::move(fds[r]));
+        statuses[r] = dp.HierarchicalAllreduce(
+            results[r].data(), count, dt, op, local_size, postscale,
+            compress_cross);
+      });
+    }
+    for (auto& t : threads) t.join();
+  }
+  SetRingChunkBytes(saved_chunk);
+  SetWireCompression(saved_comp);
+
+  for (int r = 0; r < ranks; r++) {
+    if (!statuses[r].ok()) return -3;
+  }
+  double max_err = 0.0;
+  int rc = 0;
+  for (int r = 0; r < ranks; r++) {
+    for (int64_t e = 0; e < count; e++) {
+      max_err = std::max(max_err,
+                         std::fabs(LoadAs(dt, results[r].data(), e) -
+                                   LoadAs(dt, ref.data(), e)));
+    }
+    if (exact_fill && compression == 0 &&
+        std::memcmp(results[r].data(), ref.data(), ref.size()) != 0) {
+      rc = -4;  // exact arithmetic: association cannot explain a diff
     }
     if (r > 0 && std::memcmp(results[r].data(), results[0].data(),
                              results[r].size()) != 0) {

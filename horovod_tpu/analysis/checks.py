@@ -160,12 +160,24 @@ def check_schedule_conformance(ex, ctx):
     """C5: the traced collective sequence must equal the host-side
     prediction (``expect_collectives`` — built by
     ``parallel.pipeline.predicted_collectives`` from the same schedule
-    tables the engines execute)."""
+    tables the engines execute, or by
+    ``parallel.ops.predicted_hier_collectives`` /
+    ``ReshardPlan.expected_collectives`` for the composed-plane and
+    redistribute programs). The reduce-scatter primitive is spelled
+    differently across jax versions (``psum_scatter`` vs
+    ``reduce_scatter``); both sides normalize so a version bump cannot
+    fake a divergence."""
     expected = ctx.get("expect_collectives")
     if expected is None:
         return []
-    actual = [(c.prim, tuple(c.axes)) for c in linearize(ex.signature)]
-    expected = [(p, tuple(a) if isinstance(a, (tuple, list)) else (a,))
+
+    def norm(p):
+        return "reduce_scatter" if p in _SCATTER_PRIMS else p
+
+    actual = [(norm(c.prim), tuple(c.axes))
+              for c in linearize(ex.signature)]
+    expected = [(norm(p), tuple(a) if isinstance(a, (tuple, list))
+                 else (a,))
                 for p, a in expected]
     if actual == expected:
         return []

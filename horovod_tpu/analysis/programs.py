@@ -200,6 +200,91 @@ def _zero_shard_apply(config):
                     axis_env=[("data", _ZERO_SHARDS)])
 
 
+_HIER_INTRA, _HIER_INTER = 2, 2
+
+
+def _hier_allreduce(config):
+    """The composed-plane allreduce (``parallel.ops.hier_allreduce``):
+    reduce-scatter over the intra (ICI) axis, psum of the 1/L shard
+    over the inter (DCN) axis, allgather back — traced with BOTH axes
+    in the env so C2 validates the composed axes and C5 pins the plane
+    sequence against ``predicted_hier_collectives`` (the same
+    three-step table csrc's HierarchicalAllreduce executes)."""
+    del config
+    from horovod_tpu.parallel.ops import (
+        hier_allreduce,
+        predicted_hier_collectives,
+    )
+
+    def fn(x):
+        return hier_allreduce(x, "intra", "inter")
+
+    x = jax.ShapeDtypeStruct((8 * _HIER_INTRA, 4), jnp.float32)
+    return LintSpec(
+        fn=fn, args=(x,),
+        axis_env=[("intra", _HIER_INTRA), ("inter", _HIER_INTER)],
+        expect_collectives=predicted_hier_collectives("intra", "inter"))
+
+
+def _zero_shard_apply_hier(config):
+    """The cross-plane ZeRO apply (``ZeroConfig(inter_axis=...)``): the
+    RS/AG pair rides the intra axis while the 1/N gradient shard psums
+    over the inter axis between them. C6 must still see every
+    reduce-scatter paired with a same-axis allgather (the interleaved
+    cross-plane psum sits between, which order-based counting
+    tolerates), and C2 validates both axes."""
+    from horovod_tpu.parallel.precision import fused_adam
+    from horovod_tpu.parallel.zero import (
+        ZeroAdamState,
+        build_zero_apply_inner,
+        zero_bucket_layout,
+    )
+
+    cfg = _config(config)
+    params = _abstract_params(cfg)
+    leaves, _ = jax.tree.flatten(params)
+    layout = zero_bucket_layout(leaves, _ZERO_SHARDS, 1 << 20)
+    inner = build_zero_apply_inner(
+        fused_adam(1e-3).hyper, layout, "data", _ZERO_SHARDS,
+        inter_axis="cross", inter_size=_HIER_INTER)
+    flat = tuple(jax.ShapeDtypeStruct((b.padded,), b.dtype)
+                 for b in layout.buckets)
+    shard = tuple(
+        jax.ShapeDtypeStruct((b.shard_elems(_ZERO_SHARDS),), b.dtype)
+        for b in layout.buckets)
+    opt = ZeroAdamState(
+        count=jax.ShapeDtypeStruct((1,), jnp.int32),
+        mu=shard, nu=shard)
+    return LintSpec(fn=inner, args=(flat, flat, opt),
+                    axis_env=[("data", _ZERO_SHARDS),
+                              ("cross", _HIER_INTER)])
+
+
+def _redistribute_to_replicated(config):
+    """The registered redistribute program for the sharded->replicated
+    plan: the in-graph equivalent of one allgatherv, with C5's expected
+    sequence taken from the PLAN itself
+    (``ReshardPlan.expected_collectives``) — a plan edit that changes
+    the collective mix without this program following along (or vice
+    versa) fails lint before it ships."""
+    del config
+    from jax import lax
+
+    from horovod_tpu.parallel.reshard import Layout, plan_redistribute
+
+    shards, rows = 4, 16
+    plan = plan_redistribute((rows, 4), jnp.float32,
+                             Layout.sharded(rows, shards),
+                             Layout.replicated(shards))
+
+    def fn(x):
+        return lax.all_gather(x, "shard", axis=0, tiled=True)
+
+    x = jax.ShapeDtypeStruct((rows // shards, 4), jnp.float32)
+    return LintSpec(fn=fn, args=(x,), axis_env=[("shard", shards)],
+                    expect_collectives=plan.expected_collectives("shard"))
+
+
 def _pipeline(config, schedule):
     from horovod_tpu.models.llama import llama_pipeline_programs
     from horovod_tpu.parallel.pipeline import (
@@ -248,6 +333,9 @@ _REGISTRY = {
     "llama_train_step_split_telemetry": _split_telemetry,
     "llama_train_step_split_zero1": _split_zero,
     "zero1_shard_apply": _zero_shard_apply,
+    "zero1_shard_apply_hier": _zero_shard_apply_hier,
+    "hier_allreduce": _hier_allreduce,
+    "redistribute_to_replicated": _redistribute_to_replicated,
     "pipeline_gpipe":
         functools.partial(_pipeline, schedule="gpipe"),
     "pipeline_1f1b":

@@ -155,6 +155,18 @@ def load_library():
         lib.hvdtpu_ring_selftest.argtypes = [
             i32, i64, i32, i32, i64, i32, dbl,
             ctypes.POINTER(ctypes.c_double)]
+        lib.hvdtpu_hier_selftest.restype = i32
+        lib.hvdtpu_hier_selftest.argtypes = [
+            i32, i32, i64, i32, i32, i64, i32, i32, dbl,
+            ctypes.POINTER(ctypes.c_double)]
+        lib.hvdtpu_cross_plane.restype = i32
+        lib.hvdtpu_cross_plane.argtypes = []
+        lib.hvdtpu_hier_split.restype = i32
+        lib.hvdtpu_hier_split.argtypes = []
+        lib.hvdtpu_set_hier_split.restype = None
+        lib.hvdtpu_set_hier_split.argtypes = [i32]
+        lib.hvdtpu_cross_compression.restype = i32
+        lib.hvdtpu_cross_compression.argtypes = []
         lib.hvdtpu_ring_owned_segment.restype = i32
         lib.hvdtpu_ring_owned_segment.argtypes = [i32, i32, i32]
         lib.hvdtpu_ring_send_segment.restype = i32
@@ -428,6 +440,59 @@ class HorovodBasics:
         rc = self.lib.hvdtpu_ring_selftest(
             int(ranks), int(count), int(dtype), int(op), int(chunk_bytes),
             1 if compression else 0, float(postscale), _ct.byref(err))
+        return rc, err.value
+
+    #: HOROVOD_CROSS_PLANE mode names in core enum order.
+    CROSS_PLANE_MODES = ("auto", "ici", "ring", "hier")
+
+    def cross_plane(self):
+        """The cross-plane topology descriptor (``HOROVOD_CROSS_PLANE``)
+        as one of ``"auto"|"ici"|"ring"|"hier"`` — how collectives pick
+        (or compose) the ICI device plane and the host/DCN ring. Fixed
+        at init; see ``docs/redistribute.md``."""
+        return self.CROSS_PLANE_MODES[self.lib.hvdtpu_cross_plane()]
+
+    def hier_split(self):
+        """Active hierarchy split point of the cross-plane allreduce:
+        0 = flat host ring, ``s >= 2`` = intra-slice group size of the
+        three-phase decomposition (reduce-scatter intra, allreduce of
+        the 1/s shards inter, allgather intra). -1 before init."""
+        return self.lib.hvdtpu_hier_split()
+
+    def set_hier_split(self, split):
+        """Set the hierarchy split point. MUST be rank-uniform — the
+        split decides which plane sequence every collective decomposes
+        into (the autotuner syncs its moves via the ResponseList)."""
+        self.lib.hvdtpu_set_hier_split(int(split))
+
+    def cross_compression(self):
+        """Whether the bf16 wire codec rides the inter-slice hop only
+        (``HOROVOD_CROSS_PLANE_COMPRESSION``) — cheap wire on the
+        DCN-priced fabric, full width intra-slice."""
+        return bool(self.lib.hvdtpu_cross_compression())
+
+    def hier_selftest(self, ranks, local_size, count, dtype=6, op=1,
+                      chunk_bytes=None, compression=0, exact_fill=True,
+                      postscale=1.0):
+        """In-process loopback proof of the hierarchical cross-plane
+        allreduce at an emulated ``ranks/local_size`` slices x
+        ``local_size`` ranks topology (no init needed).
+
+        ``compression``: 0 = none, 1 = every hop, 2 = the inter-slice
+        hop only. With ``exact_fill`` (small integers — exact in f32
+        and bf16) an uncompressed pass must be BIT-IDENTICAL to the
+        flat ring reference. Returns ``(rc, max_abs_err)``; rc 0 =
+        pass, -4 = bit-exactness violated, -5 = ranks disagree.
+        """
+        import ctypes as _ct
+
+        if chunk_bytes is None:
+            chunk_bytes = self.ring_chunk_bytes()
+        err = _ct.c_double()
+        rc = self.lib.hvdtpu_hier_selftest(
+            int(ranks), int(local_size), int(count), int(dtype), int(op),
+            int(chunk_bytes), int(compression), 1 if exact_fill else 0,
+            float(postscale), _ct.byref(err))
         return rc, err.value
 
     def response_cache_stats(self):

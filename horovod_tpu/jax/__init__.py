@@ -84,6 +84,12 @@ from horovod_tpu.jax.optimizer import (  # noqa: F401
     allreduce_gradients,
 )
 
+# Resharding engine (docs/redistribute.md): hvd.redistribute moves a
+# jax array between shardings with the minimal collective sequence —
+# the shared primitive of checkpoint resharding (train on N, serve on
+# M) and elastic re-formation.
+from horovod_tpu.parallel.reshard import redistribute  # noqa: E402,F401
+
 from horovod_tpu.jax import elastic  # noqa: E402,F401
 
 # Capability surface (reference analog: hvd.mpi_built()/gloo_built()/...).

@@ -130,9 +130,20 @@ class XlaIciDataPlane:
         Multi-process: initializes ``jax.distributed`` against the
         controller host (port = HOROVOD_XLA_COORD_PORT or controller
         port + 1) unless the caller already did.
+
+        Plane selection (``HOROVOD_CROSS_PLANE``, docs/redistribute.md):
+        ``ring`` forces every collective onto the host ring — the
+        device plane refuses to activate so frontends transparently
+        fall back; ``ici``/``auto``/``hier`` all want this plane up
+        (under ``hier`` the HOST side of a device-ineligible collective
+        still decomposes hierarchically in the core).
         """
         if self._active:
             return
+        if cross_plane_mode() == "ring":
+            raise RuntimeError(
+                "HOROVOD_CROSS_PLANE=ring forces host-ring collectives; "
+                "the xla_ici device plane stays disabled under it")
         rank, size = _basics.rank(), _basics.size()
         if rank < 0:
             raise RuntimeError("hvd.init() must run before the XLA data "
@@ -626,6 +637,23 @@ def _build_reducescatter(mesh, group, reduce_op, scale, off, nrows):
 
 # Module-level singleton; frontends share it.
 _data_plane = XlaIciDataPlane()
+
+
+def cross_plane_mode():
+    """The job's cross-plane topology descriptor — the core's parsed
+    ``HOROVOD_CROSS_PLANE`` when it is initialized (covers the legacy
+    ``HOROVOD_HIERARCHICAL_ALLREDUCE`` mapping), else the raw env.
+    One of ``"auto" | "ici" | "ring" | "hier"``."""
+    if _basics.lib.hvdtpu_is_initialized():
+        return HorovodBasics.CROSS_PLANE_MODES[
+            _basics.lib.hvdtpu_cross_plane()]
+    mode = os.environ.get("HOROVOD_CROSS_PLANE", "").strip().lower()
+    if mode in HorovodBasics.CROSS_PLANE_MODES:
+        return mode
+    if os.environ.get("HOROVOD_HIERARCHICAL_ALLREDUCE", "0") not in \
+            ("", "0"):
+        return "hier"
+    return "auto"
 
 
 def data_plane():

@@ -24,11 +24,23 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
 from horovod_tpu.parallel.ops import (  # noqa: F401
     all_gather,
     all_to_all,
+    hier_allreduce,
     pbroadcast,
     pmean,
     ppermute_ring,
+    predicted_hier_collectives,
     psum,
     reduce_scatter,
+)
+from horovod_tpu.parallel.reshard import (  # noqa: F401
+    Layout,
+    ReshardPlan,
+    even_row_layout,
+    execute_plan,
+    layout_from_sharding,
+    plan_redistribute,
+    redistribute,
+    simulate_plan,
 )
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
     build_interleaved_schedule,

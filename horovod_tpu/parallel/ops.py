@@ -40,6 +40,30 @@ def all_to_all(x, axis_name, split_axis, concat_axis):
                           concat_axis=concat_axis, tiled=True)
 
 
+def hier_allreduce(x, intra_axis, inter_axis, axis=0):
+    """Cross-plane composed allreduce (docs/redistribute.md): the
+    in-graph twin of the core's hierarchical host path. Reduce-scatter
+    over ``intra_axis`` (the ICI-priced fabric), psum the 1/L shard
+    over ``inter_axis`` (the DCN-priced fabric — only 1/L of the bytes
+    cross it), allgather back over ``intra_axis``. Equal to
+    ``psum(x, (intra_axis, inter_axis))`` up to f32 association order;
+    bandwidth-optimal on both fabrics at once.
+    """
+    shard = lax.psum_scatter(x, intra_axis, scatter_dimension=axis,
+                             tiled=True)
+    shard = lax.psum(shard, inter_axis)
+    return lax.all_gather(shard, intra_axis, axis=axis, tiled=True)
+
+
+def predicted_hier_collectives(intra_axis, inter_axis):
+    """The host-side collective prediction for :func:`hier_allreduce`
+    — fed to hvdlint's C5 schedule-conformance check, so the composed-
+    plane program and this table can never silently diverge."""
+    return [("psum_scatter", (intra_axis,)),
+            ("psum", (inter_axis,)),
+            ("all_gather", (intra_axis,))]
+
+
 def pbroadcast(x, axis_name, root=0):
     """Broadcast root's shard to all members of the axis.
 

@@ -1,0 +1,517 @@
+"""``redistribute`` — minimal-collective array resharding (docs/redistribute.md).
+
+The planner of "Memory-efficient array redistribution through portable
+collective communication" (arXiv:2112.01075), specialized to the row
+partitions this runtime actually ships: an array is REPLICATED (every
+rank holds all of it), SHARDED (rank r owns a contiguous, ordered row
+range of axis 0), or PARTIAL (every rank holds an unreduced addend —
+the state a gradient is in before its reduction). Between any two such
+layouts there is a *minimal* collective sequence, and emitting exactly
+it — never a gather-everything-then-slice detour — is what makes
+checkpoint resharding (train on N, serve on M) and elastic
+re-formation (docs/elastic.md) affordable:
+
+========== =============== =============================================
+src        dst             plan
+========== =============== =============================================
+X          X (same rows)   [] — zero-copy
+replicated sharded         slice (no wire)
+sharded    replicated      allgatherv
+sharded    sharded         alltoallv (intersection rows to new owners)
+partial    replicated      allreduce
+partial    sharded (even)  reducescatter
+partial    sharded (other) reducescatter + alltoallv
+========== =============== =============================================
+
+Every step carries its exact per-rank wire-byte prediction, derived
+from the SAME ring segment-rotation helpers the C++ engine executes
+(``ring_owned_segment`` twins, csrc/ring_ops.h) — so the plan
+reconciles bit-exactly with the core's wire counters
+(``make reshard-smoke`` pins measured-vs-predicted < 1%).
+
+Three executors share one plan:
+
+- :func:`simulate_plan` — pure-numpy all-rank reference (property
+  tests: src -> dst -> src must be the identity);
+- :func:`execute_plan` — this rank's slice of the plan over the eager
+  host collectives (the checkpoint-resharding path);
+- :func:`redistribute` — jax arrays between ``NamedSharding``s
+  (zero-copy when the shardings agree; XLA moves the bytes otherwise,
+  and the plan prices what the movement costs on the host planes).
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+
+__all__ = [
+    "Layout",
+    "ReshardPlan",
+    "ReshardStep",
+    "plan_redistribute",
+    "simulate_plan",
+    "execute_plan",
+    "redistribute",
+    "layout_from_sharding",
+    "even_row_layout",
+    "hier_wire_bytes",
+    "flat_allreduce_wire_bytes",
+]
+
+
+def _ring_send_segment(rank, step, size, rot=0):
+    """Python twin of ``csrc/ring_ops.h RingSendSegment`` (pinned
+    against the C ABI in tests/single/test_reshard.py)."""
+    return ((rank - step + rot) % size + 2 * size) % size
+
+
+def _even_split(n_rows, n_shards):
+    """The ONE row-split rule, shared with the core (q + remainder to
+    lower ranks — csrc/operations.cc REDUCESCATTER and ring
+    segmentation use the same arithmetic)."""
+    q, r = divmod(n_rows, n_shards)
+    return tuple(q + (1 if i < r else 0) for i in range(n_shards))
+
+
+# ---- layouts ---------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """How one logical array is distributed over ``nranks`` ranks.
+
+    ``kind``: ``"replicated"`` | ``"sharded"`` | ``"partial"``.
+    ``rows``: for sharded, the per-rank ``(start, n)`` row ranges —
+    required to be an ordered contiguous partition of ``[0, n_rows)``
+    (rank r's rows all precede rank r+1's), which is what makes the
+    sharded->sharded alltoallv receive rows already in order.
+    """
+
+    kind: str
+    nranks: int
+    rows: tuple = ()
+
+    def __post_init__(self):
+        if self.kind not in ("replicated", "sharded", "partial"):
+            raise ValueError(f"unknown layout kind {self.kind!r}")
+        if self.kind == "sharded":
+            if len(self.rows) != self.nranks:
+                raise ValueError(
+                    f"sharded layout needs one (start, n) per rank: got "
+                    f"{len(self.rows)} for {self.nranks} ranks")
+            pos = 0
+            for start, n in self.rows:
+                if start != pos or n < 0:
+                    raise ValueError(
+                        f"rows {self.rows} are not an ordered contiguous "
+                        f"partition (rank range starting at {start}, "
+                        f"expected {pos})")
+                pos += n
+        elif self.rows:
+            raise ValueError(f"{self.kind} layout carries no rows")
+
+    @property
+    def n_rows(self):
+        return sum(n for _, n in self.rows)
+
+    def range_of(self, rank):
+        return self.rows[rank]
+
+    @staticmethod
+    def replicated(nranks):
+        return Layout("replicated", nranks)
+
+    @staticmethod
+    def partial(nranks):
+        return Layout("partial", nranks)
+
+    @staticmethod
+    def sharded(n_rows, nranks):
+        """Even split, remainder to lower ranks — the core's rule."""
+        starts, pos = [], 0
+        for n in _even_split(n_rows, nranks):
+            starts.append((pos, n))
+            pos += n
+        return Layout("sharded", nranks, tuple(starts))
+
+    @staticmethod
+    def from_rows(rows):
+        return Layout("sharded", len(rows), tuple(tuple(r) for r in rows))
+
+
+def even_row_layout(n_rows, n_shards):
+    """Alias for :meth:`Layout.sharded` (the checkpoint-resharding
+    entry point reads better with a verb-free name)."""
+    return Layout.sharded(n_rows, n_shards)
+
+
+# ---- plan ------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ReshardStep:
+    """One collective of a plan. ``op`` in {slice, allgatherv,
+    alltoallv, reducescatter, allreduce}; ``wire_tx``/``wire_rx`` are
+    per-rank transport-byte predictions matching the core's WireTally
+    accounting exactly (csrc/ring_ops.cc)."""
+
+    op: str
+    wire_tx: tuple
+    wire_rx: tuple
+    detail: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    src: Layout
+    dst: Layout
+    shape: tuple
+    itemsize: int
+    steps: tuple
+
+    @property
+    def zero_copy(self):
+        return not self.steps
+
+    def wire_tx_bytes(self, rank=None):
+        """Predicted transport tx bytes (this rank, or total)."""
+        if rank is None:
+            return sum(sum(s.wire_tx) for s in self.steps)
+        return sum(s.wire_tx[rank] for s in self.steps)
+
+    def wire_rx_bytes(self, rank=None):
+        if rank is None:
+            return sum(sum(s.wire_rx) for s in self.steps)
+        return sum(s.wire_rx[rank] for s in self.steps)
+
+    def expected_collectives(self, axis="shard"):
+        """The in-graph collective signature this plan corresponds to,
+        in hvdlint C5's ``expect_collectives`` shape — the static
+        bridge between a plan and a registered redistribute program."""
+        prims = {"allgatherv": "all_gather", "alltoallv": "all_to_all",
+                 "reducescatter": "psum_scatter", "allreduce": "psum"}
+        return [(prims[s.op], (axis,)) for s in self.steps
+                if s.op in prims]
+
+    def describe(self):
+        if not self.steps:
+            return "zero-copy (layouts agree)"
+        return " -> ".join(
+            f"{s.op}[{s.detail}]" if s.detail else s.op
+            for s in self.steps)
+
+
+def _row_bytes(shape, itemsize):
+    return int(math.prod(shape[1:])) * itemsize if len(shape) > 1 \
+        else itemsize
+
+
+def _allgatherv_step(layout, shape, itemsize):
+    """Ring allgatherv of per-rank row blocks: at step s rank r sends
+    block (r - s) mod N and receives block (r - s - 1) mod N
+    (csrc/ring_ops.cc Allgatherv)."""
+    n = layout.nranks
+    rb = _row_bytes(shape, itemsize)
+    blk = [rows * rb for _, rows in layout.rows]
+    tx = [sum(blk[(r - s + n) % n] for s in range(n - 1)) for r in range(n)]
+    rx = [sum(blk[(r - s - 1 + n) % n] for s in range(n - 1))
+          for r in range(n)]
+    return ReshardStep("allgatherv", tuple(tx), tuple(rx),
+                       detail=f"{n} blocks")
+
+
+def _alltoallv_step(src, dst, shape, itemsize):
+    """Pairwise exchange of intersection row ranges. Includes the
+    8-byte-per-rank recv-splits exchange the eager ALLTOALL response
+    performs before the payload (csrc/operations.cc)."""
+    n = src.nranks
+    rb = _row_bytes(shape, itemsize)
+    send = [[0] * n for _ in range(n)]
+    for r in range(n):
+        s0, sn = src.range_of(r)
+        for d in range(n):
+            d0, dn = dst.range_of(d)
+            lo, hi = max(s0, d0), min(s0 + sn, d0 + dn)
+            if hi > lo:
+                send[r][d] = (hi - lo) * rb
+    # Splits exchange: Alltoallv of one int64 per rank (self skipped on
+    # the wire), then the payload exchange (self handled by memcpy).
+    tx = [8 * (n - 1) + sum(b for d, b in enumerate(send[r]) if d != r)
+          for r in range(n)]
+    rx = [8 * (n - 1) + sum(send[s][r] for s in range(n) if s != r)
+          for r in range(n)]
+    return ReshardStep("alltoallv", tuple(tx), tuple(rx),
+                       detail="intersection rows")
+
+
+def _ring_reduce_phase_bytes(counts, size, rot, rank):
+    """tx elems of one N-1-step ring reduce phase at rotation ``rot``
+    for ``rank`` (csrc PipelinedReduceChunks tally)."""
+    return sum(counts[_ring_send_segment(rank, s, size, rot)]
+               for s in range(size - 1))
+
+
+def _reducescatter_step(layout, shape, itemsize, compressed=False):
+    """Ring reduce-scatter at rot=-1 over the EVEN split (the core's
+    REDUCESCATTER row rule). Wire halves when the f32 payload rides the
+    bf16 codec."""
+    n = layout.nranks
+    rb = _row_bytes(shape, itemsize)
+    counts = [rows * rb for rows in _even_split(shape[0], n)]
+    scale = 0.5 if compressed else 1.0
+    tx, rx = [], []
+    for r in range(n):
+        t = _ring_reduce_phase_bytes(counts, n, -1, r)
+        v = sum(counts[_ring_send_segment(r, s + 1, n, -1)]
+                for s in range(n - 1))
+        tx.append(int(t * scale))
+        rx.append(int(v * scale))
+    return ReshardStep("reducescatter", tuple(tx), tuple(rx),
+                       detail="even split")
+
+
+def _allreduce_step(shape, itemsize, nranks, compressed=False):
+    """Flat ring allreduce: reduce-scatter phase (rot=0) + allgather
+    phase (send rot=1 / recv rot=0 segments)."""
+    total = int(math.prod(shape)) if shape else 1
+    counts = [c * itemsize for c in _even_split(total, nranks)]
+    scale = 0.5 if compressed else 1.0
+    tx, rx = [], []
+    for r in range(nranks):
+        t = _ring_reduce_phase_bytes(counts, nranks, 0, r)
+        t += sum(counts[_ring_send_segment(r, s, nranks, 1)]
+                 for s in range(nranks - 1))
+        v = sum(counts[_ring_send_segment(r, s + 1, nranks, 0)]
+                for s in range(nranks - 1))
+        v += sum(counts[_ring_send_segment(r, s, nranks, 0)]
+                 for s in range(nranks - 1))
+        tx.append(int(t * scale))
+        rx.append(int(v * scale))
+    return ReshardStep("allreduce", tuple(tx), tuple(rx))
+
+
+def flat_allreduce_wire_bytes(count, itemsize, size, rank,
+                              compressed=False):
+    """Per-rank transport tx bytes of one flat ring allreduce — the
+    telemetry-predictor twin of the core's WireTally (docs/wire.md)."""
+    step = _allreduce_step((count,), itemsize, size,
+                           compressed=compressed)
+    return step.wire_tx[rank]
+
+
+def hier_wire_bytes(count, itemsize, size, local_size, rank,
+                    compress_cross=False, compressed=False):
+    """Per-rank wire tx bytes of the hierarchical cross-plane allreduce,
+    split by plane: ``{"intra": ..., "cross": ...}``.
+
+    Mirrors csrc/ring_ops.cc HierarchicalAllreduce exactly: intra-slice
+    reduce-scatter (rot=-1) over ``local_size`` group members, flat
+    allreduce of this rank's 1/local_size segment among the
+    ``size/local_size`` same-local-rank peers (the CROSS plane —
+    compressed when either knob engages the bf16 codec there), then the
+    intra-slice ring allgatherv of the finalized segments.
+    """
+    L, M = local_size, size // local_size
+    lr = rank % L
+    seg = _even_split(count, L)
+    seg_bytes = [c * itemsize for c in seg]
+    intra_scale = 0.5 if compressed and itemsize == 4 else 1.0
+    cross_scale = 0.5 if (compressed or compress_cross) and itemsize == 4 \
+        else 1.0
+    # Phase 1: local reduce-scatter at rot=-1.
+    intra = _ring_reduce_phase_bytes(seg_bytes, L, -1, lr) * intra_scale
+    # Phase 3: local allgatherv of the segment blocks (never compressed
+    # — only reduce phases ride the codec).
+    intra += sum(seg_bytes[(lr - s + L) % L] for s in range(L - 1))
+    # Phase 2: flat allreduce of segment lr across M slices.
+    my = seg[lr]
+    cross_counts = [c * itemsize for c in _even_split(my, M)]
+    cr = rank // L
+    cross = _ring_reduce_phase_bytes(cross_counts, M, 0, cr)
+    cross += sum(cross_counts[_ring_send_segment(cr, s, M, 1)]
+                 for s in range(M - 1))
+    return {"intra": int(intra), "cross": int(cross * cross_scale)}
+
+
+def plan_redistribute(shape, dtype, src, dst, compressed=False):
+    """Plan the minimal collective sequence moving a ``shape``/``dtype``
+    array from layout ``src`` to layout ``dst`` (the table in the
+    module docstring). Raises on rank-count mismatch or sharded layouts
+    that do not cover the array's rows.
+
+    ``compressed`` mirrors the runtime's ``HOROVOD_WIRE_COMPRESSION``
+    knob: the reduce phases of the plan's allreduce/reduce-scatter
+    steps then ride the bf16 codec (f32 payloads only), halving their
+    predicted wire bytes — callers executing under the compressed wire
+    must pass it or the byte reconciliation reads 2x. Gather/exchange
+    steps never compress (the codec covers reduce phases only)."""
+    if src.nranks != dst.nranks:
+        raise ValueError(
+            f"src ({src.nranks} ranks) and dst ({dst.nranks} ranks) must "
+            "describe the same world — resizing the WORLD is the elastic "
+            "layer's job; resharding redistributes within one world")
+    shape = tuple(int(d) for d in shape)
+    itemsize = np.dtype(dtype).itemsize
+    for layout, name in ((src, "src"), (dst, "dst")):
+        if layout.kind == "sharded" and layout.n_rows != shape[0]:
+            raise ValueError(
+                f"{name} layout covers {layout.n_rows} rows; array has "
+                f"{shape[0]}")
+    if dst.kind == "partial":
+        raise ValueError("a partial (pending-reduction) destination is "
+                         "not a materializable layout")
+    n = src.nranks
+    zeros = tuple(0 for _ in range(n))
+    # The bf16 codec engages on f32 reduce phases only (docs/wire.md).
+    comp = bool(compressed) and itemsize == 4
+
+    def slice_step():
+        return ReshardStep("slice", zeros, zeros, detail="local rows")
+
+    steps = []
+    if src == dst:
+        pass  # zero-copy
+    elif src.kind == "replicated":
+        # dst sharded: every rank already holds its rows.
+        steps.append(slice_step())
+    elif src.kind == "sharded":
+        if dst.kind == "replicated":
+            steps.append(_allgatherv_step(src, shape, itemsize))
+        else:  # sharded -> sharded, different rows
+            steps.append(_alltoallv_step(src, dst, shape, itemsize))
+    else:  # partial source
+        if dst.kind == "replicated":
+            steps.append(_allreduce_step(shape, itemsize, n,
+                                         compressed=comp))
+        else:
+            even = Layout.sharded(shape[0], n)
+            steps.append(_reducescatter_step(even, shape, itemsize,
+                                             compressed=comp))
+            if dst != even:
+                steps.append(_alltoallv_step(even, dst, shape, itemsize))
+    return ReshardPlan(src=src, dst=dst, shape=shape, itemsize=itemsize,
+                       steps=tuple(steps))
+
+
+# ---- executors -------------------------------------------------------
+
+def simulate_plan(plan, locals_by_rank):
+    """Pure-numpy all-rank reference executor (the property-test
+    oracle): ``locals_by_rank[r]`` is rank r's local block under
+    ``plan.src``; returns the per-rank blocks under ``plan.dst``.
+    No wire, but the SAME data movement semantics as execute_plan."""
+    n = plan.src.nranks
+    src, dst = plan.src, plan.dst
+    if src.kind == "replicated":
+        full = locals_by_rank[0]
+    elif src.kind == "sharded":
+        full = np.concatenate([np.asarray(b) for b in locals_by_rank])
+    else:  # partial: the logical value is the sum of addends
+        full = np.sum([np.asarray(b) for b in locals_by_rank], axis=0)
+    if dst.kind == "replicated":
+        return [full.copy() for _ in range(n)]
+    return [full[s:s + c].copy() for s, c in dst.rows]
+
+
+def execute_plan(plan, local, name="reshard", eager_ops=None):
+    """Run this rank's side of the plan over the eager host
+    collectives; returns the local block under ``plan.dst``.
+
+    ``local`` is this rank's block under ``plan.src`` (the full array
+    for replicated/partial sources). Collective: every rank must call
+    with the same ``name`` in the same order. ``eager_ops`` is
+    injectable for tests; defaults to the process-wide module."""
+    if eager_ops is None:
+        from horovod_tpu.common import eager_ops as _ops
+        eager_ops = _ops
+    from horovod_tpu.common.basics import HorovodBasics
+
+    rank = HorovodBasics().rank()
+    local = np.ascontiguousarray(local)
+    out = local
+    for i, step in enumerate(plan.steps):
+        sname = f"{name}.{i}.{step.op}"
+        if step.op == "slice":
+            s, c = plan.dst.range_of(rank)
+            out = out[s:s + c].copy()
+        elif step.op == "allgatherv":
+            out = eager_ops.allgather_async(out, sname).synchronize()
+        elif step.op == "alltoallv":
+            # Contiguous ordered partitions on both sides: the rows this
+            # rank sends to each new owner are consecutive runs of its
+            # local block, and rows arrive already in dst order.
+            src_layout = plan.src if i == 0 else \
+                Layout.sharded(plan.shape[0], plan.src.nranks)
+            s0, _ = src_layout.range_of(rank)
+            splits = []
+            for d in range(plan.dst.nranks):
+                d0, dn = plan.dst.range_of(d)
+                lo = max(s0, d0)
+                hi = min(s0 + out.shape[0], d0 + dn)
+                splits.append(max(hi - lo, 0))
+            out = eager_ops.alltoall_async(out, splits,
+                                           sname).synchronize()
+        elif step.op == "reducescatter":
+            out = eager_ops.reducescatter_async(out, sname).synchronize()
+        elif step.op == "allreduce":
+            out = eager_ops.allreduce_async(out, sname).synchronize()
+        else:  # pragma: no cover — planner emits only the ops above
+            raise ValueError(f"unknown plan step {step.op!r}")
+    if plan.zero_copy:
+        return local
+    return out
+
+
+# ---- jax surface -----------------------------------------------------
+
+def _spec_tuple(sharding):
+    spec = getattr(sharding, "spec", None)
+    return tuple(spec) if spec is not None else ()
+
+def layout_from_sharding(sharding, shape):
+    """Row :class:`Layout` of a ``NamedSharding`` whose axis-0 spec is
+    the only sharded dimension (the repo's checkpoint/param layouts).
+    Replicated specs map to the replicated layout; anything sharded on
+    a later axis is rejected (redistribute plans rows)."""
+    spec = _spec_tuple(sharding)
+    if any(s is not None for s in spec[1:]):
+        raise ValueError(
+            f"redistribute plans axis-0 row layouts; spec {spec} shards "
+            "a later axis (transpose it to axis 0 first)")
+    axis0 = spec[0] if spec else None
+    mesh = sharding.mesh
+    nranks = int(math.prod(mesh.shape.values()))
+    if axis0 is None:
+        return Layout.replicated(nranks)
+    names = (axis0,) if isinstance(axis0, str) else tuple(axis0)
+    shards = int(math.prod(mesh.shape[a] for a in names))
+    if nranks % shards:
+        raise ValueError(f"mesh {dict(mesh.shape)} does not tile "
+                         f"{shards} shards")
+    # Device-order row ranges; replication across the remaining axes
+    # does not change which rows exist, so the row layout is the
+    # shards-way even split.
+    return Layout.sharded(shape[0], shards)
+
+
+def redistribute(array, src_sharding=None, dst_sharding=None):
+    """``hvd.redistribute(array, src, dst)``: move a jax array between
+    shardings with the minimal collective sequence.
+
+    Zero-copy when the shardings agree (the SAME array object comes
+    back — pinned in tests). Otherwise XLA executes the movement
+    (``jax.device_put`` lowers to exactly the planner's collective on
+    TPU meshes) while :func:`plan_redistribute` prices it for
+    telemetry. ``src_sharding`` defaults to ``array.sharding``."""
+    import jax
+
+    if dst_sharding is None:
+        raise ValueError("redistribute needs a destination sharding")
+    if src_sharding is None:
+        src_sharding = getattr(array, "sharding", None)
+    if src_sharding is not None and (
+            src_sharding == dst_sharding or
+            (_spec_tuple(src_sharding) == _spec_tuple(dst_sharding) and
+             getattr(src_sharding, "mesh", None) is
+             getattr(dst_sharding, "mesh", None))):
+        return array  # zero-copy: layouts already agree
+    return jax.device_put(array, dst_sharding)

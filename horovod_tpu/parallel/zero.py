@@ -91,12 +91,20 @@ class ZeroConfig:
     under the vmap(axis_name) emulation and only ``size`` matters.
     ``bucket_bytes`` — fused-bucket granularity (shard boundaries align
     with bucket boundaries by construction).
+
+    ``inter_axis``/``inter_size`` — optional CROSS-PLANE split of the
+    RS/AG pair (docs/redistribute.md): the reduce-scatter and allgather
+    ride ``axis`` (the intra-slice/ICI fabric) while only the 1/size
+    gradient shard crosses ``inter_axis`` (the DCN fabric) as a psum —
+    the hierarchical decomposition applied to ZeRO-1's collective mix.
     """
 
     axis: str = "data"
     size: int = None
     mesh: Any = None
     bucket_bytes: int = DEFAULT_BUCKET_BYTES
+    inter_axis: str = None
+    inter_size: int = 1
 
     def resolved_size(self):
         if self.size is not None:
@@ -281,22 +289,31 @@ def _optimizer_hyper(optimizer):
 
 # ---- the SPMD apply program -----------------------------------------
 
-def _zero_spmd(inner, axis, size, mesh, split_in, split_out):
+def _zero_spmd(inner, axis, size, mesh, split_in, split_out,
+               inter_axis=None, inter_size=1):
     """Run ``inner`` manual over the zero axis: ``jax.shard_map`` when
     this jax has it AND a mesh was provided, else the same
     ``vmap(axis_name=...)`` emulation the pipeline schedules use on
     jax 0.4.x boxes (identical collective semantics; GSPMD lays the
     emulated program out freely). ``split_in``/``split_out`` are
     per-argument booleans: True = leading dim splits over ``axis``
-    (every leaf of that argument), False = replicated."""
+    (every leaf of that argument), False = replicated.
+
+    ``inter_axis`` (the cross-plane ZeRO split) binds a second named
+    axis the inner program psums its gradient shards over. Data stays
+    replicated across it (each inter member holds the same accumulated
+    grads under the emulation; the real multi-slice run feeds per-slice
+    grads), so the emulation maps a dummy over the axis and every
+    member computes the identical result — index 0 is returned."""
     if mesh is not None and hasattr(jax, "shard_map"):
         from jax.sharding import PartitionSpec as P
 
+        names = {axis} if inter_axis is None else {axis, inter_axis}
         return jax.shard_map(
             inner, mesh=mesh,
             in_specs=tuple(P(axis) if s else P() for s in split_in),
             out_specs=tuple(P(axis) if s else P() for s in split_out),
-            axis_names={axis}, check_vma=False)
+            axis_names=names, check_vma=False)
 
     def emulated(*args):
         split = lambda a: jax.tree.map(  # noqa: E731
@@ -314,10 +331,24 @@ def _zero_spmd(inner, axis, size, mesh, split_in, split_out):
         return tuple(merge(o) if s else first(o)
                      for o, s in zip(outs, split_out))
 
-    return emulated
+    if inter_axis is None:
+        return emulated
+
+    def emulated_hier(*args):
+        # Bind the inter axis via a dummy mapped operand (vmap needs at
+        # least one); all real args replicate across it. Every member's
+        # result is identical post-psum, so member 0 stands for all.
+        dummy = jnp.zeros((inter_size,), jnp.float32)
+        outs = jax.vmap(lambda _d, *a: emulated(*a),
+                        in_axes=(0,) + (None,) * len(args),
+                        out_axes=0, axis_name=inter_axis)(dummy, *args)
+        return jax.tree.map(lambda x: x[0], outs)
+
+    return emulated_hier
 
 
-def build_zero_apply_inner(hyper, layout, axis, size):
+def build_zero_apply_inner(hyper, layout, axis, size, inter_axis=None,
+                           inter_size=1):
     """The per-rank apply program (manual over ``axis``):
 
     for every bucket, ``psum_scatter`` the full gradient bucket (rank r
@@ -328,12 +359,19 @@ def build_zero_apply_inner(hyper, layout, axis, size):
     ``jax.make_jaxpr(axis_env=[(axis, size)])`` — no mesh or shard_map
     needed), where check C6 verifies every reduce-scatter pairs with an
     allgather on the same axis.
+
+    With ``inter_axis`` the RS/AG pair SPLITS ACROSS PLANES
+    (docs/redistribute.md): the scatter and gather stay on ``axis``
+    (ICI), and the 1/size gradient shard additionally psums over
+    ``inter_axis`` (DCN) between them — the hierarchical allreduce
+    shape with the optimizer update fused at the 1/N point, so only
+    1/size of the gradient bytes ever cross the expensive fabric.
     """
     lr, b1 = hyper["learning_rate"], hyper["b1"]
     b2, eps = hyper["b2"], hyper["eps"]
     master = hyper["kind"] == "master_adam"
     compute_dtype = hyper.get("compute_dtype")
-    inv_size = 1.0 / size
+    inv_size = 1.0 / (size * max(int(inter_size), 1))
 
     def inner(grads_flat, params_flat, opt):
         r = lax.axis_index(axis)
@@ -349,8 +387,12 @@ def build_zero_apply_inner(hyper, layout, axis, size):
             # mean over the axis folds on the shard — one s-element
             # multiply instead of a padded-bucket one.
             g_shard = lax.psum_scatter(
-                grads_flat[i], axis, scatter_dimension=0,
-                tiled=True) * inv_size
+                grads_flat[i], axis, scatter_dimension=0, tiled=True)
+            if inter_axis is not None:
+                # Cross-plane hop: only the 1/size shard crosses the
+                # inter (DCN) axis — the hierarchical decomposition.
+                g_shard = lax.psum(g_shard, inter_axis)
+            g_shard = g_shard * inv_size
             if master:
                 p_shard = opt.master[i]
             else:
@@ -403,10 +445,15 @@ def make_zero_apply(optimizer, zero, jit_kwargs=None):
         if key in cache:
             return cache[key]
         layout = zero_bucket_layout(leaves, size, zero.bucket_bytes)
-        inner = build_zero_apply_inner(hyper, layout, zero.axis, size)
+        inner = build_zero_apply_inner(
+            hyper, layout, zero.axis, size,
+            inter_axis=zero.inter_axis,
+            inter_size=zero.inter_size)
         spmd = _zero_spmd(inner, zero.axis, size, zero.mesh,
                           split_in=(False, False, True),
-                          split_out=(False, True))
+                          split_out=(False, True),
+                          inter_axis=zero.inter_axis,
+                          inter_size=zero.inter_size)
 
         @functools.partial(jax.jit, donate_argnums=(1, 2), **jk)
         def jitted_apply(grads, params, opt):

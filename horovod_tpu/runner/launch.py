@@ -82,8 +82,15 @@ def parse_args(argv=None):
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
     p.add_argument("--hierarchical-allreduce", action="store_true",
-                   help="three-phase allreduce: local reduce-scatter, "
-                        "cross-node allreduce, local allgather")
+                   help="legacy spelling of --cross-plane hier "
+                        "(three-phase intra/inter-slice allreduce)")
+    p.add_argument("--cross-plane", default=None,
+                   choices=["auto", "ici", "ring", "hier"],
+                   help="plane selection for collectives "
+                        "(HOROVOD_CROSS_PLANE, docs/redistribute.md): "
+                        "auto composes the hierarchical decomposition "
+                        "on eligible layouts; ring pins the flat host "
+                        "ring; hier requires the decomposition")
     p.add_argument("--config-file", default=None,
                    help="YAML file of the above knobs")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -160,6 +167,8 @@ def env_from_args(args):
         env["HOROVOD_AUTOTUNE_STEPS"] = os.environ["HOROVOD_AUTOTUNE_STEPS"]
     if args.hierarchical_allreduce:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.cross_plane:
+        env["HOROVOD_CROSS_PLANE"] = args.cross_plane
     if args.nics:
         env["HOROVOD_GLOO_IFACE"] = args.nics
     return env

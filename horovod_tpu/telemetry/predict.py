@@ -141,6 +141,38 @@ def zero_layout_bytes(layout):
     return sum(2 * b.padded * b.dtype.itemsize for b in layout.buckets)
 
 
+def hier_allreduce_wire_bytes(count, itemsize, size, local_size, rank,
+                              compress_cross=False, compressed=False):
+    """Per-rank, PER-PLANE transport tx bytes of one hierarchical
+    cross-plane allreduce: ``{"intra": ..., "cross": ...}`` — the
+    expected side of the core's split wire counters
+    (``wire.cross_tx_bytes`` vs total; csrc/metrics.cc). Delegates to
+    the reshard module so the predictor and the planner share ONE
+    implementation of the ring segment math (exact reconciliation is
+    pinned in ``make reshard-smoke``)."""
+    from horovod_tpu.parallel.reshard import hier_wire_bytes
+
+    return hier_wire_bytes(count, itemsize, size, local_size, rank,
+                           compress_cross=compress_cross,
+                           compressed=compressed)
+
+
+def flat_ring_wire_bytes(count, itemsize, size, rank, compressed=False):
+    """Per-rank transport tx bytes of one flat host-ring allreduce
+    (the hierarchical predictor's baseline)."""
+    from horovod_tpu.parallel.reshard import flat_allreduce_wire_bytes
+
+    return flat_allreduce_wire_bytes(count, itemsize, size, rank,
+                                     compressed=compressed)
+
+
+def redistribute_bytes(plan, rank=None):
+    """Predicted transport tx bytes of a :class:`ReshardPlan` (this
+    rank, or the whole world) — what the reshard-smoke reconciles
+    against the measured wire counters to < 1%."""
+    return plan.wire_tx_bytes(rank)
+
+
 def grad_tree_bytes(loss_fn, params, batch):
     """Gradient-tree byte volume via ``jax.eval_shape`` — the
     walker-free cross-check for :func:`eager_allreduce_bytes` (the two

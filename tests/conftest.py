@@ -47,6 +47,11 @@ def pytest_configure(config):
         "markers",
         "quick: sub-5-minute CI lane — core runtime, one multi-rank "
         "file, one elastic path (make test-quick)")
+    config.addinivalue_line(
+        "markers",
+        "loadflaky: timing-sensitive under a loaded box (multi-process "
+        "steady-state assertions); runs with widened slack, and a busy "
+        "CI shard may deselect with -m 'not loadflaky'")
     _ensure_core_built()
 
 

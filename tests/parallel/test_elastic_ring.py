@@ -321,6 +321,115 @@ def test_elastic_run_recovers_training_from_last_commit():
     assert results == {0: "ok", 1: "ok"}
 
 
+# ---- elastic x hierarchical: reinit re-derives the slice layout ------
+
+_HIER_SIZE = 6          # 3 emulated hosts x 2 ranks, host-major
+_HIER_LOCAL = 2
+_HIER_WARMUPS = 2       # ops 0..1; both victims die at op 2
+
+
+def _hier_reform_worker(rank, size):
+    import os
+
+    os.environ.update({
+        "HOROVOD_LOCAL_RANK": str(rank % _HIER_LOCAL),
+        "HOROVOD_LOCAL_SIZE": str(_HIER_LOCAL),
+        "HOROVOD_CROSS_RANK": str(rank // _HIER_LOCAL),
+        "HOROVOD_CROSS_SIZE": str(size // _HIER_LOCAL),
+    })
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common.exceptions import HorovodPeerFailureError
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.hier_split() == _HIER_LOCAL  # hier active pre-fault
+    vals = (np.arange(512, dtype=np.float32) % 5) - 2  # exact ints
+    for i in range(_HIER_WARMUPS):
+        out = ops.allreduce_async(vals * (rank + 1),
+                                  f"warm.{i}").synchronize()
+        np.testing.assert_array_equal(out, vals * sum(range(1, size + 1)))
+    # BOTH ranks of host 2 die at the same collective — the whole slice
+    # vanishes, which is exactly the preemption shape (a spot slice is
+    # reclaimed wholesale).
+    if rank >= 4:
+        b.set_fault_inject(rank, _HIER_WARMUPS)
+    try:
+        ops.allreduce_async(vals, "boom").synchronize()
+        return "boom-did-not-fail"
+    except HorovodPeerFailureError as e:
+        assert set(e.fault_ranks) & {4, 5}, e.fault_ranks
+
+    # Survivors = hosts 0 and 1 intact: the re-derived layout must tile
+    # 2 hosts x 2 ranks and KEEP the hierarchical decomposition (the
+    # pre-fix core force-flattened here).
+    b.reinit([0, 1, 2, 3], 1)
+    assert b.size() == 4
+    assert b.local_size() == _HIER_LOCAL, b.local_size()
+    assert b.cross_size() == 2, b.cross_size()
+    assert b.local_rank() == b.rank() % _HIER_LOCAL
+    assert b.hier_split() == _HIER_LOCAL, b.hier_split()
+
+    snap0 = b.metrics_snapshot()["wire"]["cross_tx_bytes"]
+    out = ops.allreduce_async(vals * (rank + 1), "reformed").synchronize()
+    np.testing.assert_array_equal(out, vals * 10)  # exact: sum 1..4
+    assert b.metrics_snapshot()["wire"]["cross_tx_bytes"] > snap0
+    b.shutdown()
+    return "ok"
+
+
+def test_reinit_rederives_hier_layout_when_slice_dies_whole():
+    results = run_chaos(
+        _hier_reform_worker, _HIER_SIZE, victims={4, 5},
+        env={"HOROVOD_WIRE_TIMEOUT_MS": str(_TIMEOUT_MS),
+             "HOROVOD_CROSS_PLANE": "hier"})
+    assert results == {r: "ok" for r in range(4)}
+
+
+def _hier_uneven_worker(rank, size):
+    import os
+
+    os.environ.update({
+        "HOROVOD_LOCAL_RANK": str(rank % 2),
+        "HOROVOD_LOCAL_SIZE": "2",
+        "HOROVOD_CROSS_RANK": str(rank // 2),
+        "HOROVOD_CROSS_SIZE": str(size // 2),
+    })
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common.exceptions import HorovodPeerFailureError
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.hier_split() == 2
+    x = np.ones(64, np.float32)
+    ops.allreduce_async(x, "w0").synchronize()
+    try:
+        ops.allreduce_async(x, "boom").synchronize()
+        return "boom-did-not-fail"
+    except HorovodPeerFailureError:
+        pass
+    # One rank of host 1 died: 3 survivors cannot tile 2-per-host, so
+    # the reform falls back to the flat ring (correctness over plane
+    # optimality) — and still computes exact results.
+    b.reinit([0, 1, 2], 1)
+    assert b.size() == 3
+    assert b.hier_split() == 0, b.hier_split()
+    assert b.local_size() == 3  # flat layout
+    out = ops.allreduce_async(np.full(7, float(b.rank() + 1), np.float32),
+                              "flat").synchronize()
+    np.testing.assert_array_equal(out, np.full(7, 6.0))
+    b.shutdown()
+    return "ok"
+
+
+def test_reinit_falls_back_flat_on_uneven_survivor_tiling():
+    results = run_chaos(
+        _hier_uneven_worker, 4, victims={3},
+        env={"HOROVOD_WIRE_TIMEOUT_MS": str(_TIMEOUT_MS),
+             "HOROVOD_CROSS_PLANE": "hier",
+             "HOROVOD_FAULT_INJECT": "3:1"})
+    assert results == {0: "ok", 1: "ok", 2: "ok"}
+
+
 # ---- reinit must FAIL (not hang) when a listed survivor never shows --
 
 

@@ -9,6 +9,7 @@ the steady state).
 """
 
 import numpy as np
+import pytest
 
 from tests.utils_mp import run_ranks
 
@@ -145,13 +146,21 @@ def _worker_join_covers_pending_bits(rank, size):
         b.shutdown()
 
 
+# The steady-state runs are load-flaky under the full tier-1 suite: 12
+# steps x 8 synchronized collectives per rank leave the 90 s harness
+# deadline with no headroom once leftover workers from earlier parallel
+# tests (or a busy CI box) steal the cores. The assertions are pure
+# correctness — only the SLACK widens, and the loadflaky marker lets a
+# saturated shard deselect them explicitly instead of failing spuriously.
+@pytest.mark.loadflaky
 def test_cache_steady_state_2ranks():
-    hits = run_ranks(_worker_steady_state, 2)
+    hits = run_ranks(_worker_steady_state, 2, timeout=300)
     assert all(h > 0 for h in hits)
 
 
+@pytest.mark.loadflaky
 def test_cache_steady_state_4ranks():
-    run_ranks(_worker_steady_state, 4)
+    run_ranks(_worker_steady_state, 4, timeout=300)
 
 
 def test_cache_eviction_on_metadata_change():

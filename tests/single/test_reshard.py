@@ -1,0 +1,357 @@
+"""The redistribute planner + the hierarchical cross-plane engine
+(docs/redistribute.md).
+
+Planner: each (src, dst) layout pair must emit exactly the minimal
+collective table from arXiv:2112.01075 — never a gather-then-slice
+detour — and the numpy all-rank simulator must make every plan a
+faithful data movement (src -> dst -> src is the identity).
+
+Hierarchical: the in-process C selftest (``hvdtpu_hier_selftest``) pins
+the 2-slice x 2-rank decomposition BIT-IDENTICAL to the flat host ring
+under exact (integer-valued) arithmetic — where association order
+cannot explain any difference — and within the documented bf16 bound
+when the wire codec rides every hop or the cross hop alone. The
+per-plane wire predictions (``reshard.hier_wire_bytes``) reconcile
+EXACTLY with the core's split wire counters.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.quick
+
+_ROWS = 13
+_BOUND = lambda n: n * n * 2.0 ** -7  # docs/wire.md bf16-on-wire bound
+
+
+def _layouts():
+    from horovod_tpu.parallel.reshard import Layout
+
+    return Layout
+
+
+# ---- planner rules ---------------------------------------------------
+
+def test_plan_rule_table():
+    from horovod_tpu.parallel.reshard import Layout, plan_redistribute
+
+    n = 4
+    sh = Layout.sharded(_ROWS, n)
+    rep = Layout.replicated(n)
+    part = Layout.partial(n)
+    uneven = Layout.from_rows([(0, 1), (1, 5), (6, 3), (9, 4)])
+    cases = [
+        (sh, sh, []),                         # zero-copy
+        (rep, rep, []),
+        (rep, sh, ["slice"]),                 # no wire
+        (sh, rep, ["allgatherv"]),
+        (sh, uneven, ["alltoallv"]),
+        (part, rep, ["allreduce"]),
+        (part, sh, ["reducescatter"]),        # even dst = core's split
+        (part, uneven, ["reducescatter", "alltoallv"]),
+    ]
+    for src, dst, expected in cases:
+        plan = plan_redistribute((_ROWS, 3), np.float32, src, dst)
+        assert [s.op for s in plan.steps] == expected, \
+            (src.kind, dst.kind, plan.describe())
+        assert plan.zero_copy == (not expected)
+
+
+def test_plan_rejects_bad_layouts():
+    from horovod_tpu.parallel.reshard import Layout, plan_redistribute
+
+    with pytest.raises(ValueError, match="contiguous"):
+        Layout.from_rows([(0, 4), (5, 3)])  # gap
+    with pytest.raises(ValueError, match="same world"):
+        plan_redistribute((8,), np.float32, Layout.sharded(8, 2),
+                          Layout.sharded(8, 4))
+    with pytest.raises(ValueError, match="covers"):
+        plan_redistribute((9,), np.float32, Layout.sharded(8, 2),
+                          Layout.replicated(2))
+    with pytest.raises(ValueError, match="partial"):
+        plan_redistribute((8,), np.float32, Layout.sharded(8, 2),
+                          Layout.partial(2))
+
+
+def test_roundtrip_property():
+    """src -> dst -> src is the identity for every layout pair the
+    simulator can express (randomized contiguous partitions)."""
+    from horovod_tpu.parallel.reshard import (
+        Layout,
+        plan_redistribute,
+        simulate_plan,
+    )
+
+    rng = np.random.RandomState(7)
+    n = 4
+    full = rng.randn(17, 3).astype(np.float32)
+
+    def random_layout():
+        cuts = np.sort(rng.choice(np.arange(1, 17), size=n - 1,
+                                  replace=False))
+        bounds = [0, *cuts.tolist(), 17]
+        return Layout.from_rows(
+            [(bounds[i], bounds[i + 1] - bounds[i]) for i in range(n)])
+
+    layouts = [Layout.sharded(17, n), Layout.replicated(n)] + \
+        [random_layout() for _ in range(6)]
+    for src in layouts:
+        locs = simulate_plan(
+            plan_redistribute(full.shape, np.float32,
+                              Layout.replicated(n), src),
+            [full.copy() for _ in range(n)])
+        for dst in layouts:
+            p = plan_redistribute(full.shape, np.float32, src, dst)
+            mid = simulate_plan(p, locs)
+            back = simulate_plan(
+                plan_redistribute(full.shape, np.float32, dst, src), mid)
+            for a, b in zip(locs, back):
+                assert np.array_equal(a, b), (src, dst)
+
+
+def test_partial_layouts_simulate_to_the_sum():
+    from horovod_tpu.parallel.reshard import (
+        Layout,
+        plan_redistribute,
+        simulate_plan,
+    )
+
+    n = 3
+    addends = [np.full((6, 2), float(r + 1), np.float32)
+               for r in range(n)]
+    out = simulate_plan(
+        plan_redistribute((6, 2), np.float32, Layout.partial(n),
+                          Layout.replicated(n)), addends)
+    for o in out:
+        np.testing.assert_array_equal(o, np.full((6, 2), 6.0))
+
+
+def test_redistribute_zero_copy_returns_same_object():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel.mesh import create_mesh
+    from horovod_tpu.parallel.reshard import redistribute
+
+    mesh = create_mesh(data=2, devices=jax.devices()[:2])
+    sh = NamedSharding(mesh, P("data"))
+    x = jax.device_put(jax.numpy.arange(8.0), sh)
+    assert redistribute(x, sh, sh) is x  # zero-copy pin
+    rep = NamedSharding(mesh, P())
+    y = redistribute(x, sh, rep)
+    np.testing.assert_array_equal(np.asarray(y), np.arange(8.0))
+
+
+def test_layout_from_sharding():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel.mesh import create_mesh
+    from horovod_tpu.parallel.reshard import layout_from_sharding
+
+    mesh = create_mesh(data=4, devices=jax.devices()[:4])
+    lo = layout_from_sharding(NamedSharding(mesh, P("data")), (16, 3))
+    assert lo.kind == "sharded" and len(lo.rows) == 4
+    assert lo.rows[0] == (0, 4)
+    rep = layout_from_sharding(NamedSharding(mesh, P()), (16, 3))
+    assert rep.kind == "replicated"
+    with pytest.raises(ValueError, match="later axis"):
+        layout_from_sharding(NamedSharding(mesh, P(None, "data")),
+                             (16, 8))
+
+
+def test_compressed_plan_halves_reduce_phase_bytes():
+    """``plan_redistribute(compressed=True)`` mirrors the runtime's
+    HOROVOD_WIRE_COMPRESSION accounting: f32 reduce phases at half
+    width, gather/exchange steps and non-f32 dtypes untouched."""
+    from horovod_tpu.parallel.reshard import Layout, plan_redistribute
+
+    n = 4
+    part, sh = Layout.partial(n), Layout.sharded(16, n)
+    rep = Layout.replicated(n)
+    for dst in (sh, rep):
+        full = plan_redistribute((16, 8), np.float32, part, dst)
+        half = plan_redistribute((16, 8), np.float32, part, dst,
+                                 compressed=True)
+        assert half.wire_tx_bytes() * 2 == full.wire_tx_bytes()
+    # f64 payloads never ride the bf16 codec.
+    f64 = plan_redistribute((16, 8), np.float64, part, rep,
+                            compressed=True)
+    assert f64.wire_tx_bytes() == \
+        plan_redistribute((16, 8), np.float64, part, rep).wire_tx_bytes()
+    # Pure gather plans are unaffected by the flag.
+    ag = plan_redistribute((16, 8), np.float32, sh, rep, compressed=True)
+    assert ag.wire_tx_bytes() == \
+        plan_redistribute((16, 8), np.float32, sh, rep).wire_tx_bytes()
+
+
+def test_expected_collectives_for_lint():
+    from horovod_tpu.parallel.reshard import Layout, plan_redistribute
+
+    n = 4
+    plan = plan_redistribute((8,), np.float32, Layout.sharded(8, n),
+                             Layout.replicated(n))
+    assert plan.expected_collectives("z") == [("all_gather", ("z",))]
+    plan2 = plan_redistribute((8,), np.float32, Layout.partial(n),
+                              Layout.sharded(8, n))
+    assert plan2.expected_collectives("z") == [("psum_scatter", ("z",))]
+
+
+# ---- ring segment twins pinned against the C ABI ---------------------
+
+def test_ring_segment_twin_matches_c_abi():
+    from horovod_tpu.common import basics
+    from horovod_tpu.parallel.reshard import _ring_send_segment
+
+    b = basics.HorovodBasics()
+    for size in (2, 3, 4, 5):
+        for rot in (-1, 0, 1):
+            for rank in range(size):
+                for step in range(size):
+                    assert _ring_send_segment(rank, step, size, rot) == \
+                        b.ring_send_segment(rank, step, size, rot)
+
+
+# ---- hierarchical selftest pins (emulated 2 slices x 2 ranks) --------
+
+def test_hier_bitexact_vs_flat_ring_uncompressed():
+    """Exact integer arithmetic: the hierarchical decomposition must be
+    BIT-identical to the flat host ring — the association-free pin."""
+    from horovod_tpu.common import basics
+
+    b = basics.HorovodBasics()
+    for count in (1, 7, 4096 + 37):
+        for dtype in (6, 8, 3):  # f32, f64, int32
+            rc, err = b.hier_selftest(4, 2, count, dtype=dtype,
+                                      compression=0, exact_fill=True)
+            assert rc == 0 and err == 0.0, (count, dtype, rc, err)
+
+
+def test_hier_compressed_within_documented_bound():
+    """Real (non-dyadic) fills in [-2, 2]: bf16-on-wire error must stay
+    under the docs/wire.md N^2 * 2^-7 bound, whether the codec rides
+    every hop or the cross-plane hop alone, and ranks must agree
+    bitwise either way (rc -5 otherwise)."""
+    from horovod_tpu.common import basics
+
+    b = basics.HorovodBasics()
+    for compression in (1, 2):
+        rc, err = b.hier_selftest(4, 2, 4096 + 37, compression=compression,
+                                  exact_fill=False)
+        assert rc == 0, (compression, rc)
+        assert 0 < err <= _BOUND(4), (compression, err)
+    # Uncompressed with the same fills is NOT bit-pinned (association
+    # differs from the flat ring) but must be far below the bf16 bound.
+    rc, err = b.hier_selftest(4, 2, 4096 + 37, compression=0,
+                              exact_fill=False)
+    assert rc == 0 and err < _BOUND(4) / 16, (rc, err)
+
+
+def test_hier_wire_bytes_reconcile_exactly_with_core_counters():
+    """The per-plane predictor vs the core's split wire counters, run
+    in-process (the selftest's 4 planes share one registry, so the
+    world totals must match to the byte — cross AND intra)."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.parallel.reshard import hier_wire_bytes
+
+    b = basics.HorovodBasics()
+    ranks, local = 4, 2
+    for count, compression in ((1 << 16, 0), (1 << 16, 2), (12345, 0)):
+        b.metrics_reset()
+        rc, _ = b.hier_selftest(ranks, local, count, compression=compression,
+                                exact_fill=True)
+        assert rc == 0
+        snap = b.metrics_snapshot()["wire"]
+        pred = [hier_wire_bytes(count, 4, ranks, local, r,
+                                compress_cross=compression == 2)
+                for r in range(ranks)]
+        assert snap["cross_tx_bytes"] == sum(p["cross"] for p in pred), \
+            (compression, snap, pred)
+        assert snap["tx_bytes"] == sum(p["cross"] + p["intra"]
+                                       for p in pred)
+        if compression == 2:
+            # Cross-only codec: cross plane at half width, intra full.
+            assert snap["cross_tx_bytes"] * 2 == \
+                snap["cross_tx_logical_bytes"]
+            intra = snap["tx_bytes"] - snap["cross_tx_bytes"]
+            intra_logical = (snap["tx_logical_bytes"]
+                             - snap["cross_tx_logical_bytes"])
+            assert intra == intra_logical
+
+
+def test_flat_wire_predictor_matches_ring_selftest():
+    from horovod_tpu.common import basics
+    from horovod_tpu.parallel.reshard import flat_allreduce_wire_bytes
+
+    b = basics.HorovodBasics()
+    ranks, count = 4, 1 << 14
+    b.metrics_reset()
+    rc, err = b.ring_selftest(ranks, count)
+    assert rc == 0 and err == 0.0
+    snap = b.metrics_snapshot()["wire"]
+    pred = sum(flat_allreduce_wire_bytes(count, 4, ranks, r)
+               for r in range(ranks))
+    assert snap["tx_bytes"] == pred
+    assert snap["cross_tx_bytes"] == 0  # flat ring: no cross plane
+
+
+# ---- in-graph composed-plane ops -------------------------------------
+
+def test_hier_allreduce_equals_double_psum():
+    """hier_allreduce == psum over (intra, inter) under the nested
+    vmap emulation (exact for the integer-valued operands used)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from horovod_tpu.parallel.ops import hier_allreduce
+
+    intra, inter = 2, 2
+    x = jnp.arange(float(intra * inter * 8)).reshape(inter, intra, 8)
+
+    def composed(blk):
+        return hier_allreduce(blk, "i", "o")
+
+    def flat(blk):
+        return lax.psum(blk, ("o", "i"))
+
+    run = lambda fn: jax.vmap(  # noqa: E731
+        jax.vmap(fn, axis_name="i"), axis_name="o")(x)
+    np.testing.assert_array_equal(np.asarray(run(composed)),
+                                  np.asarray(run(flat)))
+
+
+def test_zero_hier_apply_matches_single_plane():
+    """ZeroConfig(inter_axis=...) — the RS/AG pair split across planes
+    — must produce the same updated params as the single-plane ZeRO
+    apply (the cross hop only re-associates an exact mean here)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.parallel.precision import fused_adam
+    from horovod_tpu.parallel.zero import ZeroConfig, make_zero_apply
+
+    params = {"w": jnp.arange(24, dtype=jnp.float32).reshape(6, 4) / 8,
+              "b": jnp.ones((8,), jnp.float32)}
+    grads = {"w": jnp.full((6, 4), 0.5, jnp.float32),
+             "b": jnp.full((8,), -0.25, jnp.float32)}
+    opt = fused_adam(1e-2)
+    base_apply, base_init = make_zero_apply(
+        opt, ZeroConfig(axis="data", size=4, bucket_bytes=1 << 16))
+    hier_apply, hier_init = make_zero_apply(
+        opt, ZeroConfig(axis="data", size=4, bucket_bytes=1 << 16,
+                        inter_axis="cross", inter_size=2))
+    copy = lambda t: {k: jnp.array(v) for k, v in t.items()}  # noqa: E731
+    p1, o1 = base_apply(grads, *base_init(copy(params)))
+    p2, o2 = hier_apply(grads, *hier_init(copy(params)))
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(o1.mu[0]),
+                               np.asarray(o2.mu[0]), rtol=1e-6)
+
+
+def test_new_lint_programs_clean(hvdlint_shipped):
+    for name in ("hier_allreduce", "zero1_shard_apply_hier",
+                 "redistribute_to_replicated"):
+        diags = hvdlint_shipped(name)
+        assert diags == [], f"{name}: {diags}"
