@@ -21,8 +21,9 @@ PYTHON ?= python3
 TSAN_OUT := horovod_tpu/lib/libhvdtpu_core_tsan.so
 ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
-.PHONY: core tf clean test test-quick lint lint-csrc core-tsan core-asan \
-  metrics-smoke zero-smoke elastic-smoke reshard-smoke
+.PHONY: core tf clean test test-quick test-flaky lint lint-csrc \
+  core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
+  reshard-smoke chaos-smoke
 
 core: $(OUT)
 
@@ -96,6 +97,15 @@ test: core
 test-quick: core
 	python -m pytest tests/ -m quick -x -q
 
+# Rerun the load-flaky tests STANDALONE (serial, nothing else competing
+# for the box): the loadflaky-marked cases are timing-sensitive under
+# parallel load, so a shard failure is triaged by rerunning here — if
+# this lane is green, the shard failure was load, not a regression
+# (never hand-type the pytest invocation again).
+test-flaky: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -m loadflaky -q \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
 # Telemetry smoke: 2 real eager ranks, exact byte accounting in the
 # metrics snapshot, cache steady state, per-rank timelines merged with
 # straggler attribution (horovod_tpu/telemetry/smoke.py; ~10 s).
@@ -116,6 +126,18 @@ zero-smoke: core
 # horovod_tpu/jax/elastic_smoke.py; ~30 s).
 elastic-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.jax.elastic_smoke
+
+# Chaos-matrix smoke: the three self-healing acceptance behaviors under
+# the HOROVOD_FAULT_INJECT grammar (kill|stop|reset|flip|delay) — a
+# SIGSTOP stall healed in place on the retry ladder (same epoch, zero
+# faults), a wire bit-flip caught by per-chunk CRC32C and NAK-resent,
+# and SIGKILL + blacklist-parole rejoin regrowing N-1 -> N with the
+# training trajectory pinned against an uninterrupted N-rank run
+# (docs/elastic.md; tests/parallel/test_chaos_matrix.py; ~2 min).
+chaos-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/parallel/test_chaos_matrix.py \
+	  -q -p no:cacheprovider \
+	  -k "heals_in_place or bitflip_detected or parole_rejoin"
 
 # Cross-plane + redistribute smoke: 4 real ranks emulate 2 slices x 2
 # chips under HOROVOD_CROSS_PLANE=hier — hierarchical train-step parity

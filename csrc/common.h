@@ -101,6 +101,12 @@ enum class StatusType : int32_t {
   // at the current membership epoch, and survivors re-form the ring via
   // hvdtpu_reinit (docs/elastic.md).
   PEER_FAILURE = 6,
+  // A CRC-protected wire chunk failed its integrity check past the
+  // retry budget (HOROVOD_WIRE_CRC, docs/wire.md): the link to a LIVE
+  // peer is corrupting data. Typed so silent corruption can never be
+  // reduced into a result; recovery follows the same elastic path as a
+  // peer failure (the stream is poisoned at this epoch).
+  WIRE_CORRUPTION = 7,
 };
 
 class Status {
@@ -135,10 +141,25 @@ class Status {
     s.fault_certain_ = certain;
     return s;
   }
+  // `rank` = the sending peer whose frames failed verification, `chunk`
+  // = the chunk index within the failing transfer. Not "certain" in the
+  // membership sense: the peer process is alive — only the link is bad —
+  // so driver-less survivor agreement must not treat it as a dead rank.
+  static Status WireCorruption(int rank, int64_t chunk,
+                               const std::string& msg) {
+    Status s(StatusType::WIRE_CORRUPTION, msg);
+    s.fault_rank_ = rank;
+    s.fault_chunk_ = chunk;
+    return s;
+  }
   bool ok() const { return type_ == StatusType::OK; }
   bool peer_failure() const { return type_ == StatusType::PEER_FAILURE; }
+  bool wire_corruption() const {
+    return type_ == StatusType::WIRE_CORRUPTION;
+  }
   StatusType type() const { return type_; }
   int fault_rank() const { return fault_rank_; }
+  int64_t fault_chunk() const { return fault_chunk_; }
   bool fault_certain() const { return fault_certain_; }
   const std::string& reason() const { return reason_; }
 
@@ -147,6 +168,7 @@ class Status {
       : type_(type), reason_(std::move(reason)) {}
   StatusType type_ = StatusType::OK;
   int fault_rank_ = -1;
+  int64_t fault_chunk_ = -1;
   bool fault_certain_ = false;
   std::string reason_;
 };

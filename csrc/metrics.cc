@@ -41,16 +41,25 @@ void AtomicMax(std::atomic<int64_t>& a, int64_t v) {
   }
 }
 
+// Dynamically sized append: measure first, then format straight into
+// the string. The previous fixed stack buffer (256, then 768 bytes,
+// grown by hand whenever a section gained rows) silently truncated —
+// and thereby corrupted — the snapshot JSON the moment a row outgrew
+// it; measuring makes the buffer a non-decision forever.
 void Append(std::string& out, const char* fmt, ...) {
-  // Sized for the largest single row (the 10-field wire section with
-  // full-width int64 values); vsnprintf truncation here would silently
-  // corrupt the snapshot JSON.
-  char buf[768];
   va_list args;
   va_start(args, fmt);
-  vsnprintf(buf, sizeof(buf), fmt, args);
+  va_list measure;
+  va_copy(measure, args);
+  int need = vsnprintf(nullptr, 0, fmt, measure);
+  va_end(measure);
+  if (need > 0) {
+    size_t old = out.size();
+    out.resize(old + (size_t)need + 1);
+    vsnprintf(&out[old], (size_t)need + 1, fmt, args);
+    out.resize(old + (size_t)need);
+  }
   va_end(args);
-  out += buf;
 }
 
 // Op-class names aligned with Response::ResponseType values.
@@ -184,6 +193,10 @@ void Metrics::Reset() {
   faults_detected.store(0);
   faults_recovered.store(0);
   ranks_blacklisted.store(0);
+  wire_heals.store(0);
+  wire_retries.store(0);
+  crc_errors.store(0);
+  ranks_rejoined.store(0);
   cycles.store(0);
   cycle_stalls.store(0);
   cycle_overrun_us.store(0);
@@ -270,11 +283,16 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
 
   Append(out, "\"elastic\":{\"epoch\":%lld,\"faults_detected\":%lld,"
               "\"faults_recovered\":%lld,\"ranks_blacklisted\":%lld,"
-              "\"detect_us\":",
+              "\"ranks_rejoined\":%lld,\"heals\":%lld,\"retries\":%lld,"
+              "\"crc_errors\":%lld,\"detect_us\":",
          (long long)info.epoch,
          (long long)faults_detected.load(std::memory_order_relaxed),
          (long long)faults_recovered.load(std::memory_order_relaxed),
-         (long long)ranks_blacklisted.load(std::memory_order_relaxed));
+         (long long)ranks_blacklisted.load(std::memory_order_relaxed),
+         (long long)ranks_rejoined.load(std::memory_order_relaxed),
+         (long long)wire_heals.load(std::memory_order_relaxed),
+         (long long)wire_retries.load(std::memory_order_relaxed),
+         (long long)crc_errors.load(std::memory_order_relaxed));
   out += fault_detect_us.Json() + "},";
 
   Append(out, "\"errors\":%lld,",
@@ -286,12 +304,17 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
   Append(out, "\"knobs\":{\"fusion_threshold_bytes\":%lld,"
               "\"cycle_time_ms\":%.6f,\"ring_chunk_bytes\":%lld,"
               "\"wire_compression\":%s,\"wire_timeout_ms\":%lld,"
+              "\"wire_retry_attempts\":%lld,"
+              "\"wire_retry_backoff_ms\":%lld,\"wire_crc\":%s,"
               "\"cross_plane\":\"%s\",\"hier_split\":%lld,"
               "\"cross_compression\":%s}}",
          (long long)info.fusion_threshold_bytes, info.cycle_time_ms,
          (long long)info.ring_chunk_bytes,
          info.wire_compression ? "true" : "false",
-         (long long)info.wire_timeout_ms, cp,
+         (long long)info.wire_timeout_ms,
+         (long long)info.wire_retry_attempts,
+         (long long)info.wire_retry_backoff_ms,
+         info.wire_crc ? "true" : "false", cp,
          (long long)info.hier_split,
          info.cross_compression ? "true" : "false");
   return out;

@@ -92,6 +92,16 @@ class Metrics {
   std::atomic<int64_t> faults_detected{0};
   std::atomic<int64_t> faults_recovered{0};
   std::atomic<int64_t> ranks_blacklisted{0};
+  // Self-healing accounting (docs/elastic.md "heal vs shrink vs
+  // rejoin"): transfers that resumed IN PLACE after a stall or a CRC
+  // NAK-resend (no fault recorded, no epoch bump), extra patience/
+  // resend windows spent getting there, chunks that failed CRC32C
+  // verification (HOROVOD_WIRE_CRC), and joiner slots absorbed by a
+  // grow re-formation (blacklist parole).
+  std::atomic<int64_t> wire_heals{0};
+  std::atomic<int64_t> wire_retries{0};
+  std::atomic<int64_t> crc_errors{0};
+  std::atomic<int64_t> ranks_rejoined{0};
 
   // Host-ring transport accounting, kept SEPARATE from the per-op-class
   // logical payload bytes above: `wire_*_bytes` is what actually
@@ -131,6 +141,9 @@ class Metrics {
     int64_t ring_chunk_bytes = 0;
     bool wire_compression = false;
     int64_t wire_timeout_ms = 0;
+    int64_t wire_retry_attempts = 0;   // healing ladder depth
+    int64_t wire_retry_backoff_ms = 0;
+    bool wire_crc = false;             // per-chunk CRC32C framing
     int cross_plane = 0;       // HOROVOD_CROSS_PLANE (0 auto, 1 ici,
                                // 2 ring, 3 hier)
     int64_t hier_split = 0;    // active hierarchy split (0 = flat)

@@ -5,6 +5,11 @@
 
 #include "operations.h"
 
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
 #include <algorithm>
 #include <atomic>
 #include <cctype>
@@ -149,14 +154,26 @@ struct GlobalState {
   int64_t fault_epoch = 0;
   std::vector<int32_t> fault_ranks;
   std::string fault_reason;
+  // "peer" (process gone/unresponsive) or "corruption" (a live link
+  // failed CRC verification past the retry budget, HOROVOD_WIRE_CRC).
+  std::string fault_kind;
+  int64_t fault_chunk = -1;  // corrupted chunk index (corruption only)
   int64_t fault_detect_us = 0;
-  // Deterministic fault injection (HOROVOD_FAULT_INJECT="rank:op"):
-  // when this rank's op_counter reaches inject_op it dies by SIGKILL at
-  // the top of that collective's execution — the chaos-lane primitive.
+  // Deterministic fault injection — the chaos-matrix grammar
+  // (HOROVOD_FAULT_INJECT="<rank>:<op>[:<action>[:<param>]]"): when this
+  // rank's op_counter reaches inject_op it executes the armed action at
+  // the top of that collective — kill (SIGKILL, the r12 default),
+  // stop:<ms> (SIGSTOP + forked SIGCONT waker: the transient stall the
+  // healing ladder must ride out), reset (shutdown(2) every peer
+  // socket: NIC death with the process alive), flip:<bit> (corrupt one
+  // bit of the next CRC-framed wire chunk; negative bit = persistent,
+  // forcing retry exhaustion), delay:<ms> (straggler sleep).
   // One-shot per ring generation (cleared at reinit so a renumbered
   // survivor can never inherit the victim's trigger).
   std::atomic<int32_t> inject_rank{-1};
   std::atomic<int64_t> inject_op{-1};
+  std::atomic<int32_t> inject_action{0};  // FaultAction enum below
+  std::atomic<int64_t> inject_param{0};
   std::atomic<int64_t> op_counter{0};  // executed collective responses
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
@@ -208,6 +225,99 @@ struct GlobalState {
 
 GlobalState* g_state = nullptr;
 std::mutex g_init_mutex;
+
+// Chaos-matrix fault actions (HOROVOD_FAULT_INJECT grammar).
+enum FaultAction : int32_t {
+  kFaultKill = 0,
+  kFaultStop = 1,
+  kFaultReset = 2,
+  kFaultFlip = 3,
+  kFaultDelay = 4,
+};
+
+// flip's packed param: low 20 bits = bit index, the rest = frames to
+// skip before flipping (ArmWireFlip). 2^20 bits = a 128 KiB chunk —
+// comfortably past any bit the modulo will keep anyway.
+constexpr int kFlipSkipShift = 20;
+constexpr int64_t kFlipBitMask = (1 << kFlipSkipShift) - 1;
+
+// Strict grammar parse: "<rank>:<op>[:<action>[:<param>[:<extra>]]]".
+// Returns false on ANY malformed spec — the trigger must stay disarmed
+// (a lenient parse reading garbage as 0:0 would kill rank 0 at its
+// first collective). stop/delay require a positive ms param; flip
+// requires a bit (negative = persistent |bit|) and takes an optional
+// skip count (one-shot only); kill/reset take none.
+bool ParseFaultSpec(const std::string& spec, int32_t* rank, int64_t* op,
+                    int32_t* action, int64_t* param) {
+  std::vector<std::string> parts;
+  size_t start = 0;
+  while (true) {
+    size_t colon = spec.find(':', start);
+    if (colon == std::string::npos) {
+      parts.push_back(spec.substr(start));
+      break;
+    }
+    parts.push_back(spec.substr(start, colon - start));
+    start = colon + 1;
+  }
+  if (parts.size() < 2 || parts.size() > 5) return false;
+  auto parse_i64 = [](const std::string& s, int64_t* out) {
+    if (s.empty()) return false;
+    char* end = nullptr;
+    int64_t v = strtoll(s.c_str(), &end, 10);
+    if (end != s.c_str() + s.size()) return false;
+    *out = v;
+    return true;
+  };
+  int64_t rank_v = 0, op_v = 0, param_v = 0;
+  if (!parse_i64(parts[0], &rank_v) || rank_v < 0) return false;
+  if (!parse_i64(parts[1], &op_v) || op_v < 0) return false;
+  int32_t action_v = kFaultKill;
+  bool has_param = parts.size() >= 4;
+  if (parts.size() == 5 && parts[2] != "flip") return false;
+  if (parts.size() >= 3) {
+    if (parts[2] == "kill") {
+      action_v = kFaultKill;
+      if (has_param) return false;
+    } else if (parts[2] == "stop") {
+      action_v = kFaultStop;
+      if (!has_param || !parse_i64(parts[3], &param_v) || param_v <= 0) {
+        return false;
+      }
+    } else if (parts[2] == "reset") {
+      action_v = kFaultReset;
+      if (has_param) return false;
+    } else if (parts[2] == "flip") {
+      action_v = kFaultFlip;
+      if (!has_param || !parse_i64(parts[3], &param_v)) return false;
+      // A non-negative bit must fit the packed low field even WITHOUT
+      // a skip — otherwise the decode would read phantom skip frames
+      // out of the high bits and flip the wrong bit of the wrong
+      // frame. (Negative = persistent |bit|, never packed.)
+      if (param_v > kFlipBitMask) return false;
+      if (parts.size() == 5) {
+        // flip:<bit>:<skip> — skip data frames first (one-shot only).
+        int64_t skip_v = 0;
+        if (param_v < 0 || !parse_i64(parts[4], &skip_v) || skip_v < 0) {
+          return false;
+        }
+        param_v |= skip_v << kFlipSkipShift;
+      }
+    } else if (parts[2] == "delay") {
+      action_v = kFaultDelay;
+      if (!has_param || !parse_i64(parts[3], &param_v) || param_v <= 0) {
+        return false;
+      }
+    } else {
+      return false;
+    }
+  }
+  *rank = (int32_t)rank_v;
+  *op = op_v;
+  *action = action_v;
+  *param = param_v;
+  return true;
+}
 
 // ONE construction site for the controller config, shared by init and
 // reinit so a knob added to one can never silently diverge in the
@@ -752,6 +862,10 @@ void RecordFault(GlobalState& st, const Status& s,
   }
   std::sort(ranks.begin(), ranks.end());
   ranks.erase(std::unique(ranks.begin(), ranks.end()), ranks.end());
+  // Wire corruption names a LIVE peer (the link is bad, not the
+  // process): never "certain" in the membership sense, so driver-less
+  // shrink can't misread a corrupting link as a dead rank.
+  if (s.wire_corruption()) certain = false;
   {
     std::lock_guard<std::mutex> lk(st.fault_mutex);
     st.faulted = true;
@@ -760,6 +874,8 @@ void RecordFault(GlobalState& st, const Status& s,
     st.fault_epoch = st.epoch.load();
     st.fault_ranks = ranks;
     st.fault_reason = s.reason();
+    st.fault_kind = s.wire_corruption() ? "corruption" : "peer";
+    st.fault_chunk = s.wire_corruption() ? s.fault_chunk() : -1;
     st.fault_detect_us = detect_us;
   }
   Metrics& m = GlobalMetrics();
@@ -767,19 +883,89 @@ void RecordFault(GlobalState& st, const Status& s,
   m.fault_detect_us.Record(detect_us);
 }
 
-// HOROVOD_FAULT_INJECT: die by SIGKILL at the top of the inject_op-th
-// executed collective on the matching rank. Responses are negotiated
-// identically on every rank, so the counter indexes the same collective
-// everywhere — the precision the chaos lane needs. Counted classes:
-// everything that executes (JOIN bookkeeping and ERROR verdicts are
-// skipped on every rank alike).
+// HOROVOD_FAULT_INJECT: execute the armed chaos action at the top of
+// the inject_op-th executed collective on the matching rank. Responses
+// are negotiated identically on every rank, so the counter indexes the
+// same collective everywhere — the precision the chaos lane needs.
+// Counted classes: everything that executes (JOIN bookkeeping and ERROR
+// verdicts are skipped on every rank alike). Non-kill actions disarm
+// before executing (one-shot by construction; kill needs no disarm).
 void MaybeInjectFault(GlobalState& st) {
   int64_t idx = st.op_counter.fetch_add(1, std::memory_order_relaxed);
-  if (st.inject_rank.load(std::memory_order_relaxed) == st.rank &&
-      st.inject_op.load(std::memory_order_relaxed) == idx) {
-    LOG_WARN("HOROVOD_FAULT_INJECT: rank %d dying at collective %lld",
-             st.rank, (long long)idx);
-    raise(SIGKILL);
+  if (st.inject_rank.load(std::memory_order_relaxed) != st.rank ||
+      st.inject_op.load(std::memory_order_relaxed) != idx) {
+    return;
+  }
+  const int32_t action = st.inject_action.load(std::memory_order_relaxed);
+  const int64_t param = st.inject_param.load(std::memory_order_relaxed);
+  switch (action) {
+    case kFaultKill:
+      LOG_WARN("HOROVOD_FAULT_INJECT: rank %d dying at collective %lld",
+               st.rank, (long long)idx);
+      raise(SIGKILL);
+      break;
+    case kFaultStop: {
+      LOG_WARN("HOROVOD_FAULT_INJECT: rank %d SIGSTOPping %lld ms at "
+               "collective %lld",
+               st.rank, (long long)param, (long long)idx);
+      st.inject_rank = -1;
+      // A stopped process cannot wake itself: fork a waker that sleeps
+      // out the stall and SIGCONTs the parent. The child touches only
+      // async-signal-safe calls (we fork from a multi-threaded
+      // process).
+      pid_t waker = fork();
+      if (waker == 0) {
+        struct timespec ts;
+        ts.tv_sec = param / 1000;
+        ts.tv_nsec = (param % 1000) * 1000000L;
+        nanosleep(&ts, nullptr);
+        kill(getppid(), SIGCONT);
+        _exit(0);
+      }
+      if (waker < 0) {
+        // No waker, no SIGCONT: stopping now would turn a bounded
+        // stall into a permanent one. Skip the injection loudly.
+        LOG_WARN("HOROVOD_FAULT_INJECT: fork for stop waker failed "
+                 "(%s); skipping the stall", strerror(errno));
+        break;
+      }
+      raise(SIGSTOP);
+      // Resumed: the waker has SIGCONTed us and is exiting — reap it
+      // so chaos runs don't accumulate zombies.
+      waitpid(waker, nullptr, 0);
+      break;
+    }
+    case kFaultReset:
+      LOG_WARN("HOROVOD_FAULT_INJECT: rank %d resetting every peer "
+               "socket at collective %lld",
+               st.rank, (long long)idx);
+      st.inject_rank = -1;
+      // The NIC-died shape: every peer connection aborts (they see
+      // EOF -> certain attribution) while this process stays alive.
+      for (int fd : RegisteredFds()) ::shutdown(fd, SHUT_RDWR);
+      break;
+    case kFaultFlip: {
+      const bool persistent = param < 0;
+      const int64_t bit = persistent ? -param : (param & kFlipBitMask);
+      const int64_t skip = persistent ? 0 : param >> kFlipSkipShift;
+      LOG_WARN("HOROVOD_FAULT_INJECT: rank %d flipping wire bit %lld "
+               "(skip %lld frames) at collective %lld%s",
+               st.rank, (long long)bit, (long long)skip, (long long)idx,
+               persistent ? " (persistent)" : "");
+      st.inject_rank = -1;
+      ArmWireFlip(bit, persistent, skip);
+      break;
+    }
+    case kFaultDelay:
+      LOG_WARN("HOROVOD_FAULT_INJECT: rank %d sleeping %lld ms at "
+               "collective %lld",
+               st.rank, (long long)param, (long long)idx);
+      st.inject_rank = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(param));
+      break;
+    default:
+      st.inject_rank = -1;
+      break;
   }
 }
 
@@ -861,7 +1047,7 @@ Status ExecuteResponse(GlobalState& st, const Response& response) {
     }
   }
   AccountResponse(response, entries, status);
-  if (status.peer_failure()) {
+  if (status.peer_failure() || status.wire_corruption()) {
     // Record the fault BEFORE any handle wakes an API thread: the
     // Python error path reads hvdtpu_last_fault to type the exception,
     // so the record must already exist when synchronize() returns.
@@ -944,7 +1130,7 @@ void BackgroundThreadLoop(GlobalState& st) {
       for (auto& n : response.tensor_names) st.timeline.NegotiateEnd(n);
       Status es = ExecuteResponse(st, response);
       cycle_bytes += ResponseBytes(response);
-      if (es.peer_failure()) {
+      if (es.peer_failure() || es.wire_corruption()) {
         // A peer died mid-collective: the ring is unrecoverable at this
         // epoch. ExecuteResponse already recorded the fault (before any
         // handle woke an API thread); drain everything still pending
@@ -1095,9 +1281,18 @@ int hvdtpu_init() {
   SetWireCompression(EnvInt64("HOROVOD_WIRE_COMPRESSION", 0) != 0);
   SetWireTimeoutMs(
       EnvInt64("HOROVOD_WIRE_TIMEOUT_MS", kDefaultWireTimeoutMs));
+  SetWireRetryAttempts(EnvInt64("HOROVOD_WIRE_RETRY_ATTEMPTS", 0));
+  SetWireRetryBackoffMs(EnvInt64("HOROVOD_WIRE_RETRY_BACKOFF_MS", 250));
+  SetWireCrc(EnvInt64("HOROVOD_WIRE_CRC", 0) != 0);
 
-  // Fresh world: epoch 0, no fault on record, injection from env.
-  st->epoch = 0;
+  // World epoch: 0 for a fresh launch; a REJOINING process (blacklist
+  // parole, docs/elastic.md) is told the survivors' next epoch by the
+  // rejoin door and initializes straight into it — same port-shift and
+  // hello-fence rules as a survivor's reinit, so stale-generation
+  // traffic cannot reach the regrown ring.
+  const int64_t join_epoch =
+      std::max<int64_t>(EnvInt64("HOROVOD_JOIN_EPOCH", 0), 0);
+  st->epoch = join_epoch;
   st->op_counter = 0;
   {
     std::lock_guard<std::mutex> lk(st->fault_mutex);
@@ -1106,33 +1301,32 @@ int hvdtpu_init() {
     st->fault_certain = false;
     st->fault_ranks.clear();
     st->fault_reason.clear();
+    st->fault_kind.clear();
+    st->fault_chunk = -1;
   }
   st->inject_rank = -1;
   st->inject_op = -1;
+  st->inject_action = kFaultKill;
+  st->inject_param = 0;
   {
-    // HOROVOD_FAULT_INJECT="<rank>:<op_index>": deterministic chaos —
-    // that rank SIGKILLs itself at the top of its op_index-th executed
-    // collective (docs/elastic.md). Strictly parsed: a malformed spec
-    // must stay DISARMED (a lenient strtol would read garbage as 0:0
-    // and kill rank 0 at its first collective).
+    // HOROVOD_FAULT_INJECT="<rank>:<op>[:<action>[:<param>]]" — the
+    // chaos grammar (docs/elastic.md). Strictly parsed: a malformed
+    // spec must stay DISARMED (a lenient strtol would read garbage as
+    // 0:0 and kill rank 0 at its first collective).
     std::string spec = EnvStr("HOROVOD_FAULT_INJECT", "");
-    size_t colon = spec.find(':');
-    if (colon != std::string::npos) {
-      char* end1 = nullptr;
-      char* end2 = nullptr;
-      long rank_v = strtol(spec.c_str(), &end1, 10);
-      long long op_v = strtoll(spec.c_str() + colon + 1, &end2, 10);
-      if (end1 == spec.c_str() + colon && end2 != nullptr &&
-          *end2 == '\0' && rank_v >= 0 && op_v >= 0) {
-        st->inject_rank = (int32_t)rank_v;
+    if (!spec.empty()) {
+      int32_t rank_v = -1, action_v = kFaultKill;
+      int64_t op_v = -1, param_v = 0;
+      if (ParseFaultSpec(spec, &rank_v, &op_v, &action_v, &param_v)) {
+        st->inject_rank = rank_v;
         st->inject_op = op_v;
+        st->inject_action = action_v;
+        st->inject_param = param_v;
       } else {
-        LOG_WARN("ignoring malformed HOROVOD_FAULT_INJECT=%s "
-                 "(expected <rank>:<op_index>)", spec.c_str());
+        LOG_WARN("ignoring malformed HOROVOD_FAULT_INJECT=%s (expected "
+                 "<rank>:<op>[:kill|stop:<ms>|reset|flip:<bit>|"
+                 "delay:<ms>])", spec.c_str());
       }
-    } else if (!spec.empty()) {
-      LOG_WARN("ignoring malformed HOROVOD_FAULT_INJECT=%s "
-               "(expected <rank>:<op_index>)", spec.c_str());
     }
   }
 
@@ -1141,7 +1335,9 @@ int hvdtpu_init() {
   st->base_controller_port =
       (int)EnvInt64("HOROVOD_CONTROLLER_PORT", 29500);
   ControllerConfig cfg = MakeControllerConfig(
-      *st, st->rank, st->size, /*epoch=*/0, st->base_controller_port);
+      *st, st->rank, st->size, join_epoch,
+      st->base_controller_port +
+          (join_epoch > 0 ? (int)(join_epoch % 512) : 0));
   st->controller = std::make_unique<Controller>(cfg);
   Status s = st->controller->Initialize();
   if (!s.ok()) {
@@ -1158,7 +1354,14 @@ int hvdtpu_init() {
   // global verdict identically everywhere.
   bool want_hier =
       st->cross_plane_mode == 0 || st->cross_plane_mode == 3;
-  if (want_hier && st->size > 1) {
+  // A parole joiner (HOROVOD_JOIN_EPOCH > 0) must NOT run the probe:
+  // it is a COLLECTIVE, and the survivors it joined re-formed through
+  // hvdtpu_reinit, which never probes — the lone probe allreduce would
+  // hang the joiner (and starve its control heartbeat) until the
+  // coordinator declared it dead. A grown world is flat by
+  // construction (reinit's joiner-slot fallback), so flat is the
+  // correct — not just safe — answer here.
+  if (want_hier && st->size > 1 && join_epoch == 0) {
     int64_t probe[3] = {
         st->local_size, -(int64_t)st->local_size,
         (st->local_rank == st->rank % std::max(st->local_size, 1) &&
@@ -1220,12 +1423,51 @@ int64_t hvdtpu_wire_timeout_ms() { return WireTimeoutMs(); }
 
 void hvdtpu_set_wire_timeout_ms(int64_t ms) { SetWireTimeoutMs(ms); }
 
+// Healing-ladder + integrity knobs (docs/wire.md): process-global like
+// the deadline, valid before init, re-read from env at every (re)init.
+int64_t hvdtpu_wire_retry_attempts() { return WireRetryAttempts(); }
+
+void hvdtpu_set_wire_retry_attempts(int64_t n) { SetWireRetryAttempts(n); }
+
+int64_t hvdtpu_wire_retry_backoff_ms() { return WireRetryBackoffMs(); }
+
+void hvdtpu_set_wire_retry_backoff_ms(int64_t ms) {
+  SetWireRetryBackoffMs(ms);
+}
+
+int hvdtpu_wire_crc() { return WireCrc() ? 1 : 0; }
+
+void hvdtpu_set_wire_crc(int on) { SetWireCrc(on != 0); }
+
 // Runtime fault-injection arm/disarm (the env knob's programmatic twin;
-// rank < 0 disarms). Exposed through basics.py for the chaos tests.
+// rank < 0 disarms; action defaults to kill). Exposed through basics.py
+// for the chaos tests.
 int hvdtpu_set_fault_inject(int rank, int64_t op_index) {
   if (g_state == nullptr) return -1;
   g_state->inject_rank = rank;
   g_state->inject_op = op_index;
+  g_state->inject_action = kFaultKill;
+  g_state->inject_param = 0;
+  return 0;
+}
+
+// Full chaos-grammar arm: "<rank>:<op>[:<action>[:<param>]]" (see
+// MaybeInjectFault). Returns 0 armed, -1 not initialized, -2 malformed
+// spec (trigger left untouched — never half-armed).
+int hvdtpu_set_fault_inject_spec(const char* spec) {
+  if (spec == nullptr) return -2;
+  int32_t rank_v = -1, action_v = kFaultKill;
+  int64_t op_v = -1, param_v = 0;
+  // Parse before the state check so the grammar is validatable from
+  // any process (the malformed-spec tests need no ring).
+  if (!ParseFaultSpec(spec, &rank_v, &op_v, &action_v, &param_v)) {
+    return -2;
+  }
+  if (g_state == nullptr) return -1;
+  g_state->inject_action = action_v;
+  g_state->inject_param = param_v;
+  g_state->inject_op = op_v;
+  g_state->inject_rank = rank_v;
   return 0;
 }
 
@@ -1261,6 +1503,14 @@ int64_t hvdtpu_last_fault(char* buf, int64_t cap) {
       }
       json += "],\"certain\":";
       json += g_state->fault_certain ? "true" : "false";
+      json += ",\"kind\":\"";
+      JsonEscapeInto(json, g_state->fault_kind.empty()
+                               ? std::string("peer")
+                               : g_state->fault_kind);
+      json += "\"";
+      if (g_state->fault_chunk >= 0) {
+        json += ",\"chunk\":" + std::to_string(g_state->fault_chunk);
+      }
       json += ",\"reason\":\"";
       JsonEscapeInto(json, g_state->fault_reason);
       json += "\",\"detect_ms\":" +
@@ -1278,16 +1528,20 @@ int64_t hvdtpu_last_fault(char* buf, int64_t cap) {
 }
 
 // Re-form the ring over `ranks` (OLD global rank numbers, every member
-// listing them identically) at membership epoch `epoch` WITHOUT process
-// restart: rejoin the dead loop, rebuild controller + full-mesh data
-// plane among survivors (the N-1 ring reuses the same ring_ops.h
-// rotation helpers, so results are bit-identical to a fresh N-1 world),
-// and fence the old generation out via the epoch (stale hellos and
-// frames are rejected; epoch e rendezvouses on base_port + e so the
-// half-dead stragglers' retries knock on a dead door). Returns 0 on
-// success; -1 bad args / not initialized, -2 loop still healthy (only a
-// faulted or exited loop may re-form), -3 this rank is not a survivor,
-// -4 re-formation rendezvous failed.
+// listing them identically; -1 entries are JOINER slots taken by fresh
+// processes initializing with HOROVOD_JOIN_EPOCH — the blacklist-parole
+// grow path) at membership epoch `epoch` WITHOUT process restart:
+// rebuild controller + full-mesh data plane among the members (a
+// shrunk ring reuses the same ring_ops.h rotation helpers, so results
+// are bit-identical to a fresh same-size world), and fence the old
+// generation out via the epoch (stale hellos and frames are rejected;
+// epoch e rendezvouses on base_port + e so the half-dead stragglers'
+// retries knock on a dead door). A HEALTHY loop may re-form too (the
+// scale-up path): every member sets the negotiated-shutdown bit, so the
+// collective call drains the old generation cleanly before rebuilding.
+// Returns 0 on success; -1 bad args / not initialized, -3 this rank is
+// not a survivor, -4 re-formation rendezvous failed, -5 external (MPI)
+// transport.
 int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
   std::lock_guard<std::mutex> lk(g_init_mutex);
   if (g_state == nullptr || !g_state->initialized.load() ||
@@ -1295,7 +1549,6 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
     return -1;
   }
   GlobalState* st = g_state;
-  if (!st->loop_failed.load() && !st->loop_exited.load()) return -2;
   if (EnvStr("HOROVOD_CONTROLLER", "") == "mpi") {
     // External-transport fds encode the launcher's fixed peer ranks;
     // an in-process renumbering would address the wrong mailboxes (and
@@ -1305,11 +1558,26 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
     return -5;
   }
   int new_rank = -1;
+  int joiner_slots = 0;
   for (int i = 0; i < nranks; i++) {
-    if (ranks[i] == st->rank) new_rank = i;
+    if (ranks[i] < 0) {
+      joiner_slots++;
+    } else if (ranks[i] == st->rank) {
+      new_rank = i;
+    }
   }
   if (new_rank < 0) return -3;  // this rank was declared dead
+  if (!st->loop_failed.load() && !st->loop_exited.load()) {
+    // Healthy loop (voluntary re-formation — absorbing parole
+    // joiners): request the NEGOTIATED shutdown. Every member calls
+    // reinit at the same logical point, so the coordinator sees all
+    // shutdown bits and the loops drain together; a lone caller would
+    // block here, which is the correct failure shape for a
+    // non-collective misuse.
+    st->shutdown_requested = true;
+  }
   if (st->background_thread.joinable()) st->background_thread.join();
+  st->shutdown_requested = false;
   const int old_size = st->size;
   const int old_rank = st->rank;
   const int old_local_rank = st->local_rank;
@@ -1345,7 +1613,9 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
   // derives the SAME layout without another collective.
   int new_local_size = nranks;
   int32_t new_hier_split = 0;
-  if (old_hier_split > 1 && old_local_size > 0) {
+  if (old_hier_split > 1 && old_local_size > 0 && joiner_slots == 0) {
+    // Joiner slots have no old host to group by: a grown world starts
+    // flat (the driver path restores launcher-grade layouts).
     bool tiles = true;
     for (int i = 1; i < nranks; i++) {
       if (ranks[i] <= ranks[i - 1]) tiles = false;  // must be sorted
@@ -1418,16 +1688,27 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
   }
   old_controller.reset();  // the new ring is up; now drop the old fds
   old_process_sets.reset();
+  bool had_fault = false;
   {
     std::lock_guard<std::mutex> flk(st->fault_mutex);
-    st->fault_recovered = true;
+    had_fault = st->faulted && !st->fault_recovered;
+    if (st->faulted) st->fault_recovered = true;
   }
   {
     Metrics& m = GlobalMetrics();
-    m.faults_recovered.fetch_add(1, std::memory_order_relaxed);
-    if (old_size > nranks) {
-      m.ranks_blacklisted.fetch_add(old_size - nranks,
+    if (had_fault) {
+      m.faults_recovered.fetch_add(1, std::memory_order_relaxed);
+    }
+    // Blacklisted = old ranks absent from the member list; rejoined =
+    // parole slots absorbed. A combined shrink+grow books both.
+    const int survivors = nranks - joiner_slots;
+    if (old_size > survivors) {
+      m.ranks_blacklisted.fetch_add(old_size - survivors,
                                     std::memory_order_relaxed);
+    }
+    if (joiner_slots > 0) {
+      m.ranks_rejoined.fetch_add(joiner_slots,
+                                 std::memory_order_relaxed);
     }
   }
   st->shutdown_requested = false;
@@ -1966,6 +2247,9 @@ int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap) {
       info.ring_chunk_bytes = RingChunkBytes();
       info.wire_compression = WireCompression();
       info.wire_timeout_ms = WireTimeoutMs();
+      info.wire_retry_attempts = WireRetryAttempts();
+      info.wire_retry_backoff_ms = WireRetryBackoffMs();
+      info.wire_crc = WireCrc();
       info.cross_plane = g_state->cross_plane_mode;
       info.hier_split = g_state->hier_split.load();
       info.cross_compression = g_state->cross_compression;

@@ -28,18 +28,32 @@ int64_t hvdtpu_epoch();
 // snapshot: (nullptr, 0) sizes it, a second call copies NUL-terminated.
 // {"faulted":false} until the loop has stopped on a peer failure.
 int64_t hvdtpu_last_fault(char* buf, int64_t cap);
-// Re-form the ring over the surviving OLD ranks at a new epoch without
-// process restart. Collective among survivors; requires a faulted (or
-// exited) loop. 0 on success, negative codes in operations.cc.
+// Re-form the ring over the surviving OLD ranks (-1 entries = joiner
+// slots taken by fresh HOROVOD_JOIN_EPOCH processes — scale-up) at a
+// new epoch without process restart. Collective among members; a
+// healthy loop drains via the negotiated shutdown first. 0 on success,
+// negative codes in operations.cc.
 int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch);
 // Wire progress deadline (HOROVOD_WIRE_TIMEOUT_MS; <= 0 disables).
 // Process-global, valid before init, like the ring knobs.
 int64_t hvdtpu_wire_timeout_ms();
 void hvdtpu_set_wire_timeout_ms(int64_t ms);
+// Transient-fault healing ladder + per-chunk CRC32C wire integrity
+// (HOROVOD_WIRE_RETRY_ATTEMPTS / _BACKOFF_MS / HOROVOD_WIRE_CRC;
+// docs/wire.md). Same process-global contract as the deadline.
+int64_t hvdtpu_wire_retry_attempts();
+void hvdtpu_set_wire_retry_attempts(int64_t n);
+int64_t hvdtpu_wire_retry_backoff_ms();
+void hvdtpu_set_wire_retry_backoff_ms(int64_t ms);
+int hvdtpu_wire_crc();
+void hvdtpu_set_wire_crc(int on);
 // Deterministic fault injection (HOROVOD_FAULT_INJECT's programmatic
 // twin): `rank` SIGKILLs itself at its op_index-th executed collective.
 // rank < 0 disarms. One-shot per ring generation.
 int hvdtpu_set_fault_inject(int rank, int64_t op_index);
+// Full chaos grammar: "<rank>:<op>[:kill|stop:<ms>|reset|flip:<bit>|
+// delay:<ms>]". 0 armed, -1 not initialized, -2 malformed (disarmed).
+int hvdtpu_set_fault_inject_spec(const char* spec);
 int hvdtpu_rank();
 int hvdtpu_size();
 int hvdtpu_local_rank();

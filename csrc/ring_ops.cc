@@ -844,6 +844,12 @@ Status DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
     *off = i * CHUNK;
     *len = std::min(CHUNK, bytes - *off);
   };
+  // Every hop goes through the duplex entry (one-sided where only one
+  // direction is live): the head/tail pieces used to be raw
+  // SendAll/RecvAll, which under HOROVOD_WIRE_CRC would frame one end
+  // of a socket and not the other. On the external transport and the
+  // plain TCP path a one-sided duplex degrades to exactly the old
+  // send/recv.
   if (is_root) {
     // Send CHUNK-sized pieces, matching the forwarders' chunked
     // receives: over TCP the stream hides the boundaries, but the
@@ -852,7 +858,8 @@ Status DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
     for (int64_t i = 0; i < nchunks; i++) {
       int64_t off, len;
       chunk_span(i, &off, &len);
-      Status s = SendAll(right_fd(), base + off, (size_t)len);
+      Status s = DuplexTransfer(right_fd(), base + off, (size_t)len, -1,
+                                nullptr, 0);
       if (!s.ok()) return s;
     }
     return Status::OK();
@@ -867,14 +874,16 @@ Status DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
                                 left_fd(), base + off, (size_t)len);
       if (!s.ok()) return s;
     } else {
-      Status s = RecvAll(left_fd(), base + off, (size_t)len);
+      Status s = DuplexTransfer(-1, nullptr, 0, left_fd(), base + off,
+                                (size_t)len);
       if (!s.ok()) return s;
     }
   }
   if (forwards) {
     int64_t off, len;
     chunk_span(nchunks - 1, &off, &len);
-    return SendAll(right_fd(), base + off, (size_t)len);
+    return DuplexTransfer(right_fd(), base + off, (size_t)len, -1,
+                          nullptr, 0);
   }
   return Status::OK();
 }

@@ -25,6 +25,7 @@
 
 #include "common.h"
 #include "half.h"
+#include "logging.h"
 #include "ring_ops.h"
 #include "wire.h"
 
@@ -219,7 +220,11 @@ int hvdtpu_ring_selftest(int ranks, int64_t count, int dtype, int reduce_op,
   SetWireCompression(saved_comp);
 
   for (int r = 0; r < ranks; r++) {
-    if (!statuses[r].ok()) return -3;
+    if (!statuses[r].ok()) {
+      LOG_WARN("ring selftest rank %d failed: %s", r,
+               statuses[r].reason().c_str());
+      return -3;
+    }
   }
   double max_err = 0.0;
   int rc = 0;

@@ -11,13 +11,18 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
+#include <vector>
+
+#include "metrics.h"
 
 namespace hvdtpu {
 
@@ -29,6 +34,27 @@ ExternalRecvFn g_ext_recv = nullptr;
 // initialized from env; first reader folds HOROVOD_WIRE_TIMEOUT_MS in,
 // so the ring selftest and other pre-init paths honor the knob too.
 std::atomic<int64_t> g_wire_timeout_ms{-1};
+
+// Transient-fault healing + wire-integrity knobs (wire.h). Same lazy
+// env-fold pattern as the deadline; re-read at every (re)init.
+std::atomic<int64_t> g_wire_retry_attempts{-2};  // -2 = uninitialized
+std::atomic<int64_t> g_wire_retry_backoff_ms{-2};
+std::atomic<int> g_wire_crc{-1};  // -1 = uninitialized
+
+// Chaos: flip one bit of the next CRC-framed outgoing data chunk
+// (ArmWireFlip). Relaxed atomics: armed by the background thread that
+// also runs the transfers.
+std::atomic<int64_t> g_flip_bit{-1};
+std::atomic<bool> g_flip_persistent{false};
+std::atomic<int64_t> g_flip_skip{0};
+
+int64_t EnvInt64OrDefault(const char* name, int64_t dflt) {
+  const char* env = std::getenv(name);
+  if (env == nullptr) return dflt;
+  char* end = nullptr;
+  int64_t parsed = strtoll(env, &end, 10);
+  return end != env ? parsed : dflt;
+}
 
 // fd -> global rank, for peer attribution in timeout/EOF statuses.
 // Registered by the controller (control fds) and the root data plane;
@@ -115,18 +141,48 @@ int WaitFd(int fd, short events, int64_t timeout_ms) {
     return rc < 0 ? -1 : (rc == 0 ? 0 : 1);
   }
 }
+
+// One poll() over `n` fds honoring EINTR. Same return contract as
+// WaitFd.
+int PollOnce(pollfd* fds, int n, int64_t timeout_ms) {
+  while (true) {
+    int rc = poll(fds, (nfds_t)n, timeout_ms <= 0 ? -1 : (int)timeout_ms);
+    if (rc < 0 && errno == EINTR) continue;
+    return rc < 0 ? -1 : (rc == 0 ? 0 : 1);
+  }
+}
+
+// The healing ladder (wire.h): base deadline first, then up to
+// WireRetryAttempts() extra windows of WireRetryBackoffMs() << attempt.
+// A window that turns ready after at least one expiry books a HEAL; an
+// expired window books a RETRY. `allow_retry` is false for explicit
+// (control-plane) deadlines — those stay single-window.
+int PollHealing(pollfd* fds, int n, int64_t timeout_ms, bool allow_retry) {
+  int rc = PollOnce(fds, n, timeout_ms);
+  if (rc != 0 || !allow_retry || timeout_ms <= 0) return rc;
+  const int64_t attempts = WireRetryAttempts();
+  const int64_t backoff = std::max<int64_t>(WireRetryBackoffMs(), 1);
+  Metrics& m = GlobalMetrics();
+  for (int64_t a = 0; a < attempts; a++) {
+    m.wire_retries.fetch_add(1, std::memory_order_relaxed);
+    // Exponential patience, capped so the ladder stays responsive to a
+    // genuinely dead peer: one window never exceeds 64x the base.
+    int64_t window = backoff << std::min<int64_t>(a, 6);
+    rc = PollOnce(fds, n, window);
+    if (rc != 0) {
+      if (rc == 1) m.wire_heals.fetch_add(1, std::memory_order_relaxed);
+      return rc;
+    }
+  }
+  return 0;
+}
 }  // namespace
 
 int64_t WireTimeoutMs() {
   int64_t v = g_wire_timeout_ms.load(std::memory_order_relaxed);
   if (v == -1) {
-    const char* env = std::getenv("HOROVOD_WIRE_TIMEOUT_MS");
-    v = kDefaultWireTimeoutMs;
-    if (env != nullptr) {
-      char* end = nullptr;
-      int64_t parsed = strtoll(env, &end, 10);
-      if (end != env) v = parsed;  // non-numeric keeps the default
-    }
+    v = EnvInt64OrDefault("HOROVOD_WIRE_TIMEOUT_MS",
+                          kDefaultWireTimeoutMs);
     if (v == -1) v = 0;  // same normalization as SetWireTimeoutMs
     g_wire_timeout_ms.store(v, std::memory_order_relaxed);
   }
@@ -137,6 +193,78 @@ void SetWireTimeoutMs(int64_t ms) {
   // -1 is the "uninitialized" sentinel; normalize a literal -1 to the
   // equivalent "no deadline" 0.
   g_wire_timeout_ms.store(ms == -1 ? 0 : ms, std::memory_order_relaxed);
+}
+
+int64_t WireRetryAttempts() {
+  int64_t v = g_wire_retry_attempts.load(std::memory_order_relaxed);
+  if (v == -2) {
+    v = std::max<int64_t>(
+        EnvInt64OrDefault("HOROVOD_WIRE_RETRY_ATTEMPTS", 0), 0);
+    g_wire_retry_attempts.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+void SetWireRetryAttempts(int64_t n) {
+  g_wire_retry_attempts.store(std::max<int64_t>(n, 0),
+                              std::memory_order_relaxed);
+}
+
+int64_t WireRetryBackoffMs() {
+  int64_t v = g_wire_retry_backoff_ms.load(std::memory_order_relaxed);
+  if (v == -2) {
+    v = std::max<int64_t>(
+        EnvInt64OrDefault("HOROVOD_WIRE_RETRY_BACKOFF_MS", 250), 1);
+    g_wire_retry_backoff_ms.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+void SetWireRetryBackoffMs(int64_t ms) {
+  g_wire_retry_backoff_ms.store(std::max<int64_t>(ms, 1),
+                                std::memory_order_relaxed);
+}
+
+bool WireCrc() {
+  int v = g_wire_crc.load(std::memory_order_relaxed);
+  if (v == -1) {
+    v = EnvInt64OrDefault("HOROVOD_WIRE_CRC", 0) != 0 ? 1 : 0;
+    g_wire_crc.store(v, std::memory_order_relaxed);
+  }
+  return v != 0;
+}
+
+void SetWireCrc(bool on) {
+  g_wire_crc.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+// CRC32C (Castagnoli, reflected 0x82F63B78) — the iSCSI/ext4 polynomial,
+// table-driven software implementation (no SSE4.2 dependency so the
+// sanitizer and portable builds stay identical).
+uint32_t Crc32c(const void* data, size_t len) {
+  static const uint32_t* table = [] {
+    static uint32_t t[256];
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) {
+        c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+      }
+      t[i] = c;
+    }
+    return t;
+  }();
+  uint32_t crc = 0xFFFFFFFFu;
+  const auto* p = (const uint8_t*)data;
+  for (size_t i = 0; i < len; i++) {
+    crc = table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  }
+  return crc ^ 0xFFFFFFFFu;
+}
+
+void ArmWireFlip(int64_t bit, bool persistent, int64_t skip) {
+  g_flip_persistent.store(persistent, std::memory_order_relaxed);
+  g_flip_skip.store(skip, std::memory_order_relaxed);
+  g_flip_bit.store(bit, std::memory_order_relaxed);
 }
 
 void RegisterFdRank(int fd, int rank) {
@@ -157,6 +285,14 @@ int FdRank(int fd) {
   std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
   auto it = g_fd_ranks.find(fd);
   return it == g_fd_ranks.end() ? -1 : it->second;
+}
+
+std::vector<int> RegisteredFds() {
+  std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
+  std::vector<int> fds;
+  fds.reserve(g_fd_ranks.size());
+  for (auto& kv : g_fd_ranks) fds.push_back(kv.first);
+  return fds;
 }
 
 void SetExternalTransport(ExternalSendFn send, ExternalRecvFn recv) {
@@ -247,6 +383,10 @@ void TcpClose(int fd) {
 // the background thread forever on a dead rank.
 Status SendAll(int fd, const void* buf, size_t len, int64_t timeout_ms) {
   if (IsExtFd(fd)) return ExtSend(fd, buf, len);
+  // The healing ladder only wraps deadlines resolved from the GLOBAL
+  // knob: explicit control-plane deadlines (heartbeats, rendezvous
+  // budgets) must stay single-window.
+  const bool global_deadline = timeout_ms == kWireTimeoutGlobal;
   timeout_ms = ResolveTimeout(timeout_ms);
   const char* p = (const char*)buf;
   while (len > 0) {
@@ -254,7 +394,10 @@ Status SendAll(int fd, const void* buf, size_t len, int64_t timeout_ms) {
     if (n < 0) {
       if (errno == EINTR) continue;
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
-        int w = WaitFd(fd, POLLOUT, timeout_ms);
+        pollfd pf{};
+        pf.fd = fd;
+        pf.events = POLLOUT;
+        int w = PollHealing(&pf, 1, timeout_ms, global_deadline);
         if (w == 0) return PeerTimeout(fd, "send", timeout_ms);
         if (w < 0) {
           return Status::Error(std::string("poll failed: ") +
@@ -272,6 +415,7 @@ Status SendAll(int fd, const void* buf, size_t len, int64_t timeout_ms) {
 
 Status RecvAll(int fd, void* buf, size_t len, int64_t timeout_ms) {
   if (IsExtFd(fd)) return ExtRecvExact(fd, buf, len);
+  const bool global_deadline = timeout_ms == kWireTimeoutGlobal;
   timeout_ms = ResolveTimeout(timeout_ms);
   char* p = (char*)buf;
   while (len > 0) {
@@ -279,7 +423,10 @@ Status RecvAll(int fd, void* buf, size_t len, int64_t timeout_ms) {
     if (n < 0) {
       if (errno == EINTR) continue;
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
-        int w = WaitFd(fd, POLLIN, timeout_ms);
+        pollfd pf{};
+        pf.fd = fd;
+        pf.events = POLLIN;
+        int w = PollHealing(&pf, 1, timeout_ms, global_deadline);
         if (w == 0) return PeerTimeout(fd, "recv", timeout_ms);
         if (w < 0) {
           return Status::Error(std::string("poll failed: ") +
@@ -339,22 +486,426 @@ namespace {
 // since nobody would be draining its recv side meanwhile.
 class ScopedNonblock {
  public:
+  // fd < 0 (one-sided transfers — e.g. the Broadcast head/tail hops)
+  // is skipped.
   ScopedNonblock(int fd1, int fd2) : fd1_(fd1), fd2_(fd2) {
-    flags1_ = fcntl(fd1_, F_GETFL, 0);
-    fcntl(fd1_, F_SETFL, flags1_ | O_NONBLOCK);
-    if (fd2_ != fd1_) {
+    if (fd1_ >= 0) {
+      flags1_ = fcntl(fd1_, F_GETFL, 0);
+      fcntl(fd1_, F_SETFL, flags1_ | O_NONBLOCK);
+    }
+    if (fd2_ != fd1_ && fd2_ >= 0) {
       flags2_ = fcntl(fd2_, F_GETFL, 0);
       fcntl(fd2_, F_SETFL, flags2_ | O_NONBLOCK);
     }
   }
   ~ScopedNonblock() {
-    fcntl(fd1_, F_SETFL, flags1_);
-    if (fd2_ != fd1_) fcntl(fd2_, F_SETFL, flags2_);
+    if (fd1_ >= 0) fcntl(fd1_, F_SETFL, flags1_);
+    if (fd2_ != fd1_ && fd2_ >= 0) fcntl(fd2_, F_SETFL, flags2_);
   }
 
  private:
   int fd1_, fd2_, flags1_ = 0, flags2_ = 0;
 };
+
+// ---- CRC-framed duplex (HOROVOD_WIRE_CRC, wire.h) --------------------
+// Wire format (TCP only; the knob is rank-uniform by contract — this IS
+// the framing):
+//   data frame: 'D1' | u32 idx (LE) | u32 crc32c(payload) (LE) | payload
+//   nak frame:  'A7' | u32 idx      (receiver -> sender: resend idx)
+//   done frame: '5E'                (receiver -> sender: all verified)
+// Payload length is derived from idx (every chunk is `chunk` bytes, the
+// last the remainder), so frames are self-describing. Data flows on the
+// forward direction of the data socket; acks ride the SAME socket's
+// reverse direction (in a ring, the socket a rank receives on is the
+// one its upstream neighbor sends on — which that neighbor polls for
+// acks). At size 2 (and pairwise exchange) both directions share one
+// socket; the type byte demultiplexes. The receiver writes payloads
+// into their final offsets but hands a chunk onward (on_chunk /
+// returning) ONLY after its CRC verifies — corrupted bytes can never be
+// reduced into a result. A NAKed chunk is resent from the caller's
+// still-live segment buffer (idempotent: same offset, same bytes); the
+// same chunk failing more than WireRetryAttempts()+1 times escalates to
+// a typed WireCorruption naming (rank, chunk).
+
+constexpr uint8_t kCrcData = 0xD1;
+constexpr uint8_t kCrcNak = 0xA7;
+constexpr uint8_t kCrcDone = 0x5E;
+
+uint32_t LoadLE32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+void StoreLE32(uint8_t* p, uint32_t v) {
+  p[0] = (uint8_t)v;
+  p[1] = (uint8_t)(v >> 8);
+  p[2] = (uint8_t)(v >> 16);
+  p[3] = (uint8_t)(v >> 24);
+}
+
+struct CrcFrameRef {
+  uint8_t type;
+  uint32_t idx;
+};
+
+// Outgoing frame stream for one fd: a queue of frame refs plus the
+// partial-write state of the frame currently on the wire. Data payloads
+// stream straight from the caller's segment buffer (no copy) except
+// when the chaos flip hook stages a corrupted image.
+struct CrcOutgoing {
+  std::deque<CrcFrameRef> q;
+  bool active = false;
+  uint8_t hdr[9];
+  size_t hdr_len = 0, hdr_sent = 0;
+  const uint8_t* pay = nullptr;
+  size_t pay_len = 0, pay_sent = 0;
+  bool done_flushed = false;
+  std::vector<uint8_t> flip_scratch;
+};
+
+// Incoming parser state for one fd.
+struct CrcIncoming {
+  int stage = 0;  // 0 = type byte, 1 = header, 2 = payload
+  uint8_t type = 0;
+  uint8_t hdr[8];
+  size_t hdr_need = 0, hdr_got = 0;
+  uint32_t idx = 0, crc = 0;
+  size_t pay_got = 0, pay_len = 0;
+  uint8_t* pay_dst = nullptr;
+};
+
+Status DuplexCrcTransfer(
+    int send_fd, const uint8_t* send_buf, size_t send_len, int recv_fd,
+    uint8_t* recv_buf, size_t recv_len, size_t chunk,
+    const std::function<void(size_t off, size_t len)>& on_chunk) {
+  if (chunk == 0) chunk = std::max(send_len, recv_len);
+  const size_t ns = send_len ? (send_len + chunk - 1) / chunk : 0;
+  const size_t nr = recv_len ? (recv_len + chunk - 1) / chunk : 0;
+
+  struct Slot {
+    int fd = -1;
+    bool send_role = false, recv_role = false;
+    CrcOutgoing out;
+    CrcIncoming in;
+  };
+  Slot slots[2];
+  int nslots = 0;
+  auto slot_for = [&](int fd) -> Slot* {
+    for (int i = 0; i < nslots; i++) {
+      if (slots[i].fd == fd) return &slots[i];
+    }
+    slots[nslots].fd = fd;
+    return &slots[nslots++];
+  };
+  Slot* ssend = ns > 0 ? slot_for(send_fd) : nullptr;
+  if (ssend != nullptr) ssend->send_role = true;
+  Slot* srecv = nr > 0 ? slot_for(recv_fd) : nullptr;
+  if (srecv != nullptr) srecv->recv_role = true;
+  if (nslots == 0) return Status::OK();
+
+  std::vector<uint8_t> verified(nr, 0);
+  std::vector<int64_t> failures(nr, 0);
+  size_t n_verified = 0;
+  bool peer_done = ns == 0;  // nothing sent -> no ack expected
+  const int64_t max_fails = 1 + WireRetryAttempts();
+  Metrics& m = GlobalMetrics();
+
+  if (ssend != nullptr) {
+    for (size_t i = 0; i < ns; i++) {
+      ssend->out.q.push_back({kCrcData, (uint32_t)i});
+    }
+  }
+
+  auto send_chunk_len = [&](uint32_t idx) {
+    return std::min(chunk, send_len - (size_t)idx * chunk);
+  };
+  auto recv_chunk_len = [&](uint32_t idx) {
+    return std::min(chunk, recv_len - (size_t)idx * chunk);
+  };
+
+  // Pop the next queued frame on `s` and build its header (staging a
+  // flipped payload image when the chaos hook is armed — the CRC is
+  // computed over the TRUE payload first, so the receiver must catch
+  // the mismatch).
+  auto begin_frame = [&](Slot* s) {
+    CrcFrameRef f = s->out.q.front();
+    s->out.q.pop_front();
+    s->out.active = true;
+    s->out.hdr_sent = 0;
+    s->out.pay_sent = 0;
+    s->out.hdr[0] = f.type;
+    s->out.pay = nullptr;
+    s->out.pay_len = 0;
+    if (f.type == kCrcData) {
+      size_t len = send_chunk_len(f.idx);
+      const uint8_t* pay = send_buf + (size_t)f.idx * chunk;
+      uint32_t crc = Crc32c(pay, len);
+      int64_t bit = g_flip_bit.load(std::memory_order_relaxed);
+      if (bit >= 0 && len > 0) {
+        if (g_flip_skip.load(std::memory_order_relaxed) > 0) {
+          g_flip_skip.fetch_sub(1, std::memory_order_relaxed);
+        } else {
+          s->out.flip_scratch.assign(pay, pay + len);
+          size_t b = (size_t)(bit % (int64_t)(len * 8));
+          s->out.flip_scratch[b / 8] ^= (uint8_t)(1u << (b % 8));
+          pay = s->out.flip_scratch.data();
+          if (!g_flip_persistent.load(std::memory_order_relaxed)) {
+            g_flip_bit.store(-1, std::memory_order_relaxed);
+          }
+        }
+      }
+      StoreLE32(s->out.hdr + 1, f.idx);
+      StoreLE32(s->out.hdr + 5, crc);
+      s->out.hdr_len = 9;
+      s->out.pay = pay;
+      s->out.pay_len = len;
+    } else if (f.type == kCrcNak) {
+      StoreLE32(s->out.hdr + 1, f.idx);
+      s->out.hdr_len = 5;
+    } else {  // kCrcDone
+      s->out.hdr_len = 1;
+    }
+  };
+
+  // Flush frames until the socket would block. Returns false with *st
+  // set on a fatal transport error.
+  auto writable = [&](Slot* s, Status* st) -> bool {
+    while (true) {
+      if (!s->out.active) {
+        if (s->out.q.empty()) return true;
+        begin_frame(s);
+      }
+      bool blocked = false;
+      while (s->out.hdr_sent < s->out.hdr_len) {
+        ssize_t k = send(s->fd, s->out.hdr + s->out.hdr_sent,
+                         s->out.hdr_len - s->out.hdr_sent, MSG_NOSIGNAL);
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            blocked = true;
+            break;
+          }
+          *st = PeerIoError(s->fd, "crc duplex send");
+          return false;
+        }
+        s->out.hdr_sent += (size_t)k;
+      }
+      if (blocked) return true;
+      while (s->out.pay_sent < s->out.pay_len) {
+        ssize_t k = send(s->fd, s->out.pay + s->out.pay_sent,
+                         s->out.pay_len - s->out.pay_sent, MSG_NOSIGNAL);
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            blocked = true;
+            break;
+          }
+          *st = PeerIoError(s->fd, "crc duplex send");
+          return false;
+        }
+        s->out.pay_sent += (size_t)k;
+      }
+      if (blocked) return true;
+      if (s->out.hdr[0] == kCrcDone) s->out.done_flushed = true;
+      s->out.active = false;
+    }
+  };
+
+  // Everything this call needs from `s` has arrived: the peer's ack of
+  // our send and/or every chunk verified. CRITICAL stop condition for
+  // the reader — bytes beyond this point belong to the NEXT transfer
+  // on this socket (the peer moves on as soon as its own conditions
+  // are met), and draining them here would corrupt that call's frames.
+  auto slot_satisfied = [&](Slot* s) {
+    return (!s->send_role || peer_done) &&
+           (!s->recv_role || n_verified >= nr);
+  };
+
+  // Dispatch complete frames until the socket would block or the slot
+  // is satisfied. Returns false with *st set on a fatal error (EOF,
+  // protocol violation, CRC retry exhaustion).
+  auto readable = [&](Slot* s, Status* st) -> bool {
+    while (!slot_satisfied(s)) {
+      CrcIncoming& in = s->in;
+      if (in.stage == 0) {
+        uint8_t t = 0;
+        ssize_t k = recv(s->fd, &t, 1, MSG_DONTWAIT);
+        if (k == 0) {
+          *st = PeerClosed(s->fd);
+          return false;
+        }
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+          *st = PeerIoError(s->fd, "crc duplex recv");
+          return false;
+        }
+        in.type = t;
+        in.hdr_got = 0;
+        if (t == kCrcDone) {
+          peer_done = true;
+          continue;
+        }
+        if (t == kCrcData) {
+          in.hdr_need = 8;
+        } else if (t == kCrcNak) {
+          in.hdr_need = 4;
+        } else {
+          *st = Status::Error("crc duplex: unknown frame type " +
+                              std::to_string((int)t) + " from rank " +
+                              std::to_string(FdRank(s->fd)));
+          return false;
+        }
+        in.stage = 1;
+      }
+      if (in.stage == 1) {
+        bool blocked = false;
+        while (in.hdr_got < in.hdr_need) {
+          ssize_t k = recv(s->fd, in.hdr + in.hdr_got,
+                           in.hdr_need - in.hdr_got, MSG_DONTWAIT);
+          if (k == 0) {
+            *st = PeerClosed(s->fd);
+            return false;
+          }
+          if (k < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+              blocked = true;
+              break;
+            }
+            *st = PeerIoError(s->fd, "crc duplex recv");
+            return false;
+          }
+          in.hdr_got += (size_t)k;
+        }
+        if (blocked) return true;
+        in.idx = LoadLE32(in.hdr);
+        if (in.type == kCrcNak) {
+          if (ssend == nullptr || (size_t)in.idx >= ns) {
+            *st = Status::Error("crc duplex: NAK for chunk " +
+                                std::to_string(in.idx) +
+                                " of a " + std::to_string(ns) +
+                                "-chunk transfer");
+            return false;
+          }
+          ssend->out.q.push_back({kCrcData, in.idx});
+          in.stage = 0;
+          continue;
+        }
+        if (!s->recv_role || (size_t)in.idx >= nr) {
+          *st = Status::Error("crc duplex: data chunk " +
+                              std::to_string(in.idx) +
+                              " outside the expected " +
+                              std::to_string(nr) + "-chunk transfer");
+          return false;
+        }
+        in.crc = LoadLE32(in.hdr + 4);
+        in.pay_len = recv_chunk_len(in.idx);
+        in.pay_dst = recv_buf + (size_t)in.idx * chunk;
+        in.pay_got = 0;
+        in.stage = 2;
+      }
+      bool blocked = false;
+      while (in.pay_got < in.pay_len) {
+        ssize_t k = recv(s->fd, in.pay_dst + in.pay_got,
+                         in.pay_len - in.pay_got, MSG_DONTWAIT);
+        if (k == 0) {
+          *st = PeerClosed(s->fd);
+          return false;
+        }
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            blocked = true;
+            break;
+          }
+          *st = PeerIoError(s->fd, "crc duplex recv");
+          return false;
+        }
+        in.pay_got += (size_t)k;
+      }
+      if (blocked) return true;
+      in.stage = 0;
+      // (the slot_satisfied loop condition re-checks after this frame)
+      if (Crc32c(in.pay_dst, in.pay_len) == in.crc) {
+        if (!verified[in.idx]) {
+          verified[in.idx] = 1;
+          n_verified++;
+          if (failures[in.idx] > 0) {
+            m.wire_heals.fetch_add(1, std::memory_order_relaxed);
+          }
+          if (on_chunk) on_chunk((size_t)in.idx * chunk, in.pay_len);
+          if (n_verified == nr) srecv->out.q.push_back({kCrcDone, 0});
+        }
+        continue;
+      }
+      m.crc_errors.fetch_add(1, std::memory_order_relaxed);
+      if (++failures[in.idx] > max_fails) {
+        int rank = FdRank(s->fd);
+        *st = Status::WireCorruption(
+            rank, (int64_t)in.idx,
+            "wire chunk " + std::to_string(in.idx) + " from rank " +
+                (rank >= 0 ? std::to_string(rank) : "<unknown>") +
+                " failed CRC32C verification " +
+                std::to_string(failures[in.idx]) +
+                " times (HOROVOD_WIRE_CRC; retry budget "
+                "HOROVOD_WIRE_RETRY_ATTEMPTS exhausted)");
+        return false;
+      }
+      m.wire_retries.fetch_add(1, std::memory_order_relaxed);
+      srecv->out.q.push_back({kCrcNak, in.idx});
+    }
+    return true;  // slot satisfied: later bytes belong to the NEXT call
+  };
+
+  ScopedNonblock nb(ssend != nullptr ? send_fd : -1,
+                    srecv != nullptr ? recv_fd : -1);
+  const int64_t timeout_ms = WireTimeoutMs();
+  Status st = Status::OK();
+  while (true) {
+    const bool send_side_done = ns == 0 || peer_done;
+    const bool recv_side_done =
+        nr == 0 || (n_verified == nr && srecv->out.done_flushed);
+    if (send_side_done && recv_side_done) return Status::OK();
+    pollfd fds[2];
+    Slot* by[2];
+    int n = 0;
+    for (int i = 0; i < nslots; i++) {
+      Slot& s = slots[i];
+      short ev = 0;
+      if (s.out.active || !s.out.q.empty()) ev |= POLLOUT;
+      if ((s.recv_role && n_verified < nr) ||
+          (s.send_role && !peer_done)) {
+        ev |= POLLIN;
+      }
+      if (ev == 0) continue;
+      fds[n].fd = s.fd;
+      fds[n].events = ev;
+      fds[n].revents = 0;
+      by[n] = &s;
+      n++;
+    }
+    if (n == 0) {
+      return Status::Error("crc duplex: internal protocol stall");
+    }
+    int rc = PollHealing(fds, n, timeout_ms, /*allow_retry=*/true);
+    if (rc < 0) {
+      return Status::Error(std::string("poll failed: ") + strerror(errno));
+    }
+    if (rc == 0) {
+      return PeerTimeout(nr > 0 && n_verified < nr ? recv_fd : send_fd,
+                         "crc duplex transfer", timeout_ms);
+    }
+    for (int i = 0; i < n; i++) {
+      if (fds[i].revents & (POLLOUT | POLLERR)) {
+        if (!writable(by[i], &st)) return st;
+      }
+      if (fds[i].revents & (POLLIN | POLLHUP)) {
+        if (!readable(by[i], &st)) return st;
+      }
+    }
+  }
+}
 }  // namespace
 
 Status DuplexTransfer(int send_fd, const void* send_buf, size_t send_len,
@@ -387,6 +938,13 @@ Status DuplexTransferChunked(
     if (s.ok() && on_chunk && recv_len > 0) on_chunk(0, recv_len);
     return s;
   }
+  if (WireCrc()) {
+    // Integrity mode: typed per-chunk frames with CRC32C + NAK/resend
+    // (wire.h). Chunk 0 degrades to one whole-segment frame.
+    return DuplexCrcTransfer(send_fd, (const uint8_t*)send_buf, send_len,
+                             recv_fd, (uint8_t*)recv_buf, recv_len, chunk,
+                             on_chunk);
+  }
   ScopedNonblock nb(send_fd, recv_fd);
   const int64_t timeout_ms = WireTimeoutMs();
   const char* sp = (const char*)send_buf;
@@ -406,9 +964,8 @@ Status DuplexTransferChunked(
       fds[n].events = POLLIN;
       recv_idx = n++;
     }
-    int rc = poll(fds, (nfds_t)n, timeout_ms <= 0 ? -1 : (int)timeout_ms);
+    int rc = PollHealing(fds, n, timeout_ms, /*allow_retry=*/true);
     if (rc < 0) {
-      if (errno == EINTR) continue;
       return Status::Error(std::string("poll failed: ") + strerror(errno));
     }
     if (rc == 0) {

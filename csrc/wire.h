@@ -50,12 +50,61 @@ constexpr int64_t kWireTimeoutGlobal = -2;
 int64_t WireTimeoutMs();
 void SetWireTimeoutMs(int64_t ms);
 
+// ---- transient-fault healing (HOROVOD_WIRE_RETRY_*) ------------------
+// A wire deadline expiring is SUSPICION, not proof (the peer may be
+// SIGSTOPped, GC-paused, or riding out a network blip). Before
+// escalating a timeout into a PeerFailure, the wire layer waits out up
+// to HOROVOD_WIRE_RETRY_ATTEMPTS extra windows of exponentially growing
+// patience (HOROVOD_WIRE_RETRY_BACKOFF_MS << attempt). The transfer
+// state (sent/received offsets, verified chunks) lives across the
+// retries, so a resumed peer continues the in-flight transfer from the
+// last acked byte/chunk — no world shrink, no epoch bump. Progress
+// resuming after at least one expired window counts as a HEAL
+// (metrics "elastic.heals"); exhaustion escalates to the r12 fault
+// path. Retries only wrap deadlines resolved from the GLOBAL knob —
+// explicit control-plane deadlines (heartbeats) stay crisp. Defaults:
+// 0 attempts (healing off), 250 ms base backoff.
+int64_t WireRetryAttempts();
+void SetWireRetryAttempts(int64_t n);
+int64_t WireRetryBackoffMs();
+void SetWireRetryBackoffMs(int64_t ms);
+
+// ---- wire integrity (HOROVOD_WIRE_CRC) -------------------------------
+// When on, every DuplexTransfer/DuplexTransferChunked over TCP frames
+// its payload as typed per-chunk messages carrying a CRC32C, and the
+// receiver acks the transfer: a chunk failing verification is NAKed and
+// resent by the sender (which still holds the segment), healing
+// transient corruption in place; the same chunk failing more than
+// WireRetryAttempts()+1 times escalates to a typed
+// Status::WireCorruption(rank, chunk) so corrupted data is NEVER
+// silently reduced into a result. Covers the bf16-compressed and
+// cross-plane hops (they ride the same duplex entry). Rank-uniform by
+// contract (the CRC framing IS the wire format); env-only — the
+// autotuner never touches it. Off by default: zero framing overhead.
+bool WireCrc();
+void SetWireCrc(bool on);
+uint32_t Crc32c(const void* data, size_t len);
+
+// Chaos hook (HOROVOD_FAULT_INJECT=rank:op:flip:bit[:skip]): flip
+// `bit` (modulo the frame's payload bits) in a CRC-framed data chunk
+// this process sends, AFTER its CRC is computed — wire corruption the
+// receiver must catch. `skip` lets that many data frames pass first,
+// so a specific hop of a multi-phase collective (e.g. the bf16
+// cross-plane chunk of a hierarchical allreduce) can be targeted
+// deterministically. bit >= 0 is one-shot; persistent=true re-flips
+// every subsequent frame (including resends), forcing NAK-retry
+// exhaustion so the escalation path is testable.
+void ArmWireFlip(int64_t bit, bool persistent, int64_t skip = 0);
+
 // Peer attribution: planes register which GLOBAL rank sits behind each
 // connected fd so timeout/EOF statuses can name the casualty. External
 // (message-transport) fds encode the peer directly and need no entry.
 void RegisterFdRank(int fd, int rank);
 void UnregisterFdRank(int fd);  // TcpClose calls this itself
 int FdRank(int fd);             // -1 when unknown
+// Every currently registered peer fd (control + data planes) — the
+// chaos "reset" action shuts them all down to emulate NIC death.
+std::vector<int> RegisteredFds();
 
 // Exact-length send/recv, deadline-bound (see above). timeout_ms:
 // kWireTimeoutGlobal = the knob, <= 0 = block forever, else explicit.

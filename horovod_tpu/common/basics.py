@@ -142,6 +142,20 @@ def load_library():
         lib.hvdtpu_wire_timeout_ms.argtypes = []
         lib.hvdtpu_set_wire_timeout_ms.restype = None
         lib.hvdtpu_set_wire_timeout_ms.argtypes = [i64]
+        lib.hvdtpu_wire_retry_attempts.restype = i64
+        lib.hvdtpu_wire_retry_attempts.argtypes = []
+        lib.hvdtpu_set_wire_retry_attempts.restype = None
+        lib.hvdtpu_set_wire_retry_attempts.argtypes = [i64]
+        lib.hvdtpu_wire_retry_backoff_ms.restype = i64
+        lib.hvdtpu_wire_retry_backoff_ms.argtypes = []
+        lib.hvdtpu_set_wire_retry_backoff_ms.restype = None
+        lib.hvdtpu_set_wire_retry_backoff_ms.argtypes = [i64]
+        lib.hvdtpu_wire_crc.restype = i32
+        lib.hvdtpu_wire_crc.argtypes = []
+        lib.hvdtpu_set_wire_crc.restype = None
+        lib.hvdtpu_set_wire_crc.argtypes = [i32]
+        lib.hvdtpu_set_fault_inject_spec.restype = i32
+        lib.hvdtpu_set_fault_inject_spec.argtypes = [cstr]
         lib.hvdtpu_epoch.restype = i64
         lib.hvdtpu_epoch.argtypes = []
         lib.hvdtpu_last_fault.restype = i64
@@ -343,6 +357,35 @@ class HorovodBasics:
         knobs; valid before init)."""
         self.lib.hvdtpu_set_wire_timeout_ms(int(ms))
 
+    def wire_retry_attempts(self):
+        """Healing-ladder depth (``HOROVOD_WIRE_RETRY_ATTEMPTS``): extra
+        exponential-backoff windows a stalled transfer waits out before
+        a timeout escalates to a fault. 0 = healing off (the r12
+        behavior). See ``docs/wire.md``."""
+        return self.lib.hvdtpu_wire_retry_attempts()
+
+    def set_wire_retry_attempts(self, n):
+        self.lib.hvdtpu_set_wire_retry_attempts(int(n))
+
+    def wire_retry_backoff_ms(self):
+        """Base backoff of the healing ladder
+        (``HOROVOD_WIRE_RETRY_BACKOFF_MS``); window i waits
+        ``backoff << min(i, 6)`` ms."""
+        return self.lib.hvdtpu_wire_retry_backoff_ms()
+
+    def set_wire_retry_backoff_ms(self, ms):
+        self.lib.hvdtpu_set_wire_retry_backoff_ms(int(ms))
+
+    def wire_crc(self):
+        """Whether host-ring transfers carry per-chunk CRC32C framing
+        (``HOROVOD_WIRE_CRC``): silent corruption becomes a NAK/resend
+        heal or a typed ``WireCorruption``. MUST be rank-uniform — the
+        framing IS the wire format. See ``docs/wire.md``."""
+        return bool(self.lib.hvdtpu_wire_crc())
+
+    def set_wire_crc(self, on):
+        self.lib.hvdtpu_set_wire_crc(1 if on else 0)
+
     def epoch(self):
         """Membership epoch of the current ring generation (0 for a
         fresh init; bumped by every :meth:`reinit`)."""
@@ -375,9 +418,13 @@ class HorovodBasics:
 
     def reinit(self, ranks, epoch):
         """Re-form the ring over surviving OLD ranks at a new epoch
-        without process restart (collective among survivors; the loop
-        must have stopped on a fault). Raises on failure with the core's
-        reason code. See ``docs/elastic.md``."""
+        without process restart (collective among the members). A ``-1``
+        entry is a JOINER slot: a fresh process initializing with
+        ``HOROVOD_JOIN_EPOCH=epoch`` takes that new rank — the
+        blacklist-parole scale-up path. A healthy loop drains via the
+        negotiated shutdown first, so voluntary grow works without a
+        fault. Raises on failure with the core's reason code. See
+        ``docs/elastic.md``."""
         import ctypes as _ct
 
         ranks = [int(r) for r in ranks]
@@ -385,7 +432,6 @@ class HorovodBasics:
         rc = self.lib.hvdtpu_reinit(arr, len(ranks), int(epoch))
         if rc != 0:
             reasons = {-1: "not initialized / bad ranks",
-                       -2: "background loop still healthy",
                        -3: "this rank is not in the survivor set",
                        -4: "re-formation rendezvous failed",
                        -5: "not supported on the external (MPI) "
@@ -401,6 +447,22 @@ class HorovodBasics:
         disarms). The primitive the chaos lane is built on."""
         if self.lib.hvdtpu_set_fault_inject(int(rank), int(op_index)) != 0:
             raise RuntimeError("set_fault_inject requires hvd.init()")
+
+    def set_fault_inject_spec(self, spec):
+        """Arm the full chaos grammar
+        (``<rank>:<op>[:kill|stop:<ms>|reset|flip:<bit>|delay:<ms>]``,
+        docs/elastic.md): SIGKILL, a timed SIGSTOP stall, peer-socket
+        reset, a wire bit-flip (negative bit = persistent), or a
+        straggler delay at a deterministic collective index. Raises on
+        a malformed spec (the trigger stays disarmed)."""
+        rc = self.lib.hvdtpu_set_fault_inject_spec(str(spec).encode())
+        if rc == -1:
+            raise RuntimeError("set_fault_inject_spec requires hvd.init()")
+        if rc != 0:
+            raise ValueError(
+                f"malformed fault-injection spec {spec!r} (expected "
+                "<rank>:<op>[:kill|stop:<ms>|reset|flip:<bit>|"
+                "delay:<ms>])")
 
     def ring_owned_segment(self, rank, size, rot=0):
         """Which buffer segment ``rank`` owns (holds fully reduced)

@@ -132,18 +132,27 @@ class Handle:
 # eager-op callers and elastic-mode catch blocks see the same class.
 HorovodInternalError = _exceptions.HorovodInternalError
 HorovodPeerFailureError = _exceptions.HorovodPeerFailureError
+HorovodWireCorruptionError = _exceptions.HorovodWireCorruptionError
 HorovodVersionMismatchError = _exceptions.HorovodVersionMismatchError
 
 
 def _internal_error(msg):
     """Build the recoverable error for a failed collective: the typed
-    :class:`HorovodPeerFailureError` (with the core's fault attribution)
-    when the runtime stopped on a lost peer, the plain
-    :class:`HorovodInternalError` otherwise."""
+    :class:`HorovodWireCorruptionError` when a CRC-protected link
+    corrupted past the retry budget, :class:`HorovodPeerFailureError`
+    (with the core's fault attribution) when the runtime stopped on a
+    lost peer, the plain :class:`HorovodInternalError` otherwise."""
     fault = _basics.last_fault()
     # A recovered record belongs to a previous epoch: an ordinary error
     # in the re-formed ring must not masquerade as a peer failure.
     if fault is not None and not fault.get("recovered"):
+        if fault.get("kind") == "corruption":
+            return HorovodWireCorruptionError(
+                f"{msg}: {fault.get('reason', '')}",
+                fault_ranks=fault.get("ranks", ()),
+                epoch=fault.get("epoch", 0),
+                detect_ms=fault.get("detect_ms"),
+                chunk=fault.get("chunk"))
         return HorovodPeerFailureError(
             msg, fault_ranks=fault.get("ranks", ()),
             epoch=fault.get("epoch", 0),

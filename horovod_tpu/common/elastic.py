@@ -53,6 +53,295 @@ def _worker_id():
     return wid
 
 
+def survivors():
+    """Rank-consistent survivor list for the CURRENT fault, or ``None``
+    when no driver-less agreement is possible.
+
+    THE r12 gotcha, codified: the list is derived from
+    ``last_fault()["ranks"]`` gated on ``["certain"]`` — never from
+    per-rank suspicion, because a timeout may name a different live
+    neighbor on each rank and split-brain the re-formation rendezvous.
+    The only exception is a 2-rank world, where the suspect is
+    necessarily the only other rank. Every survivor computes the
+    IDENTICAL list (the core's socket probe sweep converges on the same
+    provably-dead set), which is exactly what ``reinit`` requires.
+    Returns ``None`` (use the full re-initialization path) when there
+    is no unrecovered fault, the record is suspicion-only at size > 2,
+    or the fault is wire corruption (the peer is alive — shrinking it
+    out would be wrong).
+    """
+    if not _basics.is_initialized():
+        return None
+    fault = _basics.last_fault()
+    if fault is None or fault.get("recovered"):
+        return None
+    if fault.get("kind") == "corruption":
+        # The "dead" rank is a live peer behind a corrupting link:
+        # shrinking it out would evict a healthy worker.
+        return None
+    dead = {int(r) for r in fault.get("ranks") or ()}
+    size = _basics.size()
+    if not dead or not (fault.get("certain") or size == 2):
+        return None
+    return [r for r in range(size) if r not in dead]
+
+
+# ---- blacklist parole: the rejoin door (docs/elastic.md) -------------
+# Driver-less scale-up: rank 0 keeps a TCP "door" open
+# (HOROVOD_REJOIN_PORT on every rank enables it). A returning host
+# connects, says hello, and is held on parole; at the next epoch
+# transition every survivor asks the door for the epoch's FROZEN joiner
+# count (frozen once per target epoch, so all survivors agree), the
+# world re-forms with that many -1 slots, and the door releases each
+# joiner its assignment (rank/size/epoch/controller endpoint) so it can
+# initialize straight into the regrown ring via HOROVOD_JOIN_EPOCH.
+
+
+def _rejoin_port():
+    port = os.environ.get("HOROVOD_REJOIN_PORT")
+    return int(port) if port else 0
+
+
+def _rejoin_addr():
+    return os.environ.get(
+        "HOROVOD_REJOIN_ADDR",
+        os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1"))
+
+
+class _ParoleDoor:
+    """Rank 0's rejoin listener. Hellos are held pending; ``freeze``
+    snapshots the pending set per target epoch (idempotent — the
+    survivor-agreement primitive); ``release`` hands each frozen joiner
+    its assignment."""
+
+    def __init__(self, port):
+        import threading
+
+        self._lock = threading.Lock()
+        self._pending = []   # [(conn, hello)]
+        self._frozen = {}    # epoch -> [(conn, hello)]; NEVER popped —
+        self._released = set()  # a survivor may poll the count AFTER
+        # rank 0 released the assignments, and must still see the same
+        # number (the agreement would otherwise split-brain the
+        # re-formation world size).
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("", port))
+        self._sock.listen(16)
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        import threading
+
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        import json
+
+        try:
+            conn.settimeout(30)
+            msg = json.loads(conn.makefile("r").readline())
+        except (OSError, ValueError):
+            conn.close()
+            return
+        if msg.get("op") == "hello":
+            conn.settimeout(None)
+            with self._lock:
+                self._pending.append((conn, msg))
+        elif msg.get("op") == "poll":
+            count = self.freeze(int(msg["epoch"]))
+            try:
+                conn.sendall(
+                    (json.dumps({"count": count}) + "\n").encode())
+            except OSError:
+                pass
+            finally:
+                conn.close()
+        else:
+            conn.close()
+
+    def pending_count(self):
+        with self._lock:
+            return len(self._pending)
+
+    def freeze(self, epoch):
+        with self._lock:
+            if epoch not in self._frozen:
+                self._frozen[epoch] = self._pending
+                self._pending = []
+            return len(self._frozen[epoch])
+
+    def release(self, epoch, assignments):
+        import json
+
+        with self._lock:
+            if epoch in self._released:
+                return
+            self._released.add(epoch)
+            held = list(self._frozen.get(epoch, ()))
+        for (conn, _), asg in zip(held, assignments):
+            try:
+                conn.sendall((json.dumps(asg) + "\n").encode())
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+
+_door = None
+
+
+def _ensure_door():
+    """Open the door on rank 0 when parole is enabled (idempotent)."""
+    global _door
+    if (_door is None and _rejoin_port() and _basics.is_initialized()
+            and _basics.rank() == 0):
+        _door = _ParoleDoor(_rejoin_port())
+    return _door
+
+
+# Per-epoch poll counter: the commit-time rejoin check is a collective,
+# so its tensor name must match on every rank — including a joiner whose
+# process-lifetime counter starts fresh. (epoch, n) resets n at every
+# epoch transition, which all members observe together.
+_rejoin_poll_state = {"epoch": None, "n": 0}
+
+
+def _poll_rejoiners():
+    """Commit-time scale-up check (driver-less only): the agreed count
+    of paroled joiners waiting at the door. Collective — rank 0's local
+    count is MAX-reduced so every rank raises (or not) at the SAME
+    step; an inconsistent per-rank decision would desynchronize the
+    SPMD loop and fault it.
+
+    ``HOROVOD_REJOIN_POLL=0`` disables the commit-time check (and its
+    per-commit collective): joiners are then absorbed only at
+    fault-driven epoch transitions — the "never interrupt healthy
+    training" policy."""
+    if _is_elastic() or not _rejoin_port() or not _basics.is_initialized():
+        return 0
+    if os.environ.get("HOROVOD_REJOIN_POLL", "1") == "0":
+        return 0
+    door = _ensure_door()
+    local = door.pending_count() if door is not None else 0
+    if _basics.size() == 1:
+        return local
+    import numpy as np
+
+    state = _rejoin_poll_state
+    epoch = _basics.epoch()
+    if state["epoch"] != epoch:
+        state["epoch"] = epoch
+        state["n"] = 0
+    name = f"elastic.rejoin_poll.{epoch}.{state['n']}"
+    state["n"] += 1
+    out = eager_ops.allreduce_async(
+        np.array([local], dtype=np.int64), name,
+        op=eager_ops.ReduceOp.MAX).synchronize()
+    return int(out[0])
+
+
+def _freeze_joiners(target_epoch):
+    """The frozen joiner count for ``target_epoch`` — identical on
+    every survivor (the door freezes once per epoch; rank 0 asks
+    in-process, the rest over TCP)."""
+    if _is_elastic() or not _rejoin_port():
+        return 0
+    if _basics.rank() == 0:
+        door = _ensure_door()
+        return door.freeze(target_epoch) if door is not None else 0
+    import json
+    import time as _time
+
+    # The count MUST match rank 0's or the re-formation world sizes
+    # split-brain (mismatched rendezvous -> -4 -> full re-init
+    # everywhere, stranding any released joiner). Retry transient door
+    # failures before giving up; a persistently unreachable door (rank
+    # 0's process gone) legitimately means "no joiners" — the full
+    # re-init fallback is the right recovery there anyway.
+    for attempt in range(3):
+        try:
+            with socket.create_connection(
+                    (_rejoin_addr(), _rejoin_port()), timeout=10) as s:
+                s.sendall((json.dumps(
+                    {"op": "poll", "epoch": target_epoch}) + "\n").encode())
+                s.settimeout(10)
+                line = s.makefile("r").readline()
+            return int(json.loads(line)["count"])
+        except (OSError, ValueError):
+            if attempt == 2:
+                import warnings
+
+                warnings.warn(
+                    "rejoin-door poll failed 3x; assuming 0 joiners for "
+                    f"epoch {target_epoch} (world-size agreement may "
+                    "degrade to the full re-init fallback)",
+                    RuntimeWarning, stacklevel=2)
+                return 0
+            _time.sleep(0.2 * (attempt + 1))
+    return 0
+
+
+def rejoin(addr=None, port=None, timeout=None):
+    """Blacklist parole, joiner side (docs/elastic.md): re-enter a
+    driver-less elastic job as a FRESH process after this host's old
+    rank was fenced out (or to scale the world up).
+
+    Connects to the survivors' rejoin door, waits to be absorbed by
+    their next epoch transition, then initializes the core straight
+    into the regrown ring at the assigned rank/epoch. Returns the
+    assignment dict. Training state flows in through the normal
+    ``hvd.elastic.run`` path: the first ``state.sync()`` broadcasts the
+    survivors' last commit (``parallel.reshard.reshard_rows``
+    re-balances row-sharded/ZeRO state)."""
+    import json
+
+    addr = addr or _rejoin_addr()
+    port = int(port or _rejoin_port())
+    if not port:
+        raise ValueError(
+            "rejoin needs HOROVOD_REJOIN_PORT (or port=) — the door the "
+            "survivors' rank 0 keeps open")
+    if timeout is None:
+        timeout = float(os.environ.get("HOROVOD_START_TIMEOUT", 60))
+    s = socket.create_connection((addr, port), timeout=timeout)
+    try:
+        s.sendall((json.dumps({"op": "hello", "worker": _worker_id(),
+                               "host": socket.gethostname()})
+                   + "\n").encode())
+        s.settimeout(timeout)
+        line = s.makefile("r").readline()
+    finally:
+        s.close()
+    if not line:
+        raise RuntimeError(
+            "rejoin door closed without an assignment (no epoch "
+            "transition absorbed this worker within the timeout)")
+    asg = json.loads(line)
+    os.environ.update({
+        "HOROVOD_RANK": str(asg["rank"]),
+        "HOROVOD_SIZE": str(asg["size"]),
+        "HOROVOD_LOCAL_RANK": str(asg["rank"]),
+        "HOROVOD_LOCAL_SIZE": str(asg["size"]),
+        "HOROVOD_CROSS_RANK": "0",
+        "HOROVOD_CROSS_SIZE": "1",
+        "HOROVOD_CONTROLLER_ADDR": asg["controller_addr"],
+        "HOROVOD_CONTROLLER_PORT": str(asg["controller_port"]),
+        "HOROVOD_JOIN_EPOCH": str(asg["epoch"]),
+    })
+    try:
+        _basics.init()
+    finally:
+        os.environ.pop("HOROVOD_JOIN_EPOCH", None)  # one-shot
+    return asg
+
+
 def init():
     """Initialize the core; in elastic mode, first obtain this epoch's rank
     assignment from the driver's rendezvous server."""
@@ -71,6 +360,7 @@ def init():
         if not bootstrap_mpi_control():
             maybe_bootstrap_from_mpi()
         _basics.init()
+        _ensure_door()  # blacklist parole (HOROVOD_REJOIN_PORT)
         return
     from horovod_tpu.runner.elastic.rendezvous import RendezvousClient
     from horovod_tpu.runner.elastic.worker import notification_manager
@@ -115,13 +405,16 @@ def _disable_xla_ici():
         xla_ici.disable()
 
 
-def _reinit_survivors():
-    """Driver-less recovery: survivors agree on the dead set from the
-    core's fault record (the socket probe sweep makes SIGKILLed peers
-    visible identically on every survivor), drop them, and re-form the
-    N-1 ring in place via ``hvdtpu_reinit`` at the next epoch — no
-    process restart, no checkpoint round-trip. Returns True when this
-    path applied; False defers to the full shutdown+init path.
+def _reset_driverless():
+    """Driver-less epoch transition, shrink AND grow in one in-place
+    re-formation: survivors agree on the dead set from the core's fault
+    record (via :func:`survivors` — the socket probe sweep makes
+    SIGKILLed peers visible identically everywhere), drop them, absorb
+    any paroled joiners frozen at the door, and re-form via
+    ``hvdtpu_reinit`` at the next epoch — no process restart, no
+    checkpoint round-trip. Also handles the pure scale-up case (healthy
+    loop interrupted by a pending joiner). Returns True when this path
+    applied; False defers to the full shutdown+init path.
 
     Limits (docs/elastic.md): the coordinator of the new epoch is the
     lowest surviving old rank, reached at the SAME
@@ -129,37 +422,58 @@ def _reinit_survivors():
     must survive (always true on single-host jobs; the driver's
     re-rendezvous covers host loss).
     """
-    if not _basics.is_initialized() or not _basics.lib.hvdtpu_loop_failed():
+    if not _basics.is_initialized():
         return False
-    fault = _basics.last_fault()
-    if fault is None or fault.get("recovered"):
-        return False
-    dead = {int(r) for r in fault.get("ranks") or ()}
-    old_size, old_rank = _basics.size(), _basics.rank()
-    # Driver-less re-formation needs every survivor to derive the SAME
-    # survivor set. Only PROVEN attribution (EOF/RST/probe — "certain")
-    # guarantees that; a timeout suspicion may name a different live
-    # neighbor on each rank and split-brain the rendezvous. Exception:
-    # at size 2 the suspected peer is necessarily the only other rank.
-    if not dead or not (fault.get("certain") or old_size == 2):
-        return False
-    survivors = [r for r in range(old_size) if r not in dead]
-    if old_rank in dead or not survivors:
-        # Deliberately NOT a HorovodInternalError: being fenced out is
-        # terminal for this process, not a recoverable collective
-        # failure — it must escape the elastic retry loop.
-        raise RuntimeError(
-            f"rank {old_rank} was declared dead by its peers "
-            f"(fault: {fault.get('reason')}); cannot rejoin epoch "
-            f"{fault.get('epoch', 0) + 1} in-process")
+    faulted = bool(_basics.lib.hvdtpu_loop_failed())
+    if faulted:
+        alive = survivors()
+        if alive is None:
+            # Suspicion-only (or corruption) at size > 2: no rank-
+            # consistent survivor set exists. Full re-init recovers
+            # without risking a split-brain shrink.
+            return False
+        fault = _basics.last_fault()
+        old_rank = _basics.rank()
+        if old_rank not in alive:
+            # Deliberately NOT a HorovodInternalError: being fenced out
+            # is terminal for this process, not a recoverable collective
+            # failure — it must escape the elastic retry loop. The host
+            # can come back through the parole door (hvd.elastic.rejoin)
+            # as a fresh process.
+            raise RuntimeError(
+                f"rank {old_rank} was declared dead by its peers "
+                f"(fault: {fault.get('reason')}); cannot rejoin epoch "
+                f"{fault.get('epoch', 0) + 1} in-process — restart and "
+                "use hvd.elastic.rejoin() (blacklist parole)")
+        target_epoch = int(fault.get("epoch", 0)) + 1
+    else:
+        alive = list(range(_basics.size()))
+        target_epoch = int(_basics.epoch()) + 1
+    joiners = _freeze_joiners(target_epoch)
+    if not faulted and joiners == 0:
+        return False  # nothing to do in place; take the full path
+    new_world = alive + [-1] * joiners
+    if joiners > 0 and _basics.rank() == 0 and _door is not None:
+        # Assignments go out BEFORE the (blocking) rendezvous so the
+        # joiners can reach it. Joiner slots take the top new ranks.
+        _door.release(target_epoch, [
+            {"rank": len(alive) + i,
+             "size": len(new_world),
+             "epoch": target_epoch,
+             "controller_addr": os.environ.get(
+                 "HOROVOD_CONTROLLER_ADDR", "127.0.0.1"),
+             "controller_port": int(os.environ.get(
+                 "HOROVOD_CONTROLLER_PORT", 29500))}
+            for i in range(joiners)])
     _disable_xla_ici()
     try:
-        _basics.reinit(survivors, int(fault.get("epoch", 0)) + 1)
+        _basics.reinit(new_world, target_epoch)
     except RuntimeError as e:
         # The re-formation rendezvous itself failed (e.g. another
-        # survivor died mid-recovery). The core restored the
-        # pre-attempt state; fall back to the full shutdown+init path
-        # instead of killing the job.
+        # survivor died mid-recovery, or a paroled joiner vanished
+        # before connecting). The core restored the pre-attempt state;
+        # fall back to the full shutdown+init path instead of killing
+        # the job.
         import warnings
 
         warnings.warn(f"in-place ring re-formation failed ({e}); "
@@ -174,11 +488,13 @@ def reset():
 
     Three paths, in order: (1) driver mode re-rendezvouses against the
     elastic driver (new rank/size/epoch env); (2) without a driver, a
-    core-reported peer fault re-forms the ring over survivors IN PLACE
-    (``hvdtpu_reinit`` — no process restart); (3) otherwise full
-    shutdown + init at the same world.
+    core-reported peer fault and/or a paroled joiner re-forms the ring
+    IN PLACE over survivors + joiner slots (``hvdtpu_reinit`` — no
+    process restart; the heal-vs-shrink-vs-rejoin table lives in
+    docs/elastic.md); (3) otherwise full shutdown + init at the same
+    world.
     """
-    if not _is_elastic() and _reinit_survivors():
+    if not _is_elastic() and _reset_driverless():
         for hook in _post_reset_hooks:
             hook()
         return
@@ -226,6 +542,11 @@ class State:
         updated, skip_sync = _poll_hosts_updated()
         if updated:
             raise HostsUpdatedInterrupt(skip_sync)
+        # Driver-less scale-up: a paroled joiner at the door interrupts
+        # every rank at the same commit (the poll is a collective), and
+        # reset() regrows the world in place.
+        if _poll_rejoiners() > 0:
+            raise HostsUpdatedInterrupt(False)
 
     # Subclass surface:
     def save(self):

@@ -38,6 +38,27 @@ class HorovodPeerFailureError(HorovodInternalError):
         self.detect_ms = detect_ms
 
 
+class HorovodWireCorruptionError(HorovodPeerFailureError):
+    """A CRC-protected wire chunk failed integrity verification past the
+    retry budget (``HOROVOD_WIRE_CRC``, ``docs/wire.md``) — the link to
+    a LIVE peer is corrupting data.
+
+    The typed guarantee: corrupted bytes were NEVER reduced into a
+    result (the receiver only hands a chunk onward after its CRC32C
+    verifies). ``fault_ranks`` names the sending peer and ``chunk`` the
+    failing chunk index. Still a :class:`HorovodInternalError`, so
+    elastic recovery rolls back and re-forms — but the core records the
+    fault as suspicion, not proof of death, so driver-less recovery
+    re-initializes the full world instead of shrinking out a live rank.
+    """
+
+    def __init__(self, message, fault_ranks=(), epoch=0, detect_ms=None,
+                 chunk=None):
+        super().__init__(message, fault_ranks=fault_ranks, epoch=epoch,
+                         detect_ms=detect_ms)
+        self.chunk = chunk
+
+
 class HostsUpdatedInterrupt(Exception):
     """Raised in elastic mode when the discovery script reports a host
     topology change; training re-rendezvouses without state rollback.

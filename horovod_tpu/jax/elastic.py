@@ -31,6 +31,8 @@ run = _elastic.run_fn
 init = _elastic.init
 reset = _elastic.reset
 ObjectState = _elastic.ObjectState
+survivors = _elastic.survivors
+rejoin = _elastic.rejoin
 
 
 def _to_host(tree):

@@ -461,6 +461,35 @@ def execute_plan(plan, local, name="reshard", eager_ops=None):
     return out
 
 
+def reshard_rows(local, rows_held, name="elastic.reshard",
+                 eager_ops=None):
+    """Re-balance a row-sharded array onto the even layout of the
+    CURRENT world — the elastic state-flow primitive (docs/elastic.md).
+
+    After a shrink or grow re-formation, each member passes the row
+    count every NEW rank currently holds (``rows_held``, rank-ordered;
+    a fresh joiner holds 0) and its own block ``local`` (a joiner: an
+    empty ``(0, ...)`` array with the right trailing shape and dtype).
+    Returns this rank's block under the fresh even partition, moved by
+    the minimal planner sequence (a single alltoallv for
+    sharded->sharded). Collective: every rank must call with identical
+    ``rows_held`` and ``name`` — derive ``rows_held`` from synced state
+    (e.g. the pre-fault even layout mapped through the survivor list),
+    never from per-rank observation.
+    """
+    counts = [int(c) for c in rows_held]
+    rows, pos = [], 0
+    for c in counts:
+        rows.append((pos, c))
+        pos += c
+    local = np.ascontiguousarray(local)
+    src = Layout.from_rows(rows)
+    dst = Layout.sharded(pos, len(counts))
+    plan = plan_redistribute((pos,) + tuple(local.shape[1:]),
+                             local.dtype, src, dst)
+    return execute_plan(plan, local, name=name, eager_ops=eager_ops)
+
+
 # ---- jax surface -----------------------------------------------------
 
 def _spec_tuple(sharding):

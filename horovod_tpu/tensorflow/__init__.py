@@ -19,6 +19,7 @@ from horovod_tpu.common.process_sets import (  # noqa: F401
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError,
     HorovodPeerFailureError,
+    HorovodWireCorruptionError,
     HostsUpdatedInterrupt,
 )
 from horovod_tpu.tensorflow.compression import Compression  # noqa: F401

@@ -18,6 +18,8 @@ from horovod_tpu.common.elastic import (  # noqa: F401
 run = _elastic.run_fn
 init = _elastic.init
 reset = _elastic.reset
+survivors = _elastic.survivors
+rejoin = _elastic.rejoin
 
 
 class TensorFlowState(State):

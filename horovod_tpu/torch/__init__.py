@@ -17,6 +17,7 @@ from horovod_tpu.common.process_sets import (  # noqa: F401
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError,
     HorovodPeerFailureError,
+    HorovodWireCorruptionError,
     HostsUpdatedInterrupt,
 )
 from horovod_tpu.torch.compression import Compression  # noqa: F401

@@ -1,0 +1,717 @@
+"""The expanded chaos matrix (docs/elastic.md): self-healing under the
+HOROVOD_FAULT_INJECT grammar — kill | stop:<ms> | reset | flip:<bit> |
+delay:<ms>.
+
+Pins the three acceptance behaviors of the self-healing elastic round:
+
+1. A transient stall (SIGSTOP < retry budget, then SIGCONT) heals IN
+   PLACE: the collective completes at the same epoch, ``faults_detected``
+   stays 0, and the ``heals`` counter moves.
+2. SIGKILL followed by a host rejoin regrows the world N-1 -> N at a
+   bumped epoch through the blacklist-parole door, and the training
+   trajectory matches an uninterrupted N-rank run from the last commit.
+3. An injected bit-flip on a CRC-framed chunk (including the bf16
+   cross-plane hop) is detected, NAK-healed by resend, and NEVER
+   silently reduced into the result; a persistently corrupting link
+   escalates to a typed ``HorovodWireCorruptionError`` naming
+   rank + chunk.
+
+Plus the satellite lanes: a kill mid-``redistribute`` (alltoallv plan
+step) surfaces typed errors on every survivor within the wire deadline,
+and ``hvd.elastic.survivors()`` is rank-consistent.
+
+Workers live in this importable module (never ``python -c`` strings —
+spawn must re-import them; the r11 gotcha).
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tests.utils_mp import REPO_ROOT, free_port
+
+pytestmark = pytest.mark.quick
+
+_COUNT = 2048 + 19  # ragged on purpose
+_TIMEOUT_MS = 600   # tight wire deadline so chaos tests stay fast
+
+
+def _rank_input(rank, count):
+    e = np.arange(count, dtype=np.float64)
+    v = (((rank + 1) * 1315423911 + (e + 1) * 2654435761) % 2001) / 500 - 2
+    return v.astype(np.float32)
+
+
+def _ring_reference(inputs):
+    """Bit-exact ring-order allreduce(SUM) replay (see
+    tests/parallel/test_ring_wire.py)."""
+    n = len(inputs)
+    count = inputs[0].size
+    q, r = divmod(count, n)
+    seg = [q + (1 if i < r else 0) for i in range(n)]
+    out = np.empty_like(inputs[0])
+    off = 0
+    for j in range(n):
+        sl = slice(off, off + seg[j])
+        acc = inputs[j][sl].copy()
+        for t in range(1, n):
+            acc = inputs[(j + t) % n][sl] + acc
+        out[sl] = acc
+        off += seg[j]
+    return out
+
+
+def _entry(fn, rank, size, port, q, env):
+    os.environ.update({
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(rank),
+        "HOROVOD_LOCAL_SIZE": str(size),
+        "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+        "HOROVOD_CONTROLLER_PORT": str(port),
+        "JAX_PLATFORMS": "cpu",
+    })
+    os.environ.update(env or {})
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        q.put((rank, None, fn(rank, size)))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        q.put((rank, f"{type(e).__name__}: {e}", None))
+
+
+def run_chaos(fn, size, victims=(), timeout=120, env=None,
+              expect_sigkill=True, extra=()):
+    """Spawn `size` ring workers plus optional `extra` (fn, env) side
+    processes (e.g. a parole joiner, reported as rank size+i), collect
+    results from everyone not in `victims`, then reap victims
+    (SIGCONT+SIGKILL covers SIGSTOPped ones)."""
+    ctx = mp.get_context("spawn")
+    port = free_port()
+    q = ctx.Queue()
+    victims = set(victims)
+    procs = {
+        r: ctx.Process(target=_entry, args=(fn, r, size, port, q, env))
+        for r in range(size)
+    }
+    for i, (xfn, xenv) in enumerate(extra):
+        merged = dict(env or {})
+        merged.update(xenv or {})
+        procs[size + i] = ctx.Process(
+            target=_entry, args=(xfn, size + i, size, port, q, merged))
+    for p in procs.values():
+        p.start()
+    results, errors = {}, {}
+    want = len(procs) - len(victims)
+    deadline = time.monotonic() + timeout
+    try:
+        while len(results) + len(errors) < want:
+            remaining = deadline - time.monotonic()
+            assert remaining > 0, (
+                f"workers hung: got {sorted(results)} of {want}")
+            try:
+                rank, err, res = q.get(timeout=min(remaining, 5.0))
+            except Exception:  # noqa: BLE001 — queue.Empty
+                continue
+            if err is not None:
+                errors[rank] = err
+            else:
+                results[rank] = res
+    finally:
+        for r, p in procs.items():
+            if r in victims and p.is_alive():
+                os.kill(p.pid, signal.SIGCONT)
+                p.kill()
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+    assert not errors, f"worker failures: {errors}"
+    if expect_sigkill:
+        for v in victims:
+            assert procs[v].exitcode == -signal.SIGKILL, (
+                v, procs[v].exitcode)
+    return results
+
+
+# ---- (1) transient stall heals in place: same epoch, zero faults -----
+
+_STOP_MS = 1800
+_STOP_AT_OP = 2
+_HEAL_OPS = 4
+
+
+def _stall_heal_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.wire_retry_attempts() == 6
+    if rank == 1:
+        # SIGSTOP mid-collective, SIGCONT by the forked waker: the GC-
+        # pause / spot-throttle shape. Shorter than the healing budget.
+        b.set_fault_inject_spec(f"1:{_STOP_AT_OP}:stop:{_STOP_MS}")
+    inputs = [_rank_input(r, _COUNT) for r in range(size)]
+    ref = _ring_reference(inputs)
+    for i in range(_HEAL_OPS):
+        out = ops.allreduce_async(inputs[rank], f"op.{i}").synchronize()
+        assert np.array_equal(out.view(np.uint32), ref.view(np.uint32)), i
+    el = b.metrics_snapshot()["elastic"]
+    # Healed in place: no fault, no epoch bump, no shrink.
+    assert b.epoch() == 0
+    assert el["faults_detected"] == 0, el
+    assert b.lib.hvdtpu_loop_failed() == 0
+    b.shutdown()
+    return {"heals": el["heals"], "retries": el["retries"]}
+
+
+def test_sigstop_within_retry_budget_heals_in_place():
+    results = run_chaos(
+        _stall_heal_worker, 2, victims=set(), expect_sigkill=False,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": str(_TIMEOUT_MS),
+             "HOROVOD_WIRE_RETRY_ATTEMPTS": "6",
+             "HOROVOD_WIRE_RETRY_BACKOFF_MS": "300"})
+    assert set(results) == {0, 1}
+    # The non-stopped rank rode out the stall on the healing ladder.
+    assert results[0]["heals"] >= 1, results
+    assert results[0]["retries"] >= 1, results
+
+
+# ---- (1b) the same stall WITHOUT the ladder still faults (r12) -------
+
+
+def _stall_fault_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common.exceptions import HorovodPeerFailureError
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.wire_retry_attempts() == 0
+    if rank == 1:
+        b.set_fault_inject_spec(f"1:1:stop:{_STOP_MS}")
+    x = np.ones(256, np.float32)
+    ops.allreduce_async(x, "w0").synchronize()
+    try:
+        ops.allreduce_async(x, "boom").synchronize()
+        return "did-not-fail"
+    except HorovodPeerFailureError as e:
+        assert 1 in e.fault_ranks, e.fault_ranks
+    b.shutdown()
+    return "ok"
+
+
+def test_sigstop_without_retry_budget_still_faults():
+    results = run_chaos(
+        _stall_fault_worker, 2, victims={1}, expect_sigkill=False,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": str(_TIMEOUT_MS),
+             "HOROVOD_WIRE_RETRY_ATTEMPTS": "0"})
+    assert results == {0: "ok"}
+
+
+# ---- (3) bit-flip: CRC detects, NAK-resend heals, result exact -------
+
+_FLIP_AT_OP = 1
+
+
+def _flip_heal_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.wire_crc()
+    if rank == 1:
+        b.set_fault_inject_spec(f"1:{_FLIP_AT_OP}:flip:77")
+    inputs = [_rank_input(r, _COUNT) for r in range(size)]
+    ref = _ring_reference(inputs)
+    for i in range(3):
+        out = ops.allreduce_async(inputs[rank], f"op.{i}").synchronize()
+        # The flipped chunk was caught and resent: NEVER silently
+        # reduced into the result (bit-exact against the ring replay).
+        assert np.array_equal(out.view(np.uint32), ref.view(np.uint32)), i
+    el = b.metrics_snapshot()["elastic"]
+    assert b.epoch() == 0
+    assert el["faults_detected"] == 0, el
+    b.shutdown()
+    return {"crc_errors": el["crc_errors"], "heals": el["heals"]}
+
+
+def test_bitflip_detected_and_healed_by_resend():
+    results = run_chaos(
+        _flip_heal_worker, 2, victims=set(), expect_sigkill=False,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": "5000",
+             "HOROVOD_WIRE_CRC": "1",
+             "HOROVOD_WIRE_RETRY_ATTEMPTS": "2"})
+    total_errors = sum(r["crc_errors"] for r in results.values())
+    total_heals = sum(r["heals"] for r in results.values())
+    assert total_errors >= 1, results
+    assert total_heals >= 1, results
+
+
+_HIER_SIZE = 4
+_HIER_LOCAL = 2
+
+
+def _flip_hier_worker(rank, size):
+    os.environ.update({
+        "HOROVOD_LOCAL_RANK": str(rank % _HIER_LOCAL),
+        "HOROVOD_LOCAL_SIZE": str(_HIER_LOCAL),
+        "HOROVOD_CROSS_RANK": str(rank // _HIER_LOCAL),
+        "HOROVOD_CROSS_SIZE": str(size // _HIER_LOCAL),
+    })
+    from horovod_tpu.common import basics, eager_ops as ops
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.hier_split() == _HIER_LOCAL and b.cross_compression()
+    if rank == 1:
+        # flip:<bit>:<skip>: let the intra-slice reduce-scatter frame
+        # pass, corrupt the NEXT data frame rank 1 sends — the
+        # bf16-compressed INTER-SLICE chunk of the hierarchical
+        # decomposition (the acceptance target: CRC covers the
+        # cross-plane bf16 hop like any other).
+        b.set_fault_inject_spec("1:1:flip:5:1")
+    vals = (np.arange(_COUNT, dtype=np.float32) % 7) - 3  # exact ints
+    ops.allreduce_async(vals * (rank + 1), "warm").synchronize()
+    out = ops.allreduce_async(vals * (rank + 1), "boom").synchronize()
+    np.testing.assert_array_equal(out, vals * sum(range(1, size + 1)))
+    el = b.metrics_snapshot()["elastic"]
+    assert el["faults_detected"] == 0, el
+    b.shutdown()
+    return {"crc_errors": el["crc_errors"], "heals": el["heals"]}
+
+
+def test_bitflip_on_bf16_cross_plane_chunk_healed():
+    results = run_chaos(
+        _flip_hier_worker, _HIER_SIZE, victims=set(), expect_sigkill=False,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": "5000",
+             "HOROVOD_WIRE_CRC": "1",
+             "HOROVOD_WIRE_RETRY_ATTEMPTS": "2",
+             "HOROVOD_CROSS_PLANE": "hier",
+             "HOROVOD_CROSS_PLANE_COMPRESSION": "1"})
+    assert sum(r["crc_errors"] for r in results.values()) >= 1, results
+    assert sum(r["heals"] for r in results.values()) >= 1, results
+
+
+def _flip_escalation_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.exceptions import (
+        HorovodInternalError,
+        HorovodWireCorruptionError,
+    )
+
+    b = basics.HorovodBasics()
+    b.init()
+    if rank == 1:
+        # Persistent flip: every resend is corrupted too, so the
+        # receiver must exhaust the NAK budget and escalate.
+        b.set_fault_inject_spec("1:1:flip:-9")
+    x = _rank_input(rank, _COUNT)
+    ops.allreduce_async(x, "warm").synchronize()
+    try:
+        ops.allreduce_async(x, "boom").synchronize()
+        return "did-not-fail"
+    except HorovodWireCorruptionError as e:
+        # Typed, naming rank + chunk; only reachable on the receiver.
+        assert rank == 0, "only the downstream neighbor verifies"
+        assert 1 in e.fault_ranks, e.fault_ranks
+        assert e.chunk is not None and e.chunk >= 0, e.chunk
+        assert "CRC32C" in str(e), str(e)
+        fault = b.last_fault()
+        assert fault["kind"] == "corruption", fault
+        assert fault["certain"] is False, fault
+        # A corrupting link names a LIVE peer: driver-less shrink must
+        # refuse to evict it.
+        assert hvd_elastic.survivors() is None
+    except HorovodInternalError:
+        # The corrupting sender's own transfer dies on the receiver's
+        # abort (timeout or EOF) — typed, but not as corruption.
+        assert rank == 1
+    el = b.metrics_snapshot()["elastic"]
+    assert el["crc_errors"] >= 1 or rank == 1, el
+    b.shutdown()
+    return "ok"
+
+
+def test_persistent_corruption_escalates_typed_wire_corruption():
+    results = run_chaos(
+        _flip_escalation_worker, 2, victims=set(), expect_sigkill=False,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": str(_TIMEOUT_MS),
+             "HOROVOD_WIRE_CRC": "1",
+             "HOROVOD_WIRE_RETRY_ATTEMPTS": "1"})
+    assert results == {0: "ok", 1: "ok"}
+
+
+# ---- (2) SIGKILL + parole rejoin: N-1 -> N regrow, pinned trajectory -
+
+_TRAIN_STEPS = 8
+_TRAIN_FAIL_STEP = 5
+_TRAIN_DIM = 193
+_TRAIN_LR = 0.1
+# state.sync() costs 2 broadcasts (ops 0-1); step s's allreduce is op
+# 2 + s, so the victim dies at the top of step _TRAIN_FAIL_STEP.
+_TRAIN_KILL_OP = 2 + _TRAIN_FAIL_STEP
+_REJOIN_SIZE = 3
+_REJOIN_VICTIM = 2
+
+
+def _grad(step, rank):
+    return np.full(_TRAIN_DIM, 0.01 * (step + 1) * (rank + 1), np.float32)
+
+
+def _train_reference(worlds_by_step):
+    """Expected trajectory given the (1-based rank multipliers of the)
+    world each step ran in."""
+    p = np.zeros(_TRAIN_DIM, np.float64)
+    for s in range(_TRAIN_STEPS):
+        world = worlds_by_step(s)
+        mean = 0.01 * (s + 1) * sum(world) / len(world)
+        p = p - _TRAIN_LR * mean
+    return p
+
+
+def _train_reference_uninterrupted(size):
+    """An uninterrupted `size`-rank run: the acceptance pin — the healed
+    world (kill -> shrink+regrow through the parole door in ONE epoch
+    transition) must land on exactly this trajectory."""
+    return _train_reference(lambda s: tuple(range(1, size + 1)))
+
+
+def _rejoin_train(state, b, ops, epochs_seen):
+    from horovod_tpu.common import elastic as hvd_elastic
+
+    @hvd_elastic.run_fn
+    def train(state):
+        epochs_seen.append(b.epoch())
+        while state.step < _TRAIN_STEPS:
+            g = _grad(state.step, b.rank())
+            mean = ops.allreduce_async(
+                g, f"grad.{state.step}.{b.epoch()}",
+                op=ops.ReduceOp.AVERAGE).synchronize()
+            state.params = state.params - _TRAIN_LR * mean
+            state.step += 1
+            state.commit()
+        return state.params
+
+    return train(state)
+
+
+def _rejoin_survivor_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.elastic import ObjectState
+
+    b = basics.HorovodBasics()
+    hvd_elastic.init()
+    if rank == 0:
+        # Gate training on the joiner being paroled at the door, so the
+        # kill's epoch transition deterministically absorbs it (rank 0
+        # gates everyone: collectives can't proceed without it).
+        deadline = time.monotonic() + 60
+        door = hvd_elastic._ensure_door()
+        while door.pending_count() == 0:
+            assert time.monotonic() < deadline, "joiner never knocked"
+            time.sleep(0.05)
+    state = ObjectState(step=0,
+                        params=np.zeros(_TRAIN_DIM, np.float32))
+    epochs_seen = []
+    params = _rejoin_train(state, b, ops, epochs_seen)
+    # One transition: epoch 0 (3 ranks) -> epoch 1 (2 survivors + 1
+    # paroled joiner = 3 ranks again).
+    assert epochs_seen == [0, 1], epochs_seen
+    assert (b.epoch(), b.size()) == (1, _REJOIN_SIZE)
+    np.testing.assert_allclose(
+        params, _train_reference_uninterrupted(_REJOIN_SIZE),
+        rtol=1e-5, atol=1e-7)
+    el = b.metrics_snapshot()["elastic"]
+    assert el["ranks_blacklisted"] == 1, el
+    assert el["ranks_rejoined"] == 1, el
+    assert el["faults_recovered"] == 1, el
+    b.shutdown()
+    return "ok"
+
+
+def _join_and_train(expected_size, reference):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.elastic import ObjectState
+
+    # A FRESH process: no old rank, no state. Knock on the parole door
+    # (retrying while the survivors' rank 0 finishes its own init) and
+    # block until an epoch transition absorbs us.
+    b = basics.HorovodBasics()
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            asg = hvd_elastic.rejoin(timeout=120)
+            break
+        except (OSError, ConnectionError):
+            assert time.monotonic() < deadline, "door never opened"
+            time.sleep(0.2)
+    assert asg["rank"] == expected_size - 1 and asg["size"] == expected_size
+    assert b.epoch() == asg["epoch"] == 1
+    state = ObjectState(step=0,
+                        params=np.zeros(_TRAIN_DIM, np.float32))
+    epochs_seen = []
+    params = _rejoin_train(state, b, ops, epochs_seen)
+    # First sync() pulled the survivors' last commit; the joiner's own
+    # trajectory from there matches the same pin as theirs.
+    assert epochs_seen == [1], epochs_seen
+    np.testing.assert_allclose(params, reference, rtol=1e-5, atol=1e-7)
+    b.shutdown()
+    return "ok"
+
+
+def _rejoin_joiner_worker(rank, size):
+    return _join_and_train(
+        _REJOIN_SIZE, _train_reference_uninterrupted(_REJOIN_SIZE))
+
+
+def test_sigkill_then_parole_rejoin_regrows_and_pins_trajectory():
+    rejoin_port = free_port()
+    results = run_chaos(
+        _rejoin_survivor_worker, _REJOIN_SIZE, victims={_REJOIN_VICTIM},
+        timeout=180,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": "2000",
+             "HOROVOD_REJOIN_PORT": str(rejoin_port),
+             # Joiners are absorbed at the FAULT transition only, so the
+             # kill's op index (and the trajectory) stay deterministic.
+             "HOROVOD_REJOIN_POLL": "0",
+             "HOROVOD_FAULT_INJECT":
+                 f"{_REJOIN_VICTIM}:{_TRAIN_KILL_OP}:kill"},
+        extra=[(_rejoin_joiner_worker,
+                {"HOROVOD_FAULT_INJECT": "",
+                 "HOROVOD_WORKER_ID": "parolee:1"})])
+    assert results == {0: "ok", 1: "ok", _REJOIN_SIZE: "ok"}
+
+
+# ---- (2b) healthy scale-up: a commit absorbs the joiner, no fault ----
+
+_GROW_SIZE = 2  # before the joiner; grows to 3
+
+
+def _grow_reference():
+    # Step 0 runs at 2 ranks; the first commit absorbs the joiner and
+    # every later step runs at 3.
+    return _train_reference(
+        lambda s: (1, 2) if s == 0 else (1, 2, 3))
+
+
+def _grow_survivor_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.elastic import ObjectState
+
+    b = basics.HorovodBasics()
+    hvd_elastic.init()
+    if rank == 0:
+        deadline = time.monotonic() + 60
+        door = hvd_elastic._ensure_door()
+        while door.pending_count() == 0:
+            assert time.monotonic() < deadline, "joiner never knocked"
+            time.sleep(0.05)
+    state = ObjectState(step=0,
+                        params=np.zeros(_TRAIN_DIM, np.float32))
+    epochs_seen = []
+    params = _rejoin_train(state, b, ops, epochs_seen)
+    assert epochs_seen == [0, 1], epochs_seen
+    assert (b.epoch(), b.size()) == (1, _GROW_SIZE + 1)
+    np.testing.assert_allclose(params, _grow_reference(), rtol=1e-5,
+                               atol=1e-7)
+    el = b.metrics_snapshot()["elastic"]
+    # Pure parole: grown, nothing blacklisted, zero faults.
+    assert el["ranks_rejoined"] == 1, el
+    assert el["ranks_blacklisted"] == 0, el
+    assert el["faults_detected"] == 0, el
+    b.shutdown()
+    return "ok"
+
+
+def _grow_joiner_worker(rank, size):
+    return _join_and_train(_GROW_SIZE + 1, _grow_reference())
+
+
+def test_healthy_commit_absorbs_parole_joiner_scale_up():
+    rejoin_port = free_port()
+    results = run_chaos(
+        _grow_survivor_worker, _GROW_SIZE, victims=set(),
+        expect_sigkill=False, timeout=180,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": "5000",
+             "HOROVOD_REJOIN_PORT": str(rejoin_port)},
+        extra=[(_grow_joiner_worker,
+                {"HOROVOD_WORKER_ID": "parolee:2"})])
+    assert results == {0: "ok", 1: "ok", _GROW_SIZE: "ok"}
+
+
+# ---- satellite: kill mid-redistribute (alltoallv plan step) ----------
+
+_RESHARD_SIZE = 4
+_RESHARD_VICTIM = 3
+_RESHARD_ROWS = 64
+
+
+def _reshard_kill_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common.exceptions import HorovodPeerFailureError
+    from horovod_tpu.parallel import reshard
+
+    b = basics.HorovodBasics()
+    b.init()
+    ops.allreduce_async(np.ones(8, np.float32), "warm").synchronize()
+    # Sharded -> sharded with shifted boundaries: a pure alltoallv plan.
+    src = reshard.Layout.from_rows(
+        [(0, 10), (10, 30), (40, 20), (60, 4)])
+    dst = reshard.Layout.sharded(_RESHARD_ROWS, size)
+    plan = reshard.plan_redistribute((_RESHARD_ROWS, 5), np.float32,
+                                     src, dst)
+    assert [s.op for s in plan.steps] == ["alltoallv"], plan.steps
+    s0, n0 = src.range_of(rank)
+    full = np.arange(_RESHARD_ROWS * 5, dtype=np.float32).reshape(-1, 5)
+    local = full[s0:s0 + n0]
+    if rank == _RESHARD_VICTIM:
+        b.set_fault_inject(rank, 1)  # die at the alltoallv itself
+    t0 = time.monotonic()
+    try:
+        out = reshard.execute_plan(plan, local, name="chaos.reshard")
+        return "reshard-did-not-fail"
+    except HorovodPeerFailureError as e:
+        # Every survivor: typed, within the deadline + slack, never a
+        # hang (the planner's multi-step sequences ride the same
+        # recoverable wire as any collective).
+        elapsed = time.monotonic() - t0
+        assert _RESHARD_VICTIM in e.fault_ranks, (e.fault_ranks, str(e))
+        assert elapsed < 2.0 + 8.0, elapsed
+    b.shutdown()
+    return "ok"
+
+
+def test_kill_mid_redistribute_raises_typed_on_every_survivor():
+    results = run_chaos(
+        _reshard_kill_worker, _RESHARD_SIZE, victims={_RESHARD_VICTIM},
+        env={"HOROVOD_WIRE_TIMEOUT_MS": "2000"})
+    assert results == {r: "ok" for r in range(_RESHARD_SIZE - 1)}
+
+
+# ---- satellite: reshard_rows rebalances after a world change ---------
+
+
+def _reshard_rows_worker(rank, size):
+    from horovod_tpu.common import basics
+    from horovod_tpu.parallel import reshard
+
+    b = basics.HorovodBasics()
+    b.init()
+    # Simulated post-regrow state: ranks 0..size-2 hold the old even
+    # shards, the "joiner" (last rank) holds nothing.
+    n_rows = 31
+    old = reshard.Layout.sharded(n_rows, size - 1)
+    rows_held = [old.range_of(r)[1] for r in range(size - 1)] + [0]
+    full = np.arange(n_rows * 3, dtype=np.float32).reshape(-1, 3)
+    if rank < size - 1:
+        s0, n0 = old.range_of(rank)
+        local = full[s0:s0 + n0]
+    else:
+        local = np.zeros((0, 3), np.float32)
+    out = reshard.reshard_rows(local, rows_held)
+    s1, n1 = reshard.Layout.sharded(n_rows, size).range_of(rank)
+    np.testing.assert_array_equal(out, full[s1:s1 + n1])
+    b.shutdown()
+    return "ok"
+
+
+def test_reshard_rows_flows_state_onto_regrown_world():
+    results = run_chaos(_reshard_rows_worker, 3, victims=set(),
+                        expect_sigkill=False,
+                        env={"HOROVOD_WIRE_TIMEOUT_MS": "5000"})
+    assert results == {0: "ok", 1: "ok", 2: "ok"}
+
+
+# ---- satellite: survivors() is rank-consistent -----------------------
+
+
+def _survivors_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert hvd_elastic.survivors() is None  # no fault yet
+    x = np.ones(64, np.float32)
+    ops.allreduce_async(x, "w0").synchronize()
+    try:
+        ops.allreduce_async(x, "boom").synchronize()
+        return "did-not-fail"
+    except HorovodInternalError:
+        pass
+    alive = hvd_elastic.survivors()
+    # Keep our sockets OPEN until every survivor has recorded its own
+    # fault (the r12 ordering rule reinit itself follows): shutting
+    # down now would feed late-detecting survivors an EOF from a live
+    # rank and skew THEIR dead set. Non-neighbors pay one wire
+    # deadline, so one deadline + slack covers the slowest detector.
+    time.sleep(_TIMEOUT_MS / 1000.0 + 3.0)
+    b.shutdown()
+    return alive
+
+
+def test_survivors_identical_on_every_rank():
+    results = run_chaos(
+        _survivors_worker, 4, victims={1},
+        env={"HOROVOD_WIRE_TIMEOUT_MS": str(_TIMEOUT_MS),
+             "HOROVOD_FAULT_INJECT": "1:1:kill"})
+    assert set(results) == {0, 2, 3}
+    lists = {tuple(v) for v in results.values()}
+    assert lists == {(0, 2, 3)}, results
+
+
+# ---- grammar + knob plumbing (no ring needed) ------------------------
+
+
+def test_fault_grammar_rejects_malformed_specs():
+    from horovod_tpu.common import basics
+
+    b = basics.HorovodBasics()
+    for bad in ("nonsense", "1", "1:2:explode", "1:2:stop",
+                "1:2:stop:-5", "1:2:kill:7", "1:2:flip",
+                "x:2:kill", "1:y", "1:2:delay:0", "1:2:stop:3:4",
+                "1:2:flip:5:x", "1:2:flip:-5:1", "1:2:flip:5:-1",
+                # bit must fit the packed low field even without skip
+                "1:2:flip:2000000"):
+        rc = b.lib.hvdtpu_set_fault_inject_spec(bad.encode())
+        assert rc == -2, (bad, rc)
+    # Well-formed specs parse (arming needs init; -1 = parsed but no
+    # state, never -2).
+    for good in ("0:3", "2:5:kill", "1:2:stop:250", "0:1:reset",
+                 "1:4:flip:17", "1:4:flip:-17", "1:4:flip:17:2",
+                 "3:9:delay:100"):
+        rc = b.lib.hvdtpu_set_fault_inject_spec(good.encode())
+        assert rc in (0, -1), (good, rc)
+
+
+def test_wire_heal_and_crc_knob_roundtrips():
+    from horovod_tpu.common import basics
+
+    b = basics.HorovodBasics()
+    saved = (b.wire_retry_attempts(), b.wire_retry_backoff_ms(),
+             b.wire_crc())
+    try:
+        b.set_wire_retry_attempts(7)
+        assert b.wire_retry_attempts() == 7
+        b.set_wire_retry_backoff_ms(123)
+        assert b.wire_retry_backoff_ms() == 123
+        b.set_wire_crc(True)
+        assert b.wire_crc() is True
+        b.set_wire_crc(False)
+        assert b.wire_crc() is False
+    finally:
+        b.set_wire_retry_attempts(saved[0])
+        b.set_wire_retry_backoff_ms(saved[1])
+        b.set_wire_crc(saved[2])

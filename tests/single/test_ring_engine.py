@@ -123,3 +123,29 @@ def test_knob_surface_roundtrip(b):
     finally:
         b.set_ring_chunk_bytes(old_chunk)
         b.set_wire_compression(old_comp)
+
+
+@pytest.mark.parametrize("ranks", [2, 4])
+def test_crc_framing_is_bit_identical(b, ranks):
+    """HOROVOD_WIRE_CRC reframes every duplex as typed CRC32C chunk
+    messages (docs/wire.md) — the engine's results must stay
+    BIT-identical to the unframed ring, including the size-2 case
+    where data and acks share one socket, and under the bf16 codec
+    (the compressed hops are CRC-framed like any other)."""
+    saved = b.wire_crc()
+    b.set_wire_crc(True)
+    try:
+        for count in (0, 1, ranks + 3, 1025, 5000):
+            rc, err = b.ring_selftest(ranks, count, dtype=F32, op=SUM,
+                                      chunk_bytes=1024)
+            assert rc == 0 and err == 0.0, (ranks, count, rc, err)
+        rc, err = b.ring_selftest(ranks, 4096, dtype=F32, op=SUM,
+                                  chunk_bytes=1024, compression=True)
+        assert rc == 0 and err <= _bound(ranks), (rc, err)
+        # Hierarchical decomposition under CRC: cross-plane hops framed
+        # too (2 slices x 2 ranks needs 4).
+        if ranks == 4:
+            rc, err = b.hier_selftest(4, 2, 2048, chunk_bytes=512)
+            assert rc == 0 and err == 0.0, (rc, err)
+    finally:
+        b.set_wire_crc(saved)

@@ -46,6 +46,45 @@ def test_snapshot_before_init_is_valid():
     json.loads(json.dumps(snap))
 
 
+def test_fully_populated_snapshot_roundtrips_untruncated(hvd_core):
+    """The Append buffer grows dynamically (it was a fixed 768-byte
+    stack buffer grown by hand every time a section gained rows —
+    truncation silently corrupted the JSON): a snapshot with EVERY
+    section populated must parse and keep its final key."""
+    from horovod_tpu.common import eager_ops as ops
+
+    # Populate every op class the single-rank ring can execute.
+    x = np.arange(64, dtype=np.float32)
+    ops.allreduce_async(x, "full.ar").synchronize()
+    ops.allgather_async(x, "full.ag").synchronize()
+    ops.broadcast_async(x, 0, "full.bc").synchronize()
+    snap = hvd_core.metrics_snapshot()
+    # Every section present...
+    for key in ("ops", "device_ops", "negotiation_us", "queue_us",
+                "wire_us", "fusion", "cycle", "cache", "straggler",
+                "wire", "elastic", "errors", "knobs"):
+        assert key in snap, key
+    # ...including the self-healing rows and the new knob columns.
+    el = snap["elastic"]
+    for key in ("heals", "retries", "crc_errors", "ranks_rejoined",
+                "ranks_blacklisted", "detect_us"):
+        assert key in el, key
+    for key in ("wire_retry_attempts", "wire_retry_backoff_ms",
+                "wire_crc", "wire_timeout_ms", "cross_plane"):
+        assert key in snap["knobs"], key
+    # Truncation would cut the TAIL: knobs is the last section, and the
+    # raw JSON must end exactly where the parser says it does.
+    raw_len = hvd_core.lib.hvdtpu_metrics_snapshot(None, 0)
+    import ctypes
+
+    buf = ctypes.create_string_buffer(int(raw_len) + 512)
+    hvd_core.lib.hvdtpu_metrics_snapshot(buf, int(raw_len) + 512)
+    raw = buf.value.decode()
+    assert raw.endswith("}"), raw[-40:]
+    assert json.loads(raw)["knobs"]["cross_plane"] in (
+        "auto", "ici", "ring", "hier")
+
+
 def test_counters_monotonic_and_exact_on_eager_path(hvd_core):
     """Counter monotonicity + exact byte accounting: every allreduce
     adds its payload to ops.allreduce.bytes and nothing ever goes
