@@ -23,7 +23,7 @@ ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
 .PHONY: core tf clean test test-quick test-flaky lint lint-csrc \
   core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
-  reshard-smoke chaos-smoke
+  reshard-smoke chaos-smoke obs-smoke
 
 core: $(OUT)
 
@@ -138,6 +138,15 @@ chaos-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/parallel/test_chaos_matrix.py \
 	  -q -p no:cacheprovider \
 	  -k "heals_in_place or bitflip_detected or parole_rejoin"
+
+# Observability smoke: 2 real ranks with the debug endpoint up; an
+# injected stop:<ms> stall escalates to a typed fault — /healthz must
+# answer on both ranks mid-run, every rank leaves a black-box event-
+# ring dump, and the merged post-mortem names the stalled rank without
+# declaring anyone dead (docs/metrics.md;
+# horovod_tpu/telemetry/obs_smoke.py; ~20 s).
+obs-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.obs_smoke
 
 # Cross-plane + redistribute smoke: 4 real ranks emulate 2 slices x 2
 # chips under HOROVOD_CROSS_PLANE=hier — hierarchical train-step parity

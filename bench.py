@@ -584,6 +584,83 @@ def _smoke_row():
     return run_spmd(cfg, 2, 128, 3, "llama_train_step_mfu", "cpu smoke")
 
 
+# Child body for one events_overhead rank: the ungrouped eager shape
+# (many small per-tensor allreduces per step, stable names riding the
+# response-cache bitvector) — the workload where per-response event
+# recording would hurt if it could. Pure host, no jax import.
+_EVENTS_BENCH_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, os.environ["HVDTPU_REPO"])
+from horovod_tpu.common import eager_ops as ops
+from horovod_tpu.common.basics import HorovodBasics
+
+cfg = json.loads(os.environ["EVENTS_BENCH_CFG"])
+b = HorovodBasics()
+b.init()
+rank = b.rank()
+tensors = [np.full(cfg["elems"], float(rank + 1 + i), np.float32)
+           for i in range(cfg["tensors"])]
+
+def step():
+    hs = [ops.allreduce_async(t, f"ug{i}")
+          for i, t in enumerate(tensors)]
+    for h in hs:
+        h.synchronize()
+
+for _ in range(2):  # warmup: reach response-cache steady state
+    step()
+t0 = time.perf_counter()
+for _ in range(cfg["steps"]):
+    step()
+dt = (time.perf_counter() - t0) / cfg["steps"]
+if rank == 0:
+    print("EVENTS_BENCH_POINT " + json.dumps(
+        {"step_s": dt, "events_head": int(b.lib.hvdtpu_events_head())}))
+b.shutdown()
+"""
+
+
+def _events_overhead_rows(ranks=2, tensors=183, elems=2048, steps=8,
+                          repeats=3):
+    """Event-ring overhead on the eager ungrouped lane: `tensors` small
+    per-parameter allreduces per step (the 183-allreduce r07 shape),
+    measured with the flight recorder on (default) vs off
+    (HOROVOD_EVENTS=0), best-of-`repeats` per config to shed loopback
+    noise. The acceptance bar is < 2% regression with events on —
+    recording is one fetch_add + a handful of relaxed stores on the
+    paths that fire per response/chunk (csrc/events.h)."""
+    cfg = json.dumps({"tensors": tensors, "elems": elems,
+                      "steps": steps})
+    best = {}
+    heads = {}
+    try:
+        for _ in range(repeats):
+            for name, knob in (("on", "1"), ("off", "0")):
+                point = _run_loopback_ranks(
+                    _EVENTS_BENCH_CHILD, "EVENTS_BENCH_POINT", ranks,
+                    {"HOROVOD_EVENTS": knob, "EVENTS_BENCH_CFG": cfg})
+                if name not in best or point["step_s"] < best[name]:
+                    best[name] = point["step_s"]
+                heads[name] = point["events_head"]
+    except Exception as e:  # noqa: BLE001 — an unusable loopback box
+        return [{"metric": "events_overhead",
+                 "error": f"{type(e).__name__}: {e}"}]
+    overhead = (best["on"] - best["off"]) / best["off"] * 100.0
+    return [{
+        "metric": "events_overhead",
+        "ranks": ranks, "tensors_per_step": tensors,
+        "elems_per_tensor": elems,
+        "step_s_events_on": round(best["on"], 6),
+        "step_s_events_off": round(best["off"], 6),
+        "overhead_pct": round(overhead, 3),
+        "events_recorded": heads["on"],
+        "criterion": "overhead_pct < 2 (ungrouped eager lane, "
+                     "best-of-%d)" % repeats,
+        "pass": overhead < 2.0,
+    }]
+
+
 # Child body for one ring_busbw rank: pure host — numpy + the native
 # core over TCP loopback, no jax import, so children are safe to run
 # before the flagship subprocess claims the virgin device heap.
@@ -1079,6 +1156,12 @@ def main():
         argv = [a for a in argv if a != "--lint"]
         if not argv:
             return
+    if "--events-overhead" in argv:
+        # Standalone event-ring overhead check (no accelerator needed):
+        # the ungrouped eager lane with the flight recorder on vs off.
+        for row in _events_overhead_rows():
+            emit(row)
+        return
     if "--ring-busbw" in argv:
         # Standalone host-ring transport sweep (no accelerator needed),
         # including the cross-plane hierarchical rows (dense/hier lane).
@@ -1138,6 +1221,8 @@ def main():
             emit(row)
         for row in _hier_busbw_rows():
             emit(row)
+        for row in _events_overhead_rows():
+            emit(row)
         emit(_smoke_row())
         return
 
@@ -1146,6 +1231,8 @@ def main():
     for row in _ring_busbw_rows():
         emit(row)
     for row in _hier_busbw_rows():
+        emit(row)
+    for row in _events_overhead_rows():
         emit(row)
 
     flagship_row, flagship_extras = _flagship_row()

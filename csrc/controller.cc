@@ -5,6 +5,7 @@
 #include <sstream>
 
 #include "logging.h"
+#include "events.h"
 #include "metrics.h"
 #include "wire.h"
 
@@ -779,12 +780,16 @@ void Controller::CheckForStalledTensors() {
         std::chrono::duration<double>(now - kv.second.first_seen).count();
     if (waited > cfg_.stall_warning_secs) {
       std::ostringstream missing;
+      int n_missing = 0;
       for (int32_t r :
            MembersOf(kv.second.requests.front().process_set_id)) {
         if (!kv.second.ranks_seen.count(r) && !joined_ranks_.count(r)) {
           missing << r << " ";
+          n_missing++;
         }
       }
+      GlobalEvents().Record(EventType::kStall, (int32_t)waited,
+                            n_missing);
       LOG_WARN(
           "Stall detected: tensor %s has waited %.0fs; missing ranks: %s"
           " (one or more ranks did not submit this collective)",
@@ -799,11 +804,18 @@ void Controller::CheckForStalledTensors() {
     if (waited > cfg_.stall_warning_secs && cache_.Has(kv.first)) {
       const Response& r = cache_.Get(kv.first);
       std::ostringstream missing;
+      int n_missing = 0;
       for (int32_t m : MembersOf(r.process_set_id)) {
         if (!kv.second.ranks.count(m) && !joined_ranks_.count(m)) {
           missing << m << " ";
+          n_missing++;
         }
       }
+      // Steady-state (cache-bit) stalls are the common production
+      // case — they must reach the flight recorder like full-request
+      // stalls do.
+      GlobalEvents().Record(EventType::kStall, (int32_t)waited,
+                            n_missing);
       LOG_WARN(
           "Stall detected: cached tensor %s has waited %.0fs; missing "
           "ranks: %s (one or more ranks did not submit this collective)",
@@ -935,6 +947,8 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     // Coordinator-relayed fault notice: fail fast with its attribution
     // instead of waiting out our own wire deadline against the broken
     // ring. The full set stays in out->fault_ranks for the caller.
+    GlobalEvents().Record(EventType::kFaultNotice,
+                          (int32_t)out->fault_ranks[0], 1);
     return Status::PeerFailure(
         (int)out->fault_ranks[0],
         "coordinator reported peer failure (rank " +
@@ -950,6 +964,7 @@ void Controller::BroadcastFaultNotice(const Status& failure) {
   // they stop within one control round instead of one wire timeout.
   // Send errors are ignored — the target may be the casualty itself.
   if (cfg_.rank != 0) return;
+  GlobalEvents().Record(EventType::kFaultNotice, failure.fault_rank(), 0);
   ResponseList notice;
   notice.epoch = cfg_.epoch;
   notice.fault_ranks.push_back(failure.fault_rank());

@@ -1,0 +1,205 @@
+#include "events.h"
+
+#include "metrics.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace hvdtpu {
+
+namespace {
+
+// ONE table: type name + the JSON key of each of the four args (empty =
+// arg unused for that type). Order must match EventType.
+struct EventSpec {
+  const char* name;
+  const char* a;
+  const char* b;
+  const char* c;
+  const char* d;
+};
+
+const EventSpec kEventSpecs[(int)EventType::kTypeCount] = {
+    {"negotiate_begin", "requests", "", "", ""},
+    {"negotiate_end", "responses", "shutdown", "", ""},
+    {"response_launch", "op_class", "device", "tensors", "bytes"},
+    {"wire_chunk", "plane", "crc", "offset", "len"},
+    {"wire_span", "plane", "dur_us", "tx_bytes", "rx_bytes"},
+    // NB: no event arg may be named "rank" — the post-mortem merge
+    // tags every timeline entry with its SOURCE rank under that key.
+    {"crc_error", "sender", "fails", "chunk", ""},
+    {"crc_resend", "", "", "chunk", ""},
+    {"retry_window", "attempt", "window_ms", "", ""},
+    {"wire_heal", "", "", "", ""},
+    {"fault", "kind", "certain", "epoch", "fault_rank"},
+    {"epoch", "", "", "epoch", "old_epoch"},
+    {"reinit_begin", "size", "", "epoch", ""},
+    {"reinit_end", "rc", "size", "epoch", ""},
+    {"rejoin", "slots", "", "epoch", ""},
+    {"knob_adopt", "knob", "", "value", ""},
+    {"inject", "action", "", "op_index", ""},
+    {"stall", "waited_s", "missing", "", ""},
+    {"fault_notice", "fault_rank", "received", "", ""},
+};
+
+const char* kKnobNames[] = {"fusion_bytes", "cycle_time_us", "ring_chunk",
+                            "wire_compression", "hier_split"};
+
+thread_local int t_event_plane = 0;
+
+}  // namespace
+
+const char* EventTypeName(EventType t) {
+  int i = (int)t;
+  if (i < 0 || i >= (int)EventType::kTypeCount) return "unknown";
+  return kEventSpecs[i].name;
+}
+
+void SetEventWirePlane(int plane) { t_event_plane = plane; }
+int EventWirePlane() { return t_event_plane; }
+
+bool EventRing::enabled() const {
+  int32_t en = enabled_.load(std::memory_order_relaxed);
+  if (en != -1) return en != 0;
+  // Not yet resolved (no Record ran): answer from the env directly so
+  // pre-init queries don't misreport HOROVOD_EVENTS=0 as enabled.
+  const char* v = std::getenv("HOROVOD_EVENTS");
+  return !(v != nullptr && std::strtoll(v, nullptr, 10) == 0);
+}
+
+void EventRing::Record(EventType t, int32_t a, int32_t b, int64_t c,
+                       int64_t d) {
+  int32_t en = enabled_.load(std::memory_order_relaxed);
+  if (en == -1) {
+    // Lazy env read, same pattern as the wire knobs: valid before init
+    // and from any thread (the race writes the same value twice).
+    const char* v = std::getenv("HOROVOD_EVENTS");
+    en = (v != nullptr && std::strtoll(v, nullptr, 10) == 0) ? 0 : 1;
+    enabled_.store(en, std::memory_order_relaxed);
+  }
+  if (en == 0) return;
+  int64_t seq = head_.fetch_add(1, std::memory_order_acq_rel);
+  Slot& s = slots_[seq % kCapacity];
+  // Invalidate first so a concurrent reader can never stitch this
+  // write's payload to the previous occupant's seq. The release fence
+  // keeps the payload stores below from becoming visible BEFORE the
+  // invalidation on weakly-ordered CPUs (a release store alone does
+  // not order later stores) — the Boehm seqlock writer protocol.
+  s.seq.store(-1, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  s.ts_us.store(MetricsNowUs(), std::memory_order_relaxed);
+  s.type.store((int32_t)t, std::memory_order_relaxed);
+  s.a.store(a, std::memory_order_relaxed);
+  s.b.store(b, std::memory_order_relaxed);
+  s.c.store(c, std::memory_order_relaxed);
+  s.d.store(d, std::memory_order_relaxed);
+  // Publish only if the slot still holds OUR invalidation: a writer
+  // descheduled long enough for the ring to lap a full kCapacity back
+  // onto its slot would otherwise claim the lapping writer's payload
+  // (or a mix) under its own stale seq — a torn record readers could
+  // validate. On CAS failure poison the slot instead: one event is
+  // dropped (forensically honest) rather than returned corrupt.
+  int64_t expect = -1;
+  if (!s.seq.compare_exchange_strong(expect, seq,
+                                     std::memory_order_release,
+                                     std::memory_order_relaxed)) {
+    s.seq.store(-1, std::memory_order_release);
+  }
+}
+
+bool EventRing::ReadSlot(int64_t seq, EventRecord* out) const {
+  const Slot& s = slots_[seq % kCapacity];
+  if (s.seq.load(std::memory_order_acquire) != seq) return false;
+  out->seq = seq;
+  out->ts_us = s.ts_us.load(std::memory_order_relaxed);
+  out->type = (EventType)s.type.load(std::memory_order_relaxed);
+  out->a = s.a.load(std::memory_order_relaxed);
+  out->b = s.b.load(std::memory_order_relaxed);
+  out->c = s.c.load(std::memory_order_relaxed);
+  out->d = s.d.load(std::memory_order_relaxed);
+  // Re-check: a writer may have lapped the ring mid-read. The acquire
+  // fence pins the relaxed payload loads above ordering-wise BEFORE
+  // this load — without it they may sink below the re-check and a torn
+  // slot could pass validation (Boehm seqlock reader protocol).
+  std::atomic_thread_fence(std::memory_order_acquire);
+  return s.seq.load(std::memory_order_relaxed) == seq;
+}
+
+int64_t EventRing::Snapshot(int64_t from_seq,
+                            std::vector<EventRecord>* out) const {
+  int64_t h = head();
+  int64_t lo = h > kCapacity ? h - kCapacity : 0;
+  if (from_seq < lo) from_seq = lo;
+  for (int64_t seq = from_seq; seq < h; seq++) {
+    EventRecord e;
+    if (ReadSlot(seq, &e)) out->push_back(e);
+  }
+  return h;
+}
+
+std::string EventJson(const EventRecord& e) {
+  int i = (int)e.type;
+  char buf[256];
+  if (i < 0 || i >= (int)EventType::kTypeCount) {
+    snprintf(buf, sizeof(buf),
+             "{\"seq\":%lld,\"ts_us\":%lld,\"type\":\"unknown\"}",
+             (long long)e.seq, (long long)e.ts_us);
+    return buf;
+  }
+  const EventSpec& spec = kEventSpecs[i];
+  std::string out;
+  snprintf(buf, sizeof(buf), "{\"seq\":%lld,\"ts_us\":%lld,\"type\":\"%s\"",
+           (long long)e.seq, (long long)e.ts_us, spec.name);
+  out = buf;
+  auto arg = [&](const char* key, long long v) {
+    if (key[0] == '\0') return;
+    snprintf(buf, sizeof(buf), ",\"%s\":%lld", key, v);
+    out += buf;
+  };
+  arg(spec.a, e.a);
+  arg(spec.b, e.b);
+  arg(spec.c, e.c);
+  arg(spec.d, e.d);
+  // Decode the knob id inline so consumers never need the enum.
+  if (e.type == EventType::kKnobAdopt && e.a >= 0 &&
+      e.a < (int32_t)(sizeof(kKnobNames) / sizeof(kKnobNames[0]))) {
+    out += ",\"knob_name\":\"";
+    out += kKnobNames[e.a];
+    out += "\"";
+  }
+  out += "}";
+  return out;
+}
+
+std::string EventRing::Json(int64_t from_seq, int64_t* next_seq,
+                            int64_t max_events) const {
+  std::vector<EventRecord> evs;
+  evs.reserve(256);
+  int64_t h = Snapshot(from_seq, &evs);
+  if (next_seq != nullptr) *next_seq = h;
+  size_t start = 0;
+  if (max_events > 0 && (int64_t)evs.size() > max_events) {
+    start = evs.size() - (size_t)max_events;  // newest wins
+  }
+  std::string out = "[";
+  for (size_t i = start; i < evs.size(); i++) {
+    if (i > start) out += ",";
+    out += EventJson(evs[i]);
+  }
+  out += "]";
+  return out;
+}
+
+void EventRing::Reset() {
+  // head_ keeps counting (cursors stay monotonic); slots are simply
+  // invalidated so old payloads stop being readable.
+  for (auto& s : slots_) s.seq.store(-1, std::memory_order_release);
+}
+
+EventRing& GlobalEvents() {
+  static EventRing* r = new EventRing();  // never destroyed: the wire
+  return *r;  // hot path may record during process teardown
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,149 @@
+// Always-on structured event ring for the native core: a fixed-size
+// per-process lock-free buffer of typed, timestamped events — the
+// black-box flight recorder behind post-mortem fault forensics
+// (docs/metrics.md "Event ring & black-box post-mortem").
+//
+// Reference analog: none in upstream Horovod — its timeline records
+// per-tensor spans to a file on ONE rank when the operator asked in
+// advance. The ring is the inverse trade: always recording, bounded
+// memory, no IO on the hot path, drained only when someone asks
+// (hvdtpu_events_drain) or when a fault makes the tail forensically
+// valuable (the black-box dump in operations.cc).
+//
+// Concurrency: Record() is WAIT-FREE (one fetch_add + fenced relaxed
+// stores + one CAS publish) — it runs on the wire hot path (per-chunk)
+// and on the background loop; readers (drain/peek, any API thread, the
+// debug server) are lock-free and never block a writer. Torn slots are
+// detected by a seq re-check and skipped; a writer that finds its slot
+// lapped while it was descheduled poisons it rather than claiming
+// mixed payload (the only residual tear window needs a full-kCapacity
+// lap during one preemption AND a reader racing the poison store).
+
+#ifndef HVDTPU_EVENTS_H
+#define HVDTPU_EVENTS_H
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Typed events, one per observable runtime transition. Argument
+// meanings per type live in kEventArgNames (events.cc) — the ONE table
+// the JSON serializer, docs/metrics.md, and telemetry/postmortem.py
+// field handling all follow.
+enum class EventType : int32_t {
+  kNegotiateBegin = 0,  // a=requests popped this cycle
+  kNegotiateEnd,        // a=responses, b=shutdown bit
+  kResponseLaunch,      // a=op_class, b=device plane, c=tensors, d=bytes
+  kWireChunk,           // a=plane, b=crc framed, c=offset, d=len (rx verified)
+  kWireSpan,            // a=plane, b=dur_us, c=tx_bytes, d=rx_bytes
+  kCrcError,            // a=sender, b=fails so far, c=chunk idx
+  kCrcResend,           // c=chunk idx (sender side: NAK received)
+  kRetryWindow,         // a=attempt, b=window_ms (healing ladder step)
+  kWireHeal,            // progress resumed after >=1 expired window
+  kFault,               // a=kind(0 peer,1 corruption), b=certain,
+                        // c=epoch, d=first fault rank (-1 none)
+  kEpoch,               // c=new epoch, d=old epoch
+  kReinitBegin,         // a=new size, c=target epoch
+  kReinitEnd,           // a=rc (0 ok), b=new size, c=epoch
+  kRejoin,              // a=joiner slots absorbed, c=epoch
+  kKnobAdopt,           // a=knob id (kKnob*), c=new value
+  kInject,              // a=chaos action, c=collective index
+  kStall,               // a=waited seconds, b=missing/blocking ranks
+  kFaultNotice,         // a=fault rank, b=0 broadcast / 1 received
+  kTypeCount
+};
+
+// Knob ids for kKnobAdopt (autotuner moves + worker lockstep adoption).
+enum EventKnob : int32_t {
+  kKnobFusionBytes = 0,
+  kKnobCycleTimeMs,   // value in microseconds (integer event args)
+  kKnobRingChunk,
+  kKnobCompression,
+  kKnobHierSplit,
+};
+
+const char* EventTypeName(EventType t);
+
+struct EventRecord {
+  int64_t seq = 0;
+  int64_t ts_us = 0;  // steady clock (MetricsNowUs) — wall-aligned by
+                      // the black-box header / CLOCK_SYNC anchors
+  EventType type = EventType::kTypeCount;
+  int32_t a = 0, b = 0;
+  int64_t c = 0, d = 0;
+};
+
+class EventRing {
+ public:
+  // ~8k events x 56 B = bounded, covers minutes of steady-state
+  // traffic and the full causal window of any fault sequence.
+  static constexpr int64_t kCapacity = 8192;
+
+  // Wait-free; drops silently when disabled (HOROVOD_EVENTS=0).
+  void Record(EventType t, int32_t a = 0, int32_t b = 0, int64_t c = 0,
+              int64_t d = 0);
+
+  // Resolves the HOROVOD_EVENTS env lazily like Record does, so it
+  // answers correctly before the first record (and before init).
+  bool enabled() const;
+  void set_enabled(bool on) {
+    enabled_.store(on ? 1 : 0, std::memory_order_relaxed);
+  }
+
+  // Next sequence number to be written (== total events recorded).
+  int64_t head() const { return head_.load(std::memory_order_acquire); }
+
+  // Copy every intact event with seq >= from_seq (clamped to the live
+  // window) into `out`, oldest first; returns the next cursor (head at
+  // read time). Slots overwritten or mid-write during the scan are
+  // skipped — a snapshot is forensically consistent, not linearizable.
+  int64_t Snapshot(int64_t from_seq, std::vector<EventRecord>* out) const;
+
+  // JSON array of events from `from_seq`, capped to the newest
+  // `max_events` (<= 0 = everything live). Writes the next cursor to
+  // *next_seq when non-null. One line per event is the JSONL the
+  // black-box dump writes; here they are comma-joined into an array.
+  std::string Json(int64_t from_seq, int64_t* next_seq,
+                   int64_t max_events = 0) const;
+
+  void Reset();  // test isolation only (concurrent writers tolerated)
+
+ private:
+  struct Slot {
+    // seq == -1 while a writer is mid-update; readers re-check seq
+    // after reading the payload and discard on mismatch.
+    std::atomic<int64_t> seq{-1};
+    std::atomic<int64_t> ts_us{0};
+    std::atomic<int32_t> type{0};
+    std::atomic<int32_t> a{0}, b{0};
+    std::atomic<int64_t> c{0}, d{0};
+  };
+  std::atomic<int64_t> head_{0};
+  std::atomic<int32_t> enabled_{-1};  // -1 = read HOROVOD_EVENTS lazily
+  Slot slots_[kCapacity];
+
+  bool ReadSlot(int64_t seq, EventRecord* out) const;
+};
+
+// Process-wide ring; like the metrics registry it outlives
+// init/shutdown so a post-mortem can still read a dying process.
+EventRing& GlobalEvents();
+
+// Serialize one event as a JSON object with per-type named args —
+// shared by the ring serializer and the black-box JSONL dump.
+std::string EventJson(const EventRecord& e);
+
+// Wire-plane tag for events recorded inside wire.cc, which has no
+// DataPlane context: the ring engine (ring_ops.cc) sets it around its
+// transport calls. thread_local on purpose — all of a plane's
+// transport calls run on one thread (wire.h threading contract), and
+// the in-process selftests drive several planes from distinct threads.
+void SetEventWirePlane(int plane);
+int EventWirePlane();
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_EVENTS_H

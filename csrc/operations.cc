@@ -6,6 +6,7 @@
 #include "operations.h"
 
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/wait.h>
 #include <time.h>
 #include <unistd.h>
@@ -22,6 +23,7 @@
 #include <unordered_map>
 
 #include "controller.h"
+#include "events.h"
 #include "logging.h"
 #include "message.h"
 #include "metrics.h"
@@ -829,6 +831,69 @@ void AccountResponse(const Response& response,
   if (!status.ok()) m.errors.fetch_add(1, std::memory_order_relaxed);
 }
 
+// Black-box post-mortem dump (docs/metrics.md): append the live tail
+// of the event ring to a per-rank JSONL file the moment a typed fault
+// is recorded — BEFORE any handle wakes an API thread, so the causal
+// window survives even if the process is about to be torn down by an
+// unhandled exception. One header line carries the fault record plus a
+// (unix_us, steady_us) clock pair, the same anchor contract as the
+// timeline's CLOCK_SYNC event, so telemetry/postmortem.py can put
+// every rank's events on one wall-clock axis. Disable with
+// HOROVOD_BLACKBOX_DIR=off; default dir is $TMPDIR/hvdtpu_blackbox.
+void DumpBlackBox(GlobalState& st, const Status& s,
+                  const std::vector<int32_t>& ranks, bool certain,
+                  int64_t detect_us) {
+  std::string dir = EnvStr("HOROVOD_BLACKBOX_DIR", "");
+  if (dir == "off" || dir == "none" || dir == "0") return;
+  if (dir.empty()) {
+    const char* tmp = std::getenv("TMPDIR");
+    dir = std::string(tmp != nullptr && tmp[0] != '\0' ? tmp : "/tmp") +
+          "/hvdtpu_blackbox";
+  }
+  ::mkdir(dir.c_str(), 0777);  // best-effort; open failure is the gate
+  std::string path =
+      dir + "/blackbox-rank" + std::to_string(st.rank) + ".jsonl";
+  FILE* f = fopen(path.c_str(), "a");
+  if (f == nullptr) {
+    LOG_WARN("black-box dump skipped: cannot open %s", path.c_str());
+    return;
+  }
+  int64_t unix_us =
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::system_clock::now().time_since_epoch())
+          .count();
+  std::string hdr = "{\"kind\":\"blackbox_header\",\"rank\":" +
+                    std::to_string(st.rank) +
+                    ",\"size\":" + std::to_string(st.size) +
+                    ",\"epoch\":" + std::to_string(st.epoch.load()) +
+                    ",\"unix_us\":" + std::to_string(unix_us) +
+                    ",\"steady_us\":" + std::to_string(MetricsNowUs()) +
+                    ",\"fault\":{\"kind\":\"" +
+                    (s.wire_corruption() ? "corruption" : "peer") +
+                    "\",\"certain\":" + (certain ? "true" : "false") +
+                    ",\"ranks\":[";
+  for (size_t i = 0; i < ranks.size(); i++) {
+    if (i) hdr += ',';
+    hdr += std::to_string(ranks[i]);
+  }
+  hdr += "],\"detect_ms\":" + std::to_string(detect_us / 1000) +
+         ",\"reason\":\"";
+  for (char c : s.reason()) {
+    if (c == '"' || c == '\\') hdr += '\\';
+    hdr += (unsigned char)c < 0x20 ? ' ' : c;
+  }
+  hdr += "\"}}\n";
+  fputs(hdr.c_str(), f);
+  std::vector<EventRecord> evs;
+  GlobalEvents().Snapshot(0, &evs);
+  for (const auto& e : evs) {
+    std::string line = EventJson(e);
+    line += '\n';
+    fputs(line.c_str(), f);
+  }
+  fclose(f);
+}
+
 // Write the fault record + metrics once the loop decides to stop on a
 // peer failure. Attribution = the typed status's rank, any ranks the
 // coordinator's fault notice named, plus a liveness probe over every
@@ -881,6 +946,13 @@ void RecordFault(GlobalState& st, const Status& s,
   Metrics& m = GlobalMetrics();
   m.faults_detected.fetch_add(1, std::memory_order_relaxed);
   m.fault_detect_us.Record(detect_us);
+  // The fault event enters the ring BEFORE the dump so the black-box
+  // tail ends with the fault it explains.
+  GlobalEvents().Record(EventType::kFault,
+                        s.wire_corruption() ? 1 : 0, certain ? 1 : 0,
+                        st.epoch.load(),
+                        ranks.empty() ? -1 : (int64_t)ranks[0]);
+  DumpBlackBox(st, s, ranks, certain, detect_us);
 }
 
 // HOROVOD_FAULT_INJECT: execute the armed chaos action at the top of
@@ -898,6 +970,9 @@ void MaybeInjectFault(GlobalState& st) {
   }
   const int32_t action = st.inject_action.load(std::memory_order_relaxed);
   const int64_t param = st.inject_param.load(std::memory_order_relaxed);
+  // Forensics: the injection itself is part of the causal record — a
+  // post-mortem over a chaos run shows chaos fired, then what broke.
+  GlobalEvents().Record(EventType::kInject, action, 0, idx);
   switch (action) {
     case kFaultKill:
       LOG_WARN("HOROVOD_FAULT_INJECT: rank %d dying at collective %lld",
@@ -983,6 +1058,11 @@ Status ExecuteResponse(GlobalState& st, const Response& response) {
   }
   if (response.response_type != Response::ResponseType::ERROR) {
     MaybeInjectFault(st);
+    GlobalEvents().Record(EventType::kResponseLaunch,
+                          (int32_t)response.response_type,
+                          (int32_t)response.device,
+                          (int64_t)response.tensor_names.size(),
+                          ShapesTotalBytes(response));
   }
   const int64_t exec_start_us = MetricsNowUs();
   // Resolve the data plane for this response's process set BEFORE touching
@@ -1078,6 +1158,13 @@ void BackgroundThreadLoop(GlobalState& st) {
     for (auto& r : requests) st.timeline.NegotiateStart(r.tensor_name);
     bool had_requests = !requests.empty();
     int64_t negotiate_start_us = MetricsNowUs();
+    // Event-ring policy mirrors the histogram below: only ACTIVE
+    // cycles are recorded (idle rounds would lap the ring with noise
+    // and erase the causal window a post-mortem needs).
+    if (had_requests) {
+      GlobalEvents().Record(EventType::kNegotiateBegin,
+                            (int32_t)requests.size());
+    }
     ResponseList response_list;
     Status s = st.controller->ComputeResponseList(
         std::move(requests), st.shutdown_requested.load(), &response_list);
@@ -1086,6 +1173,9 @@ void BackgroundThreadLoop(GlobalState& st) {
     if (had_requests || !response_list.responses.empty()) {
       GlobalMetrics().negotiation_us.Record(MetricsNowUs() -
                                             negotiate_start_us);
+      GlobalEvents().Record(EventType::kNegotiateEnd,
+                            (int32_t)response_list.responses.size(),
+                            response_list.shutdown ? 1 : 0);
     }
     if (!s.ok()) {
       LOG_ERROR("control plane failure: %s", s.reason().c_str());
@@ -1101,11 +1191,23 @@ void BackgroundThreadLoop(GlobalState& st) {
       break;
     }
     // Workers adopt coordinator-autotuned knobs (coordinator already has
-    // them via SetAutotunedParams).
+    // them via SetAutotunedParams). Adoptions that MOVE a knob are
+    // recorded in the event ring — the ResponseList re-broadcasts the
+    // current values every cycle, so only changes are forensic signal.
     if (response_list.fusion_threshold_bytes > 0 && st.rank != 0) {
+      if (st.fusion_threshold.load() !=
+          response_list.fusion_threshold_bytes) {
+        GlobalEvents().Record(EventType::kKnobAdopt, kKnobFusionBytes, 0,
+                              response_list.fusion_threshold_bytes);
+      }
       st.fusion_threshold = response_list.fusion_threshold_bytes;
     }
     if (response_list.cycle_time_ms > 0 && st.rank != 0) {
+      if (st.cycle_time_ms.load() != response_list.cycle_time_ms) {
+        GlobalEvents().Record(
+            EventType::kKnobAdopt, kKnobCycleTimeMs, 0,
+            (int64_t)(response_list.cycle_time_ms * 1000.0));
+      }
       st.cycle_time_ms = response_list.cycle_time_ms;
     }
     // Ring knobs must flip on every rank in the SAME cycle (the chunk
@@ -1113,15 +1215,27 @@ void BackgroundThreadLoop(GlobalState& st) {
     // coordinator adopted these at the END of the previous cycle, and
     // workers adopt here before executing this cycle's responses.
     if (response_list.ring_chunk_bytes >= 0 && st.rank != 0) {
+      if (RingChunkBytes() != response_list.ring_chunk_bytes) {
+        GlobalEvents().Record(EventType::kKnobAdopt, kKnobRingChunk, 0,
+                              response_list.ring_chunk_bytes);
+      }
       SetRingChunkBytes(response_list.ring_chunk_bytes);
     }
     if (response_list.wire_compression >= 0 && st.rank != 0) {
+      if (WireCompression() != (response_list.wire_compression != 0)) {
+        GlobalEvents().Record(EventType::kKnobAdopt, kKnobCompression, 0,
+                              response_list.wire_compression != 0);
+      }
       SetWireCompression(response_list.wire_compression != 0);
     }
     // The hierarchy split decides which plane sequence every rank's
     // next collective decomposes into — as framing-critical as the
     // chunk knob, so it flips in the same lockstep cycle.
     if (response_list.hier_split >= 0 && st.rank != 0) {
+      if (st.hier_split.load() != response_list.hier_split) {
+        GlobalEvents().Record(EventType::kKnobAdopt, kKnobHierSplit, 0,
+                              response_list.hier_split);
+      }
       st.hier_split = response_list.hier_split;
     }
     int64_t cycle_bytes = 0;
@@ -1146,6 +1260,30 @@ void BackgroundThreadLoop(GlobalState& st) {
     if (faulted) break;
     if (st.rank == 0 && st.param_manager &&
         st.param_manager->Update(cycle_bytes)) {
+      // The coordinator committed a new autotuned config: one knob-
+      // adoption event per knob that actually moved.
+      EventRing& ev = GlobalEvents();
+      if (st.fusion_threshold.load() !=
+          st.param_manager->fusion_threshold_bytes()) {
+        ev.Record(EventType::kKnobAdopt, kKnobFusionBytes, 0,
+                  st.param_manager->fusion_threshold_bytes());
+      }
+      if (st.cycle_time_ms.load() != st.param_manager->cycle_time_ms()) {
+        ev.Record(EventType::kKnobAdopt, kKnobCycleTimeMs, 0,
+                  (int64_t)(st.param_manager->cycle_time_ms() * 1000.0));
+      }
+      if (RingChunkBytes() != st.param_manager->ring_chunk_bytes()) {
+        ev.Record(EventType::kKnobAdopt, kKnobRingChunk, 0,
+                  st.param_manager->ring_chunk_bytes());
+      }
+      if (WireCompression() != st.param_manager->wire_compression()) {
+        ev.Record(EventType::kKnobAdopt, kKnobCompression, 0,
+                  st.param_manager->wire_compression() ? 1 : 0);
+      }
+      if (st.hier_split.load() != (int32_t)st.param_manager->hier_split()) {
+        ev.Record(EventType::kKnobAdopt, kKnobHierSplit, 0,
+                  st.param_manager->hier_split());
+      }
       st.fusion_threshold = st.param_manager->fusion_threshold_bytes();
       st.cycle_time_ms = st.param_manager->cycle_time_ms();
       SetRingChunkBytes(st.param_manager->ring_chunk_bytes());
@@ -1403,6 +1541,10 @@ int hvdtpu_init() {
   st->timeline_mark_cycles =
       EnvInt64("HOROVOD_TIMELINE_MARK_CYCLES", 0) != 0;
   InitAutotune(*st);
+  // HOROVOD_EVENTS=0 turns the flight recorder off (on by default;
+  // re-read at every (re)init like the ring knobs).
+  GlobalEvents().set_enabled(EnvInt64("HOROVOD_EVENTS", 1) != 0);
+  GlobalEvents().Record(EventType::kEpoch, 0, 0, join_epoch, -1);
   st->initialized = true;
   st->background_thread = std::thread(BackgroundThreadLoop, std::ref(*st));
   LOG_INFO("initialized rank %d/%d", st->rank, st->size);
@@ -1567,6 +1709,7 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
     }
   }
   if (new_rank < 0) return -3;  // this rank was declared dead
+  GlobalEvents().Record(EventType::kReinitBegin, nranks, 0, epoch);
   if (!st->loop_failed.load() && !st->loop_exited.load()) {
     // Healthy loop (voluntary re-formation — absorbing parole
     // joiners): request the NEGOTIATED shutdown. Every member calls
@@ -1684,6 +1827,7 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
     st->cross_size = old_cross_size;
     st->hier_split = old_hier_split;
     st->epoch = old_epoch;
+    GlobalEvents().Record(EventType::kReinitEnd, -4, nranks, epoch);
     return -4;
   }
   old_controller.reset();  // the new ring is up; now drop the old fds
@@ -1710,6 +1854,11 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
       m.ranks_rejoined.fetch_add(joiner_slots,
                                  std::memory_order_relaxed);
     }
+  }
+  GlobalEvents().Record(EventType::kReinitEnd, 0, nranks, epoch);
+  GlobalEvents().Record(EventType::kEpoch, 0, 0, epoch, old_epoch);
+  if (joiner_slots > 0) {
+    GlobalEvents().Record(EventType::kRejoin, joiner_slots, 0, epoch);
   }
   st->shutdown_requested = false;
   st->loop_exited = false;
@@ -2274,6 +2423,58 @@ int hvdtpu_metrics_reset() {
   GlobalMetrics().Reset();
   return 0;
 }
+
+// Consuming-drain cursor for hvdtpu_events_drain: one per process (the
+// drain surface is a single logical consumer — hvd.events_drain(); the
+// debug server and black-box dump use the non-consuming peek).
+static std::atomic<int64_t> g_events_cursor{0};
+
+int64_t hvdtpu_events_drain(char* buf, int64_t cap) {
+  // Structured event ring drain, two-call pattern like the metrics
+  // snapshot: (nullptr, 0) sizes the pending JSON WITHOUT advancing
+  // the cursor; a buffer call that fits copies the events and advances
+  // the cursor past them (consuming). A too-small buffer copies
+  // nothing, leaves the cursor alone, and returns the needed size so
+  // the caller can retry losslessly. Valid before init.
+  int64_t cursor = g_events_cursor.load(std::memory_order_acquire);
+  int64_t next = cursor;
+  std::string json = GlobalEvents().Json(cursor, &next);
+  if (buf == nullptr || cap <= (int64_t)json.size()) {
+    return (int64_t)json.size();
+  }
+  std::memcpy(buf, json.data(), json.size());
+  buf[json.size()] = '\0';
+  // A concurrent drain may have advanced past us; never move back.
+  int64_t cur = cursor;
+  while (cur < next && !g_events_cursor.compare_exchange_weak(
+                           cur, next, std::memory_order_acq_rel)) {
+  }
+  return (int64_t)json.size();
+}
+
+int64_t hvdtpu_events_peek(char* buf, int64_t cap, int64_t last_n) {
+  // Non-consuming tail read: the newest `last_n` events (<= 0 = the
+  // whole live window) as a JSON array. Same two-call sizing contract;
+  // never touches the drain cursor — the live-introspection surface
+  // (/events on the debug server, hvd.events()).
+  std::string json = GlobalEvents().Json(0, nullptr, last_n);
+  if (buf != nullptr && cap > 0) {
+    int64_t n = std::min<int64_t>((int64_t)json.size(), cap - 1);
+    std::memcpy(buf, json.data(), (size_t)n);
+    buf[n] = '\0';
+  }
+  return (int64_t)json.size();
+}
+
+int hvdtpu_events_enabled() {
+  return GlobalEvents().enabled() ? 1 : 0;
+}
+
+void hvdtpu_set_events_enabled(int on) {
+  GlobalEvents().set_enabled(on != 0);
+}
+
+int64_t hvdtpu_events_head() { return GlobalEvents().head(); }
 
 int hvdtpu_start_timeline(const char* path) {
   CHECK_INIT(-1)

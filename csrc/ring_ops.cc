@@ -14,6 +14,7 @@
 #include <thread>
 
 #include "half.h"
+#include "events.h"
 #include "metrics.h"
 #include "wire.h"
 
@@ -210,9 +211,17 @@ class ReduceWorker {
 struct DataPlane::WireTally {
   int plane = 0;  // 0 intra/flat, 1 cross-slice (set from wire_plane_)
   int64_t tx = 0, rx = 0, tx_logical = 0, rx_logical = 0;
+  int64_t start_us = MetricsNowUs();
   ~WireTally() {
+    // Restore the default plane tag for whatever the thread runs next
+    // (the hierarchical engine nests intra/cross tallies).
+    SetEventWirePlane(0);
     if (tx || rx || tx_logical || rx_logical) {
       GlobalMetrics().AccountWire(plane, tx, rx, tx_logical, rx_logical);
+      int64_t dur = MetricsNowUs() - start_us;
+      GlobalEvents().Record(
+          EventType::kWireSpan, plane,
+          (int32_t)std::min<int64_t>(dur, INT32_MAX), tx, rx);
     }
   }
 };
@@ -754,6 +763,7 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
   tally.plane = wire_plane_;
+  SetEventWirePlane(wire_plane_);
   if ((WireCompression() || force_compression_) &&
       dt == DataType::HVDTPU_FLOAT32 &&
       (op == ReduceOp::SUM || op == ReduceOp::AVERAGE)) {
@@ -804,6 +814,7 @@ Status DataPlane::Allgatherv(const void* input, void* output,
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
   tally.plane = wire_plane_;
+  SetEventWirePlane(wire_plane_);
   for (int step = 0; step < size_ - 1; step++) {
     int send_blk = (rank_ - step + size_) % size_;
     int recv_blk = (rank_ - step - 1 + size_) % size_;
@@ -831,6 +842,7 @@ Status DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
   bool forwards = !is_root && right != root;
   WireTally tally;
   tally.plane = wire_plane_;
+  SetEventWirePlane(wire_plane_);
   if (is_root || forwards) {
     tally.tx += bytes;
     tally.tx_logical += bytes;
@@ -907,6 +919,7 @@ Status DataPlane::Alltoallv(const void* input,
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
   tally.plane = wire_plane_;
+  SetEventWirePlane(wire_plane_);
   // Symmetric pairing: in round r, rank i partners with (r - i) mod size —
   // an involution, so each unordered pair {i, j} exchanges exactly once, in
   // round (i + j) mod size.
@@ -950,6 +963,7 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
   const int64_t chunk = RingChunkBytes();
   WireTally tally;
   tally.plane = wire_plane_;
+  SetEventWirePlane(wire_plane_);
   // rot = -1: after size-1 steps the segment that has accumulated all
   // `size` contributions at rank r is exactly segment r (the API output
   // segment — see RingOwnedSegment).

@@ -22,6 +22,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "events.h"
 #include "metrics.h"
 
 namespace hvdtpu {
@@ -168,9 +169,14 @@ int PollHealing(pollfd* fds, int n, int64_t timeout_ms, bool allow_retry) {
     // Exponential patience, capped so the ladder stays responsive to a
     // genuinely dead peer: one window never exceeds 64x the base.
     int64_t window = backoff << std::min<int64_t>(a, 6);
+    GlobalEvents().Record(EventType::kRetryWindow, (int32_t)a,
+                          (int32_t)window);
     rc = PollOnce(fds, n, window);
     if (rc != 0) {
-      if (rc == 1) m.wire_heals.fetch_add(1, std::memory_order_relaxed);
+      if (rc == 1) {
+        m.wire_heals.fetch_add(1, std::memory_order_relaxed);
+        GlobalEvents().Record(EventType::kWireHeal);
+      }
       return rc;
     }
   }
@@ -789,6 +795,8 @@ Status DuplexCrcTransfer(
             return false;
           }
           ssend->out.q.push_back({kCrcData, in.idx});
+          GlobalEvents().Record(EventType::kCrcResend, 0, 0,
+                                (int64_t)in.idx);
           in.stage = 0;
           continue;
         }
@@ -833,13 +841,20 @@ Status DuplexCrcTransfer(
           n_verified++;
           if (failures[in.idx] > 0) {
             m.wire_heals.fetch_add(1, std::memory_order_relaxed);
+            GlobalEvents().Record(EventType::kWireHeal);
           }
+          GlobalEvents().Record(EventType::kWireChunk, EventWirePlane(),
+                                1, (int64_t)in.idx * (int64_t)chunk,
+                                (int64_t)in.pay_len);
           if (on_chunk) on_chunk((size_t)in.idx * chunk, in.pay_len);
           if (n_verified == nr) srecv->out.q.push_back({kCrcDone, 0});
         }
         continue;
       }
       m.crc_errors.fetch_add(1, std::memory_order_relaxed);
+      GlobalEvents().Record(EventType::kCrcError, FdRank(s->fd),
+                            (int32_t)(failures[in.idx] + 1),
+                            (int64_t)in.idx);
       if (++failures[in.idx] > max_fails) {
         int rank = FdRank(s->fd);
         *st = Status::WireCorruption(
@@ -991,13 +1006,19 @@ Status DuplexTransferChunked(
       if (k > 0) recvd += (size_t)k;
       if (chunk > 0 && on_chunk) {
         while (recvd - fired >= chunk) {
+          GlobalEvents().Record(EventType::kWireChunk, EventWirePlane(),
+                                0, (int64_t)fired, (int64_t)chunk);
           on_chunk(fired, chunk);
           fired += chunk;
         }
       }
     }
   }
-  if (on_chunk && recvd > fired) on_chunk(fired, recvd - fired);
+  if (on_chunk && recvd > fired) {
+    GlobalEvents().Record(EventType::kWireChunk, EventWirePlane(), 0,
+                          (int64_t)fired, (int64_t)(recvd - fired));
+    on_chunk(fired, recvd - fired);
+  }
   return Status::OK();
 }
 

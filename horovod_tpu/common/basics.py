@@ -126,6 +126,16 @@ def load_library():
         lib.hvdtpu_metrics_snapshot.argtypes = [p, i64]
         lib.hvdtpu_metrics_reset.restype = i32
         lib.hvdtpu_metrics_reset.argtypes = []
+        lib.hvdtpu_events_drain.restype = i64
+        lib.hvdtpu_events_drain.argtypes = [p, i64]
+        lib.hvdtpu_events_peek.restype = i64
+        lib.hvdtpu_events_peek.argtypes = [p, i64, i64]
+        lib.hvdtpu_events_enabled.restype = i32
+        lib.hvdtpu_events_enabled.argtypes = []
+        lib.hvdtpu_set_events_enabled.restype = None
+        lib.hvdtpu_set_events_enabled.argtypes = [i32]
+        lib.hvdtpu_events_head.restype = i64
+        lib.hvdtpu_events_head.argtypes = []
         lib.hvdtpu_start_timeline.restype = i32
         lib.hvdtpu_start_timeline.argtypes = [cstr]
         lib.hvdtpu_stop_timeline.restype = i32
@@ -243,8 +253,33 @@ class HorovodBasics:
         if self.lib.hvdtpu_init() != 0:
             raise RuntimeError(
                 "Horovod initialization failed (see stderr log)")
+        # Opt-in live introspection (HOROVOD_DEBUG_PORT, docs/
+        # metrics.md): a per-rank daemon HTTP thread serving /healthz,
+        # /metrics, /events, /stacks — so a live or wedged rank can be
+        # inspected without SIGKILL. Never fatal: observability must
+        # not take the job down.
+        import os as _os
+
+        if _os.environ.get("HOROVOD_DEBUG_PORT"):
+            try:
+                from horovod_tpu.telemetry import debug_server
+
+                debug_server.maybe_start(self)
+            except Exception as e:  # noqa: BLE001
+                import sys as _sys
+
+                print(f"hvdtpu debug server not started: {e}",
+                      file=_sys.stderr)
 
     def shutdown(self):
+        import sys as _sys
+
+        ds = _sys.modules.get("horovod_tpu.telemetry.debug_server")
+        if ds is not None:  # only loaded when HOROVOD_DEBUG_PORT was set
+            try:
+                ds.stop()
+            except Exception:  # noqa: BLE001
+                pass
         self.lib.hvdtpu_shutdown()
 
     def is_initialized(self):
@@ -323,6 +358,51 @@ class HorovodBasics:
         for test isolation and interactive sessions.
         """
         self.lib.hvdtpu_metrics_reset()
+
+    def events(self, last_n=0):
+        """The newest ``last_n`` events of the core's structured event
+        ring (``0`` = the whole live window, up to the ring capacity),
+        as a list of dicts — NON-consuming, so concurrent consumers
+        (the debug server's ``/events``, a black-box dump in flight)
+        are unaffected. Each event carries ``seq``, ``ts_us`` (steady
+        clock), ``type``, and per-type named args; catalog in
+        ``docs/metrics.md``. Works before ``init()``."""
+        import ctypes as _ct
+        import json as _json
+
+        lib = self.lib
+        cap = int(lib.hvdtpu_events_peek(None, 0, int(last_n))) + 4096
+        while True:
+            buf = _ct.create_string_buffer(cap)
+            need = int(lib.hvdtpu_events_peek(buf, cap, int(last_n)))
+            if need < cap:
+                return _json.loads(buf.value.decode())
+            cap = need + 4096
+
+    def events_drain(self):
+        """Consume every event recorded since the last drain (ring-
+        capacity bounded) and return them as a list of dicts. ONE
+        logical consumer per process by contract — scrapers that tail
+        the ring use this; ad-hoc inspection uses :meth:`events`."""
+        import ctypes as _ct
+        import json as _json
+
+        lib = self.lib
+        cap = int(lib.hvdtpu_events_drain(None, 0)) + 4096
+        while True:
+            buf = _ct.create_string_buffer(cap)
+            need = int(lib.hvdtpu_events_drain(buf, cap))
+            if need < cap:
+                return _json.loads(buf.value.decode())
+            cap = need + 4096
+
+    def events_enabled(self):
+        """Whether the event ring records (``HOROVOD_EVENTS``; on by
+        default — recording is wait-free and bounded-memory)."""
+        return bool(self.lib.hvdtpu_events_enabled())
+
+    def set_events_enabled(self, on):
+        self.lib.hvdtpu_set_events_enabled(1 if on else 0)
 
     def ring_chunk_bytes(self):
         """Chunk granularity of the chunk-pipelined host ring

@@ -146,6 +146,21 @@ def metrics_reset():
     telemetry.metrics_reset()
 
 
+def events(last_n=0):
+    """The newest ``last_n`` events of the core's structured event ring
+    (``0`` = the whole live window), as a list of dicts — the always-on
+    flight recorder behind black-box post-mortems (docs/metrics.md).
+
+    Non-consuming: safe alongside the debug server's ``/events`` and
+    the core's own fault dumps. Each event carries ``seq``, ``ts_us``
+    (steady clock), ``type`` (``negotiate_begin``, ``response_launch``,
+    ``wire_chunk``, ``retry_window``, ``fault``, ``knob_adopt``, ...)
+    and per-type named args. For the cross-rank forensic merge see
+    ``python -m horovod_tpu.telemetry.report --post-mortem``.
+    """
+    return _basics.events(last_n)
+
+
 is_initialized = _basics.is_initialized
 rank = _basics.rank
 size = _basics.size

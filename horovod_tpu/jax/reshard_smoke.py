@@ -84,6 +84,15 @@ def worker():
         assert cross_moved == _STEPS * pred["cross"], \
             (cross_moved, _STEPS * pred["cross"])
         assert total_moved == _STEPS * (pred["cross"] + pred["intra"])
+        # Per-plane split (telemetry.core.wire_plane_bytes, the r15
+        # StepTimer surface): intra = total - cross must reconcile to
+        # the byte against the SAME planner math, independently.
+        from horovod_tpu.telemetry.core import wire_plane_bytes
+
+        intra_now = wire_plane_bytes()[0]
+        intra0 = snap0["tx_bytes"] - snap0["cross_tx_bytes"]
+        assert intra_now - intra0 == _STEPS * pred["intra"], \
+            (intra_now - intra0, _STEPS * pred["intra"])
 
         # Acceptance ratio: cross-plane bytes <= ~(1/local_size + eps)
         # of the flat ring's DCN traffic. The flat ring is LOCALITY-

@@ -24,15 +24,31 @@ the Chrome timeline and the autotune log). Three pieces:
    merges per-rank timeline JSONs into one Perfetto-loadable trace
    with clock alignment and per-tensor straggler attribution.
 
+4. **Flight recorder & forensics** — the core's always-on structured
+   event ring (:func:`events` / :func:`events_drain`) feeds black-box
+   per-rank JSONL dumps on every typed fault;
+   :mod:`~horovod_tpu.telemetry.postmortem` merges them into one
+   causal cross-rank timeline (``report --post-mortem``) naming the
+   root-cause rank, and :mod:`~horovod_tpu.telemetry.debug_server`
+   (``HOROVOD_DEBUG_PORT``) serves ``/healthz`` ``/metrics``
+   ``/events`` ``/stacks`` per rank, live.
+
 See ``docs/metrics.md`` for the counter catalog and walkthroughs.
 """
 
 from horovod_tpu.telemetry.core import (  # noqa: F401
+    events,
+    events_drain,
     metrics_reset,
     snapshot,
     total_collective_bytes,
+    wire_plane_bytes,
 )
 from horovod_tpu.telemetry.exporters import MetricsScraper  # noqa: F401
+from horovod_tpu.telemetry.postmortem import (  # noqa: F401
+    format_post_mortem,
+    merge_post_mortem,
+)
 from horovod_tpu.telemetry.step_timer import (  # noqa: F401
     StepTimer,
     analytic_bubble,

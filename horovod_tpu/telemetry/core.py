@@ -44,6 +44,37 @@ def wire_bytes(snap=None):
     return w.get("tx_bytes", 0), w.get("tx_logical_bytes", 0)
 
 
+def wire_plane_bytes(snap=None):
+    """Per-plane transport tx accounting as a 4-tuple
+    ``(intra_tx, intra_tx_logical, cross_tx, cross_tx_logical)``.
+
+    The core books the cross-slice hop of the hierarchical
+    decomposition separately (``wire.cross_*``, the DCN-priced fabric
+    — docs/redistribute.md) *inside* the totals, so intra here is
+    total minus cross. The pair of pairs lets per-plane goodput and
+    compression ratios reconcile independently (cross-hop-only bf16
+    moves cross to ~0.5 while intra stays 1.0).
+    """
+    if snap is None:
+        snap = snapshot()
+    w = snap.get("wire", {})
+    cross = w.get("cross_tx_bytes", 0)
+    cross_l = w.get("cross_tx_logical_bytes", 0)
+    return (w.get("tx_bytes", 0) - cross,
+            w.get("tx_logical_bytes", 0) - cross_l, cross, cross_l)
+
+
+def events(last_n=0):
+    """The newest ``last_n`` structured ring events (non-consuming;
+    see ``docs/metrics.md`` for the event catalog)."""
+    return _basics.events(last_n)
+
+
+def events_drain():
+    """Consume and return every ring event since the last drain."""
+    return _basics.events_drain()
+
+
 def total_collective_bytes(snap=None, planes=("ops", "device_ops"),
                            op_classes=None):
     """Sum payload bytes across op classes and planes of a snapshot.

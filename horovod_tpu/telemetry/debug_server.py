@@ -1,0 +1,188 @@
+"""Live per-rank introspection endpoint (``HOROVOD_DEBUG_PORT``).
+
+An opt-in daemon HTTP thread per rank — the window into a live or
+WEDGED process that post-mortems cannot give (a post-mortem needs the
+process to have noticed its fault; a rank blocked on a SIGSTOPped peer
+has not, and SIGKILLing it to find out destroys the evidence). Rank r
+listens on ``HOROVOD_DEBUG_PORT + r`` (ranks on one host must not
+collide), bound to loopback unless ``HOROVOD_DEBUG_HOST`` widens it,
+and serves:
+
+- ``/healthz`` — epoch, world size, loop state, last fault record, and
+  the elastic heal/retry counters as one JSON object; the liveness
+  probe an operator (or k8s) polls.
+- ``/metrics`` — the existing Prometheus text formatter
+  (:func:`horovod_tpu.telemetry.exporters._flatten_prom`) over a fresh
+  core snapshot: point a Prometheus scrape at the debug port directly,
+  no textfile hop.
+- ``/events`` — the newest event-ring tail as JSON
+  (``?n=<count>``, default 256) — the flight recorder, live.
+- ``/stacks`` — a ``faulthandler`` dump of every Python thread: where
+  exactly a wedged rank is stuck (ctypes waits release the GIL, so the
+  server thread answers even while the main thread blocks inside a
+  collective on a dead peer — the situation introspection exists for).
+
+Everything is served by stdlib ``http.server`` on a daemon thread:
+zero dependencies, zero cost until the first request, and the process
+never waits on it at shutdown.
+"""
+
+import faulthandler
+import json
+import os
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+_server = None
+_thread = None
+_lock = threading.Lock()
+_start_time = None
+
+
+def _healthz(basics):
+    lib = basics.lib
+    initialized = bool(lib.hvdtpu_is_initialized())
+    out = {
+        "initialized": initialized,
+        "rank": lib.hvdtpu_rank() if initialized else -1,
+        "size": lib.hvdtpu_size() if initialized else -1,
+        "epoch": int(lib.hvdtpu_epoch()),
+        "loop_failed": bool(lib.hvdtpu_loop_failed()),
+        "last_fault": basics.last_fault(),
+        "uptime_s": round(time.monotonic() - _start_time, 3)
+        if _start_time is not None else None,
+    }
+    try:
+        snap = basics.metrics_snapshot()
+        out["elastic"] = {
+            k: v for k, v in snap.get("elastic", {}).items()
+            if k != "detect_us"
+        }
+        out["cycles"] = snap.get("cycle", {}).get("count", 0)
+    except Exception as e:  # noqa: BLE001 — health must answer anyway
+        out["metrics_error"] = str(e)
+    return out
+
+
+def _stacks():
+    """All-thread tracebacks via faulthandler (signal-safe C-level
+    walker — it renders frames even when a thread holds odd state),
+    plus the thread-name table faulthandler does not print."""
+    names = {
+        t.ident: f"{t.name}{' daemon' if t.daemon else ''}"
+        for t in threading.enumerate()
+    }
+    with tempfile.TemporaryFile(mode="w+") as f:
+        faulthandler.dump_traceback(file=f, all_threads=True)
+        f.seek(0)
+        dump = f.read()
+    header = "\n".join(f"thread 0x{ident:x}: {name}"
+                       for ident, name in names.items() if ident)
+    return header + "\n\n" + dump
+
+
+class _Handler(BaseHTTPRequestHandler):
+    basics = None  # class attr, set by maybe_start
+
+    def log_message(self, *args):  # silence per-request stderr lines
+        pass
+
+    def _reply(self, code, body, ctype="application/json"):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        try:
+            if url.path in ("/healthz", "/health"):
+                self._reply(200, json.dumps(_healthz(self.basics)))
+            elif url.path == "/metrics":
+                from horovod_tpu.telemetry.exporters import _flatten_prom
+
+                snap = self.basics.metrics_snapshot()
+                self._reply(200,
+                            _flatten_prom(snap, snap.get("rank", -1)),
+                            ctype="text/plain; version=0.0.4")
+            elif url.path == "/events":
+                n = int(parse_qs(url.query).get("n", ["256"])[0])
+                self._reply(200, json.dumps(self.basics.events(n)))
+            elif url.path == "/stacks":
+                self._reply(200, _stacks(), ctype="text/plain")
+            else:
+                self._reply(404, json.dumps({
+                    "error": f"unknown path {url.path}",
+                    "endpoints": ["/healthz", "/metrics", "/events",
+                                  "/stacks"]}))
+        except Exception as e:  # noqa: BLE001 — a broken endpoint must
+            # not kill the server thread (introspection of a sick
+            # process is exactly when internals throw)
+            try:
+                self._reply(500, json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}))
+            except Exception:  # noqa: BLE001 — client went away
+                pass
+
+
+def start(basics, port, host="127.0.0.1"):
+    """Start the debug server on `port` (exact — callers resolve the
+    per-rank offset). Returns the bound port. Idempotent per process.
+
+    Binds loopback by default: the endpoints expose thread stacks and
+    runtime internals with no auth, so reaching them from off-host is
+    an explicit opt-in (``HOROVOD_DEBUG_HOST=0.0.0.0`` — e.g. for a
+    k8s liveness probe against the pod IP)."""
+    global _server, _thread, _start_time
+    with _lock:
+        if _server is not None:
+            return _server.server_address[1]
+        handler = type("BoundHandler", (_Handler,), {"basics": basics})
+        _server = ThreadingHTTPServer((host, port), handler)
+        _server.daemon_threads = True
+        _start_time = time.monotonic()
+        _thread = threading.Thread(target=_server.serve_forever,
+                                   name="hvdtpu-debug-server",
+                                   daemon=True)
+        _thread.start()
+        return _server.server_address[1]
+
+
+def maybe_start(basics):
+    """Start iff ``HOROVOD_DEBUG_PORT`` is set: rank r binds port+r
+    (rank from the live core when initialized, else HOROVOD_RANK).
+    Returns the bound port or ``None``."""
+    base = os.environ.get("HOROVOD_DEBUG_PORT")
+    if not base:
+        return None
+    base = int(base)
+    if base <= 0:
+        return None
+    rank = 0
+    try:
+        if basics.lib.hvdtpu_is_initialized():
+            rank = max(basics.lib.hvdtpu_rank(), 0)
+        else:
+            rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    except Exception:  # noqa: BLE001
+        pass
+    host = os.environ.get("HOROVOD_DEBUG_HOST", "127.0.0.1")
+    return start(basics, base + rank, host=host)
+
+
+def stop():
+    """Shut the server down (called from hvd.shutdown; safe if never
+    started)."""
+    global _server, _thread
+    with _lock:
+        if _server is None:
+            return
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+        _thread = None

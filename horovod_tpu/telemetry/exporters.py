@@ -58,11 +58,28 @@ def _flatten_prom(snap, rank):
                  f'{fus.get("fill_ratio", 0.0)}')
     wire = snap.get("wire", {})
     for field in ("tx_bytes", "rx_bytes", "tx_logical_bytes",
-                  "rx_logical_bytes"):
+                  "rx_logical_bytes", "cross_tx_bytes", "cross_rx_bytes",
+                  "cross_tx_logical_bytes", "cross_rx_logical_bytes"):
         lines.append(f'hvdtpu_wire_{field}_total{{{label}}} '
                      f'{wire.get(field, 0)}')
-    lines.append(f'hvdtpu_wire_compression_ratio{{{label}}} '
-                 f'{wire.get("compression_ratio", 1.0)}')
+    for field in ("compression_ratio", "cross_compression_ratio"):
+        lines.append(f'hvdtpu_wire_{field}{{{label}}} '
+                     f'{wire.get(field, 1.0)}')
+    # Elastic fault lifecycle (docs/elastic.md): the counters an
+    # alerting rule watches — faults/heals/retries/CRC errors moving is
+    # the flaky-host signal, epoch divergence the split-brain one.
+    el = snap.get("elastic", {})
+    for field in ("faults_detected", "faults_recovered",
+                  "ranks_blacklisted", "ranks_rejoined", "heals",
+                  "retries", "crc_errors"):
+        lines.append(f'hvdtpu_elastic_{field}_total{{{label}}} '
+                     f'{el.get(field, 0)}')
+    lines.append(f'hvdtpu_elastic_epoch{{{label}}} '
+                 f'{el.get("epoch", 0)}')
+    det = el.get("detect_us", {})
+    for field in ("count", "p50_us", "p99_us", "max_us"):
+        lines.append(f'hvdtpu_elastic_detect_{field}{{{label}}} '
+                     f'{det.get(field, 0)}')
     for r, n in enumerate(
             snap.get("straggler", {}).get("last_rank_counts", [])):
         lines.append(

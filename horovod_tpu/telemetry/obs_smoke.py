@@ -1,0 +1,177 @@
+"""Two-process observability smoke: ``make obs-smoke``.
+
+The full flight-recorder stack, one command, no accelerator: 2 real
+ranks over the eager host ring with the debug endpoint up on both,
+then a chaos-injected ``stop:<ms>`` stall (SIGSTOP + SIGCONT waker)
+that escalates to a typed fault. Asserts:
+
+1. **live introspection mid-run** — ``/healthz`` answers on BOTH ranks
+   while the job is running (and ``/stacks`` + ``/events`` on the rank
+   that is about to be wedged against the stalled peer);
+2. **black-box post-mortem** — both ranks dump their event-ring tail
+   the moment they record the fault, and the merged causal timeline
+   (``report --post-mortem``) names the stalled rank as first-stalled
+   WITHOUT declaring anyone dead (a stall is suspicion, not proof —
+   both processes survived and dumped).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+STALL_MS = 2500
+STALL_AT_OP = 3
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def worker(tmpdir):
+    import numpy as np
+
+    from horovod_tpu.common import eager_ops
+    from horovod_tpu.common.basics import HorovodBasics
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    b = HorovodBasics()
+    b.init()
+    rank, size = b.rank(), b.size()
+    if rank == 1:
+        b.set_fault_inject_spec(f"1:{STALL_AT_OP}:stop:{STALL_MS}")
+    x = np.full(2048, float(rank + 1), np.float32)
+    for i in range(STALL_AT_OP):  # clean warmup ops
+        out = eager_ops.allreduce_async(x, f"warm.{i}").synchronize()
+        assert out[0] == 3.0, out[0]
+    # Handshake: tell the driver both ranks are up (debug servers
+    # answering) and wait for its go before running the op that stalls.
+    with open(os.path.join(tmpdir, f"ready.{rank}"), "w") as f:
+        f.write("ready")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(os.path.join(tmpdir, "go")):
+        assert time.monotonic() < deadline, "driver never said go"
+        time.sleep(0.05)
+    try:
+        eager_ops.allreduce_async(x, "stall").synchronize()
+        print(f"OBS_SMOKE_FAIL rank={rank}: stall op did not fault")
+        return 1
+    except HorovodInternalError:
+        pass
+    fault = b.last_fault()
+    assert fault is not None
+    # r12 ordering rule: keep sockets open until the peer has
+    # classified its own fault too, then leave.
+    time.sleep(1.5)
+    b.shutdown()
+    print(f"OBS_SMOKE_OK rank={rank} fault_ranks={fault['ranks']} "
+          f"certain={fault['certain']}")
+    return 0
+
+
+def _get(url, timeout=10):
+    return urllib.request.urlopen(url, timeout=timeout).read()
+
+
+def main():
+    if "--worker" in sys.argv:
+        return worker(os.environ["HVDTPU_SMOKE_TMP"])
+
+    from horovod_tpu.telemetry import postmortem
+
+    size = 2
+    port = _free_port()
+    dbg_port = _free_port()
+    with tempfile.TemporaryDirectory() as tmpdir:
+        bb_dir = os.path.join(tmpdir, "blackbox")
+        procs = []
+        for rank in range(size):
+            env = dict(os.environ,
+                       HOROVOD_RANK=str(rank), HOROVOD_SIZE=str(size),
+                       HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                       HOROVOD_CONTROLLER_PORT=str(port),
+                       HOROVOD_WIRE_TIMEOUT_MS="600",
+                       HOROVOD_WIRE_RETRY_ATTEMPTS="0",
+                       HOROVOD_DEBUG_PORT=str(dbg_port),
+                       HOROVOD_BLACKBOX_DIR=bb_dir,
+                       HVDTPU_SMOKE_TMP=tmpdir,
+                       JAX_PLATFORMS="cpu")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "horovod_tpu.telemetry.obs_smoke", "--worker"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+
+        # Phase 1: both ranks warmed up -> /healthz must answer on BOTH
+        # mid-run (plus /stacks and /events on rank 0, which is about
+        # to block against the stalled peer).
+        deadline = time.monotonic() + 60
+        while not all(os.path.exists(os.path.join(tmpdir, f"ready.{r}"))
+                      for r in range(size)):
+            if time.monotonic() > deadline:
+                for p in procs:
+                    p.kill()
+                print("obs-smoke: FAILED (workers never became ready)")
+                return 1
+            time.sleep(0.05)
+        for r in range(size):
+            health = json.loads(_get(
+                f"http://127.0.0.1:{dbg_port + r}/healthz"))
+            assert health["rank"] == r and health["initialized"], health
+            assert health["epoch"] == 0 and not health["loop_failed"]
+        stacks = _get(f"http://127.0.0.1:{dbg_port}/stacks")
+        assert b"File" in stacks or b"Thread" in stacks
+        events = json.loads(_get(
+            f"http://127.0.0.1:{dbg_port}/events?n=64"))
+        assert any(e["type"] == "response_launch" for e in events)
+        print(f"obs-smoke: /healthz answered on both ranks mid-run, "
+              f"/stacks + /events live ({len(events)} ring events)")
+
+        # Phase 2: release the stall op; the fault must leave per-rank
+        # black boxes whose merge names the stalled rank.
+        with open(os.path.join(tmpdir, "go"), "w") as f:
+            f.write("go")
+        failed = False
+        for rank, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out = "TIMEOUT"
+            ok = p.returncode == 0 and "OBS_SMOKE_OK" in out
+            print(out.strip())
+            if not ok:
+                print(f"rank {rank} FAILED (rc={p.returncode})")
+                failed = True
+        if failed:
+            return 1
+
+        dumps = sorted(os.listdir(bb_dir))
+        assert dumps == [f"blackbox-rank{r}.jsonl" for r in range(size)], \
+            dumps
+        analysis = postmortem.merge_post_mortem(bb_dir)
+        # A stall is suspicion, not proof: nobody is declared dead
+        # (both processes dumped = both alive), and the first-stalled
+        # analysis names the SIGSTOPped rank.
+        assert analysis["root_cause_ranks"] == [], analysis
+        assert analysis["first_stalled_rank"] == 1, {
+            k: analysis[k] for k in ("first_stalled_rank", "per_rank")}
+        assert analysis["timeline"], "empty merged timeline"
+        print(postmortem.format_post_mortem(analysis, tail=12))
+        print(f"obs-smoke: OK (merged post-mortem over {size} ranks "
+              f"names rank 1 as first-stalled, "
+              f"{len(analysis['timeline'])} causal events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

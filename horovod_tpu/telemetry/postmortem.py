@@ -1,0 +1,266 @@
+"""Black-box post-mortem: merge per-rank event-ring dumps into one
+causal cross-rank fault timeline.
+
+When the native core records a typed fault (``PeerFailure`` /
+``WireCorruption``) it dumps the tail of its structured event ring to a
+per-rank JSONL file *before* any handle wakes an API thread
+(``DumpBlackBox`` in ``csrc/operations.cc``) — so even a job that dies
+ugly leaves, per surviving rank, the causal window that led there. This
+module is the offline half::
+
+    python -m horovod_tpu.telemetry.report --post-mortem \
+        /tmp/hvdtpu_blackbox/blackbox-rank*.jsonl
+
+Clock alignment reuses the CLOCK_SYNC contract of the Perfetto merge:
+each dump's header carries a ``(unix_us, steady_us)`` pair sampled
+together at dump time, so every rank's steady-clock event timestamps
+map onto one wall-clock axis (up to NTP skew, same bound as the trace
+merge).
+
+Attribution separates **root-cause death from secondary timeouts**, the
+same proof-vs-suspicion discipline as the elastic layer
+(docs/elastic.md): a rank named by a *certain* fault record (EOF/RST/
+probe sweep) is provably dead — root cause. A rank that is merely
+*suspected* (timeout) but wrote its own black-box dump is demonstrably
+alive — its naming was a secondary timeout (it was quiet because it was
+itself blocked on the real casualty). The **first-stalled rank** is the
+one whose last forward-progress event (wire chunk/span, response
+launch, negotiation end) is earliest on the merged axis — among
+survivors, that is the rank the stall propagated *from*.
+"""
+
+import json
+import os
+from collections import defaultdict
+
+# Event types that constitute forward progress for first-stall analysis.
+PROGRESS_TYPES = ("wire_chunk", "wire_span", "response_launch",
+                  "negotiate_end")
+
+
+def default_blackbox_dir():
+    """Where the core dumps land when HOROVOD_BLACKBOX_DIR is unset
+    (must mirror DumpBlackBox in csrc/operations.cc)."""
+    env = os.environ.get("HOROVOD_BLACKBOX_DIR", "")
+    if env and env not in ("off", "none", "0"):
+        return env
+    return os.path.join(os.environ.get("TMPDIR") or "/tmp",
+                        "hvdtpu_blackbox")
+
+
+def load_blackbox(path):
+    """Parse one per-rank black-box JSONL file into a list of dumps
+    (a process appends one dump per fault): each is
+    ``{"header": {...}, "events": [...]}``. Tolerates a truncated final
+    line (the process may have died mid-write)."""
+    dumps = []
+    current = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a dying process
+            if row.get("kind") == "blackbox_header":
+                current = {"header": row, "events": []}
+                dumps.append(current)
+            elif current is not None:
+                current["events"].append(row)
+    return dumps
+
+
+def collect_paths(paths_or_dir):
+    """Expand a directory (or mixed list) into blackbox JSONL paths."""
+    if isinstance(paths_or_dir, str):
+        paths_or_dir = [paths_or_dir]
+    out = []
+    for p in paths_or_dir:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.startswith("blackbox-") and f.endswith(".jsonl")))
+        else:
+            out.append(p)
+    return out
+
+
+def _wall_us(event, header):
+    """Steady-clock event timestamp -> wall clock, via the header's
+    (unix_us, steady_us) anchor pair (the CLOCK_SYNC contract)."""
+    return event["ts_us"] - header["steady_us"] + header["unix_us"]
+
+
+def merge_post_mortem(paths_or_dir, dump_index=-1):
+    """Merge per-rank black-box dumps into one causal analysis.
+
+    ``dump_index`` selects which dump per file when a process recorded
+    several faults (-1 = the latest). Returns a dict with:
+
+    - ``timeline``: every rank's events on one wall axis, sorted —
+      each entry carries ``rank``, ``wall_us``, ``t_ms`` (relative to
+      the earliest event) and the original event fields;
+    - ``root_cause_ranks``: provably dead (or corrupting) ranks;
+    - ``secondary_suspects``: ranks named only by timeout suspicion
+      that demonstrably survived (wrote their own dump);
+    - ``first_stalled_rank`` and ``last_progress_us`` per rank;
+    - ``per_rank``: each survivor's fault record + event count.
+    """
+    paths = collect_paths(paths_or_dir)
+    ranks = {}
+    for path in paths:
+        dumps = load_blackbox(path)
+        if not dumps:
+            continue
+        dump = dumps[dump_index]
+        rank = dump["header"].get("rank", -1)
+        ranks[rank] = dump
+    if not ranks:
+        raise ValueError(f"no black-box dumps found in {paths_or_dir!r}")
+
+    survivors = set(ranks)
+    certain, suspected, corrupting = set(), set(), set()
+    per_rank = {}
+    for rank, dump in sorted(ranks.items()):
+        fault = dump["header"].get("fault", {})
+        named = set(fault.get("ranks", []))
+        if fault.get("kind") == "corruption":
+            # Corruption names a live-but-poisoning peer: root cause
+            # of THIS fault even though the process survives (and may
+            # itself have dumped, oblivious).
+            corrupting |= named
+        elif fault.get("certain"):
+            certain |= named
+        else:
+            suspected |= named
+        per_rank[rank] = {
+            "epoch": dump["header"].get("epoch"),
+            "fault": fault,
+            "events": len(dump["events"]),
+        }
+
+    # A dump is proof of life at fault time, and it BEATS a peer's
+    # "certain" EOF attribution: survivors tearing their sockets down
+    # after recording their own fault feed late-classifying peers EOFs
+    # on live ranks (the r12 ordering gotcha) — offline, the dump's
+    # existence filters those artifacts out. What remains certain and
+    # dump-less is provably dead: root cause.
+    root_cause = sorted((certain - survivors) | corrupting)
+    secondary = sorted(((certain | suspected) & survivors) - corrupting)
+    if not root_cause:
+        # No proof anywhere: the suspects that did NOT dump are the
+        # best remaining explanation (they never noticed a fault —
+        # consistent with being the casualty).
+        root_cause = sorted(suspected - survivors)
+
+    timeline = []
+    for rank, dump in ranks.items():
+        hdr = dump["header"]
+        for ev in dump["events"]:
+            entry = dict(ev)
+            entry["rank"] = rank
+            entry["wall_us"] = _wall_us(ev, hdr)
+            timeline.append(entry)
+    timeline.sort(key=lambda e: e["wall_us"])
+    t0 = timeline[0]["wall_us"] if timeline else 0
+    for e in timeline:
+        e["t_ms"] = round((e["wall_us"] - t0) / 1000.0, 3)
+
+    # First-stalled: progress only counts BEFORE the stall was first
+    # noticed anywhere (the earliest retry-ladder window or fault on
+    # the merged axis) — a SIGSTOPped rank that later resumes, retries,
+    # and faults records plenty of late activity, but its last progress
+    # *before the stall surfaced* is what betrays that it froze first
+    # while its peers were still launching work against it.
+    stall_marks = [e["wall_us"] for e in timeline
+                   if e["type"] in ("retry_window", "fault", "crc_error")]
+    cutoff = min(stall_marks) if stall_marks else None
+    last_progress = {}
+    for e in timeline:
+        if e["type"] not in PROGRESS_TYPES:
+            continue
+        if cutoff is not None and e["wall_us"] > cutoff:
+            continue
+        rank = e["rank"]
+        if e["wall_us"] > last_progress.get(rank, float("-inf")):
+            last_progress[rank] = e["wall_us"]
+    first_stalled = None
+    if last_progress:
+        first_stalled = min(last_progress, key=last_progress.get)
+    for rank, us in last_progress.items():
+        per_rank[rank]["last_progress_ms"] = round((us - t0) / 1000.0, 3)
+
+    return {
+        "ranks": sorted(survivors),
+        "root_cause_ranks": root_cause,
+        "secondary_suspects": secondary,
+        "first_stalled_rank": first_stalled,
+        "per_rank": per_rank,
+        "timeline": timeline,
+    }
+
+
+def format_post_mortem(analysis, tail=40):
+    """Operator-facing text rendering of :func:`merge_post_mortem`."""
+    lines = []
+    rc = analysis["root_cause_ranks"]
+    lines.append(
+        f"root cause: rank(s) {rc}" if rc else
+        "root cause: none provable (no certain attribution in any dump)")
+    if analysis["secondary_suspects"]:
+        lines.append("secondary timeouts (suspected but alive): "
+                     f"{analysis['secondary_suspects']}")
+    if analysis["first_stalled_rank"] is not None:
+        lines.append(
+            f"first stalled: rank {analysis['first_stalled_rank']} "
+            "(earliest last-progress event)")
+    for rank, d in sorted(analysis["per_rank"].items()):
+        fault = d.get("fault", {})
+        lines.append(
+            f"  rank {rank}: epoch {d.get('epoch')}, "
+            f"{d['events']} events, fault kind={fault.get('kind')} "
+            f"certain={fault.get('certain')} ranks={fault.get('ranks')} "
+            f"last progress {d.get('last_progress_ms', '-')} ms")
+    lines.append(f"causal timeline (last {tail} of "
+                 f"{len(analysis['timeline'])} events):")
+    for e in analysis["timeline"][-tail:]:
+        args = {k: v for k, v in e.items()
+                if k not in ("rank", "wall_us", "t_ms", "ts_us", "seq",
+                             "type")}
+        lines.append(f"  {e['t_ms']:>10.3f} ms  rank {e['rank']}  "
+                     f"{e['type']}  {args}")
+    return "\n".join(lines)
+
+
+# ---- events -> Perfetto -----------------------------------------------
+
+
+def events_to_trace_events(dump, base_unix_us, tid=990):
+    """Render one dump's ring events as Chrome-trace events on the
+    merged axis (``ts = wall_us - base_unix_us``): ``wire_span``
+    becomes a complete ('X') span ending at its record time, everything
+    else an instant ('i') — so chunk-level wire activity and heal-ladder
+    steps land on the same Perfetto timeline as the per-op spans."""
+    hdr = dump["header"]
+    rank = hdr.get("rank", -1)
+    out = [{
+        "name": "thread_name", "ph": "M", "pid": rank, "tid": tid,
+        "args": {"name": "events"},
+    }]
+    for ev in dump["events"]:
+        wall = _wall_us(ev, hdr)
+        ts = wall - base_unix_us
+        args = {k: v for k, v in ev.items()
+                if k not in ("ts_us", "seq", "type")}
+        if ev.get("type") == "wire_span":
+            dur = max(int(ev.get("dur_us", 0)), 1)
+            out.append({"name": f"wire_span p{ev.get('plane', 0)}",
+                        "ph": "X", "ts": ts - dur, "dur": dur,
+                        "pid": rank, "tid": tid, "args": args})
+        else:
+            out.append({"name": ev.get("type", "event"), "ph": "i",
+                        "ts": ts, "pid": rank, "tid": tid, "s": "t",
+                        "args": args})
+    return out

@@ -156,12 +156,18 @@ def straggler_table(traces, offsets, top_n=10):
             "matched_events": len(keys)}
 
 
-def merge(paths, align=True):
+def merge(paths, align=True, events_paths=None):
     """Merge per-rank timeline files.
 
     Returns ``(merged_events, skew)``: one Chrome-trace event list
     (per-rank ts shifted onto the common axis, pid = rank, process
-    names labeled) and the straggler table.
+    names labeled) and the straggler table. ``events_paths`` optionally
+    folds per-rank event-ring dumps (black-box JSONL, see
+    :mod:`horovod_tpu.telemetry.postmortem`) in as extra per-rank
+    tracks — chunk-level wire activity, heal-ladder steps, and fault
+    milestones land on the same axis as the per-op spans, aligned
+    through each dump's wall/steady anchor pair against the traces'
+    CLOCK_SYNC anchors.
     """
     traces = [load_timeline(p) for p in paths]
     seen = set()
@@ -187,6 +193,42 @@ def merge(paths, align=True):
             merged.append({"name": "process_name", "ph": "M",
                            "pid": rank,
                            "args": {"name": f"rank {rank}"}})
+    if events_paths:
+        from horovod_tpu.telemetry import postmortem
+
+        # The merged axis puts rank r's trace event at
+        # (wall - sync_r) + offsets[r], so the wall base that lands
+        # rank r's ring events on ITS OWN trace rows is
+        # sync_r - offsets[r]. Under full CLOCK_SYNC alignment that is
+        # the same value for every rank (min(sync)); under --no-align
+        # or the NEGOTIATE-median fallback the bases differ per rank,
+        # and a single global anchor would shear the event tracks off
+        # the op spans they annotate.
+        syncs = {rank: _clock_sync_us(events) for rank, events in traces}
+        bases = {rank: s - offsets[rank]
+                 for rank, s in syncs.items() if s is not None}
+        fallback = min(bases.values()) if bases else None
+        for path in postmortem.collect_paths(events_paths):
+            # A process appends one dump per fault and each dump is the
+            # ring tail at that moment — successive dumps overlap, so
+            # fold each event ONCE (seq is per-process monotonic):
+            # rendering every dump verbatim would duplicate the shared
+            # window at identical timestamps, while keeping only the
+            # last would drop events that aged out of the ring between
+            # faults.
+            seen_seqs = set()
+            for dump in postmortem.load_blackbox(path):
+                hdr = dump["header"]
+                fresh = [e for e in dump["events"]
+                         if e.get("seq") not in seen_seqs]
+                if not fresh:
+                    continue
+                seen_seqs.update(e.get("seq") for e in fresh)
+                base = bases.get(hdr.get("rank"), fallback)
+                if base is None:  # no anchored trace anywhere: events-
+                    base = hdr["unix_us"]  # only, relative to dump time
+                merged.extend(postmortem.events_to_trace_events(
+                    {"header": hdr, "events": fresh}, base))
     merged.sort(key=lambda e: e.get("ts", 0))
     skew = straggler_table(traces, offsets)
     return merged, skew
@@ -250,7 +292,9 @@ def main(argv=None):
         description="Merge per-rank hvdtpu timelines into one "
                     "Perfetto-loadable trace with straggler attribution")
     ap.add_argument("timelines", nargs="+",
-                    help="per-rank timeline JSON files")
+                    help="per-rank timeline JSON files (or, with "
+                         "--post-mortem, black-box JSONL dumps / the "
+                         "dump directory)")
     ap.add_argument("-o", "--output", default="merged_timeline.json",
                     help="merged trace output path")
     ap.add_argument("--skew-json", default=None,
@@ -261,9 +305,31 @@ def main(argv=None):
                     help="per-rank hvd.metrics() snapshot JSON files: "
                          "folds elastic fault events (epoch, faults, "
                          "detection latency) into the straggler table")
+    ap.add_argument("--events", nargs="*", default=None,
+                    help="per-rank event-ring dumps (black-box JSONL): "
+                         "rendered as extra Perfetto tracks on the "
+                         "merged timeline")
+    ap.add_argument("--post-mortem", action="store_true",
+                    help="positional args are black-box JSONL dumps "
+                         "(or their directory): merge them into one "
+                         "causal cross-rank fault timeline naming the "
+                         "root-cause rank(s); -o writes the analysis "
+                         "as JSON")
     args = ap.parse_args(argv)
 
-    merged, skew = merge(args.timelines, align=not args.no_align)
+    if args.post_mortem:
+        from horovod_tpu.telemetry import postmortem
+
+        analysis = postmortem.merge_post_mortem(args.timelines)
+        print(postmortem.format_post_mortem(analysis))
+        if args.output != "merged_timeline.json":
+            with open(args.output, "w") as f:
+                json.dump(analysis, f, indent=2)
+            print(f"wrote {args.output}")
+        return 0
+
+    merged, skew = merge(args.timelines, align=not args.no_align,
+                         events_paths=args.events)
     if args.snapshots:
         attach_fault_events(skew, args.snapshots)
     with open(args.output, "w") as f:

@@ -92,9 +92,15 @@ class StepTimer:
         # (tx, tx_logical) transport-byte deltas per step — diverge
         # only under wire compression (core.wire_bytes).
         self.wire_bytes_per_step = []
+        # (intra_tx, intra_tx_logical, cross_tx, cross_tx_logical)
+        # deltas per step: the per-plane split of the same transport
+        # traffic (core.wire_plane_bytes) — cross is the DCN-priced
+        # inter-slice hop of the hierarchical decomposition.
+        self.plane_bytes_per_step = []
         self._t0 = None
         self._bytes0 = None
         self._wire0 = None
+        self._plane0 = None
         self._outputs = None
 
     # -- flops sources --------------------------------------------------
@@ -110,18 +116,19 @@ class StepTimer:
     # -- per-step recording ---------------------------------------------
 
     def _read_bytes(self):
-        # One snapshot serves both the logical-payload and the
-        # wire-vs-logical counters.
+        # One snapshot serves the logical-payload, wire-vs-logical,
+        # and per-plane counters alike.
         try:
             snap = _core.snapshot()
         except Exception:  # noqa: BLE001 — core not built/loaded: the
-            return None, None  # timer still measures wall time and MFU
+            return None, None, None  # timer still measures wall + MFU
         return (_core.total_collective_bytes(
                     snap, op_classes=self.byte_op_classes),
-                _core.wire_bytes(snap))
+                _core.wire_bytes(snap),
+                _core.wire_plane_bytes(snap))
 
     def start_step(self):
-        self._bytes0, self._wire0 = self._read_bytes()
+        self._bytes0, self._wire0, self._plane0 = self._read_bytes()
         self._t0 = time.perf_counter()
 
     def end_step(self, outputs=None):
@@ -135,12 +142,15 @@ class StepTimer:
             except Exception:  # noqa: BLE001 — non-jax outputs
                 pass
         self.step_times.append(time.perf_counter() - self._t0)
-        b1, w1 = self._read_bytes()
+        b1, w1, p1 = self._read_bytes()
         if self._bytes0 is not None and b1 is not None:
             self.bytes_per_step.append(b1 - self._bytes0)
         if self._wire0 is not None and w1 is not None:
             self.wire_bytes_per_step.append(
                 (w1[0] - self._wire0[0], w1[1] - self._wire0[1]))
+        if self._plane0 is not None and p1 is not None:
+            self.plane_bytes_per_step.append(
+                tuple(a - b for a, b in zip(p1, self._plane0)))
         self._t0 = None
 
     class _Step:
@@ -238,6 +248,34 @@ class StepTimer:
         txl = sum(w[1] for w in vals)
         return tx / txl if txl else None
 
+    def plane_wire_summary(self, skip_first=True):
+        """Per-plane transport accounting over the recorded steps:
+        ``{plane: {tx_bytes_per_step, goodput_gbps,
+        compression_ratio}}`` for ``intra`` (ICI-priced/local hops) and
+        ``cross`` (the DCN-priced inter-slice hop the hierarchical
+        decomposition books separately). Per-plane compression is the
+        point: ``HOROVOD_CROSS_PLANE_COMPRESSION`` moves only the cross
+        ratio to ~0.5 while intra stays 1.0, and the two byte streams
+        must sum exactly to the total wire counters (pinned in ``make
+        reshard-smoke``). ``None`` when no plane deltas were recorded."""
+        vals = self.plane_bytes_per_step
+        if skip_first and len(vals) > 1:
+            vals = vals[1:]
+        if not vals:
+            return None
+        dt = self.mean_step_s(skip_first)
+        n = len(vals)
+        out = {}
+        for plane, (itx, itxl) in (("intra", (0, 1)), ("cross", (2, 3))):
+            tx = sum(v[itx] for v in vals)
+            txl = sum(v[itxl] for v in vals)
+            out[plane] = {
+                "tx_bytes_per_step": tx / n,
+                "goodput_gbps": (tx / n / dt / 1e9) if dt else None,
+                "compression_ratio": (tx / txl) if txl else None,
+            }
+        return out
+
     def summary(self):
         """One JSON-ready row of everything the timer knows."""
         snap = None
@@ -255,6 +293,7 @@ class StepTimer:
             "byte_reconciliation": self.byte_reconciliation(),
             "wire_goodput_gbps": self.wire_goodput_gbps(),
             "wire_compression_ratio": self.wire_compression_ratio(),
+            "plane_wire": self.plane_wire_summary(),
         }
         if snap and snap.get("initialized"):
             row["cache_hit_rate"] = snap["cache"]["hit_rate"]

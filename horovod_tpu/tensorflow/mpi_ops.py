@@ -63,6 +63,8 @@ stop_timeline = _basics.stop_timeline
 # every frontend.
 metrics = _basics.metrics_snapshot
 metrics_reset = _basics.metrics_reset
+# Structured event-ring tail (flight recorder, docs/metrics.md).
+events = _basics.events
 
 from horovod_tpu.common.auto_name import make_auto_namer
 

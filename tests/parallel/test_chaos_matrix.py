@@ -592,11 +592,32 @@ def _reshard_kill_worker(rank, size):
     return "ok"
 
 
-def test_kill_mid_redistribute_raises_typed_on_every_survivor():
+def test_kill_mid_redistribute_raises_typed_on_every_survivor(tmp_path):
+    bb_dir = str(tmp_path / "blackbox")
     results = run_chaos(
         _reshard_kill_worker, _RESHARD_SIZE, victims={_RESHARD_VICTIM},
-        env={"HOROVOD_WIRE_TIMEOUT_MS": "2000"})
+        env={"HOROVOD_WIRE_TIMEOUT_MS": "2000",
+             "HOROVOD_BLACKBOX_DIR": bb_dir})
     assert results == {r: "ok" for r in range(_RESHARD_SIZE - 1)}
+    # Black-box post-mortem (docs/metrics.md): every survivor dumped
+    # its event-ring tail the moment it recorded the fault, and the
+    # merged causal timeline names the injected-fault rank as root
+    # cause — proven death, not one of the secondary timeouts the
+    # stall propagated to.
+    from horovod_tpu.telemetry import postmortem
+
+    for r in range(_RESHARD_SIZE - 1):
+        path = os.path.join(bb_dir, f"blackbox-rank{r}.jsonl")
+        assert os.path.exists(path), f"no black-box dump for rank {r}"
+        dumps = postmortem.load_blackbox(path)
+        assert dumps and dumps[-1]["events"], path
+    analysis = postmortem.merge_post_mortem(bb_dir)
+    assert analysis["root_cause_ranks"] == [_RESHARD_VICTIM], analysis[
+        "root_cause_ranks"]
+    assert _RESHARD_VICTIM not in analysis["ranks"]
+    # The injected collective shows up in the merged causal window.
+    types = {e["type"] for e in analysis["timeline"]}
+    assert "fault" in types and "response_launch" in types, types
 
 
 # ---- satellite: reshard_rows rebalances after a world change ---------

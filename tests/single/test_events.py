@@ -1,0 +1,316 @@
+"""The structured event ring + black-box post-mortem pipeline
+(docs/metrics.md): wait-free recording in the core, the two-call
+drain/peek C-ABI, offline dump parsing, root-cause-vs-secondary
+attribution, and the events -> Perfetto rendering.
+
+Multi-rank wire recording is pinned in
+tests/parallel/test_observability.py; this lane covers everything that
+needs no second process.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from horovod_tpu.telemetry import postmortem, report
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture()
+def hvd_core(monkeypatch):
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+              "HOROVOD_LOCAL_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    from horovod_tpu.common import basics
+
+    b = basics.HorovodBasics()
+    b.init()
+    yield b
+    b.shutdown()
+
+
+# ---- ring semantics ---------------------------------------------------
+
+
+def test_events_record_drain_peek(hvd_core):
+    from horovod_tpu.common import eager_ops as ops
+
+    hvd_core.events_drain()  # start from a clean cursor
+    head0 = hvd_core.lib.hvdtpu_events_head()
+    x = np.ones(256, np.float32)
+    for i in range(3):
+        ops.allreduce_async(x, f"ring.{i}").synchronize()
+    evs = [e for e in hvd_core.events() if e["seq"] >= head0]
+    types = [e["type"] for e in evs]
+    assert types.count("response_launch") >= 3
+    assert "negotiate_begin" in types and "negotiate_end" in types
+    # seq strictly increasing; every event timestamped and typed.
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert all(e["ts_us"] > 0 for e in evs)
+    # response_launch carries the negotiated shape bytes + op class.
+    launch = [e for e in evs if e["type"] == "response_launch"][-1]
+    assert launch["op_class"] == 0 and launch["bytes"] == 256 * 4
+    # peek is non-consuming, drain consumes exactly once.
+    tail = hvd_core.events(2)
+    assert len(tail) == 2 and tail == hvd_core.events(2)
+    drained = hvd_core.events_drain()
+    assert [e["seq"] for e in drained] == seqs
+    assert hvd_core.events_drain() == []
+
+
+def test_events_disable_toggle(hvd_core):
+    from horovod_tpu.common import eager_ops as ops
+
+    assert hvd_core.events_enabled()
+    hvd_core.set_events_enabled(False)
+    h0 = hvd_core.lib.hvdtpu_events_head()
+    ops.allreduce_async(np.ones(16, np.float32), "off.0").synchronize()
+    assert hvd_core.lib.hvdtpu_events_head() == h0
+    hvd_core.set_events_enabled(True)
+    ops.allreduce_async(np.ones(16, np.float32), "on.0").synchronize()
+    assert hvd_core.lib.hvdtpu_events_head() > h0
+
+
+def test_ring_selftest_records_plane_tagged_wire_events(hvd_core):
+    """The in-process selftest drives REAL ring transfers: chunk and
+    span events appear, and recording is exercised from several caller
+    threads at once (each plane's thread-local tag)."""
+    hvd_core.events_drain()
+    rc, _ = hvd_core.ring_selftest(4, 20000, chunk_bytes=4096)
+    assert rc == 0
+    evs = hvd_core.events_drain()
+    spans = [e for e in evs if e["type"] == "wire_span"]
+    chunks = [e for e in evs if e["type"] == "wire_chunk"]
+    assert spans and chunks
+    assert all(s["plane"] == 0 for s in spans)
+    assert all(s["tx_bytes"] > 0 for s in spans)
+    assert all(c["len"] > 0 for c in chunks)
+
+
+def test_event_ring_wraps_without_losing_order(hvd_core):
+    """Overfill the ring (capacity 8192) and check the live window is
+    the NEWEST events, still seq-ordered."""
+    from horovod_tpu.common import eager_ops as ops
+
+    x = np.ones(4, np.float32)
+    # Each grouped enqueue negotiates >= 3 events; 3500 rounds laps 8k.
+    for i in range(3500):
+        ops.allreduce_async(x, f"wrap.{i}").synchronize()
+    evs = hvd_core.events()
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    assert len(evs) <= 8192
+    head = hvd_core.lib.hvdtpu_events_head()
+    assert seqs[-1] == head - 1
+    assert seqs[0] >= head - 8192
+
+
+# ---- black-box parsing & post-mortem attribution ---------------------
+
+
+def _write_dump(path, rank, faults, events, unix0=1_700_000_000_000_000,
+                steady0=5_000_000, epoch=0, append=False):
+    """One dump: header + event lines. ``events`` = (ts_us, type,
+    extra-dict) tuples on the rank's steady clock."""
+    lines = [json.dumps({
+        "kind": "blackbox_header", "rank": rank, "size": 4,
+        "epoch": epoch, "unix_us": unix0 + steady0, "steady_us": steady0,
+        "fault": faults})]
+    for seq, (ts, typ, extra) in enumerate(events):
+        lines.append(json.dumps(
+            {"seq": seq, "ts_us": ts, "type": typ, **extra}))
+    with open(path, "a" if append else "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def test_postmortem_root_cause_vs_secondary(tmp_path):
+    """Certain attribution of a rank with NO dump = root-cause death;
+    any naming of a rank that dumped = secondary timeout (it was alive
+    — the r12 teardown race writes false EOF attributions)."""
+    # Rank 3 was SIGKILLed: ranks 0/1 prove it (EOF), rank 2 timed out
+    # blaming its quiet neighbor 1 — which dumped, so it is alive.
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0,
+                {"kind": "peer", "certain": True, "ranks": [3]},
+                [(1000, "response_launch",
+                  {"op_class": 0, "device": 0, "tensors": 1,
+                   "bytes": 64}),
+                 (2000, "fault", {"kind": 0, "certain": 1, "epoch": 0,
+                                  "fault_rank": 3})])
+    _write_dump(tmp_path / "blackbox-rank1.jsonl", 1,
+                {"kind": "peer", "certain": True, "ranks": [3]},
+                [(900, "negotiate_end", {"responses": 1, "shutdown": 0}),
+                 (2100, "fault", {"kind": 0, "certain": 1, "epoch": 0,
+                                  "fault_rank": 3})])
+    _write_dump(tmp_path / "blackbox-rank2.jsonl", 2,
+                {"kind": "peer", "certain": False, "ranks": [1]},
+                [(1500, "negotiate_end", {"responses": 1, "shutdown": 0}),
+                 (2500, "fault", {"kind": 0, "certain": 0, "epoch": 0,
+                                  "fault_rank": 1})])
+    analysis = postmortem.merge_post_mortem(str(tmp_path))
+    assert analysis["root_cause_ranks"] == [3]
+    assert analysis["secondary_suspects"] == [1]
+    assert analysis["ranks"] == [0, 1, 2]
+    text = postmortem.format_post_mortem(analysis)
+    assert "root cause: rank(s) [3]" in text
+    assert "secondary timeouts" in text
+
+
+def test_postmortem_corruption_names_live_peer(tmp_path):
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0,
+                {"kind": "corruption", "certain": False, "ranks": [1]},
+                [(100, "crc_error", {"sender": 1, "fails": 3, "chunk": 7}),
+                 (200, "fault", {"kind": 1, "certain": 0, "epoch": 0,
+                                 "fault_rank": 1})])
+    _write_dump(tmp_path / "blackbox-rank1.jsonl", 1,
+                {"kind": "peer", "certain": False, "ranks": [0]},
+                [(150, "negotiate_end", {"responses": 1, "shutdown": 0})])
+    analysis = postmortem.merge_post_mortem(str(tmp_path))
+    # The corrupting link's sender is the root cause even though its
+    # process is alive (and dumped); it is never "secondary".
+    assert analysis["root_cause_ranks"] == [1]
+    assert analysis["secondary_suspects"] == [0]
+
+
+def test_postmortem_first_stalled_cutoff(tmp_path):
+    """Progress after the stall surfaced (retry windows began) must not
+    mask who froze first."""
+    # Rank 1 froze at t=1000 then resumed late and did more work; rank
+    # 0 kept launching until t=1900, then rode the retry ladder.
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0,
+                {"kind": "peer", "certain": False, "ranks": [1]},
+                [(1000, "response_launch",
+                  {"op_class": 0, "device": 0, "tensors": 1, "bytes": 8}),
+                 (1900, "response_launch",
+                  {"op_class": 0, "device": 0, "tensors": 1, "bytes": 8}),
+                 (2500, "retry_window", {"attempt": 0, "window_ms": 250}),
+                 (4000, "fault", {"kind": 0, "certain": 0, "epoch": 0,
+                                  "fault_rank": 1})])
+    _write_dump(tmp_path / "blackbox-rank1.jsonl", 1,
+                {"kind": "peer", "certain": False, "ranks": [0]},
+                [(1000, "response_launch",
+                  {"op_class": 0, "device": 0, "tensors": 1, "bytes": 8}),
+                 # resumed AFTER rank 0's ladder began: doesn't count
+                 (3000, "response_launch",
+                  {"op_class": 0, "device": 0, "tensors": 1, "bytes": 8}),
+                 (4100, "fault", {"kind": 0, "certain": 0, "epoch": 0,
+                                  "fault_rank": 0})])
+    analysis = postmortem.merge_post_mortem(str(tmp_path))
+    assert analysis["root_cause_ranks"] == []  # nobody provably died
+    assert analysis["first_stalled_rank"] == 1
+    # timeline is wall-merged and monotonic
+    walls = [e["wall_us"] for e in analysis["timeline"]]
+    assert walls == sorted(walls) and len(walls) == 7
+
+
+def test_load_blackbox_multiple_dumps_and_torn_tail(tmp_path):
+    p = tmp_path / "blackbox-rank0.jsonl"
+    _write_dump(p, 0, {"kind": "peer", "certain": True, "ranks": [2]},
+                [(10, "wire_heal", {})], epoch=0)
+    _write_dump(p, 0, {"kind": "peer", "certain": True, "ranks": [1]},
+                [(20, "wire_heal", {})], epoch=1, append=True)
+    with open(p, "a") as f:
+        f.write('{"seq": 99, "ts_us": 30, "type": "trunc')  # died here
+    dumps = postmortem.load_blackbox(str(p))
+    assert len(dumps) == 2
+    assert dumps[0]["header"]["epoch"] == 0
+    assert dumps[1]["header"]["epoch"] == 1
+    assert len(dumps[1]["events"]) == 1  # torn line dropped
+    # merge picks the LATEST dump by default
+    analysis = postmortem.merge_post_mortem(str(tmp_path))
+    assert analysis["root_cause_ranks"] == [1]
+
+
+# ---- events -> Perfetto ----------------------------------------------
+
+
+def test_events_fold_into_perfetto_merge(tmp_path):
+    """--events renders ring dumps as extra tracks on the merged trace,
+    wall-aligned against the timelines' CLOCK_SYNC anchors."""
+    sync0 = 1_700_000_000_000_000
+    tl = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "rank 0"}},
+        {"name": "CLOCK_SYNC", "ph": "i", "ts": 0, "pid": 0, "tid": 0,
+         "s": "p", "args": {"unix_us": sync0, "rank": 0}},
+        {"name": "NEGOTIATE", "ph": "B", "ts": 500, "pid": 0, "tid": 1,
+         "args": {"tensor": "g0"}},
+        {"name": "NEGOTIATE", "ph": "E", "ts": 900, "pid": 0, "tid": 1,
+         "args": {"tensor": "g0"}},
+    ]
+    tl_path = tmp_path / "tl.0.json"
+    tl_path.write_text(json.dumps(tl))
+    # A dump whose steady clock origin differs: event at steady 7000
+    # with anchor (steady 5000 -> wall sync0 + 600) = wall sync0+2600.
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0,
+                {"kind": "peer", "certain": True, "ranks": [1]},
+                [(7000, "wire_span",
+                  {"plane": 1, "dur_us": 400, "tx_bytes": 64,
+                   "rx_bytes": 64}),
+                 (7100, "wire_heal", {})],
+                unix0=sync0 + 600 - 5000, steady0=5000)
+    merged, _skew = report.merge(
+        [str(tl_path)],
+        events_paths=[str(tmp_path / "blackbox-rank0.jsonl")])
+    spans = [e for e in merged if e.get("name", "").startswith(
+        "wire_span")]
+    assert len(spans) == 1
+    # ts = wall - base = 2600, rendered as an X span ending there.
+    assert spans[0]["ph"] == "X"
+    assert spans[0]["ts"] + spans[0]["dur"] == 2600
+    assert spans[0]["pid"] == 0 and spans[0]["args"]["plane"] == 1
+    insts = [e for e in merged if e.get("name") == "wire_heal"]
+    assert insts and insts[0]["ts"] == 2700
+    # the events lane is labeled
+    assert any(e.get("name") == "thread_name" and
+               e["args"]["name"] == "events" for e in merged)
+
+
+def test_events_fold_anchors_per_rank_without_alignment(tmp_path):
+    """With align=False (or the NEGOTIATE fallback) per-rank offsets
+    are NOT sync_r - min(sync): each dump must anchor against ITS OWN
+    rank's trace (base = sync_r - offset_r), or the event tracks shear
+    off the op spans they annotate."""
+    for rank, sync in ((0, 10_000_000), (1, 11_000_000)):
+        tl = [
+            {"name": "CLOCK_SYNC", "ph": "i", "ts": 0, "pid": rank,
+             "s": "p", "args": {"unix_us": sync, "rank": rank}},
+            {"name": "OP", "ph": "X", "ts": 500, "dur": 100,
+             "pid": rank, "args": {}},
+        ]
+        (tmp_path / f"tl.{rank}.json").write_text(json.dumps(tl))
+        _write_dump(tmp_path / f"blackbox-rank{rank}.jsonl", rank,
+                    {"kind": "peer", "certain": True, "ranks": [1]},
+                    [(4900, "wire_heal", {})],
+                    unix0=sync + 600 - 5000, steady0=5000)
+    paths = [str(tmp_path / "tl.0.json"), str(tmp_path / "tl.1.json")]
+    for align in (True, False):
+        merged, _ = report.merge(paths, align=align,
+                                 events_paths=[str(tmp_path)])
+        # Each ring event (wall = sync_r + 500) lands at its own
+        # trace's t=500 coordinate plus that rank's offset — offsets
+        # are 0 when not aligning, sync_r - min(sync) when aligning.
+        want = {0: 500, 1: 500 + (1_000_000 if align else 0)}
+        got = {e["pid"]: e["ts"] for e in merged
+               if e.get("name") == "wire_heal"}
+        assert got == want, (align, got, want)
+
+
+def test_report_post_mortem_cli(tmp_path, capsys):
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0,
+                {"kind": "peer", "certain": True, "ranks": [1],
+                 "detect_ms": 12, "reason": "eof"},
+                [(100, "negotiate_begin", {"requests": 1}),
+                 (300, "fault", {"kind": 0, "certain": 1, "epoch": 0,
+                                 "fault_rank": 1})])
+    out_json = tmp_path / "analysis.json"
+    rc = report.main(["--post-mortem", str(tmp_path / "blackbox-rank0.jsonl"),
+                      "-o", str(out_json)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "root cause: rank(s) [1]" in out
+    assert out_json.exists()
+    saved = json.loads(out_json.read_text())
+    assert saved["root_cause_ranks"] == [1]

@@ -56,6 +56,11 @@ _DRIVER = textwrap.dedent("""
             # Metrics snapshot from an API thread while the background
             # loop records into the registry (the r9 read path).
             b.metrics_snapshot()
+            # Event-ring readers (consuming drain + non-consuming peek)
+            # concurrent with the loop's and the ring engine's wait-free
+            # writers — the r15 flight-recorder read path.
+            b.events_drain()
+            b.events(64)
             b.stop_timeline()
             i += 1
 
@@ -144,6 +149,61 @@ _FAULT_DRIVER = textwrap.dedent("""
 """)
 
 
+# Event-ring churn lane (r15): concurrent events_drain/peek + metrics
+# snapshots + the ring selftest's multi-plane writers, WHILE the main
+# thread hammers healthy-loop reinit epoch bumps — every reinit joins
+# and restarts the background thread, re-records epoch/reinit events,
+# and the drain cursor must stay consistent through the churn. The
+# ring's slots are all atomics by design; this pins that property.
+_EVENTS_REINIT_DRIVER = textwrap.dedent("""
+    import os, threading
+    import numpy as np
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+              "HOROVOD_LOCAL_SIZE"):
+        os.environ.pop(k, None)
+    from horovod_tpu.common import basics
+    from horovod_tpu.common import eager_ops as ops
+
+    b = basics.HorovodBasics()
+    b.init()
+    stop = threading.Event()
+    drained = [0]
+
+    def drainer():
+        while not stop.is_set():
+            drained[0] += len(b.events_drain())
+            b.events(32)
+            b.metrics_snapshot()
+
+    def ring_hammer():
+        for i in range(4):
+            rc, _err = b.ring_selftest(4, 8000, chunk_bytes=1024,
+                                       compression=(i % 2 == 1))
+            assert rc == 0, (i, rc)
+
+    t = threading.Thread(target=drainer)
+    rh = threading.Thread(target=ring_hammer)
+    t.start()
+    rh.start()
+    epoch = 0
+    for i in range(6):
+        epoch += 1
+        # Healthy-loop reinit (negotiated shutdown; a size-1 world is
+        # legal): epoch bump + bg-thread restart under reader churn.
+        b.reinit([0], epoch)
+        x = np.full(64, float(epoch), np.float32)
+        out = ops.allreduce_async(x, f"e{epoch}").synchronize()
+        assert (out == x).all()
+    rh.join()
+    stop.set()
+    t.join()
+    assert b.epoch() == epoch
+    assert drained[0] > 0
+    b.shutdown()
+    print("EVENTS_SMOKE_OK")
+""")
+
+
 def _tsan_env():
     runtime = _find_tsan_runtime()
     if runtime is None:
@@ -223,3 +283,25 @@ def test_tsan_multithreaded_allreduce_smoke():
     assert "WARNING: ThreadSanitizer" not in out, out[-4000:]
     assert proc.returncode == 0, out[-2000:]
     assert "SMOKE_OK" in out
+
+
+def test_tsan_events_drain_snapshot_reinit_hammer():
+    """Concurrent events_drain/peek + metrics snapshots + multi-plane
+    ring writers while the main thread bumps epochs through healthy
+    reinit — the event ring must be TSan-clean under churn (r15
+    acceptance)."""
+    if not os.path.exists(TSAN_LIB):
+        pytest.skip("TSan core not built (run `make core-tsan`)")
+    env = _tsan_env()
+    if env is None:
+        pytest.skip("no libtsan runtime on this host")
+    proc = subprocess.run([sys.executable, "-c", _EVENTS_REINIT_DRIVER],
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+    out = proc.stdout + proc.stderr
+    if proc.returncode != 0 and "ThreadSanitizer" not in out:
+        pytest.skip(f"TSan subprocess unusable on this host: "
+                    f"rc={proc.returncode} {out[-400:]}")
+    assert "WARNING: ThreadSanitizer" not in out, out[-4000:]
+    assert proc.returncode == 0, out[-2000:]
+    assert "EVENTS_SMOKE_OK" in out

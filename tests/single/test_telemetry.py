@@ -295,6 +295,116 @@ def test_scraper_exporters(tmp_path, hvd_core):
     assert "hvdtpu_cache_hit_rate" in text
 
 
+def test_prom_flattening_covers_fully_populated_snapshot():
+    """Audit of the Prometheus flattening against a snapshot with EVERY
+    section populated: the r13/r14 additions (elastic heal/retry/CRC/
+    rejoin counters, per-plane wire.cross_* bytes) must all surface as
+    samples — a section silently dropped by the flattener is an
+    alerting blind spot, which is how the elastic counters shipped two
+    rounds without an exporter row."""
+    from horovod_tpu.telemetry.exporters import _flatten_prom
+
+    hist = {"count": 3, "sum_us": 30, "min_us": 5, "max_us": 20,
+            "p50_us": 10, "p90_us": 20, "p99_us": 20}
+    snap = {
+        "initialized": True, "rank": 2, "size": 4,
+        "ops": {"allreduce": {"responses": 5, "tensors": 7,
+                              "bytes": 4096}},
+        "device_ops": {"allgather": {"responses": 1, "tensors": 1,
+                                     "bytes": 64}},
+        "negotiation_us": hist, "queue_us": hist, "wire_us": hist,
+        "fusion": {"fused_responses": 2, "fill_bytes": 100,
+                   "capacity_bytes": 400, "fill_ratio": 0.25},
+        "cycle": {"count": 9, "stalls": 1, "overrun_us": 12},
+        "cache": {"hits": 3, "misses": 1, "entries": 2, "hit_bytes": 99,
+                  "hit_rate": 0.75},
+        "straggler": {"last_rank_counts": [0, 2, 0, 1],
+                      "skew_us": hist},
+        "wire": {"tx_bytes": 1000, "rx_bytes": 1000,
+                 "tx_logical_bytes": 2000, "rx_logical_bytes": 2000,
+                 "compression_ratio": 0.5,
+                 "cross_tx_bytes": 250, "cross_rx_bytes": 250,
+                 "cross_tx_logical_bytes": 500,
+                 "cross_rx_logical_bytes": 500,
+                 "cross_compression_ratio": 0.5},
+        "elastic": {"epoch": 3, "faults_detected": 2,
+                    "faults_recovered": 1, "ranks_blacklisted": 1,
+                    "ranks_rejoined": 1, "heals": 4, "retries": 6,
+                    "crc_errors": 2, "detect_us": hist},
+        "errors": 1,
+        "knobs": {"fusion_threshold_bytes": 1024},
+    }
+    text = _flatten_prom(snap, snap["rank"])
+    expected = [
+        'hvdtpu_wire_cross_tx_bytes_total{rank="2"} 250',
+        'hvdtpu_wire_cross_rx_bytes_total{rank="2"} 250',
+        'hvdtpu_wire_cross_tx_logical_bytes_total{rank="2"} 500',
+        'hvdtpu_wire_cross_rx_logical_bytes_total{rank="2"} 500',
+        'hvdtpu_wire_cross_compression_ratio{rank="2"} 0.5',
+        'hvdtpu_elastic_heals_total{rank="2"} 4',
+        'hvdtpu_elastic_retries_total{rank="2"} 6',
+        'hvdtpu_elastic_crc_errors_total{rank="2"} 2',
+        'hvdtpu_elastic_ranks_rejoined_total{rank="2"} 1',
+        'hvdtpu_elastic_faults_detected_total{rank="2"} 2',
+        'hvdtpu_elastic_faults_recovered_total{rank="2"} 1',
+        'hvdtpu_elastic_ranks_blacklisted_total{rank="2"} 1',
+        'hvdtpu_elastic_epoch{rank="2"} 3',
+        'hvdtpu_elastic_detect_p99_us{rank="2"} 20',
+        'hvdtpu_wire_tx_bytes_total{rank="2"} 1000',
+        'hvdtpu_straggler_last_total{rank="2",straggler="1"} 2',
+        'hvdtpu_errors_total{rank="2"} 1',
+    ]
+    for line in expected:
+        assert line in text, f"missing exporter row: {line}"
+    # Every line is well-formed text-format: "name{labels} value".
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        assert name and not name.endswith("{"), line
+        float(value)
+
+
+def test_step_timer_per_plane_wire_split(monkeypatch):
+    """plane_wire_summary splits the transport deltas intra vs cross
+    and reconciles per-plane compression independently (cross-hop-only
+    bf16: cross ratio 0.5, intra 1.0, intra+cross == total)."""
+    from horovod_tpu.telemetry import core as tcore
+
+    snaps = []
+    # Per step: total tx grows 1200 (logical 1600); the cross slice of
+    # it grows 200 (logical 400) -> intra 1000/1200, cross 200/400.
+    for i in range(6):
+        snaps.append({
+            "initialized": True, "rank": 0, "size": 2, "ops": {},
+            "device_ops": {},
+            "cache": {"hit_rate": 0.0}, "cycle": {"stalls": 0},
+            "wire": {"tx_bytes": 1200 * i, "rx_bytes": 1200 * i,
+                     "tx_logical_bytes": 1600 * i,
+                     "rx_logical_bytes": 1600 * i,
+                     "cross_tx_bytes": 200 * i,
+                     "cross_rx_bytes": 200 * i,
+                     "cross_tx_logical_bytes": 400 * i,
+                     "cross_rx_logical_bytes": 400 * i},
+        })
+    it = iter(snaps + snaps[-1:] * 4)
+    monkeypatch.setattr(tcore, "snapshot", lambda: next(it))
+    timer = telemetry.StepTimer(block=False)
+    for _ in range(3):
+        timer.start_step()
+        timer.end_step()
+    planes = timer.plane_wire_summary(skip_first=False)
+    assert planes["intra"]["tx_bytes_per_step"] == 1000
+    assert planes["intra"]["compression_ratio"] == pytest.approx(1000 / 1200)
+    assert planes["cross"]["tx_bytes_per_step"] == 200
+    assert planes["cross"]["compression_ratio"] == pytest.approx(0.5)
+    # intra + cross reconcile exactly with the total wire counters.
+    total = timer.wire_bytes_per_step
+    for (tx, _txl), p in zip(total, timer.plane_bytes_per_step):
+        assert p[0] + p[2] == tx
+    assert "plane_wire" in timer.summary()
+
+
 # ---- cross-rank trace merge -------------------------------------------
 
 
