@@ -23,7 +23,7 @@ ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
 .PHONY: core tf clean test test-quick test-flaky lint lint-csrc \
   core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
-  reshard-smoke chaos-smoke obs-smoke
+  reshard-smoke chaos-smoke obs-smoke scale-smoke
 
 core: $(OUT)
 
@@ -95,7 +95,7 @@ test: core
 # Sub-5-minute lane: core runtime units, the multi-rank eager-ops file,
 # and the elastic driver path (the full suite is ~25 min).
 test-quick: core
-	python -m pytest tests/ -m quick -x -q
+	python -m pytest tests/ -m "quick and not slow" -x -q
 
 # Rerun the load-flaky tests STANDALONE (serial, nothing else competing
 # for the box): the loadflaky-marked cases are timing-sensitive under
@@ -147,6 +147,16 @@ chaos-smoke: core
 # horovod_tpu/telemetry/obs_smoke.py; ~20 s).
 obs-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.obs_smoke
+
+# Large-world smoke: one 64-rank simulated world (thread-per-rank over
+# socketpairs, csrc/simworld.cc) runs a negotiation + allreduce round
+# in BOTH gather modes (flat star vs HOROVOD_CONTROL_TREE) with the
+# per-phase control-plane latency rows emitted, then an injected kill
+# surfaces typed attribution on all 63 survivors and the streaming
+# post-mortem merge over their dumps names the dead rank as root cause
+# (docs/scale.md; horovod_tpu/simworld/scale_smoke.py; ~15 s).
+scale-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.simworld.scale_smoke
 
 # Cross-plane + redistribute smoke: 4 real ranks emulate 2 slices x 2
 # chips under HOROVOD_CROSS_PLANE=hier — hierarchical train-step parity

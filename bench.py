@@ -621,6 +621,23 @@ b.shutdown()
 """
 
 
+def _control_plane_scaling_rows(world_sizes=None):
+    """The `control_plane_scaling` rows (docs/scale.md): flat-vs-tree
+    negotiation latency curves from the simulated large-world harness
+    (csrc/simworld.cc — thread-per-rank, in-process, no accelerator).
+    Both curves per world size, so the tree gather's sub-linear claim
+    is checkable against the sequential baseline from the same run."""
+    from horovod_tpu.simworld import scaling_profile
+
+    try:
+        return scaling_profile(world_sizes=world_sizes) \
+            if world_sizes else scaling_profile()
+    except Exception as e:  # noqa: BLE001 — a starved CI box must not
+        # lose the rest of the bench run to the 256-thread point
+        return [{"metric": "control_plane_scaling",
+                 "error": f"{type(e).__name__}: {e}"}]
+
+
 def _events_overhead_rows(ranks=2, tensors=183, elems=2048, steps=8,
                           repeats=3):
     """Event-ring overhead on the eager ungrouped lane: `tensors` small
@@ -1162,6 +1179,12 @@ def main():
         for row in _events_overhead_rows():
             emit(row)
         return
+    if "--scale" in argv:
+        # Standalone control-plane scaling curves (no accelerator):
+        # the full 8..256 ladder, flat star vs tree gather.
+        for row in _control_plane_scaling_rows():
+            emit(row)
+        return
     if "--ring-busbw" in argv:
         # Standalone host-ring transport sweep (no accelerator needed),
         # including the cross-plane hierarchical rows (dense/hier lane).
@@ -1223,6 +1246,8 @@ def main():
             emit(row)
         for row in _events_overhead_rows():
             emit(row)
+        for row in _control_plane_scaling_rows():
+            emit(row)
         emit(_smoke_row())
         return
 
@@ -1233,6 +1258,8 @@ def main():
     for row in _hier_busbw_rows():
         emit(row)
     for row in _events_overhead_rows():
+        emit(row)
+    for row in _control_plane_scaling_rows():
         emit(row)
 
     flagship_row, flagship_extras = _flagship_row()

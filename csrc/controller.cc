@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cstdio>
+#include <cstring>
 #include <sstream>
 
 #include "logging.h"
@@ -24,7 +25,36 @@ struct Hello {
   int32_t epoch_hi;
   char addr[64];
   int32_t port;
+  // Control-tree listen port of this rank (HOROVOD_CONTROL_TREE):
+  // interior workers accept their tree children here. 0 = not an
+  // interior worker (leaf, rank 0, or tree mode off).
+  int32_t tree_port;
 };
+
+// Bundle format for the tree gather: one wire frame holding a sequence
+// of [u32 LE len][serialized RequestList] entries. A relay appends its
+// children's bundles VERBATIM after its own entry — no re-parse on the
+// way up; only the coordinator unpacks.
+void AppendBundleEntry(std::string* bundle, const std::string& frame) {
+  uint32_t len = (uint32_t)frame.size();
+  bundle->append(reinterpret_cast<const char*>(&len), sizeof(len));
+  bundle->append(frame);
+}
+
+bool SplitBundle(const std::string& bundle,
+                 std::vector<std::string>* frames) {
+  size_t off = 0;
+  while (off < bundle.size()) {
+    if (off + sizeof(uint32_t) > bundle.size()) return false;
+    uint32_t len;
+    std::memcpy(&len, bundle.data() + off, sizeof(len));
+    off += sizeof(len);
+    if (off + len > bundle.size()) return false;
+    frames->emplace_back(bundle.substr(off, len));
+    off += len;
+  }
+  return true;
+}
 
 void SetHelloEpoch(Hello* h, int64_t epoch) {
   h->epoch_lo = (int32_t)(epoch & 0xffffffff);
@@ -79,6 +109,23 @@ Controller::Controller(ControllerConfig cfg) : cfg_(std::move(cfg)) {
 
 Controller::~Controller() {
   for (int fd : control_fds_) TcpClose(fd);
+  for (int fd : tree_owned_fds_) TcpClose(fd);
+}
+
+std::vector<int> Controller::TreeChildren(int r) const {
+  std::vector<int> out;
+  if (cfg_.tree_fanout < 2) return out;
+  for (int i = 1; i <= cfg_.tree_fanout; i++) {
+    int c = r * cfg_.tree_fanout + i;
+    if (c < cfg_.size) out.push_back(c);
+  }
+  return out;
+}
+
+int Controller::SubtreeSize(int r) const {
+  int n = 1;
+  for (int c : TreeChildren(r)) n += SubtreeSize(c);
+  return n;
 }
 
 Status Controller::Initialize() {
@@ -87,6 +134,9 @@ Status Controller::Initialize() {
     data_plane_ = std::make_unique<DataPlane>(0, 1, std::vector<int>{-1});
     return Status::OK();
   }
+  // Rendezvous fan-in is the first O(N) control-plane suspect on the
+  // scaling profile (docs/scale.md) — time the whole bootstrap.
+  const int64_t rdzv_start_us = MetricsNowUs();
 
   if (cfg_.use_external_transport) {
     // Bare-MPI mode: no rendezvous, no sockets. Ranks and sizes come
@@ -114,17 +164,34 @@ Status Controller::Initialize() {
     return Status::OK();
   }
 
-  // 1) Data-plane listen socket (ephemeral port).
+  // 1) Data-plane listen socket (ephemeral port), plus the control-tree
+  // listen socket when this rank is an interior tree worker (its tree
+  // children connect here; the port rides the hello/address book).
   int data_port = 0;
   int data_listen = TcpListen(&data_port);
   if (data_listen < 0) return Status::Error("failed to open data-plane port");
   std::string my_addr = LocalAddress();
+  const bool tree = TreeEnabled();
+  std::vector<int> my_tree_children = tree ? TreeChildren(rank)
+                                           : std::vector<int>();
+  int tree_port = 0, tree_listen = -1;
+  if (tree && rank != 0 && !my_tree_children.empty()) {
+    tree_listen = TcpListen(&tree_port);
+    if (tree_listen < 0) {
+      TcpClose(data_listen);
+      return Status::Error("failed to open control-tree port");
+    }
+  }
   // Full-mesh peer fds, filled in step 3 (declared here so the error
   // cleanup covers every return below; -1 entries are no-ops to close).
   std::vector<int> peers(size, -1);
+  // Tree edges built in step 4; owned here until handoff.
+  std::vector<int> tree_fds;
   Cleanup cleanup{[&] {
     TcpClose(data_listen);
+    TcpClose(tree_listen);
     for (int fd : peers) TcpClose(fd);
+    for (int fd : tree_fds) TcpClose(fd);
   }};
 
   // 2) Control-plane rendezvous + address-book broadcast. Bootstrap
@@ -149,7 +216,7 @@ Status Controller::Initialize() {
                            std::to_string(cfg_.controller_port));
     }
     control_fds_.assign(size, -1);
-    Hello mine{0, 0, 0, {0}, data_port};
+    Hello mine{0, 0, 0, {0}, data_port, 0};
     SetHelloEpoch(&mine, cfg_.epoch);
     snprintf(mine.addr, sizeof(mine.addr), "%s", my_addr.c_str());
     book[0] = mine;
@@ -206,7 +273,7 @@ Status Controller::Initialize() {
                            std::to_string(cfg_.controller_port));
     }
     RegisterFdRank(fd, 0);
-    Hello mine{(int32_t)rank, 0, 0, {0}, data_port};
+    Hello mine{(int32_t)rank, 0, 0, {0}, data_port, tree_port};
     SetHelloEpoch(&mine, cfg_.epoch);
     snprintf(mine.addr, sizeof(mine.addr), "%s", my_addr.c_str());
     Status s = SendAll(fd, &mine, sizeof(mine), remaining_ms());
@@ -260,11 +327,103 @@ Status Controller::Initialize() {
     RegisterFdRank(fd, (int)who[0]);
     connected++;
   }
+  // 4) Control-tree edges (HOROVOD_CONTROL_TREE). Edges touching rank
+  // 0 reuse the star sockets; a deeper child connects to its parent's
+  // tree port from the book. Children connect upward, parents accept —
+  // acyclic, so no connect/accept deadlock.
+  if (tree) {
+    const int parent = TreeParent(rank);
+    if (rank != 0) {
+      if (parent == 0) {
+        tree_parent_fd_ = control_fds_[0];  // shared with the star
+      } else {
+        int fd = TcpConnect(book[parent].addr, book[parent].tree_port,
+                            (int)remaining_ms());
+        if (fd < 0) {
+          return Status::Error("control-tree connect to rank " +
+                               std::to_string(parent) + " failed");
+        }
+        tree_fds.push_back(fd);
+        int64_t me[2] = {(int64_t)rank, cfg_.epoch};
+        Status s = SendAll(fd, me, sizeof(me), remaining_ms());
+        if (!s.ok()) return s;
+        RegisterFdRank(fd, parent);
+        tree_parent_fd_ = fd;
+      }
+    }
+    if (rank == 0) {
+      for (int c : my_tree_children) {
+        tree_children_.emplace_back(c, control_fds_[c]);
+      }
+    } else {
+      std::vector<int> child_fd(size, -1);
+      int accepted = 0;
+      while (accepted < (int)my_tree_children.size()) {
+        int fd = TcpAcceptTimeout(tree_listen, remaining_ms());
+        if (fd < 0) {
+          return Status::Error(
+              "control-tree rendezvous timed out with " +
+              std::to_string((int)my_tree_children.size() - accepted) +
+              " child(ren) missing (HOROVOD_START_TIMEOUT)");
+        }
+        int64_t who[2] = {-1, -1};
+        Status s = RecvAll(fd, who, sizeof(who), remaining_ms());
+        bool expected = s.ok() && who[1] == cfg_.epoch;
+        if (expected) {
+          expected = false;
+          for (int c : my_tree_children) expected |= c == (int)who[0];
+          expected = expected && child_fd[who[0]] == -1;
+        }
+        if (!expected) {
+          LOG_WARN("rejecting control-tree hello from rank %lld epoch "
+                   "%lld", (long long)who[0], (long long)who[1]);
+          TcpClose(fd);
+          continue;
+        }
+        child_fd[who[0]] = fd;
+        tree_fds.push_back(fd);
+        RegisterFdRank(fd, (int)who[0]);
+        accepted++;
+      }
+      for (int c : my_tree_children) {
+        tree_children_.emplace_back(c, child_fd[c]);
+      }
+    }
+  }
   cleanup.release();  // mesh complete: the DataPlane owns the fds now
+  tree_owned_fds_ = std::move(tree_fds);  // closed by the destructor
   TcpClose(data_listen);
+  TcpClose(tree_listen);
   data_plane_ = std::make_unique<DataPlane>(rank, size, std::move(peers));
-  LOG_DEBUG("rank %d: control+data planes up (size=%d, epoch=%lld)", rank,
-            size, (long long)cfg_.epoch);
+  RecordControlPhase(kPhaseRendezvous, MetricsNowUs() - rdzv_start_us);
+  LOG_DEBUG("rank %d: control+data planes up (size=%d, epoch=%lld, "
+            "tree_fanout=%d)", rank, size, (long long)cfg_.epoch,
+            cfg_.tree_fanout);
+  return Status::OK();
+}
+
+Status Controller::InitializeFromFds(
+    std::vector<int> control_fds, std::vector<int> peer_fds,
+    int tree_parent_fd, std::vector<std::pair<int, int>> tree_children) {
+  control_fds_ = std::move(control_fds);
+  if (TreeEnabled()) {
+    if (cfg_.rank == 0) {
+      for (int c : TreeChildren(0)) {
+        tree_children_.emplace_back(c, control_fds_[c]);
+      }
+    } else {
+      if (tree_parent_fd >= 0) {
+        tree_parent_fd_ = tree_parent_fd;
+        tree_owned_fds_.push_back(tree_parent_fd);
+      } else {
+        tree_parent_fd_ = control_fds_[0];  // parent is the coordinator
+      }
+      tree_children_ = std::move(tree_children);
+      for (auto& kv : tree_children_) tree_owned_fds_.push_back(kv.second);
+    }
+  }
+  data_plane_ = std::make_unique<DataPlane>(cfg_.rank, cfg_.size,
+                                            std::move(peer_fds));
   return Status::OK();
 }
 
@@ -840,6 +999,7 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
 
   RequestList my_list = BuildRequestList(std::move(requests), should_shutdown);
   my_list.epoch = cfg_.epoch;
+  my_list.rank = cfg_.rank;
   // Control-plane deadline: the per-cycle gather/bcast round IS the
   // heartbeat (idle workers still send an empty list every cycle), so
   // bounding each frame bounds failure detection.
@@ -859,30 +1019,44 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     std::vector<int64_t> evictions;
     HandleCacheBits(my_list, 0, &evictions);
     HandleRequestList(my_list, 0);
-    for (int r = 1; r < cfg_.size; r++) {
-      std::string frame;
-      Status s = RecvFrame(control_fds_[r], &frame, hb_ms);
-      RequestList rl;
-      if (s.ok()) {
-        s = ParseRequestList(frame, &rl);
-        if (s.ok() && rl.epoch != cfg_.epoch) {
-          s = Status::PeerFailure(
-              r, "rank " + std::to_string(r) + " sent a stale-epoch " +
-                     "request (epoch " + std::to_string(rl.epoch) +
-                     ", current " + std::to_string(cfg_.epoch) + ")");
-        }
-      } else if (!s.peer_failure()) {
-        s = Status::PeerFailure(r, "control-plane gather from rank " +
-                                       std::to_string(r) +
-                                       " failed: " + s.reason());
-      }
+    // The gather is THE O(N) coordinator suspect at large worlds:
+    // per-cycle latency lands on the control_phase profile either way,
+    // so the flat-vs-tree scaling curves come from one instrumentation
+    // site (docs/scale.md).
+    const int64_t gather_t0 = MetricsNowUs();
+    if (TreeEnabled()) {
+      Status s = TreeCoordinatorGather(hb_ms, &evictions);
       if (!s.ok()) {
         BroadcastFaultNotice(s);
         return s;
       }
-      HandleCacheBits(rl, r, &evictions);
-      HandleRequestList(rl, r);
+    } else {
+      for (int r = 1; r < cfg_.size; r++) {
+        std::string frame;
+        Status s = RecvFrame(control_fds_[r], &frame, hb_ms);
+        RequestList rl;
+        if (s.ok()) {
+          s = ParseRequestList(frame, &rl);
+          if (s.ok() && rl.epoch != cfg_.epoch) {
+            s = Status::PeerFailure(
+                r, "rank " + std::to_string(r) + " sent a stale-epoch " +
+                       "request (epoch " + std::to_string(rl.epoch) +
+                       ", current " + std::to_string(cfg_.epoch) + ")");
+          }
+        } else if (!s.peer_failure()) {
+          s = Status::PeerFailure(r, "control-plane gather from rank " +
+                                         std::to_string(r) +
+                                         " failed: " + s.reason());
+        }
+        if (!s.ok()) {
+          BroadcastFaultNotice(s);
+          return s;
+        }
+        HandleCacheBits(rl, r, &evictions);
+        HandleRequestList(rl, r);
+      }
     }
+    const int64_t gather_dur_us = MetricsNowUs() - gather_t0;
     CheckForStalledTensors();
     ResponseList list;
     list.epoch = cfg_.epoch;
@@ -892,6 +1066,14 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     // same way MaybePromote does for full requests.
     CollectCacheHits(&list);
     list.responses = FuseResponses().responses;
+    // Idle cycles (nothing negotiated, no cache traffic) stay on the
+    // gather/broadcast latency histograms but skip the ring events —
+    // the flight recorder keeps its tail for events that carry signal
+    // (RecordControlPhase).
+    const bool busy_cycle = !list.responses.empty() ||
+                            !list.cache_hit_positions.empty() ||
+                            !list.cache_evictions.empty();
+    RecordControlPhase(kPhaseGather, gather_dur_us, busy_cycle);
     list.shutdown = std::all_of(shutdown_flags_.begin(), shutdown_flags_.end(),
                                 [](bool b) { return b; });
     list.fusion_threshold_bytes = bcast_fusion_bytes_;
@@ -903,21 +1085,39 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     // negotiated responses + cache verdicts; every rank (this one included)
     // then rebuilds hit responses and inserts new entries identically.
     std::string payload = SerializeResponseList(list);
-    for (int r = 1; r < cfg_.size; r++) {
-      Status s = SendFrame(control_fds_[r], payload, hb_ms);
+    const int64_t bcast_t0 = MetricsNowUs();
+    // Tree mode: send to the direct children only (they relay down);
+    // flat mode: one frame per worker.
+    std::vector<std::pair<int, int>> targets;
+    if (TreeEnabled()) {
+      targets = tree_children_;
+    } else {
+      for (int r = 1; r < cfg_.size; r++) {
+        targets.emplace_back(r, control_fds_[r]);
+      }
+    }
+    for (auto& target : targets) {
+      Status s = SendFrame(target.second, payload, hb_ms);
       if (!s.ok()) {
         if (!s.peer_failure()) {
-          s = Status::PeerFailure(r, "control-plane broadcast to rank " +
-                                         std::to_string(r) +
-                                         " failed: " + s.reason());
+          s = Status::PeerFailure(
+              target.first, "control-plane broadcast to rank " +
+                                std::to_string(target.first) +
+                                " failed: " + s.reason());
         }
         BroadcastFaultNotice(s);
         return s;
       }
     }
+    RecordControlPhase(kPhaseBroadcast, MetricsNowUs() - bcast_t0,
+                       busy_cycle);
     *out = std::move(list);
     ApplyCacheVerdicts(out);
     return Status::OK();
+  }
+
+  if (TreeEnabled()) {
+    return TreeWorkerCycle(my_list, hb_ms, worker_recv_ms, out);
   }
 
   // Worker: one send + one receive per cycle (the gather/bcast round).
@@ -955,6 +1155,159 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
             std::to_string(out->fault_ranks[0]) + ") at epoch " +
             std::to_string(cfg_.epoch));
   }
+  ApplyCacheVerdicts(out);
+  return Status::OK();
+}
+
+Status Controller::TreeCoordinatorGather(int64_t hb_ms,
+                                         std::vector<int64_t>* evictions) {
+  std::vector<bool> seen(cfg_.size, false);
+  seen[0] = true;
+  int got = 1;
+  for (auto& child : tree_children_) {
+    const int crank = child.first;
+    std::string bundle;
+    // The child's bundle carries its whole subtree, so the deadline
+    // scales with the subtree's aggregate budget (failure detection in
+    // tree mode is bounded by the deepest subtree, not one frame).
+    Status s = RecvFrame(child.second, &bundle,
+                         hb_ms <= 0 ? hb_ms : hb_ms * SubtreeSize(crank));
+    if (!s.ok()) {
+      if (!s.peer_failure()) {
+        s = Status::PeerFailure(
+            crank, "control-tree gather from rank " +
+                       std::to_string(crank) + " failed: " + s.reason());
+      }
+      return s;
+    }
+    std::vector<std::string> frames;
+    if (!SplitBundle(bundle, &frames)) {
+      return Status::PeerFailure(
+          crank, "malformed control-tree bundle from rank " +
+                     std::to_string(crank));
+    }
+    for (auto& frame : frames) {
+      RequestList rl;
+      Status ps = ParseRequestList(frame, &rl);
+      if (!ps.ok()) {
+        return Status::PeerFailure(
+            crank, "unparseable control-tree entry via rank " +
+                       std::to_string(crank) + ": " + ps.reason());
+      }
+      if (rl.rank < 1 || rl.rank >= cfg_.size || seen[rl.rank]) {
+        return Status::PeerFailure(
+            crank, "control-tree entry with bad/duplicate origin rank " +
+                       std::to_string(rl.rank) + " via rank " +
+                       std::to_string(crank));
+      }
+      if (rl.epoch != cfg_.epoch) {
+        return Status::PeerFailure(
+            rl.rank, "rank " + std::to_string(rl.rank) +
+                         " sent a stale-epoch request (epoch " +
+                         std::to_string(rl.epoch) + ", current " +
+                         std::to_string(cfg_.epoch) + ")");
+      }
+      seen[rl.rank] = true;
+      got++;
+      HandleCacheBits(rl, rl.rank, evictions);
+      HandleRequestList(rl, rl.rank);
+    }
+  }
+  if (got < cfg_.size) {
+    // A relay forwarded a partial bundle (one of its children died):
+    // the first absent origin IS the casualty — or its subtree root.
+    int missing = 1;
+    while (missing < cfg_.size && seen[missing]) missing++;
+    return Status::PeerFailure(
+        missing, "control-tree gather missing rank " +
+                     std::to_string(missing) + " (" +
+                     std::to_string(cfg_.size - got) + " absent)");
+  }
+  return Status::OK();
+}
+
+Status Controller::TreeWorkerCycle(const RequestList& my_list,
+                                   int64_t hb_ms, int64_t worker_recv_ms,
+                                   ResponseList* out) {
+  // Gather: own entry first, then each child's bundle verbatim.
+  std::string bundle;
+  AppendBundleEntry(&bundle, SerializeRequestList(my_list));
+  Status child_failure = Status::OK();
+  for (auto& child : tree_children_) {
+    const int crank = child.first;
+    std::string child_bundle;
+    Status s = RecvFrame(child.second, &child_bundle,
+                         hb_ms <= 0 ? hb_ms : hb_ms * SubtreeSize(crank));
+    if (!s.ok()) {
+      // Keep gathering and FORWARD what arrived: the coordinator then
+      // names the exact missing member instead of writing off this
+      // whole subtree on a timeout.
+      if (!s.peer_failure()) {
+        s = Status::PeerFailure(
+            crank, "control-tree gather from rank " +
+                       std::to_string(crank) + " failed: " + s.reason());
+      }
+      child_failure = s;
+      continue;
+    }
+    bundle += child_bundle;  // entries are self-delimiting
+  }
+  Status s = SendFrame(tree_parent_fd_, bundle, hb_ms);
+  if (!s.ok()) {
+    if (!s.peer_failure()) {
+      s = Status::PeerFailure(
+          TreeParent(cfg_.rank),
+          "control-tree relay to parent failed: " + s.reason());
+    }
+    return s;
+  }
+
+  // Response: receive from the parent and relay down FIRST — even when
+  // a child already failed. The coordinator answers a partial gather
+  // with a fault notice (over the star for depth-1 workers, relayed
+  // here for deeper ones), and the SURVIVING children are blocked on
+  // this relay: returning early would starve them for a full timeout.
+  std::string frame;
+  s = RecvFrame(tree_parent_fd_, &frame, worker_recv_ms);
+  if (s.ok()) s = ParseResponseList(frame, out);
+  if (!s.ok()) {
+    if (!child_failure.ok()) return child_failure;
+    if (!s.peer_failure()) {
+      s = Status::PeerFailure(
+          TreeParent(cfg_.rank),
+          "control-tree round with parent failed: " + s.reason());
+    }
+    return s;
+  }
+  Status relay_failure = Status::OK();
+  for (auto& child : tree_children_) {
+    // Send errors to an already-failed child are expected; the first
+    // failure on a HEALTHY child is reported after local processing.
+    Status rs = SendFrame(child.second, frame, hb_ms);
+    if (!rs.ok() && relay_failure.ok()) {
+      relay_failure = Status::PeerFailure(
+          child.first, "control-tree relay to rank " +
+                           std::to_string(child.first) +
+                           " failed: " + rs.reason());
+    }
+  }
+  if (!child_failure.ok()) return child_failure;
+  if (out->epoch != cfg_.epoch) {
+    return Status::PeerFailure(
+        0, "coordinator response at stale epoch " +
+               std::to_string(out->epoch) + " (current " +
+               std::to_string(cfg_.epoch) + ")");
+  }
+  if (!out->fault_ranks.empty()) {
+    GlobalEvents().Record(EventType::kFaultNotice,
+                          (int32_t)out->fault_ranks[0], 1);
+    return Status::PeerFailure(
+        (int)out->fault_ranks[0],
+        "coordinator reported peer failure (rank " +
+            std::to_string(out->fault_ranks[0]) + ") at epoch " +
+            std::to_string(cfg_.epoch));
+  }
+  if (!relay_failure.ok()) return relay_failure;
   ApplyCacheVerdicts(out);
   return Status::OK();
 }

@@ -57,6 +57,18 @@ struct ControllerConfig {
   // the registered external message transport (wire.h) — zero TCP
   // sockets, for firewalled MPI-only fabrics.
   bool use_external_transport = false;
+  // HOROVOD_CONTROL_TREE=<fanout>: tree-structured negotiation round.
+  // The flat star gather/broadcast is O(N) sequential frames at the
+  // coordinator — the dominant control-plane cost at 64-256 ranks
+  // (docs/scale.md scaling curves). fanout >= 2 arranges workers as a
+  // fanout-ary tree rooted at rank 0: interior workers gather their
+  // children's frame bundles and relay one concatenated bundle up, and
+  // relay the response broadcast down, so the coordinator touches only
+  // `fanout` sockets per cycle. 0/1 = flat (default). Fault
+  // attribution coarsens to the first missing subtree member (the
+  // probe sweep and post-mortem refine it); fault NOTICES still ride
+  // the star, which every rank keeps for exactly that.
+  int tree_fanout = 0;
 };
 
 class Controller {
@@ -67,6 +79,28 @@ class Controller {
   // Rendezvous with the coordinator, exchange data-plane addresses, and
   // build the full-mesh data-plane sockets. Blocking; collective.
   Status Initialize();
+
+  // In-process harness entry (csrc/simworld.cc): adopt pre-connected
+  // socketpair fds instead of the TCP rendezvous. `control_fds` uses
+  // the control_fds_ layout (coordinator: fd per worker; worker: one
+  // fd to the coordinator). Tree edges between two WORKERS arrive in
+  // `tree_parent_fd` / `tree_children` (rank, fd); edges that touch
+  // rank 0 are resolved from the star fds internally, exactly as the
+  // TCP path shares them. All fds (including `peer_fds`) are owned by
+  // the controller/data plane from here on.
+  Status InitializeFromFds(std::vector<int> control_fds,
+                           std::vector<int> peer_fds,
+                           int tree_parent_fd,
+                           std::vector<std::pair<int, int>> tree_children);
+
+  // Tree topology helpers (rank numbering; heap layout rooted at 0).
+  bool TreeEnabled() const {
+    return cfg_.tree_fanout >= 2 && cfg_.size > 2 &&
+           !cfg_.use_external_transport;
+  }
+  int TreeParent(int r) const { return (r - 1) / cfg_.tree_fanout; }
+  std::vector<int> TreeChildren(int r) const;
+  int SubtreeSize(int r) const;  // members of the subtree rooted at r
 
   // One negotiation round (blocking, collective): submit this rank's new
   // requests, get back the globally-agreed ResponseList.
@@ -128,11 +162,24 @@ class Controller {
   // via their own wire deadline/EOF.
   void BroadcastFaultNotice(const Status& failure);
 
+  // Tree-mode cycle halves (coordinator unpacks bundles; workers
+  // gather children, relay up, relay the response down).
+  Status TreeCoordinatorGather(int64_t hb_ms,
+                               std::vector<int64_t>* evictions);
+  Status TreeWorkerCycle(const RequestList& my_list, int64_t hb_ms,
+                         int64_t worker_recv_ms, ResponseList* out);
+
   ControllerConfig cfg_;
   std::unique_ptr<DataPlane> data_plane_;
   // Worker: control_fds_[0] = socket to coordinator.
   // Coordinator: control_fds_[r] = socket to worker r (r >= 1).
   std::vector<int> control_fds_;
+  // Tree edges (HOROVOD_CONTROL_TREE). Fds shared with the star
+  // (every edge touching rank 0) are NOT in tree_owned_fds_ — the
+  // destructor closes each fd exactly once.
+  int tree_parent_fd_ = -1;
+  std::vector<std::pair<int, int>> tree_children_;  // (child rank, fd)
+  std::vector<int> tree_owned_fds_;
 
   // --- Coordinator state (rank 0 only) ---
   struct PendingTensor {

@@ -41,6 +41,7 @@ const EventSpec kEventSpecs[(int)EventType::kTypeCount] = {
     {"inject", "action", "", "op_index", ""},
     {"stall", "waited_s", "missing", "", ""},
     {"fault_notice", "fault_rank", "received", "", ""},
+    {"phase", "phase", "", "dur_us", ""},
 };
 
 const char* kKnobNames[] = {"fusion_bytes", "cycle_time_us", "ring_chunk",
@@ -166,6 +167,13 @@ std::string EventJson(const EventRecord& e) {
       e.a < (int32_t)(sizeof(kKnobNames) / sizeof(kKnobNames[0]))) {
     out += ",\"knob_name\":\"";
     out += kKnobNames[e.a];
+    out += "\"";
+  }
+  // Same courtesy for the control-plane phase id (ONE name table,
+  // metrics.cc — the snapshot keys and the event decode cannot skew).
+  if (e.type == EventType::kPhase) {
+    out += ",\"phase_name\":\"";
+    out += ControlPhaseName(e.a);
     out += "\"";
   }
   out += "}";

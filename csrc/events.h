@@ -53,6 +53,7 @@ enum class EventType : int32_t {
   kInject,              // a=chaos action, c=collective index
   kStall,               // a=waited seconds, b=missing/blocking ranks
   kFaultNotice,         // a=fault rank, b=0 broadcast / 1 received
+  kPhase,               // a=ControlPhase (metrics.h), c=dur_us
   kTypeCount
 };
 

@@ -159,6 +159,7 @@ std::string SerializeRequestList(const RequestList& list) {
   Writer w;
   w.Put<uint8_t>(list.shutdown ? 1 : 0);
   w.Put<int64_t>(list.epoch);
+  w.Put<int32_t>(list.rank);
   w.PutI64Vec(list.cache_hits);
   w.PutI64Vec(list.cache_invalid);
   w.Put<uint32_t>((uint32_t)list.requests.size());
@@ -172,6 +173,7 @@ Status ParseRequestList(const std::string& buf, RequestList* list) {
   if (!rd.Get(&shutdown)) return Status::Error("truncated RequestList");
   list->shutdown = shutdown != 0;
   if (!rd.Get(&list->epoch)) return Status::Error("truncated RequestList");
+  if (!rd.Get(&list->rank)) return Status::Error("truncated RequestList");
   if (!rd.GetI64Vec(&list->cache_hits) ||
       !rd.GetI64Vec(&list->cache_invalid)) {
     return Status::Error("truncated RequestList");

@@ -117,6 +117,12 @@ inline int64_t ShapesTotalBytes(const Response& r) {
 struct RequestList {
   std::vector<Request> requests;
   bool shutdown = false;
+  // Origin rank of this list. The flat star gather knows the sender
+  // from the socket it read; the tree gather (HOROVOD_CONTROL_TREE)
+  // relays frames through interior workers, so the frame itself must
+  // name its origin. -1 = unset (pre-tree frames; the flat path keeps
+  // using the positional fd).
+  int32_t rank = -1;
   // Membership epoch this worker believes it is in. The coordinator
   // rejects frames from any other epoch, so a half-dead rank from a
   // previous ring generation cannot poison the re-formed ring

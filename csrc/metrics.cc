@@ -1,6 +1,7 @@
 #include "metrics.h"
 
 #include "common.h"
+#include "events.h"
 
 #include <algorithm>
 #include <chrono>
@@ -14,6 +15,47 @@ int64_t MetricsNowUs() {
   return std::chrono::duration_cast<std::chrono::microseconds>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+namespace {
+// Snapshot/docs keys for the control-plane phase profile. Order must
+// match ControlPhase (metrics.h).
+const char* kPhaseNames[kPhaseCount] = {
+    "rendezvous", "gather", "broadcast", "probe_sweep", "reinit",
+    "parole_freeze"};
+}  // namespace
+
+const char* ControlPhaseName(int phase) {
+  if (phase < 0 || phase >= kPhaseCount) return "unknown";
+  return kPhaseNames[phase];
+}
+
+void RecordControlPhase(int phase, int64_t dur_us, bool emit_event) {
+  if (phase < 0 || phase >= kPhaseCount) return;
+  GlobalMetrics().control_phase_us[phase].Record(dur_us);
+  if (emit_event) {
+    GlobalEvents().Record(EventType::kPhase, phase, 0, dur_us);
+  }
+}
+
+// Dynamically sized append: measure first, then format straight into
+// the string. The previous fixed stack buffer (256, then 768 bytes,
+// grown by hand whenever a section gained rows) silently truncated —
+// and thereby corrupted — the snapshot JSON the moment a row outgrew
+// it; measuring makes the buffer a non-decision forever. Shared by
+// every printf-style JSON producer in the core (metrics snapshot,
+// simworld report).
+void AppendFmtV(std::string& out, const char* fmt, va_list args) {
+  va_list measure;
+  va_copy(measure, args);
+  int need = vsnprintf(nullptr, 0, fmt, measure);
+  va_end(measure);
+  if (need > 0) {
+    size_t old = out.size();
+    out.resize(old + (size_t)need + 1);
+    vsnprintf(&out[old], (size_t)need + 1, fmt, args);
+    out.resize(old + (size_t)need);
+  }
 }
 
 namespace {
@@ -41,24 +83,14 @@ void AtomicMax(std::atomic<int64_t>& a, int64_t v) {
   }
 }
 
-// Dynamically sized append: measure first, then format straight into
-// the string. The previous fixed stack buffer (256, then 768 bytes,
-// grown by hand whenever a section gained rows) silently truncated —
-// and thereby corrupted — the snapshot JSON the moment a row outgrew
-// it; measuring makes the buffer a non-decision forever.
+// Local shorthand for the shared AppendFmtV (metrics.h) — every JSON
+// producer in the core uses the measure-then-format append; a fixed
+// stack buffer silently truncates (= corrupts) the JSON the moment a
+// row outgrows it.
 void Append(std::string& out, const char* fmt, ...) {
   va_list args;
   va_start(args, fmt);
-  va_list measure;
-  va_copy(measure, args);
-  int need = vsnprintf(nullptr, 0, fmt, measure);
-  va_end(measure);
-  if (need > 0) {
-    size_t old = out.size();
-    out.resize(old + (size_t)need + 1);
-    vsnprintf(&out[old], (size_t)need + 1, fmt, args);
-    out.resize(old + (size_t)need);
-  }
+  AppendFmtV(out, fmt, args);
   va_end(args);
 }
 
@@ -190,6 +222,7 @@ void Metrics::Reset() {
   wire_us.Reset();
   straggler_skew_us.Reset();
   fault_detect_us.Reset();
+  for (auto& h : control_phase_us) h.Reset();
   faults_detected.store(0);
   faults_recovered.store(0);
   ranks_blacklisted.store(0);
@@ -227,6 +260,20 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
   out += "\"negotiation_us\":" + negotiation_us.Json() + ",";
   out += "\"queue_us\":" + queue_us.Json() + ",";
   out += "\"wire_us\":" + wire_us.Json() + ",";
+
+  // Control-plane phase profile (docs/scale.md). Zero-count phases are
+  // skipped like empty op classes — snapshots stay compact.
+  out += "\"control_phase\":{";
+  {
+    bool first = true;
+    for (int i = 0; i < kPhaseCount; i++) {
+      if (control_phase_us[i].count() == 0) continue;
+      Append(out, "%s\"%s\":", first ? "" : ",", ControlPhaseName(i));
+      out += control_phase_us[i].Json();
+      first = false;
+    }
+  }
+  out += "},";
 
   int64_t fr = fused_responses.load(std::memory_order_relaxed);
   int64_t fb = fusion_fill_bytes.load(std::memory_order_relaxed);

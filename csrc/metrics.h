@@ -20,6 +20,7 @@
 #define HVDTPU_METRICS_H
 
 #include <atomic>
+#include <cstdarg>
 #include <cstdint>
 #include <mutex>
 #include <string>
@@ -28,6 +29,37 @@
 namespace hvdtpu {
 
 int64_t MetricsNowUs();  // steady-clock microseconds (monotonic)
+
+// Control-plane phases profiled for large-world scaling (docs/scale.md):
+// each is an O(N) suspect in the coordinator/elastic machinery, and the
+// per-phase histograms below are how the scaling curves indict (or
+// clear) them at 64-256 ranks. kPhaseParoleFreeze is recorded from
+// Python (common/elastic.py) through the hvdtpu_record_phase C-ABI —
+// the parole door lives above the core but its latency belongs on the
+// same profile.
+enum ControlPhase : int32_t {
+  kPhaseRendezvous = 0,  // Controller::Initialize bootstrap fan-in
+  kPhaseGather,          // coordinator: per-cycle request gather
+  kPhaseBroadcast,       // coordinator: per-cycle response broadcast
+  kPhaseProbeSweep,      // DataPlane::ProbeDeadPeers fault sweep
+  kPhaseReinit,          // hvdtpu_reinit ring re-formation
+  kPhaseParoleFreeze,    // parole-door freeze/poll (python side)
+  kPhaseCount
+};
+const char* ControlPhaseName(int phase);
+
+// Record one phase duration into the metrics histogram AND the event
+// ring (EventType::kPhase) — one call keeps the two views consistent.
+// `emit_event=false` updates only the histogram: the coordinator's
+// idle negotiation cycles still belong on the latency profile, but two
+// ring events per cycle would lap the flight recorder in seconds and
+// evict the forensic tail the black box exists to keep.
+void RecordControlPhase(int phase, int64_t dur_us, bool emit_event = true);
+
+// Measure-then-format printf append (definition rationale in
+// metrics.cc): the shared primitive for every JSON producer — fixed
+// stack buffers silently truncate, i.e. corrupt, the output.
+void AppendFmtV(std::string& out, const char* fmt, va_list args);
 
 // Log2-bucketed microsecond histogram: bucket i holds values in
 // [2^i, 2^(i+1)). Percentiles are read off the bucket CDF at upper bucket
@@ -75,6 +107,10 @@ class Metrics {
   // Elastic: how long the failing operation ran before the typed
   // PeerFailure surfaced (EOF ~ instant; stalls ~ the wire deadline).
   LatencyHistogram fault_detect_us;
+  // Per-phase control-plane latency (ControlPhase above): the scaling
+  // profile the simworld harness and `bench.py --scale` read to indict
+  // O(N) suspects at 64-256 ranks (docs/scale.md).
+  LatencyHistogram control_phase_us[kPhaseCount];
 
   std::atomic<int64_t> cycles{0};
   std::atomic<int64_t> cycle_stalls{0};      // loop overran its budget

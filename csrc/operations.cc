@@ -345,6 +345,9 @@ ControllerConfig MakeControllerConfig(GlobalState& st, int rank, int size,
   // ring data ride the registered external transport (mpi4py
   // point-to-point; the frontend registers callbacks before init).
   cfg.use_external_transport = EnvStr("HOROVOD_CONTROLLER", "") == "mpi";
+  // HOROVOD_CONTROL_TREE=<fanout>: tree-structured negotiation round
+  // (docs/scale.md) — 0/1 keeps the flat star.
+  cfg.tree_fanout = (int)EnvInt64("HOROVOD_CONTROL_TREE", 0);
   return cfg;
 }
 
@@ -1709,6 +1712,7 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
     }
   }
   if (new_rank < 0) return -3;  // this rank was declared dead
+  const int64_t reinit_start_us = MetricsNowUs();
   GlobalEvents().Record(EventType::kReinitBegin, nranks, 0, epoch);
   if (!st->loop_failed.load() && !st->loop_exited.load()) {
     // Healthy loop (voluntary re-formation — absorbing parole
@@ -1855,6 +1859,7 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
                                  std::memory_order_relaxed);
     }
   }
+  RecordControlPhase(kPhaseReinit, MetricsNowUs() - reinit_start_us);
   GlobalEvents().Record(EventType::kReinitEnd, 0, nranks, epoch);
   GlobalEvents().Record(EventType::kEpoch, 0, 0, epoch, old_epoch);
   if (joiner_slots > 0) {
@@ -2422,6 +2427,23 @@ int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap) {
 int hvdtpu_metrics_reset() {
   GlobalMetrics().Reset();
   return 0;
+}
+
+// Record one control-plane phase duration from ABOVE the core: the
+// parole-door freeze/poll lives in Python (common/elastic.py) but its
+// latency belongs on the same per-phase scaling profile as the native
+// phases (docs/scale.md). Valid before init like the registry itself.
+void hvdtpu_record_phase(int phase, int64_t dur_us) {
+  RecordControlPhase(phase, dur_us);
+}
+
+// Live pending-tensor gauge: collectives enqueued by API threads that
+// the background loop has not finished executing. The queue-depth
+// signal the autoscaler's /healthz consumes (docs/scale.md) — a gauge,
+// unlike the monotonic counters in the snapshot. 0 before init.
+int64_t hvdtpu_queue_depth() {
+  if (g_state == nullptr || !g_state->initialized.load()) return 0;
+  return (int64_t)g_state->tensor_queue.Size();
 }
 
 // Consuming-drain cursor for hvdtpu_events_drain: one per process (the

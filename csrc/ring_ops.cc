@@ -330,6 +330,10 @@ DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds,
 }
 
 std::vector<int32_t> DataPlane::ProbeDeadPeers() const {
+  // The sweep is O(peer fds) of nonblocking poll+MSG_PEEK syscalls —
+  // one of the large-world control-plane suspects the per-phase
+  // profile tracks (docs/scale.md).
+  const int64_t t0 = MetricsNowUs();
   std::vector<int32_t> dead;
   for (int i = 0; i < (int)peer_fds_.size() && i < size_; i++) {
     int fd = peer_fds_[i];
@@ -354,6 +358,7 @@ std::vector<int32_t> DataPlane::ProbeDeadPeers() const {
       }
     }
   }
+  RecordControlPhase(kPhaseProbeSweep, MetricsNowUs() - t0);
   return dead;
 }
 

@@ -126,6 +126,13 @@ def load_library():
         lib.hvdtpu_metrics_snapshot.argtypes = [p, i64]
         lib.hvdtpu_metrics_reset.restype = i32
         lib.hvdtpu_metrics_reset.argtypes = []
+        lib.hvdtpu_record_phase.restype = None
+        lib.hvdtpu_record_phase.argtypes = [i32, i64]
+        lib.hvdtpu_queue_depth.restype = i64
+        lib.hvdtpu_queue_depth.argtypes = []
+        lib.hvdtpu_simworld_run.restype = i32
+        lib.hvdtpu_simworld_run.argtypes = [i32, i32, i64, i32, i32, i32,
+                                            p, i64]
         lib.hvdtpu_events_drain.restype = i64
         lib.hvdtpu_events_drain.argtypes = [p, i64]
         lib.hvdtpu_events_peek.restype = i64
@@ -358,6 +365,58 @@ class HorovodBasics:
         for test isolation and interactive sessions.
         """
         self.lib.hvdtpu_metrics_reset()
+
+    # Control-plane phase ids (csrc/metrics.h ControlPhase) — the ONE
+    # name order the snapshot keys, the kPhase events, and this binding
+    # all follow (docs/scale.md).
+    CONTROL_PHASES = ("rendezvous", "gather", "broadcast", "probe_sweep",
+                      "reinit", "parole_freeze")
+
+    def record_phase(self, phase, dur_us):
+        """Record one control-plane phase duration into the per-phase
+        scaling profile (histogram + ``phase`` event). ``phase`` is a
+        name from :data:`CONTROL_PHASES` or its index; used by the
+        Python-side phases (the parole-door freeze) so they land on the
+        same profile as the native ones. Valid before ``init()``."""
+        if isinstance(phase, str):
+            phase = self.CONTROL_PHASES.index(phase)
+        self.lib.hvdtpu_record_phase(int(phase), int(dur_us))
+
+    def queue_depth(self):
+        """Live pending-tensor gauge: collectives enqueued by API
+        threads that the background loop has not finished executing.
+        The queue-depth signal the autoscaler reads off ``/healthz``
+        (docs/scale.md). 0 before ``init()``."""
+        return int(self.lib.hvdtpu_queue_depth())
+
+    def simworld_run(self, ranks, tree_fanout=0, elems=1024, rounds=3,
+                     kill_rank=-1, kill_round=-1):
+        """Run one simulated `ranks`-rank world in-process (thread per
+        rank over socketpairs — ``csrc/simworld.cc``) and return its
+        JSON report as a dict: world standup, per-round negotiation+
+        allreduce latency, and the per-phase control-plane profile the
+        scaling curves are built from (docs/scale.md). Refuses to run
+        next to a live core (rc -5): it resets the phase histograms.
+        Raises RuntimeError on a non-injected failure."""
+        import ctypes as _ct
+        import json as _json
+
+        buf = _ct.create_string_buffer(1 << 16)
+        rc = self.lib.hvdtpu_simworld_run(
+            int(ranks), int(tree_fanout), int(elems), int(rounds),
+            int(kill_rank), int(kill_round), buf, len(buf))
+        out = _json.loads(buf.value.decode()) if buf.value else {}
+        out["rc"] = rc
+        if rc != 0:
+            reasons = {-1: "bad arguments", -2: "fd budget/socketpair",
+                       -3: "a rank failed", -4: "allreduce mismatch",
+                       -5: "core already initialized in this process",
+                       -6: "injected kill surfaced no typed fault"}
+            raise RuntimeError(
+                f"simworld_run(ranks={ranks}, tree_fanout={tree_fanout})"
+                f" failed: {reasons.get(rc, rc)}: "
+                f"{out.get('error', '')}")
+        return out
 
     def events(self, last_n=0):
         """The newest ``last_n`` events of the core's structured event

@@ -250,7 +250,21 @@ def _poll_rejoiners():
 def _freeze_joiners(target_epoch):
     """The frozen joiner count for ``target_epoch`` — identical on
     every survivor (the door freezes once per epoch; rank 0 asks
-    in-process, the rest over TCP)."""
+    in-process, the rest over TCP). The freeze/poll latency lands on
+    the control-plane phase profile (``parole_freeze``,
+    docs/scale.md): it sits on the epoch-transition critical path and
+    its TCP round is an O(survivors) suspect at large worlds."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    try:
+        return _freeze_joiners_inner(target_epoch)
+    finally:
+        _basics.record_phase("parole_freeze",
+                             int((_time.monotonic() - t0) * 1e6))
+
+
+def _freeze_joiners_inner(target_epoch):
     if _is_elastic() or not _rejoin_port():
         return 0
     if _basics.rank() == 0:
@@ -340,6 +354,41 @@ def rejoin(addr=None, port=None, timeout=None):
     finally:
         os.environ.pop("HOROVOD_JOIN_EPOCH", None)  # one-shot
     return asg
+
+
+def shrink(victims):
+    """Voluntary world shrink (the autoscaler's scale-down leg,
+    docs/scale.md): re-form the ring WITHOUT ``victims`` at the next
+    epoch — no fault, no blacklist, the negotiated-shutdown drain keeps
+    every in-flight collective intact.
+
+    Collective: every rank (victims included) must call it at the same
+    logical point with the SAME victim set — the drain is a negotiated
+    shutdown, so the survivors' reinit blocks until every rank's
+    shutdown bit (a victim's arrives via its full ``shutdown()``) has
+    reached the coordinator. Survivors return True at the new epoch;
+    a victim tears its core down and returns False — the process is
+    free to exit, or to knock on the parole door later when the
+    autoscaler grows the world again (``hvd.elastic.rejoin``).
+    """
+    victims = {int(v) for v in victims}
+    size = _basics.size()
+    rank = _basics.rank()
+    bad = [v for v in victims if v < 0 or v >= size]
+    if bad or len(victims) >= size:
+        raise ValueError(
+            f"shrink(victims={sorted(victims)}): victims must be a "
+            f"proper subset of range({size})")
+    if rank in victims:
+        _basics.shutdown()
+        return False
+    target_epoch = int(_basics.epoch()) + 1
+    _disable_xla_ici()
+    _basics.reinit([r for r in range(size) if r not in victims],
+                   target_epoch)
+    for hook in _post_reset_hooks:
+        hook()
+    return True
 
 
 def init():

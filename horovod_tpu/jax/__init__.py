@@ -66,6 +66,7 @@ from horovod_tpu.jax.mpi_ops import (  # noqa: F401
     is_initialized,
     local_rank,
     local_size,
+    debug_port,
     events,
     metrics,
     metrics_reset,

@@ -161,6 +161,17 @@ def events(last_n=0):
     return _basics.events(last_n)
 
 
+def debug_port():
+    """The bound port of this rank's debug server, or ``None`` when it
+    is not running — THE discovery path under ``HOROVOD_DEBUG_PORT=0``
+    (ephemeral bind for co-located/simulated large worlds; the port is
+    also echoed as the ``X-Hvdtpu-Debug-Port`` response header and in
+    ``/healthz``). See docs/metrics.md / docs/scale.md."""
+    from horovod_tpu.telemetry import debug_server
+
+    return debug_server.debug_port()
+
+
 is_initialized = _basics.is_initialized
 rank = _basics.rank
 size = _basics.size

@@ -1,0 +1,28 @@
+"""Simulated large-world harness (docs/scale.md).
+
+``csrc/simworld.cc`` stands up a 64-256-rank world as thread-per-rank
+controllers over socketpairs in ONE process — the real negotiation
+protocol (flat star or the ``HOROVOD_CONTROL_TREE`` tree gather) and
+the real ring allreduce, with only the transport hops loopback. This
+package is the Python face:
+
+- :func:`run_world` — one world, one JSON report (standup, per-round
+  latency, the per-phase control-plane profile);
+- :func:`scaling_profile` — the ``control_plane_scaling`` bench rows:
+  flat-vs-tree latency curves at 8/32/64/128/256 ranks, the
+  characterization the tree gather was built from (arXiv:1810.11112's
+  profile-first discipline);
+- :func:`write_sim_dumps` — synthetic per-rank black-box dumps in the
+  exact ``DumpBlackBox`` schema, sized to exercise the streaming
+  post-mortem merge at hundreds of ranks (the in-process world shares
+  one event ring, so per-rank dump FILES are simulated while the fault
+  content mirrors what each real rank would record);
+- ``python -m horovod_tpu.simworld.scale_smoke`` — the 64-rank CI lane
+  (``make scale-smoke``).
+"""
+
+from horovod_tpu.simworld.harness import (  # noqa: F401
+    run_world,
+    scaling_profile,
+    write_sim_dumps,
+)

@@ -1,0 +1,107 @@
+"""``make scale-smoke`` — the large-world CI lane (docs/scale.md).
+
+One 64-rank simulated world, flat star AND tree gather:
+
+1. a negotiation + allreduce round completes in both modes and the
+   per-phase control-plane latency rows come out (the scaling-curve
+   plumbing, end to end);
+2. an injected kill at round 1 surfaces a typed peer failure on the
+   survivors with the dead rank named;
+3. a 64-rank post-mortem — one black-box dump per survivor in the
+   exact ``DumpBlackBox`` schema — merges through the STREAMING path
+   and names the killed rank as root cause.
+
+Exit 0 = all three behaviors hold. ~15 s on a laptop.
+"""
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+from horovod_tpu.simworld import run_world, write_sim_dumps
+from horovod_tpu.telemetry.postmortem import (
+    format_post_mortem,
+    merge_post_mortem_streaming,
+)
+
+RANKS = 64
+TREE_FANOUT = 8
+KILL_RANK = 37
+
+
+def main():
+    failures = []
+
+    # (1) negotiation + allreduce, both gather modes, phase rows out.
+    for config, fanout in (("flat", 0), (f"tree{TREE_FANOUT}",
+                                         TREE_FANOUT)):
+        t0 = time.monotonic()
+        rep = run_world(RANKS, tree_fanout=fanout, elems=256, rounds=2)
+        row = {
+            "metric": "scale_smoke", "config": config, "ranks": RANKS,
+            "standup_us": rep["standup_us"],
+            "round_mean_us": rep["round_us"]["mean"],
+            "phases": {k: {"p50_us": v["p50_us"], "count": v["count"]}
+                       for k, v in rep["phases"].items()},
+            "allreduce_ok": rep["allreduce_ok"],
+            "wall_s": round(time.monotonic() - t0, 2),
+        }
+        print("SCALE_SMOKE " + json.dumps(row), flush=True)
+        if not rep["allreduce_ok"]:
+            failures.append(f"{config}: allreduce mismatch")
+        for phase in ("gather", "broadcast"):
+            if not rep["phases"].get(phase, {}).get("count"):
+                failures.append(f"{config}: no {phase} phase rows")
+
+    # (2) injected kill: every survivor gets a typed fault naming the
+    # dead rank (certain EOF attribution, no timeouts needed).
+    rep = run_world(RANKS, tree_fanout=TREE_FANOUT, elems=256, rounds=3,
+                    kill_rank=KILL_RANK, kill_round=1)
+    fault = rep.get("fault", {})
+    print("SCALE_SMOKE " + json.dumps(
+        {"metric": "scale_smoke_kill", "ranks": RANKS, **fault}),
+        flush=True)
+    if fault.get("typed_faults", 0) < RANKS - 1:
+        failures.append(f"kill: only {fault.get('typed_faults')} of "
+                        f"{RANKS - 1} survivors saw a typed fault")
+    if fault.get("named_rank") != KILL_RANK:
+        failures.append(f"kill: named rank {fault.get('named_rank')}, "
+                        f"injected {KILL_RANK}")
+
+    # (3) fleet post-mortem: streaming merge over the survivors' dumps
+    # names the killed rank as root cause.
+    dump_dir = tempfile.mkdtemp(prefix="hvdtpu_scale_smoke_")
+    try:
+        write_sim_dumps(dump_dir, RANKS, KILL_RANK,
+                        events_per_rank=256)
+        t0 = time.monotonic()
+        analysis = merge_post_mortem_streaming(dump_dir)
+        merge_s = time.monotonic() - t0
+        print("SCALE_SMOKE " + json.dumps({
+            "metric": "scale_smoke_postmortem", "dumps": RANKS - 1,
+            "merge_s": round(merge_s, 2),
+            "root_cause_ranks": analysis["root_cause_ranks"],
+            "timeline_total": analysis["timeline_total"],
+        }), flush=True)
+        if analysis["root_cause_ranks"] != [KILL_RANK]:
+            failures.append("post-mortem root cause "
+                            f"{analysis['root_cause_ranks']}, expected "
+                            f"[{KILL_RANK}]")
+            print(format_post_mortem(analysis, tail=10))
+    finally:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+
+    if failures:
+        print("scale-smoke FAILED:\n  " + "\n  ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"scale-smoke OK ({RANKS}-rank world: negotiation+allreduce "
+          "in both gather modes, typed kill attribution, streaming "
+          "post-mortem root cause)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

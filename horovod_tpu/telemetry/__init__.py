@@ -48,6 +48,7 @@ from horovod_tpu.telemetry.exporters import MetricsScraper  # noqa: F401
 from horovod_tpu.telemetry.postmortem import (  # noqa: F401
     format_post_mortem,
     merge_post_mortem,
+    merge_post_mortem_streaming,
 )
 from horovod_tpu.telemetry.step_timer import (  # noqa: F401
     StepTimer,

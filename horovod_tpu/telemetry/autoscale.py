@@ -1,0 +1,249 @@
+"""Telemetry-driven autoscaler: elastic as capacity management.
+
+The r12-r14 elastic machinery treats world-size change as a FAULT
+response (a peer dies, survivors shrink; a host returns, the parole
+door regrows). This module closes the observability loop the other way
+round: the signals the runtime already serves — queue depth, straggler
+skew, step-time trend, heal/fault rates, paroled joiners waiting at
+the door (``/healthz``, docs/scale.md signal table) — drive the SAME
+rejoin/shrink machinery to grow or shrink the world under load.
+
+Three layers, deliberately separable:
+
+- :class:`Signals` — one observation; :func:`collect_signals` fills it
+  from the live core (the same fields ``/healthz`` exports, so a
+  driver-side autoscaler polling HTTP computes identical decisions);
+- :class:`AutoscalePolicy` — a PURE decision function over an
+  observation stream: deterministic, no clock reads, no side effects;
+  hysteresis (consecutive-breach streaks, an up/down deadband, and a
+  post-action cooldown) guarantees a flapping signal never oscillates
+  the world size (pinned by tests/single/test_autoscale.py);
+- :class:`Autoscaler` — the driver glue: applies decisions through
+  ``grow``/``shrink`` callbacks (in driverless worlds: spawn a worker
+  that knocks on the parole door / ``hvd.elastic.shrink``).
+
+Reference analog: none in upstream Horovod — its elastic driver only
+reacts to discovery changes; the policy shape (breach streaks +
+cooldown around a deadband) is the classic k8s-HPA stabilization
+recipe applied to training-runtime signals.
+"""
+
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Signals:
+    """One autoscaler observation (field meanings in docs/scale.md)."""
+
+    t: float                       # observation time, seconds (any
+    #                                monotonic origin; the policy only
+    #                                differences it for cooldowns)
+    world_size: int
+    queue_depth: int = 0           # pending collectives in the core
+    straggler_skew_ms: float = 0.0  # negotiation skew p90
+    step_time_ms: float = 0.0      # step-time EWMA (0 = unknown)
+    heal_rate: float = 0.0         # wire heals since last observation
+    fault_rate: float = 0.0        # faults since last observation
+    pending_rejoiners: int = 0     # paroled joiners waiting at the door
+
+
+@dataclass
+class Decision:
+    action: str                    # "up" | "down" | "hold"
+    target_size: int
+    reason: str
+
+
+@dataclass
+class AutoscalePolicy:
+    """Pure hysteresis policy: ``decide`` maps an observation stream to
+    scale decisions, deterministically.
+
+    Scale-up pressure: ``queue_depth > up_queue_depth`` or the
+    step-time EWMA exceeding ``up_step_time_ratio`` x its own slow
+    baseline. Scale-down pressure: queue at/below ``down_queue_depth``
+    AND straggler skew under ``down_skew_ms`` (an idle world that is
+    also not limping). A breach only becomes a decision after
+    ``up_consecutive``/``down_consecutive`` observations in a row, any
+    decision opens a ``cooldown_s`` window of forced holds, and the
+    deadband between the up and down conditions means a signal flapping
+    around either threshold resets the opposite streak instead of
+    reversing the world — the three stabilizers that make oscillation
+    structurally impossible (test_autoscale.py pins a flap trace).
+
+    Instability gates scaling entirely: while faults/heals are moving
+    (``fault_rate``/``heal_rate`` > 0) the policy holds — resizing a
+    world that is mid-recovery would race the elastic machinery it
+    drives.
+    """
+
+    min_size: int = 1
+    max_size: int = 256
+    step: int = 1                  # ranks per decision
+    up_queue_depth: int = 8
+    up_step_time_ratio: float = 1.5
+    down_queue_depth: int = 0
+    down_skew_ms: float = 50.0
+    up_consecutive: int = 3
+    down_consecutive: int = 6
+    cooldown_s: float = 30.0
+    baseline_alpha: float = 0.05   # slow step-time baseline EWMA
+
+    _up_streak: int = field(default=0, repr=False)
+    _down_streak: int = field(default=0, repr=False)
+    _cooldown_until: float = field(default=float("-inf"), repr=False)
+    _baseline_ms: float = field(default=0.0, repr=False)
+
+    def _overloaded(self, s):
+        if s.queue_depth > self.up_queue_depth:
+            return f"queue_depth {s.queue_depth} > {self.up_queue_depth}"
+        if (self._baseline_ms > 0.0 and s.step_time_ms
+                > self.up_step_time_ratio * self._baseline_ms):
+            return (f"step_time {s.step_time_ms:.1f}ms > "
+                    f"{self.up_step_time_ratio:.2f}x baseline "
+                    f"{self._baseline_ms:.1f}ms")
+        return None
+
+    def _idle(self, s):
+        return (s.queue_depth <= self.down_queue_depth
+                and s.straggler_skew_ms <= self.down_skew_ms)
+
+    def decide(self, s):
+        """One observation -> one :class:`Decision` (pure; mutates only
+        the policy's own streak/cooldown/baseline state)."""
+        # The baseline tracks step time through every observation —
+        # including holds — so "1.5x slower than usual" means usual for
+        # THIS model/world, not a configured absolute.
+        if s.step_time_ms > 0.0:
+            self._baseline_ms = (
+                s.step_time_ms if self._baseline_ms == 0.0
+                else (1 - self.baseline_alpha) * self._baseline_ms
+                + self.baseline_alpha * s.step_time_ms)
+
+        if s.fault_rate > 0 or s.heal_rate > 0:
+            self._up_streak = self._down_streak = 0
+            return Decision("hold", s.world_size,
+                            "unstable: faults/heals in flight")
+        if s.t < self._cooldown_until:
+            return Decision("hold", s.world_size,
+                            "cooldown after last resize")
+
+        overload = self._overloaded(s)
+        if overload is not None:
+            self._down_streak = 0
+            self._up_streak += 1
+            if (self._up_streak >= self.up_consecutive
+                    and s.world_size < self.max_size):
+                self._up_streak = 0
+                self._cooldown_until = s.t + self.cooldown_s
+                target = min(s.world_size + self.step, self.max_size)
+                return Decision("up", target, overload)
+            return Decision("hold", s.world_size,
+                            f"overload streak {self._up_streak}/"
+                            f"{self.up_consecutive}: {overload}")
+        if self._idle(s):
+            self._up_streak = 0
+            self._down_streak += 1
+            if (self._down_streak >= self.down_consecutive
+                    and s.world_size > self.min_size):
+                self._down_streak = 0
+                self._cooldown_until = s.t + self.cooldown_s
+                target = max(s.world_size - self.step, self.min_size)
+                return Decision("down", target, "idle: queue drained, "
+                                "skew low")
+            return Decision("hold", s.world_size,
+                            f"idle streak {self._down_streak}/"
+                            f"{self.down_consecutive}")
+        # Deadband: neither overloaded nor idle — both streaks reset,
+        # so a signal flapping across one threshold can never bank
+        # progress toward the opposite action.
+        self._up_streak = self._down_streak = 0
+        return Decision("hold", s.world_size, "in deadband")
+
+
+def collect_signals(basics=None, t=None):
+    """Fill a :class:`Signals` from the live core — the same values
+    ``/healthz`` serves, so in-process and HTTP-polling autoscalers see
+    one truth. Rate fields are diffs against the previous call."""
+    import time as _time
+
+    from horovod_tpu.common.basics import HorovodBasics
+
+    b = basics or HorovodBasics()
+    snap = b.metrics_snapshot()
+    elastic = snap.get("elastic", {})
+    straggler = snap.get("straggler", {})
+    global _last_counters
+    faults = int(elastic.get("faults_detected", 0))
+    heals = int(elastic.get("heals", 0))
+    prev = _last_counters or {"faults": faults, "heals": heals}
+    _last_counters = {"faults": faults, "heals": heals}
+    pending = 0
+    try:
+        from horovod_tpu.common import elastic as hvd_elastic
+
+        if hvd_elastic._door is not None:
+            pending = hvd_elastic._door.pending_count()
+    except Exception:  # noqa: BLE001 — signals must come back anyway
+        pass
+    step_ms = 0.0
+    try:
+        from horovod_tpu.telemetry.step_timer import step_time_ewma_ms
+
+        step_ms = step_time_ewma_ms() or 0.0
+    except Exception:  # noqa: BLE001
+        pass
+    return Signals(
+        t=_time.monotonic() if t is None else t,
+        world_size=b.size() if b.is_initialized() else 1,
+        queue_depth=b.queue_depth(),
+        straggler_skew_ms=float(
+            straggler.get("skew_us", {}).get("p90_us", 0)) / 1000.0,
+        step_time_ms=step_ms,
+        heal_rate=float(heals - prev["heals"]),
+        fault_rate=float(faults - prev["faults"]),
+        pending_rejoiners=pending,
+    )
+
+
+_last_counters = None
+
+
+class Autoscaler:
+    """Driver glue: observe -> decide -> act.
+
+    ``grow(decision)`` / ``shrink(decision)`` apply the resize — in a
+    driverless world, grow spawns (or admits) a worker that enters
+    through the parole door and is absorbed at the next commit
+    (``hvd.elastic`` rejoin path), shrink calls
+    :func:`horovod_tpu.common.elastic.shrink`. Both default to no-ops
+    so an observe-only autoscaler can log decisions first.
+
+    IMPORTANT (SPMD): when every rank runs its own Autoscaler, the
+    DECISION must be rank-uniform — feed the policy rank-0's signals
+    (broadcast them) or run the autoscaler on rank 0 / the driver only;
+    a per-rank decision from per-rank signals would desynchronize the
+    world (the same agreement rule as the rejoin-poll collective).
+    """
+
+    def __init__(self, policy=None, collect=None, grow=None, shrink=None,
+                 history=256):
+        self.policy = policy or AutoscalePolicy()
+        self.collect = collect or collect_signals
+        self.grow = grow
+        self.shrink = shrink
+        # Bounded: a driver polling every few seconds for weeks must not
+        # grow without limit; the newest window is what debugging wants.
+        self.history = deque(maxlen=history)
+
+    def step(self):
+        """One observe/decide/act cycle; returns the Decision."""
+        s = self.collect()
+        d = self.policy.decide(s)
+        self.history.append((s, d))
+        if d.action == "up" and self.grow is not None:
+            self.grow(d)
+        elif d.action == "down" and self.shrink is not None:
+            self.shrink(d)
+        return d

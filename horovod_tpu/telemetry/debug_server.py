@@ -54,7 +54,28 @@ def _healthz(basics):
         "last_fault": basics.last_fault(),
         "uptime_s": round(time.monotonic() - _start_time, 3)
         if _start_time is not None else None,
+        "debug_port": debug_port(),
     }
+    # The autoscaler's signal set (docs/scale.md signal table): one
+    # endpoint serves everything the scaling policy consumes, so a
+    # driver-side autoscaler needs no second scrape.
+    out["queue_depth"] = int(lib.hvdtpu_queue_depth())
+    try:
+        from horovod_tpu.telemetry.step_timer import step_time_ewma_ms
+
+        out["step_time_ewma_ms"] = round(step_time_ewma_ms(), 3)
+    except Exception:  # noqa: BLE001
+        out["step_time_ewma_ms"] = 0.0
+    pending = 0
+    try:
+        import sys
+
+        hvd_elastic = sys.modules.get("horovod_tpu.common.elastic")
+        if hvd_elastic is not None and hvd_elastic._door is not None:
+            pending = hvd_elastic._door.pending_count()
+    except Exception:  # noqa: BLE001
+        pass
+    out["pending_rejoiners"] = pending
     try:
         snap = basics.metrics_snapshot()
         out["elastic"] = {
@@ -62,8 +83,12 @@ def _healthz(basics):
             if k != "detect_us"
         }
         out["cycles"] = snap.get("cycle", {}).get("count", 0)
+        out["straggler_skew_ms"] = round(
+            snap.get("straggler", {}).get("skew_us", {}).get("p90_us", 0)
+            / 1000.0, 3)
     except Exception as e:  # noqa: BLE001 — health must answer anyway
         out["metrics_error"] = str(e)
+        out["straggler_skew_ms"] = 0.0
     return out
 
 
@@ -95,6 +120,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        # The bound port on every response: with HOROVOD_DEBUG_PORT=0
+        # (ephemeral bind, large co-located worlds) this is how an
+        # operator who found ONE endpoint learns the authoritative
+        # port to record for this rank.
+        self.send_header("X-Hvdtpu-Debug-Port",
+                         str(self.server.server_address[1]))
         self.end_headers()
         self.wfile.write(data)
 
@@ -153,16 +184,36 @@ def start(basics, port, host="127.0.0.1"):
         return _server.server_address[1]
 
 
+def debug_port():
+    """The port this process's debug server is bound to, or ``None``
+    when it is not running. THE way to discover the endpoint under
+    ``HOROVOD_DEBUG_PORT=0`` (ephemeral bind); also echoed on every
+    response as the ``X-Hvdtpu-Debug-Port`` header and in
+    ``/healthz``."""
+    with _lock:
+        return _server.server_address[1] if _server is not None else None
+
+
 def maybe_start(basics):
     """Start iff ``HOROVOD_DEBUG_PORT`` is set: rank r binds port+r
     (rank from the live core when initialized, else HOROVOD_RANK).
-    Returns the bound port or ``None``."""
+
+    ``HOROVOD_DEBUG_PORT=0`` binds an EPHEMERAL port instead: base+rank
+    collides when many simulated or co-located ranks share one host
+    (two processes with the same HOROVOD_RANK, or more ranks than the
+    port range) — with 0 every rank gets its own kernel-assigned port,
+    discoverable via ``hvd.debug_port()`` / the ``X-Hvdtpu-Debug-Port``
+    header. Returns the bound port or ``None``; negative disables."""
     base = os.environ.get("HOROVOD_DEBUG_PORT")
     if not base:
         return None
     base = int(base)
-    if base <= 0:
+    if base < 0:
         return None
+    if base == 0:
+        return start(basics, 0,
+                     host=os.environ.get("HOROVOD_DEBUG_HOST",
+                                         "127.0.0.1"))
     rank = 0
     try:
         if basics.lib.hvdtpu_is_initialized():

@@ -29,9 +29,10 @@ launch, negotiation end) is earliest on the merged axis — among
 survivors, that is the rank the stall propagated *from*.
 """
 
+import heapq
 import json
 import os
-from collections import defaultdict
+from collections import defaultdict, deque
 
 # Event types that constitute forward progress for first-stall analysis.
 PROGRESS_TYPES = ("wire_chunk", "wire_span", "response_launch",
@@ -202,6 +203,247 @@ def merge_post_mortem(paths_or_dir, dump_index=-1):
     }
 
 
+def _load_dump_at(path, dump_index=-1):
+    """Parse ONE dump from a per-rank file without materializing the
+    others: for the common ``dump_index=-1`` the file is scanned once
+    and only events after the LAST header are retained — memory stays
+    one-dump-bounded however many faults the process logged."""
+    if dump_index != -1:
+        dumps = load_blackbox(path)
+        return dumps[dump_index] if dumps else None
+    current = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a dying process
+            if row.get("kind") == "blackbox_header":
+                current = {"header": row, "events": []}
+            elif current is not None:
+                current["events"].append(row)
+    return current
+
+
+def _scan_last_dump(path):
+    """One O(1)-memory pass over a per-rank file: locate the LAST dump
+    and summarize it without retaining its events. Returns ``None`` if
+    the file holds no dump, else a dict with the header, the byte
+    offset of the first event line, the event count, whether the events
+    are already ts-ordered (ring snapshots normally are), and this
+    rank's contribution to the global stall cutoff (min wall time of
+    retry/fault/crc events)."""
+    info = None
+    with open(path) as f:
+        while True:
+            line = f.readline()
+            if not line:
+                break
+            s = line.strip()
+            if not s:
+                continue
+            try:
+                row = json.loads(s)
+            except json.JSONDecodeError:
+                continue  # torn tail of a dying process
+            if row.get("kind") == "blackbox_header":
+                info = {"path": path, "header": row, "offset": f.tell(),
+                        "events": 0, "in_order": True, "cutoff": None,
+                        "_last_ts": None}
+            elif info is not None:
+                info["events"] += 1
+                ts = row.get("ts_us", 0)
+                if info["_last_ts"] is not None and ts < info["_last_ts"]:
+                    info["in_order"] = False
+                info["_last_ts"] = ts
+                if row.get("type") in ("retry_window", "fault",
+                                       "crc_error"):
+                    wall = _wall_us(row, info["header"])
+                    info["cutoff"] = (wall if info["cutoff"] is None
+                                      else min(info["cutoff"], wall))
+    return info
+
+
+def _iter_dump_events(info, rank):
+    """Lazily re-read one rank's last-dump events from disk (the offset
+    :func:`_scan_last_dump` found), yielding ``(wall_us, rank, event)``
+    in file order — the sorted-stream contract ``heapq.merge`` needs.
+    The rare unsorted snapshot falls back to materializing just this
+    rank (bounded by one ring tail)."""
+    if not info["in_order"]:
+        events = []
+        with open(info["path"]) as f:
+            f.seek(info["offset"])
+            for line in f:
+                row = _event_row(line)
+                if row is _STOP:
+                    break
+                if row is not None:
+                    events.append(row)
+        events.sort(key=lambda e: e.get("ts_us", 0))
+        for ev in events:
+            yield (_wall_us(ev, info["header"]), rank, ev)
+        return
+    with open(info["path"]) as f:
+        f.seek(info["offset"])
+        for line in f:
+            row = _event_row(line)
+            if row is _STOP:
+                break
+            if row is not None:
+                yield (_wall_us(row, info["header"]), rank, row)
+
+
+_STOP = object()
+
+
+def _event_row(line):
+    s = line.strip()
+    if not s:
+        return None
+    try:
+        row = json.loads(s)
+    except json.JSONDecodeError:
+        return None
+    # A header can only follow the scanned offset if the scan raced a
+    # NEW dump being appended; everything past it belongs to that later
+    # dump, not the one being merged.
+    if row.get("kind") == "blackbox_header":
+        return _STOP
+    return row
+
+
+def merge_post_mortem_streaming(paths_or_dir, dump_index=-1, tail=512):
+    """`merge_post_mortem` for LARGE worlds: same verdicts, streaming
+    merge, bounded timeline.
+
+    The eager merge materializes every rank's full event window as
+    per-event dicts on one list and sorts it globally — fine at 2-8
+    dumps, a multi-gigabyte sort at 256 ranks x 8k events with per-event
+    wall/t_ms annotation. Here each file is scanned once in O(1) memory
+    (header, event count, stall-cutoff contribution, sortedness), then
+    the wall-aligned per-rank streams are re-read lazily from disk
+    through one ``heapq.merge`` k-way pass (one open fd per rank):
+    root-cause / secondary verdicts, per-rank last progress, and the
+    newest ``tail`` timeline entries (annotated only on retention) are
+    computed in that pass with O(ranks + tail) live memory — an
+    unsorted snapshot (rare) materializes only that rank, bounded by
+    one ring tail.
+
+    Returns the `merge_post_mortem` dict with ``timeline`` holding only
+    the newest ``tail`` entries plus ``timeline_total`` (the full
+    merged event count); :func:`format_post_mortem` renders either.
+    """
+    paths = collect_paths(paths_or_dir)
+    ranks = {}
+    for path in paths:
+        if dump_index == -1:
+            info = _scan_last_dump(path)
+        else:
+            # Selecting an OLDER dump is a small-scale forensic move —
+            # the eager loader is fine there; the scan path exists for
+            # the latest-dump fleet merge.
+            dump = _load_dump_at(path, dump_index)
+            info = None
+            if dump is not None:
+                events = sorted(dump["events"],
+                                key=lambda e: e.get("ts_us", 0))
+                cut = None
+                for ev in events:
+                    if ev.get("type") in ("retry_window", "fault",
+                                          "crc_error"):
+                        wall = _wall_us(ev, dump["header"])
+                        cut = wall if cut is None else min(cut, wall)
+                info = {"header": dump["header"], "events": len(events),
+                        "cutoff": cut, "_materialized": events}
+        if info is None:
+            continue
+        ranks[info["header"].get("rank", -1)] = info
+    if not ranks:
+        raise ValueError(f"no black-box dumps found in {paths_or_dir!r}")
+
+    survivors = set(ranks)
+    certain, suspected, corrupting = set(), set(), set()
+    per_rank = {}
+    # Pass 0 came from the file scans: verdict sets off the headers,
+    # the stall cutoff (a global MIN — order-free), per-rank event
+    # counts. No event is resident yet.
+    cutoff = None
+    for rank, info in sorted(ranks.items()):
+        hdr = info["header"]
+        fault = hdr.get("fault", {})
+        named = set(fault.get("ranks", []))
+        if fault.get("kind") == "corruption":
+            corrupting |= named
+        elif fault.get("certain"):
+            certain |= named
+        else:
+            suspected |= named
+        per_rank[rank] = {
+            "epoch": hdr.get("epoch"),
+            "fault": fault,
+            "events": info["events"],
+        }
+        if info["cutoff"] is not None:
+            cutoff = (info["cutoff"] if cutoff is None
+                      else min(cutoff, info["cutoff"]))
+    root_cause = sorted((certain - survivors) | corrupting)
+    secondary = sorted(((certain | suspected) & survivors) - corrupting)
+    if not root_cause:
+        root_cause = sorted(suspected - survivors)
+
+    def rank_stream(rank, info):
+        if "_materialized" in info:
+            hdr = info["header"]
+            return ((_wall_us(ev, hdr), rank, ev)
+                    for ev in info["_materialized"])
+        return _iter_dump_events(info, rank)
+
+    merged = heapq.merge(*(rank_stream(r, i) for r, i in ranks.items()))
+    last_progress = {}
+    window = deque(maxlen=max(int(tail), 1))
+    total = 0
+    t0 = None
+    for wall, rank, ev in merged:
+        total += 1
+        if t0 is None:
+            t0 = wall
+        window.append((wall, rank, ev))
+        if ev.get("type") not in PROGRESS_TYPES:
+            continue
+        if cutoff is not None and wall > cutoff:
+            continue
+        if wall > last_progress.get(rank, float("-inf")):
+            last_progress[rank] = wall
+    first_stalled = None
+    if last_progress:
+        first_stalled = min(last_progress, key=last_progress.get)
+    for rank, us in last_progress.items():
+        per_rank[rank]["last_progress_ms"] = round(
+            (us - (t0 or 0)) / 1000.0, 3)
+
+    timeline = []
+    for wall, rank, ev in window:
+        entry = dict(ev)
+        entry["rank"] = rank
+        entry["wall_us"] = wall
+        entry["t_ms"] = round((wall - (t0 or 0)) / 1000.0, 3)
+        timeline.append(entry)
+
+    return {
+        "ranks": sorted(survivors),
+        "root_cause_ranks": root_cause,
+        "secondary_suspects": secondary,
+        "first_stalled_rank": first_stalled,
+        "per_rank": per_rank,
+        "timeline": timeline,
+        "timeline_total": total,
+    }
+
+
 def format_post_mortem(analysis, tail=40):
     """Operator-facing text rendering of :func:`merge_post_mortem`."""
     lines = []
@@ -223,8 +465,8 @@ def format_post_mortem(analysis, tail=40):
             f"{d['events']} events, fault kind={fault.get('kind')} "
             f"certain={fault.get('certain')} ranks={fault.get('ranks')} "
             f"last progress {d.get('last_progress_ms', '-')} ms")
-    lines.append(f"causal timeline (last {tail} of "
-                 f"{len(analysis['timeline'])} events):")
+    total = analysis.get("timeline_total", len(analysis["timeline"]))
+    lines.append(f"causal timeline (last {tail} of {total} events):")
     for e in analysis["timeline"][-tail:]:
         args = {k: v for k, v in e.items()
                 if k not in ("rank", "wall_us", "t_ms", "ts_us", "seq",

@@ -29,6 +29,10 @@ import statistics
 import sys
 from collections import defaultdict
 
+# --post-mortem switches to the streaming k-way merge above this many
+# dumps (large simulated/real worlds; docs/scale.md).
+_STREAM_THRESHOLD = 16
+
 
 def load_timeline(path):
     """Load one rank's timeline; returns (rank, events). Tolerates the
@@ -315,12 +319,24 @@ def main(argv=None):
                          "causal cross-rank fault timeline naming the "
                          "root-cause rank(s); -o writes the analysis "
                          "as JSON")
+    ap.add_argument("--stream", action="store_true",
+                    help="with --post-mortem: force the streaming "
+                         "merge (bounded memory, timeline tail only). "
+                         "Selected automatically above %d dumps."
+                         % _STREAM_THRESHOLD)
     args = ap.parse_args(argv)
 
     if args.post_mortem:
         from horovod_tpu.telemetry import postmortem
 
-        analysis = postmortem.merge_post_mortem(args.timelines)
+        paths = postmortem.collect_paths(args.timelines)
+        if args.stream or len(paths) > _STREAM_THRESHOLD:
+            # Hundreds of dumps: the eager merge's global annotate+sort
+            # is quadratic-feeling at fleet scale; the k-way streaming
+            # pass returns identical verdicts in seconds (docs/scale.md).
+            analysis = postmortem.merge_post_mortem_streaming(paths)
+        else:
+            analysis = postmortem.merge_post_mortem(paths)
         print(postmortem.format_post_mortem(analysis))
         if args.output != "merged_timeline.json":
             with open(args.output, "w") as f:

@@ -57,6 +57,28 @@ def compiled_flops(compiled):
         return None
 
 
+# ---- process-wide step-time EWMA --------------------------------------
+# One number every observer agrees on: /healthz exports it and the
+# autoscaler's step-time-trend signal reads it (docs/scale.md) — fed by
+# whichever StepTimer instance is driving the training loop. EWMA so a
+# single GC pause cannot flip a scaling decision on its own.
+_STEP_EWMA_ALPHA = 0.1
+_step_ewma_ms = 0.0
+
+
+def _update_step_ewma(ms):
+    global _step_ewma_ms
+    _step_ewma_ms = (ms if _step_ewma_ms == 0.0 else
+                     (1 - _STEP_EWMA_ALPHA) * _step_ewma_ms
+                     + _STEP_EWMA_ALPHA * ms)
+
+
+def step_time_ewma_ms():
+    """The process's step-time EWMA in ms (0.0 until the first
+    ``end_step``)."""
+    return _step_ewma_ms
+
+
 class StepTimer:
     """Accumulates per-step measurements; renders one summary row.
 
@@ -142,6 +164,7 @@ class StepTimer:
             except Exception:  # noqa: BLE001 — non-jax outputs
                 pass
         self.step_times.append(time.perf_counter() - self._t0)
+        _update_step_ewma(self.step_times[-1] * 1000.0)
         b1, w1, p1 = self._read_bytes()
         if self._bytes0 is not None and b1 is not None:
             self.bytes_per_step.append(b1 - self._bytes0)

@@ -45,6 +45,7 @@ from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
     join,
     local_rank,
     local_size,
+    debug_port,
     events,
     metrics,
     metrics_reset,

@@ -66,6 +66,15 @@ metrics_reset = _basics.metrics_reset
 # Structured event-ring tail (flight recorder, docs/metrics.md).
 events = _basics.events
 
+
+def debug_port():
+    """Bound port of this rank's debug server (None when not running);
+    the discovery path under ``HOROVOD_DEBUG_PORT=0`` (docs/scale.md).
+    """
+    from horovod_tpu.telemetry import debug_server
+
+    return debug_server.debug_port()
+
 from horovod_tpu.common.auto_name import make_auto_namer
 
 _auto_name = make_auto_namer()

@@ -60,6 +60,7 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     init,
     is_initialized,
     local_rank,
+    debug_port,
     events,
     metrics,
     metrics_reset,

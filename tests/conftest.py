@@ -52,6 +52,11 @@ def pytest_configure(config):
         "loadflaky: timing-sensitive under a loaded box (multi-process "
         "steady-state assertions); runs with widened slack, and a busy "
         "CI shard may deselect with -m 'not loadflaky'")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight lanes (e.g. the 256-rank simulated world) "
+        "excluded from the tier-1 budget via -m 'not slow'; covered by "
+        "the full suite and bench.py --scale")
     _ensure_core_built()
 
 

@@ -1,0 +1,197 @@
+"""Autoscaler chaos (docs/scale.md): offered load ramps and the
+telemetry-driven policy GROWS the world through the blacklist-parole
+door at a healthy commit point, then SHRINKS it back when the load
+drops — elastic as capacity management, not just fault response.
+
+The policy is the pure AutoscalePolicy over a deterministic offered-
+load trace (so every rank computes the identical decision at the same
+commit — the SPMD agreement rule), and the actions it drives are the
+REAL machinery: scale-up absorbs a live parolee knocking at the door
+via an epoch transition (the r14 rejoin path, trajectory pinned the
+same way test_chaos_matrix pins it), scale-down re-forms the ring
+without the evicted rank through the negotiated-shutdown drain
+(``hvd.elastic.shrink``), no fault anywhere.
+
+Workers live in this importable module (spawn re-imports them).
+"""
+
+import numpy as np
+import pytest
+
+from tests.utils_mp import free_port
+from tests.parallel.test_chaos_matrix import run_chaos
+
+pytestmark = pytest.mark.quick
+
+_AS_STEPS = 8
+_AS_DIM = 97
+_AS_LR = 0.1
+_AS_BASE = 2          # world before the ramp
+_AS_MAX = 3           # world at peak load
+
+
+def _as_offered_load(step):
+    """Queue-depth trace: overloaded while the ramp lasts, idle after."""
+    return 100 if step <= 1 else 0
+
+
+def _as_worlds_by_step(step):
+    """Expected world (1-based rank multipliers) per step, given the
+    policy knobs below: up streak completes at step 1's commit (grow),
+    idle streak completes at step 5's commit (shrink)."""
+    if step <= 1:
+        return (1, 2)
+    if step <= 5:
+        return (1, 2, 3)
+    return (1, 2)
+
+
+def _as_reference(through_step=_AS_STEPS):
+    p = np.zeros(_AS_DIM, np.float64)
+    for s in range(through_step):
+        world = _as_worlds_by_step(s)
+        mean = 0.01 * (s + 1) * sum(world) / len(world)
+        p = p - _AS_LR * mean
+    return p
+
+
+def _as_policy():
+    from horovod_tpu.telemetry.autoscale import AutoscalePolicy
+
+    # t is the step index; cooldown_s=0.5 expires by the next commit.
+    return AutoscalePolicy(min_size=_AS_BASE, max_size=_AS_MAX, step=1,
+                           up_queue_depth=8, up_consecutive=2,
+                           down_consecutive=4, down_skew_ms=50.0,
+                           cooldown_s=0.5)
+
+
+class _Evicted(Exception):
+    pass
+
+
+def _as_train(state, b, ops, epochs_seen, sizes_seen):
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+    from horovod_tpu.telemetry.autoscale import Signals
+
+    policy = _as_policy()
+
+    @hvd_elastic.run_fn
+    def train(state):
+        epochs_seen.append(b.epoch())
+        while state.step < _AS_STEPS:
+            g = np.full(_AS_DIM, 0.01 * (state.step + 1) * (b.rank() + 1),
+                        np.float32)
+            mean = ops.allreduce_async(
+                g, f"as.{state.step}.{b.epoch()}",
+                op=ops.ReduceOp.AVERAGE).synchronize()
+            state.params = state.params - _AS_LR * mean
+            sizes_seen.append((state.step, b.size()))
+            state.step += 1
+            state.commit()
+            # One observation per commit: the offered-load trace plus
+            # the LIVE signals (world size; rank 0 sees the pending
+            # parolee). Every rank decides identically.
+            decision = policy.decide(Signals(
+                t=float(state.step - 1), world_size=b.size(),
+                queue_depth=_as_offered_load(state.step - 1),
+                straggler_skew_ms=0.0,
+                pending_rejoiners=(
+                    hvd_elastic._door.pending_count()
+                    if hvd_elastic._door is not None else 0)))
+            if decision.action == "up":
+                # Healthy-commit scale-up: the epoch transition freezes
+                # and absorbs the parolee at the door (r14 machinery).
+                raise HostsUpdatedInterrupt(False)
+            if decision.action == "down":
+                victims = set(range(decision.target_size, b.size()))
+                if not hvd_elastic.shrink(victims):
+                    raise _Evicted()  # this rank left the world
+        return state.params
+
+    return train(state)
+
+
+def _as_run_worker(rank, size, expect_epochs):
+    import os
+    import time
+
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.elastic import ObjectState
+
+    b = basics.HorovodBasics()
+    hvd_elastic.init()
+    if rank == 0 and int(os.environ.get("HOROVOD_RANK", rank)) == 0:
+        # Gate training on the parolee knocking, so the up decision
+        # deterministically has a joiner to absorb.
+        deadline = time.monotonic() + 60
+        door = hvd_elastic._ensure_door()
+        while door.pending_count() == 0:
+            assert time.monotonic() < deadline, "joiner never knocked"
+            time.sleep(0.05)
+    state = ObjectState(step=0, params=np.zeros(_AS_DIM, np.float32))
+    epochs_seen, sizes_seen = [], []
+    try:
+        params = _as_train(state, b, ops, epochs_seen, sizes_seen)
+    except _Evicted:
+        # The scale-down victim: its trajectory is pinned through the
+        # shrink step, then it leaves the world cleanly (no fault) —
+        # free to re-enter through the door at the next ramp.
+        np.testing.assert_allclose(
+            state.params, _as_reference(max(s for s, _ in sizes_seen) + 1),
+            rtol=1e-5, atol=1e-7)
+        assert not b.is_initialized()
+        return "evicted"
+    np.testing.assert_allclose(params, _as_reference(), rtol=1e-5,
+                               atol=1e-7)
+    assert epochs_seen == expect_epochs, epochs_seen
+    # Grown to 3 for the loaded steps, back to 2 after the drain.
+    worlds = sorted(set(sizes_seen))
+    assert (0, _AS_BASE) in worlds and (7, _AS_BASE) in worlds, worlds
+    assert (2, _AS_MAX) in worlds and (5, _AS_MAX) in worlds, worlds
+    assert (b.size(), b.epoch()) == (_AS_BASE, 2), (b.size(), b.epoch())
+    el = b.metrics_snapshot()["elastic"]
+    # Capacity management, not fault response: zero faults end to end.
+    assert el["faults_detected"] == 0, el
+    assert el["ranks_rejoined"] == 1, el
+    b.shutdown()
+    return "ok"
+
+
+def _as_survivor_worker(rank, size):
+    return _as_run_worker(rank, size, expect_epochs=[0, 1])
+
+
+def _as_joiner_worker(rank, size):
+    import time
+
+    from horovod_tpu.common import basics
+    from horovod_tpu.common import elastic as hvd_elastic
+
+    b = basics.HorovodBasics()
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            asg = hvd_elastic.rejoin(timeout=120)
+            break
+        except (OSError, ConnectionError):
+            assert time.monotonic() < deadline, "door never opened"
+            time.sleep(0.2)
+    assert asg["rank"] == _AS_MAX - 1 and asg["size"] == _AS_MAX, asg
+    # The joiner is rank 2 — the shrink victim once the load drops.
+    return _as_run_worker(asg["rank"], asg["size"], expect_epochs=[1])
+
+
+def test_autoscaler_grows_through_parole_door_then_shrinks_back():
+    rejoin_port = free_port()
+    results = run_chaos(
+        _as_survivor_worker, _AS_BASE, victims=set(),
+        expect_sigkill=False, timeout=180,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": "5000",
+             "HOROVOD_REJOIN_PORT": str(rejoin_port),
+             # Growth is the AUTOSCALER's call, not the commit poll's.
+             "HOROVOD_REJOIN_POLL": "0"},
+        extra=[(_as_joiner_worker,
+                {"HOROVOD_WORKER_ID": "as-parolee:1"})])
+    assert results == {0: "ok", 1: "ok", _AS_BASE: "evicted"}, results

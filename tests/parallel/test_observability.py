@@ -265,7 +265,52 @@ def test_healthz_and_stacks_respond_while_peer_sigstopped(tmp_path):
     assert isinstance(polled.get("healthz"), bytes), polled
     health = json.loads(polled["healthz"])
     assert health["rank"] == 0 and health["initialized"], health
+    # The autoscaler's signal set rides /healthz (docs/scale.md): one
+    # endpoint serves everything the scaling policy consumes.
+    for key in ("queue_depth", "straggler_skew_ms", "step_time_ewma_ms",
+                "pending_rejoiners", "debug_port"):
+        assert key in health, (key, sorted(health))
+    assert health["debug_port"] == dbg_port, health
+    assert isinstance(health["queue_depth"], int), health
+    assert isinstance(health["pending_rejoiners"], int), health
     assert isinstance(polled.get("stacks"), bytes), polled
     assert b"File" in polled["stacks"] or b"Thread" in polled["stacks"]
     assert isinstance(polled.get("events"), bytes), polled
     assert json.loads(polled["events"]), "empty events tail"
+
+
+# ---- HOROVOD_DEBUG_PORT=0: ephemeral bind for co-located ranks -------
+
+
+def test_debug_port_zero_binds_ephemeral_and_advertises_port():
+    """`HOROVOD_DEBUG_PORT=base` collides across co-located simulated
+    ranks (every process computes base+rank from the same env); `=0`
+    binds an ephemeral port per process, discoverable via
+    hvd.debug_port(), /healthz, and the X-Hvdtpu-Debug-Port header."""
+    from horovod_tpu.common.basics import HorovodBasics
+    from horovod_tpu.telemetry import debug_server
+
+    b = HorovodBasics()
+    old = os.environ.get("HOROVOD_DEBUG_PORT")
+    os.environ["HOROVOD_DEBUG_PORT"] = "0"
+    try:
+        port = debug_server.maybe_start(b)
+        assert port and port > 0, port
+        assert debug_server.debug_port() == port
+        import horovod_tpu.jax as hvd
+
+        assert hvd.debug_port() == port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.headers.get("X-Hvdtpu-Debug-Port") == str(port)
+            health = json.loads(r.read())
+        assert health["debug_port"] == port, health
+        # Idempotent: a second start keeps the same server.
+        assert debug_server.maybe_start(b) == port
+    finally:
+        debug_server.stop()
+        if old is None:
+            os.environ.pop("HOROVOD_DEBUG_PORT", None)
+        else:
+            os.environ["HOROVOD_DEBUG_PORT"] = old
+    assert debug_server.debug_port() is None

@@ -1,0 +1,126 @@
+"""The autoscaler policy as a pure function (docs/scale.md): synthetic
+signal traces — ramp, spike, flap, drain — against the hysteresis
+contract. No core, no processes: decisions are a deterministic map of
+the observation stream, which is exactly what makes the policy safe to
+run rank-uniformly."""
+
+import pytest
+
+from horovod_tpu.telemetry.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    Decision,
+    Signals,
+)
+
+pytestmark = pytest.mark.quick
+
+
+def _policy(**kw):
+    kw.setdefault("up_consecutive", 3)
+    kw.setdefault("down_consecutive", 4)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("min_size", 2)
+    kw.setdefault("max_size", 8)
+    return AutoscalePolicy(**kw)
+
+
+def _sig(t, size=4, queue=0, skew=0.0, step=0.0, faults=0.0, heals=0.0,
+         rejoiners=0):
+    return Signals(t=float(t), world_size=size, queue_depth=queue,
+                   straggler_skew_ms=skew, step_time_ms=step,
+                   fault_rate=faults, heal_rate=heals,
+                   pending_rejoiners=rejoiners)
+
+
+def _drive(policy, trace):
+    return [policy.decide(s) for s in trace]
+
+
+def test_ramp_scales_up_after_streak_then_cools_down():
+    p = _policy()
+    trace = [_sig(t, queue=20) for t in range(8)]
+    actions = [d.action for d in _drive(p, trace)]
+    # Two holds banking the streak, the up at t=2, then cooldown holds.
+    assert actions[:3] == ["hold", "hold", "up"], actions
+    assert all(a == "hold" for a in actions[3:]), actions
+    # After the cooldown expires, sustained load scales again.
+    more = _drive(p, [_sig(13 + t, size=5, queue=20) for t in range(4)])
+    assert [d.action for d in more][:3] == ["hold", "hold", "up"], more
+
+
+def test_single_spike_never_scales():
+    p = _policy()
+    trace = ([_sig(0, queue=0)] + [_sig(1, queue=100)]
+             + [_sig(2 + t, queue=0, skew=0.1) for t in range(3)])
+    assert all(d.action == "hold" for d in _drive(p, trace))
+
+
+def test_flap_never_oscillates_world_size():
+    """The hysteresis acceptance: a signal flapping between overload
+    and idle every observation must produce ZERO resizes — the
+    deadband resets the opposite streak each flip."""
+    p = _policy()
+    trace = [_sig(t, queue=(100 if t % 2 == 0 else 0),
+                  skew=(0.0 if t % 2 == 0 else 200.0))
+             for t in range(40)]
+    decisions = _drive(p, trace)
+    assert all(d.action == "hold" for d in decisions), [
+        (i, d.action) for i, d in enumerate(decisions)
+        if d.action != "hold"]
+
+
+def test_sustained_idle_scales_down_to_min_and_stops():
+    p = _policy(cooldown_s=2.0)
+    decisions = _drive(p, [_sig(t, size=3, queue=0, skew=1.0)
+                           for t in range(30)])
+    downs = [d for d in decisions if d.action == "down"]
+    assert downs and downs[0].target_size == 2, decisions
+    # At min_size the policy can only hold.
+    p2 = _policy()
+    at_min = _drive(p2, [_sig(t, size=2, queue=0) for t in range(10)])
+    assert all(d.action == "hold" for d in at_min)
+
+
+def test_step_time_trend_triggers_scale_up_against_own_baseline():
+    p = _policy(up_consecutive=2, baseline_alpha=0.0)
+    # Establish a ~100ms baseline, then run 2x slower with an empty
+    # queue: the trend signal alone must scale up.
+    for t in range(5):
+        assert p.decide(_sig(t, step=100.0, queue=5, skew=100.0)
+                        ).action == "hold"  # deadband: busy-ish
+    late = _drive(p, [_sig(10 + t, step=220.0, queue=0, skew=100.0)
+                      for t in range(3)])
+    assert [d.action for d in late][:2] == ["hold", "up"], late
+
+
+def test_instability_gates_all_scaling():
+    p = _policy(up_consecutive=1, down_consecutive=1)
+    # Overloaded AND faulting: hold. Idle AND healing: hold.
+    assert p.decide(_sig(0, queue=100, faults=1.0)).action == "hold"
+    assert p.decide(_sig(1, queue=0, heals=2.0)).action == "hold"
+    # The streaks were reset — stability must re-bank them.
+    assert p.decide(_sig(2, queue=100)).action == "up"  # streak of 1
+
+
+def test_max_size_caps_growth():
+    p = _policy(up_consecutive=1)
+    d = p.decide(_sig(0, size=8, queue=100))
+    assert d.action == "hold", d  # already at max
+
+
+def test_autoscaler_driver_applies_decisions_via_callbacks():
+    calls = []
+    feed = iter([_sig(t, queue=20) for t in range(3)]
+                + [_sig(20 + t, size=5, queue=0, skew=0.0)
+                   for t in range(6)])
+    a = Autoscaler(policy=_policy(up_consecutive=3, down_consecutive=4,
+                                  cooldown_s=1.0),
+                   collect=lambda: next(feed),
+                   grow=lambda d: calls.append(("grow", d.target_size)),
+                   shrink=lambda d: calls.append(
+                       ("shrink", d.target_size)))
+    decisions = [a.step() for _ in range(9)]
+    assert calls == [("grow", 5), ("shrink", 4)], (calls, decisions)
+    assert len(a.history) == 9
+    assert all(isinstance(d, Decision) for d in decisions)

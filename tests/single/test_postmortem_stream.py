@@ -1,0 +1,96 @@
+"""Streaming post-mortem merge (docs/scale.md): verdict parity with
+the eager merge on small fleets, bounded-timeline semantics, and the
+hundreds-of-dumps lane completing in seconds — the fleet-scale half of
+the r15 forensics."""
+
+import json
+import time
+
+import pytest
+
+from horovod_tpu.simworld import write_sim_dumps
+from horovod_tpu.telemetry.postmortem import (
+    format_post_mortem,
+    merge_post_mortem,
+    merge_post_mortem_streaming,
+)
+
+pytestmark = pytest.mark.quick
+
+
+def test_streaming_verdicts_match_eager_merge(tmp_path):
+    write_sim_dumps(str(tmp_path), 8, 5, events_per_rank=64)
+    eager = merge_post_mortem(str(tmp_path))
+    stream = merge_post_mortem_streaming(str(tmp_path))
+    for key in ("ranks", "root_cause_ranks", "secondary_suspects",
+                "first_stalled_rank"):
+        assert eager[key] == stream[key], (key, eager[key], stream[key])
+    # Per-rank accounting matches too (minus the timeline cap).
+    assert set(eager["per_rank"]) == set(stream["per_rank"])
+    for rank, d in eager["per_rank"].items():
+        assert stream["per_rank"][rank]["events"] == d["events"]
+    assert stream["timeline_total"] == len(eager["timeline"])
+
+
+def test_streaming_timeline_is_tail_bounded_and_ordered(tmp_path):
+    write_sim_dumps(str(tmp_path), 6, 2, events_per_rank=128)
+    out = merge_post_mortem_streaming(str(tmp_path), tail=50)
+    assert len(out["timeline"]) == 50
+    assert out["timeline_total"] == 5 * 128
+    walls = [e["wall_us"] for e in out["timeline"]]
+    assert walls == sorted(walls)
+    # The tail is the NEWEST window of the merged axis.
+    full = merge_post_mortem(str(tmp_path))
+    assert walls[-1] == full["timeline"][-1]["wall_us"]
+    # format renders the bounded analysis and reports the true total.
+    text = format_post_mortem(out, tail=5)
+    assert f"of {out['timeline_total']} events" in text
+
+
+def test_streaming_reads_last_dump_of_multi_fault_files(tmp_path):
+    # A process that faulted twice APPENDS a second dump to its file;
+    # dump_index=-1 must pick the last without materializing the first.
+    epoch0 = tmp_path / "epoch0"
+    epoch1 = tmp_path / "epoch1"
+    merged = tmp_path / "merged"
+    write_sim_dumps(str(epoch0), 4, 3, events_per_rank=16, epoch=0)
+    write_sim_dumps(str(epoch1), 4, 1, events_per_rank=16, epoch=1)
+    merged.mkdir()
+    for path in epoch1.iterdir():  # fleet of the SECOND fault
+        older = epoch0 / path.name
+        prefix = older.read_text() if older.exists() else ""
+        (merged / path.name).write_text(prefix + path.read_text())
+    out = merge_post_mortem_streaming(str(merged))
+    assert all(d["epoch"] == 1 for d in out["per_rank"].values()), \
+        out["per_rank"]
+    assert out["root_cause_ranks"] == [1], out["root_cause_ranks"]
+
+
+def test_256_dump_merge_completes_in_seconds(tmp_path):
+    """The acceptance lane: a 256-rank fleet's post-mortem merges in
+    seconds, not minutes. 512 events per dump keeps CI fast while
+    still exercising the k-way path at full width; the wall bound has
+    ~10x slack over a laptop run."""
+    write_sim_dumps(str(tmp_path), 256, 97, events_per_rank=512)
+    t0 = time.monotonic()
+    out = merge_post_mortem_streaming(str(tmp_path))
+    wall = time.monotonic() - t0
+    assert out["root_cause_ranks"] == [97]
+    assert out["timeline_total"] == 255 * 512
+    assert wall < 30.0, f"streaming merge took {wall:.1f}s"
+
+
+def test_report_cli_auto_selects_streaming(tmp_path, capsys):
+    from horovod_tpu.telemetry.report import main as report_main
+
+    write_sim_dumps(str(tmp_path), 24, 7, events_per_rank=32)
+    out_json = tmp_path / "analysis.json"
+    rc = report_main(["--post-mortem", str(tmp_path),
+                      "-o", str(out_json)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "root cause: rank(s) [7]" in text, text
+    analysis = json.loads(out_json.read_text())
+    # > _STREAM_THRESHOLD dumps -> the streaming merge (tail-bounded
+    # timeline with the total alongside).
+    assert "timeline_total" in analysis, sorted(analysis)

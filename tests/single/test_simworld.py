@@ -1,0 +1,87 @@
+"""Simulated large-world harness (docs/scale.md): thread-per-rank
+controllers over socketpairs run the REAL negotiation protocol — flat
+star and HOROVOD_CONTROL_TREE tree gather — plus the real ring
+allreduce, in one process. Pins:
+
+- negotiation + allreduce completes and verifies at both small and
+  large worlds, both gather modes (256 ranks = the north-star size);
+- the per-phase control-plane profile (gather/broadcast/rendezvous
+  histograms) comes out of every run — the scaling-curve plumbing;
+- the tree gather beats the flat star's GROWTH: sub-linear vs the
+  sequential baseline between 32 and 128 ranks (ratioed, so a loaded
+  CI box shifts both sides together);
+- an injected kill surfaces typed PeerFailure attribution naming the
+  dead rank on the survivors, flat and tree.
+"""
+
+import pytest
+
+from horovod_tpu.common.basics import HorovodBasics
+
+pytestmark = pytest.mark.quick
+
+_b = HorovodBasics()
+
+
+def _run(ranks, **kw):
+    return _b.simworld_run(ranks, **kw)
+
+
+def test_small_world_flat_and_tree_complete_and_verify():
+    for fanout in (0, 2):
+        rep = _run(8, tree_fanout=fanout, elems=512, rounds=3)
+        assert rep["rc"] == 0 and rep["allreduce_ok"], rep
+        assert rep["round_us"]["count"] == 3, rep
+        for phase in ("rendezvous", "gather", "broadcast"):
+            assert rep["phases"][phase]["count"] > 0, (fanout, phase)
+        # Steady state: rounds 2+ ride the response-cache bit path —
+        # the gather still records once per cycle.
+        assert rep["phases"]["gather"]["count"] == 3, rep
+
+
+@pytest.mark.slow
+def test_256_rank_world_completes_negotiation_and_allreduce():
+    # The acceptance world size (ISSUE r16 / ROADMAP item 5). ~10 s.
+    for fanout in (0, 8):
+        rep = _run(256, tree_fanout=fanout, elems=64, rounds=2)
+        assert rep["rc"] == 0 and rep["allreduce_ok"], (fanout, rep)
+        assert rep["data_mesh"] == "ring", rep  # fd-budget topology
+
+
+def test_tree_gather_grows_sublinearly_vs_flat():
+    """The tentpole claim, pinned at CI-safe sizes: growing the world
+    32 -> 128 (4x) must grow the tree gather's mean latency by LESS
+    than it grows the flat star's. Ratio-of-ratios, so machine speed
+    and load cancel; 1.35x headroom on top keeps a noisy box green
+    while still failing if the tree gather ever degenerates to
+    sequential behavior."""
+
+    def gather_mean(ranks, fanout):
+        rep = _run(ranks, tree_fanout=fanout, elems=64, rounds=6)
+        assert rep["rc"] == 0, rep
+        h = rep["phases"]["gather"]
+        return h["sum_us"] / h["count"]
+
+    flat_growth = gather_mean(128, 0) / max(gather_mean(32, 0), 1.0)
+    tree_growth = gather_mean(128, 8) / max(gather_mean(32, 8), 1.0)
+    assert tree_growth < flat_growth * 1.35, (
+        f"tree gather grew {tree_growth:.2f}x from 32->128 ranks vs "
+        f"flat {flat_growth:.2f}x — not sub-linear vs the baseline")
+
+
+def test_injected_kill_names_dead_rank_flat_and_tree():
+    for fanout in (0, 8):
+        rep = _run(64, tree_fanout=fanout, elems=64, rounds=3,
+                   kill_rank=37, kill_round=1)
+        assert rep["rc"] == 0, rep
+        fault = rep["fault"]
+        assert fault["typed_faults"] == 63, (fanout, fault)
+        assert fault["named_rank"] == 37, (fanout, fault)
+
+
+def test_refuses_to_run_next_to_live_core_and_bad_args():
+    # Bad arguments are rejected outright (rc -1 -> RuntimeError).
+    with pytest.raises(RuntimeError, match="bad arguments"):
+        _run(1)
+    with pytest.raises(RuntimeError, match="bad arguments"):
+        _run(8, kill_rank=3)  # kill without a kill_round
