@@ -23,7 +23,7 @@ ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
 .PHONY: core tf clean test test-quick test-flaky lint lint-csrc \
   core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
-  reshard-smoke chaos-smoke obs-smoke scale-smoke
+  reshard-smoke chaos-smoke obs-smoke scale-smoke perf-smoke
 
 core: $(OUT)
 
@@ -98,10 +98,13 @@ test-quick: core
 	python -m pytest tests/ -m "quick and not slow" -x -q
 
 # Rerun the load-flaky tests STANDALONE (serial, nothing else competing
-# for the box): the loadflaky-marked cases are timing-sensitive under
-# parallel load, so a shard failure is triaged by rerunning here — if
-# this lane is green, the shard failure was load, not a regression
-# (never hand-type the pytest invocation again).
+# for the box) and in CI ORDER: the exact plugin-disable set of the
+# tier-1 command (no xdist, no randomization, no cache) so collection
+# order matches what CI ran — a flake that depends on which test warmed
+# the core before it reproduces here or not at all. The loadflaky
+# discipline: run THIS lane before blaming a diff for a shard failure —
+# if it is green, the failure was load, not a regression (never
+# hand-type the pytest invocation again).
 test-flaky: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -m loadflaky -q \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
@@ -147,6 +150,16 @@ chaos-smoke: core
 # horovod_tpu/telemetry/obs_smoke.py; ~20 s).
 obs-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.obs_smoke
+
+# Step-anatomy smoke: 2 real ranks run an eager loop under a StepTimer
+# (step windows + overlap ledger) with a chaos delay:<ms> straggler
+# injection on rank 1 — asserts exposed + hidden == total wire time
+# reconciles within 1% of the wire_us histogram, and that the
+# cross-rank critical-path merge (report.py --critical-path) names the
+# delayed rank with phase "stall" on exactly the injected step
+# (docs/metrics.md; horovod_tpu/telemetry/perf_smoke.py; ~20 s).
+perf-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.perf_smoke
 
 # Large-world smoke: one 64-rank simulated world (thread-per-rank over
 # socketpairs, csrc/simworld.cc) runs a negotiation + allreduce round

@@ -56,6 +56,12 @@ from horovod_tpu.models import (
 _PEAK = {"v4": 275e12, "v5e": 197e12, "v5 lite": 197e12, "v5": 459e12,
          "v5p": 459e12, "v6e": 918e12, "cpu": 5e11}
 
+# Row-format version stamped on every emitted row (emit() below): bump
+# when a field is renamed or its meaning moves, so `--diff` and
+# `python -m horovod_tpu.telemetry.perfwatch` can refuse mismatched row
+# formats loudly instead of mis-comparing (schema 1 = the r17 format).
+BENCH_SCHEMA = 1
+
 
 def match_device_table(device, table, default_key="cpu"):
     """Longest-key-first substring match of device_kind against a
@@ -1145,6 +1151,95 @@ def _run_sweep(batch, seq, steps, emit):
         emit(row)
 
 
+# ---- bench-row diffing (`bench.py --diff old.json new.json`) ----------
+# The BENCH_r0*.json trajectory finally gets a tool instead of eyeballs:
+# load two row files (bench JSONL, a JSON array, or a driver artifact
+# whose `tail` embeds rows), match rows by their identity fields, and
+# print a per-row delta table over every numeric measurement field.
+
+# Fields that are neither identity nor comparable measurements. The
+# identity (join-key) field list is shared with perfwatch
+# (ROW_IDENTITY_FIELDS) so grouping and diffing can never disagree.
+_DIFF_SKIP_FIELDS = {"schema", "unit", "error", "ts", "wall_s", "tail"}
+
+
+def _diff_key(row, seen, key_fields):
+    key = tuple((f, row.get(f)) for f in key_fields if f in row)
+    n = seen.get(key, 0)
+    seen[key] = n + 1
+    return key + (("occurrence", n),) if n else key
+
+
+def _diff_rows(old_path, new_path, threshold=0.0):
+    """Compare two bench row files; returns (lines, worst_rel_change).
+    Refuses mismatched `schema` stamps — a renamed column diffed by
+    name is a silent lie, so format drift must fail loudly. Rows with a
+    nested `points` list (ring_busbw/hier_busbw) are flattened to one
+    pseudo-row per point first, so the per-size busbw measurements diff
+    like any other field."""
+    from horovod_tpu.telemetry.perfwatch import (
+        ROW_IDENTITY_FIELDS,
+        check_schema,
+        flatten_rows,
+        load_rows,
+    )
+
+    old_rows, new_rows = load_rows(old_path), load_rows(new_path)
+    old_schema = check_schema(old_rows, what=old_path)
+    new_schema = check_schema(new_rows, what=new_path)
+    if old_schema != new_schema:
+        raise SystemExit(
+            f"bench --diff: refusing to compare schema {old_schema} "
+            f"({old_path}) against schema {new_schema} ({new_path}) — "
+            "row formats differ; re-run the older side on this tree")
+    seen_old, seen_new = {}, {}
+    old_by_key = {_diff_key(r, seen_old, ROW_IDENTITY_FIELDS): r
+                  for r in flatten_rows(old_rows)}
+    new_by_key = {_diff_key(r, seen_new, ROW_IDENTITY_FIELDS): r
+                  for r in flatten_rows(new_rows)}
+    lines = [f"{'row':<52} {'field':<24} {'old':>12} {'new':>12} "
+             f"{'delta':>9}"]
+    worst = 0.0
+    for key in old_by_key:
+        if key not in new_by_key:
+            lines.append(f"{_key_str(key):<52} (only in {old_path})")
+            continue
+        old, new = old_by_key[key], new_by_key[key]
+        for field in sorted(set(old) & set(new)):
+            ov, nv = old[field], new[field]
+            if (field in _DIFF_SKIP_FIELDS
+                    or any(f == field for f, _ in key)
+                    or not isinstance(ov, (int, float))
+                    or not isinstance(nv, (int, float))
+                    or isinstance(ov, bool) or isinstance(nv, bool)):
+                continue
+            if ov:
+                rel = (nv - ov) / abs(ov)
+                delta = f"{rel:>+8.1%}"
+            elif nv:
+                # 0 -> x has no finite relative change: shown, never
+                # threshold-dropped, and it moves the worst tally (a
+                # counter appearing — crc_errors, stalls — IS news).
+                rel = None
+                delta = "    (new)"
+            else:
+                rel = 0.0
+                delta = f"{0.0:>+8.1%}"
+            if rel is not None and abs(rel) < threshold:
+                continue
+            worst = max(worst, abs(rel) if rel is not None else 1.0)
+            lines.append(f"{_key_str(key):<52} {field:<24} "
+                         f"{ov:>12.6g} {nv:>12.6g} {delta}")
+    for key in new_by_key:
+        if key not in old_by_key:
+            lines.append(f"{_key_str(key):<52} (only in {new_path})")
+    return lines, worst
+
+
+def _key_str(key):
+    return "/".join(str(v) for _, v in key if v is not None)
+
+
 def main():
     argv = sys.argv[1:]
     batch, seq, steps = _BENCH_SHAPE
@@ -1154,11 +1249,34 @@ def main():
         # discard minutes of already-measured rows. gc between rows
         # returns every stale device buffer before the next config
         # allocates. A list is several rows (run_eager yields its
-        # telemetry goodput row alongside the MFU headline).
+        # telemetry goodput row alongside the MFU headline). Every row
+        # is stamped with the format version HERE — one choke point —
+        # so --diff/perfwatch schema guards see a uniform stamp.
         for r in (row if isinstance(row, list) else [row]):
+            r.setdefault("schema", BENCH_SCHEMA)
             print(json.dumps(r), flush=True)
         gc.collect()
 
+    if "--diff" in argv:
+        # Two-point trajectory comparison (no accelerator needed):
+        # per-row delta table between any two bench row files.
+        # --diff-threshold 0.05 hides deltas under 5% (0->x rows are
+        # always shown — no finite relative change to threshold).
+        i = argv.index("--diff")
+        try:
+            old_path, new_path = argv[i + 1], argv[i + 2]
+        except IndexError:
+            raise SystemExit("usage: bench.py --diff old.json new.json "
+                             "[--diff-threshold 0.05]")
+        threshold = 0.0
+        if "--diff-threshold" in argv:
+            threshold = float(argv[argv.index("--diff-threshold") + 1])
+        lines, worst = _diff_rows(old_path, new_path,
+                                  threshold=threshold)
+        for line in lines:
+            print(line)
+        print(f"bench --diff: worst relative change {worst:+.1%}")
+        return
     if "--lint" in argv:
         # hvdlint preflight: statically analyze every shipped program
         # (collective divergence, axis validity, donation hazards,

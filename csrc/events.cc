@@ -42,6 +42,10 @@ const EventSpec kEventSpecs[(int)EventType::kTypeCount] = {
     {"stall", "waited_s", "missing", "", ""},
     {"fault_notice", "fault_rank", "received", "", ""},
     {"phase", "phase", "", "dur_us", ""},
+    // Step scoping (docs/metrics.md "Step anatomy"): every other event
+    // attributes to the step window its timestamp falls inside.
+    {"step_begin", "", "", "step", ""},
+    {"step_end", "", "", "step", "dur_us"},
 };
 
 const char* kKnobNames[] = {"fusion_bytes", "cycle_time_us", "ring_chunk",

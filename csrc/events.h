@@ -54,6 +54,8 @@ enum class EventType : int32_t {
   kStall,               // a=waited seconds, b=missing/blocking ranks
   kFaultNotice,         // a=fault rank, b=0 broadcast / 1 received
   kPhase,               // a=ControlPhase (metrics.h), c=dur_us
+  kStepBegin,           // c=step id (monotonic, hvdtpu_step_mark)
+  kStepEnd,             // c=step id, d=dur_us
   kTypeCount
 };
 

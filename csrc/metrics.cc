@@ -322,11 +322,15 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
               "\"cross_tx_bytes\":%lld,\"cross_rx_bytes\":%lld,"
               "\"cross_tx_logical_bytes\":%lld,"
               "\"cross_rx_logical_bytes\":%lld,"
-              "\"cross_compression_ratio\":%.6f},",
+              "\"cross_compression_ratio\":%.6f,",
          (long long)wtx, (long long)wrx, (long long)wtxl, (long long)wrxl,
          wtxl > 0 ? (double)wtx / (double)wtxl : 1.0,
          (long long)ctx, (long long)crx, (long long)ctxl, (long long)crxl,
          ctxl > 0 ? (double)ctx / (double)ctxl : 1.0);
+  // Step-anatomy overlap ledger (docs/metrics.md): how much of the
+  // wire time above was hidden under concurrent wire activity, per
+  // step window and plane.
+  out += "\"overlap\":" + GlobalLedger().Json() + "},";
 
   Append(out, "\"elastic\":{\"epoch\":%lld,\"faults_detected\":%lld,"
               "\"faults_recovered\":%lld,\"ranks_blacklisted\":%lld,"
@@ -370,6 +374,136 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
 Metrics& GlobalMetrics() {
   static Metrics* m = new Metrics();  // never destroyed: API threads may
   return *m;                          // record during process teardown
+}
+
+// ---- per-step overlap ledger ------------------------------------------
+
+void OverlapLedger::StepBegin(int64_t ts_us) {
+  std::lock_guard<std::mutex> lk(mu_);
+  open_ = true;
+  begin_us_ = ts_us;
+  for (auto& s : spans_) s.clear();
+}
+
+int64_t OverlapLedger::StepEnd(int64_t ts_us) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!open_) return -1;
+  open_ = false;
+  for (int p = 0; p < 2; p++) {
+    auto& spans = spans_[p];
+    int64_t total = 0, exposed = 0;
+    // Clip to the window, then union by a sorted sweep. total and
+    // exposed come from the SAME clipped set, so exposed + hidden ==
+    // total is exact by construction (the reconciliation contract).
+    // Time clipped OFF (a span straddling the step boundary, or a
+    // racing span entirely outside) books as unattributed — every
+    // span microsecond lands somewhere, so the ledger stays
+    // reconcilable against the wire_us histogram.
+    std::vector<std::pair<int64_t, int64_t>> clipped;
+    clipped.reserve(spans.size());
+    for (auto& [a, b] : spans) {
+      int64_t lo = a < begin_us_ ? begin_us_ : a;
+      int64_t hi = b > ts_us ? ts_us : b;
+      if (hi < lo) {
+        unattributed_us_ += b - a;  // fully outside (racing span)
+        continue;
+      }
+      clipped.emplace_back(lo, hi);
+      total += hi - lo;
+      unattributed_us_ += (b - a) - (hi - lo);  // the clipped-off part
+    }
+    std::sort(clipped.begin(), clipped.end());
+    int64_t cur_lo = 0, cur_hi = -1;
+    for (auto& [lo, hi] : clipped) {
+      if (cur_hi < 0) {
+        cur_lo = lo;
+        cur_hi = hi;
+      } else if (lo <= cur_hi) {  // overlapping or abutting: extend
+        if (hi > cur_hi) cur_hi = hi;
+      } else {
+        exposed += cur_hi - cur_lo;
+        cur_lo = lo;
+        cur_hi = hi;
+      }
+    }
+    if (cur_hi >= 0) exposed += cur_hi - cur_lo;
+    PlaneLedger& pl = planes_[p];
+    pl.last_total_us = total;
+    pl.last_exposed_us = exposed;
+    pl.last_hidden_us = total - exposed;
+    pl.total_us += total;
+    pl.exposed_us += exposed;
+    pl.hidden_us += total - exposed;
+    spans.clear();
+  }
+  steps_++;
+  return ts_us - begin_us_;
+}
+
+void OverlapLedger::AddSpan(int plane, int64_t start_us, int64_t end_us) {
+  if (end_us < start_us) return;
+  if (plane != 1) plane = 0;
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!open_ || end_us <= begin_us_) {
+    unattributed_us_ += end_us - start_us;
+    return;
+  }
+  // Bound the open-window span list: a window left open forever (a
+  // driver that stopped marking — e.g. the optimizer boundary after
+  // the last apply(), with eval traffic still flowing) must not grow
+  // memory without limit. Past the cap, span time books unattributed
+  // (reconcilable, just not union-decomposed) — 64k spans is ~1 MB
+  // and far beyond any real step's collective count.
+  if (spans_[plane].size() >= (size_t)kMaxSpansPerPlane) {
+    unattributed_us_ += end_us - start_us;
+    return;
+  }
+  spans_[plane].emplace_back(start_us, end_us);
+}
+
+void OverlapLedger::Reset() {
+  std::lock_guard<std::mutex> lk(mu_);
+  open_ = false;
+  begin_us_ = 0;
+  steps_ = 0;
+  unattributed_us_ = 0;
+  for (auto& s : spans_) s.clear();
+  for (auto& p : planes_) p = PlaneLedger();
+}
+
+std::string OverlapLedger::Json() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t exp_us = planes_[0].exposed_us + planes_[1].exposed_us;
+  int64_t hid_us = planes_[0].hidden_us + planes_[1].hidden_us;
+  int64_t tot_us = exp_us + hid_us;
+  std::string out = "{";
+  Append(out, "\"steps\":%lld,\"unattributed_us\":%lld,"
+              "\"exposed_wire_ms\":%.3f,\"hidden_wire_ms\":%.3f,"
+              "\"overlap_efficiency\":%.6f",
+         (long long)steps_, (long long)unattributed_us_,
+         (double)exp_us / 1000.0, (double)hid_us / 1000.0,
+         tot_us > 0 ? (double)hid_us / (double)tot_us : 0.0);
+  const char* names[2] = {"intra", "cross"};
+  for (int p = 0; p < 2; p++) {
+    const PlaneLedger& pl = planes_[p];
+    Append(out, ",\"%s\":{\"exposed_us\":%lld,\"hidden_us\":%lld,"
+                "\"total_us\":%lld,\"overlap_efficiency\":%.6f,"
+                "\"last_exposed_us\":%lld,\"last_hidden_us\":%lld,"
+                "\"last_total_us\":%lld}",
+           names[p], (long long)pl.exposed_us, (long long)pl.hidden_us,
+           (long long)pl.total_us,
+           pl.total_us > 0 ? (double)pl.hidden_us / (double)pl.total_us
+                           : 0.0,
+           (long long)pl.last_exposed_us, (long long)pl.last_hidden_us,
+           (long long)pl.last_total_us);
+  }
+  out += "}";
+  return out;
+}
+
+OverlapLedger& GlobalLedger() {
+  static OverlapLedger* l = new OverlapLedger();  // lifetime contract
+  return *l;                                      // as GlobalMetrics
 }
 
 }  // namespace hvdtpu

@@ -199,6 +199,68 @@ class Metrics {
 // monotonic for the lifetime of the process (scrapers diff snapshots).
 Metrics& GlobalMetrics();
 
+// Per-step overlap ledger (docs/metrics.md "Overlap ledger"):
+// interval-union math over the wire spans recorded inside one step
+// window [hvdtpu_step_mark(1), hvdtpu_step_mark(0)]. Per plane
+// (0 intra/flat, 1 cross-slice), per step:
+//
+//   total    = sum of wire-span durations (the serial wire cost)
+//   exposed  = measure of their interval UNION (wall time the step
+//              actually spent with >= 1 transfer in flight)
+//   hidden   = total - exposed (wire time that ran concurrently with
+//              other wire traffic: pipelined chunks, overlapped
+//              buckets, simultaneous planes — the overlap win)
+//
+// exposed + hidden == total EXACTLY by construction (both are computed
+// from the same clipped interval set) — the reconciliation the
+// perf-smoke/reshard-smoke lanes assert against the wire_us histogram.
+// overlap_efficiency = hidden / total (0 with no wire traffic).
+//
+// Concurrency: spans arrive from the background loop / reduce-worker
+// threads (WireTally destructors), step marks from whichever API
+// thread drives the loop — one small mutex; every call is O(spans in
+// the open step) at worst, and the hot path (AddSpan) is O(1).
+class OverlapLedger {
+ public:
+  void StepBegin(int64_t ts_us);
+  // Close the open step: computes the per-plane union accounting over
+  // the spans recorded since StepBegin. Returns the step duration in
+  // us, or -1 when no step was open.
+  int64_t StepEnd(int64_t ts_us);
+  // One completed wire span. Outside any step window the duration is
+  // booked as `unattributed` (still reconcilable against wire_us).
+  void AddSpan(int plane, int64_t start_us, int64_t end_us);
+  void Reset();
+  // The "overlap" object embedded in the snapshot's wire section:
+  // {"steps":..,"unattributed_us":..,"exposed_wire_ms":..,
+  //  "hidden_wire_ms":..,"overlap_efficiency":..,
+  //  "intra":{exposed_us,hidden_us,total_us,overlap_efficiency,
+  //           last_exposed_us,last_hidden_us,last_total_us},
+  //  "cross":{...}}
+  std::string Json() const;
+
+  // Open-window span cap: beyond this, AddSpan books straight to
+  // unattributed (a never-closed window must not grow without bound).
+  static constexpr int64_t kMaxSpansPerPlane = 65536;
+
+ private:
+  struct PlaneLedger {
+    int64_t exposed_us = 0, hidden_us = 0, total_us = 0;  // cumulative
+    int64_t last_exposed_us = 0, last_hidden_us = 0,      // last step
+        last_total_us = 0;
+  };
+  mutable std::mutex mu_;
+  bool open_ = false;
+  int64_t begin_us_ = 0;
+  int64_t steps_ = 0;           // completed step windows
+  int64_t unattributed_us_ = 0;  // span time outside any step window
+  std::vector<std::pair<int64_t, int64_t>> spans_[2];  // open step
+  PlaneLedger planes_[2];
+};
+
+// Process-wide ledger, same lifetime contract as the registry.
+OverlapLedger& GlobalLedger();
+
 // RAII wall-clock span recorded into a histogram on destruction.
 class ScopedLatency {
  public:

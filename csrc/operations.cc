@@ -2424,9 +2424,50 @@ int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap) {
   return (int64_t)json.size();
 }
 
+// ---- step scoping (docs/metrics.md "Step anatomy") --------------------
+// One per-process step cursor, driven from above the core (StepTimer
+// boundaries, the eager optimizer step): kStepBegin/kStepEnd events
+// bracket every other event's timestamp into a step window, and the
+// overlap ledger unions the wire spans inside it. Valid before init —
+// the ring and the ledger outlive init/shutdown like the registry.
+static std::atomic<int64_t> g_step_counter{0};
+static std::atomic<int64_t> g_open_step{-1};
+
 int hvdtpu_metrics_reset() {
   GlobalMetrics().Reset();
+  GlobalLedger().Reset();
+  // The ledger's open window died with the reset — drop the cursor
+  // too, or step_id() keeps advertising a window whose ledger state
+  // is gone and the next step_mark(false) books a -1-duration end.
+  // The id counter stays monotonic: step ids must never repeat within
+  // a process (offline dumps match steps across ranks by id).
+  g_open_step.store(-1, std::memory_order_release);
   return 0;
+}
+
+int64_t hvdtpu_step_mark(int begin) {
+  // begin != 0: open a new step window (a still-open one is closed
+  // first — boundary semantics, so a mark-per-iteration driver needs
+  // no explicit end). Returns the new step id (monotonic from 1).
+  // begin == 0: close the open window; returns its id, or -1 if none.
+  int64_t now = MetricsNowUs();
+  int64_t open = g_open_step.exchange(-1, std::memory_order_acq_rel);
+  if (open >= 0) {
+    int64_t dur = GlobalLedger().StepEnd(now);
+    GlobalEvents().Record(EventType::kStepEnd, 0, 0, open, dur);
+  }
+  if (!begin) return open >= 0 ? open : -1;
+  int64_t id = g_step_counter.fetch_add(1, std::memory_order_relaxed) + 1;
+  GlobalLedger().StepBegin(now);
+  GlobalEvents().Record(EventType::kStepBegin, 0, 0, id);
+  g_open_step.store(id, std::memory_order_release);
+  return id;
+}
+
+int64_t hvdtpu_step_id() {
+  // The currently open step id, or -1 — how an implicit driver (the
+  // eager optimizer boundary) defers to an explicit scope (StepTimer).
+  return g_open_step.load(std::memory_order_acquire);
 }
 
 // Record one control-plane phase duration from ABOVE the core: the

@@ -162,6 +162,16 @@ int64_t hvdtpu_response_cache_entries();
 // hvd.metrics() through horovod_tpu/telemetry.
 int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap);
 int hvdtpu_metrics_reset();
+
+// Step scoping (docs/metrics.md "Step anatomy"): mark a training-step
+// boundary. begin != 0 opens a new step window with a fresh monotonic
+// id (closing any still-open one — boundary semantics) and returns the
+// id; begin == 0 closes the open window and returns its id (-1 if
+// none). kStepBegin/kStepEnd land in the event ring and the per-step
+// wire overlap ledger aggregates between the marks. Valid before init.
+int64_t hvdtpu_step_mark(int begin);
+// The currently open step id, or -1.
+int64_t hvdtpu_step_id();
 }
 
 #endif  // HVDTPU_OPERATIONS_H

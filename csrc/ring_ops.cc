@@ -218,10 +218,15 @@ struct DataPlane::WireTally {
     SetEventWirePlane(0);
     if (tx || rx || tx_logical || rx_logical) {
       GlobalMetrics().AccountWire(plane, tx, rx, tx_logical, rx_logical);
-      int64_t dur = MetricsNowUs() - start_us;
+      int64_t end_us = MetricsNowUs();
+      // The span interval feeds the per-step overlap ledger — the SAME
+      // [start,end) the kWireSpan event encodes, so the ledger and the
+      // flight recorder can never disagree about what the wire did.
+      GlobalLedger().AddSpan(plane, start_us, end_us);
       GlobalEvents().Record(
           EventType::kWireSpan, plane,
-          (int32_t)std::min<int64_t>(dur, INT32_MAX), tx, rx);
+          (int32_t)std::min<int64_t>(end_us - start_us, INT32_MAX), tx,
+          rx);
     }
   }
 };

@@ -128,6 +128,10 @@ def load_library():
         lib.hvdtpu_metrics_reset.argtypes = []
         lib.hvdtpu_record_phase.restype = None
         lib.hvdtpu_record_phase.argtypes = [i32, i64]
+        lib.hvdtpu_step_mark.restype = i64
+        lib.hvdtpu_step_mark.argtypes = [i32]
+        lib.hvdtpu_step_id.restype = i64
+        lib.hvdtpu_step_id.argtypes = []
         lib.hvdtpu_queue_depth.restype = i64
         lib.hvdtpu_queue_depth.argtypes = []
         lib.hvdtpu_simworld_run.restype = i32
@@ -381,6 +385,25 @@ class HorovodBasics:
         if isinstance(phase, str):
             phase = self.CONTROL_PHASES.index(phase)
         self.lib.hvdtpu_record_phase(int(phase), int(dur_us))
+
+    def step_mark(self, begin=True):
+        """Mark a training-step boundary for the step-anatomy layer
+        (docs/metrics.md): ``begin=True`` opens a new step window with
+        a fresh monotonic id (closing a still-open one first — boundary
+        semantics) and returns the id; ``begin=False`` closes the open
+        window and returns its id (-1 if none). ``step_begin``/
+        ``step_end`` events land in the flight recorder and the wire
+        overlap ledger aggregates between the marks. Valid before
+        ``init()``. Driven by :class:`~horovod_tpu.telemetry.step_timer.
+        StepTimer` and the eager optimizer step; call directly only
+        when neither scopes your loop."""
+        return int(self.lib.hvdtpu_step_mark(1 if begin else 0))
+
+    def step_id(self):
+        """The currently open step id, or -1 — how an implicit step
+        driver (the eager optimizer boundary) defers to an explicit
+        scope (StepTimer)."""
+        return int(self.lib.hvdtpu_step_id())
 
     def queue_depth(self):
         """Live pending-tensor gauge: collectives enqueued by API

@@ -77,6 +77,7 @@ from horovod_tpu.jax.mpi_ops import (  # noqa: F401
     shutdown,
     size,
     start_timeline,
+    step_mark,
     stop_timeline,
     synchronize,
 )

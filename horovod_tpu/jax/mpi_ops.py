@@ -172,6 +172,17 @@ def debug_port():
     return debug_server.debug_port()
 
 
+def step_mark(begin=True):
+    """Mark a training-step boundary for the step-anatomy layer
+    (docs/metrics.md "Step anatomy"): ``step_begin``/``step_end``
+    events scope every other flight-recorder event to a step window and
+    the wire overlap ledger unions the wire spans inside it. Driven
+    automatically by ``telemetry.StepTimer`` and
+    ``hvd.DistributedFusedAdam``; call directly only when neither
+    scopes your loop. Returns the step id."""
+    return _basics.step_mark(begin)
+
+
 is_initialized = _basics.is_initialized
 rank = _basics.rank
 size = _basics.size

@@ -18,6 +18,29 @@ from horovod_tpu.jax import mpi_ops
 from horovod_tpu.jax.compression import Compression
 
 
+# Step scoping from the eager optimizer (docs/metrics.md "Step
+# anatomy"): each fused-optimizer apply() is a step BOUNDARY — the
+# previous implicit window closes and the next opens, so window k spans
+# "apply k returned" to "apply k+1 returned" = one full train step
+# (grad compute + allreduce + update). Defers to an explicit scope: a
+# StepTimer that opened a step the optimizer did not is driving the
+# marks, and a second driver would fragment its windows.
+_last_boundary_id = None
+
+
+def _mark_optimizer_step():
+    global _last_boundary_id
+    try:
+        from horovod_tpu.telemetry import core as _tcore
+
+        open_id = _tcore.step_id()
+        if open_id >= 0 and open_id != _last_boundary_id:
+            return  # an explicit scope (StepTimer) owns the window
+        _last_boundary_id = _tcore.step_mark(True)
+    except Exception:  # noqa: BLE001 — telemetry must never take the
+        pass           # training step down
+
+
 def allreduce_gradients(grads, op=mpi_ops.Average,
                         compression=Compression.none, prefix="grad",
                         donate=False):
@@ -146,10 +169,13 @@ def DistributedFusedAdam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
     from horovod_tpu.parallel.precision import FusedOptimizer, fused_adam
 
     if zero:
-        return _zero_fused_adam(learning_rate, b1, b2, eps, op=op,
+        zopt = _zero_fused_adam(learning_rate, b1, b2, eps, op=op,
                                 compression=compression,
                                 bucket_bytes=bucket_bytes,
                                 overlap=overlap)
+        return FusedOptimizer(init=zopt.init,
+                              apply=_boundary_marked(zopt.apply),
+                              hyper=zopt.hyper)
 
     inner = fused_adam(learning_rate, b1=b1, b2=b2, eps=eps)
 
@@ -165,8 +191,21 @@ def DistributedFusedAdam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
                                     donate=True)
         return jitted_apply(params, grads, state)
 
-    return FusedOptimizer(init=inner.init, apply=apply,
+    return FusedOptimizer(init=inner.init,
+                          apply=_boundary_marked(apply),
                           hyper=inner.hyper)
+
+
+def _boundary_marked(apply_fn):
+    """Wrap an optimizer apply so every completed update marks a step
+    boundary (see :func:`_mark_optimizer_step`)."""
+    @functools.wraps(apply_fn)
+    def apply(params, grads, state):
+        out = apply_fn(params, grads, state)
+        _mark_optimizer_step()
+        return out
+
+    return apply
 
 
 def _zero_fused_adam(learning_rate, b1, b2, eps, op, compression,

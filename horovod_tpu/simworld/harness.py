@@ -88,6 +88,65 @@ def scaling_profile(world_sizes=DEFAULT_WORLD_SIZES,
 # exactly the proof-vs-suspicion geometry merge_post_mortem untangles.
 
 
+def write_sim_step_dumps(out_dir, ranks, steps, slow_rank, step_ms=120,
+                         wire_ms=15, slow_ms=60, epoch=0, skew_us=900):
+    """Synthesize per-rank STEP-ANATOMY dumps for the critical-path
+    merge at fleet scale (the step-window twin of
+    :func:`write_sim_dumps`): every rank records the same
+    ``step_begin``/``step_end`` windows (one id sequence — the SPMD
+    mark contract), but ``slow_rank`` spends ``slow_ms`` extra in
+    unrecorded compute each step while everyone else's wire span
+    stretches to absorb the wait — exactly the signature a real
+    straggler leaves, so ``critpath.critical_path`` must name
+    ``slow_rank`` with phase ``compute`` on EVERY step
+    (tests/single/test_critpath.py pins this at 64 ranks; r16 gotcha 1
+    applies — the in-process simworld cannot emit real per-rank files).
+
+    Returns the list of dump paths."""
+    os.makedirs(out_dir, exist_ok=True)
+    base_unix = int(time.time() * 1e6)
+    total_us = (step_ms + slow_ms) * 1000
+    wire_us = wire_ms * 1000
+    paths = []
+    for rank in range(ranks):
+        path = os.path.join(out_dir, f"blackbox-rank{rank}.jsonl")
+        steady0 = 5_000_000 + rank * 333_007
+        unix0 = base_unix + skew_us * rank  # simulated NTP skew
+        header = {
+            "kind": "blackbox_header", "rank": rank, "size": ranks,
+            "epoch": epoch, "unix_us": unix0, "steady_us": steady0,
+            "fault": {},
+        }
+        lines = [json.dumps(header)]
+        seq = 0
+        for k in range(1, steps + 1):
+            begin = steady0 + (k - 1) * total_us
+            end = begin + total_us
+            lines.append(json.dumps({
+                "seq": seq, "ts_us": begin, "type": "step_begin",
+                "step": k}))
+            seq += 1
+            # The slow rank computes for most of the window and runs a
+            # short span at the end; everyone else finishes local work
+            # quickly and their span blocks until the slow rank's data
+            # arrives (span stamped at its END with dur_us).
+            dur = wire_us if rank == slow_rank else \
+                total_us - wire_us - 2000
+            lines.append(json.dumps({
+                "seq": seq, "ts_us": end - 1000, "type": "wire_span",
+                "plane": 0, "dur_us": dur, "tx_bytes": 1 << 20,
+                "rx_bytes": 1 << 20}))
+            seq += 1
+            lines.append(json.dumps({
+                "seq": seq, "ts_us": end, "type": "step_end",
+                "step": k, "dur_us": total_us}))
+            seq += 1
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        paths.append(path)
+    return paths
+
+
 def write_sim_dumps(out_dir, ranks, fault_rank, events_per_rank=64,
                     epoch=0, skew_us=1500):
     """Write ``ranks - 1`` survivor dumps (the dead rank writes none —

@@ -33,6 +33,16 @@ the Chrome timeline and the autotune log). Three pieces:
    (``HOROVOD_DEBUG_PORT``) serves ``/healthz`` ``/metrics``
    ``/events`` ``/stacks`` per rank, live.
 
+5. **Step anatomy** — :func:`step_mark` windows (driven by
+   :class:`StepTimer` and the eager optimizer) scope every event to a
+   step; the core's overlap ledger (``wire.overlap``) splits wire time
+   into exposed vs hidden per plane,
+   :mod:`~horovod_tpu.telemetry.critpath` attributes each step's wall
+   time to the blocking rank and phase across ranks
+   (``report --critical-path``), and
+   :mod:`~horovod_tpu.telemetry.perfwatch` gates CI on step-time/
+   busbw/overlap-efficiency regressions (``perfwatch --budget``).
+
 See ``docs/metrics.md`` for the counter catalog and walkthroughs.
 """
 
@@ -41,8 +51,16 @@ from horovod_tpu.telemetry.core import (  # noqa: F401
     events_drain,
     metrics_reset,
     snapshot,
+    step_id,
+    step_mark,
     total_collective_bytes,
+    wire_overlap,
     wire_plane_bytes,
+)
+from horovod_tpu.telemetry.critpath import (  # noqa: F401
+    critical_path,
+    format_critical_path,
+    write_event_dump,
 )
 from horovod_tpu.telemetry.exporters import MetricsScraper  # noqa: F401
 from horovod_tpu.telemetry.postmortem import (  # noqa: F401

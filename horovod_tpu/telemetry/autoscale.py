@@ -46,6 +46,13 @@ class Signals:
     heal_rate: float = 0.0         # wire heals since last observation
     fault_rate: float = 0.0        # faults since last observation
     pending_rejoiners: int = 0     # paroled joiners waiting at the door
+    # Step-anatomy additions (r17, defaulted so pre-r17 observation
+    # sources — recorded traces, older /healthz payloads — still
+    # construct Signals unchanged): the overlap ledger's combined
+    # hidden/total wire fraction and cumulative exposed wire wall time
+    # (docs/metrics.md "Overlap ledger").
+    overlap_efficiency: float = 0.0
+    exposed_wire_ms: float = 0.0
 
 
 @dataclass
@@ -194,6 +201,7 @@ def collect_signals(basics=None, t=None):
         step_ms = step_time_ewma_ms() or 0.0
     except Exception:  # noqa: BLE001
         pass
+    overlap = snap.get("wire", {}).get("overlap", {})
     return Signals(
         t=_time.monotonic() if t is None else t,
         world_size=b.size() if b.is_initialized() else 1,
@@ -204,6 +212,9 @@ def collect_signals(basics=None, t=None):
         heal_rate=float(heals - prev["heals"]),
         fault_rate=float(faults - prev["faults"]),
         pending_rejoiners=pending,
+        overlap_efficiency=float(
+            overlap.get("overlap_efficiency", 0.0)),
+        exposed_wire_ms=float(overlap.get("exposed_wire_ms", 0.0)),
     )
 
 

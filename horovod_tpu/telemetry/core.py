@@ -64,6 +64,29 @@ def wire_plane_bytes(snap=None):
             w.get("tx_logical_bytes", 0) - cross_l, cross, cross_l)
 
 
+def step_mark(begin=True):
+    """Mark a step boundary (see ``HorovodBasics.step_mark``); returns
+    the step id. The StepTimer calls this at its own boundaries so the
+    core-side overlap ledger and the Python wall clock scope the same
+    window."""
+    return _basics.step_mark(begin)
+
+
+def step_id():
+    """The currently open step id, or -1."""
+    return _basics.step_id()
+
+
+def wire_overlap(snap=None):
+    """The per-step wire overlap ledger (``wire.overlap`` of the
+    snapshot, docs/metrics.md): cumulative + last-step exposed/hidden/
+    total wire time per plane, and the combined ``overlap_efficiency``.
+    Empty dict when the core is unavailable."""
+    if snap is None:
+        snap = snapshot()
+    return snap.get("wire", {}).get("overlap", {})
+
+
 def events(last_n=0):
     """The newest ``last_n`` structured ring events (non-consuming;
     see ``docs/metrics.md`` for the event catalog)."""

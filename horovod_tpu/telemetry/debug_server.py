@@ -86,9 +86,21 @@ def _healthz(basics):
         out["straggler_skew_ms"] = round(
             snap.get("straggler", {}).get("skew_us", {}).get("p90_us", 0)
             / 1000.0, 3)
+        # Step-anatomy overlap ledger (docs/metrics.md): the combined
+        # hidden/total wire fraction inside step windows, and the
+        # cumulative wall time the steps spent with wire in flight —
+        # the overlap-efficiency trend signal the autoscaler and
+        # perfwatch read off this endpoint.
+        ov = snap.get("wire", {}).get("overlap", {})
+        out["overlap_efficiency"] = round(
+            ov.get("overlap_efficiency", 0.0), 6)
+        out["exposed_wire_ms"] = round(
+            ov.get("exposed_wire_ms", 0.0), 3)
     except Exception as e:  # noqa: BLE001 — health must answer anyway
         out["metrics_error"] = str(e)
         out["straggler_skew_ms"] = 0.0
+        out["overlap_efficiency"] = 0.0
+        out["exposed_wire_ms"] = 0.0
     return out
 
 

@@ -65,6 +65,25 @@ def _flatten_prom(snap, rank):
     for field in ("compression_ratio", "cross_compression_ratio"):
         lines.append(f'hvdtpu_wire_{field}{{{label}}} '
                      f'{wire.get(field, 1.0)}')
+    # Step-anatomy overlap ledger (docs/metrics.md): exposed vs hidden
+    # wire time per plane — the overlap-efficiency trend perfwatch and
+    # the fusion-work acceptance criterion watch.
+    ov = wire.get("overlap", {})
+    lines.append(f'hvdtpu_overlap_steps_total{{{label}}} '
+                 f'{ov.get("steps", 0)}')
+    lines.append(f'hvdtpu_overlap_unattributed_us_total{{{label}}} '
+                 f'{ov.get("unattributed_us", 0)}')
+    lines.append(f'hvdtpu_overlap_efficiency{{{label}}} '
+                 f'{ov.get("overlap_efficiency", 0.0)}')
+    for plane in ("intra", "cross"):
+        p = ov.get(plane, {})
+        for field in ("exposed_us", "hidden_us", "total_us"):
+            lines.append(
+                f'hvdtpu_overlap_{field}_total{{plane="{plane}",'
+                f'{label}}} {p.get(field, 0)}')
+        lines.append(
+            f'hvdtpu_overlap_plane_efficiency{{plane="{plane}",'
+            f'{label}}} {p.get("overlap_efficiency", 0.0)}')
     # Elastic fault lifecycle (docs/elastic.md): the counters an
     # alerting rule watches — faults/heals/retries/CRC errors moving is
     # the flaky-host signal, epoch divergence the split-brain one.

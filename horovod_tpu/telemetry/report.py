@@ -324,7 +324,26 @@ def main(argv=None):
                          "merge (bounded memory, timeline tail only). "
                          "Selected automatically above %d dumps."
                          % _STREAM_THRESHOLD)
+    ap.add_argument("--critical-path", action="store_true",
+                    help="positional args are per-rank event dumps "
+                         "(black-box JSONL or live write_event_dump "
+                         "traces, or their directory): merge the step "
+                         "windows across ranks and name, per step, the "
+                         "rank and phase (compute/negotiation/wire/"
+                         "stall) that bounded it; -o writes the "
+                         "analysis as JSON")
     args = ap.parse_args(argv)
+
+    if args.critical_path:
+        from horovod_tpu.telemetry import critpath
+
+        analysis = critpath.critical_path(args.timelines)
+        print(critpath.format_critical_path(analysis))
+        if args.output != "merged_timeline.json":
+            with open(args.output, "w") as f:
+                json.dump(analysis, f, indent=2)
+            print(f"wrote {args.output}")
+        return 0
 
     if args.post_mortem:
         from horovod_tpu.telemetry import postmortem

@@ -119,6 +119,12 @@ class StepTimer:
         # traffic (core.wire_plane_bytes) — cross is the DCN-priced
         # inter-slice hop of the hierarchical decomposition.
         self.plane_bytes_per_step = []
+        # Per-step overlap ledger rows (docs/metrics.md "Overlap
+        # ledger"): {plane: (exposed_us, hidden_us, total_us)} straight
+        # from the core's interval-union math over the step window this
+        # timer's own marks opened (exposed + hidden == total exactly).
+        self.overlap_per_step = []
+        self._step_id = None
         self._t0 = None
         self._bytes0 = None
         self._wire0 = None
@@ -139,18 +145,26 @@ class StepTimer:
 
     def _read_bytes(self):
         # One snapshot serves the logical-payload, wire-vs-logical,
-        # and per-plane counters alike.
+        # per-plane, and overlap-ledger reads alike.
         try:
             snap = _core.snapshot()
         except Exception:  # noqa: BLE001 — core not built/loaded: the
-            return None, None, None  # timer still measures wall + MFU
+            return None, None, None, None  # timer still measures wall
         return (_core.total_collective_bytes(
                     snap, op_classes=self.byte_op_classes),
                 _core.wire_bytes(snap),
-                _core.wire_plane_bytes(snap))
+                _core.wire_plane_bytes(snap),
+                _core.wire_overlap(snap))
 
     def start_step(self):
-        self._bytes0, self._wire0, self._plane0 = self._read_bytes()
+        self._bytes0, self._wire0, self._plane0, _ = self._read_bytes()
+        # Open the core-side step window (kStepBegin + overlap ledger,
+        # docs/metrics.md "Step anatomy") AFTER the byte snapshot so
+        # the window brackets exactly what this step moves.
+        try:
+            self._step_id = _core.step_mark(True)
+        except Exception:  # noqa: BLE001 — core not built/loaded
+            self._step_id = None
         self._t0 = time.perf_counter()
 
     def end_step(self, outputs=None):
@@ -165,7 +179,15 @@ class StepTimer:
                 pass
         self.step_times.append(time.perf_counter() - self._t0)
         _update_step_ewma(self.step_times[-1] * 1000.0)
-        b1, w1, p1 = self._read_bytes()
+        # Close the window BEFORE the snapshot: the ledger folds the
+        # step's wire spans on kStepEnd, so the read below sees this
+        # step's union accounting in wire.overlap.*.last_*.
+        if self._step_id is not None:
+            try:
+                _core.step_mark(False)
+            except Exception:  # noqa: BLE001
+                pass
+        b1, w1, p1, ov = self._read_bytes()
         if self._bytes0 is not None and b1 is not None:
             self.bytes_per_step.append(b1 - self._bytes0)
         if self._wire0 is not None and w1 is not None:
@@ -174,6 +196,13 @@ class StepTimer:
         if self._plane0 is not None and p1 is not None:
             self.plane_bytes_per_step.append(
                 tuple(a - b for a, b in zip(p1, self._plane0)))
+        if self._step_id is not None and ov:
+            self.overlap_per_step.append({
+                plane: (ov[plane]["last_exposed_us"],
+                        ov[plane]["last_hidden_us"],
+                        ov[plane]["last_total_us"])
+                for plane in ("intra", "cross") if plane in ov})
+        self._step_id = None
         self._t0 = None
 
     class _Step:
@@ -299,6 +328,46 @@ class StepTimer:
             }
         return out
 
+    def overlap_summary(self, skip_first=True):
+        """Per-plane step-anatomy ledger over the recorded steps
+        (docs/metrics.md "Overlap ledger"): ``{plane:
+        {mean_exposed_wire_ms, mean_hidden_wire_ms,
+        mean_total_wire_ms, overlap_efficiency}}`` plus a combined
+        ``overlap_efficiency`` across planes. ``exposed`` is wall time
+        inside the step with >= 1 transfer in flight (the interval
+        union of wire spans); ``hidden = total - exposed`` is wire
+        time that ran concurrently with other wire traffic — the
+        pipelining/overlap win the jit-lane fusion work must move
+        (ROADMAP item 3). exposed + hidden == total exactly, per step,
+        by construction. The ``mean_`` prefix is deliberate: the
+        snapshot's ``wire.overlap`` and ``/healthz`` expose CUMULATIVE
+        ``exposed_wire_ms`` totals under the unprefixed names — the
+        two shapes must not share a key. ``None`` until a step
+        recorded ledger rows."""
+        vals = self.overlap_per_step
+        if skip_first and len(vals) > 1:
+            vals = vals[1:]
+        if not vals:
+            return None
+        n = len(vals)
+        out = {}
+        all_exp = all_tot = 0
+        for plane in ("intra", "cross"):
+            exp = sum(v[plane][0] for v in vals if plane in v)
+            hid = sum(v[plane][1] for v in vals if plane in v)
+            tot = sum(v[plane][2] for v in vals if plane in v)
+            all_exp += exp
+            all_tot += tot
+            out[plane] = {
+                "mean_exposed_wire_ms": exp / 1000.0 / n,
+                "mean_hidden_wire_ms": hid / 1000.0 / n,
+                "mean_total_wire_ms": tot / 1000.0 / n,
+                "overlap_efficiency": (hid / tot) if tot else 0.0,
+            }
+        out["overlap_efficiency"] = (
+            (all_tot - all_exp) / all_tot if all_tot else 0.0)
+        return out
+
     def summary(self):
         """One JSON-ready row of everything the timer knows."""
         snap = None
@@ -317,6 +386,7 @@ class StepTimer:
             "wire_goodput_gbps": self.wire_goodput_gbps(),
             "wire_compression_ratio": self.wire_compression_ratio(),
             "plane_wire": self.plane_wire_summary(),
+            "overlap": self.overlap_summary(),
         }
         if snap and snap.get("initialized"):
             row["cache_hit_rate"] = snap["cache"]["hit_rate"]

@@ -266,13 +266,19 @@ def test_healthz_and_stacks_respond_while_peer_sigstopped(tmp_path):
     health = json.loads(polled["healthz"])
     assert health["rank"] == 0 and health["initialized"], health
     # The autoscaler's signal set rides /healthz (docs/scale.md): one
-    # endpoint serves everything the scaling policy consumes.
+    # endpoint serves everything the scaling policy consumes — field
+    # set PINNED here (r17 adds the overlap-ledger pair; autoscale
+    # Signals defaults keep older payloads constructing).
     for key in ("queue_depth", "straggler_skew_ms", "step_time_ewma_ms",
-                "pending_rejoiners", "debug_port"):
+                "pending_rejoiners", "debug_port", "overlap_efficiency",
+                "exposed_wire_ms"):
         assert key in health, (key, sorted(health))
     assert health["debug_port"] == dbg_port, health
     assert isinstance(health["queue_depth"], int), health
     assert isinstance(health["pending_rejoiners"], int), health
+    assert isinstance(health["overlap_efficiency"], float), health
+    assert isinstance(health["exposed_wire_ms"], float), health
+    assert 0.0 <= health["overlap_efficiency"] <= 1.0, health
     assert isinstance(polled.get("stacks"), bytes), polled
     assert b"File" in polled["stacks"] or b"Thread" in polled["stacks"]
     assert isinstance(polled.get("events"), bytes), polled

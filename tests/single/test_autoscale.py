@@ -37,6 +37,20 @@ def _drive(policy, trace):
     return [policy.decide(s) for s in trace]
 
 
+def test_signals_backward_compatible_with_pre_r17_field_set():
+    """The r17 overlap-ledger fields default: observation sources that
+    predate them (recorded traces, older /healthz payloads) must still
+    construct Signals — and the policy must decide identically when
+    they are absent (they carry no decision weight yet)."""
+    s = Signals(t=0.0, world_size=4)
+    assert s.overlap_efficiency == 0.0
+    assert s.exposed_wire_ms == 0.0
+    rich = Signals(t=0.0, world_size=4, overlap_efficiency=0.8,
+                   exposed_wire_ms=123.4)
+    a, b = _policy(), _policy()
+    assert a.decide(s) == b.decide(rich)
+
+
 def test_ramp_scales_up_after_streak_then_cools_down():
     p = _policy()
     trace = [_sig(t, queue=20) for t in range(8)]

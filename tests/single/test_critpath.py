@@ -1,0 +1,188 @@
+"""Cross-rank critical-path attribution (docs/metrics.md "Critical
+path"): interval-union math, step-window extraction (including windows
+whose begin mark aged out of the ring), blocking-rank/phase verdicts on
+synthetic two-rank traces with KNOWN chains, and the 64-rank simworld
+merge over synthesized dumps (r16 gotcha 1: the in-process world cannot
+emit real per-rank files, so the harness synthesizes them in the exact
+DumpBlackBox schema)."""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.telemetry import critpath, report
+
+pytestmark = pytest.mark.quick
+
+_UNIX0 = 1_700_000_000_000_000
+
+
+def _write_dump(path, rank, events, steady0=0, unix0=_UNIX0, size=2):
+    """One rank's dump with an explicit clock anchor: an event meant at
+    TRUE wall time W must be stamped ts_us = W - unix0 + steady0."""
+    header = {"kind": "blackbox_header", "rank": rank, "size": size,
+              "epoch": 0, "unix_us": unix0, "steady_us": steady0,
+              "fault": {}}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for seq, ev in enumerate(events):
+            f.write(json.dumps({"seq": seq, **ev}) + "\n")
+    return path
+
+
+def _at(wall, steady0=0, unix0=_UNIX0):
+    return wall - unix0 + steady0
+
+
+# ---- interval-union edge cases ----------------------------------------
+
+
+def test_union_measure_edge_cases():
+    # Abutting intervals merge without double counting.
+    assert critpath.union_measure([(0, 5), (5, 10)]) == 10
+    # Nested and overlapping collapse.
+    assert critpath.union_measure([(0, 10), (2, 5), (8, 12)]) == 12
+    # Zero-length spans contribute nothing.
+    assert critpath.union_measure([(3, 3)]) == 0
+    assert critpath.union_measure([(3, 3), (1, 2)]) == 1
+    # Clipping to a window.
+    assert critpath.union_measure([(0, 100)], lo=10, hi=30) == 20
+    # Inverted (negative) intervals are dropped, not subtracted.
+    assert critpath.union_measure([(5, 2), (0, 4)]) == 4
+    assert critpath.union_measure([]) == 0
+
+
+def test_step_window_spanning_ring_wrap(tmp_path):
+    """A step_end whose step_begin aged out of the 8192-slot ring opens
+    at the dump's earliest event: the window is truncated, not lost."""
+    path = _write_dump(str(tmp_path / "blackbox-rank0.jsonl"), 0, [
+        {"ts_us": _at(50_000), "type": "wire_span", "plane": 0,
+         "dur_us": 10_000, "tx_bytes": 1, "rx_bytes": 1},
+        {"ts_us": _at(100_000), "type": "step_end", "step": 7,
+         "dur_us": 90_000},
+    ])
+    dump = critpath.postmortem.load_blackbox(path)[-1]
+    windows = critpath.step_windows(dump)
+    # The dump's earliest event (the span's END stamp at wall 50 ms)
+    # opens the truncated window.
+    assert windows == {7: (50_000, 100_000)}, windows
+    a = critpath.critical_path(str(tmp_path))
+    assert a["steps"][0]["step"] == 7
+    assert a["steps"][0]["per_rank"][0]["window_ms"] == 50.0
+
+
+# ---- known two-rank blocking chains -----------------------------------
+
+
+def _two_rank_traces(tmp_path):
+    """Three steps with a known chain: step 1 rank 0 compute-bound,
+    step 2 rank 1 stall-bound (healing-ladder retry window), step 3
+    rank 0 wire-bound. Rank 1's steady clock starts elsewhere — the
+    anchor pair must realign it."""
+    r0, r1 = [], []
+    s1 = 500_000  # rank 1 steady offset
+
+    def mark(events, steady0, sid, begin, end, body):
+        events.append({"ts_us": _at(begin, steady0),
+                       "type": "step_begin", "step": sid})
+        events.extend(body)
+        events.append({"ts_us": _at(end, steady0), "type": "step_end",
+                       "step": sid, "dur_us": end - begin})
+
+    def span(wall_end, dur, steady0):
+        return {"ts_us": _at(wall_end, steady0), "type": "wire_span",
+                "plane": 0, "dur_us": dur, "tx_bytes": 1, "rx_bytes": 1}
+
+    # Step 1: wall 0..100k. rank0 computes 90k then wires 10k; rank1's
+    # span stretches over 90k absorbing the wait.
+    mark(r0, 0, 1, 0, 100_000, [span(100_000, 10_000, 0)])
+    mark(r1, s1, 1, 0, 100_000, [span(100_000, 90_000, s1)])
+
+    # Step 2: wall 100k..200k. rank1 spends 80k in a retry window then
+    # 10k on the wire; rank0 waits on the wire for 90k.
+    mark(r0, 0, 2, 100_000, 200_000, [span(200_000, 90_000, 0)])
+    mark(r1, s1, 2, 100_000, 200_000, [
+        {"ts_us": _at(190_000, s1), "type": "retry_window",
+         "attempt": 1, "window_ms": 80},
+        span(200_000, 10_000, s1)])
+
+    # Step 3: wall 200k..300k. Both wire-bound; rank0 slightly more
+    # self time (88k wire vs rank1's 90k).
+    mark(r0, 0, 3, 200_000, 300_000, [span(295_000, 88_000, 0)])
+    mark(r1, s1, 3, 200_000, 300_000, [span(295_000, 90_000, s1)])
+
+    _write_dump(str(tmp_path / "blackbox-rank0.jsonl"), 0, r0)
+    _write_dump(str(tmp_path / "blackbox-rank1.jsonl"), 1, r1,
+                steady0=s1)
+    return str(tmp_path)
+
+
+def test_known_blocking_chain_two_ranks(tmp_path):
+    a = critpath.critical_path(_two_rank_traces(tmp_path))
+    assert a["ranks"] == [0, 1]
+    chain = [(s["step"], s["blocking_rank"], s["phase"])
+             for s in a["steps"]]
+    assert chain == [(1, 0, "compute"), (2, 1, "stall"),
+                     (3, 0, "wire")], chain
+    # Per-rank shares carry the evidence: step 1's blocking rank shows
+    # 90 ms compute / 10 ms wire; its peer the inverse.
+    s1 = a["steps"][0]["per_rank"]
+    assert s1[0]["compute_ms"] == 90.0 and s1[0]["wire_ms"] == 10.0
+    assert s1[1]["wire_ms"] == 90.0 and s1[1]["self_ms"] == 10.0
+    assert a["blocking_counts"] == {0: 2, 1: 1}
+    assert a["phase_counts"] == {"compute": 1, "stall": 1, "wire": 1}
+
+
+def test_injected_delay_gap_attributes_as_stall(tmp_path):
+    """A chaos delay:<ms> sleeps between its inject event and the next
+    runtime activity — that gap is stall evidence, closed at a
+    following wire_span's START so wire time is not swallowed."""
+    _write_dump(str(tmp_path / "blackbox-rank0.jsonl"), 0, [
+        {"ts_us": _at(0), "type": "step_begin", "step": 1},
+        {"ts_us": _at(5_000), "type": "inject", "action": 4,
+         "op_index": 3},
+        # Sleep 80 ms, then a 10 ms wire span stamped at its end.
+        {"ts_us": _at(95_000), "type": "wire_span", "plane": 0,
+         "dur_us": 10_000, "tx_bytes": 1, "rx_bytes": 1},
+        {"ts_us": _at(100_000), "type": "step_end", "step": 1,
+         "dur_us": 100_000},
+    ])
+    a = critpath.critical_path(str(tmp_path))
+    (s,) = a["steps"]
+    assert s["phase"] == "stall", s
+    r = s["per_rank"][0]
+    assert r["stall_ms"] == 80.0 and r["wire_ms"] == 10.0, r
+
+
+def test_report_cli_critical_path(tmp_path, capsys):
+    d = _two_rank_traces(tmp_path / "dumps")
+    out_json = str(tmp_path / "cp.json")
+    rc = report.main(["--critical-path", d, "-o", out_json])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "critical path: rank 0 bounded 2 of 3 steps" in out, out
+    assert os.path.exists(out_json)
+    with open(out_json) as f:
+        assert json.load(f)["blocking_counts"] == {"0": 2, "1": 1}
+
+
+# ---- 64-rank simworld merge (synthesized dumps, r16 gotcha 1) ---------
+
+
+def test_simworld_64_rank_straggler_attribution(tmp_path):
+    from horovod_tpu.simworld import harness
+
+    harness.write_sim_step_dumps(str(tmp_path), ranks=64, steps=4,
+                                 slow_rank=41)
+    a = critpath.critical_path(str(tmp_path))
+    assert a["ranks"] == list(range(64))
+    assert len(a["steps"]) == 4
+    for s in a["steps"]:
+        assert s["blocking_rank"] == 41, s["step"]
+        assert s["phase"] == "compute", s
+    assert a["blocking_counts"] == {41: 4}
+    # The rendering names the straggler too.
+    text = critpath.format_critical_path(a)
+    assert "rank 41 bounded 4 of 4 steps" in text, text

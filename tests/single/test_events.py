@@ -58,6 +58,14 @@ def test_events_record_drain_peek(hvd_core):
     assert len(tail) == 2 and tail == hvd_core.events(2)
     drained = hvd_core.events_drain()
     assert [e["seq"] for e in drained] == seqs
+    # Drain-residue discipline (r15 gotcha): residue assertions after a
+    # drain must be TYPE-based, never count-based — a straggling
+    # background cycle's bookkeeping events race an immediate second
+    # drain under full-suite load. The bare `== []` below is safe ONLY
+    # because this world is size 1 with no traffic in flight; in any
+    # multi-rank test assert "no traffic types in the residue" like
+    # tests/parallel/test_observability.py::_wire_events_worker does,
+    # or you will reintroduce the one quick-lane flake r15 fixed.
     assert hvd_core.events_drain() == []
 
 
@@ -88,6 +96,47 @@ def test_ring_selftest_records_plane_tagged_wire_events(hvd_core):
     assert all(s["plane"] == 0 for s in spans)
     assert all(s["tx_bytes"] > 0 for s in spans)
     assert all(c["len"] > 0 for c in chunks)
+
+
+def test_step_marks_scope_ledger_and_events(hvd_core):
+    """hvdtpu_step_mark boundary semantics + the overlap ledger's exact
+    per-plane reconciliation over real selftest wire traffic
+    (docs/metrics.md "Step anatomy")."""
+    ov0 = hvd_core.metrics_snapshot()["wire"]["overlap"]
+    assert hvd_core.step_id() == -1
+    sid = hvd_core.step_mark(True)
+    assert sid >= 1 and hvd_core.step_id() == sid
+    rc, _ = hvd_core.ring_selftest(4, 20000, chunk_bytes=4096)
+    assert rc == 0
+    assert hvd_core.step_mark(False) == sid
+    assert hvd_core.step_id() == -1
+    # Begin-while-open closes first (boundary semantics): one call per
+    # iteration is a complete driver.
+    sid2 = hvd_core.step_mark(True)
+    sid3 = hvd_core.step_mark(True)
+    assert sid3 == sid2 + 1
+    hvd_core.step_mark(False)
+    assert hvd_core.step_mark(False) == -1  # nothing open: no-op
+
+    ov1 = hvd_core.metrics_snapshot()["wire"]["overlap"]
+    assert ov1["steps"] - ov0["steps"] == 3
+    for plane in ("intra", "cross"):
+        p = ov1[plane]
+        # The reconciliation contract: exact, not approximate.
+        assert p["exposed_us"] + p["hidden_us"] == p["total_us"], ov1
+    # The selftest's 4 concurrent planes overlap each other: wire time
+    # was hidden, and the first window booked it all (intra plane).
+    intra = {k: ov1["intra"][k] - ov0["intra"][k]
+             for k in ("total_us", "hidden_us", "exposed_us")}
+    assert intra["total_us"] > 0 and intra["hidden_us"] > 0, ov1
+    assert ov1["overlap_efficiency"] > 0.0
+
+    evs = [e for e in hvd_core.events()
+           if e["type"] in ("step_begin", "step_end")][-6:]
+    assert [e["type"] for e in evs] == ["step_begin", "step_end"] * 3
+    assert evs[0]["step"] == sid and evs[1]["step"] == sid
+    assert evs[1]["dur_us"] >= 0
+    assert evs[3]["step"] == sid2 and evs[4]["step"] == sid3
 
 
 def test_event_ring_wraps_without_losing_order(hvd_core):

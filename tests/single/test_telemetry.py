@@ -326,7 +326,24 @@ def test_prom_flattening_covers_fully_populated_snapshot():
                  "cross_tx_bytes": 250, "cross_rx_bytes": 250,
                  "cross_tx_logical_bytes": 500,
                  "cross_rx_logical_bytes": 500,
-                 "cross_compression_ratio": 0.5},
+                 "cross_compression_ratio": 0.5,
+                 "overlap": {"steps": 7, "unattributed_us": 11,
+                             "exposed_wire_ms": 5.0,
+                             "hidden_wire_ms": 15.0,
+                             "overlap_efficiency": 0.75,
+                             "intra": {"exposed_us": 5000,
+                                       "hidden_us": 15000,
+                                       "total_us": 20000,
+                                       "overlap_efficiency": 0.75,
+                                       "last_exposed_us": 1,
+                                       "last_hidden_us": 2,
+                                       "last_total_us": 3},
+                             "cross": {"exposed_us": 0, "hidden_us": 0,
+                                       "total_us": 0,
+                                       "overlap_efficiency": 0.0,
+                                       "last_exposed_us": 0,
+                                       "last_hidden_us": 0,
+                                       "last_total_us": 0}}},
         "elastic": {"epoch": 3, "faults_detected": 2,
                     "faults_recovered": 1, "ranks_blacklisted": 1,
                     "ranks_rejoined": 1, "heals": 4, "retries": 6,
@@ -353,6 +370,15 @@ def test_prom_flattening_covers_fully_populated_snapshot():
         'hvdtpu_wire_tx_bytes_total{rank="2"} 1000',
         'hvdtpu_straggler_last_total{rank="2",straggler="1"} 2',
         'hvdtpu_errors_total{rank="2"} 1',
+        # r17 step-anatomy overlap ledger (docs/metrics.md).
+        'hvdtpu_overlap_steps_total{rank="2"} 7',
+        'hvdtpu_overlap_unattributed_us_total{rank="2"} 11',
+        'hvdtpu_overlap_efficiency{rank="2"} 0.75',
+        'hvdtpu_overlap_exposed_us_total{plane="intra",rank="2"} 5000',
+        'hvdtpu_overlap_hidden_us_total{plane="intra",rank="2"} 15000',
+        'hvdtpu_overlap_total_us_total{plane="intra",rank="2"} 20000',
+        'hvdtpu_overlap_plane_efficiency{plane="intra",rank="2"} 0.75',
+        'hvdtpu_overlap_plane_efficiency{plane="cross",rank="2"} 0.0',
     ]
     for line in expected:
         assert line in text, f"missing exporter row: {line}"
@@ -403,6 +429,47 @@ def test_step_timer_per_plane_wire_split(monkeypatch):
     for (tx, _txl), p in zip(total, timer.plane_bytes_per_step):
         assert p[0] + p[2] == tx
     assert "plane_wire" in timer.summary()
+
+
+def test_step_timer_overlap_summary(monkeypatch):
+    """overlap_summary aggregates the core ledger's per-step last_*
+    rows: per-plane exposed/hidden/total reconcile exactly and the
+    combined efficiency is hidden/total across planes."""
+    from horovod_tpu.telemetry import core as tcore
+
+    snap = {
+        "initialized": True, "rank": 0, "size": 2, "ops": {},
+        "device_ops": {}, "cache": {"hit_rate": 0.0},
+        "cycle": {"stalls": 0},
+        "wire": {"tx_bytes": 0, "tx_logical_bytes": 0,
+                 "cross_tx_bytes": 0, "cross_tx_logical_bytes": 0,
+                 "overlap": {
+                     "steps": 1,
+                     "intra": {"last_exposed_us": 4000,
+                               "last_hidden_us": 6000,
+                               "last_total_us": 10000},
+                     "cross": {"last_exposed_us": 1000,
+                               "last_hidden_us": 1000,
+                               "last_total_us": 2000},
+                 }},
+    }
+    monkeypatch.setattr(tcore, "snapshot", lambda: snap)
+    monkeypatch.setattr(tcore, "step_mark", lambda begin=True: 1)
+    timer = telemetry.StepTimer(block=False)
+    for _ in range(2):
+        timer.start_step()
+        timer.end_step()
+    ov = timer.overlap_summary(skip_first=False)
+    # mean_ prefix on purpose: the snapshot/healthz expose CUMULATIVE
+    # exposed_wire_ms — per-step means must not share the key.
+    assert ov["intra"]["mean_exposed_wire_ms"] == 4.0
+    assert ov["intra"]["mean_hidden_wire_ms"] == 6.0
+    assert ov["intra"]["mean_total_wire_ms"] == 10.0
+    assert ov["intra"]["overlap_efficiency"] == pytest.approx(0.6)
+    assert ov["cross"]["overlap_efficiency"] == pytest.approx(0.5)
+    # Combined: hidden 7ms of total 12ms.
+    assert ov["overlap_efficiency"] == pytest.approx(7 / 12)
+    assert timer.summary()["overlap"] is not None
 
 
 # ---- cross-rank trace merge -------------------------------------------
