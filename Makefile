@@ -23,7 +23,8 @@ ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
 .PHONY: core tf clean test test-quick test-flaky lint lint-csrc \
   core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
-  reshard-smoke chaos-smoke obs-smoke scale-smoke perf-smoke
+  reshard-smoke chaos-smoke obs-smoke scale-smoke perf-smoke \
+  serve-smoke
 
 core: $(OUT)
 
@@ -170,6 +171,15 @@ perf-smoke: core
 # (docs/scale.md; horovod_tpu/simworld/scale_smoke.py; ~15 s).
 scale-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.simworld.scale_smoke
+
+# Serving chaos smoke: a 2-rank prefill/decode world serves a Poisson
+# request trace with int8 paged KV shipped over the CRC-framed host
+# ring; the decode rank is SIGKILLed mid-trace and every admitted
+# request must complete on the survivor with greedy output
+# token-identical to llama_generate (docs/serving.md;
+# horovod_tpu/serving/serve_smoke.py; ~60 s).
+serve-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.serving.serve_smoke
 
 # Cross-plane + redistribute smoke: 4 real ranks emulate 2 slices x 2
 # chips under HOROVOD_CROSS_PLANE=hier — hierarchical train-step parity

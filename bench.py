@@ -684,6 +684,41 @@ def _events_overhead_rows(ranks=2, tensors=183, elems=2048, steps=8,
     }]
 
 
+def _serving_rows():
+    """Serving-lane rows (docs/serving.md): sustained tok/s and
+    p50/p99 request latency of the continuous-batching decode engine
+    under a seeded Poisson arrival trace, one row per paged-KV block
+    format (f32 / int8). Runs horovod_tpu/serving/bench_lane.py as a
+    CPU-pinned SUBPROCESS — substrate-independent like ring_busbw, and
+    the flagship lane's virgin-device-heap requirement stays intact."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+                + os.pathsep + env.get("PYTHONPATH", "")})
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.serving.bench_lane"],
+            capture_output=True, text=True, timeout=600, env=env,
+            check=True)
+    except Exception as e:  # noqa: BLE001 — a failed serving lane
+        # yields an error row; the rest of the bench run continues.
+        detail = getattr(e, "stderr", "") or ""
+        return [{"metric": "serving_latency",
+                 "error": f"{type(e).__name__}: {e} {detail[-400:]}"}]
+    rows = []
+    for line in out.stdout.splitlines():
+        if line.startswith("SERVING_ROW "):
+            rows.append(json.loads(line.split(" ", 1)[1]))
+    if not rows:
+        return [{"metric": "serving_latency",
+                 "error": "bench_lane emitted no rows",
+                 "tail": out.stdout[-400:]}]
+    return rows
+
+
 # Child body for one ring_busbw rank: pure host — numpy + the native
 # core over TCP loopback, no jax import, so children are safe to run
 # before the flagship subprocess claims the virgin device heap.
@@ -1303,6 +1338,13 @@ def main():
         for row in _control_plane_scaling_rows():
             emit(row)
         return
+    if "--serving" in argv:
+        # Standalone serving lane (no accelerator needed): the
+        # continuous-batching decode engine under a Poisson trace,
+        # f32 and int8 paged-KV rows.
+        for row in _serving_rows():
+            emit(row)
+        return
     if "--ring-busbw" in argv:
         # Standalone host-ring transport sweep (no accelerator needed),
         # including the cross-plane hierarchical rows (dense/hier lane).
@@ -1366,6 +1408,8 @@ def main():
             emit(row)
         for row in _control_plane_scaling_rows():
             emit(row)
+        for row in _serving_rows():
+            emit(row)
         emit(_smoke_row())
         return
 
@@ -1378,6 +1422,8 @@ def main():
     for row in _events_overhead_rows():
         emit(row)
     for row in _control_plane_scaling_rows():
+        emit(row)
+    for row in _serving_rows():
         emit(row)
 
     flagship_row, flagship_extras = _flagship_row()

@@ -38,9 +38,53 @@ CONFIGS = [(1, 128), (16, 128), (64, 128)]
 NEW_LONG, NEW_SHORT = 256, 32
 
 
+def _paged_row(params, cfg, batch=16, t0_len=128, new_tokens=64):
+    """Paged-cache decode throughput on the same chip: the serving
+    engine's continuous-batching step (host-gathered paged KV,
+    models/generate.llama_decode_step) at a fixed batch, all requests
+    arriving at t=0. Reports the paged lane's tok/s next to the fused
+    contiguous kernel's headline so the host-gather tax — the gap a
+    device-resident paged-attention kernel would close (docs/
+    serving.md) — is a number, not a guess."""
+    import time as _time
+
+    import numpy as np
+
+    from horovod_tpu.serving.engine import DecodeEngine
+    from horovod_tpu.serving.scheduler import Request
+
+    eng = DecodeEngine(params, cfg, block_size=32,
+                       n_blocks=batch * ((t0_len + new_tokens) // 32 + 2),
+                       max_batch=batch, max_context=t0_len + new_tokens)
+    rng = np.random.default_rng(1)
+    for rid in range(batch):
+        eng.submit(Request(
+            rid=rid,
+            prompt=rng.integers(0, cfg.vocab_size,
+                                size=t0_len).astype(np.int32),
+            max_new_tokens=new_tokens))
+    eng.step()  # admit + compile prefill/decode off the clock
+    t0 = _time.time()
+    steps0, toks0 = eng.steps, eng.tokens_out
+    eng.run_until_idle()
+    dt = _time.time() - t0
+    steps = eng.steps - steps0
+    tok_s = (eng.tokens_out - toks0) / dt
+    return {
+        "metric": f"decode_paged_tok_s_b{batch}",
+        "value": round(tok_s, 1),
+        "unit": f"tok/s continuous-batching paged KV (batch {batch}, "
+                f"prompt {t0_len}, {new_tokens} new, "
+                f"{dt / max(steps, 1) * 1e3:.2f} ms/step incl host "
+                "gather)",
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
+    ap.add_argument("--paged", action="store_true",
+                    help="also run the paged-KV serving-engine lane")
     args = ap.parse_args()
 
     import numpy as np
@@ -108,6 +152,10 @@ def main():
                     f"{jax.devices()[0].device_kind})",
             "vs_baseline": round(mbu, 3),
         }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.paged:
+        row = _paged_row(params, cfg)
         rows.append(row)
         print(json.dumps(row), flush=True)
     if args.out:

@@ -13,7 +13,11 @@ from horovod_tpu.models.llama import (  # noqa: F401
     llama_loss,
     llama_partition_rules,
 )
-from horovod_tpu.models.generate import llama_generate  # noqa: F401
+from horovod_tpu.models.generate import (  # noqa: F401
+    llama_decode_step,
+    llama_generate,
+    llama_prefill,
+)
 from horovod_tpu.models.mlp import mlp_forward, mlp_init  # noqa: F401
 from horovod_tpu.models.resnet import (  # noqa: F401
     ResNetConfig,

@@ -152,25 +152,16 @@ def _attend_step(x, lp, c, cache_k, cache_v, li, pos):
     return x, cache_k, cache_v
 
 
-@partial(jax.jit,
-         static_argnames=("config", "max_new_tokens", "temperature"))
-def llama_generate(params, prompt, config, max_new_tokens,
-                   temperature=0.0, key=None):
-    """Greedy (temperature=0) or sampled decoding.
+def _prefill(params, prompt, c, pad_to):
+    """One full-sequence pass capturing each layer's K/V.
 
-    prompt [B, T] int32 -> [B, T + max_new_tokens] (prompt + generated).
-    The whole prefill+decode is ONE compiled program; recompiles when
-    (config, prompt length, max_new_tokens, temperature) change —
-    temperature is static because it selects greedy vs sampled tracing.
-    """
-    c = config
+    Returns (x [B, T, D] final hidden states, cache_k, cache_v
+    [L, B, Hkv, T+pad_to, hd] heads-major). The shared front half of
+    :func:`llama_generate` (pad_to=max_new_tokens, decode scans in
+    place) and :func:`llama_prefill` (the serving lane, pad_to=0 — the
+    paged KV pool owns the growth instead of padding)."""
     dt = c.compute_dtype
     b, t0 = prompt.shape
-    if key is None:
-        key = jax.random.PRNGKey(0)
-    keys = jax.random.split(key, max_new_tokens)  # [0]=first, rest=steps
-
-    # ---- prefill: one full pass, capturing each layer's K/V ----------
     x = params["embed"].astype(dt)[prompt]
     positions = jnp.broadcast_to(jnp.arange(t0), (b, t0))
 
@@ -191,17 +182,131 @@ def llama_generate(params, prompt, config, max_new_tokens,
         # Heads-major cache layout [B, Hkv, max_len, hd] (the decode
         # attention kernel's layout); one transpose per layer at
         # prefill, never again.
-        pad = jnp.zeros((b, c.n_kv_heads, max_new_tokens, c.head_dim),
-                        dt)
+        pad = jnp.zeros((b, c.n_kv_heads, pad_to, c.head_dim), dt)
         return x, (jnp.concatenate([k.transpose(0, 2, 1, 3), pad], 2),
                    jnp.concatenate([v.transpose(0, 2, 1, 3), pad], 2))
 
     x, (cache_k, cache_v) = lax.scan(prefill_layer, x, params["layers"])
+    return x, cache_k, cache_v
+
+
+def _lm_logits(params, x_last, c):
+    """Final-norm + lm_head in f32 (x_last [..., D])."""
+    dt = c.compute_dtype
+    h = _rmsnorm(x_last, params["final_norm"].astype(dt), c.norm_eps)
+    return (h @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("config", "pad_to"))
+def llama_prefill(params, prompt, config, pad_to=0):
+    """Serving-lane prefill: one compiled pass -> the greedy first
+    token plus this prompt's per-layer K/V for a paged cache.
+
+    prompt [B, T] int32 -> (first [B] int32, cache_k, cache_v
+    [L, B, Hkv, T+pad_to, hd]). Unlike :func:`llama_generate` the
+    caches come back UNPADDED by default — the continuous-batching
+    engine writes them into fixed-size pool blocks (per-sequence block
+    tables), so sequence growth never re-allocates a monolithic
+    buffer. Greedy only: the serving lane's elastic re-queue guarantee
+    is token-identity, which sampling would break."""
+    x, cache_k, cache_v = _prefill(params, prompt, config, pad_to)
+    logits = _lm_logits(params, x[:, -1:, :], config)[:, 0, :]
+    return (jnp.argmax(logits, axis=-1).astype(prompt.dtype),
+            cache_k, cache_v)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def llama_decode_step(params, tokens, cache_k, cache_v, lengths, config,
+                      k_scale=None, v_scale=None):
+    """One continuous-batching decode step over a RAGGED batch.
+
+    Each batch row b holds its own sequence at position ``lengths[b]``
+    (valid cached slots < lengths[b]; pool-gathered caches are padded
+    to one static S — the mask, not the shape, carries raggedness, so
+    one compiled program serves every batch composition). tokens [B]
+    int32 (each row's last emitted token); cache_k/v
+    [L, B, Hkv, S, hd] — f32/bf16, or int8 with per-slot dequant
+    scales ``k_scale``/``v_scale`` [L, B, Hkv, S] (the paged pool's
+    per-block scales expanded; dequant is f32-accumulate inside
+    ``decode_attention_ragged``).
+
+    Returns (next [B] int32 greedy tokens, k_new, v_new
+    [L, B, Hkv, hd] — this step's projections, which the CALLER writes
+    into the paged cache; the step never updates the cache in place,
+    so the gathered view can stay a cheap scan input instead of a
+    carried copy).
+    """
+    from horovod_tpu.ops.decode_attention import decode_attention_ragged
+
+    c = config
+    dt = c.compute_dtype
+    b = tokens.shape[0]
+    x = params["embed"].astype(dt)[tokens]          # [B, D]
+    positions = jnp.asarray(lengths, jnp.int32)[:, None]  # [B, 1]
+
+    def layer(x, xs):
+        lp, ck, cv, ks, vs = xs
+        h = _rmsnorm(x, lp["attn_norm"].astype(dt), c.norm_eps)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, 1, c.n_heads,
+                                              c.head_dim)
+        q = _rope(q, positions, c.rope_theta)
+        k_new, v_new = _layer_kv(h[:, None, :], lp, c, positions)
+        attn = decode_attention_ragged(
+            q, ck, cv, lengths,
+            k_new.transpose(0, 2, 1, 3), v_new.transpose(0, 2, 1, 3),
+            k_scale=ks, v_scale=vs)
+        x = x + attn.reshape(b, -1) @ lp["wo"].astype(dt)
+        h = _rmsnorm(x, lp["mlp_norm"].astype(dt), c.norm_eps)
+        x = x + _decode_ffn(h[:, None, :], lp, c)[:, 0, :]
+        return x, (k_new[:, 0, :, :], v_new[:, 0, :, :])
+
+    # Caches (and scales) are read-only here, so they ride as scanned
+    # xs — no carried copy (the _attend_step rebuild hazard only bites
+    # when the scan must WRITE the stacked buffer). Absent scales scan
+    # as zero-width placeholders so both modes share one layer body.
+    if k_scale is None:
+        empty = jnp.zeros((c.n_layers, 0), jnp.float32)
+
+        def layer_noscale(x, xs):
+            lp, ck, cv, _, _ = xs
+            return layer(x, (lp, ck, cv, None, None))
+
+        x, (k_new, v_new) = lax.scan(
+            layer_noscale, x,
+            (params["layers"], cache_k, cache_v, empty, empty))
+    else:
+        x, (k_new, v_new) = lax.scan(
+            layer, x,
+            (params["layers"], cache_k, cache_v, k_scale, v_scale))
+    logits = _lm_logits(params, x, c)               # [B, V]
+    nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+    return nxt, k_new, v_new
+
+
+@partial(jax.jit,
+         static_argnames=("config", "max_new_tokens", "temperature"))
+def llama_generate(params, prompt, config, max_new_tokens,
+                   temperature=0.0, key=None):
+    """Greedy (temperature=0) or sampled decoding.
+
+    prompt [B, T] int32 -> [B, T + max_new_tokens] (prompt + generated).
+    The whole prefill+decode is ONE compiled program; recompiles when
+    (config, prompt length, max_new_tokens, temperature) change —
+    temperature is static because it selects greedy vs sampled tracing.
+    """
+    c = config
+    dt = c.compute_dtype
+    b, t0 = prompt.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, max_new_tokens)  # [0]=first, rest=steps
+
+    # ---- prefill: one full pass, capturing each layer's K/V ----------
+    x, cache_k, cache_v = _prefill(params, prompt, c, max_new_tokens)
     # cache_k/v: [L, B, Hkv, max_len, hd]
 
     def logits_of(x_last):
-        h = _rmsnorm(x_last, params["final_norm"].astype(dt), c.norm_eps)
-        return (h @ params["lm_head"].astype(dt)).astype(jnp.float32)
+        return _lm_logits(params, x_last, c)
 
     def pick(logits, k):
         if temperature == 0.0:

@@ -103,6 +103,57 @@ def decode_attention(q, cache_k, cache_v, pos):
     return out.reshape(b, 1, hq, d)
 
 
+def decode_attention_ragged(q, cache_k, cache_v, lengths, k_new, v_new,
+                            k_scale=None, v_scale=None):
+    """One-token attention for a CONTINUOUS-BATCHING step: every row of
+    the batch sits at its OWN position (``lengths[b]`` — the count of
+    valid cached slots), and the new token's k/v ride alongside instead
+    of being written into the cache first (the serving engine owns the
+    paged write; see horovod_tpu/serving/kvcache.py).
+
+    q [B, 1, H, D]; cache_k/v [B, Hkv, S, D] gathered from the block
+    pool (slots < lengths[b] valid); k_new/v_new [B, Hkv, 1, D] — this
+    step's projections, attended as position lengths[b]. Masked cache
+    slots softmax to exactly 0.0 (exp underflow at -1e30), so the
+    result equals attention over the first lengths[b]+1 positions.
+
+    int8 paged read path (``k_scale``/``v_scale`` [B, Hkv, S]): the
+    cache arrives int8 with per-block scales expanded per slot, and the
+    dequant happens HERE — widen to f32, scale, and accumulate in f32
+    (``preferred_element_type``), the quantize-narrow/accumulate-wide
+    recipe of the bf16 wire codec and EQuARX (arXiv:2506.17615).
+    Numeric recipe otherwise matches :func:`decode_attention`: f32
+    scores/softmax, probabilities cast to the value dtype before a
+    f32-accumulated PV.
+    """
+    b, _, hq, d = q.shape
+    hkv, s_len = cache_k.shape[1], cache_k.shape[2]
+    n_rep = hq // hkv
+    qg = q.reshape(b, hkv, n_rep, d)
+    if k_scale is not None:
+        kc = cache_k.astype(jnp.float32) * k_scale[..., None]
+        vc = cache_v.astype(jnp.float32) * v_scale[..., None]
+    else:
+        kc, vc = cache_k, cache_v
+    s = jnp.einsum("bgrd,bgsd->bgrs", qg, kc,
+                   preferred_element_type=jnp.float32) * (d ** -0.5)
+    valid = (jnp.arange(s_len)[None, :]
+             < jnp.asarray(lengths, jnp.int32)[:, None])
+    s = jnp.where(valid[:, None, None, :], s, _NEG)
+    s_self = jnp.einsum("bgrd,bgsd->bgrs", qg, k_new.astype(kc.dtype),
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    s = jnp.concatenate([s, s_self], axis=-1)
+    p = jax.nn.softmax(s, axis=-1)
+    # bf16-probabilities recipe: cast to the (dequantized) value dtype.
+    p = p.astype(vc.dtype)
+    out = (jnp.einsum("bgrs,bgsd->bgrd", p[..., :s_len], vc,
+                      preferred_element_type=jnp.float32)
+           + jnp.einsum("bgrs,bgsd->bgrd", p[..., s_len:],
+                        v_new.astype(vc.dtype),
+                        preferred_element_type=jnp.float32))
+    return out.reshape(b, 1, hq, d).astype(q.dtype)
+
+
 def _decode_attention_xla(q, cache_k, cache_v, pos):
     """Reference-math einsum chain (off-TPU fallback; same numerics).
     cache_k/v in the [B, Hkv, S, D] kernel layout."""

@@ -1,0 +1,36 @@
+"""horovod_tpu.serving — the continuous-batching decode service.
+
+The first REQUEST-driven (not step-driven) consumer of the runtime:
+a paged KV cache (fixed-size block pool + free-list allocator +
+optional int8 block format — kvcache.py), a continuous-batching
+scheduler (admit/evict per decode step against a token budget —
+scheduler.py), a static-shape decode engine over
+``models.generate.llama_decode_step`` (engine.py), and the elastic
+serving loop with prefill/decode disaggregation over the CRC-framed
+chunked host ring (service.py). ``make serve-smoke`` kills a decode
+rank mid-trace and pins that every admitted request still completes,
+token-identically, on the survivors. docs/serving.md has the full
+semantics table.
+
+Reference analog: none — upstream Horovod is a training runtime; this
+lane is what ROADMAP item 1 calls the path from "fast kernel" to
+"millions of users".
+"""
+
+from horovod_tpu.serving.kvcache import (  # noqa: F401
+    OutOfBlocks,
+    PagedKVCache,
+    quantize_blocks,
+)
+from horovod_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    Request,
+    Sequence,
+    latency_summary,
+    poisson_trace,
+)
+from horovod_tpu.serving.engine import DecodeEngine  # noqa: F401
+from horovod_tpu.serving.service import (  # noqa: F401
+    ServingLoop,
+    serving_signals,
+)

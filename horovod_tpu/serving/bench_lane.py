@@ -1,0 +1,106 @@
+"""Serving bench lane: sustained tok/s + p50/p99 under a Poisson trace.
+
+``python -m horovod_tpu.serving.bench_lane`` runs the all-in-one
+continuous-batching engine (single rank, no wire — the scheduler/paged
+-cache/decode-step stack is what's being measured) against a seeded
+Poisson arrival trace on a tiny llama config, once per KV block format
+(f32 and int8), and prints one schema-stamped JSON row per format —
+the ``serving_latency`` family ``bench.py`` emits and
+``perfwatch``/``bench.py --diff`` watch (p50/p99 up and
+sustained_tok_s down are the bad directions; registered in
+telemetry/perfwatch.py).
+
+Substrate-independent (CPU jax) like ``ring_busbw``: the driver's
+bench capture gets serving rows on any box. bench.py runs this module
+as a SUBPROCESS so the flagship lane's virgin-device-heap requirement
+is untouched.
+"""
+
+import json
+import sys
+import time
+
+
+def serving_rows(n_requests=24, rps=200.0, seed=7):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np  # noqa: F401  (trace helpers return numpy)
+
+    from horovod_tpu.models import LlamaConfig, llama_init
+    from horovod_tpu.serving.scheduler import (
+        latency_summary,
+        poisson_trace,
+    )
+    from horovod_tpu.serving.engine import DecodeEngine
+
+    cfg = LlamaConfig.tiny(dtype="float32", n_layers=2)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    rows = []
+    for name, quantized in (("f32", False), ("int8", True)):
+        trace = poisson_trace(n_requests, rps, seed=seed,
+                              prompt_len=(4, 24), max_new=(4, 24),
+                              vocab_size=cfg.vocab_size)
+        eng = DecodeEngine(params, cfg, block_size=8, n_blocks=128,
+                           max_batch=8, max_context=64,
+                           quantized=quantized)
+        # Warm EVERY compiled program off the clock: the prefill
+        # recompiles per distinct prompt length (static T) and is
+        # shared across formats, so an unwarmed first format would eat
+        # all the compiles and skew the f32-vs-int8 comparison.
+        seen = set()
+        for req in trace:
+            if len(req.prompt) not in seen:
+                seen.add(len(req.prompt))
+                eng.prefill(req)
+        eng.submit(trace[0])
+        eng.run_until_idle()     # decode program for this format
+        eng.scheduler.completed.clear()
+        t0 = time.monotonic()
+        done_at = {}
+        for req in trace:
+            # Offered-load replay: submit when the trace clock says so.
+            now = time.monotonic() - t0
+            if req.arrival_t > now:
+                time.sleep(req.arrival_t - now)
+            eng.submit(req)
+            eng.step()
+            for rid in list(eng.scheduler.completed):
+                done_at.setdefault(rid, time.monotonic() - t0)
+        while eng.scheduler.waiting or eng.scheduler.running:
+            eng.step()
+            for rid in list(eng.scheduler.completed):
+                done_at.setdefault(rid, time.monotonic() - t0)
+        wall = time.monotonic() - t0
+        lat = latency_summary([
+            done_at[r.rid] - r.arrival_t for r in trace])
+        gen = sum(len(s.tokens) - len(s.req.prompt)
+                  for s in eng.scheduler.completed.values())
+        rows.append({
+            "metric": "serving_latency",
+            "config": name,
+            "ranks": 1,
+            "arrival_rps": rps,
+            "block_size": eng.pool.block_size,
+            "requests": n_requests,
+            "served": len(eng.scheduler.completed),
+            "sustained_tok_s": round(gen / wall, 2),
+            "p50_ms": lat["p50_ms"],
+            "p99_ms": lat["p99_ms"],
+            "evictions": eng.scheduler.evictions,
+            "unit": "continuous-batching decode, Poisson trace "
+                    f"({rps:.0f} rps offered, tiny llama, CPU, "
+                    f"paged KV {name}); sustained tok/s + request "
+                    "latency percentiles",
+        })
+    return rows
+
+
+def main():
+    for row in serving_rows():
+        print("SERVING_ROW " + json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,268 @@
+"""Paged KV cache: a fixed-size block pool with a free-list allocator.
+
+The offline decode path (models/generate.py) pads every sequence's
+cache to ``prompt + max_new`` up front — fine for a fixed batch, fatal
+for serving: a 2048-slot reservation for a request that stops after 40
+tokens strands ~98% of its HBM for its whole lifetime. Here the cache
+is a POOL of fixed-size blocks (``block_size`` token slots each, all
+layers and kv-heads of those slots together, the vLLM/PagedAttention
+layout adapted to this stack's heads-major [L, Hkv, S, D] attention
+order); a sequence holds a BLOCK TABLE (ordered block ids) and grows
+one block at a time, so stranded memory is bounded by
+``block_size - 1`` slots per sequence and freed blocks are instantly
+reusable by any other request.
+
+Optional int8 block format (``quantized=True``): blocks store int8
+payloads plus one f32 scale per (block, layer, kv-head) —
+quantize-narrow on write, f32-accumulate dequant on read, the EQuARX
+recipe (arXiv:2506.17615) the bf16 wire codec already validates. Halves
+pool HBM *and* the prefill->decode KV wire bytes (serving/service.py
+ships blocks in pool format). A later write into a partially-filled
+block may grow the block's amax; existing entries are then requantized
+under the new scale, which adds at most one extra quantization step of
+error (pinned in tests/single/test_serving.py).
+
+Host-resident numpy by design: the pool is control-plane state (the
+scheduler allocates/evicts against it, the elastic re-queue path reads
+block tables off it, the wire ships it), and the decode step consumes
+a GATHERED view — on TPU the gathered batch is device_put once per
+step, exactly like the eager lane's host staging. A device-resident
+pool with in-place paged writes is the kernel follow-up
+(docs/serving.md).
+"""
+
+import numpy as np
+
+
+class OutOfBlocks(RuntimeError):
+    """The pool cannot satisfy an allocation (caller evicts or queues)."""
+
+
+def quantize_blocks(k, v, block_size, quantized=True, dtype=np.float32):
+    """Freeze k/v [L, Hkv, T, D] into POOL-FORMAT blocks — the
+    prefill->decode wire payload (serving/service.py ships these bytes;
+    ``PagedKVCache.write_raw`` adopts them verbatim).
+
+    Returns (k_q, v_q [n, L, Hkv, block_size, D], k_scale, v_scale
+    [n, L, Hkv] — None unquantized). The quantization recipe is
+    IDENTICAL to a fresh-block :meth:`PagedKVCache.write` (per-block
+    amax/127, zero padding), so a shipped prompt and a locally
+    re-prefilled one produce the same bytes — the bit-determinism the
+    elastic re-queue token-identity pin rests on."""
+    n_layers, n_kv_heads, t, head_dim = k.shape
+    n = max(1, -(-t // block_size))
+    s_pad = n * block_size
+
+    def to_blocks(x):
+        out = np.zeros((n_layers, n_kv_heads, s_pad, head_dim),
+                       x.dtype)
+        out[:, :, :t, :] = x
+        # [L, Hkv, n, bs, D] -> [n, L, Hkv, bs, D]
+        return out.reshape(n_layers, n_kv_heads, n, block_size,
+                           head_dim).transpose(2, 0, 1, 3, 4)
+
+    kb, vb = to_blocks(np.asarray(k)), to_blocks(np.asarray(v))
+    if not quantized:
+        return kb.astype(dtype), vb.astype(dtype), None, None
+
+    def quant(xb):
+        amax = np.abs(xb).max(axis=(-2, -1))          # [n, L, Hkv]
+        scale = amax.astype(np.float32) / 127.0
+        safe = np.where(scale > 0, scale, 1.0)
+        q = np.rint(xb.astype(np.float32) / safe[..., None, None])
+        return np.clip(q, -127, 127).astype(np.int8), scale
+
+    k_q, k_s = quant(kb)
+    v_q, v_s = quant(vb)
+    return k_q, v_q, k_s, v_s
+
+
+class PagedKVCache:
+    """Block pool + allocator for K and V of every layer.
+
+    Block layout: ``k_pool[b]``/``v_pool[b]`` are
+    [n_layers, n_kv_heads, block_size, head_dim] — one block covers
+    ``block_size`` consecutive token positions of ONE sequence across
+    all layers/heads, so a sequence's cache is just its block table
+    concatenated along the position axis.
+    """
+
+    def __init__(self, n_layers, n_kv_heads, head_dim, block_size=16,
+                 n_blocks=256, dtype=np.float32, quantized=False):
+        self.n_layers = int(n_layers)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.n_blocks = int(n_blocks)
+        self.quantized = bool(quantized)
+        shape = (self.n_blocks, self.n_layers, self.n_kv_heads,
+                 self.block_size, self.head_dim)
+        store = np.int8 if quantized else dtype
+        self.k_pool = np.zeros(shape, store)
+        self.v_pool = np.zeros(shape, store)
+        if quantized:
+            sshape = (self.n_blocks, self.n_layers, self.n_kv_heads)
+            self.k_scale = np.zeros(sshape, np.float32)
+            self.v_scale = np.zeros(sshape, np.float32)
+        else:
+            self.k_scale = self.v_scale = None
+        # LIFO free list: recently-freed blocks are cache-warm.
+        self._free = list(range(self.n_blocks - 1, -1, -1))
+        self._allocated = set()
+
+    # ---- allocator ----------------------------------------------------
+
+    @property
+    def blocks_free(self):
+        return len(self._free)
+
+    @property
+    def blocks_total(self):
+        return self.n_blocks
+
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold ``n_tokens`` positions."""
+        return max(1, -(-int(n_tokens) // self.block_size))
+
+    def alloc(self, n):
+        """Take ``n`` blocks off the free list (all-or-nothing).
+
+        Raises :class:`OutOfBlocks` when fewer than ``n`` are free —
+        the scheduler's cue to evict or hold the request."""
+        if n > len(self._free):
+            raise OutOfBlocks(
+                f"need {n} blocks, {len(self._free)} free "
+                f"of {self.n_blocks}")
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, blocks):
+        """Return blocks to the pool (idempotence is a bug: freeing a
+        block twice means two sequences think they own it)."""
+        for blk in blocks:
+            if blk not in self._allocated:
+                raise ValueError(f"double free of block {blk}")
+            self._allocated.discard(blk)
+            self._free.append(blk)
+
+    # ---- block I/O ----------------------------------------------------
+
+    def write(self, blocks, pos, k, v):
+        """Write ``k``/``v`` [L, Hkv, T, D] at sequence positions
+        ``pos .. pos+T-1`` into the block table ``blocks``.
+
+        Prefill writes whole prompts (T = prompt length); decode writes
+        T=1 at the tail. Quantized writes are BLOCK-granular: one scale
+        update + one requantize per touched block per call (not per
+        slot), so a full-prompt write pays the single-shot quantization
+        error and only tail-block growth across calls compounds (error
+        note in the module docstring)."""
+        t = k.shape[2]
+        i = 0
+        while i < t:
+            p = pos + i
+            blk = blocks[p // self.block_size]
+            off = p % self.block_size
+            # All incoming slots landing in this block, in one strip.
+            run = min(t - i, self.block_size - off)
+            ks = k[:, :, i:i + run, :]
+            vs = v[:, :, i:i + run, :]
+            if self.quantized:
+                self._write_block_q(blk, off, ks, vs)
+            else:
+                self.k_pool[blk, :, :, off:off + run, :] = ks
+                self.v_pool[blk, :, :, off:off + run, :] = vs
+            i += run
+
+    def _write_block_q(self, blk, off, k_strip, v_strip):
+        """Quantized write of a strip [L, Hkv, run, D] at slot ``off``;
+        rescale-and-requantize existing entries when the strip's amax
+        grows the block scale."""
+        run = k_strip.shape[2]
+        for pool, scales, strip in ((self.k_pool, self.k_scale, k_strip),
+                                    (self.v_pool, self.v_scale, v_strip)):
+            amax = np.abs(strip).max(axis=(-2, -1))    # [L, Hkv]
+            new_scale = amax.astype(np.float32) / 127.0
+            old = scales[blk]
+            grow = new_scale > old
+            if grow.any():
+                merged = np.where(grow, new_scale, old)
+                # Requantize existing entries under the merged scale
+                # (dead scale rows scale by 0 — nothing stored there).
+                safe = np.where(merged > 0, merged, 1.0)
+                ratio = np.where(old > 0, old, 0.0) / safe
+                pool[blk] = np.rint(
+                    pool[blk].astype(np.float32)
+                    * ratio[:, :, None, None]).astype(np.int8)
+                scales[blk] = merged
+            s = scales[blk]                            # [L, Hkv]
+            safe = np.where(s > 0, s, 1.0)
+            q = np.rint(strip.astype(np.float32) / safe[:, :, None, None])
+            pool[blk, :, :, off:off + run, :] = np.clip(
+                q, -127, 127).astype(np.int8)
+
+    def write_raw(self, blocks, k_q, v_q, k_scale, v_scale):
+        """Adopt pool-format payloads wholesale (the prefill->decode
+        wire path): ``k_q``/``v_q`` [n, L, Hkv, bs, D] in the pool's
+        storage dtype, scales [n, L, Hkv] (quantized pools only)."""
+        for i, blk in enumerate(blocks):
+            self.k_pool[blk] = k_q[i]
+            self.v_pool[blk] = v_q[i]
+            if self.quantized:
+                self.k_scale[blk] = k_scale[i]
+                self.v_scale[blk] = v_scale[i]
+
+    def read_raw(self, blocks):
+        """Pool-format payloads for ``blocks`` (the wire's send side).
+        Returns (k_q, v_q, k_scale, v_scale); scales are None for
+        unquantized pools."""
+        idx = np.asarray(blocks, np.int64)
+        k_q, v_q = self.k_pool[idx], self.v_pool[idx]
+        if self.quantized:
+            return k_q, v_q, self.k_scale[idx], self.v_scale[idx]
+        return k_q, v_q, None, None
+
+    def gather(self, blocks, pad_blocks=0):
+        """Concatenate a block table into the attention view.
+
+        Returns (k, v, k_scale, v_scale): k/v
+        [L, Hkv, (len(blocks)+pad_blocks)*block_size, D] in the pool's
+        storage dtype; scales are per-SLOT vectors [L, Hkv, S] (the
+        per-block scale repeated over its slots) for quantized pools,
+        None otherwise — exactly what
+        ``decode_attention_ragged(k_scale=...)``'s f32-accumulate
+        dequant consumes. ``pad_blocks`` zero-pads to a static shape so
+        one compiled step serves every table length."""
+        idx = np.asarray(blocks, np.int64)
+        n = len(blocks) + int(pad_blocks)
+        s_pad = n * self.block_size
+        shape = (self.n_layers, self.n_kv_heads, s_pad, self.head_dim)
+        k = np.zeros(shape, self.k_pool.dtype)
+        v = np.zeros(shape, self.v_pool.dtype)
+        valid = len(blocks) * self.block_size
+        if len(blocks):
+            # [n, L, Hkv, bs, D] -> [L, Hkv, n*bs, D]
+            k[:, :, :valid, :] = self.k_pool[idx].transpose(
+                1, 2, 0, 3, 4).reshape(self.n_layers, self.n_kv_heads,
+                                       valid, self.head_dim)
+            v[:, :, :valid, :] = self.v_pool[idx].transpose(
+                1, 2, 0, 3, 4).reshape(self.n_layers, self.n_kv_heads,
+                                       valid, self.head_dim)
+        if not self.quantized:
+            return k, v, None, None
+        ks = np.zeros((self.n_layers, self.n_kv_heads, s_pad), np.float32)
+        vs = np.zeros_like(ks)
+        if len(blocks):
+            ks[:, :, :valid] = np.repeat(
+                self.k_scale[idx].transpose(1, 2, 0), self.block_size,
+                axis=-1)
+            vs[:, :, :valid] = np.repeat(
+                self.v_scale[idx].transpose(1, 2, 0), self.block_size,
+                axis=-1)
+        return k, v, ks, vs
+
+    def stats(self):
+        """The /healthz serving fields (docs/serving.md)."""
+        return {"kv_blocks_free": self.blocks_free,
+                "kv_blocks_total": self.blocks_total}

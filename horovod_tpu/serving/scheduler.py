@@ -1,0 +1,241 @@
+"""Continuous-batching request scheduler: admit/evict per decode step.
+
+The serving lane's control brain, deliberately pure bookkeeping (no
+model, no wire — testable with a bare :class:`PagedKVCache`): requests
+wait in an arrival-ordered queue; each decode step the scheduler ADMITS
+from the front while three budgets hold — batch slots, a token budget
+(the sum of live context lengths, the knob that bounds per-step
+attention work), and pool blocks for prompt+1 — and GROWS running
+sequences one block at a time as they cross block boundaries. When the
+pool runs dry mid-step, the YOUNGEST running sequence is evicted
+(LIFO preemption: the oldest request is closest to completing, evicting
+it wastes the most work), its blocks freed and the request re-queued at
+the FRONT of the waiting line for a later re-prefill — nothing is ever
+dropped. The same re-queue primitive serves the elastic path: a dead
+decode rank's sequences re-enter through it (serving/service.py).
+
+Greedy decoding makes eviction and elastic re-queue SAFE: re-prefilling
+the same prompt reproduces the identical continuation, so a preempted
+or orphaned request completes with token-identical output (pinned by
+tests/parallel/test_serving_elastic.py).
+"""
+
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from horovod_tpu.serving.kvcache import OutOfBlocks
+
+
+@dataclass
+class Request:
+    """One decode request (prompt tokens in, greedy continuation out)."""
+
+    rid: int
+    prompt: np.ndarray            # [T] int32
+    max_new_tokens: int
+    arrival_t: float = 0.0        # seconds on the trace clock
+
+
+@dataclass
+class Sequence:
+    """A running request: its block table and generated tail."""
+
+    req: Request
+    blocks: list = field(default_factory=list)
+    generated: list = field(default_factory=list)  # incl. the prefill
+    #                                                first token
+
+    @property
+    def rid(self):
+        return self.req.rid
+
+    @property
+    def length(self):
+        """Logical sequence length (prompt + generated so far)."""
+        return len(self.req.prompt) + len(self.generated)
+
+    @property
+    def cached(self):
+        """Cache slots actually HOLDING K/V: the newest generated
+        token is the decode step's input — its K/V is computed (and
+        written at position ``cached``) by that step, so it is always
+        one behind ``length`` while decoding."""
+        return len(self.req.prompt) + max(len(self.generated) - 1, 0)
+
+    @property
+    def done(self):
+        return len(self.generated) >= self.req.max_new_tokens
+
+    @property
+    def tokens(self):
+        return np.concatenate([
+            np.asarray(self.req.prompt, np.int32),
+            np.asarray(self.generated, np.int32)])
+
+
+def poisson_trace(n, rps, seed=0, prompt_len=(4, 24),
+                  max_new=(4, 24), vocab_size=256):
+    """A deterministic Poisson arrival trace: ``n`` requests with
+    exponential inter-arrival gaps at ``rps`` requests/second, ragged
+    prompt lengths and generation budgets — the serving bench's (and
+    chaos smoke's) offered load."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    for rid in range(n):
+        t += rng.exponential(1.0 / rps)
+        tlen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+        out.append(Request(
+            rid=rid,
+            prompt=rng.integers(0, vocab_size, size=tlen).astype(np.int32),
+            max_new_tokens=int(rng.integers(max_new[0], max_new[1] + 1)),
+            arrival_t=t))
+    return out
+
+
+class ContinuousBatchingScheduler:
+    """Admit/evict against a :class:`PagedKVCache` and a token budget.
+
+    The pool may be shared with other components; the scheduler only
+    allocates/frees through it. ``token_budget`` caps the sum of live
+    context lengths across running sequences (attention work per step);
+    ``max_batch`` caps batch slots (the decode step's static B).
+    """
+
+    def __init__(self, pool, max_batch=8, token_budget=4096):
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        self.token_budget = int(token_budget)
+        self.waiting = deque()
+        self.running = []            # admission order: oldest first
+        self.completed = {}          # rid -> Sequence
+        self.evictions = 0
+
+    # ---- signals -------------------------------------------------------
+
+    @property
+    def queue_depth(self):
+        return len(self.waiting)
+
+    @property
+    def inflight(self):
+        return len(self.running)
+
+    def _live_tokens(self):
+        # +1: each running sequence is about to fill one more slot.
+        return sum(s.cached + 1 for s in self.running)
+
+    # ---- admission -----------------------------------------------------
+
+    def submit(self, req):
+        self.waiting.append(req)
+
+    def requeue_front(self, reqs):
+        """Put evicted/orphaned requests back at the head of the line
+        (they already waited once)."""
+        for r in reversed(list(reqs)):
+            self.waiting.appendleft(r)
+
+    def admit(self):
+        """Admit from the waiting queue while every budget holds.
+        Returns the newly admitted :class:`Sequence` list — the caller
+        (engine or service) prefills them and writes their KV blocks."""
+        admitted = []
+        while (self.waiting and len(self.running) < self.max_batch):
+            req = self.waiting[0]
+            need_tokens = len(req.prompt) + 1
+            if self._live_tokens() + need_tokens > self.token_budget:
+                break
+            try:
+                blocks = self.pool.alloc(self.pool.blocks_for(need_tokens))
+            except OutOfBlocks:
+                break
+            self.waiting.popleft()
+            seq = Sequence(req=req, blocks=blocks)
+            self.running.append(seq)
+            admitted.append(seq)
+        return admitted
+
+    def adopt(self, seq):
+        """Register an externally-built sequence (the disaggregated
+        path: prefill happened on another rank, blocks are already
+        allocated and written)."""
+        self.running.append(seq)
+
+    # ---- per-step growth / eviction ------------------------------------
+
+    def ensure_slot(self, seq):
+        """Guarantee ``seq`` has a cache slot for its next token,
+        growing its block table across a block boundary; evicts the
+        youngest OTHER running sequence until the allocation fits.
+        Returns False when ``seq`` itself had to be evicted (pool too
+        small even after evicting everyone else)."""
+        need = self.pool.blocks_for(seq.cached + 1)
+        while need > len(seq.blocks):
+            try:
+                seq.blocks.extend(self.pool.alloc(need - len(seq.blocks)))
+            except OutOfBlocks:
+                victim = self._youngest_other(seq)
+                if victim is None:
+                    self.evict(seq)
+                    return False
+                self.evict(victim)
+        return True
+
+    def _youngest_other(self, seq):
+        for s in reversed(self.running):
+            if s is not seq:
+                return s
+        return None
+
+    def evict(self, seq):
+        """Free a running sequence's blocks and re-queue its request
+        at the front (re-prefill later; greedy decode makes the replay
+        token-identical)."""
+        self.running.remove(seq)
+        self.pool.free(seq.blocks)
+        seq.blocks = []
+        seq.generated = []
+        self.requeue_front([seq.req])
+        self.evictions += 1
+
+    def complete(self, seq):
+        self.running.remove(seq)
+        self.pool.free(seq.blocks)
+        seq.blocks = []
+        self.completed[seq.rid] = seq
+
+    def drop(self, rid):
+        """Cancel a running/waiting request (the elastic duplicate
+        guard: another rank already completed it). Returns True when
+        something was dropped."""
+        for s in list(self.running):
+            if s.rid == rid:
+                self.running.remove(s)
+                self.pool.free(s.blocks)
+                s.blocks = []
+                return True
+        for r in list(self.waiting):
+            if r.rid == rid:
+                self.waiting.remove(r)
+                return True
+        return False
+
+    def signals(self):
+        """The /healthz serving field set (docs/serving.md)."""
+        out = {"serving_queue_depth": self.queue_depth,
+               "inflight_sequences": self.inflight}
+        out.update(self.pool.stats())
+        return out
+
+
+def latency_summary(latencies_s):
+    """p50/p99 (ms) over per-request completion latencies."""
+    if not latencies_s:
+        return {"p50_ms": 0.0, "p99_ms": 0.0}
+    p50, p99 = np.percentile(np.asarray(latencies_s, np.float64),
+                             [50, 99])
+    return {"p50_ms": round(float(p50) * 1000.0, 3),
+            "p99_ms": round(float(p99) * 1000.0, 3)}

@@ -1,0 +1,539 @@
+"""The elastic serving loop: prefill/decode disaggregation on the
+self-healing runtime.
+
+Topology (derived fresh every round, so elastic resizes just work):
+rank 0 is the FRONTEND — it owns the arrival trace, runs prefill, and
+scoreboards completions; every other rank is a DECODE rank running a
+local :class:`DecodeEngine`. At size 1 the frontend decodes too (the
+all-in-one lane — also exactly what a 2-rank world collapses to when
+its decode rank dies).
+
+One round = one lockstep beat of the world:
+
+1. frontend admits due arrivals, prefills (``llama_prefill``) and packs
+   each prompt's KV into POOL-FORMAT blocks — int8 + per-block scales
+   when quantized, so the wire ships the narrow format and the decode
+   rank adopts bytes verbatim (``write_raw``): quantize once, at the
+   source, per EQuARX;
+2. a control allgather (pickled, CRC-framed chunked host ring like
+   every eager collective): frontend -> {assignments, cancels,
+   shutdown}; decode ranks -> {acks, rejects, completions, stats};
+3. a uint8 alltoall ships the KV payloads to their target ranks (the
+   splits vector routes; skipped by agreement when nothing was
+   assigned this round);
+4. decode ranks adopt new sequences and run ``steps_per_round``
+   continuous-batching steps; the frontend decodes its own batch when
+   it is in the decode set.
+
+ELASTIC CONTRACT (the chaos acceptance): any typed collective failure
+(``HorovodPeerFailureError`` — a SIGKILLed decode rank's EOF) is caught
+at the round boundary; survivors re-form IN PLACE via
+``hvd.elastic.reset()`` (r12/r14 machinery — python state, including
+every survivor's pool and running batch, survives), and the frontend
+re-queues the dead rank's in-flight requests plus anything assigned but
+never acked. Greedy decoding + the engine's static-shape determinism
+make the replay token-identical, so a request's output does not depend
+on whether its first home died (pinned by
+tests/parallel/test_serving_elastic.py and ``make serve-smoke``).
+A re-queued rid that a survivor ALSO still holds (assigned, admitted,
+ack lost with the round) is cancelled on the survivor via the control
+message — first completion wins, nothing double-serves.
+
+Load-balancer integration: the per-rank debug server's ``/healthz``
+(r15) carries the serving field set — queue depth, in-flight
+sequences, kv blocks free/total — via :func:`serving_signals`
+(module-level registry; zeros when no service is live).
+"""
+
+import time
+
+import numpy as np
+
+from horovod_tpu.serving.engine import DecodeEngine
+from horovod_tpu.serving.kvcache import quantize_blocks
+from horovod_tpu.serving.scheduler import (
+    Request,
+    Sequence,
+    latency_summary,
+)
+
+# The live service in this process (serving_signals / /healthz).
+_live = None
+
+
+def serving_signals():
+    """The /healthz serving fields — sentinel defaults when no service
+    is live (ONE source of truth:
+    ``telemetry.autoscale.SERVING_SIGNAL_DEFAULTS``; the field SET is
+    pinned in tests/parallel/test_observability.py)."""
+    from horovod_tpu.telemetry.autoscale import SERVING_SIGNAL_DEFAULTS
+
+    if _live is not None:
+        try:
+            return _live.signals()
+        except Exception:  # noqa: BLE001 — health must answer anyway
+            pass
+    return dict(SERVING_SIGNAL_DEFAULTS)
+
+
+class ServingLoop:
+    """Round-based elastic serving over a request trace.
+
+    ``trace`` is a list of :class:`Request` (see ``poisson_trace``);
+    arrival times are honored against a wall clock started at
+    :meth:`run`. ``round_hook(loop, round_idx)`` runs at the top of
+    every round on every rank — the chaos tests' kill injection point.
+    """
+
+    def __init__(self, params, config, trace=(), *, block_size=16,
+                 n_blocks=256, max_batch=8, max_context=512,
+                 token_budget=None, quantized=False, steps_per_round=4,
+                 prefill_per_round=4, max_rounds=100000,
+                 time_scale=1.0, round_hook=None):
+        self.engine = DecodeEngine(
+            params, config, block_size=block_size, n_blocks=n_blocks,
+            max_batch=max_batch, max_context=max_context,
+            token_budget=token_budget, quantized=quantized)
+        self.params = params
+        self.config = config
+        self.trace = sorted(trace, key=lambda r: r.arrival_t)
+        self.quantized = bool(quantized)
+        self.steps_per_round = int(steps_per_round)
+        self.prefill_per_round = int(prefill_per_round)
+        self.max_rounds = int(max_rounds)
+        self.time_scale = float(time_scale)  # <1 compresses the trace
+        self.round_hook = round_hook
+        # Every request must fit the engine's static decode shape —
+        # reject at construction, not deep inside a decode rank's
+        # gather (where it would read as a fault and cascade).
+        for req in self.trace:
+            if (len(req.prompt) + req.max_new_tokens
+                    > self.engine.s_pad):
+                raise ValueError(
+                    f"request {req.rid}: prompt {len(req.prompt)} + "
+                    f"max_new {req.max_new_tokens} exceeds max_context "
+                    f"{self.engine.s_pad}")
+        # Frontend state.
+        self._pending = []            # Requests awaiting assignment
+        self._assigned = {}           # rid -> {req, rank, acked}
+        self._cancel = []             # rids to cancel on survivors
+        self._completed = {}          # rid -> np.ndarray tokens
+        self._latency = {}            # rid -> seconds
+        self._req_by_rid = {r.rid: r for r in self.trace}
+        self._arrival_idx = 0
+        # Decode-rank OUTBOXES: report payloads stay here until the
+        # frontend provably PROCESSED them — receiving the frontend's
+        # round-R+1 control is the proof for round R's reports (the
+        # frontend only enters R+1 after applying R), so items retire
+        # two-stage: sent -> inflight -> retired at the NEXT successful
+        # allgather. A fault at any point keeps them for re-send; the
+        # frontend's handlers are idempotent (duplicate acks/rejects
+        # no-op, first completion wins).
+        self._ack_buf = []
+        self._reject_buf = []
+        self._done_outbox = {}
+        self._inflight = {"acks": [], "rejects": [], "done": []}
+        self.faults_survived = 0
+        self.served_local = 0         # completions this rank decoded
+        self.rounds = 0
+        # Collective names are serve.<epoch>.<epoch_round>: the
+        # counter advances only on a fully-successful round and RESETS
+        # on recovery, so survivors that observed a fault at different
+        # rounds re-align at (new epoch, 0) instead of negotiating
+        # mismatched tensor names forever.
+        self._epoch_round = 0
+        self._rr = 0                  # round-robin assignment cursor
+
+    # ---- signals -------------------------------------------------------
+
+    def signals(self):
+        sig = self.engine.scheduler.signals()
+        sig["serving_queue_depth"] += len(self._pending)
+        return sig
+
+    # ---- helpers -------------------------------------------------------
+
+    def _basics(self):
+        from horovod_tpu.common.basics import HorovodBasics
+
+        return HorovodBasics()
+
+    def _decode_ranks(self, size):
+        return list(range(1, size)) if size > 1 else [0]
+
+    def _pack_assignment(self, req):
+        """Prefill one request and freeze its wire payload: pool-format
+        blocks (quantized at the SOURCE when the pool is int8) plus the
+        metadata a decode rank needs to adopt them."""
+        first, k, v = self.engine.prefill(req)
+        bs = self.engine.pool.block_size
+        k_q, v_q, k_s, v_s = quantize_blocks(
+            k, v, bs, quantized=self.quantized,
+            dtype=self.engine.pool.k_pool.dtype)
+        payload = [k_q.tobytes(), v_q.tobytes()]
+        if self.quantized:
+            payload += [k_s.tobytes(), v_s.tobytes()]
+        meta = {"rid": req.rid, "prompt": np.asarray(req.prompt,
+                                                    np.int32).tolist(),
+                "first": int(first), "max_new": int(req.max_new_tokens),
+                "n_blocks": int(k_q.shape[0]),
+                "nbytes": sum(len(p) for p in payload)}
+        return meta, b"".join(payload)
+
+    def _adopt_assignment(self, meta, payload):
+        """Decode-rank side of :meth:`_pack_assignment`: allocate local
+        blocks, adopt the shipped bytes, register the sequence. Returns
+        True, or False when the local pool is full (NACK)."""
+        from horovod_tpu.serving.kvcache import OutOfBlocks
+
+        pool = self.engine.pool
+        c = self.config
+        n = meta["n_blocks"]
+        bs = pool.block_size
+        store = pool.k_pool.dtype
+        shape = (n, c.n_layers, c.n_kv_heads, bs, c.head_dim)
+        k_q = np.frombuffer(payload, store,
+                            count=int(np.prod(shape))).reshape(shape)
+        off = k_q.nbytes
+        v_q = np.frombuffer(payload, store, count=int(np.prod(shape)),
+                            offset=off).reshape(shape)
+        off += v_q.nbytes
+        k_s = v_s = None
+        if self.quantized:
+            sshape = (n, c.n_layers, c.n_kv_heads)
+            k_s = np.frombuffer(payload, np.float32,
+                                count=int(np.prod(sshape)),
+                                offset=off).reshape(sshape)
+            off += k_s.nbytes
+            v_s = np.frombuffer(payload, np.float32,
+                                count=int(np.prod(sshape)),
+                                offset=off).reshape(sshape)
+        try:
+            blocks = pool.alloc(n)
+        except OutOfBlocks:
+            return False
+        pool.write_raw(blocks, k_q, v_q, k_s, v_s)
+        req = Request(rid=meta["rid"],
+                      prompt=np.asarray(meta["prompt"], np.int32),
+                      max_new_tokens=meta["max_new"])
+        seq = Sequence(req=req, blocks=blocks,
+                       generated=[meta["first"]])
+        if seq.done:  # max_new == 1: the prefill token finished it
+            pool.free(blocks)
+            seq.blocks = []
+            self.engine.scheduler.completed[seq.rid] = seq
+        else:
+            self.engine.adopt_remote(seq)
+        return True
+
+    def _admit_arrivals(self, now):
+        while (self._arrival_idx < len(self.trace)
+               and self.trace[self._arrival_idx].arrival_t
+               * self.time_scale <= now):
+            self._pending.append(self.trace[self._arrival_idx])
+            self._arrival_idx += 1
+
+    def _local_admit(self, reqs):
+        """Frontend-as-decoder lane (size 1): the same prefill+write
+        path a remote adoption takes, through the engine's local
+        scheduler — numerics identical to the shipped path because the
+        pool write IS the quantizer."""
+        for req in reqs:
+            self.engine.submit(req)
+
+    # ---- fault recovery ------------------------------------------------
+
+    def _recover(self, old_size, old_rank):
+        """Re-form over survivors and re-route orphaned work. Returns
+        the (new_rank, new_size) of this process."""
+        from horovod_tpu.common import elastic as hvd_elastic
+
+        alive = hvd_elastic.survivors()  # old-rank ids, rank-consistent
+        if old_rank != 0 and alive is not None and 0 not in alive:
+            # The frontend owns the trace scoreboard (arrivals,
+            # assignments, completions) — no survivor can reconstruct
+            # it, and a decode rank silently promoting itself to rank 0
+            # would replay the whole trace against its own half-decoded
+            # state. Fail loudly instead; restarting the service is the
+            # recovery (the driverless elastic core has the same
+            # rank-0-must-survive constraint, docs/elastic.md).
+            raise RuntimeError(
+                "frontend (rank 0) died; the serving loop cannot "
+                "re-form without its scoreboard — restart the service")
+        hvd_elastic.reset()
+        b = self._basics()
+        self.faults_survived += 1
+        # Survivors may have observed the fault at DIFFERENT rounds;
+        # every one re-aligns at (new epoch, round 0). Nothing inflight
+        # is confirmed anymore — keep it all in the outboxes for
+        # re-send (idempotent on the frontend).
+        self._epoch_round = 0
+        self._inflight = {"acks": [], "rejects": [], "done": []}
+        if old_rank == 0:
+            if alive is None:
+                # Suspicion-only fallback (full re-init): no agreed
+                # dead set — conservatively treat every un-acked or
+                # remote assignment as orphaned.
+                alive = [0]
+            dead = [r for r in range(old_size) if r not in alive]
+            requeue = []
+            for rid, rec in list(self._assigned.items()):
+                target = rec["rank"]
+                if target in dead or not rec["acked"]:
+                    requeue.append(rec["req"])
+                    if target not in dead:
+                        # May have been admitted with the ack lost in
+                        # the dying round: cancel the survivor's copy
+                        # so the replay can't double-serve.
+                        self._cancel.append(rid)
+                    del self._assigned[rid]
+                else:
+                    # Surviving decode ranks renumber compactly.
+                    rec["rank"] = alive.index(target)
+            # Oldest arrivals first, ahead of anything still pending.
+            requeue.sort(key=lambda r: r.arrival_t)
+            self._pending = requeue + self._pending
+        return b.rank(), b.size()
+
+    # ---- the loop ------------------------------------------------------
+
+    def run(self):
+        """Drive the trace to completion. Rank 0 returns the serving
+        report (completions, latency percentiles, sustained tok/s);
+        decode ranks return their local engine stats."""
+        global _live
+        from horovod_tpu.common import elastic as hvd_elastic
+        from horovod_tpu.common.exceptions import HorovodInternalError
+
+        b = self._basics()
+        _live = self
+        t0 = time.monotonic()
+        decode_clock = 0.0
+        try:
+            while True:
+                rank, size = b.rank(), b.size()
+                if self.round_hook is not None:
+                    self.round_hook(self, self.rounds)
+                try:
+                    done = self._round(b, rank, size,
+                                       time.monotonic() - t0)
+                except HorovodInternalError:
+                    rank, size = self._recover(size, rank)
+                    continue
+                self.rounds += 1
+                self._epoch_round += 1
+                if done:
+                    break
+                if self.rounds > self.max_rounds:
+                    raise RuntimeError(
+                        f"serving loop: no convergence after "
+                        f"{self.max_rounds} rounds")
+            decode_clock = time.monotonic() - t0
+        finally:
+            _live = None
+        if b.rank() != 0:
+            return {"rank": b.rank(), "steps": self.engine.steps,
+                    "served": self.served_local,
+                    "evictions": self.engine.scheduler.evictions}
+        total_tokens = int(sum(
+            len(t) - len(self._rid_req(rid).prompt)
+            for rid, t in self._completed.items()))
+        lat = latency_summary(list(self._latency.values()))
+        return {
+            "completed": {int(r): np.asarray(t)
+                          for r, t in self._completed.items()},
+            "requests": len(self.trace),
+            "served": len(self._completed),
+            "generated_tokens": total_tokens,
+            "wall_s": round(decode_clock, 4),
+            "sustained_tok_s": round(total_tokens / decode_clock, 2)
+            if decode_clock > 0 else 0.0,
+            "faults_survived": self.faults_survived,
+            "evictions": self.engine.scheduler.evictions,
+            "rounds": self.rounds,
+            **lat,
+        }
+
+    def _rid_req(self, rid):
+        return self._req_by_rid[rid]
+
+    def _round(self, b, rank, size, now):
+        from horovod_tpu.common import elastic as hvd_elastic
+
+        epoch = b.epoch() if b.is_initialized() else 0
+        tag = f"serve.{epoch}.{self._epoch_round}"
+        decode_ranks = self._decode_ranks(size)
+
+        # -- frontend: admit + prefill + assign --------------------------
+        ctl = {}
+        packed = {}                   # target rank -> [(meta, bytes)]
+        if rank == 0:
+            self._admit_arrivals(now)
+            if size == 1:
+                self._local_admit(self._pending)
+                self._pending = []
+            assigns = []
+            if size > 1:
+                budget = self.prefill_per_round
+                while self._pending and budget > 0:
+                    req = self._pending.pop(0)
+                    target = decode_ranks[self._rr % len(decode_ranks)]
+                    self._rr += 1
+                    meta, payload = self._pack_assignment(req)
+                    meta["target"] = target
+                    packed.setdefault(target, []).append(
+                        (meta, payload))
+                    assigns.append(meta)
+                    self._assigned[req.rid] = {
+                        "req": req, "rank": target, "acked": False}
+                    budget -= 1
+            all_done = (self._arrival_idx >= len(self.trace)
+                        and not self._pending and not self._assigned
+                        and (size > 1 or (
+                            not self.engine.scheduler.waiting
+                            and not self.engine.scheduler.running)))
+            ctl = {"assign": assigns, "cancel": list(self._cancel),
+                   "shutdown": bool(all_done)}
+        else:
+            ctl = {"acks": list(self._ack_buf),
+                   "rejects": list(self._reject_buf),
+                   "done": self._done_out(),
+                   "stats": self.engine.scheduler.signals()}
+
+        # -- collectives (the only wire section => the only fault
+        # -- surface; _recover handles a typed failure of either) --------
+        if size > 1:
+            ctls = hvd_elastic._allgather_object(ctl, name=f"{tag}.ctl")
+            front = ctls[0]
+            if rank != 0:
+                self._retire_inflight(ctl)
+        else:
+            front = ctl if rank == 0 else {"assign": [], "cancel": [],
+                                           "shutdown": True}
+            ctls = [ctl]
+
+        # Cancels apply BEFORE payload adoption: they target copies
+        # admitted in EARLIER rounds, and a rid that is cancelled and
+        # reassigned in one control message must drop the stale copy
+        # while keeping this round's fresh adoption.
+        if rank in decode_ranks:
+            for rid in front.get("cancel", ()):
+                self.engine.scheduler.drop(rid)
+
+        if size > 1 and front["assign"]:
+            # KV payloads ride one alltoall, by agreement non-empty.
+            self._exchange_payloads(b, rank, size, front, packed, tag)
+
+        # -- apply control ----------------------------------------------
+        if rank == 0 and size > 1:
+            for peer_rank, peer in enumerate(ctls[1:], start=1):
+                self._apply_decode_report(peer_rank, peer, now)
+        if rank == 0:
+            # Retire only the cancels that RODE this round's control
+            # (at ANY world size — a size-1 survivor must not re-apply
+            # them forever): _apply_decode_report may have appended
+            # fresh ones, which must survive to the next round.
+            sent = set(ctl["cancel"])
+            self._cancel = [c for c in self._cancel if c not in sent]
+        if rank in decode_ranks:
+            for _ in range(self.steps_per_round):
+                self.engine.step()
+        if rank == 0 and size == 1:
+            # Collect local completions straight off the engine.
+            for rid, seq in list(self.engine.scheduler.completed.items()):
+                if rid not in self._completed:
+                    self._completed[rid] = seq.tokens
+                    self._latency[rid] = max(
+                        now - self._rid_req(rid).arrival_t
+                        * self.time_scale, 0.0)
+        if rank == 0 and not front.get("shutdown"):
+            idle = (not self._pending
+                    and self._arrival_idx < len(self.trace)
+                    and (size > 1
+                         or not self.engine.scheduler.running))
+            if idle:
+                # Idle beat: let the trace clock advance.
+                time.sleep(0.002)
+        return bool(front.get("shutdown"))
+
+    # -- decode-rank report bookkeeping ---------------------------------
+
+    def _done_out(self):
+        """Move fresh completions into the outbox and return the WHOLE
+        outbox — items re-send every round until retired."""
+        for rid, seq in list(self.engine.scheduler.completed.items()):
+            self._done_outbox[int(rid)] = seq.tokens.tolist()
+            del self.engine.scheduler.completed[rid]
+            self.served_local += 1
+        return dict(self._done_outbox)
+
+    def _retire_inflight(self, sent_ctl):
+        """A successful allgather proves the frontend finished the
+        PREVIOUS round (it only builds this round's control after
+        applying the last one's reports): retire what was inflight,
+        and promote this round's payload to inflight."""
+        for rid in self._inflight["acks"]:
+            if rid in self._ack_buf:
+                self._ack_buf.remove(rid)
+        for rid in self._inflight["rejects"]:
+            if rid in self._reject_buf:
+                self._reject_buf.remove(rid)
+        for rid in self._inflight["done"]:
+            self._done_outbox.pop(rid, None)
+        self._inflight = {"acks": list(sent_ctl["acks"]),
+                          "rejects": list(sent_ctl["rejects"]),
+                          "done": list(sent_ctl["done"])}
+
+    def _apply_decode_report(self, peer_rank, peer, now):
+        for rid in peer.get("acks", ()):
+            rec = self._assigned.get(rid)
+            if rec is not None and rec["rank"] == peer_rank:
+                rec["acked"] = True
+        for rid in peer.get("rejects", ()):
+            rec = self._assigned.pop(rid, None)
+            if rec is not None:
+                self._pending.insert(0, rec["req"])
+        for rid, tokens in peer.get("done", {}).items():
+            rid = int(rid)
+            if rid in self._completed:
+                continue  # duplicate (re-queued then both finished)
+            self._completed[rid] = np.asarray(tokens, np.int32)
+            self._latency[rid] = max(
+                now - self._rid_req(rid).arrival_t * self.time_scale,
+                0.0)
+            # Duplicate guard: a re-queued copy may still be pending
+            # here or re-assigned to another rank — drop/cancel it so
+            # nothing double-serves (first completion wins).
+            rec = self._assigned.pop(rid, None)
+            if rec is not None and rec["rank"] != peer_rank:
+                self._cancel.append(rid)
+            self._pending = [r for r in self._pending if r.rid != rid]
+
+    def _exchange_payloads(self, b, rank, size, front, packed, tag):
+        from horovod_tpu.common import eager_ops
+
+        sizes = np.zeros(size, np.int64)
+        chunks = []
+        if rank == 0:
+            for target in range(size):
+                for meta, payload in packed.get(target, ()):
+                    sizes[target] += len(payload)
+                    chunks.append(payload)
+        buf = np.frombuffer(b"".join(chunks), np.uint8) if chunks \
+            else np.zeros(0, np.uint8)
+        out = eager_ops.alltoall_async(
+            buf, sizes.tolist(), f"{tag}.kv").synchronize()
+        if rank == 0:
+            return
+        # Everything received came from rank 0, packed in assignment
+        # order for THIS rank.
+        mine = [m for m in front["assign"] if m["target"] == rank]
+        data = out.tobytes()
+        off = 0
+        for meta in mine:
+            payload = data[off:off + meta["nbytes"]]
+            off += meta["nbytes"]
+            if self._adopt_assignment(meta, payload):
+                self._ack_buf.append(meta["rid"])
+            else:
+                self._reject_buf.append(meta["rid"])

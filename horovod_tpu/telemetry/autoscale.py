@@ -31,6 +31,33 @@ recipe applied to training-runtime signals.
 from collections import deque
 from dataclasses import dataclass, field
 
+# THE serving-field sentinel set (docs/serving.md): every consumer —
+# /healthz (telemetry/debug_server.py), collect_signals below, and
+# serving_signals itself — shares this one literal, so the pinned
+# field names/defaults can never drift apart. kv_blocks_* -1 means
+# "no pool in this process", distinct from a pool momentarily empty.
+SERVING_SIGNAL_DEFAULTS = {
+    "serving_queue_depth": 0,
+    "inflight_sequences": 0,
+    "kv_blocks_free": -1,
+    "kv_blocks_total": -1,
+}
+
+
+def read_serving_signals():
+    """The live decode service's signal dict, or the defaults. Lazy
+    ``sys.modules`` lookup: a non-serving process never imports the
+    serving package for its health check."""
+    import sys
+
+    svc = sys.modules.get("horovod_tpu.serving.service")
+    if svc is not None:
+        try:
+            return svc.serving_signals()
+        except Exception:  # noqa: BLE001 — signals must come back
+            pass
+    return dict(SERVING_SIGNAL_DEFAULTS)
+
 
 @dataclass
 class Signals:
@@ -53,6 +80,15 @@ class Signals:
     # (docs/metrics.md "Overlap ledger").
     overlap_efficiency: float = 0.0
     exposed_wire_ms: float = 0.0
+    # Serving-lane additions (r18, same back-compat discipline —
+    # defaults keep pre-serving observation sources constructing):
+    # the decode service's /healthz field set (docs/serving.md).
+    # kv_blocks_* default -1 = "no pool in this process", distinct
+    # from a real pool that is momentarily empty.
+    serving_queue_depth: int = 0
+    inflight_sequences: int = 0
+    kv_blocks_free: int = -1
+    kv_blocks_total: int = -1
 
 
 @dataclass
@@ -202,6 +238,7 @@ def collect_signals(basics=None, t=None):
     except Exception:  # noqa: BLE001
         pass
     overlap = snap.get("wire", {}).get("overlap", {})
+    serving = read_serving_signals()
     return Signals(
         t=_time.monotonic() if t is None else t,
         world_size=b.size() if b.is_initialized() else 1,
@@ -215,6 +252,10 @@ def collect_signals(basics=None, t=None):
         overlap_efficiency=float(
             overlap.get("overlap_efficiency", 0.0)),
         exposed_wire_ms=float(overlap.get("exposed_wire_ms", 0.0)),
+        serving_queue_depth=int(serving["serving_queue_depth"]),
+        inflight_sequences=int(serving["inflight_sequences"]),
+        kv_blocks_free=int(serving["kv_blocks_free"]),
+        kv_blocks_total=int(serving["kv_blocks_total"]),
     )
 
 

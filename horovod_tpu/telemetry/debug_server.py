@@ -76,6 +76,17 @@ def _healthz(basics):
     except Exception:  # noqa: BLE001
         pass
     out["pending_rejoiners"] = pending
+    # Serving-lane fields (docs/serving.md): queue depth, in-flight
+    # sequences, and paged-KV pool occupancy — the load-balancer /
+    # autoscaler signal set for a decode rank. Always present
+    # (autoscale.SERVING_SIGNAL_DEFAULTS sentinels when no service is
+    # live) so the /healthz field set stays pinned.
+    try:
+        from horovod_tpu.telemetry.autoscale import read_serving_signals
+
+        out.update(read_serving_signals())
+    except Exception:  # noqa: BLE001 — health must answer anyway
+        pass
     try:
         snap = basics.metrics_snapshot()
         out["elastic"] = {

@@ -39,7 +39,13 @@ import sys
 # disagree about what distinguishes rows of one metric family.
 ROW_IDENTITY_FIELDS = ("metric", "config", "name", "schedule", "bench",
                        "ranks", "bytes", "payload_bytes", "bucket_bytes",
-                       "V", "accum", "dtype", "op")
+                       "V", "accum", "dtype", "op",
+                       # Serving rows (serving_latency): the offered
+                       # load and KV block geometry identify a series —
+                       # interleaving different traces or block sizes
+                       # into one EWMA baseline would flag every config
+                       # transition as a regression.
+                       "arrival_rps", "block_size")
 
 # Watched series and their bad direction: step time up = slower,
 # busbw/efficiency/MFU down = slower. Matched against the REAL bench
@@ -55,6 +61,14 @@ DEFAULT_WATCH = {
     "busbw_gbps": "down",
     "overlap_efficiency": "down",
     "mfu": "down",
+    # Serving rows (bench.py --serving / serving_latency family):
+    # request latency percentiles regress UP, sustained decode
+    # throughput regresses DOWN — watched from day one so the CI gate
+    # covers the serving lane the moment it emits rows.
+    "p50_ms": "up",
+    "p99_ms": "up",
+    "sustained_tok_s": "down",
+    "tok_s": "down",
 }
 
 

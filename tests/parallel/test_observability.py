@@ -267,12 +267,19 @@ def test_healthz_and_stacks_respond_while_peer_sigstopped(tmp_path):
     assert health["rank"] == 0 and health["initialized"], health
     # The autoscaler's signal set rides /healthz (docs/scale.md): one
     # endpoint serves everything the scaling policy consumes — field
-    # set PINNED here (r17 adds the overlap-ledger pair; autoscale
-    # Signals defaults keep older payloads constructing).
+    # set PINNED here (r17 adds the overlap-ledger pair, r18 the
+    # serving quartet; autoscale Signals defaults keep older payloads
+    # constructing).
     for key in ("queue_depth", "straggler_skew_ms", "step_time_ewma_ms",
                 "pending_rejoiners", "debug_port", "overlap_efficiency",
-                "exposed_wire_ms"):
+                "exposed_wire_ms", "serving_queue_depth",
+                "inflight_sequences", "kv_blocks_free",
+                "kv_blocks_total"):
         assert key in health, (key, sorted(health))
+    # No serving loop in this process: the sentinel defaults, not a
+    # phantom empty pool.
+    assert health["serving_queue_depth"] == 0, health
+    assert health["kv_blocks_total"] == -1, health
     assert health["debug_port"] == dbg_port, health
     assert isinstance(health["queue_depth"], int), health
     assert isinstance(health["pending_rejoiners"], int), health

@@ -1,0 +1,139 @@
+"""Elastic serving: prefill/decode disaggregation over the host ring,
+and the chaos acceptance — SIGKILL a decode rank mid-trace, every
+admitted request completes on the survivors with token-identical
+greedy output (docs/serving.md "Elastic behavior").
+
+Two-rank worlds: rank 0 frontend+prefill, rank 1 decode; int8 paged KV
+blocks ship over the CRC-framed chunked host ring (one alltoall per
+assignment round). The kill test's recovery path is the full r12/r14
+machinery: typed ``HorovodPeerFailureError`` at the round boundary ->
+in-place 1-rank re-formation -> orphaned requests re-queued and decoded
+by the survivor — whose replay must be indistinguishable from a world
+where the victim never existed.
+
+Workers live in this importable module (spawn must re-import them —
+the r11 gotcha).
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from tests.parallel.test_chaos_matrix import run_chaos
+
+pytestmark = pytest.mark.quick
+
+_N_REQUESTS = 8
+_RPS = 120.0
+_TRACE_SEED = 9
+_KILL_ROUND = 5
+
+
+def _setup(quantized):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from horovod_tpu.models import LlamaConfig, llama_init
+    from horovod_tpu.serving.scheduler import poisson_trace
+
+    cfg = LlamaConfig.tiny(dtype="float32", n_layers=2)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    trace = poisson_trace(_N_REQUESTS, _RPS, seed=_TRACE_SEED,
+                          prompt_len=(4, 10), max_new=(3, 7),
+                          vocab_size=cfg.vocab_size)
+    return cfg, params, trace
+
+
+def _make_loop(cfg, params, trace, hook=None, quantized=True):
+    from horovod_tpu.serving.service import ServingLoop
+
+    return ServingLoop(params, cfg, trace, block_size=8, n_blocks=64,
+                       max_batch=4, max_context=32,
+                       quantized=quantized, steps_per_round=2,
+                       prefill_per_round=2, round_hook=hook)
+
+
+def _verify_all(report, cfg, params, trace):
+    import jax
+
+    from horovod_tpu.models import llama_generate
+
+    assert report["served"] == len(trace), (
+        report["served"], len(trace))
+    for req in trace:
+        ref = np.asarray(llama_generate(
+            params, jax.numpy.asarray(req.prompt[None, :]), cfg,
+            req.max_new_tokens))[0]
+        got = report["completed"][req.rid]
+        np.testing.assert_array_equal(got, ref, err_msg=f"rid {req.rid}")
+
+
+def _disagg_worker(rank, size):
+    """No-fault 2-rank disaggregation: every request decodes REMOTELY
+    (rank 1) off int8 blocks shipped from rank 0's prefill, and the
+    output is still llama_generate's exact tokens (f32 reference —
+    quantization must not leak into the greedy path's determinism, see
+    test_serving.py's quantized-parity note; this seed decodes
+    identically, pinning the shipped-vs-local path equivalence)."""
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.basics import HorovodBasics
+
+    b = HorovodBasics()
+    hvd_elastic.init()
+    cfg, params, trace = _setup(quantized=False)
+    loop = _make_loop(cfg, params, trace, quantized=False)
+    report = loop.run()
+    if b.rank() == 0:
+        assert report["faults_survived"] == 0, report
+        _verify_all(report, cfg, params, trace)
+        # Disaggregation really happened: the frontend never decoded.
+        assert loop.engine.steps == 0, loop.engine.steps
+    else:
+        assert report["served"] > 0, "decode rank served nothing"
+    b.shutdown()
+    return "ok"
+
+
+def test_two_rank_disaggregated_poisson_serves_all():
+    results = run_chaos(_disagg_worker, 2, victims=(), timeout=240,
+                        env={"HOROVOD_WIRE_TIMEOUT_MS": "4000"},
+                        expect_sigkill=False)
+    assert results == {0: "ok", 1: "ok"}
+
+
+def _kill_worker(rank, size):
+    from horovod_tpu.common import elastic as hvd_elastic
+    from horovod_tpu.common.basics import HorovodBasics
+
+    b = HorovodBasics()
+    hvd_elastic.init()
+    cfg, params, trace = _setup(quantized=True)
+
+    def hook(loop, round_idx):
+        if rank == 1 and round_idx == _KILL_ROUND:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    loop = _make_loop(cfg, params, trace, hook=hook, quantized=True)
+    report = loop.run()
+    assert b.rank() == 0  # the only survivor reports
+    assert report["faults_survived"] >= 1, report
+    assert b.size() == 1, b.size()
+    _verify_all(report, cfg, params, trace)
+    # The survivor genuinely took over decoding.
+    assert loop.engine.steps > 0
+    el = b.metrics_snapshot()["elastic"]
+    assert el["faults_detected"] >= 1, el
+    b.shutdown()
+    return "ok"
+
+
+def test_kill_decode_rank_midtrace_completes_on_survivor():
+    """The ISSUE acceptance chaos case: SIGKILL the decode rank with
+    admitted sequences in flight; the surviving frontend re-forms a
+    1-rank world, re-queues the orphans, and serves the WHOLE trace
+    token-identically to llama_generate."""
+    results = run_chaos(_kill_worker, 2, victims={1}, timeout=240,
+                        env={"HOROVOD_WIRE_TIMEOUT_MS": "2000"})
+    assert results == {0: "ok"}
