@@ -176,8 +176,12 @@ scale-smoke: core
 # request trace with int8 paged KV shipped over the CRC-framed host
 # ring; the decode rank is SIGKILLed mid-trace and every admitted
 # request must complete on the survivor with greedy output
-# token-identical to llama_generate (docs/serving.md;
-# horovod_tpu/serving/serve_smoke.py; ~60 s).
+# token-identical to llama_generate — AND the latency cliff must be
+# EXPLAINED: every completed rid stitches into a gap-free request span
+# chain (per-phase sums == wall time exactly), the chaos victim's
+# orphans carry fault_requeue spans and only they do, and
+# report.py --requests renders the tail attribution over the dumps
+# (docs/serving.md; horovod_tpu/serving/serve_smoke.py; ~60 s).
 serve-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.serving.serve_smoke
 

@@ -688,9 +688,12 @@ def _serving_rows():
     """Serving-lane rows (docs/serving.md): sustained tok/s and
     p50/p99 request latency of the continuous-batching decode engine
     under a seeded Poisson arrival trace, one row per paged-KV block
-    format (f32 / int8). Runs horovod_tpu/serving/bench_lane.py as a
-    CPU-pinned SUBPROCESS — substrate-independent like ring_busbw, and
-    the flagship lane's virgin-device-heap requirement stays intact."""
+    format (f32 / int8), plus the `serving_trace_overhead` row
+    (request-tracing on vs off on the closed-loop decode lane; the
+    < 2% criterion mirrors --events-overhead). Runs
+    horovod_tpu/serving/bench_lane.py as a CPU-pinned SUBPROCESS —
+    substrate-independent like ring_busbw, and the flagship lane's
+    virgin-device-heap requirement stays intact."""
     import os
     import subprocess
 
@@ -1341,7 +1344,8 @@ def main():
     if "--serving" in argv:
         # Standalone serving lane (no accelerator needed): the
         # continuous-batching decode engine under a Poisson trace,
-        # f32 and int8 paged-KV rows.
+        # f32 and int8 paged-KV rows + the request-tracing overhead
+        # check (serving_trace_overhead, < 2% criterion).
         for row in _serving_rows():
             emit(row)
         return

@@ -46,6 +46,16 @@ const EventSpec kEventSpecs[(int)EventType::kTypeCount] = {
     // attributes to the step window its timestamp falls inside.
     {"step_begin", "", "", "step", ""},
     {"step_end", "", "", "step", "dur_us"},
+    // Serving-request lifecycle transition (docs/serving.md): rid in c
+    // (an int64 request id), phase-specific aux in d.
+    {"request", "phase", "", "rid", "aux"},
+};
+
+// Order is ABI with RequestPhase (events.h) and mirrored by
+// telemetry.reqtrace.REQUEST_PHASES.
+const char* kRequestPhaseNames[kReqPhaseCount] = {
+    "queued",        "prefill",         "kv_ship",       "decode_wait",
+    "decode_active", "evicted_requeue", "fault_requeue", "done",
 };
 
 const char* kKnobNames[] = {"fusion_bytes", "cycle_time_us", "ring_chunk",
@@ -54,6 +64,11 @@ const char* kKnobNames[] = {"fusion_bytes", "cycle_time_us", "ring_chunk",
 thread_local int t_event_plane = 0;
 
 }  // namespace
+
+const char* RequestPhaseName(int phase) {
+  if (phase < 0 || phase >= kReqPhaseCount) return "unknown";
+  return kRequestPhaseNames[phase];
+}
 
 const char* EventTypeName(EventType t) {
   int i = (int)t;
@@ -178,6 +193,13 @@ std::string EventJson(const EventRecord& e) {
   if (e.type == EventType::kPhase) {
     out += ",\"phase_name\":\"";
     out += ControlPhaseName(e.a);
+    out += "\"";
+  }
+  // And for the serving-request lifecycle phase (ONE table again —
+  // reqtrace's stitcher reads the decoded name, never the id).
+  if (e.type == EventType::kRequest) {
+    out += ",\"phase_name\":\"";
+    out += RequestPhaseName(e.a);
     out += "\"";
   }
   out += "}";

@@ -56,8 +56,31 @@ enum class EventType : int32_t {
   kPhase,               // a=ControlPhase (metrics.h), c=dur_us
   kStepBegin,           // c=step id (monotonic, hvdtpu_step_mark)
   kStepEnd,             // c=step id, d=dur_us
+  kRequest,             // a=RequestPhase, c=rid, d=aux (phase-specific:
+                        // tokens/bytes) — serving-lane lifecycle
+                        // transition (hvdtpu_record_request)
   kTypeCount
 };
+
+// Serving-request lifecycle phases for kRequest (docs/serving.md): each
+// event marks the instant a request ENTERS the phase, so a rid's span
+// chain is the gaps between its consecutive transitions — gap-free by
+// construction (telemetry/reqtrace.py stitches them across ranks).
+// Order is ABI: telemetry.reqtrace.REQUEST_PHASES mirrors it by index
+// (pinned in tests/single/test_reqtrace.py).
+enum RequestPhase : int32_t {
+  kReqQueued = 0,      // admitted to the frontend's pending line
+  kReqPrefill,         // prefill compute started for this request
+  kReqKvShip,          // packed; KV payload in flight to a decode rank
+  kReqDecodeWait,      // adopted/admitted, between decode steps
+  kReqDecodeActive,    // inside a decode step's batch this instant
+  kReqEvictedRequeue,  // LIFO-evicted; waiting for re-prefill
+  kReqFaultRequeue,    // orphaned by a peer fault; re-queued
+  kReqDone,            // terminal: completion reached the scoreboard
+  kReqPhaseCount
+};
+
+const char* RequestPhaseName(int phase);
 
 // Knob ids for kKnobAdopt (autotuner moves + worker lockstep adoption).
 enum EventKnob : int32_t {

@@ -2478,6 +2478,15 @@ void hvdtpu_record_phase(int phase, int64_t dur_us) {
   RecordControlPhase(phase, dur_us);
 }
 
+// Record one serving-request lifecycle transition (RequestPhase,
+// events.h) from the Python serving lane: the rid-tagged kRequest
+// family telemetry/reqtrace.py stitches into per-request span chains
+// (docs/serving.md "Request lifecycle & tracing"). Wait-free like
+// every Record; valid before init like the ring itself.
+void hvdtpu_record_request(int phase, int64_t rid, int64_t aux) {
+  GlobalEvents().Record(EventType::kRequest, phase, 0, rid, aux);
+}
+
 // Live pending-tensor gauge: collectives enqueued by API threads that
 // the background loop has not finished executing. The queue-depth
 // signal the autoscaler's /healthz consumes (docs/scale.md) — a gauge,

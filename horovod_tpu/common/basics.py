@@ -128,6 +128,8 @@ def load_library():
         lib.hvdtpu_metrics_reset.argtypes = []
         lib.hvdtpu_record_phase.restype = None
         lib.hvdtpu_record_phase.argtypes = [i32, i64]
+        lib.hvdtpu_record_request.restype = None
+        lib.hvdtpu_record_request.argtypes = [i32, i64, i64]
         lib.hvdtpu_step_mark.restype = i64
         lib.hvdtpu_step_mark.argtypes = [i32]
         lib.hvdtpu_step_id.restype = i64
@@ -385,6 +387,16 @@ class HorovodBasics:
         if isinstance(phase, str):
             phase = self.CONTROL_PHASES.index(phase)
         self.lib.hvdtpu_record_phase(int(phase), int(dur_us))
+
+    def record_request(self, phase, rid, aux=0):
+        """Record one serving-request lifecycle transition (``request``
+        event, csrc/events.h RequestPhase): the rid enters ``phase``
+        (an index into :data:`horovod_tpu.telemetry.reqtrace.
+        REQUEST_PHASES`, which mirrors the C table) now. The serving
+        lane calls this through :func:`telemetry.reqtrace.
+        record_request` (which also keeps the live in-flight table the
+        ``/requests`` debug endpoint serves). Valid before ``init()``."""
+        self.lib.hvdtpu_record_request(int(phase), int(rid), int(aux))
 
     def step_mark(self, begin=True):
         """Mark a training-step boundary for the step-anatomy layer

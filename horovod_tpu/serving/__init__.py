@@ -7,10 +7,15 @@ scheduler (admit/evict per decode step against a token budget —
 scheduler.py), a static-shape decode engine over
 ``models.generate.llama_decode_step`` (engine.py), and the elastic
 serving loop with prefill/decode disaggregation over the CRC-framed
-chunked host ring (service.py). ``make serve-smoke`` kills a decode
-rank mid-trace and pins that every admitted request still completes,
-token-identically, on the survivors. docs/serving.md has the full
-semantics table.
+chunked host ring (service.py). Every request's lifecycle is traced
+through the core event ring (rid-tagged ``request`` events ->
+:mod:`horovod_tpu.telemetry.reqtrace` span ledgers,
+``report.py --requests`` tail attribution, the ``/requests`` live
+endpoint). ``make serve-smoke`` kills a decode rank mid-trace and pins
+that every admitted request still completes, token-identically, on
+the survivors — and that the stitched request chains attribute the
+latency cliff to ``fault_requeue``, gap-free. docs/serving.md has the
+full semantics table.
 
 Reference analog: none — upstream Horovod is a training runtime; this
 lane is what ROADMAP item 1 calls the path from "fast kernel" to

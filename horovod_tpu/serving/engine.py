@@ -24,6 +24,7 @@ import numpy as np
 
 from horovod_tpu.serving.kvcache import PagedKVCache
 from horovod_tpu.serving.scheduler import ContinuousBatchingScheduler
+from horovod_tpu.telemetry import reqtrace
 
 
 class DecodeEngine:
@@ -83,16 +84,22 @@ class DecodeEngine:
 
     def _admit_local(self):
         for seq in self.scheduler.admit():
+            reqtrace.record_request("prefill", seq.rid,
+                                    aux=len(seq.req.prompt))
             first, k, v = self.prefill(seq.req)
             self.pool.write(seq.blocks, 0, k, v)
             seq.generated.append(first)
             self.tokens_out += 1
             if seq.done:  # max_new_tokens == 1: prefill finished it
                 self.scheduler.complete(seq)
+            else:
+                reqtrace.record_request("decode_wait", seq.rid)
 
     def adopt_remote(self, seq):
         """Register a sequence whose blocks were shipped in (service
         lane). The caller allocated+wrote the blocks already."""
+        reqtrace.record_request("decode_wait", seq.rid,
+                                aux=len(seq.blocks))
         self.scheduler.adopt(seq)
 
     # ---- the decode step ----------------------------------------------
@@ -113,6 +120,15 @@ class DecodeEngine:
         if not live:
             return []
         live = live[:self.max_batch]
+        # Request tracing: this batch's rows are DECODING for the span
+        # of the jitted step; survivors fall back to decode_wait after
+        # it. One transition pair per row per step is the ledger's
+        # resolution (tail_report aggregates the alternation), cheap
+        # enough that `bench.py --serving` pins the whole tracing cost
+        # under 2% of sustained tok/s.
+        for seq in live:
+            reqtrace.record_request("decode_active", seq.rid,
+                                    aux=seq.cached)
         out = self._decode_batch(live)
         events = []
         for seq, tok in zip(live, out):
@@ -123,6 +139,8 @@ class DecodeEngine:
             events.append((seq.rid, tok, seq.done))
             if seq.done:
                 self.scheduler.complete(seq)
+            else:
+                reqtrace.record_request("decode_wait", seq.rid)
         self.steps += 1
         return events
 

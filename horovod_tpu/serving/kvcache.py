@@ -135,6 +135,21 @@ class PagedKVCache:
                 f"of {self.n_blocks}")
         out = [self._free.pop() for _ in range(n)]
         self._allocated.update(out)
+        if self.quantized:
+            # A handed-out block must be SCALE-fresh: `_write_block_q`
+            # merges against the block's current scale, and a reused
+            # block still carrying its previous owner's (possibly much
+            # larger) scale would quantize the new owner's first write
+            # under it — different bytes than `quantize_blocks`, i.e.
+            # the local-write==wire equivalence the elastic replay
+            # token-identity pin rests on breaks, and it breaks
+            # TIMING-DEPENDENTLY (which block the LIFO list hands back
+            # depends on eviction churn). Stale payload beyond a
+            # sequence's `cached` slots is fine — the lengths mask
+            # hides it — but scales feed every future write.
+            idx = np.asarray(out, np.int64)
+            self.k_scale[idx] = 0.0
+            self.v_scale[idx] = 0.0
         return out
 
     def free(self, blocks):

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from horovod_tpu.serving.kvcache import OutOfBlocks
+from horovod_tpu.telemetry import reqtrace
 
 
 @dataclass
@@ -112,6 +113,14 @@ class ContinuousBatchingScheduler:
         self.running = []            # admission order: oldest first
         self.completed = {}          # rid -> Sequence
         self.evictions = 0
+        # Eviction amplification (docs/serving.md): prompt tokens that
+        # must be prefilled AGAIN because their first pass was thrown
+        # away (LIFO eviction or elastic fault re-queue), against the
+        # generated tokens that actually reached a completion. The
+        # ratio is the pool-thrash signal /healthz and the Prometheus
+        # exporter carry.
+        self.recomputed_prefill_tokens = 0
+        self.useful_tokens = 0
 
     # ---- signals -------------------------------------------------------
 
@@ -130,6 +139,7 @@ class ContinuousBatchingScheduler:
     # ---- admission -----------------------------------------------------
 
     def submit(self, req):
+        reqtrace.record_request("queued", req.rid, aux=len(req.prompt))
         self.waiting.append(req)
 
     def requeue_front(self, reqs):
@@ -200,12 +210,22 @@ class ContinuousBatchingScheduler:
         seq.generated = []
         self.requeue_front([seq.req])
         self.evictions += 1
+        # The prompt's prefill pass is now wasted work: it runs again
+        # when the request is re-admitted (the generated tail is also
+        # re-decoded, but the ledger counts prefill recompute — the
+        # quantity the amplification ratio names).
+        self.recomputed_prefill_tokens += len(seq.req.prompt)
+        reqtrace.record_request("evicted_requeue", seq.rid,
+                                aux=len(seq.req.prompt))
 
     def complete(self, seq):
         self.running.remove(seq)
         self.pool.free(seq.blocks)
         seq.blocks = []
         self.completed[seq.rid] = seq
+        self.useful_tokens += len(seq.generated)
+        reqtrace.record_request("done", seq.rid,
+                                aux=len(seq.generated))
 
     def drop(self, rid):
         """Cancel a running/waiting request (the elastic duplicate
@@ -216,17 +236,28 @@ class ContinuousBatchingScheduler:
                 self.running.remove(s)
                 self.pool.free(s.blocks)
                 s.blocks = []
+                # No `done` transition here: the completion that wins
+                # lives on another rank, whose event is the chain's
+                # terminal — only the live table forgets the rid.
+                reqtrace.forget_request(rid)
                 return True
         for r in list(self.waiting):
             if r.rid == rid:
                 self.waiting.remove(r)
+                reqtrace.forget_request(rid)
                 return True
         return False
 
     def signals(self):
         """The /healthz serving field set (docs/serving.md)."""
         out = {"serving_queue_depth": self.queue_depth,
-               "inflight_sequences": self.inflight}
+               "inflight_sequences": self.inflight,
+               "recomputed_prefill_tokens":
+                   self.recomputed_prefill_tokens,
+               "useful_tokens": self.useful_tokens,
+               "eviction_amplification": round(
+                   self.recomputed_prefill_tokens
+                   / max(self.useful_tokens, 1), 6)}
         out.update(self.pool.stats())
         return out
 

@@ -15,7 +15,14 @@ mid-trace, with admitted sequences in flight. Asserts:
    home died (the static-shape engine + source-side quantization
    determinism, docs/serving.md);
 3. the victim really died by SIGKILL (exit code pins the chaos, not a
-   clean shutdown).
+   clean shutdown);
+4. request-scoped tracing EXPLAINS the latency cliff
+   (docs/serving.md "Request lifecycle & tracing"): the survivor's
+   event dump stitches into one gap-free span chain per completed rid
+   (per-phase sums reconcile to the chain's wall time EXACTLY — the
+   r17 standard), the victim's orphaned requests carry a
+   ``fault_requeue`` span (and only they do), and
+   ``report.py --requests`` renders the tail attribution.
 """
 
 import json
@@ -89,8 +96,48 @@ def worker():
                     "faults_survived", "evictions", "rounds",
                     "sustained_tok_s", "p50_ms", "p99_ms")}
         print("SERVE_SMOKE_OK " + json.dumps(summary), flush=True)
+        _verify_request_chains(b, loop, report)
     b.shutdown()
     return 0
+
+
+def _verify_request_chains(b, loop, report):
+    """Acceptance 4: dump the survivor's event ring, stitch the
+    per-request span chains, and assert the chaos is EXPLAINED — every
+    completed rid's chain is gap-free with per-phase sums reconciling
+    to its wall time exactly, and `fault_requeue` spans appear on
+    precisely the requests the fault orphaned."""
+    from horovod_tpu.telemetry import critpath, reqtrace
+
+    dump_dir = os.environ.get("SERVE_SMOKE_DUMPS")
+    if not dump_dir:
+        return
+    path = os.path.join(dump_dir, f"blackbox-rank{b.rank()}.jsonl")
+    critpath.write_event_dump(path, b.rank(), b.size(),
+                              b.events_drain(),
+                              epoch=int(b.lib.hvdtpu_epoch()))
+    chains = reqtrace.stitch(dump_dir)
+    for rid in report["completed"]:
+        chain = chains.get(int(rid))
+        assert chain is not None, f"rid {rid}: no stitched chain"
+        assert chain["complete"], f"rid {rid}: no terminal done"
+        defects = reqtrace.chain_gaps(chain)
+        assert not defects, f"rid {rid}: chain defects {defects}"
+        # The exact-reconciliation pin, recomputed independently of
+        # the stitcher's construction.
+        assert sum(chain["phase_us"].values()) == chain["wall_us"], rid
+    fault_rids = {rid for rid, c in chains.items()
+                  if c["phase_us"].get("fault_requeue", 0) > 0}
+    assert fault_rids == loop.requeued_rids, (
+        "fault_requeue attribution does not match the re-queued set",
+        sorted(fault_rids), sorted(loop.requeued_rids))
+    assert fault_rids, "chaos fired but no request carries a " \
+                       "fault_requeue span"
+    print("REQTRACE_OK " + json.dumps({
+        "chains": len(chains),
+        "complete": sum(c["complete"] for c in chains.values()),
+        "fault_requeued": sorted(fault_rids),
+    }), flush=True)
 
 
 def _free_port():
@@ -107,9 +154,12 @@ def main():
     if "--worker" in sys.argv:
         return worker()
 
+    import tempfile
+
     port = _free_port()
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    dump_dir = tempfile.mkdtemp(prefix="serve_smoke_reqtrace_")
     procs = []
     for r in range(2):
         env = dict(os.environ)
@@ -119,6 +169,8 @@ def main():
             "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
             "HOROVOD_CONTROLLER_PORT": str(port),
             "HOROVOD_WIRE_TIMEOUT_MS": "2000",
+            "HOROVOD_EVENTS": "1",
+            "SERVE_SMOKE_DUMPS": dump_dir,
             "JAX_PLATFORMS": "cpu",
             "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
         })
@@ -140,12 +192,33 @@ def main():
     summary = json.loads(ok_lines[0].split(" ", 1)[1])
     assert summary["faults_survived"] >= 1, summary
     assert summary["served"] == summary["requests"] == N_REQUESTS
+    trace_lines = [ln for ln in out.splitlines()
+                   if ln.startswith("REQTRACE_OK")]
+    assert trace_lines, f"no REQTRACE_OK line:\n{out}"
+    reqtrace_summary = json.loads(trace_lines[0].split(" ", 1)[1])
+    assert reqtrace_summary["complete"] == N_REQUESTS, reqtrace_summary
+    assert reqtrace_summary["fault_requeued"], reqtrace_summary
+    # The operator-facing renderer over the same dumps: the tail band
+    # must attribute through the CLI too (report.py --requests).
+    from horovod_tpu.telemetry.report import main as report_main
+
+    rc = report_main(["--requests", dump_dir])
+    assert rc == 0, "report.py --requests failed over smoke dumps"
     print(f"serve-smoke OK in {time.monotonic() - t0:.1f}s: "
           f"{summary['served']}/{summary['requests']} requests "
           f"token-identical across a SIGKILLed decode rank "
           f"({summary['generated_tokens']} tokens, "
           f"p99 {summary['p99_ms']:.0f} ms, "
-          f"{summary['faults_survived']} fault(s) survived)")
+          f"{summary['faults_survived']} fault(s) survived; "
+          f"{reqtrace_summary['complete']} gap-free request chains, "
+          f"fault_requeue on {reqtrace_summary['fault_requeued']})")
+    # Dumps are forensic evidence on a FAILED run (every assertion
+    # above raises before this line, leaving them in place); a green
+    # run cleans up after itself instead of leaking a /tmp dir per CI
+    # invocation.
+    import shutil
+
+    shutil.rmtree(dump_dir, ignore_errors=True)
     return 0
 
 

@@ -41,11 +41,19 @@ message — first completion wins, nothing double-serves.
 
 Load-balancer integration: the per-rank debug server's ``/healthz``
 (r15) carries the serving field set — queue depth, in-flight
-sequences, kv blocks free/total — via :func:`serving_signals`
-(module-level registry; zeros when no service is live).
+sequences, kv blocks free/total, rolling p50/p99 latency, served
+count, and eviction amplification — via :func:`serving_signals`
+(module-level registry; sentinel defaults when no service is live).
+Request-scoped tracing (r19, docs/serving.md "Request lifecycle &
+tracing"): every lifecycle transition records a rid-tagged ``request``
+event through :mod:`horovod_tpu.telemetry.reqtrace`, which also feeds
+the ``/requests`` live in-flight endpoint; offline,
+``report.py --requests`` stitches per-rank dumps into gap-free
+per-request span chains and decomposes the tail-latency band.
 """
 
 import time
+from collections import deque
 
 import numpy as np
 
@@ -56,6 +64,7 @@ from horovod_tpu.serving.scheduler import (
     Sequence,
     latency_summary,
 )
+from horovod_tpu.telemetry import reqtrace
 
 # The live service in this process (serving_signals / /healthz).
 _live = None
@@ -119,6 +128,16 @@ class ServingLoop:
         self._cancel = []             # rids to cancel on survivors
         self._completed = {}          # rid -> np.ndarray tokens
         self._latency = {}            # rid -> seconds
+        # Rolling completion-latency window (newest _LAT_WINDOW): the
+        # /healthz serving_p50/p99_ms pressure signal — percentiles
+        # over recent completions, not the whole run, so the
+        # autoscaler sees CURRENT latency, not history-diluted.
+        self._lat_window = deque(maxlen=128)
+        self.requests_served = 0
+        # rids the elastic path re-queued after a peer fault — the
+        # chaos smoke checks the stitched chains' fault_requeue set
+        # against exactly this.
+        self.requeued_rids = set()
         self._req_by_rid = {r.rid: r for r in self.trace}
         self._arrival_idx = 0
         # Decode-rank OUTBOXES: report payloads stay here until the
@@ -149,6 +168,15 @@ class ServingLoop:
     def signals(self):
         sig = self.engine.scheduler.signals()
         sig["serving_queue_depth"] += len(self._pending)
+        # Rolling latency pressure + served count. Decode ranks have no
+        # scoreboard (latency is measured where the request's arrival
+        # clock lives — the frontend), so their window is empty and
+        # served counts what THIS rank decoded.
+        lat = latency_summary(list(self._lat_window))
+        sig["serving_p50_ms"] = lat["p50_ms"]
+        sig["serving_p99_ms"] = lat["p99_ms"]
+        sig["requests_served"] = (self.requests_served
+                                  or self.served_local)
         return sig
 
     # ---- helpers -------------------------------------------------------
@@ -165,6 +193,8 @@ class ServingLoop:
         """Prefill one request and freeze its wire payload: pool-format
         blocks (quantized at the SOURCE when the pool is int8) plus the
         metadata a decode rank needs to adopt them."""
+        reqtrace.record_request("prefill", req.rid,
+                                aux=len(req.prompt))
         first, k, v = self.engine.prefill(req)
         bs = self.engine.pool.block_size
         k_q, v_q, k_s, v_s = quantize_blocks(
@@ -178,6 +208,10 @@ class ServingLoop:
                 "first": int(first), "max_new": int(req.max_new_tokens),
                 "n_blocks": int(k_q.shape[0]),
                 "nbytes": sum(len(p) for p in payload)}
+        # Packed: the payload is (about to be) in flight to its decode
+        # rank — kv_ship lasts until that rank's adoption transition
+        # (or, if the rank dies holding it, until fault_requeue).
+        reqtrace.record_request("kv_ship", req.rid, aux=meta["nbytes"])
         return meta, b"".join(payload)
 
     def _adopt_assignment(self, meta, payload):
@@ -222,6 +256,9 @@ class ServingLoop:
             pool.free(blocks)
             seq.blocks = []
             self.engine.scheduler.completed[seq.rid] = seq
+            self.engine.scheduler.useful_tokens += len(seq.generated)
+            reqtrace.record_request("done", seq.rid,
+                                    aux=len(seq.generated))
         else:
             self.engine.adopt_remote(seq)
         return True
@@ -230,7 +267,10 @@ class ServingLoop:
         while (self._arrival_idx < len(self.trace)
                and self.trace[self._arrival_idx].arrival_t
                * self.time_scale <= now):
-            self._pending.append(self.trace[self._arrival_idx])
+            req = self.trace[self._arrival_idx]
+            reqtrace.record_request("queued", req.rid,
+                                    aux=len(req.prompt))
+            self._pending.append(req)
             self._arrival_idx += 1
 
     def _local_admit(self, reqs):
@@ -292,6 +332,17 @@ class ServingLoop:
                     rec["rank"] = alive.index(target)
             # Oldest arrivals first, ahead of anything still pending.
             requeue.sort(key=lambda r: r.arrival_t)
+            for req in requeue:
+                # The orphan's extra latency books to fault_requeue
+                # from THIS instant until its replacement prefill
+                # starts — the span the chaos smoke's tail report
+                # attributes the latency cliff to. The dead rank also
+                # re-prefills the prompt, so it counts as recompute.
+                reqtrace.record_request("fault_requeue", req.rid,
+                                        aux=len(req.prompt))
+                self.requeued_rids.add(req.rid)
+                self.engine.scheduler.recomputed_prefill_tokens += \
+                    len(req.prompt)
             self._pending = requeue + self._pending
         return b.rank(), b.size()
 
@@ -356,6 +407,28 @@ class ServingLoop:
 
     def _rid_req(self, rid):
         return self._req_by_rid[rid]
+
+    def _score_completion(self, rid, now, remote=False):
+        """Frontend scoreboard entry for one completed rid: measured
+        latency, the rolling /healthz window, and the chain-terminal
+        ``done`` transition (the instant the user-visible answer
+        exists — a decode rank's own ``done`` marks local completion;
+        this one closes the request's span chain). ``remote`` books
+        the generated tokens as useful on the FRONTEND's scheduler
+        too — its amplification ratio must describe the service
+        (it holds the fault-requeue recompute counter), not divide a
+        fleet-wide numerator by a local-only denominator; local
+        completions were already counted by ``scheduler.complete``."""
+        lat = max(now - self._rid_req(rid).arrival_t * self.time_scale,
+                  0.0)
+        self._latency[rid] = lat
+        self._lat_window.append(lat)
+        self.requests_served += 1
+        generated = (len(self._completed[rid])
+                     - len(self._rid_req(rid).prompt))
+        if remote:
+            self.engine.scheduler.useful_tokens += generated
+        reqtrace.record_request("done", rid, aux=generated)
 
     def _round(self, b, rank, size, now):
         from horovod_tpu.common import elastic as hvd_elastic
@@ -443,9 +516,7 @@ class ServingLoop:
             for rid, seq in list(self.engine.scheduler.completed.items()):
                 if rid not in self._completed:
                     self._completed[rid] = seq.tokens
-                    self._latency[rid] = max(
-                        now - self._rid_req(rid).arrival_t
-                        * self.time_scale, 0.0)
+                    self._score_completion(rid, now)
         if rank == 0 and not front.get("shutdown"):
             idle = (not self._pending
                     and self._arrival_idx < len(self.trace)
@@ -492,15 +563,17 @@ class ServingLoop:
         for rid in peer.get("rejects", ()):
             rec = self._assigned.pop(rid, None)
             if rec is not None:
+                # NACK (decode pool full): back to the head of the
+                # line — a fresh queued span until the next prefill.
+                reqtrace.record_request(
+                    "queued", rid, aux=len(rec["req"].prompt))
                 self._pending.insert(0, rec["req"])
         for rid, tokens in peer.get("done", {}).items():
             rid = int(rid)
             if rid in self._completed:
                 continue  # duplicate (re-queued then both finished)
             self._completed[rid] = np.asarray(tokens, np.int32)
-            self._latency[rid] = max(
-                now - self._rid_req(rid).arrival_t * self.time_scale,
-                0.0)
+            self._score_completion(rid, now, remote=True)
             # Duplicate guard: a re-queued copy may still be pending
             # here or re-assigned to another rank — drop/cancel it so
             # nothing double-serves (first completion wins).

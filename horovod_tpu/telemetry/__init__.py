@@ -33,7 +33,15 @@ the Chrome timeline and the autotune log). Three pieces:
    (``HOROVOD_DEBUG_PORT``) serves ``/healthz`` ``/metrics``
    ``/events`` ``/stacks`` per rank, live.
 
-5. **Step anatomy** — :func:`step_mark` windows (driven by
+5. **Request anatomy** — the serving lane records rid-tagged
+   ``request`` lifecycle events (queued/prefill/kv_ship/decode/
+   requeue) through the same ring;
+   :mod:`~horovod_tpu.telemetry.reqtrace` stitches per-rank dumps into
+   gap-free per-request span chains (``report --requests`` decomposes
+   the tail-latency band by phase) and feeds the debug server's
+   ``/requests`` live in-flight view.
+
+6. **Step anatomy** — :func:`step_mark` windows (driven by
    :class:`StepTimer` and the eager optimizer) scope every event to a
    step; the core's overlap ledger (``wire.overlap``) splits wire time
    into exposed vs hidden per plane,
@@ -63,6 +71,13 @@ from horovod_tpu.telemetry.critpath import (  # noqa: F401
     write_event_dump,
 )
 from horovod_tpu.telemetry.exporters import MetricsScraper  # noqa: F401
+from horovod_tpu.telemetry.reqtrace import (  # noqa: F401
+    format_requests,
+    live_requests,
+    record_request,
+    stitch_requests,
+    tail_report,
+)
 from horovod_tpu.telemetry.postmortem import (  # noqa: F401
     format_post_mortem,
     merge_post_mortem,

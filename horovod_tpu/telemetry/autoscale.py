@@ -41,6 +41,17 @@ SERVING_SIGNAL_DEFAULTS = {
     "inflight_sequences": 0,
     "kv_blocks_free": -1,
     "kv_blocks_total": -1,
+    # r19: rolling-window request-latency pressure (the autoscaler can
+    # see latency, not just queue depth) + eviction amplification
+    # (recomputed prefill tokens per useful generated token — the
+    # pool-thrash signal; docs/serving.md "Request lifecycle &
+    # tracing"). Zeros = "no service live or nothing measured yet".
+    "serving_p50_ms": 0.0,
+    "serving_p99_ms": 0.0,
+    "requests_served": 0,
+    "recomputed_prefill_tokens": 0,
+    "useful_tokens": 0,
+    "eviction_amplification": 0.0,
 }
 
 
@@ -89,6 +100,17 @@ class Signals:
     inflight_sequences: int = 0
     kv_blocks_free: int = -1
     kv_blocks_total: int = -1
+    # r19 serving additions (same back-compat discipline; decision-
+    # invariant today — the policy reads none of them): rolling-window
+    # request latency so a latency-pressured but short-queued service
+    # is VISIBLE to a future policy, and eviction amplification
+    # (recomputed prefill tokens / useful tokens — KV-pool thrash).
+    serving_p50_ms: float = 0.0
+    serving_p99_ms: float = 0.0
+    requests_served: int = 0
+    recomputed_prefill_tokens: int = 0
+    useful_tokens: int = 0
+    eviction_amplification: float = 0.0
 
 
 @dataclass
@@ -256,6 +278,14 @@ def collect_signals(basics=None, t=None):
         inflight_sequences=int(serving["inflight_sequences"]),
         kv_blocks_free=int(serving["kv_blocks_free"]),
         kv_blocks_total=int(serving["kv_blocks_total"]),
+        serving_p50_ms=float(serving.get("serving_p50_ms", 0.0)),
+        serving_p99_ms=float(serving.get("serving_p99_ms", 0.0)),
+        requests_served=int(serving.get("requests_served", 0)),
+        recomputed_prefill_tokens=int(
+            serving.get("recomputed_prefill_tokens", 0)),
+        useful_tokens=int(serving.get("useful_tokens", 0)),
+        eviction_amplification=float(
+            serving.get("eviction_amplification", 0.0)),
     )
 
 

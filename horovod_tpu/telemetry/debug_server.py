@@ -17,6 +17,10 @@ and serves:
   no textfile hop.
 - ``/events`` — the newest event-ring tail as JSON
   (``?n=<count>``, default 256) — the flight recorder, live.
+- ``/requests`` — in-flight serving requests (``?n=<count>``, default
+  64): rid, current lifecycle phase, time in that phase, total age —
+  the live side of the request span ledger
+  (:mod:`horovod_tpu.telemetry.reqtrace`); empty on non-serving ranks.
 - ``/stacks`` — a ``faulthandler`` dump of every Python thread: where
   exactly a wedged rank is stuck (ctypes waits release the GIL, so the
   server thread answers even while the main thread blocks inside a
@@ -167,13 +171,22 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/events":
                 n = int(parse_qs(url.query).get("n", ["256"])[0])
                 self._reply(200, json.dumps(self.basics.events(n)))
+            elif url.path == "/requests":
+                # In-flight serving requests with current phase + age
+                # (docs/serving.md "Request lifecycle & tracing"): the
+                # live side of the reqtrace span ledger — answers on
+                # any rank, empty list when nothing is being served.
+                from horovod_tpu.telemetry import reqtrace
+
+                n = int(parse_qs(url.query).get("n", ["64"])[0])
+                self._reply(200, json.dumps(reqtrace.live_requests(n)))
             elif url.path == "/stacks":
                 self._reply(200, _stacks(), ctype="text/plain")
             else:
                 self._reply(404, json.dumps({
                     "error": f"unknown path {url.path}",
                     "endpoints": ["/healthz", "/metrics", "/events",
-                                  "/stacks"]}))
+                                  "/requests", "/stacks"]}))
         except Exception as e:  # noqa: BLE001 — a broken endpoint must
             # not kill the server thread (introspection of a sick
             # process is exactly when internals throw)

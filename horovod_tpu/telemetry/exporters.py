@@ -99,6 +99,20 @@ def _flatten_prom(snap, rank):
     for field in ("count", "p50_us", "p99_us", "max_us"):
         lines.append(f'hvdtpu_elastic_detect_{field}{{{label}}} '
                      f'{det.get(field, 0)}')
+    # Serving-lane gauges (docs/serving.md): queue/pool pressure,
+    # rolling request-latency percentiles, and eviction amplification
+    # (recomputed prefill tokens / useful tokens — KV-pool thrash).
+    # Sourced from the live service's signal set, sentinel defaults
+    # when no service runs in this process — the field set can never
+    # differ between a serving and a training scrape.
+    try:
+        from horovod_tpu.telemetry.autoscale import read_serving_signals
+
+        serving = read_serving_signals()
+    except Exception:  # noqa: BLE001 — the scrape must come back
+        serving = {}
+    for field, v in sorted(serving.items()):
+        lines.append(f'hvdtpu_serving_{field}{{{label}}} {v}')
     for r, n in enumerate(
             snap.get("straggler", {}).get("last_rank_counts", [])):
         lines.append(
@@ -154,6 +168,19 @@ class MetricsScraper:
         snap = _core.snapshot()
         rank = snap.get("rank", -1)
         row = {"ts": time.time(), **snap}
+        # Serving signal set on every scrape row (defaults when no
+        # service is live): the JSONL flight recorder is the offline
+        # twin of /healthz, and a post-mortem of a serving incident
+        # needs the latency/amplification trail next to the wire
+        # counters (docs/serving.md).
+        try:
+            from horovod_tpu.telemetry.autoscale import (
+                read_serving_signals,
+            )
+
+            row["serving"] = read_serving_signals()
+        except Exception:  # noqa: BLE001 — the scrape must come back
+            pass
         if self.jsonl_path:
             self._write_jsonl(row)
         if self.prom_path:

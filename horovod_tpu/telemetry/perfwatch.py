@@ -69,6 +69,10 @@ DEFAULT_WATCH = {
     "p99_ms": "up",
     "sustained_tok_s": "down",
     "tok_s": "down",
+    # Instrumentation-cost rows (events_overhead, the r19
+    # serving_trace_overhead lane): the flight recorder / request
+    # tracing getting more expensive IS a perf regression.
+    "overhead_pct": "up",
 }
 
 

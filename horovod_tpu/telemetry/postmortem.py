@@ -479,23 +479,35 @@ def format_post_mortem(analysis, tail=40):
 # ---- events -> Perfetto -----------------------------------------------
 
 
-def events_to_trace_events(dump, base_unix_us, tid=990):
+def events_to_trace_events(dump, base_unix_us, tid=990, req_tid=2000):
     """Render one dump's ring events as Chrome-trace events on the
     merged axis (``ts = wall_us - base_unix_us``): ``wire_span``
     becomes a complete ('X') span ending at its record time, everything
     else an instant ('i') — so chunk-level wire activity and heal-ladder
-    steps land on the same Perfetto timeline as the per-op spans."""
+    steps land on the same Perfetto timeline as the per-op spans.
+
+    Serving ``request`` events get PER-REQUEST tracks instead: each
+    rid's lifecycle transitions within this dump become named phase
+    spans ('X', one row per rid at ``tid = req_tid + rid``) — a
+    request's residency across queued/prefill/kv_ship/decode/requeue
+    phases reads as one lane on the rank that observed it
+    (cross-rank chains are :mod:`reqtrace`'s job; Perfetto shows each
+    rank's view)."""
     hdr = dump["header"]
     rank = hdr.get("rank", -1)
     out = [{
         "name": "thread_name", "ph": "M", "pid": rank, "tid": tid,
         "args": {"name": "events"},
     }]
+    requests = defaultdict(list)
     for ev in dump["events"]:
         wall = _wall_us(ev, hdr)
         ts = wall - base_unix_us
         args = {k: v for k, v in ev.items()
                 if k not in ("ts_us", "seq", "type")}
+        if ev.get("type") == "request":
+            requests[ev.get("rid", -1)].append((ts, ev))
+            continue
         if ev.get("type") == "wire_span":
             dur = max(int(ev.get("dur_us", 0)), 1)
             out.append({"name": f"wire_span p{ev.get('plane', 0)}",
@@ -505,4 +517,22 @@ def events_to_trace_events(dump, base_unix_us, tid=990):
             out.append({"name": ev.get("type", "event"), "ph": "i",
                         "ts": ts, "pid": rank, "tid": tid, "s": "t",
                         "args": args})
+    for rid, transitions in sorted(requests.items()):
+        transitions.sort(key=lambda t: (t[0], t[1].get("seq", 0)))
+        rid_tid = req_tid + (rid if isinstance(rid, int)
+                             and rid >= 0 else 0)
+        out.append({"name": "thread_name", "ph": "M", "pid": rank,
+                    "tid": rid_tid, "args": {"name": f"rid {rid}"}})
+        for (t0, ev0), (t1, _ev1) in zip(transitions, transitions[1:]):
+            out.append({
+                "name": ev0.get("phase_name", "request"), "ph": "X",
+                "ts": t0, "dur": max(t1 - t0, 1), "pid": rank,
+                "tid": rid_tid,
+                "args": {"rid": rid, "aux": ev0.get("aux", 0)}})
+        last_ts, last_ev = transitions[-1]
+        out.append({"name": last_ev.get("phase_name", "request"),
+                    "ph": "i", "ts": last_ts, "pid": rank,
+                    "tid": rid_tid, "s": "t",
+                    "args": {"rid": rid,
+                             "aux": last_ev.get("aux", 0)}})
     return out

@@ -324,6 +324,18 @@ def main(argv=None):
                          "merge (bounded memory, timeline tail only). "
                          "Selected automatically above %d dumps."
                          % _STREAM_THRESHOLD)
+    ap.add_argument("--requests", action="store_true",
+                    help="positional args are per-rank event dumps "
+                         "(black-box JSONL or live write_event_dump "
+                         "traces, or their directory): stitch each "
+                         "request's cross-rank span chain off the "
+                         "`request` events and decompose the tail "
+                         "latency band by lifecycle phase; -o writes "
+                         "the analysis (report + per-rid chains) as "
+                         "JSON")
+    ap.add_argument("--pct", type=float, default=99.0,
+                    help="with --requests: the percentile band to "
+                         "attribute (default 99)")
     ap.add_argument("--critical-path", action="store_true",
                     help="positional args are per-rank event dumps "
                          "(black-box JSONL or live write_event_dump "
@@ -333,6 +345,21 @@ def main(argv=None):
                          "stall) that bounded it; -o writes the "
                          "analysis as JSON")
     args = ap.parse_args(argv)
+
+    if args.requests:
+        from horovod_tpu.telemetry import reqtrace
+
+        chains = reqtrace.stitch(args.timelines)
+        analysis = reqtrace.tail_report(chains, pct=args.pct)
+        print(reqtrace.format_requests(analysis))
+        if args.output != "merged_timeline.json":
+            with open(args.output, "w") as f:
+                json.dump({"report": analysis,
+                           "chains": {str(r): c
+                                      for r, c in sorted(chains.items())}},
+                          f, indent=2)
+            print(f"wrote {args.output}")
+        return 0
 
     if args.critical_path:
         from horovod_tpu.telemetry import critpath

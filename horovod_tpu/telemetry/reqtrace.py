@@ -1,0 +1,376 @@
+"""Request-scoped tracing: per-request span ledgers and cross-rank
+trace stitching for the serving lane.
+
+The step-anatomy observatory (critpath.py) explains *steps*; this
+module explains *requests* — the unit users experience. The serving
+lane records one ``request`` event (csrc/events.h ``kRequest``) per
+lifecycle transition, rid-tagged, through the same always-on event
+ring as everything else: each event marks the instant a request ENTERS
+a phase, so a rid's span ledger is simply the gaps between its
+consecutive transitions — **gap-free by construction** (every
+microsecond of a request's wall time lands in exactly one phase, the
+same exact-reconciliation standard as the r17 overlap ledger).
+
+Phases (``REQUEST_PHASES`` — index-ABI with the C table, pinned in
+tests/single/test_reqtrace.py)::
+
+    queued           admitted to the frontend's pending line
+    prefill          prefill compute running for this request
+    kv_ship          packed; KV payload in flight to its decode rank
+    decode_wait      adopted/admitted, between decode steps
+    decode_active    inside a decode step's batch
+    evicted_requeue  LIFO-evicted; waiting for re-prefill
+    fault_requeue    orphaned by a peer fault; re-queued
+    done             terminal: completion reached the scoreboard
+
+Transitions for ONE rid happen on more than one rank (frontend
+prefills and scoreboards; a decode rank decodes), so :func:`stitch`
+merges per-rank event dumps on the r15 anchor-pair wall axis
+(``postmortem._wall_us`` — the CLOCK_SYNC contract) and reassembles
+each rid's chain across ranks. A decode rank that died without dumping
+(SIGKILL) simply leaves its phases unobserved: the preceding frontend
+phase extends until the frontend's next transition (``fault_requeue``),
+so chains stay gap-free even through the chaos case they exist to
+explain.
+
+``report.py --requests`` renders the tail-latency attribution: pick a
+percentile band and see where its wall time went ("the p99 cohort
+spends 71% in evicted_requeue"), plus the dominant phase per slow
+request. The live counterpart is the debug server's ``/requests?n=``
+endpoint over :func:`live_requests` — in-flight rids with current
+phase and age, no dump needed.
+"""
+
+import time
+from collections import defaultdict
+
+# Index-ABI with csrc/events.h RequestPhase / events.cc
+# kRequestPhaseNames (pinned in tests/single/test_reqtrace.py).
+REQUEST_PHASES = ("queued", "prefill", "kv_ship", "decode_wait",
+                  "decode_active", "evicted_requeue", "fault_requeue",
+                  "done")
+TERMINAL_PHASE = "done"
+
+# rid -> (phase, t_phase, t_first) on this process's monotonic clock —
+# the /requests live table. Plain dict on purpose: writers are the
+# serving thread, readers (the debug server) copy under the GIL.
+_live = {}
+
+_basics = None
+_basics_ok = None  # None = unresolved, False = core lib unavailable
+_tracing = None    # None = resolve from the ring's enabled() lazily
+
+
+def _lib():
+    """The core binding, or None when the native lib is unavailable
+    (pure-python test environments) — tracing then degrades to the
+    live table only."""
+    global _basics, _basics_ok
+    if _basics_ok is None:
+        try:
+            from horovod_tpu.common.basics import HorovodBasics
+
+            _basics = HorovodBasics()
+            # HorovodBasics resolves the .so lazily on first `.lib`
+            # access — touch it HERE so a missing/unbuildable core
+            # fails inside this try and the fallback actually engages
+            # (a lazy failure would otherwise surface later, inside
+            # record_request, in exactly the environment this clause
+            # protects).
+            _basics.lib
+            _basics_ok = True
+        except Exception:  # noqa: BLE001 — tracing must never be the
+            _basics_ok = False  # reason a serving process cannot start
+    return _basics if _basics_ok else None
+
+
+def tracing_enabled():
+    """Whether request events reach the ring (rides the ring's own
+    HOROVOD_EVENTS gate; :func:`set_tracing` overrides in-process)."""
+    global _tracing
+    if _tracing is None:
+        b = _lib()
+        _tracing = bool(b is not None and b.events_enabled())
+    return _tracing
+
+
+def set_tracing(on):
+    """Flip request tracing (and the event ring) in-process — the
+    tracing-overhead bench's off switch (bench_lane.py)."""
+    global _tracing
+    _tracing = bool(on)
+    b = _lib()
+    if b is not None:
+        b.lib.hvdtpu_set_events_enabled(1 if on else 0)
+
+
+def record_request(phase, rid, aux=0):
+    """Record one lifecycle transition: ``rid`` enters ``phase`` now.
+
+    Always updates the live in-flight table (the ``/requests``
+    endpoint's source — ~a dict store); emits the ring event only while
+    tracing is on. A ``done`` transition retires the rid from the live
+    table. Unknown phase names raise — a typo'd phase would silently
+    corrupt every downstream ledger."""
+    pid = REQUEST_PHASES.index(phase)
+    if phase == TERMINAL_PHASE:
+        _live.pop(rid, None)
+    else:
+        now = time.monotonic()
+        prev = _live.get(rid)
+        _live[rid] = (phase, now, prev[2] if prev else now)
+    if tracing_enabled():
+        b = _lib()
+        if b is not None:
+            b.lib.hvdtpu_record_request(pid, int(rid), int(aux))
+
+
+def forget_request(rid):
+    """Drop a rid from the live table WITHOUT a ``done`` transition —
+    the duplicate-cancel path (another rank owns the completion; its
+    ``done`` is the chain's terminal, not ours)."""
+    _live.pop(rid, None)
+
+
+def live_requests(limit=64):
+    """The in-flight table for ``/requests?n=``: one row per live rid
+    with its current phase, time in that phase, and total age — oldest
+    first, capped at ``limit`` (<= 0 = all)."""
+    now = time.monotonic()
+    rows = [{"rid": rid, "phase": ph,
+             "phase_age_ms": round((now - t_ph) * 1000.0, 3),
+             "age_ms": round((now - t0) * 1000.0, 3)}
+            for rid, (ph, t_ph, t0) in list(_live.items())]
+    rows.sort(key=lambda r: -r["age_ms"])
+    return rows[:int(limit)] if int(limit) > 0 else rows
+
+
+# ---- cross-rank stitching ---------------------------------------------
+
+
+def _request_transitions(paths_or_dir):
+    """Every ``request`` event across all dumps, wall-aligned and
+    source-rank-tagged: ``[(wall_us, seq, rank, phase, rid, aux)]``.
+    Folds each event once by seq per file (a process appends one dump
+    per fault; successive dumps overlap — the report.py --events
+    discipline)."""
+    from horovod_tpu.telemetry import postmortem
+
+    out = []
+    for path in postmortem.collect_paths(paths_or_dir):
+        seen = set()
+        for dump in postmortem.load_blackbox(path):
+            hdr = dump["header"]
+            rank = hdr.get("rank", -1)
+            for ev in dump["events"]:
+                if ev.get("type") != "request" or ev.get("seq") in seen:
+                    continue
+                seen.add(ev.get("seq"))
+                phase = ev.get("phase_name")
+                if phase is None:
+                    pid = ev.get("phase", -1)
+                    phase = (REQUEST_PHASES[pid]
+                             if 0 <= pid < len(REQUEST_PHASES)
+                             else "unknown")
+                out.append((postmortem._wall_us(ev, hdr),
+                            ev.get("seq", 0), rank, phase,
+                            ev.get("rid"), ev.get("aux", 0)))
+    return out
+
+
+def stitch(paths_or_dir):
+    """Merge per-rank dumps and reassemble each rid's span chain.
+
+    Returns ``{rid: chain}`` where a chain is::
+
+        {"rid": rid,
+         "spans": [{"phase", "rank", "start_us", "end_us", "dur_us"}],
+         "phase_us": {phase: total us},   # every phase observed
+         "start_us", "end_us", "wall_us", # chain extent (wall axis)
+         "complete": bool,                # a terminal `done` was seen
+         "ranks": [ranks that contributed transitions]}
+
+    Chains are gap-free and overlap-free BY CONSTRUCTION: transitions
+    sort onto one wall axis and span *i* is exactly
+    ``[t_i, t_{i+1})`` — so ``sum(phase_us.values()) == wall_us``
+    holds to the microsecond (the r17 exact-reconciliation standard;
+    serve-smoke re-verifies it from the span list rather than trusting
+    this sentence). Adjacent same-phase spans merge; zero-length spans
+    contribute nothing. Time after an intermediate ``done`` (a decode
+    rank completed; the frontend scoreboard confirmed later) books to
+    the ``done`` phase — completion-report latency is real latency.
+    """
+    per_rid = defaultdict(list)
+    for t in _request_transitions(paths_or_dir):
+        per_rid[t[4]].append(t)
+    chains = {}
+    for rid, transitions in per_rid.items():
+        transitions.sort(key=lambda t: (t[0], t[1]))
+        spans = []
+        for (w0, _s0, rank, phase, _r0, _a0), (w1, *_rest) in zip(
+                transitions, transitions[1:]):
+            dur = w1 - w0
+            if dur <= 0:
+                continue
+            if spans and spans[-1]["phase"] == phase \
+                    and spans[-1]["rank"] == rank \
+                    and spans[-1]["end_us"] == w0:
+                spans[-1]["end_us"] = w1
+                spans[-1]["dur_us"] += dur
+                continue
+            spans.append({"phase": phase, "rank": rank,
+                          "start_us": w0, "end_us": w1, "dur_us": dur})
+        phase_us = defaultdict(int)
+        for s in spans:
+            phase_us[s["phase"]] += s["dur_us"]
+        start = transitions[0][0]
+        end = transitions[-1][0]
+        chains[rid] = {
+            "rid": rid,
+            "spans": spans,
+            "phase_us": dict(phase_us),
+            "start_us": start,
+            "end_us": end,
+            "wall_us": end - start,
+            "complete": any(t[3] == TERMINAL_PHASE for t in transitions),
+            "ranks": sorted({t[2] for t in transitions}),
+        }
+    return chains
+
+
+def chain_gaps(chain):
+    """Independent gap/overlap audit of one chain (what serve-smoke
+    asserts empty instead of trusting :func:`stitch`'s construction):
+    returns a list of ``(kind, at_us, us)`` defects — ``gap`` for
+    uncovered wall time between spans, ``overlap`` for doubly-covered
+    time, plus a ``sum`` defect when the span durations do not total
+    the chain's wall extent exactly."""
+    defects = []
+    spans = chain["spans"]
+    cursor = chain["start_us"]
+    for s in spans:
+        if s["start_us"] > cursor:
+            defects.append(("gap", cursor, s["start_us"] - cursor))
+        elif s["start_us"] < cursor:
+            defects.append(("overlap", s["start_us"],
+                            cursor - s["start_us"]))
+        cursor = s["end_us"]
+    if cursor != chain["end_us"]:
+        defects.append(("gap", cursor, chain["end_us"] - cursor))
+    total = sum(s["dur_us"] for s in spans)
+    if total != chain["wall_us"]:
+        defects.append(("sum", chain["start_us"],
+                        chain["wall_us"] - total))
+    return defects
+
+
+# Package-level alias (``telemetry.stitch_requests``): ``stitch`` is
+# unambiguous inside this module, not at the package surface.
+stitch_requests = stitch
+
+
+# ---- tail-latency attribution -----------------------------------------
+
+
+def tail_report(chains, pct=99.0):
+    """Decompose a latency percentile band: which phases own the slow
+    requests' wall time.
+
+    Returns::
+
+        {"requests", "complete", "pct", "threshold_ms",
+         "population_phase_share": {phase: fraction},
+         "cohort_phase_share": {phase: fraction},
+         "cohort": [{"rid", "wall_ms", "dominant_phase",
+                     "phases_ms": {...}, "ranks"}],   # slowest first
+         "incomplete": [rids without a terminal done]}
+
+    The cohort is every COMPLETE chain at or above the ``pct``-th
+    percentile of complete-chain wall latency; shares are
+    phase-time / total-wall-time over the respective set (they sum to
+    1 exactly, because chains are gap-free).
+    """
+    import numpy as np
+
+    complete = [c for c in chains.values() if c["complete"]]
+    incomplete = sorted(c["rid"] for c in chains.values()
+                        if not c["complete"])
+    if not complete:
+        return {"requests": len(chains), "complete": 0, "pct": pct,
+                "threshold_ms": 0.0, "population_phase_share": {},
+                "cohort_phase_share": {}, "cohort": [],
+                "incomplete": incomplete}
+    walls = np.asarray([c["wall_us"] for c in complete], np.float64)
+    threshold = float(np.percentile(walls, pct))
+    cohort = sorted((c for c in complete if c["wall_us"] >= threshold),
+                    key=lambda c: -c["wall_us"])
+
+    def shares(cs):
+        total = sum(c["wall_us"] for c in cs)
+        if total <= 0:
+            return {}
+        acc = defaultdict(int)
+        for c in cs:
+            for ph, us in c["phase_us"].items():
+                acc[ph] += us
+        return {ph: round(us / total, 6)
+                for ph, us in sorted(acc.items())}
+
+    rows = []
+    for c in cohort:
+        dominant = max(c["phase_us"], key=c["phase_us"].get) \
+            if c["phase_us"] else "-"
+        rows.append({
+            "rid": c["rid"],
+            "wall_ms": round(c["wall_us"] / 1000.0, 3),
+            "dominant_phase": dominant,
+            "phases_ms": {ph: round(us / 1000.0, 3)
+                          for ph, us in sorted(c["phase_us"].items())},
+            "ranks": c["ranks"],
+        })
+    return {
+        "requests": len(chains),
+        "complete": len(complete),
+        "pct": pct,
+        "threshold_ms": round(threshold / 1000.0, 3),
+        "population_phase_share": shares(complete),
+        "cohort_phase_share": shares(cohort),
+        "cohort": rows,
+        "incomplete": incomplete,
+    }
+
+
+def format_requests(report, max_rows=20):
+    """Operator-facing rendering of :func:`tail_report`: the headline
+    names where the slow band's time goes."""
+    lines = []
+    cs = report["cohort_phase_share"]
+    if cs:
+        worst = max(cs, key=cs.get)
+        lines.append(
+            f"p{report['pct']:g} cohort ({len(report['cohort'])} of "
+            f"{report['complete']} requests, >= "
+            f"{report['threshold_ms']:.1f} ms): spends "
+            f"{cs[worst]:.0%} in {worst}")
+    else:
+        lines.append("no complete request chains")
+    ps = report["population_phase_share"]
+    if ps:
+        lines.append("population: " + "  ".join(
+            f"{ph} {frac:.0%}" for ph, frac in
+            sorted(ps.items(), key=lambda kv: -kv[1])))
+    lines.append(f"{'rid':>8} {'wall ms':>10} {'dominant':>16} "
+                 f"{'share':>6}  phases")
+    for row in report["cohort"][:max_rows]:
+        dom_ms = row["phases_ms"].get(row["dominant_phase"], 0.0)
+        share = dom_ms / row["wall_ms"] if row["wall_ms"] else 0.0
+        detail = " ".join(f"{ph}={ms:.1f}" for ph, ms in
+                          sorted(row["phases_ms"].items(),
+                                 key=lambda kv: -kv[1])[:4])
+        lines.append(f"{row['rid']:>8} {row['wall_ms']:>10.1f} "
+                     f"{row['dominant_phase']:>16} {share:>6.0%}  "
+                     f"{detail}")
+    if report["incomplete"]:
+        lines.append(f"incomplete (no terminal done): "
+                     f"{report['incomplete']}")
+    return "\n".join(lines)

@@ -241,7 +241,8 @@ def test_healthz_and_stacks_respond_while_peer_sigstopped(tmp_path):
         time.sleep(0.3)
         base = f"http://127.0.0.1:{dbg_port}"
         for path, key in (("/healthz", "healthz"), ("/stacks", "stacks"),
-                          ("/events?n=64", "events")):
+                          ("/events?n=64", "events"),
+                          ("/requests?n=8", "requests")):
             try:
                 body = urllib.request.urlopen(base + path,
                                               timeout=10).read()
@@ -268,18 +269,27 @@ def test_healthz_and_stacks_respond_while_peer_sigstopped(tmp_path):
     # The autoscaler's signal set rides /healthz (docs/scale.md): one
     # endpoint serves everything the scaling policy consumes — field
     # set PINNED here (r17 adds the overlap-ledger pair, r18 the
-    # serving quartet; autoscale Signals defaults keep older payloads
+    # serving quartet, r19 the rolling-latency trio + eviction
+    # amplification; autoscale Signals defaults keep older payloads
     # constructing).
     for key in ("queue_depth", "straggler_skew_ms", "step_time_ewma_ms",
                 "pending_rejoiners", "debug_port", "overlap_efficiency",
                 "exposed_wire_ms", "serving_queue_depth",
                 "inflight_sequences", "kv_blocks_free",
-                "kv_blocks_total"):
+                "kv_blocks_total", "serving_p50_ms", "serving_p99_ms",
+                "requests_served", "recomputed_prefill_tokens",
+                "useful_tokens", "eviction_amplification"):
         assert key in health, (key, sorted(health))
     # No serving loop in this process: the sentinel defaults, not a
     # phantom empty pool.
     assert health["serving_queue_depth"] == 0, health
     assert health["kv_blocks_total"] == -1, health
+    assert health["requests_served"] == 0, health
+    assert health["eviction_amplification"] == 0.0, health
+    # /requests answers on a non-serving rank too: an empty in-flight
+    # table, not an error (docs/serving.md).
+    assert isinstance(polled.get("requests"), bytes), polled
+    assert json.loads(polled["requests"]) == [], polled["requests"]
     assert health["debug_port"] == dbg_port, health
     assert isinstance(health["queue_depth"], int), health
     assert isinstance(health["pending_rejoiners"], int), health

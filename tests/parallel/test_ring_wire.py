@@ -235,6 +235,15 @@ def _worker_compressed_reducescatter(rank, size):
         b.shutdown()
 
 
+# loadflaky: this case (and its uncompressed-ratio sibling below) has
+# failed ONLY under full-suite load — r17 during a concurrent .so
+# relink, r18 with no rebuild in flight; 3x standalone + the
+# `make test-flaky` lane were green both times. Four spawned ranks
+# racing the wire-timeout budget on a busy box is load sensitivity,
+# not a wire regression — rerun `make test-flaky` standalone before
+# blaming a diff (the r13 de-flake discipline; busy CI shards may
+# deselect with -m 'not loadflaky').
+@pytest.mark.loadflaky
 def test_compressed_reducescatter_wire_and_bits():
     assert run_ranks(_worker_compressed_reducescatter, 4, timeout=180,
                      env={"HOROVOD_RING_CHUNK_BYTES": "8192",
@@ -259,6 +268,7 @@ def _worker_uncompressed_ratio(rank, size):
         b.shutdown()
 
 
+@pytest.mark.loadflaky  # see the note on the reducescatter case above
 def test_uncompressed_wire_equals_logical():
     assert run_ranks(_worker_uncompressed_ratio, 2, timeout=120,
                      env={"HOROVOD_WIRE_COMPRESSION": "0"}) == ["ok"] * 2

@@ -90,17 +90,54 @@ def _disagg_worker(rank, size):
         _verify_all(report, cfg, params, trace)
         # Disaggregation really happened: the frontend never decoded.
         assert loop.engine.steps == 0, loop.engine.steps
+        # r19 rolling-latency signals live on the frontend.
+        sig = loop.signals()
+        assert sig["requests_served"] == len(trace), sig
+        assert sig["serving_p99_ms"] >= sig["serving_p50_ms"] > 0, sig
     else:
         assert report["served"] > 0, "decode rank served nothing"
+    # Request-tracing dump for the cross-rank stitch assertion in the
+    # test driver (every rank contributes its view of each rid).
+    dump_dir = os.environ.get("REQTRACE_DUMPS")
+    if dump_dir:
+        from horovod_tpu.telemetry import critpath
+
+        critpath.write_event_dump(
+            os.path.join(dump_dir, f"blackbox-rank{b.rank()}.jsonl"),
+            b.rank(), b.size(), b.events_drain())
     b.shutdown()
     return "ok"
 
 
-def test_two_rank_disaggregated_poisson_serves_all():
+def test_two_rank_disaggregated_poisson_serves_all(tmp_path):
+    dump_dir = str(tmp_path / "reqtrace")
+    os.makedirs(dump_dir)
     results = run_chaos(_disagg_worker, 2, victims=(), timeout=240,
-                        env={"HOROVOD_WIRE_TIMEOUT_MS": "4000"},
+                        env={"HOROVOD_WIRE_TIMEOUT_MS": "4000",
+                             "HOROVOD_EVENTS": "1",
+                             "REQTRACE_DUMPS": dump_dir},
                         expect_sigkill=False)
     assert results == {0: "ok", 1: "ok"}
+    # Cross-rank trace stitching on a REAL disaggregated run: every
+    # rid's chain reassembles from BOTH ranks' dumps on the anchor-pair
+    # wall axis — the frontend contributes queued/prefill/kv_ship, the
+    # decode rank contributes decode_wait/decode_active, the chain is
+    # gap-free with per-phase sums reconciling exactly, and no request
+    # carries a fault_requeue span (nothing faulted).
+    from horovod_tpu.telemetry import reqtrace
+
+    chains = reqtrace.stitch(dump_dir)
+    assert len(chains) == _N_REQUESTS, sorted(chains)
+    for rid, c in sorted(chains.items()):
+        assert c["complete"], rid
+        assert c["ranks"] == [0, 1], (rid, c["ranks"])
+        assert reqtrace.chain_gaps(c) == [], rid
+        assert sum(c["phase_us"].values()) == c["wall_us"], rid
+        assert "fault_requeue" not in c["phase_us"], (rid, c["phase_us"])
+        span_ranks = {s["phase"]: s["rank"] for s in c["spans"]}
+        assert span_ranks.get("kv_ship") == 0, (rid, span_ranks)
+        assert any(s["phase"] == "decode_active" and s["rank"] == 1
+                   for s in c["spans"]), (rid, c["spans"])
 
 
 def _kill_worker(rank, size):

@@ -1,0 +1,266 @@
+"""Request-scoped tracing (docs/serving.md "Request lifecycle &
+tracing"): the kRequest event family's Python/C phase-table ABI, the
+cross-rank span stitcher's gap-free exact reconciliation, tail-latency
+attribution, the Perfetto per-request fold, and the live
+``/requests`` surface.
+
+Synthetic dumps here are hand-built in the exact black-box schema
+(header anchor pair + JSONL events) with DELIBERATELY skewed per-rank
+steady clocks — the stitcher must merge through the anchor pairs, not
+raw timestamps (the r15 CLOCK_SYNC contract).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.telemetry import postmortem, reqtrace
+from horovod_tpu.telemetry.reqtrace import REQUEST_PHASES
+
+pytestmark = pytest.mark.quick
+
+
+# ---- phase-table ABI: python mirror == C table ------------------------
+
+
+def test_request_phase_table_matches_core():
+    """REQUEST_PHASES is index-ABI with csrc/events.h RequestPhase:
+    recording phase id i must serialize with the python table's name
+    at i (the stitcher consumes the decoded ``phase_name``)."""
+    from horovod_tpu.common import basics
+
+    b = basics.HorovodBasics()
+    b.events_drain()  # clean cursor (one logical consumer)
+    for i in range(len(REQUEST_PHASES)):
+        b.record_request(i, 7000 + i, aux=i * 3)
+    evs = [e for e in b.events_drain() if e["type"] == "request"
+           and 7000 <= e["rid"] < 7000 + len(REQUEST_PHASES)]
+    assert len(evs) == len(REQUEST_PHASES)
+    for i, e in enumerate(evs):
+        assert e["phase"] == i
+        assert e["phase_name"] == REQUEST_PHASES[i], (i, e)
+        assert e["rid"] == 7000 + i and e["aux"] == i * 3
+
+
+def test_record_request_rejects_unknown_phase():
+    with pytest.raises(ValueError):
+        reqtrace.record_request("no_such_phase", 1)
+
+
+# ---- synthetic dumps --------------------------------------------------
+
+
+def _write_dump(path, rank, steady_base, events):
+    """One black-box dump whose steady clock starts at ``steady_base``
+    (per-rank skew) while every rank shares wall time 1_000_000 us at
+    that instant — stitching must align through the anchor pair."""
+    header = {"kind": "blackbox_header", "rank": rank, "size": 2,
+              "epoch": 0, "unix_us": 1_000_000,
+              "steady_us": steady_base, "fault": {}}
+    with open(path, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for seq, (ts, phase, rid, aux) in enumerate(events):
+            f.write(json.dumps({
+                "seq": seq, "ts_us": steady_base + ts,
+                "type": "request", "phase": REQUEST_PHASES.index(phase),
+                "rid": rid, "aux": aux, "phase_name": phase}) + "\n")
+    return path
+
+
+def test_stitch_cross_rank_chain_exact(tmp_path):
+    """One rid's lifecycle split across two ranks with skewed steady
+    clocks: the chain reassembles in wall order, every span carries
+    its source rank, and per-phase sums reconcile to the wall latency
+    EXACTLY (the r17 standard)."""
+    # Frontend (rank 0): queued@0 -> prefill@100 -> kv_ship@300,
+    # done@1000. Decode rank (rank 1, steady clock 5_000_000 ahead):
+    # decode_wait@500 -> decode_active@600 (wall offsets).
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0, 10_000, [
+        (0, "queued", 1, 8), (100, "prefill", 1, 8),
+        (300, "kv_ship", 1, 4096), (1000, "done", 1, 5)])
+    _write_dump(tmp_path / "blackbox-rank1.jsonl", 1, 5_000_000, [
+        (500, "decode_wait", 1, 2), (600, "decode_active", 1, 9)])
+    chains = reqtrace.stitch(str(tmp_path))
+    assert set(chains) == {1}
+    c = chains[1]
+    assert c["complete"] and c["ranks"] == [0, 1]
+    assert [(s["phase"], s["rank"], s["dur_us"]) for s in c["spans"]] \
+        == [("queued", 0, 100), ("prefill", 0, 200),
+            ("kv_ship", 0, 200), ("decode_wait", 1, 100),
+            ("decode_active", 1, 400)]
+    assert c["wall_us"] == 1000
+    assert sum(c["phase_us"].values()) == c["wall_us"]
+    assert reqtrace.chain_gaps(c) == []
+
+
+def test_stitch_merges_adjacent_same_phase_and_drops_zero(tmp_path):
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0, 0, [
+        (0, "queued", 4, 0), (50, "queued", 4, 0),   # re-queue merges
+        (50, "prefill", 4, 0),                       # zero-length drop
+        (90, "done", 4, 0)])
+    c = reqtrace.stitch(str(tmp_path))[4]
+    assert [(s["phase"], s["dur_us"]) for s in c["spans"]] \
+        == [("queued", 50), ("prefill", 40)]
+    assert sum(c["phase_us"].values()) == c["wall_us"] == 90
+    assert reqtrace.chain_gaps(c) == []
+
+
+def test_fault_requeue_attribution_only_on_orphans(tmp_path):
+    """The chaos criterion's shape: the orphaned rid's chain carries a
+    fault_requeue span covering the dead rank's unobserved window (its
+    events died with it); the healthy rid carries none."""
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0, 0, [
+        # rid 1: shipped to the rank that dies -> frontend never sees
+        # an adoption; kv_ship extends to the fault_requeue transition.
+        (0, "queued", 1, 0), (10, "prefill", 1, 0),
+        (20, "kv_ship", 1, 0), (520, "fault_requeue", 1, 0),
+        (530, "prefill", 1, 0), (560, "decode_wait", 1, 0),
+        (600, "done", 1, 0),
+        # rid 2: served before the fault — no fault_requeue anywhere.
+        (5, "queued", 2, 0), (15, "prefill", 2, 0),
+        (40, "decode_wait", 2, 0), (80, "done", 2, 0)])
+    chains = reqtrace.stitch(str(tmp_path))
+    assert chains[1]["phase_us"]["fault_requeue"] == 10
+    assert chains[1]["phase_us"]["kv_ship"] == 500  # the orphan window
+    assert "fault_requeue" not in chains[2]["phase_us"]
+    for c in chains.values():
+        assert reqtrace.chain_gaps(c) == []
+        assert sum(c["phase_us"].values()) == c["wall_us"]
+
+
+def test_incomplete_chain_reported_not_crashed(tmp_path):
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0, 0, [
+        (0, "queued", 9, 0), (10, "prefill", 9, 0)])
+    chains = reqtrace.stitch(str(tmp_path))
+    assert not chains[9]["complete"]
+    report = reqtrace.tail_report(chains)
+    assert report["complete"] == 0 and report["incomplete"] == [9]
+    assert reqtrace.format_requests(report)  # renders, no crash
+
+
+# ---- tail-latency attribution -----------------------------------------
+
+
+def _chain(rid, phase_us, complete=True):
+    spans, t = [], 0
+    for ph, us in phase_us.items():
+        spans.append({"phase": ph, "rank": 0, "start_us": t,
+                      "end_us": t + us, "dur_us": us})
+        t += us
+    return {"rid": rid, "spans": spans, "phase_us": dict(phase_us),
+            "start_us": 0, "end_us": t, "wall_us": t,
+            "complete": complete, "ranks": [0]}
+
+
+def test_tail_report_decomposes_p90_cohort():
+    """Nine fast decode-bound requests + one slow one dominated by
+    evicted_requeue: the p90 cohort is the slow request, its dominant
+    phase is named, and both share tables sum to exactly 1 (chains are
+    gap-free, so shares are a partition of wall time)."""
+    chains = {r: _chain(r, {"queued": 50, "prefill": 100,
+                            "decode_active": 850})
+              for r in range(9)}
+    chains[9] = _chain(9, {"queued": 50, "prefill": 200,
+                           "evicted_requeue": 7100,
+                           "decode_active": 650})
+    report = reqtrace.tail_report(chains, pct=90.0)
+    assert report["threshold_ms"] > 1.0
+    assert [c["rid"] for c in report["cohort"]] == [9]
+    assert report["cohort"][0]["dominant_phase"] == "evicted_requeue"
+    for key in ("cohort_phase_share", "population_phase_share"):
+        total = sum(report[key].values())
+        assert abs(total - 1.0) < 1e-9, (key, report[key])
+    assert report["cohort_phase_share"]["evicted_requeue"] > 0.8
+    text = reqtrace.format_requests(report)
+    assert "evicted_requeue" in text and "p90" in text
+
+
+def test_report_cli_requests(tmp_path, capsys):
+    _write_dump(tmp_path / "blackbox-rank0.jsonl", 0, 0, [
+        (0, "queued", 3, 0), (40, "prefill", 3, 0),
+        (90, "decode_active", 3, 0), (500, "done", 3, 0)])
+    from horovod_tpu.telemetry import report
+
+    out_json = tmp_path / "requests.json"
+    rc = report.main(["--requests", str(tmp_path), "--pct", "50",
+                      "-o", str(out_json)])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "decode_active" in printed
+    doc = json.loads(out_json.read_text())
+    assert doc["report"]["complete"] == 1
+    assert doc["chains"]["3"]["wall_us"] == 500
+
+
+# ---- Perfetto fold: per-request tracks --------------------------------
+
+
+def test_perfetto_fold_renders_per_request_tracks(tmp_path):
+    path = _write_dump(tmp_path / "blackbox-rank0.jsonl", 0, 0, [
+        (0, "queued", 5, 0), (100, "prefill", 5, 0),
+        (400, "done", 5, 0),
+        (10, "queued", 6, 0), (50, "done", 6, 0)])
+    dump = postmortem.load_blackbox(str(path))[0]
+    evs = postmortem.events_to_trace_events(dump, 0)
+    # One named lane per rid...
+    names = {e["args"]["name"] for e in evs
+             if e.get("name") == "thread_name" and e["tid"] >= 2000}
+    assert names == {"rid 5", "rid 6"}
+    # ...with phase spans on it: queued/prefill 'X' rows whose tids
+    # separate the two requests.
+    spans = [e for e in evs if e.get("ph") == "X"]
+    assert {(e["name"], e["tid"]) for e in spans} \
+        == {("queued", 2005), ("prefill", 2005), ("queued", 2006)}
+    q5 = next(e for e in spans if e["tid"] == 2005
+              and e["name"] == "queued")
+    assert q5["dur"] == 100
+    # The terminal transition renders as an instant marker.
+    assert any(e.get("ph") == "i" and e.get("name") == "done"
+               and e["tid"] == 2005 for e in evs)
+
+
+# ---- live in-flight table + /requests ---------------------------------
+
+
+def test_live_requests_and_forget():
+    reqtrace.record_request("queued", 801)
+    reqtrace.record_request("prefill", 801)
+    reqtrace.record_request("queued", 802)
+    rows = {r["rid"]: r for r in reqtrace.live_requests()}
+    assert rows[801]["phase"] == "prefill"
+    assert rows[801]["age_ms"] >= rows[801]["phase_age_ms"] >= 0
+    reqtrace.record_request("done", 801)
+    assert 801 not in {r["rid"] for r in reqtrace.live_requests()}
+    # The duplicate-cancel path retires WITHOUT a done transition.
+    reqtrace.forget_request(802)
+    assert 802 not in {r["rid"] for r in reqtrace.live_requests()}
+
+
+def test_debug_server_requests_endpoint():
+    from horovod_tpu.common.basics import HorovodBasics
+    from horovod_tpu.telemetry import debug_server
+
+    b = HorovodBasics()
+    port = debug_server.start(b, 0)
+    try:
+        reqtrace.record_request("decode_wait", 901)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/requests?n=8", timeout=10).read()
+        rows = json.loads(body)
+        assert any(r["rid"] == 901 and r["phase"] == "decode_wait"
+                   for r in rows), rows
+        reqtrace.record_request("done", 901)
+        rows = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/requests", timeout=10).read())
+        assert all(r["rid"] != 901 for r in rows)
+        # The 404 map advertises the endpoint.
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+            raise AssertionError("404 expected")
+        except urllib.error.HTTPError as e:
+            assert "/requests" in e.read().decode()
+    finally:
+        debug_server.stop()

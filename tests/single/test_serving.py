@@ -10,6 +10,8 @@ admission order, eviction/replay, or the int8 block format's presence
 request always takes the same path).
 """
 
+import json
+
 import numpy as np
 import pytest
 
@@ -138,6 +140,32 @@ def test_int8_block_dequant_error_bound():
     assert (err <= 2.0 * scale_final[..., None, None] + 1e-7).all()
 
 
+def test_reused_block_quantizes_like_fresh():
+    """A re-allocated block must be SCALE-fresh: `_write_block_q`
+    merges against the block's current scale, so a reused block still
+    carrying its previous owner's larger scale would quantize the new
+    owner's first write under it — different bytes than
+    `quantize_blocks` (the wire format), breaking the local-write==wire
+    equivalence TIMING-DEPENDENTLY (which block the LIFO free list
+    hands back depends on eviction churn; caught as a flaky serve-smoke
+    token-identity failure at r19)."""
+    pool = PagedKVCache(1, 1, 4, block_size=4, n_blocks=2,
+                        quantized=True)
+    big = np.full((1, 1, 4, 4), 100.0, np.float32)
+    blocks = pool.alloc(1)
+    pool.write(blocks, 0, big, big)
+    pool.free(blocks)
+    small = np.full((1, 1, 4, 4), 1.0, np.float32)
+    reused = pool.alloc(1)
+    assert reused == blocks  # LIFO hands the stale block straight back
+    pool.write(reused, 0, small, small)
+    k_q, v_q, k_s, v_s = quantize_blocks(small, small, 4,
+                                         quantized=True)
+    np.testing.assert_allclose(pool.k_scale[reused[0]], k_s[0])
+    np.testing.assert_array_equal(pool.k_pool[reused[0]], k_q[0])
+    np.testing.assert_array_equal(pool.v_pool[reused[0]], v_q[0])
+
+
 def test_quantized_pool_write_matches_wire_format():
     """The local pool write and the wire's quantize_blocks must
     produce byte-identical int8 content for a fresh prompt — the
@@ -259,13 +287,19 @@ def test_engine_matches_llama_generate_mid_flight_admission(tiny):
 # ---- bench row + perfwatch registration -------------------------------
 
 
-def test_serving_rows_shape_and_schema():
-    """The real bench lane emits schema-stampable serving_latency rows
-    with the watched fields present (a tiny offered load keeps this in
-    the quick lane)."""
+@pytest.fixture(scope="module")
+def real_rows():
+    """ONE real bench-lane run shared by the row-contract tests (a
+    tiny offered load keeps the module in the quick lane)."""
     from horovod_tpu.serving.bench_lane import serving_rows
 
-    rows = serving_rows(n_requests=4, rps=500.0, seed=2)
+    return serving_rows(n_requests=4, rps=500.0, seed=2)
+
+
+def test_serving_rows_shape_and_schema(real_rows):
+    """The real bench lane emits schema-stampable serving_latency rows
+    with the watched fields present."""
+    rows = real_rows
     assert [r["config"] for r in rows] == ["f32", "int8"]
     for row in rows:
         assert row["metric"] == "serving_latency"
@@ -311,6 +345,112 @@ def test_perfwatch_watches_serving_rows():
                for m, f in flagged)
     assert not any("400.0" in m for m, f in flagged), (
         "steady series flagged — identity grouping broke")
+
+
+def test_diff_and_perfwatch_on_real_serving_row_files(real_rows,
+                                                     tmp_path):
+    """The --diff/perfwatch contract on serving rows, exercised from
+    two REAL row files (bench-lane output written to disk, schema-
+    stamped like bench.py emit does):
+
+    - identity separation: rows join strictly on the full identity
+      (arrival_rps/block_size included) — a changed block_size makes a
+      NEW series/row, it never cross-joins into the old one;
+    - a p99 regression between the two files shows in --diff with the
+      right sign, and a series built from the same two files flags
+      p99_ms through perfwatch at the regressed index.
+    """
+    import copy
+
+    from bench import _diff_rows
+    from horovod_tpu.telemetry import perfwatch as pw
+
+    old_rows = copy.deepcopy(real_rows)
+    new_rows = copy.deepcopy(real_rows)
+    for r in old_rows + new_rows:
+        r.setdefault("schema", 1)  # what bench.py emit() stamps
+    # Regress the f32 row's p99 3x in the new file; move the int8
+    # row's block geometry so it becomes a DIFFERENT identity.
+    new_rows[0]["p99_ms"] = old_rows[0]["p99_ms"] * 3.0 + 1.0
+    new_rows[1]["block_size"] = 16
+    old_path, new_path = tmp_path / "old.json", tmp_path / "new.json"
+    old_path.write_text(json.dumps(old_rows))
+    new_path.write_text(json.dumps(new_rows))
+
+    lines, worst = _diff_rows(str(old_path), str(new_path))
+    text = "\n".join(lines)
+    f32_p99 = [ln for ln in lines
+               if "f32" in ln and "p99_ms" in ln]
+    assert f32_p99 and "+" in f32_p99[0], text
+    assert worst >= 2.0, worst
+    # The re-geometried int8 row did NOT join across block sizes: it
+    # appears as only-in on both sides instead of a bogus delta.
+    assert sum("(only in" in ln for ln in lines) == 2, text
+
+    # perfwatch over a series drawn from the same two real files:
+    # 6 healthy observations then 3 regressed ones.
+    series_rows = (pw.load_rows(str(old_path)) * 6
+                   + pw.load_rows(str(new_path)) * 3)
+    series = pw.bench_series(series_rows)
+    verdicts = pw.watch(series, rel_threshold=0.25, consecutive=2)
+    flagged = {(v["metric"], v["field"]): v for v in verdicts
+               if v["regressed"]}
+    p99_flags = [k for k in flagged if k[1] == "p99_ms"
+                 and "f32" in k[0]]
+    assert p99_flags, (sorted(flagged), verdicts)
+    assert flagged[p99_flags[0]]["index"] == 6
+    # The int8 series (identity changed mid-stream) split into two
+    # short series rather than flagging a phantom regression.
+    assert not any("int8" in m for m, f in flagged), sorted(flagged)
+
+
+def test_trace_overhead_row_shape():
+    """The serving_trace_overhead row (the <2% tracing-cost criterion
+    the driver's bench gate watches): measured fields present, both
+    modes productive, tracing left ON afterwards. The 2% bound itself
+    is asserted by the bench criterion field, not here — a loaded CI
+    box must not turn a measurement into a flake."""
+    from horovod_tpu.serving.bench_lane import trace_overhead_row
+    from horovod_tpu.telemetry import perfwatch as pw, reqtrace
+
+    row = trace_overhead_row(n_requests=3, seed=4, repeats=1)
+    assert row["metric"] == "serving_trace_overhead"
+    assert row["tok_s_tracing_on"] > 0
+    assert row["tok_s_tracing_off"] > 0
+    assert isinstance(row["pass"], bool)
+    assert "overhead_pct" in row and "criterion" in row
+    # perfwatch watches the overhead (up = tracing got more expensive).
+    assert pw.field_direction("serving_trace_overhead",
+                              "overhead_pct") == "up"
+    assert reqtrace.tracing_enabled(), "bench left tracing off"
+
+
+def test_eviction_amplification_counters(tiny):
+    """Recomputed-prefill vs useful tokens (docs/serving.md): eviction
+    churn moves the recompute counter by exactly the re-prefilled
+    prompt lengths, completions move useful tokens, and the signal set
+    carries the ratio."""
+    cfg, params = tiny
+    eng = DecodeEngine(params, cfg, block_size=4, n_blocks=6,
+                       max_batch=4, max_context=24)
+    trace = poisson_trace(5, 1000.0, seed=3, prompt_len=(6, 10),
+                          max_new=(4, 7), vocab_size=cfg.vocab_size)
+    for r in trace:
+        eng.submit(r)
+    done = eng.run_until_idle()
+    sched = eng.scheduler
+    assert sched.evictions > 0, "pool never pressured"
+    assert sched.recomputed_prefill_tokens > 0
+    assert sched.useful_tokens == sum(
+        len(t) - len(r.prompt) for r, t in
+        ((req, done[req.rid]) for req in trace))
+    sig = sched.signals()
+    assert sig["recomputed_prefill_tokens"] \
+        == sched.recomputed_prefill_tokens
+    assert sig["useful_tokens"] == sched.useful_tokens
+    assert sig["eviction_amplification"] == pytest.approx(
+        sched.recomputed_prefill_tokens / sched.useful_tokens,
+        abs=1e-5)
 
 
 # ---- service bookkeeping: fault-safe report delivery ------------------
@@ -404,19 +544,33 @@ def test_oversize_request_rejected_at_construction(tiny):
 
 def test_serving_signals_defaults_and_live(monkeypatch):
     from horovod_tpu.serving import service as svc
+    from horovod_tpu.telemetry.autoscale import SERVING_SIGNAL_DEFAULTS
 
+    # The pinned field set: queue/pool quartet + the r19 rolling
+    # latency trio + eviction amplification (docs/serving.md).
     assert svc.serving_signals() == {
         "serving_queue_depth": 0, "inflight_sequences": 0,
-        "kv_blocks_free": -1, "kv_blocks_total": -1}
+        "kv_blocks_free": -1, "kv_blocks_total": -1,
+        "serving_p50_ms": 0.0, "serving_p99_ms": 0.0,
+        "requests_served": 0, "recomputed_prefill_tokens": 0,
+        "useful_tokens": 0, "eviction_amplification": 0.0}
+    assert svc.serving_signals() == dict(SERVING_SIGNAL_DEFAULTS)
 
     class _Stub:
         def signals(self):
             return {"serving_queue_depth": 3, "inflight_sequences": 2,
-                    "kv_blocks_free": 10, "kv_blocks_total": 64}
+                    "kv_blocks_free": 10, "kv_blocks_total": 64,
+                    "serving_p50_ms": 12.5, "serving_p99_ms": 80.0,
+                    "requests_served": 9,
+                    "recomputed_prefill_tokens": 40,
+                    "useful_tokens": 100,
+                    "eviction_amplification": 0.4}
 
     monkeypatch.setattr(svc, "_live", _Stub())
     assert svc.serving_signals()["serving_queue_depth"] == 3
     assert svc.serving_signals()["kv_blocks_free"] == 10
+    assert svc.serving_signals()["serving_p99_ms"] == 80.0
+    assert svc.serving_signals()["eviction_amplification"] == 0.4
 
 
 def test_autoscale_signals_serving_backcompat():
@@ -431,6 +585,11 @@ def test_autoscale_signals_serving_backcompat():
                   kv_blocks_free=1, kv_blocks_total=64)
     assert old.serving_queue_depth == 0
     assert old.kv_blocks_free == -1
+    # r19 additions (latency trio + amplification) keep the same
+    # discipline: defaults construct, decisions untouched.
+    assert old.serving_p99_ms == 0.0
+    assert old.requests_served == 0
+    assert old.eviction_amplification == 0.0
     p_old, p_new = AutoscalePolicy(), AutoscalePolicy()
     d_old = [p_old.decide(Signals(t=float(i), world_size=2,
                                   queue_depth=9)) for i in range(4)]
@@ -438,7 +597,13 @@ def test_autoscale_signals_serving_backcompat():
                                   queue_depth=9, serving_queue_depth=7,
                                   inflight_sequences=3,
                                   kv_blocks_free=1,
-                                  kv_blocks_total=64))
+                                  kv_blocks_total=64,
+                                  serving_p50_ms=50.0,
+                                  serving_p99_ms=900.0,
+                                  requests_served=123,
+                                  recomputed_prefill_tokens=400,
+                                  useful_tokens=100,
+                                  eviction_amplification=4.0))
              for i in range(4)]
     assert [(d.action, d.target_size) for d in d_old] \
         == [(d.action, d.target_size) for d in d_new]
