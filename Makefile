@@ -24,7 +24,7 @@ ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 .PHONY: core tf clean test test-quick test-flaky lint lint-csrc \
   core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
   reshard-smoke chaos-smoke obs-smoke scale-smoke perf-smoke \
-  serve-smoke
+  serve-smoke wire-smoke
 
 core: $(OUT)
 
@@ -109,6 +109,13 @@ test-quick: core
 test-flaky: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -m loadflaky -q \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Striped-wire smoke: selftest bit-identity at K in {1,4} (+ CRC +
+# SIMD), exact per-channel byte reconciliation on a real 2-rank K=4
+# job, and K=4 transport goodput beating the K=1 baseline at 16 MiB
+# (docs/wire.md; horovod_tpu/common/wire_smoke.py; ~60 s).
+wire-smoke: core
+	$(PYTHON) -m horovod_tpu.common.wire_smoke
 
 # Telemetry smoke: 2 real eager ranks, exact byte accounting in the
 # metrics snapshot, cache steady state, per-rank timelines merged with

@@ -725,6 +725,15 @@ def _serving_rows():
 # Child body for one ring_busbw rank: pure host — numpy + the native
 # core over TCP loopback, no jax import, so children are safe to run
 # before the flagship subprocess claims the virgin device heap.
+# Alongside the end-to-end busbw (the NCCL-tests convention: includes
+# negotiation, queueing, and the API path), each point reports
+# `wire_gbps` — the same bus formula over the TRANSPORT time alone
+# (the core's wire_us histogram delta), which is what the striped
+# multi-channel engine actually moves; on a loopback box the fixed
+# per-op API overhead (~5 ms) otherwise dilutes the transport win at
+# large payloads. Warmup is 3 ops and large sizes run >= 6 timed
+# iterations: the first ops after connect pay TCP ramp + page faults
+# and a 2-iteration sample was dominated by them.
 _RING_BUSBW_CHILD = r"""
 import json, os, sys, time
 import numpy as np
@@ -738,8 +747,9 @@ try:
     for nbytes in json.loads(os.environ["RING_BUSBW_SIZES"]):
         elems = max(nbytes // 4, 1)
         x = np.full(elems, float(rank + 1), np.float32)
-        iters = max(2, min(20, (1 << 24) // nbytes))
-        eager_ops.allreduce_async(x, f"bw.{nbytes}.warm").synchronize()
+        iters = max(6, min(20, (1 << 26) // max(nbytes, 1)))
+        for w in range(3):
+            eager_ops.allreduce_async(x, f"bw.{nbytes}.w{w}").synchronize()
         snap0 = b.metrics_snapshot()
         t0 = time.perf_counter()
         for i in range(iters):
@@ -749,10 +759,13 @@ try:
         tx = snap1["wire"]["tx_bytes"] - snap0["wire"]["tx_bytes"]
         txl = (snap1["wire"]["tx_logical_bytes"]
                - snap0["wire"]["tx_logical_bytes"])
+        wire_dt = (snap1["wire_us"]["sum_us"]
+                   - snap0["wire_us"]["sum_us"]) / iters / 1e6
+        bus = 2 * (size - 1) / size * nbytes
         points.append({
             "payload_bytes": nbytes,
-            "busbw_gbps": round(2 * (size - 1) / size * nbytes / dt / 1e9,
-                                4),
+            "busbw_gbps": round(bus / dt / 1e9, 4),
+            "wire_gbps": round(bus / wire_dt / 1e9, 4) if wire_dt else None,
             "step_s": round(dt, 6),
             "wire_ratio": round(tx / txl, 4) if txl else None,
         })
@@ -907,31 +920,64 @@ def _ring_busbw_rows(ranks=4):
     """Host-ring allreduce bus-bandwidth sweep, one JSON row per
     transport config: bulk-synchronous (chunk knob 0 — the pre-r10
     engine), chunk-overlapped (default 256 KiB double-buffered
-    pipeline), and chunk-overlapped + bf16 wire compression. 1 KiB to
-    64 MiB payloads over `ranks` local processes on TCP loopback —
+    pipeline), chunk-overlapped + bf16 wire compression, and the
+    multi-channel striped transport (HOROVOD_WIRE_CHANNELS=K: chunk i
+    rides socket i % K with one reduce worker per channel) at K in
+    {2, 4}. Every row carries its ``channels`` so perfwatch series
+    never cross-join K=1 and K=4 (ROW_IDENTITY_FIELDS). The striped
+    win is per-LINK parallelism, and a loopback box saturates its
+    aggregate fabric with >= 4 ranks pumping — so the sweep adds a
+    2-rank lane (K=1 vs K=4) where the per-link headroom is visible;
+    the `wire_gbps` column (transport time alone) is the striping
+    acceptance number, busbw the end-to-end one. 1 KiB to 64 MiB
+    payloads over local processes on TCP loopback —
     substrate-independent, so the driver's bench capture gets the
-    overlap and compression wins as numbers on any box. busbw follows
-    the NCCL-tests convention (2(N-1)/N x payload / time); wire_ratio
-    is the measured transport/full-width byte quotient (~0.5 when
-    compression engages — the core's wire-vs-logical counters)."""
+    overlap, compression, and striping wins as numbers on any box.
+    busbw follows the NCCL-tests convention (2(N-1)/N x payload /
+    time); wire_ratio is the measured transport/full-width byte
+    quotient (~0.5 when bf16 engages — the core's wire-vs-logical
+    counters)."""
     sizes = [1 << 10, 1 << 15, 1 << 20, 1 << 24, 1 << 26]
+    unit = ("host-ring allreduce bus GB/s (2(N-1)/N x payload/time), "
+            "TCP loopback; wire_gbps = same formula over transport "
+            "(wire_us) time; wire_ratio = transport/full-width bytes")
     configs = [
-        ("bulk", {"HOROVOD_RING_CHUNK_BYTES": "0",
-                  "HOROVOD_WIRE_COMPRESSION": "0"}),
-        ("overlap", {"HOROVOD_RING_CHUNK_BYTES": str(256 * 1024),
-                     "HOROVOD_WIRE_COMPRESSION": "0"}),
-        ("overlap+bf16", {"HOROVOD_RING_CHUNK_BYTES": str(256 * 1024),
-                          "HOROVOD_WIRE_COMPRESSION": "1"}),
+        ("bulk", ranks, 1, {"HOROVOD_RING_CHUNK_BYTES": "0",
+                            "HOROVOD_WIRE_COMPRESSION": "0"}),
+        ("overlap", ranks, 1,
+         {"HOROVOD_RING_CHUNK_BYTES": str(256 * 1024),
+          "HOROVOD_WIRE_COMPRESSION": "0"}),
+        ("overlap+bf16", ranks, 1,
+         {"HOROVOD_RING_CHUNK_BYTES": str(256 * 1024),
+          "HOROVOD_WIRE_COMPRESSION": "1"}),
+        # Striped lanes: 1 MiB chunks (each channel still cuts multi-
+        # chunk streams at 16 MiB), uncompressed — the pure transport
+        # comparison against `overlap`.
+        ("striped-k2", ranks, 2,
+         {"HOROVOD_RING_CHUNK_BYTES": str(1024 * 1024),
+          "HOROVOD_WIRE_COMPRESSION": "0",
+          "HOROVOD_WIRE_CHANNELS": "2"}),
+        ("striped-k4", ranks, 4,
+         {"HOROVOD_RING_CHUNK_BYTES": str(1024 * 1024),
+          "HOROVOD_WIRE_COMPRESSION": "0",
+          "HOROVOD_WIRE_CHANNELS": "4"}),
+        # Per-link lane: 2 ranks, where loopback aggregate bandwidth
+        # does not mask the per-pair stripe win (K=1 baseline + K=4).
+        ("overlap-n2", 2, 1,
+         {"HOROVOD_RING_CHUNK_BYTES": str(256 * 1024),
+          "HOROVOD_WIRE_COMPRESSION": "0"}),
+        ("striped-k4-n2", 2, 4,
+         {"HOROVOD_RING_CHUNK_BYTES": str(1024 * 1024),
+          "HOROVOD_WIRE_COMPRESSION": "0",
+          "HOROVOD_WIRE_CHANNELS": "4"}),
     ]
     rows = []
-    for name, knobs in configs:
-        row = {"metric": "ring_busbw", "config": name, "ranks": ranks,
-               "unit": "host-ring allreduce bus GB/s (2(N-1)/N x "
-                       "payload/time), TCP loopback; wire_ratio = "
-                       "transport/full-width bytes"}
+    for name, nranks, channels, knobs in configs:
+        row = {"metric": "ring_busbw", "config": name, "ranks": nranks,
+               "channels": channels, "unit": unit}
         try:
             row["points"] = _run_loopback_ranks(
-                _RING_BUSBW_CHILD, "RING_BUSBW_POINTS", ranks,
+                _RING_BUSBW_CHILD, "RING_BUSBW_POINTS", nranks,
                 dict(knobs, RING_BUSBW_SIZES=json.dumps(sizes)))
         except Exception as e:  # noqa: BLE001 — a failed transport
             # config yields an error row; the sweep continues.

@@ -184,13 +184,23 @@ Status Controller::Initialize() {
   }
   // Full-mesh peer fds, filled in step 3 (declared here so the error
   // cleanup covers every return below; -1 entries are no-ops to close).
+  // Channel 0 is `peers`; stripe channels 1..K-1 (HOROVOD_WIRE_-
+  // CHANNELS) live in `extra_peers[c-1]` — same mesh, K sockets per
+  // pair, the channel id riding the data-plane hello.
+  const int wire_channels =
+      std::min(std::max(cfg_.wire_channels, 1), kMaxWireChannels);
   std::vector<int> peers(size, -1);
+  std::vector<std::vector<int>> extra_peers(
+      wire_channels - 1, std::vector<int>(size, -1));
   // Tree edges built in step 4; owned here until handoff.
   std::vector<int> tree_fds;
   Cleanup cleanup{[&] {
     TcpClose(data_listen);
     TcpClose(tree_listen);
     for (int fd : peers) TcpClose(fd);
+    for (auto& chan : extra_peers) {
+      for (int fd : chan) TcpClose(fd);
+    }
     for (int fd : tree_fds) TcpClose(fd);
   }};
 
@@ -287,44 +297,56 @@ Status Controller::Initialize() {
     control_fds_.assign(1, fd);
   }
 
-  // 3) Full-mesh data plane: rank i accepts from all j > i, connects to all
-  // j < i. Each connection is identified by a (rank, epoch) hello pair.
+  // 3) Full-mesh data plane: rank i accepts from all j > i, connects to
+  // all j < i — K times per pair (one connection per stripe channel).
+  // Each connection is identified by a (rank, epoch, channel) hello;
+  // the channel id is what lets both ends bind socket k to stripe k,
+  // so the chunk round-robin schedules agree end to end.
+  auto chan_slot = [&](int c, int r) -> int* {
+    return c == 0 ? &peers[r] : &extra_peers[c - 1][r];
+  };
   for (int j = 0; j < rank; j++) {
-    int fd = TcpConnect(book[j].addr, book[j].port, (int)remaining_ms());
-    if (fd < 0) {
-      return Status::Error("data-plane connect to rank " + std::to_string(j) +
-                           " failed");
+    for (int c = 0; c < wire_channels; c++) {
+      int fd = TcpConnect(book[j].addr, book[j].port, (int)remaining_ms());
+      if (fd < 0) {
+        return Status::Error("data-plane connect to rank " +
+                             std::to_string(j) + " channel " +
+                             std::to_string(c) + " failed");
+      }
+      *chan_slot(c, j) = fd;  // owned by the cleanup guard from here on
+      int64_t me[3] = {(int64_t)rank, cfg_.epoch, (int64_t)c};
+      Status s = SendAll(fd, me, sizeof(me), remaining_ms());
+      if (!s.ok()) return s;
+      RegisterFdRank(fd, j, c);
     }
-    peers[j] = fd;  // owned by the cleanup guard from here on
-    int64_t me[2] = {(int64_t)rank, cfg_.epoch};
-    Status s = SendAll(fd, me, sizeof(me), remaining_ms());
-    if (!s.ok()) return s;
-    RegisterFdRank(fd, j);
   }
   int connected = 0;
-  while (connected < size - 1 - rank) {
+  const int expect = (size - 1 - rank) * wire_channels;
+  while (connected < expect) {
     int fd = TcpAcceptTimeout(data_listen, remaining_ms());
     if (fd < 0) {
       return Status::Error(
           "data-plane rendezvous timed out with " +
-          std::to_string(size - 1 - rank - connected) +
-          " peer(s) missing (HOROVOD_START_TIMEOUT)");
+          std::to_string(expect - connected) +
+          " connection(s) missing (HOROVOD_START_TIMEOUT)");
     }
-    int64_t who[2] = {-1, -1};
+    int64_t who[3] = {-1, -1, -1};
     Status s = RecvAll(fd, who, sizeof(who), remaining_ms());
     if (!s.ok()) {
       TcpClose(fd);
       continue;
     }
     if (who[1] != cfg_.epoch || who[0] <= rank || who[0] >= size ||
-        peers[who[0]] != -1) {
-      LOG_WARN("rejecting data-plane hello from rank %lld epoch %lld",
-               (long long)who[0], (long long)who[1]);
+        who[2] < 0 || who[2] >= wire_channels ||
+        *chan_slot((int)who[2], (int)who[0]) != -1) {
+      LOG_WARN("rejecting data-plane hello from rank %lld epoch %lld "
+               "channel %lld",
+               (long long)who[0], (long long)who[1], (long long)who[2]);
       TcpClose(fd);
       continue;
     }
-    peers[who[0]] = fd;
-    RegisterFdRank(fd, (int)who[0]);
+    *chan_slot((int)who[2], (int)who[0]) = fd;
+    RegisterFdRank(fd, (int)who[0], (int)who[2]);
     connected++;
   }
   // 4) Control-tree edges (HOROVOD_CONTROL_TREE). Edges touching rank
@@ -395,6 +417,9 @@ Status Controller::Initialize() {
   TcpClose(data_listen);
   TcpClose(tree_listen);
   data_plane_ = std::make_unique<DataPlane>(rank, size, std::move(peers));
+  if (wire_channels > 1) {
+    data_plane_->AdoptExtraChannelFds(std::move(extra_peers));
+  }
   RecordControlPhase(kPhaseRendezvous, MetricsNowUs() - rdzv_start_us);
   LOG_DEBUG("rank %d: control+data planes up (size=%d, epoch=%lld, "
             "tree_fanout=%d)", rank, size, (long long)cfg_.epoch,
@@ -1081,6 +1106,7 @@ Status Controller::ComputeResponseList(std::vector<Request> requests,
     list.ring_chunk_bytes = bcast_ring_chunk_bytes_;
     list.wire_compression = bcast_wire_compression_;
     list.hier_split = bcast_hier_split_;
+    list.wire_channels = bcast_wire_channels_;
     // Serialize before ApplyCacheVerdicts: the broadcast carries only
     // negotiated responses + cache verdicts; every rank (this one included)
     // then rebuilds hit responses and inserts new entries identically.

@@ -69,6 +69,12 @@ struct ControllerConfig {
   // probe sweep and post-mortem refine it); fault NOTICES still ride
   // the star, which every rank keeps for exactly that.
   int tree_fanout = 0;
+  // Stripe sockets to establish per data-plane neighbor pair
+  // (HOROVOD_WIRE_CHANNELS, wire.h): rendezvous builds K connections
+  // per pair — the channel id rides the data-plane hello, epoch-fenced
+  // like the rank — and every reinit rebuilds all K per survivor pair.
+  // 1 on the external transport (mailbox fds carry no channel id).
+  int wire_channels = 1;
 };
 
 class Controller {
@@ -116,19 +122,23 @@ class Controller {
 
   // Coordinator only: adopt autotuned knobs locally (fusion decisions are
   // made here) and piggyback them on every subsequent ResponseList.
-  // ring_chunk_bytes/wire_compression keep their unset sentinels (-1)
-  // until the tuner actually moves them, so non-autotuned runs
-  // broadcast nothing and workers keep their env-derived values.
+  // ring_chunk_bytes/wire_compression/wire_channels keep their unset
+  // sentinels (-1) until the tuner actually moves them, so
+  // non-autotuned runs broadcast nothing and workers keep their
+  // env-derived values. wire_compression carries the full codec mode
+  // (0 off / 1 bf16 / 2 int8); wire_channels the active stripe width.
   void SetAutotunedParams(int64_t fusion_bytes, double cycle_ms,
                           int64_t ring_chunk_bytes = -1,
                           int32_t wire_compression = -1,
-                          int32_t hier_split = -1) {
+                          int32_t hier_split = -1,
+                          int32_t wire_channels = -1) {
     cfg_.fusion_threshold_bytes = fusion_bytes;
     bcast_fusion_bytes_ = fusion_bytes;
     bcast_cycle_ms_ = cycle_ms;
     bcast_ring_chunk_bytes_ = ring_chunk_bytes;
     bcast_wire_compression_ = wire_compression;
     bcast_hier_split_ = hier_split;
+    bcast_wire_channels_ = wire_channels;
   }
 
  private:
@@ -216,6 +226,7 @@ class Controller {
   int64_t bcast_ring_chunk_bytes_ = -1;  // -1 = nothing to broadcast
   int32_t bcast_wire_compression_ = -1;
   int32_t bcast_hier_split_ = -1;
+  int32_t bcast_wire_channels_ = -1;
   std::chrono::steady_clock::time_point last_stall_check_;
 
   // --- Response cache (all ranks; state bit-identical by construction) ---

@@ -24,8 +24,12 @@ const EventSpec kEventSpecs[(int)EventType::kTypeCount] = {
     {"negotiate_begin", "requests", "", "", ""},
     {"negotiate_end", "responses", "shutdown", "", ""},
     {"response_launch", "op_class", "device", "tensors", "bytes"},
-    {"wire_chunk", "plane", "crc", "offset", "len"},
-    {"wire_span", "plane", "dur_us", "tx_bytes", "rx_bytes"},
+    // wire_chunk packs b = (stripe channel << 1) | crc-verified;
+    // wire_span packs a = plane | (stripe width << 1). Both decode to
+    // named JSON keys below (the packed args stay "" here so the
+    // generic emitter skips them).
+    {"wire_chunk", "plane", "", "offset", "len"},
+    {"wire_span", "", "dur_us", "tx_bytes", "rx_bytes"},
     // NB: no event arg may be named "rank" — the post-mortem merge
     // tags every timeline entry with its SOURCE rank under that key.
     {"crc_error", "sender", "fails", "chunk", ""},
@@ -59,7 +63,8 @@ const char* kRequestPhaseNames[kReqPhaseCount] = {
 };
 
 const char* kKnobNames[] = {"fusion_bytes", "cycle_time_us", "ring_chunk",
-                            "wire_compression", "hier_split"};
+                            "wire_compression", "hier_split",
+                            "wire_channels"};
 
 thread_local int t_event_plane = 0;
 
@@ -181,6 +186,17 @@ std::string EventJson(const EventRecord& e) {
   arg(spec.b, e.b);
   arg(spec.c, e.c);
   arg(spec.d, e.d);
+  // Unpack the stripe-channel tags (spec table note above): consumers
+  // see plain "channel"/"crc"/"plane"/"channels" keys, never the
+  // packed ints.
+  if (e.type == EventType::kWireChunk) {
+    arg("crc", e.b & 1);
+    arg("channel", e.b >> 1);
+  }
+  if (e.type == EventType::kWireSpan) {
+    arg("plane", e.a & 1);
+    arg("channels", e.a >> 1);
+  }
   // Decode the knob id inline so consumers never need the enum.
   if (e.type == EventType::kKnobAdopt && e.a >= 0 &&
       e.a < (int32_t)(sizeof(kKnobNames) / sizeof(kKnobNames[0]))) {

@@ -89,6 +89,7 @@ enum EventKnob : int32_t {
   kKnobRingChunk,
   kKnobCompression,
   kKnobHierSplit,
+  kKnobWireChannels,  // active stripe width (HOROVOD_WIRE_CHANNELS)
 };
 
 const char* EventTypeName(EventType t);

@@ -199,6 +199,7 @@ std::string SerializeResponseList(const ResponseList& list) {
   w.Put<int64_t>(list.ring_chunk_bytes);
   w.Put<int32_t>(list.wire_compression);
   w.Put<int32_t>(list.hier_split);
+  w.Put<int32_t>(list.wire_channels);
   w.PutI64Vec(list.cache_hit_positions);
   w.PutI64Vec(list.cache_hit_group_sizes);
   w.PutI64Vec(list.cache_evictions);
@@ -221,7 +222,8 @@ Status ParseResponseList(const std::string& buf, ResponseList* list) {
   }
   if (!rd.Get(&list->ring_chunk_bytes) ||
       !rd.Get(&list->wire_compression) ||
-      !rd.Get(&list->hier_split)) {
+      !rd.Get(&list->hier_split) ||
+      !rd.Get(&list->wire_channels)) {
     return Status::Error("truncated ResponseList");
   }
   if (!rd.GetI64Vec(&list->cache_hit_positions) ||

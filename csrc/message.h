@@ -166,6 +166,12 @@ struct ResponseList {
   // the same reason as the ring knobs: every rank must decompose the
   // SAME collective into the SAME plane sequence in the same cycle.
   int32_t hier_split = -1;
+  // Active stripe width of the multi-channel wire transport (-1 unset,
+  // >= 1 = channels; clamped to the established socket count at use
+  // sites). Rank-uniform: the chunk->channel round-robin IS the
+  // framing, so the autotuner flips it in the same lockstep cycle as
+  // the chunk knob (docs/wire.md).
+  int32_t wire_channels = -1;
   // Response-cache verdicts. Positions ready on every member rank this
   // cycle, grouped for fusion: group_sizes partitions cache_hit_positions
   // (e.g. [3,1] = first three fuse into one allreduce, next is alone).

@@ -2,6 +2,11 @@
 
 #include "common.h"
 #include "events.h"
+#include "wire.h"
+
+static_assert(hvdtpu::Metrics::kWireChannelSlots ==
+                  hvdtpu::kMaxWireChannels,
+              "per-channel counter slots must match the wire's stripe cap");
 
 #include <algorithm>
 #include <chrono>
@@ -195,6 +200,17 @@ void Metrics::AccountWire(int plane, int64_t tx, int64_t rx,
   }
 }
 
+void Metrics::AccountWireChannels(const int64_t* tx, const int64_t* rx) {
+  for (int c = 0; c < kWireChannelSlots; c++) {
+    if (tx[c]) {
+      wire_chan_tx_bytes[c].fetch_add(tx[c], std::memory_order_relaxed);
+    }
+    if (rx[c]) {
+      wire_chan_rx_bytes[c].fetch_add(rx[c], std::memory_order_relaxed);
+    }
+  }
+}
+
 void Metrics::RecordStraggler(int rank, int64_t skew_us) {
   {
     std::lock_guard<std::mutex> lk(straggler_mutex_);
@@ -245,6 +261,8 @@ void Metrics::Reset() {
   wire_cross_rx_bytes.store(0);
   wire_cross_tx_logical_bytes.store(0);
   wire_cross_rx_logical_bytes.store(0);
+  for (auto& c : wire_chan_tx_bytes) c.store(0);
+  for (auto& c : wire_chan_rx_bytes) c.store(0);
   std::lock_guard<std::mutex> lk(straggler_mutex_);
   straggler_counts_.clear();
 }
@@ -327,6 +345,30 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
          wtxl > 0 ? (double)wtx / (double)wtxl : 1.0,
          (long long)ctx, (long long)crx, (long long)ctxl, (long long)crxl,
          ctxl > 0 ? (double)ctx / (double)ctxl : 1.0);
+  {
+    // Per-stripe-channel tx/rx (docs/wire.md): emitted through the
+    // highest slot that ever moved bytes (channel 0 always present),
+    // summing exactly to tx/rx_bytes — stripe imbalance is a first-
+    // class signal, not an average.
+    int hi = 0;
+    for (int c = 1; c < kWireChannelSlots; c++) {
+      if (wire_chan_tx_bytes[c].load(std::memory_order_relaxed) ||
+          wire_chan_rx_bytes[c].load(std::memory_order_relaxed)) {
+        hi = c;
+      }
+    }
+    out += "\"channels\":[";
+    for (int c = 0; c <= hi; c++) {
+      Append(out, "%s{\"channel\":%d,\"tx_bytes\":%lld,"
+                  "\"rx_bytes\":%lld}",
+             c ? "," : "", c,
+             (long long)wire_chan_tx_bytes[c].load(
+                 std::memory_order_relaxed),
+             (long long)wire_chan_rx_bytes[c].load(
+                 std::memory_order_relaxed));
+    }
+    out += "],";
+  }
   // Step-anatomy overlap ledger (docs/metrics.md): how much of the
   // wire time above was hidden under concurrent wire activity, per
   // step window and plane.
@@ -352,16 +394,25 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
       (info.cross_plane >= 0 && info.cross_plane < kCrossPlaneModeCount)
           ? CrossPlaneModeNames()[info.cross_plane]
           : "auto";
+  const char* codec_name =
+      info.wire_codec == 2 ? "int8" : (info.wire_codec == 1 ? "bf16"
+                                                            : "off");
   Append(out, "\"knobs\":{\"fusion_threshold_bytes\":%lld,"
               "\"cycle_time_ms\":%.6f,\"ring_chunk_bytes\":%lld,"
-              "\"wire_compression\":%s,\"wire_timeout_ms\":%lld,"
+              "\"wire_compression\":%s,\"wire_codec\":\"%s\","
+              "\"wire_channels\":%lld,"
+              "\"wire_channels_established\":%lld,\"simd\":%s,"
+              "\"wire_timeout_ms\":%lld,"
               "\"wire_retry_attempts\":%lld,"
               "\"wire_retry_backoff_ms\":%lld,\"wire_crc\":%s,"
               "\"cross_plane\":\"%s\",\"hier_split\":%lld,"
               "\"cross_compression\":%s}}",
          (long long)info.fusion_threshold_bytes, info.cycle_time_ms,
          (long long)info.ring_chunk_bytes,
-         info.wire_compression ? "true" : "false",
+         info.wire_compression ? "true" : "false", codec_name,
+         (long long)info.wire_channels,
+         (long long)info.wire_channels_established,
+         info.simd ? "true" : "false",
          (long long)info.wire_timeout_ms,
          (long long)info.wire_retry_attempts,
          (long long)info.wire_retry_backoff_ms,

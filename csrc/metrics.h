@@ -162,8 +162,19 @@ class Metrics {
   std::atomic<int64_t> wire_cross_tx_logical_bytes{0};
   std::atomic<int64_t> wire_cross_rx_logical_bytes{0};
 
+  // Per-stripe-channel slice of the wire counters (HOROVOD_WIRE_-
+  // CHANNELS, docs/wire.md): channel c's share of the chunk schedule,
+  // with every unstriped path booked to channel 0 — so the buckets sum
+  // EXACTLY to wire_tx/rx_bytes and a dead or slow channel shows as
+  // imbalance instead of averaging away. Slot count mirrors
+  // kMaxWireChannels (wire.h; static_assert in metrics.cc).
+  static constexpr int kWireChannelSlots = 8;
+  std::atomic<int64_t> wire_chan_tx_bytes[kWireChannelSlots] = {};
+  std::atomic<int64_t> wire_chan_rx_bytes[kWireChannelSlots] = {};
+
   void AccountWire(int plane, int64_t tx, int64_t rx, int64_t tx_logical,
                    int64_t rx_logical);
+  void AccountWireChannels(const int64_t* tx, const int64_t* rx);
   void RecordStraggler(int rank, int64_t skew_us);
   void Reset();
 
@@ -176,6 +187,12 @@ class Metrics {
     double cycle_time_ms = 0;
     int64_t ring_chunk_bytes = 0;
     bool wire_compression = false;
+    int wire_codec = 0;  // 0 off, 1 bf16, 2 int8 blockwise
+    // Stripe transport: active width (autotunable) vs sockets
+    // established per neighbor pair (env, fixed per process).
+    int64_t wire_channels = 1;
+    int64_t wire_channels_established = 1;
+    bool simd = true;  // HOROVOD_SIMD vectorized reduce/codec paths
     int64_t wire_timeout_ms = 0;
     int64_t wire_retry_attempts = 0;   // healing ladder depth
     int64_t wire_retry_backoff_ms = 0;

@@ -179,6 +179,11 @@ struct GlobalState {
   std::atomic<int64_t> op_counter{0};  // executed collective responses
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
+  // Stripe sockets ESTABLISHED per neighbor pair this generation
+  // (WireChannelsEnv at rendezvous; reinit rebuilds the same count).
+  // The ACTIVE width is the process-global WireChannels() knob,
+  // autotuned within [1, established].
+  int wire_channels_established = 1;
   std::atomic<int64_t> fusion_threshold{64 * 1024 * 1024};
   std::atomic<double> cycle_time_ms{1.0};
   std::vector<uint8_t> fusion_buffer;  // reference: fusion_buffer_manager.cc
@@ -237,18 +242,29 @@ enum FaultAction : int32_t {
   kFaultDelay = 4,
 };
 
-// flip's packed param: low 20 bits = bit index, the rest = frames to
-// skip before flipping (ArmWireFlip). 2^20 bits = a 128 KiB chunk —
-// comfortably past any bit the modulo will keep anyway.
+// flip's packed param: low 20 bits = bit index, bits 20..43 = frames
+// to skip before flipping, bits 44+ = (stripe channel + 1) for a
+// channel-filtered flip (0 = no filter; ArmWireFlip). 2^20 bits = a
+// 128 KiB chunk — comfortably past any bit the modulo will keep
+// anyway.
 constexpr int kFlipSkipShift = 20;
+constexpr int kFlipChanShift = 44;
 constexpr int64_t kFlipBitMask = (1 << kFlipSkipShift) - 1;
+constexpr int64_t kFlipSkipMask =
+    (1LL << (kFlipChanShift - kFlipSkipShift)) - 1;
 
-// Strict grammar parse: "<rank>:<op>[:<action>[:<param>[:<extra>]]]".
-// Returns false on ANY malformed spec — the trigger must stay disarmed
-// (a lenient parse reading garbage as 0:0 would kill rank 0 at its
-// first collective). stop/delay require a positive ms param; flip
-// requires a bit (negative = persistent |bit|) and takes an optional
-// skip count (one-shot only); kill/reset take none.
+// reset's param: -1 = every registered peer fd (the NIC-died shape),
+// >= 0 = only that stripe channel's fds (ONE dead NIC queue while the
+// other K-1 stripes stay up — docs/wire.md).
+
+// Strict grammar parse:
+// "<rank>:<op>[:<action>[:<param>[:<skip>[:<chan>]]]]". Returns false
+// on ANY malformed spec — the trigger must stay disarmed (a lenient
+// parse reading garbage as 0:0 would kill rank 0 at its first
+// collective). stop/delay require a positive ms param; flip requires a
+// bit (negative = persistent |bit|) and takes an optional skip count
+// and stripe channel (one-shot only); reset takes an optional stripe
+// channel; kill takes none.
 bool ParseFaultSpec(const std::string& spec, int32_t* rank, int64_t* op,
                     int32_t* action, int64_t* param) {
   std::vector<std::string> parts;
@@ -262,7 +278,7 @@ bool ParseFaultSpec(const std::string& spec, int32_t* rank, int64_t* op,
     parts.push_back(spec.substr(start, colon - start));
     start = colon + 1;
   }
-  if (parts.size() < 2 || parts.size() > 5) return false;
+  if (parts.size() < 2 || parts.size() > 6) return false;
   auto parse_i64 = [](const std::string& s, int64_t* out) {
     if (s.empty()) return false;
     char* end = nullptr;
@@ -276,7 +292,7 @@ bool ParseFaultSpec(const std::string& spec, int32_t* rank, int64_t* op,
   if (!parse_i64(parts[1], &op_v) || op_v < 0) return false;
   int32_t action_v = kFaultKill;
   bool has_param = parts.size() >= 4;
-  if (parts.size() == 5 && parts[2] != "flip") return false;
+  if (parts.size() >= 5 && parts[2] != "flip") return false;
   if (parts.size() >= 3) {
     if (parts[2] == "kill") {
       action_v = kFaultKill;
@@ -288,7 +304,14 @@ bool ParseFaultSpec(const std::string& spec, int32_t* rank, int64_t* op,
       }
     } else if (parts[2] == "reset") {
       action_v = kFaultReset;
-      if (has_param) return false;
+      // Optional stripe channel: reset:<chan> aborts only that
+      // channel's sockets.
+      param_v = -1;
+      if (has_param &&
+          (!parse_i64(parts[3], &param_v) || param_v < 0 ||
+           param_v >= kMaxWireChannels)) {
+        return false;
+      }
     } else if (parts[2] == "flip") {
       action_v = kFaultFlip;
       if (!has_param || !parse_i64(parts[3], &param_v)) return false;
@@ -297,13 +320,24 @@ bool ParseFaultSpec(const std::string& spec, int32_t* rank, int64_t* op,
       // out of the high bits and flip the wrong bit of the wrong
       // frame. (Negative = persistent |bit|, never packed.)
       if (param_v > kFlipBitMask) return false;
-      if (parts.size() == 5) {
-        // flip:<bit>:<skip> — skip data frames first (one-shot only).
+      if (parts.size() >= 5) {
+        // flip:<bit>:<skip>[:<chan>] — skip data frames first,
+        // optionally counting (and flipping) only on one stripe
+        // channel (one-shot only).
         int64_t skip_v = 0;
-        if (param_v < 0 || !parse_i64(parts[4], &skip_v) || skip_v < 0) {
+        if (param_v < 0 || !parse_i64(parts[4], &skip_v) || skip_v < 0 ||
+            skip_v > kFlipSkipMask) {
           return false;
         }
         param_v |= skip_v << kFlipSkipShift;
+        if (parts.size() == 6) {
+          int64_t chan_v = -1;
+          if (!parse_i64(parts[5], &chan_v) || chan_v < 0 ||
+              chan_v >= kMaxWireChannels) {
+            return false;
+          }
+          param_v |= (chan_v + 1) << kFlipChanShift;
+        }
       }
     } else if (parts[2] == "delay") {
       action_v = kFaultDelay;
@@ -348,6 +382,13 @@ ControllerConfig MakeControllerConfig(GlobalState& st, int rank, int size,
   // HOROVOD_CONTROL_TREE=<fanout>: tree-structured negotiation round
   // (docs/scale.md) — 0/1 keeps the flat star.
   cfg.tree_fanout = (int)EnvInt64("HOROVOD_CONTROL_TREE", 0);
+  // Stripe sockets per neighbor pair (HOROVOD_WIRE_CHANNELS). From the
+  // ENV, not the active knob: a reinit must provision what the env
+  // promised even if the tuner had narrowed the active width. The
+  // external transport's mailbox fds carry no channel id — K stays 1.
+  cfg.wire_channels =
+      cfg.use_external_transport ? 1 : WireChannelsEnv();
+  st.wire_channels_established = cfg.wire_channels;
   return cfg;
 }
 
@@ -387,12 +428,17 @@ void InitAutotune(GlobalState& st) {
       (int)EnvInt64("HOROVOD_AUTOTUNE_STEPS", 20),
       EnvInt64("HOROVOD_AUTOTUNE_WINDOW_BYTES", 1 << 20),
       (int)EnvInt64("HOROVOD_AUTOTUNE_WINDOW_CYCLES", 20),
-      RingChunkBytes(), WireCompression(),
+      RingChunkBytes(), WireCodec(),
       // Compression joins the grid only when the user opted into
       // compressed numerics; the tuner may still settle on OFF
       // (strictly more accurate), never the other way around.
-      /*tune_wire_compression=*/WireCompression(),
-      std::move(hier_values), split);
+      /*tune_wire_codec=*/WireCodec() != 0, std::move(hier_values),
+      split,
+      // 6th dimension: active stripe width, over the powers of two up
+      // to the sockets actually established this generation — the
+      // tuner can never ask the wire for channels rendezvous did not
+      // build.
+      WireChannels(), st.wire_channels_established);
 }
 
 void ApplyPostOp(TensorTableEntry& e, void* buf, int64_t count, int size) {
@@ -1014,24 +1060,32 @@ void MaybeInjectFault(GlobalState& st) {
       break;
     }
     case kFaultReset:
-      LOG_WARN("HOROVOD_FAULT_INJECT: rank %d resetting every peer "
-               "socket at collective %lld",
-               st.rank, (long long)idx);
+      LOG_WARN("HOROVOD_FAULT_INJECT: rank %d resetting %s peer "
+               "socket(s) at collective %lld",
+               st.rank,
+               param < 0 ? "every" : "one stripe channel's",
+               (long long)idx);
       st.inject_rank = -1;
-      // The NIC-died shape: every peer connection aborts (they see
-      // EOF -> certain attribution) while this process stays alive.
-      for (int fd : RegisteredFds()) ::shutdown(fd, SHUT_RDWR);
+      // The NIC-died shape: peer connections abort (they see EOF ->
+      // certain attribution) while this process stays alive. A
+      // channel param scopes the abort to ONE stripe's sockets — the
+      // dead-NIC-queue case whose other K-1 channels must stay up.
+      for (int fd : RegisteredFds((int)param)) ::shutdown(fd, SHUT_RDWR);
       break;
     case kFaultFlip: {
       const bool persistent = param < 0;
       const int64_t bit = persistent ? -param : (param & kFlipBitMask);
-      const int64_t skip = persistent ? 0 : param >> kFlipSkipShift;
+      const int64_t skip =
+          persistent ? 0 : (param >> kFlipSkipShift) & kFlipSkipMask;
+      const int64_t chan =
+          persistent ? -1 : (param >> kFlipChanShift) - 1;
       LOG_WARN("HOROVOD_FAULT_INJECT: rank %d flipping wire bit %lld "
-               "(skip %lld frames) at collective %lld%s",
-               st.rank, (long long)bit, (long long)skip, (long long)idx,
+               "(skip %lld frames, channel %lld) at collective %lld%s",
+               st.rank, (long long)bit, (long long)skip,
+               (long long)chan, (long long)idx,
                persistent ? " (persistent)" : "");
       st.inject_rank = -1;
-      ArmWireFlip(bit, persistent, skip);
+      ArmWireFlip(bit, persistent, skip, chan);
       break;
     }
     case kFaultDelay:
@@ -1225,11 +1279,13 @@ void BackgroundThreadLoop(GlobalState& st) {
       SetRingChunkBytes(response_list.ring_chunk_bytes);
     }
     if (response_list.wire_compression >= 0 && st.rank != 0) {
-      if (WireCompression() != (response_list.wire_compression != 0)) {
+      // The field carries the full codec mode (0 off / 1 bf16 / 2
+      // int8) — the wire width every rank must frame with.
+      if (WireCodec() != response_list.wire_compression) {
         GlobalEvents().Record(EventType::kKnobAdopt, kKnobCompression, 0,
-                              response_list.wire_compression != 0);
+                              response_list.wire_compression);
       }
-      SetWireCompression(response_list.wire_compression != 0);
+      SetWireCodec(response_list.wire_compression);
     }
     // The hierarchy split decides which plane sequence every rank's
     // next collective decomposes into — as framing-critical as the
@@ -1240,6 +1296,15 @@ void BackgroundThreadLoop(GlobalState& st) {
                               response_list.hier_split);
       }
       st.hier_split = response_list.hier_split;
+    }
+    // The stripe width is the chunk round-robin framing: every rank
+    // must cut the SAME chunk->channel schedule in the same cycle.
+    if (response_list.wire_channels >= 1 && st.rank != 0) {
+      if (WireChannels() != response_list.wire_channels) {
+        GlobalEvents().Record(EventType::kKnobAdopt, kKnobWireChannels,
+                              0, response_list.wire_channels);
+      }
+      SetWireChannels(response_list.wire_channels);
     }
     int64_t cycle_bytes = 0;
     bool faulted = false;
@@ -1287,16 +1352,22 @@ void BackgroundThreadLoop(GlobalState& st) {
         ev.Record(EventType::kKnobAdopt, kKnobHierSplit, 0,
                   st.param_manager->hier_split());
       }
+      if (WireChannels() != st.param_manager->wire_channels()) {
+        ev.Record(EventType::kKnobAdopt, kKnobWireChannels, 0,
+                  st.param_manager->wire_channels());
+      }
       st.fusion_threshold = st.param_manager->fusion_threshold_bytes();
       st.cycle_time_ms = st.param_manager->cycle_time_ms();
       SetRingChunkBytes(st.param_manager->ring_chunk_bytes());
-      SetWireCompression(st.param_manager->wire_compression());
+      SetWireCodec(st.param_manager->wire_codec());
       st.hier_split = (int32_t)st.param_manager->hier_split();
+      SetWireChannels(st.param_manager->wire_channels());
       st.controller->SetAutotunedParams(
           st.fusion_threshold.load(), st.cycle_time_ms.load(),
           st.param_manager->ring_chunk_bytes(),
-          st.param_manager->wire_compression() ? 1 : 0,
-          (int32_t)st.param_manager->hier_split());
+          st.param_manager->wire_codec(),
+          (int32_t)st.param_manager->hier_split(),
+          (int32_t)st.param_manager->wire_channels());
     }
     if (response_list.shutdown) break;
     auto elapsed = std::chrono::steady_clock::now() - cycle_start;
@@ -1419,7 +1490,22 @@ int hvdtpu_init() {
   // framing even if a prior life's autotuner had moved the globals.
   SetRingChunkBytes(
       EnvInt64("HOROVOD_RING_CHUNK_BYTES", kDefaultRingChunkBytes));
-  SetWireCompression(EnvInt64("HOROVOD_WIRE_COMPRESSION", 0) != 0);
+  {
+    // HOROVOD_WIRE_COMPRESSION: 0/1/2 or the codec spellings
+    // ("bf16" == 1, "int8" == 2 — the EQuARX blockwise codec).
+    std::string comp = EnvStr("HOROVOD_WIRE_COMPRESSION", "0");
+    if (comp == "bf16") {
+      SetWireCodec(1);
+    } else if (comp == "int8") {
+      SetWireCodec(2);
+    } else {
+      SetWireCodec((int)EnvInt64("HOROVOD_WIRE_COMPRESSION", 0));
+    }
+  }
+  // Active stripe width re-seeds from the env on every (re)init — a
+  // tuned-down width from a previous generation must not leak into a
+  // re-formed ring whose peers read the env fresh.
+  SetWireChannels(WireChannelsEnv());
   SetWireTimeoutMs(
       EnvInt64("HOROVOD_WIRE_TIMEOUT_MS", kDefaultWireTimeoutMs));
   SetWireRetryAttempts(EnvInt64("HOROVOD_WIRE_RETRY_ATTEMPTS", 0));
@@ -1868,6 +1954,14 @@ int hvdtpu_reinit(const int32_t* ranks, int nranks, int64_t epoch) {
   st->shutdown_requested = false;
   st->loop_exited = false;
   st->loop_failed = false;
+  // Reset the ACTIVE stripe width to the env value, like a fresh init:
+  // reinit is collective over the survivors, and a parole JOINER
+  // seeds from the same env in its own init — a tuner-narrowed width
+  // surviving here would leave survivors and joiners cutting
+  // different chunk->channel schedules, and the stripe split IS the
+  // wire framing (wire.h). The rebuilt autotuner below re-tunes from
+  // this point.
+  SetWireChannels(WireChannelsEnv());
   // Rebuild the autotuner for the re-formed world: its hier-split grid
   // must cover the RE-DERIVED layout (a stale grid's next window would
   // stomp the new split with a divisor of the dead layout), and the
@@ -2325,6 +2419,32 @@ int hvdtpu_wire_compression() { return WireCompression() ? 1 : 0; }
 
 void hvdtpu_set_wire_compression(int v) { SetWireCompression(v != 0); }
 
+// Wire codec mode behind the compression knob: 0 off, 1 bf16, 2 int8
+// blockwise-scaled (docs/wire.md).
+int hvdtpu_wire_codec() { return WireCodec(); }
+
+void hvdtpu_set_wire_codec(int mode) { SetWireCodec(mode); }
+
+// Active stripe width (HOROVOD_WIRE_CHANNELS; docs/wire.md). MUST be
+// rank-uniform like the chunk knob — the stripe split is the wire
+// framing; the autotuner syncs it via the ResponseList. Clamped at use
+// sites to the sockets actually established per pair.
+int64_t hvdtpu_wire_channels() { return WireChannels(); }
+
+void hvdtpu_set_wire_channels(int64_t k) { SetWireChannels(k); }
+
+// Sockets established per neighbor pair this generation (env-derived,
+// fixed until the next full init; 1 before init).
+int hvdtpu_wire_channels_established() {
+  return g_state != nullptr ? g_state->wire_channels_established : 1;
+}
+
+// Explicit-SIMD reduce/codec paths (HOROVOD_SIMD; bit-identical to
+// scalar by contract — csrc/simd.h).
+int hvdtpu_simd_enabled() { return SimdEnabled() ? 1 : 0; }
+
+void hvdtpu_set_simd_enabled(int on) { SetSimdEnabled(on != 0); }
+
 // Cross-plane topology descriptor (HOROVOD_CROSS_PLANE): 0 auto, 1 ici,
 // 2 ring, 3 hier — fixed at init (the mode is a per-job choice; the
 // SPLIT within hier/auto is the runtime knob below).
@@ -2400,6 +2520,11 @@ int64_t hvdtpu_metrics_snapshot(char* buf, int64_t cap) {
       info.cycle_time_ms = g_state->cycle_time_ms.load();
       info.ring_chunk_bytes = RingChunkBytes();
       info.wire_compression = WireCompression();
+      info.wire_codec = WireCodec();
+      info.wire_channels = WireChannels();
+      info.wire_channels_established =
+          g_state->wire_channels_established;
+      info.simd = SimdEnabled();
       info.wire_timeout_ms = WireTimeoutMs();
       info.wire_retry_attempts = WireRetryAttempts();
       info.wire_retry_backoff_ms = WireRetryBackoffMs();

@@ -16,10 +16,12 @@ void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
                                   int max_samples, int64_t window_bytes,
                                   int window_cycles,
                                   int64_t ring_chunk_bytes,
-                                  bool wire_compression,
-                                  bool tune_wire_compression,
+                                  int wire_codec,
+                                  bool tune_wire_codec,
                                   std::vector<int64_t> hier_values,
-                                  int64_t hier_split) {
+                                  int64_t hier_split,
+                                  int64_t wire_channels,
+                                  int64_t max_wire_channels) {
   min_window_bytes_ = std::max<int64_t>(window_bytes, 1);
   min_window_cycles_ = std::max(window_cycles, 1);
   for (int64_t v = 1 << 20; v <= (64 << 20); v *= 2) {
@@ -36,12 +38,14 @@ void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
     chunk_values_ = {ring_chunk_bytes};
   }
   // Compression flips numerics: only the user's enablement puts the
-  // on/off choice on the grid; otherwise the dimension is a single
-  // fixed point and the GP never varies it.
-  if (tune_wire_compression) {
-    comp_values_ = {0, 1};
+  // off/codec choice on the grid; otherwise the dimension is a single
+  // fixed point and the GP never varies it. The tuner may settle on
+  // OFF (strictly more accurate), never on a codec the user did not
+  // pick.
+  if (tune_wire_codec && wire_codec != 0) {
+    comp_values_ = {0, wire_codec};
   } else {
-    comp_values_ = {wire_compression ? 1 : 0};
+    comp_values_ = {wire_codec};
   }
   // Hierarchy split point of the cross-plane allreduce: the caller
   // (operations.cc) passes the eligible splits for THIS layout — empty
@@ -49,6 +53,13 @@ void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
   // explicit HOROVOD_CROSS_PLANE=ring/hier choice the tuner must not
   // override beyond the split itself).
   if (!hier_values.empty()) hier_values_ = std::move(hier_values);
+  // Stripe width (6th dimension): powers of two up to the sockets
+  // actually established — a single-socket mesh pins it at {1}.
+  chan_values_.clear();
+  for (int64_t k = 1; k <= std::max<int64_t>(max_wire_channels, 1);
+       k *= 2) {
+    chan_values_.push_back(k);
+  }
   max_samples_ = std::max(max_samples, 2);
 
   // Candidate grid in a normalized space: log2 of each byte/ms knob
@@ -66,25 +77,33 @@ void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
   double k_hi = chunk_pinned ? 1 : std::log2((double)chunk_values_.back());
   // Hier coordinate: split index scaled to [0,1] (the split grid is
   // small and ordered flat < divisors ascending, so the index is a
-  // monotone proxy for "how local the decomposition is").
+  // monotone proxy for "how local the decomposition is"). Channel
+  // coordinate: same index treatment over the power-of-two widths.
   bool hier_pinned = hier_values_.size() <= 1;
+  bool chan_pinned = chan_values_.size() <= 1;
   for (size_t fi = 0; fi < fusion_values_.size(); fi++) {
     for (size_t ci = 0; ci < cycle_values_.size(); ci++) {
       for (size_t ki = 0; ki < chunk_values_.size(); ki++) {
         for (size_t mi = 0; mi < comp_values_.size(); mi++) {
           for (size_t hi = 0; hi < hier_values_.size(); hi++) {
-            cands.push_back(
-                {(std::log2((double)fusion_values_[fi]) - f_lo) /
-                     (f_hi - f_lo),
-                 (std::log2(cycle_values_[ci]) - c_lo) / (c_hi - c_lo),
-                 chunk_pinned
-                     ? 0.0
-                     : (std::log2((double)chunk_values_[ki]) - k_lo) /
-                           (k_hi - k_lo),
-                 (double)comp_values_[mi],
-                 hier_pinned
-                     ? 0.0
-                     : (double)hi / (double)(hier_values_.size() - 1)});
+            for (size_t ni = 0; ni < chan_values_.size(); ni++) {
+              cands.push_back(
+                  {(std::log2((double)fusion_values_[fi]) - f_lo) /
+                       (f_hi - f_lo),
+                   (std::log2(cycle_values_[ci]) - c_lo) / (c_hi - c_lo),
+                   chunk_pinned
+                       ? 0.0
+                       : (std::log2((double)chunk_values_[ki]) - k_lo) /
+                             (k_hi - k_lo),
+                   comp_values_[mi] != 0 ? 1.0 : 0.0,
+                   hier_pinned
+                       ? 0.0
+                       : (double)hi / (double)(hier_values_.size() - 1),
+                   chan_pinned
+                       ? 0.0
+                       : (double)ni /
+                             (double)(chan_values_.size() - 1)});
+            }
           }
         }
       }
@@ -107,27 +126,33 @@ void ParameterManager::Initialize(int64_t fusion_bytes, double cycle_ms,
   }
   comp_idx_ = 0;
   for (size_t i = 0; i < comp_values_.size(); i++) {
-    if (comp_values_[i] == (wire_compression ? 1 : 0)) comp_idx_ = i;
+    if (comp_values_[i] == wire_codec) comp_idx_ = i;
   }
   hier_idx_ = 0;
   for (size_t i = 0; i < hier_values_.size(); i++) {
     if (hier_values_[i] == hier_split) hier_idx_ = i;
   }
+  chan_idx_ = 0;
+  for (size_t i = 0; i < chan_values_.size(); i++) {
+    if (chan_values_[i] <= wire_channels) chan_idx_ = i;
+  }
   current_candidate_ =
-      (((fusion_idx_ * cycle_values_.size() + cycle_idx_) *
-            chunk_values_.size() +
-        chunk_idx_) *
-           comp_values_.size() +
-       comp_idx_) *
-          hier_values_.size() +
-      hier_idx_;
+      ((((fusion_idx_ * cycle_values_.size() + cycle_idx_) *
+             chunk_values_.size() +
+         chunk_idx_) *
+            comp_values_.size() +
+        comp_idx_) *
+           hier_values_.size() +
+       hier_idx_) *
+          chan_values_.size() +
+      chan_idx_;
 
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_) {
       fprintf(log_, "fusion_threshold_bytes,cycle_time_ms,"
                     "ring_chunk_bytes,wire_compression,hier_split,"
-                    "score_bytes_per_sec\n");
+                    "wire_channels,score_bytes_per_sec\n");
       fflush(log_);
     }
   }
@@ -140,15 +165,17 @@ ParameterManager::~ParameterManager() {
 
 void ParameterManager::Log(double score) {
   if (!log_) return;
-  fprintf(log_, "%lld,%.3f,%lld,%d,%lld,%.0f\n",
+  fprintf(log_, "%lld,%.3f,%lld,%d,%lld,%lld,%.0f\n",
           (long long)fusion_threshold_bytes(), cycle_time_ms(),
-          (long long)ring_chunk_bytes(), wire_compression() ? 1 : 0,
-          (long long)hier_split(), score);
+          (long long)ring_chunk_bytes(), wire_codec(),
+          (long long)hier_split(), (long long)wire_channels(), score);
   fflush(log_);
 }
 
 void ParameterManager::MoveTo(size_t candidate) {
   current_candidate_ = candidate;
+  chan_idx_ = candidate % chan_values_.size();
+  candidate /= chan_values_.size();
   hier_idx_ = candidate % hier_values_.size();
   candidate /= hier_values_.size();
   comp_idx_ = candidate % comp_values_.size();
@@ -171,10 +198,11 @@ void ParameterManager::Score(double bytes_per_sec) {
     // read rows[-1] as "what the tuner settled on".
     Log(opt_->MeanScore(current_candidate_));
     LOG_INFO("autotune converged: fusion=%lld bytes, cycle=%.2f ms, "
-             "ring_chunk=%lld bytes, wire_compression=%d, hier_split=%lld",
+             "ring_chunk=%lld bytes, wire_codec=%d, hier_split=%lld, "
+             "wire_channels=%lld",
              (long long)fusion_threshold_bytes(), cycle_time_ms(),
-             (long long)ring_chunk_bytes(), wire_compression() ? 1 : 0,
-             (long long)hier_split());
+             (long long)ring_chunk_bytes(), wire_codec(),
+             (long long)hier_split(), (long long)wire_channels());
     return;
   }
   MoveTo(opt_->Suggest());
@@ -220,8 +248,9 @@ bool ParameterManager::Update(int64_t bytes) {
   int64_t prev_fusion = fusion_threshold_bytes();
   double prev_cycle = cycle_time_ms();
   int64_t prev_chunk = ring_chunk_bytes();
-  bool prev_comp = wire_compression();
+  int prev_comp = wire_codec();
   int64_t prev_hier = hier_split();
+  int64_t prev_chan = wire_channels();
   if (warmup_windows_ > 0) {
     warmup_windows_--;  // discard: startup warmup pollutes the score
   } else if (window_bytes_ >= min_window_bytes_ ||
@@ -240,8 +269,9 @@ bool ParameterManager::Update(int64_t bytes) {
   return fusion_threshold_bytes() != prev_fusion ||
          cycle_time_ms() != prev_cycle ||
          ring_chunk_bytes() != prev_chunk ||
-         wire_compression() != prev_comp ||
-         hier_split() != prev_hier;
+         wire_codec() != prev_comp ||
+         hier_split() != prev_hier ||
+         wire_channels() != prev_chan;
 }
 
 }  // namespace hvdtpu

@@ -40,15 +40,23 @@ class ParameterManager {
   // allreduce (0 = flat ring, d >= 2 = intra-slice group size; the
   // eligible divisors of local_size — operations.cc builds it). A
   // single value pins the dimension; hier_split seeds the start point.
+  // wire_codec is the full codec mode (0 off / 1 bf16 / 2 int8);
+  // tune_wire_codec puts {0, codec} on the grid (OFF is always the
+  // safe fallback, the tuner never narrows an uncompressed run).
+  // wire_channels seeds the stripe-width dimension; its grid is the
+  // powers of two up to max_wire_channels (the sockets actually
+  // established), pinned when max == 1.
   void Initialize(int64_t fusion_bytes, double cycle_ms,
                   const std::string& log_path, int max_samples = 20,
                   int64_t window_bytes = 1 << 20,
                   int window_cycles = 20,
                   int64_t ring_chunk_bytes = 256 * 1024,
-                  bool wire_compression = false,
-                  bool tune_wire_compression = false,
+                  int wire_codec = 0,
+                  bool tune_wire_codec = false,
                   std::vector<int64_t> hier_values = {},
-                  int64_t hier_split = 0);
+                  int64_t hier_split = 0,
+                  int64_t wire_channels = 1,
+                  int64_t max_wire_channels = 1);
   ~ParameterManager();
 
   bool active() const { return active_; }
@@ -56,7 +64,9 @@ class ParameterManager {
   double cycle_time_ms() const { return cycle_values_[cycle_idx_]; }
   int64_t ring_chunk_bytes() const { return chunk_values_[chunk_idx_]; }
   bool wire_compression() const { return comp_values_[comp_idx_] != 0; }
+  int wire_codec() const { return comp_values_[comp_idx_]; }
   int64_t hier_split() const { return hier_values_[hier_idx_]; }
+  int64_t wire_channels() const { return chan_values_[chan_idx_]; }
 
   // Record bytes moved by allreduce responses this cycle; returns true when
   // a tuning window closed and the recommended parameters may have changed.
@@ -73,14 +83,15 @@ class ParameterManager {
   std::vector<int64_t> fusion_values_;
   std::vector<double> cycle_values_;
   std::vector<int64_t> chunk_values_;
-  std::vector<int> comp_values_;  // {0} / {1} fixed, or {0,1} tuned
+  std::vector<int> comp_values_;  // {0}/{mode} fixed, or {0,mode} tuned
   std::vector<int64_t> hier_values_ = {0};  // {0} fixed, else split grid
+  std::vector<int64_t> chan_values_ = {1};  // stripe widths <= max
   size_t fusion_idx_ = 0, cycle_idx_ = 0, chunk_idx_ = 0, comp_idx_ = 0;
-  size_t hier_idx_ = 0;
+  size_t hier_idx_ = 0, chan_idx_ = 0;
 
   // Bayesian optimization over the flattened grid: candidate index
-  // c = (((fusion_i * |cycle| + cycle_i) * |chunk| + chunk_i) * |comp|
-  //     + comp_i) * |hier| + hier_i.
+  // c = ((((fusion_i * |cycle| + cycle_i) * |chunk| + chunk_i) * |comp|
+  //     + comp_i) * |hier| + hier_i) * |chan| + chan_i.
   std::unique_ptr<BayesOpt> opt_;
   size_t current_candidate_ = 0;
   int max_samples_ = 20;

@@ -6,16 +6,20 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <functional>
+#include <limits>
 #include <mutex>
 #include <thread>
 
 #include "half.h"
 #include "events.h"
 #include "metrics.h"
+#include "simd.h"
 #include "wire.h"
 
 namespace hvdtpu {
@@ -23,7 +27,9 @@ namespace hvdtpu {
 namespace {
 
 std::atomic<int64_t> g_ring_chunk_bytes{kDefaultRingChunkBytes};
-std::atomic<bool> g_wire_compression{false};
+std::atomic<int> g_wire_codec{0};  // 0 none, 1 bf16, 2 int8
+// SIMD toggle (HOROVOD_SIMD): -1 = not yet folded from env.
+std::atomic<int> g_simd{-1};
 
 template <typename T, typename Acc = T>
 void ReduceTyped(T* dst, const T* src, int64_t count, ReduceOp op) {
@@ -83,32 +89,6 @@ void ScaleHalfLike(uint16_t* p, int64_t count, double factor) {
   }
 }
 
-// ---- bf16 wire codec (compressed allreduce) --------------------------
-
-void EncodeBF16(uint16_t* dst, const float* src, int64_t n) {
-  for (int64_t i = 0; i < n; i++) dst[i] = FloatToBF16Bits(src[i]);
-}
-
-void DecodeAccumBF16(float* dst, const uint16_t* src, int64_t n) {
-  // Full-precision accumulation: the bf16 hop payload is widened back
-  // to f32 before the add, so only the WIRE is narrow (EQuARX recipe).
-  for (int64_t i = 0; i < n; i++) dst[i] += BF16BitsToFloat(src[i]);
-}
-
-void DecodeScaleBF16(float* dst, const uint16_t* src, int64_t n,
-                     double post) {
-  if (post == 1.0) {
-    for (int64_t i = 0; i < n; i++) dst[i] = BF16BitsToFloat(src[i]);
-  } else {
-    // Same rounding as ScaleBuffer's f32 case (double multiply, one
-    // f32 cast) so folding the postscale here is bit-identical to
-    // scaling after the decode — it only saves the extra memory pass.
-    for (int64_t i = 0; i < n; i++) {
-      dst[i] = (float)((double)BF16BitsToFloat(src[i]) * post);
-    }
-  }
-}
-
 // Identical clamped chunk spans over the two directions of one hop:
 // fn(i, soff, slen, roff, rlen) per chunk index, offsets/lengths in
 // the caller's units. Both ends of a hop share the segment lengths,
@@ -139,20 +119,193 @@ void SetRingChunkBytes(int64_t bytes) {
   g_ring_chunk_bytes.store(bytes, std::memory_order_relaxed);
 }
 
-bool WireCompression() {
-  return g_wire_compression.load(std::memory_order_relaxed);
+bool WireCompression() { return WireCodec() != 0; }
+
+void SetWireCompression(bool on) { SetWireCodec(on ? 1 : 0); }
+
+int WireCodec() { return g_wire_codec.load(std::memory_order_relaxed); }
+
+void SetWireCodec(int mode) {
+  if (mode < 0 || mode > 2) mode = 0;
+  g_wire_codec.store(mode, std::memory_order_relaxed);
 }
 
-void SetWireCompression(bool on) {
-  g_wire_compression.store(on, std::memory_order_relaxed);
+bool SimdEnabled() {
+  int v = g_simd.load(std::memory_order_relaxed);
+  if (v == -1) {
+    // Lazy env fold, same pattern as the wire knobs: valid pre-init
+    // (the selftests run without a controller). Unparseable values
+    // keep the default (ON) — strtoll's 0-on-garbage must not turn
+    // "HOROVOD_SIMD=true" into a silent scalar downgrade.
+    v = 1;
+    const char* env = std::getenv("HOROVOD_SIMD");
+    if (env != nullptr) {
+      char* end = nullptr;
+      long long parsed = std::strtoll(env, &end, 10);
+      if (end != env) v = parsed != 0 ? 1 : 0;
+    }
+    g_simd.store(v, std::memory_order_relaxed);
+  }
+  return v != 0;
+}
+
+void SetSimdEnabled(bool on) {
+  g_simd.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+// ---- bf16 wire codec (compressed allreduce) --------------------------
+// SIMD-dispatched (simd.h); the scalar branches are the bit-identity
+// reference the HOROVOD_SIMD=0 escape hatch and the selftest pin run.
+
+void EncodeBF16(uint16_t* dst, const float* src, int64_t n) {
+  if (SimdEnabled()) {
+    simd::EncodeBF16(dst, src, n);
+    return;
+  }
+  for (int64_t i = 0; i < n; i++) dst[i] = FloatToBF16Bits(src[i]);
+}
+
+void DecodeAccumBF16(float* dst, const uint16_t* src, int64_t n) {
+  // Full-precision accumulation: the bf16 hop payload is widened back
+  // to f32 before the add, so only the WIRE is narrow (EQuARX recipe).
+  if (SimdEnabled()) {
+    simd::DecodeAccumBF16(dst, src, n);
+    return;
+  }
+  for (int64_t i = 0; i < n; i++) dst[i] += BF16BitsToFloat(src[i]);
+}
+
+void DecodeScaleBF16(float* dst, const uint16_t* src, int64_t n,
+                     double post) {
+  if (SimdEnabled()) {
+    simd::DecodeScaleBF16(dst, src, n, post);
+    return;
+  }
+  if (post == 1.0) {
+    for (int64_t i = 0; i < n; i++) dst[i] = BF16BitsToFloat(src[i]);
+  } else {
+    // Same rounding as ScaleBuffer's f32 case (double multiply, one
+    // f32 cast) so folding the postscale here is bit-identical to
+    // scaling after the decode — it only saves the extra memory pass.
+    for (int64_t i = 0; i < n; i++) {
+      dst[i] = (float)((double)BF16BitsToFloat(src[i]) * post);
+    }
+  }
+}
+
+// ---- int8 blockwise-scaled wire codec (EQuARX, arXiv:2506.17615) -----
+// Wire image: [f32 scale LE | B int8 quants] per block of
+// B = kInt8CodecBlock elems (tail block holds the remainder). One
+// scale per block keeps the quantization range local (a single hot
+// gradient cannot wash out a whole segment), decode accumulates in
+// f32, and — like the bf16 codec — the allgather phase forwards the
+// wire image verbatim, so every rank decodes the SAME bits and results
+// stay rank-consistent bitwise.
+
+int64_t Int8WireLen(int64_t n) {
+  if (n <= 0) return 0;
+  const int64_t blocks = (n + kInt8CodecBlock - 1) / kInt8CodecBlock;
+  return blocks * 4 + n;
+}
+
+void EncodeInt8(uint8_t* dst, const float* src, int64_t n) {
+  for (int64_t b = 0; b < n; b += kInt8CodecBlock) {
+    const int64_t m = std::min(kInt8CodecBlock, n - b);
+    float amax = 0.0f;
+    bool finite = true;
+    for (int64_t i = 0; i < m; i++) {
+      finite = finite && std::isfinite(src[b + i]);
+      amax = std::max(amax, std::fabs(src[b + i]));
+    }
+    if (!finite) {
+      // A non-finite input must POISON the block, not quantize to a
+      // clean-looking number (a NaN gradient mapping to -128*scale
+      // would dodge every divergence tripwire; casting a NaN float to
+      // int8 is UB besides). NaN scale + zero quants decode to NaN
+      // for the whole block — deterministic on every rank, like the
+      // bf16 codec's NaN propagation at block granularity.
+      const float scale = std::numeric_limits<float>::quiet_NaN();
+      std::memcpy(dst, &scale, 4);
+      dst += 4;
+      std::memset(dst, 0, (size_t)m);
+      dst += m;
+      continue;
+    }
+    // amax == 0 degrades to scale 1: all-zero quants, no divide by
+    // zero; the deterministic choice every rank reproduces. The
+    // FLT_MIN floor keeps an all-denormal block's scale from
+    // underflowing amax/127 to 0.0f — 0/0 would be NaN and the int8
+    // cast UB, with target-dependent wire bytes.
+    const float scale =
+        amax > 0.0f
+            ? std::max(amax / 127.0f, std::numeric_limits<float>::min())
+            : 1.0f;
+    std::memcpy(dst, &scale, 4);
+    dst += 4;
+    for (int64_t i = 0; i < m; i++) {
+      float q = std::nearbyintf(src[b + i] / scale);
+      if (q > 127.0f) q = 127.0f;
+      if (q < -127.0f) q = -127.0f;
+      *dst++ = (uint8_t)(int8_t)q;
+    }
+  }
+}
+
+namespace {
+// Shared record walk for the two span decoders: `fn(elem_idx, scale,
+// quant)` per element of each whole record in [woff, woff + wlen).
+template <typename Fn>
+void ForEachInt8Record(const uint8_t* wire, int64_t woff, int64_t wlen,
+                       int64_t seg_elems, Fn&& fn) {
+  const int64_t rec = 4 + kInt8CodecBlock;
+  int64_t block = woff / rec;   // records before the tail are full
+  const uint8_t* p = wire + woff;
+  const uint8_t* end = wire + woff + wlen;
+  while (p < end) {
+    const int64_t e0 = block * kInt8CodecBlock;
+    const int64_t m = std::min(kInt8CodecBlock, seg_elems - e0);
+    float scale;
+    std::memcpy(&scale, p, 4);
+    p += 4;
+    for (int64_t i = 0; i < m; i++) {
+      fn(e0 + i, scale, (int8_t)p[i]);
+    }
+    p += m;
+    block++;
+  }
+}
+}  // namespace
+
+void DecodeAccumInt8Span(float* dst, const uint8_t* wire, int64_t woff,
+                         int64_t wlen, int64_t seg_elems) {
+  ForEachInt8Record(wire, woff, wlen, seg_elems,
+                    [dst](int64_t e, float scale, int8_t q) {
+                      dst[e] += scale * (float)q;
+                    });
+}
+
+void DecodeScaleInt8Span(float* dst, const uint8_t* wire, int64_t woff,
+                         int64_t wlen, int64_t seg_elems, double post) {
+  if (post == 1.0) {
+    ForEachInt8Record(wire, woff, wlen, seg_elems,
+                      [dst](int64_t e, float scale, int8_t q) {
+                        dst[e] = scale * (float)q;
+                      });
+  } else {
+    ForEachInt8Record(wire, woff, wlen, seg_elems,
+                      [dst, post](int64_t e, float scale, int8_t q) {
+                        dst[e] =
+                            (float)((double)(scale * (float)q) * post);
+                      });
+  }
 }
 
 // Overlap worker: one thread, FIFO tasks, started lazily on first
 // Submit so planes that never run a chunked reduce cost nothing. The
-// caller thread owns the transport (wire.h contract); the worker only
-// touches host memory (ReduceInto / bf16 decode), and every public
-// collective drains the queue before returning, so no task outlives
-// the buffers it references.
+// transfer threads own the transport (wire.h contract); the worker
+// only touches host memory (ReduceInto / codec decode), and every
+// public collective drains the queue before returning, so no task
+// outlives the buffers it references.
 class ReduceWorker {
  public:
   ~ReduceWorker() {
@@ -203,6 +356,133 @@ class ReduceWorker {
   std::thread thread_;
 };
 
+// One ReduceWorker per stripe channel (ring_ops.h): chunk i % K
+// reduces on worker i % K, so reduction parallelism tracks the stripe
+// width. Threads start lazily per worker; DrainAll on idle workers is
+// free (pending == 0 returns immediately).
+class WorkerPool {
+ public:
+  void Submit(int channel, std::function<void()> fn) {
+    workers_[channel % kMaxWireChannels].Submit(std::move(fn));
+  }
+  void DrainAll() {
+    for (auto& w : workers_) w.Drain();
+  }
+
+ private:
+  ReduceWorker workers_[kMaxWireChannels];
+};
+
+namespace {
+
+// Run one striped transfer as a set of concurrent legs: leg 0 on the
+// caller thread, the rest on transient threads. Each leg owns its fds
+// (and, in split mode, its DIRECTION of an fd) exclusively for the
+// duration (the wire.h single-caller contract, per fd per direction),
+// and every thread joins before return, so no transport state
+// outlives the call. The first non-OK status wins, leg order
+// (deterministic enough for attribution: all legs fail against the
+// same dead peer).
+Status RunLegs(int wire_plane, std::vector<std::function<Status()>>& legs) {
+  if (legs.empty()) return Status::OK();
+  if (legs.size() == 1) return legs[0]();
+  std::vector<Status> sts(legs.size(), Status::OK());
+  std::vector<std::thread> threads;
+  threads.reserve(legs.size() - 1);
+  for (size_t i = 1; i < legs.size(); i++) {
+    threads.emplace_back([&, i] {
+      // kWireChunk events record the plane from a thread_local the
+      // caller thread set — replicate it on the leg's thread.
+      SetEventWirePlane(wire_plane);
+      sts[i] = legs[i]();
+      SetEventWirePlane(0);
+    });
+  }
+  sts[0] = legs[0]();
+  for (auto& t : threads) t.join();
+  for (auto& s : sts) {
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+// Build the leg set of one striped hop: per channel, either one
+// duplex leg (CRC mode — acks ride the data socket's reverse
+// direction, so ONE reader must own each fd), or a send-only and a
+// recv-only leg on separate threads (plain mode; the two directions
+// are independent byte streams even when they share one socket at
+// N=2, and splitting them doubles the user<->kernel copy parallelism
+// per channel — the loopback bottleneck in practice). `send_fd_of` /
+// `recv_fd_of` map a channel to its fds; `on_chunk` fires on the leg
+// that received the chunk.
+void BuildStripedLegs(
+    int stripe_k, const std::function<int(int)>& send_fd_of,
+    const void* send_buf, size_t send_len,
+    const std::function<int(int)>& recv_fd_of, void* recv_buf,
+    size_t recv_len, size_t chunk,
+    const std::function<void(size_t off, size_t len, int c)>& on_chunk,
+    std::vector<std::function<Status()>>* legs) {
+  const bool crc = WireCrc();
+  for (int c = 0; c < stripe_k; c++) {
+    const int sfd = send_fd_of(c);
+    const int rfd = recv_fd_of(c);
+    // Split only when the lane's two directions ride DIFFERENT fds:
+    // CRC needs one reader per fd (ack demux), and a shared fd (the
+    // size-2 ring / alltoall self-pair below the paired-plan width)
+    // must keep ONE leg — two legs' ScopedNonblock guards would race
+    // the fd's fcntl flags (the finisher restores blocking mode under
+    // the still-running leg, and the wire deadline stops firing).
+    const bool split = !crc && sfd != rfd;
+    auto chunk_cb = on_chunk
+                        ? std::function<void(size_t, size_t)>(
+                              [on_chunk, c](size_t off, size_t len) {
+                                on_chunk(off, len, c);
+                              })
+                        : std::function<void(size_t, size_t)>();
+    if (split) {
+      // Recv legs first: they carry the reduce callbacks and finish
+      // last — the caller thread should drive one of them.
+      if (recv_len > 0) {
+        legs->push_back([=] {
+          return DuplexTransferStriped(-1, nullptr, 0, rfd, recv_buf,
+                                       recv_len, chunk, stripe_k, c,
+                                       chunk_cb);
+        });
+      }
+      if (send_len > 0) {
+        legs->push_back([=] {
+          return DuplexTransferStriped(sfd, send_buf, send_len, -1,
+                                       nullptr, 0, chunk, stripe_k, c,
+                                       nullptr);
+        });
+      }
+    } else {
+      legs->push_back([=] {
+        return DuplexTransferStriped(sfd, send_buf, send_len, rfd,
+                                     recv_buf, recv_len, chunk, stripe_k,
+                                     c, chunk_cb);
+      });
+    }
+  }
+}
+
+// Bytes channel `c` carries of a `total`-byte stream striped at
+// `chunk` granularity over `k` channels (the deterministic schedule
+// both ends derive) — the per-channel wire accounting the stripe
+// imbalance view reads.
+int64_t StripeShareBytes(int64_t total, int64_t chunk, int k, int c) {
+  if (total <= 0) return 0;
+  if (k <= 1 || chunk <= 0) return c == 0 ? total : 0;
+  int64_t share = 0;
+  const int64_t nchunks = (total + chunk - 1) / chunk;
+  for (int64_t i = c; i < nchunks; i += k) {
+    share += std::min(chunk, total - i * chunk);
+  }
+  return share;
+}
+
+}  // namespace
+
 // Per-collective wire accounting, flushed into the metrics registry on
 // scope exit (error paths included): `tx/rx` are bytes that actually
 // crossed the transport, `*_logical` what they would be at full tensor
@@ -210,21 +490,60 @@ class ReduceWorker {
 // reads (compression_ratio = tx / tx_logical).
 struct DataPlane::WireTally {
   int plane = 0;  // 0 intra/flat, 1 cross-slice (set from wire_plane_)
+  int channels = 1;  // widest stripe this collective ran (span tag)
   int64_t tx = 0, rx = 0, tx_logical = 0, rx_logical = 0;
+  // Per-stripe-channel wire bytes (chunk schedule share): channel 0
+  // also books every unstriped path, so the channel buckets always sum
+  // to tx/rx exactly — the reconciliation that makes a dead or slow
+  // channel VISIBLE instead of averaged away.
+  int64_t chan_tx[kMaxWireChannels] = {0};
+  int64_t chan_rx[kMaxWireChannels] = {0};
   int64_t start_us = MetricsNowUs();
+
+  // Book one hop's wire + logical bytes, splitting the wire bytes over
+  // the hop's stripe schedule onto the PHYSICAL channels each lane
+  // rides (the parity-split pairwise plan maps lane i to channel
+  // 2i + parity; everything else is identity — DataPlane::HopStripe).
+  void BookTx(int64_t wire, int64_t logical, int64_t chunk,
+              const DataPlane::HopStripe& h) {
+    tx += wire;
+    tx_logical += logical;
+    for (int i = 0; i < h.width; i++) {
+      int phys = h.tx_chan(i);
+      if (phys >= kMaxWireChannels) continue;
+      if (phys + 1 > channels) channels = phys + 1;
+      chan_tx[phys] += StripeShareBytes(wire, chunk, h.width, i);
+    }
+  }
+  void BookRx(int64_t wire, int64_t logical, int64_t chunk,
+              const DataPlane::HopStripe& h) {
+    rx += wire;
+    rx_logical += logical;
+    for (int i = 0; i < h.width; i++) {
+      int phys = h.rx_chan(i);
+      if (phys >= kMaxWireChannels) continue;
+      if (phys + 1 > channels) channels = phys + 1;
+      chan_rx[phys] += StripeShareBytes(wire, chunk, h.width, i);
+    }
+  }
+
   ~WireTally() {
     // Restore the default plane tag for whatever the thread runs next
     // (the hierarchical engine nests intra/cross tallies).
     SetEventWirePlane(0);
     if (tx || rx || tx_logical || rx_logical) {
       GlobalMetrics().AccountWire(plane, tx, rx, tx_logical, rx_logical);
+      GlobalMetrics().AccountWireChannels(chan_tx, chan_rx);
       int64_t end_us = MetricsNowUs();
       // The span interval feeds the per-step overlap ledger — the SAME
       // [start,end) the kWireSpan event encodes, so the ledger and the
       // flight recorder can never disagree about what the wire did.
+      // Spans stay CHANNEL-MERGED (one span per collective, stripe
+      // width in the high bits of the plane arg): the ledger's
+      // exposed/hidden math wants wall intervals, not per-socket ones.
       GlobalLedger().AddSpan(plane, start_us, end_us);
       GlobalEvents().Record(
-          EventType::kWireSpan, plane,
+          EventType::kWireSpan, (int32_t)(plane | (channels << 1)),
           (int32_t)std::min<int64_t>(end_us - start_us, INT32_MAX), tx,
           rx);
     }
@@ -251,10 +570,25 @@ void ReduceInto(void* dst, const void* src, int64_t count, DataType dt,
           (uint16_t*)dst, (const uint16_t*)src, count, op);
       break;
     case DataType::HVDTPU_BFLOAT16:
+      // SUM-family bf16 takes the vectorized decode-add-encode path
+      // (bit-identical to ReduceHalfLike's sequence, pinned by
+      // hvdtpu_simd_selftest); MIN/MAX/PRODUCT stay scalar.
+      if ((op == ReduceOp::SUM || op == ReduceOp::AVERAGE ||
+           op == ReduceOp::ADASUM) &&
+          SimdEnabled()) {
+        simd::ReduceSumBF16((uint16_t*)dst, (const uint16_t*)src, count);
+        break;
+      }
       ReduceHalfLike<FloatToBF16Bits, BF16BitsToFloat>(
           (uint16_t*)dst, (const uint16_t*)src, count, op);
       break;
     case DataType::HVDTPU_FLOAT32:
+      if ((op == ReduceOp::SUM || op == ReduceOp::AVERAGE ||
+           op == ReduceOp::ADASUM) &&
+          SimdEnabled()) {
+        simd::AddF32((float*)dst, (const float*)src, count);
+        break;
+      }
       ReduceTyped((float*)dst, (const float*)src, count, op);
       break;
     case DataType::HVDTPU_FLOAT64:
@@ -284,6 +618,10 @@ void ScaleBuffer(void* buf, int64_t count, DataType dt, double factor) {
   switch (dt) {
     case DataType::HVDTPU_FLOAT32: {
       auto* p = (float*)buf;
+      if (SimdEnabled()) {
+        simd::ScaleF32(p, count, factor);
+        break;
+      }
       for (int64_t i = 0; i < count; i++) p[i] = (float)(p[i] * factor);
       break;
     }
@@ -321,7 +659,7 @@ DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds)
 DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds,
                      bool owns_fds)
     : rank_(rank), size_(size), peer_fds_(std::move(peer_fds)),
-      owns_fds_(owns_fds), worker_(std::make_shared<ReduceWorker>()) {
+      owns_fds_(owns_fds), workers_(std::make_shared<WorkerPool>()) {
   global_ranks_.resize(size_);
   for (int i = 0; i < size_; i++) global_ranks_[i] = i;
   if (owns_fds_) {
@@ -332,6 +670,56 @@ DataPlane::DataPlane(int rank, int size, std::vector<int> peer_fds,
       if (peer_fds_[i] >= 0) RegisterFdRank(peer_fds_[i], (int)i);
     }
   }
+}
+
+void DataPlane::AdoptExtraChannelFds(
+    std::vector<std::vector<int>> chan_fds) {
+  extra_fds_ = std::move(chan_fds);
+  if (owns_fds_) {
+    for (size_t c = 0; c < extra_fds_.size(); c++) {
+      for (size_t i = 0; i < extra_fds_[c].size(); i++) {
+        if (extra_fds_[c][i] >= 0) {
+          RegisterFdRank(extra_fds_[c][i], (int)i, (int)c + 1);
+        }
+      }
+    }
+  }
+}
+
+int DataPlane::ActiveStripe(int64_t chunk_bytes) const {
+  // Striping needs chunk framing (the stripe schedule IS chunk
+  // round-robin) and real sockets; the external transport's mailbox
+  // fds carry no channel id. Rank-uniform: both inputs are
+  // (docs/wire.md).
+  if (chunk_bytes <= 0 || extra_fds_.empty() ||
+      ExternalTransportActive()) {
+    return 1;
+  }
+  int k = (int)WireChannels();
+  if (k > channels()) k = channels();
+  return k < 1 ? 1 : k;
+}
+
+DataPlane::HopStripe DataPlane::StripeFor(int send_peer, int recv_peer,
+                                          int64_t chunk_bytes) const {
+  HopStripe h;
+  const int k = ActiveStripe(chunk_bytes);
+  if (k <= 1) return h;
+  if (send_peer == recv_peer && k >= 4) {
+    // Pairwise hop at k >= 4: direction-split the channels (see
+    // ring_ops.h). Group ranks order both ends identically, so the
+    // two sides pick opposite parities and each socket carries exactly
+    // one direction. At k < 4 the split would leave ONE stream per
+    // direction — measurably slower than two duplexed ones — so small
+    // widths keep duplex lanes.
+    h.paired = true;
+    h.width = k / 2;  // the last lane's physical channel is
+    h.tx_base = rank_ > send_peer ? 1 : 0;  // k-1 <= channels()-1
+    h.rx_base = 1 - h.tx_base;
+  } else {
+    h.width = k;
+  }
+  return h;
 }
 
 std::vector<int32_t> DataPlane::ProbeDeadPeers() const {
@@ -370,31 +758,39 @@ std::vector<int32_t> DataPlane::ProbeDeadPeers() const {
 DataPlane::~DataPlane() {
   if (!owns_fds_) return;
   for (int fd : peer_fds_) TcpClose(fd);
+  for (auto& chan : extra_fds_) {
+    for (int fd : chan) TcpClose(fd);
+  }
 }
 
 DataPlane DataPlane::Subset(const std::vector<int32_t>& members) const {
   std::vector<int> fds(members.size(), -1);
+  std::vector<std::vector<int>> extra(extra_fds_.size(),
+                                      std::vector<int>(members.size(), -1));
   int my_idx = -1;
   for (size_t i = 0; i < members.size(); i++) {
     if (members[i] == rank_) {
       my_idx = (int)i;
     } else {
       fds[i] = peer_fds_[members[i]];
+      for (size_t c = 0; c < extra_fds_.size(); c++) {
+        extra[c][i] = extra_fds_[c][members[i]];
+      }
     }
   }
   // All ring algorithms index peer_fds_ by (group-relative) rank, so a
   // remapped fd table + group rank/size is a fully working data plane.
   DataPlane sub(my_idx, (int)members.size(), std::move(fds),
                 /*owns_fds=*/false);
+  sub.extra_fds_ = std::move(extra);  // shared, like the primary mesh
   sub.global_ranks_ = members;
   // Views inherit the parent's wire plane + compression override;
   // HierarchicalAllreduce re-tags its inter-slice subset explicitly.
   sub.wire_plane_ = wire_plane_;
   sub.force_compression_ = force_compression_;
-  // Share the parent's overlap worker: the core's single background
-  // thread is the only caller on both, so per-response subset views
-  // never spawn (and tear down) their own thread.
-  sub.worker_ = worker_;
+  // Share the parent's worker pool: per-response subset views never
+  // spawn (and tear down) their own reduce threads.
+  sub.workers_ = workers_;
   return sub;
 }
 
@@ -459,22 +855,22 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count, DataType dt,
   return local.Allgatherv(my_seg.data(), buf, seg_bytes);
 }
 
-Status DataPlane::PipelinedReduceChunks(int send_fd, const uint8_t* send_buf,
-                                        int64_t send_bytes, int recv_fd,
+Status DataPlane::PipelinedReduceChunks(int send_peer, const uint8_t* send_buf,
+                                        int64_t send_bytes, int recv_peer,
                                         uint8_t* reduce_dst,
                                         int64_t recv_count, DataType dt,
                                         ReduceOp op, int64_t chunk_bytes,
                                         WireTally* tally) {
   const int64_t elem = DataTypeSize(dt);
   const int64_t recv_bytes = recv_count * elem;
-  tally->tx += send_bytes;
-  tally->tx_logical += send_bytes;
-  tally->rx += recv_bytes;
-  tally->rx_logical += recv_bytes;
+  const int send_fd = peer_fd(0, send_peer);
+  const int recv_fd = peer_fd(0, recv_peer);
   if (chunk_bytes <= 0 ||
       (send_bytes <= chunk_bytes && recv_bytes <= chunk_bytes)) {
     // Bulk path: one whole-segment transfer, then a serial reduce —
     // same framing and bit-identical results as the pre-chunking ring.
+    tally->BookTx(send_bytes, send_bytes, 0, HopStripe{});
+    tally->BookRx(recv_bytes, recv_bytes, 0, HopStripe{});
     if ((int64_t)scratch_.size() < recv_bytes) scratch_.resize(recv_bytes);
     Status s = DuplexTransfer(send_fd, send_buf, (size_t)send_bytes, recv_fd,
                               scratch_.data(), (size_t)recv_bytes);
@@ -486,30 +882,42 @@ Status DataPlane::PipelinedReduceChunks(int send_fd, const uint8_t* send_buf,
   const int64_t chunk_elems = std::max<int64_t>(chunk_bytes / elem, 1);
   const int64_t cbytes = chunk_elems * elem;
   if (!IsExtFd(send_fd) && !IsExtFd(recv_fd)) {
-    // TCP: ONE continuous duplex for the whole segment — the send
-    // streams with no per-chunk lockstep or fcntl churn (byte-stream
-    // framing is unchanged vs the bulk path), while every completed
-    // recv chunk fires a ReduceInto on the worker, overlapping the
-    // reduction with the rest of the transfer.
+    // TCP: ONE continuous duplex per stripe lane for the whole
+    // segment — each lane's send streams with no per-chunk lockstep
+    // (chunk i rides lane i % width; the K=1 stream is byte-identical
+    // to the pre-striping engine), while every completed recv chunk
+    // fires a ReduceInto on ITS LANE's worker, overlapping reduction
+    // with the rest of the transfer at stripe parallelism.
+    const HopStripe hop = StripeFor(send_peer, recv_peer, chunk_bytes);
+    tally->BookTx(send_bytes, send_bytes, cbytes, hop);
+    tally->BookRx(recv_bytes, recv_bytes, cbytes, hop);
     if ((int64_t)scratch_.size() < recv_bytes) scratch_.resize(recv_bytes);
     uint8_t* rbuf = scratch_.data();
-    Status s = DuplexTransferChunked(
-        send_fd, send_buf, (size_t)send_bytes, recv_fd, rbuf,
+    std::vector<std::function<Status()>> legs;
+    BuildStripedLegs(
+        hop.width,
+        [&](int i) { return peer_fd(hop.tx_chan(i), send_peer); },
+        send_buf, (size_t)send_bytes,
+        [&](int i) { return peer_fd(hop.rx_chan(i), recv_peer); }, rbuf,
         (size_t)recv_bytes, (size_t)cbytes,
-        [&](size_t off, size_t len) {
+        [&](size_t off, size_t len, int c) {
           uint8_t* dst = reduce_dst + off;
           const uint8_t* src = rbuf + off;
           const int64_t n = (int64_t)len / elem;
-          worker_->Submit(
-              [dst, src, n, dt, op] { ReduceInto(dst, src, n, dt, op); });
-        });
-    worker_->Drain();  // the segment is fully reduced before the caller
-    return s;          // forwards it on the next ring step
+          workers_->Submit(
+              c, [dst, src, n, dt, op] { ReduceInto(dst, src, n, dt, op); });
+        },
+        &legs);
+    Status s = RunLegs(wire_plane_, legs);
+    workers_->DrainAll();  // the segment is fully reduced before the
+    return s;              // caller forwards it on the next ring step
   }
   // External (message) transport: the mailbox preserves boundaries, so
   // both ends cut identical chunk spans into equal-length paired
   // messages, double-buffered so the reduce of chunk i-1 overlaps the
-  // exchange of chunk i.
+  // exchange of chunk i. Never striped (ActiveStripe == 1 there).
+  tally->BookTx(send_bytes, send_bytes, 0, HopStripe{});
+  tally->BookRx(recv_bytes, recv_bytes, 0, HopStripe{});
   if ((int64_t)chunk_scratch_.size() < 2 * cbytes) {
     chunk_scratch_.resize((size_t)(2 * cbytes));
   }
@@ -522,35 +930,56 @@ Status DataPlane::PipelinedReduceChunks(int send_fd, const uint8_t* send_buf,
         // (submitted below last iteration) out of the other half.
         Status t = DuplexTransfer(send_fd, send_buf + soff, (size_t)slen,
                                   recv_fd, rscratch, (size_t)rlen);
-        worker_->Drain();  // chunk i-1 reduced; its scratch half is free
+        workers_->DrainAll();  // chunk i-1 reduced; its half is free
         if (!t.ok()) return t;
         if (rlen > 0) {
           uint8_t* dst = reduce_dst + roff;
           const int64_t n = rlen / elem;
-          worker_->Submit([dst, rscratch, n, dt, op] {
+          workers_->Submit(0, [dst, rscratch, n, dt, op] {
             ReduceInto(dst, rscratch, n, dt, op);
           });
         }
         return Status::OK();
       });
-  worker_->Drain();
+  workers_->DrainAll();
   return s;
 }
 
-Status DataPlane::ChunkedDuplex(int send_fd, const uint8_t* send_buf,
-                                int64_t send_bytes, int recv_fd,
+Status DataPlane::ChunkedDuplex(int send_peer, const uint8_t* send_buf,
+                                int64_t send_bytes, int recv_peer,
                                 uint8_t* recv_buf, int64_t recv_bytes,
                                 int64_t chunk_bytes, WireTally* tally) {
-  tally->tx += send_bytes;
-  tally->tx_logical += send_bytes;
-  tally->rx += recv_bytes;
-  tally->rx_logical += recv_bytes;
-  // No reduction to overlap here, so the knob only matters where the
-  // transport frames messages: on TCP the byte stream hides chunk
-  // boundaries and one duplex is strictly cheaper.
+  const int send_fd = peer_fd(0, send_peer);
+  const int recv_fd = peer_fd(0, recv_peer);
+  const bool tcp = !IsExtFd(send_fd) && !IsExtFd(recv_fd);
+  const bool small =
+      send_bytes <= chunk_bytes && recv_bytes <= chunk_bytes;
+  const HopStripe hop = small || !tcp
+                            ? HopStripe{}
+                            : StripeFor(send_peer, recv_peer, chunk_bytes);
+  if (hop.width > 1 || hop.paired) {
+    // No reduction to overlap, but the stripe lanes (x2 direction
+    // legs) multiply the raw socket parallelism — the allgather phase
+    // is pure wire time.
+    tally->BookTx(send_bytes, send_bytes, chunk_bytes, hop);
+    tally->BookRx(recv_bytes, recv_bytes, chunk_bytes, hop);
+    std::vector<std::function<Status()>> legs;
+    BuildStripedLegs(
+        hop.width,
+        [&](int i) { return peer_fd(hop.tx_chan(i), send_peer); },
+        send_buf, (size_t)send_bytes,
+        [&](int i) { return peer_fd(hop.rx_chan(i), recv_peer); },
+        recv_buf, (size_t)recv_bytes, (size_t)chunk_bytes, nullptr,
+        &legs);
+    return RunLegs(wire_plane_, legs);
+  }
+  tally->BookTx(send_bytes, send_bytes, 0, HopStripe{});
+  tally->BookRx(recv_bytes, recv_bytes, 0, HopStripe{});
+  // Single channel: the knob only matters where the transport frames
+  // messages — on TCP the byte stream hides chunk boundaries and one
+  // duplex is strictly cheaper.
   if (chunk_bytes <= 0 ||
-      (send_bytes <= chunk_bytes && recv_bytes <= chunk_bytes) ||
-      (!IsExtFd(send_fd) && !IsExtFd(recv_fd))) {
+      (send_bytes <= chunk_bytes && recv_bytes <= chunk_bytes) || tcp) {
     return DuplexTransfer(send_fd, send_buf, (size_t)send_bytes, recv_fd,
                           recv_buf, (size_t)recv_bytes);
   }
@@ -565,26 +994,42 @@ Status DataPlane::ChunkedDuplex(int send_fd, const uint8_t* send_buf,
 Status DataPlane::CompressedReducePhase(
     float* base, const std::vector<int64_t>& seg_count,
     const std::vector<int64_t>& seg_off, int64_t chunk_elems, int rot,
-    WireTally* tally) {
+    int codec, WireTally* tally) {
   int64_t max_seg = 0;
   for (int i = 0; i < size_; i++) max_seg = std::max(max_seg, seg_count[i]);
   const bool tcp = !IsExtFd(right_fd()) && !IsExtFd(left_fd());
-  // Scratch: the TCP path encodes/receives whole segments (one
-  // streaming duplex per step); the external path works chunk-by-chunk
-  // with a double-buffered recv half.
-  const int64_t send_scratch_elems = tcp ? max_seg : chunk_elems;
-  const int64_t recv_scratch_elems =
-      tcp ? max_seg : 2 * chunk_elems;
-  if ((int64_t)comp_send_scratch_.size() < send_scratch_elems * 2) {
-    comp_send_scratch_.resize((size_t)(send_scratch_elems * 2));
+  const bool i8 = codec == 2;
+  // The int8 image is [scale | block] records: chunk boundaries must
+  // cut at record multiples so every wire chunk decodes
+  // self-contained (ring_ops.h codec contract).
+  if (i8) {
+    chunk_elems =
+        std::max<int64_t>((chunk_elems / kInt8CodecBlock) * kInt8CodecBlock,
+                          kInt8CodecBlock);
   }
-  if ((int64_t)chunk_scratch_.size() < recv_scratch_elems * 2) {
-    chunk_scratch_.resize((size_t)(recv_scratch_elems * 2));
+  // Wire bytes of an n-elem segment under this codec, and the wire
+  // chunk granularity matching `chunk_elems`.
+  auto wlen = [&](int64_t n) { return i8 ? Int8WireLen(n) : n * 2; };
+  const int64_t wire_chunk = wlen(chunk_elems);
+  const int64_t send_scratch = tcp ? wlen(max_seg) : wire_chunk;
+  const int64_t recv_scratch = tcp ? wlen(max_seg) : 2 * wire_chunk;
+  if ((int64_t)comp_send_scratch_.size() < send_scratch) {
+    comp_send_scratch_.resize((size_t)send_scratch);
   }
-  // N-1 ring reduce steps at rotation `rot`. Each hop ships the current
-  // f32 partial as bf16; the receiver widens back to f32 and
+  if ((int64_t)chunk_scratch_.size() < recv_scratch) {
+    chunk_scratch_.resize((size_t)recv_scratch);
+  }
+  auto encode = [&](uint8_t* dst, const float* src, int64_t n) {
+    if (i8) {
+      EncodeInt8(dst, src, n);
+    } else {
+      EncodeBF16((uint16_t*)dst, src, n);
+    }
+  };
+  // N-1 ring reduce steps at rotation `rot`. Each hop ships the
+  // current f32 partial narrow; the receiver widens back to f32 and
   // accumulates at full precision, overlapped with the remaining
-  // transfer.
+  // transfer on the per-channel workers.
   for (int step = 0; step < size_ - 1; step++) {
     int send_seg = RingSendSegment(rank_, step, size_, rot);
     int recv_seg = RingRecvSegment(rank_, step, size_, rot);
@@ -592,49 +1037,72 @@ Status DataPlane::CompressedReducePhase(
     float* rbase = base + seg_off[recv_seg];
     const int64_t scount = seg_count[send_seg];
     const int64_t rcount = seg_count[recv_seg];
-    tally->tx += scount * 2;
-    tally->tx_logical += scount * 4;
-    tally->rx += rcount * 2;
-    tally->rx_logical += rcount * 4;
     if (tcp) {
-      // Encode the whole outgoing segment once, then stream it in one
-      // duplex while completed recv chunks decode+accumulate on the
-      // worker.
-      auto* senc = (uint16_t*)comp_send_scratch_.data();
-      EncodeBF16(senc, sbase, scount);
-      auto* rdec = (uint16_t*)chunk_scratch_.data();
-      Status s = DuplexTransferChunked(
-          right_fd(), senc, (size_t)(scount * 2), left_fd(), rdec,
-          (size_t)(rcount * 2), (size_t)(chunk_elems * 2),
-          [&](size_t off, size_t len) {
-            float* dst = rbase + off / 2;
-            const uint16_t* src = rdec + off / 2;
-            const int64_t n = (int64_t)len / 2;
-            worker_->Submit([dst, src, n] { DecodeAccumBF16(dst, src, n); });
-          });
-      worker_->Drain();  // next step sends what this step accumulated
+      // Encode the whole outgoing segment once, then stream it —
+      // striped over the active channels — while completed recv chunks
+      // decode+accumulate on their channel's worker.
+      const HopStripe hop =
+          StripeFor(right_peer(), left_peer(), wire_chunk);
+      tally->BookTx(wlen(scount), scount * 4, wire_chunk, hop);
+      tally->BookRx(wlen(rcount), rcount * 4, wire_chunk, hop);
+      uint8_t* senc = comp_send_scratch_.data();
+      encode(senc, sbase, scount);
+      uint8_t* rdec = chunk_scratch_.data();
+      std::vector<std::function<Status()>> legs;
+      BuildStripedLegs(
+          hop.width,
+          [&](int i) { return right_fd(hop.tx_chan(i)); }, senc,
+          (size_t)wlen(scount),
+          [&](int i) { return left_fd(hop.rx_chan(i)); }, rdec,
+          (size_t)wlen(rcount), (size_t)wire_chunk,
+          [&](size_t off, size_t len, int c) {
+            if (i8) {
+              workers_->Submit(c, [=] {
+                DecodeAccumInt8Span(rbase, rdec, (int64_t)off,
+                                    (int64_t)len, rcount);
+              });
+            } else {
+              float* dst = rbase + off / 2;
+              const uint16_t* src = (const uint16_t*)rdec + off / 2;
+              const int64_t n = (int64_t)len / 2;
+              workers_->Submit(
+                  c, [dst, src, n] { DecodeAccumBF16(dst, src, n); });
+            }
+          },
+          &legs);
+      Status s = RunLegs(wire_plane_, legs);
+      workers_->DrainAll();  // next step sends what this accumulated
       if (!s.ok()) return s;
       continue;
     }
+    tally->BookTx(wlen(scount), scount * 4, 0, HopStripe{});
+    tally->BookRx(wlen(rcount), rcount * 4, 0, HopStripe{});
     Status s = ForEachChunkSpan(
         scount, rcount, chunk_elems,
         [&](int64_t i, int64_t soff, int64_t sn, int64_t roff, int64_t rn) {
-          auto* senc = (uint16_t*)comp_send_scratch_.data();
-          EncodeBF16(senc, sbase + soff, sn);
-          auto* rdec =
-              (uint16_t*)chunk_scratch_.data() + (i & 1) * chunk_elems;
-          Status t = DuplexTransfer(right_fd(), senc, (size_t)(sn * 2),
-                                    left_fd(), rdec, (size_t)(rn * 2));
-          worker_->Drain();  // chunk i-1 accumulated; its half is free
+          uint8_t* senc = comp_send_scratch_.data();
+          encode(senc, sbase + soff, sn);
+          uint8_t* rdec = chunk_scratch_.data() + (i & 1) * wire_chunk;
+          Status t =
+              DuplexTransfer(right_fd(), senc, (size_t)wlen(sn),
+                             left_fd(), rdec, (size_t)wlen(rn));
+          workers_->DrainAll();  // chunk i-1 accumulated; half is free
           if (!t.ok()) return t;
           if (rn > 0) {
             float* dst = rbase + roff;
-            worker_->Submit(
-                [dst, rdec, rn] { DecodeAccumBF16(dst, rdec, rn); });
+            if (i8) {
+              workers_->Submit(0, [=] {
+                DecodeAccumInt8Span(dst, rdec, 0, wlen(rn), rn);
+              });
+            } else {
+              workers_->Submit(0, [dst, rdec, rn] {
+                DecodeAccumBF16(dst, (const uint16_t*)rdec, rn);
+              });
+            }
           }
           return Status::OK();
         });
-    worker_->Drain();  // next step sends what this step accumulated
+    workers_->DrainAll();  // next step sends what this accumulated
     if (!s.ok()) return s;
   }
   return Status::OK();
@@ -652,100 +1120,140 @@ static int64_t CompressedChunkElems(int64_t chunk_bytes,
 
 Status DataPlane::CompressedRingReduceScatter(
     float* base, const std::vector<int64_t>& seg_count,
-    const std::vector<int64_t>& seg_off, int64_t chunk_bytes,
+    const std::vector<int64_t>& seg_off, int64_t chunk_bytes, int codec,
     WireTally* tally) {
   // rot = -1: rank r's fully-accumulated segment is its own segment r —
   // the reduce-scatter output contract (see RingOwnedSegment).
   return CompressedReducePhase(base, seg_count, seg_off,
                                CompressedChunkElems(chunk_bytes, seg_count),
-                               /*rot=*/-1, tally);
+                               /*rot=*/-1, codec, tally);
 }
 
 Status DataPlane::CompressedRingAllreduce(
     float* base, const std::vector<int64_t>& seg_count,
     const std::vector<int64_t>& seg_off, double postscale,
-    int64_t chunk_bytes, WireTally* tally) {
-  const int64_t chunk_elems = CompressedChunkElems(chunk_bytes, seg_count);
+    int64_t chunk_bytes, int codec, WireTally* tally) {
+  int64_t chunk_elems = CompressedChunkElems(chunk_bytes, seg_count);
+  const bool i8 = codec == 2;
+  if (i8) {
+    chunk_elems =
+        std::max<int64_t>((chunk_elems / kInt8CodecBlock) * kInt8CodecBlock,
+                          kInt8CodecBlock);
+  }
   // Phase 1: ring reduce-scatter (rot = 0 — rank r ends owning segment
   // (r+1)%N, which phase 2 sends first).
   Status ph1 = CompressedReducePhase(base, seg_count, seg_off, chunk_elems,
-                                     /*rot=*/0, tally);
+                                     /*rot=*/0, codec, tally);
   if (!ph1.ok()) return ph1;
   const bool tcp = !IsExtFd(right_fd()) && !IsExtFd(left_fd());
+  auto wlen = [&](int64_t n) { return i8 ? Int8WireLen(n) : n * 2; };
+  const int64_t wire_chunk = wlen(chunk_elems);
   // Phase 2: ring allgather of the finalized segments, compressed. The
-  // bf16 wire image is forwarded verbatim (re-encoding a decoded bf16
-  // value is lossless, so no rounding compounds across hops), and every
-  // rank — the owner included — decodes the SAME bits, so the result is
-  // rank-consistent: each element is exactly one bf16 rounding of its
-  // full-precision f32 reduction, times the postscale.
-  const int64_t total = seg_off[size_ - 1] + seg_count[size_ - 1];
-  if ((int64_t)comp_plane_.size() < total * 2) {
-    comp_plane_.resize((size_t)(total * 2));
+  // narrow wire image is forwarded VERBATIM (no hop re-encodes), and
+  // every rank — the owner included — decodes the SAME bits, so the
+  // result is rank-consistent: each element is exactly one codec
+  // rounding of its full-precision f32 reduction, times the postscale.
+  // The plane holds every segment's wire image at its wire offset.
+  std::vector<int64_t> woff(size_);
+  int64_t wtotal = 0;
+  for (int i = 0; i < size_; i++) {
+    woff[i] = wtotal;
+    wtotal += wlen(seg_count[i]);
   }
-  auto* comp = (uint16_t*)comp_plane_.data();
+  if ((int64_t)comp_plane_.size() < wtotal) {
+    comp_plane_.resize((size_t)wtotal);
+  }
+  uint8_t* comp = comp_plane_.data();
+  auto decode_scale = [&](int seg, int64_t off, int64_t len) {
+    // Decode `len` wire bytes at wire offset `off` of segment `seg`
+    // into its f32 region, with the postscale folded in.
+    if (i8) {
+      DecodeScaleInt8Span(base + seg_off[seg], comp + woff[seg], off, len,
+                          seg_count[seg], postscale);
+    } else {
+      DecodeScaleBF16(base + seg_off[seg] + off / 2,
+                      (const uint16_t*)(comp + woff[seg]) + off / 2,
+                      len / 2, postscale);
+    }
+  };
   // After size-1 reduce-scatter steps the fully-accumulated segment at
   // rank r is (r+1) mod size — exactly the first segment phase 2 sends.
   const int own_seg = RingOwnedSegment(rank_, size_);
-  EncodeBF16(comp + seg_off[own_seg], base + seg_off[own_seg],
-             seg_count[own_seg]);
-  DecodeScaleBF16(base + seg_off[own_seg], comp + seg_off[own_seg],
-                  seg_count[own_seg], postscale);
+  if (i8) {
+    EncodeInt8(comp + woff[own_seg], base + seg_off[own_seg],
+               seg_count[own_seg]);
+  } else {
+    EncodeBF16((uint16_t*)(comp + woff[own_seg]), base + seg_off[own_seg],
+               seg_count[own_seg]);
+  }
+  decode_scale(own_seg, 0, wlen(seg_count[own_seg]));
   for (int step = 0; step < size_ - 1; step++) {
     int send_seg = RingSendSegment(rank_, step, size_, /*rot=*/1);
     int recv_seg = RingSendSegment(rank_, step, size_, /*rot=*/0);
     const int64_t scount = seg_count[send_seg];
     const int64_t rcount = seg_count[recv_seg];
-    tally->tx += scount * 2;
-    tally->tx_logical += scount * 4;
-    tally->rx += rcount * 2;
-    tally->rx_logical += rcount * 4;
     // Receive straight into the compressed plane (it is forwarded next
     // step); the f32 decode overlaps the remaining transfer. No
     // per-step drain: every chunk decodes from its own plane region.
     if (tcp) {
-      uint16_t* rplane = comp + seg_off[recv_seg];
-      float* rbase = base + seg_off[recv_seg];
-      Status s = DuplexTransferChunked(
-          right_fd(), comp + seg_off[send_seg], (size_t)(scount * 2),
-          left_fd(), rplane, (size_t)(rcount * 2),
-          (size_t)(chunk_elems * 2),
-          [&](size_t off, size_t len) {
-            float* dst = rbase + off / 2;
-            const uint16_t* src = rplane + off / 2;
-            const int64_t n = (int64_t)len / 2;
-            worker_->Submit([dst, src, n, postscale] {
-              DecodeScaleBF16(dst, src, n, postscale);
+      const HopStripe hop =
+          StripeFor(right_peer(), left_peer(), wire_chunk);
+      tally->BookTx(wlen(scount), scount * 4, wire_chunk, hop);
+      tally->BookRx(wlen(rcount), rcount * 4, wire_chunk, hop);
+      std::vector<std::function<Status()>> legs;
+      BuildStripedLegs(
+          hop.width,
+          [&](int i) { return right_fd(hop.tx_chan(i)); },
+          comp + woff[send_seg], (size_t)wlen(scount),
+          [&](int i) { return left_fd(hop.rx_chan(i)); },
+          comp + woff[recv_seg], (size_t)wlen(rcount),
+          (size_t)wire_chunk,
+          [&, recv_seg](size_t off, size_t len, int c) {
+            workers_->Submit(c, [=] {
+              decode_scale(recv_seg, (int64_t)off, (int64_t)len);
             });
-          });
+          },
+          &legs);
+      Status s = RunLegs(wire_plane_, legs);
       if (!s.ok()) {
-        worker_->Drain();
+        workers_->DrainAll();
         return s;
       }
       continue;
     }
+    tally->BookTx(wlen(scount), scount * 4, 0, HopStripe{});
+    tally->BookRx(wlen(rcount), rcount * 4, 0, HopStripe{});
     Status s = ForEachChunkSpan(
         scount, rcount, chunk_elems,
         [&](int64_t, int64_t soff, int64_t sn, int64_t roff, int64_t rn) {
+          // Elem spans map onto the wire image at codec record
+          // granularity (chunk_elems is block-aligned under int8, so
+          // both offsets are record boundaries).
+          const int64_t swoff = i8 ? (soff / kInt8CodecBlock) *
+                                         (4 + kInt8CodecBlock)
+                                   : soff * 2;
+          const int64_t rwoff = i8 ? (roff / kInt8CodecBlock) *
+                                         (4 + kInt8CodecBlock)
+                                   : roff * 2;
+          const int64_t swl = wlen(soff + sn) - wlen(soff);
+          const int64_t rwl = wlen(roff + rn) - wlen(roff);
           Status t = DuplexTransfer(
-              right_fd(), comp + seg_off[send_seg] + soff,
-              (size_t)(sn * 2), left_fd(),
-              comp + seg_off[recv_seg] + roff, (size_t)(rn * 2));
+              right_fd(), comp + woff[send_seg] + swoff, (size_t)swl,
+              left_fd(), comp + woff[recv_seg] + rwoff, (size_t)rwl);
           if (!t.ok()) return t;
           if (rn > 0) {
-            float* dst = base + seg_off[recv_seg] + roff;
-            const uint16_t* src = comp + seg_off[recv_seg] + roff;
-            worker_->Submit([dst, src, rn, postscale] {
-              DecodeScaleBF16(dst, src, rn, postscale);
+            workers_->Submit(0, [=] {
+              decode_scale(recv_seg, rwoff, rwl);
             });
           }
           return Status::OK();
         });
     if (!s.ok()) {
-      worker_->Drain();
+      workers_->DrainAll();
       return s;
     }
   }
-  worker_->Drain();
+  workers_->DrainAll();
   return Status::OK();
 }
 
@@ -774,22 +1282,22 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
   WireTally tally;
   tally.plane = wire_plane_;
   SetEventWirePlane(wire_plane_);
-  if ((WireCompression() || force_compression_) &&
-      dt == DataType::HVDTPU_FLOAT32 &&
+  const int codec = force_compression_ ? 1 : WireCodec();
+  if (codec != 0 && dt == DataType::HVDTPU_FLOAT32 &&
       (op == ReduceOp::SUM || op == ReduceOp::AVERAGE)) {
-    // Linear ops only: the per-hop bf16 rounding composes with sums
+    // Linear ops only: the per-hop codec rounding composes with sums
     // (full-precision accumulate), and AVERAGE is sum + postscale.
     return CompressedRingAllreduce((float*)buf, seg_count, seg_off,
-                                   postscale, chunk, &tally);
+                                   postscale, chunk, codec, &tally);
   }
-  // Phase 1: ring reduce-scatter, chunk-pipelined (reduce of chunk i-1
-  // overlaps the transfer of chunk i on the worker thread).
+  // Phase 1: ring reduce-scatter, chunk-pipelined (each chunk's reduce
+  // overlaps the remaining transfer on its stripe channel's worker).
   for (int step = 0; step < size_ - 1; step++) {
     int send_seg = RingSendSegment(rank_, step, size_);
     int recv_seg = RingRecvSegment(rank_, step, size_);
     Status s = PipelinedReduceChunks(
-        right_fd(), base + seg_off[send_seg] * elem,
-        seg_count[send_seg] * elem, left_fd(),
+        right_peer(), base + seg_off[send_seg] * elem,
+        seg_count[send_seg] * elem, left_peer(),
         base + seg_off[recv_seg] * elem, seg_count[recv_seg], dt, op, chunk,
         &tally);
     if (!s.ok()) return s;
@@ -800,8 +1308,8 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dt,
     int send_seg = RingSendSegment(rank_, step, size_, /*rot=*/1);
     int recv_seg = RingSendSegment(rank_, step, size_, /*rot=*/0);
     Status s = ChunkedDuplex(
-        right_fd(), base + seg_off[send_seg] * elem,
-        seg_count[send_seg] * elem, left_fd(),
+        right_peer(), base + seg_off[send_seg] * elem,
+        seg_count[send_seg] * elem, left_peer(),
         base + seg_off[recv_seg] * elem, seg_count[recv_seg] * elem, chunk,
         &tally);
     if (!s.ok()) return s;
@@ -828,8 +1336,8 @@ Status DataPlane::Allgatherv(const void* input, void* output,
   for (int step = 0; step < size_ - 1; step++) {
     int send_blk = (rank_ - step + size_) % size_;
     int recv_blk = (rank_ - step - 1 + size_) % size_;
-    Status s = ChunkedDuplex(right_fd(), out + offs[send_blk],
-                             bytes_per_rank[send_blk], left_fd(),
+    Status s = ChunkedDuplex(right_peer(), out + offs[send_blk],
+                             bytes_per_rank[send_blk], left_peer(),
                              out + offs[recv_blk], bytes_per_rank[recv_blk],
                              chunk, &tally);
     if (!s.ok()) return s;
@@ -936,10 +1444,10 @@ Status DataPlane::Alltoallv(const void* input,
   for (int round = 0; round < size_; round++) {
     int partner = (round - rank_ + size_) % size_;
     if (partner == rank_) continue;
-    int fd = peer_fds_[partner];
-    Status s = ChunkedDuplex(fd, in + send_off[partner], send_bytes[partner],
-                             fd, out + recv_off[partner],
-                             recv_bytes[partner], chunk, &tally);
+    Status s = ChunkedDuplex(partner, in + send_off[partner],
+                             send_bytes[partner], partner,
+                             out + recv_off[partner], recv_bytes[partner],
+                             chunk, &tally);
     if (!s.ok()) return s;
   }
   return Status::OK();
@@ -978,14 +1486,14 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
   // `size` contributions at rank r is exactly segment r (the API output
   // segment — see RingOwnedSegment).
   const int own = RingOwnedSegment(rank_, size_, /*rot=*/-1);
-  if ((WireCompression() || force_compression_) &&
-      dt == DataType::HVDTPU_FLOAT32 &&
+  const int codec = force_compression_ ? 1 : WireCodec();
+  if (codec != 0 && dt == DataType::HVDTPU_FLOAT32 &&
       (op == ReduceOp::SUM || op == ReduceOp::AVERAGE)) {
     // Linear ops only, same contract as the compressed allreduce: the
-    // per-hop bf16 rounding composes with sums (full-precision f32
+    // per-hop codec rounding composes with sums (full-precision f32
     // accumulate), AVERAGE is sum + the caller's postscale.
     Status s = CompressedRingReduceScatter((float*)base, elems_per_rank,
-                                           seg_off, chunk, &tally);
+                                           seg_off, chunk, codec, &tally);
     if (!s.ok()) return s;
     std::memcpy(output, base + seg_off[own] * elem,
                 (size_t)(elems_per_rank[own] * elem));
@@ -995,8 +1503,8 @@ Status DataPlane::ReduceScatterv(const void* input, void* output,
     int send_seg = RingSendSegment(rank_, step, size_, /*rot=*/-1);
     int recv_seg = RingRecvSegment(rank_, step, size_, /*rot=*/-1);
     Status s = PipelinedReduceChunks(
-        right_fd(), base + seg_off[send_seg] * elem,
-        elems_per_rank[send_seg] * elem, left_fd(),
+        right_peer(), base + seg_off[send_seg] * elem,
+        elems_per_rank[send_seg] * elem, left_peer(),
         base + seg_off[recv_seg] * elem, elems_per_rank[recv_seg], dt, op,
         chunk, &tally);
     if (!s.ok()) return s;

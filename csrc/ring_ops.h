@@ -48,6 +48,49 @@ void SetRingChunkBytes(int64_t bytes);
 bool WireCompression();
 void SetWireCompression(bool on);
 
+// Wire codec selector behind the compression knob: 0 = none, 1 = bf16
+// (2 bytes/elem, the default when HOROVOD_WIRE_COMPRESSION=1), 2 =
+// int8 blockwise-scaled (1 byte/elem + one f32 scale per
+// kInt8CodecBlock elems — the EQuARX recipe; f32 accumulate, wire
+// ratio ~0.26). HOROVOD_WIRE_COMPRESSION accepts 0/1/2 or the
+// spellings "bf16"/"int8". WireCompression() == (WireCodec() != 0),
+// kept for the existing bool surfaces; SetWireCompression(true)
+// selects bf16.
+constexpr int64_t kInt8CodecBlock = 256;
+int WireCodec();
+void SetWireCodec(int mode);
+
+// Explicit-SIMD toggle for the reduce/codec hot loops (HOROVOD_SIMD,
+// default on; simd.h has the kernels and the bit-identity contract).
+bool SimdEnabled();
+void SetSimdEnabled(bool on);
+
+// ---- bf16/int8 wire codec primitives (the codec seam) ----------------
+// Exposed for the SIMD-vs-scalar bit-identity selftest and the int8
+// codec's span decoders; the compressed ring engines are the only
+// production callers. Encode/decode dispatch to the simd.h kernels
+// when SimdEnabled() (bit-identical by contract, pinned by
+// hvdtpu_simd_selftest).
+void EncodeBF16(uint16_t* dst, const float* src, int64_t n);
+void DecodeAccumBF16(float* dst, const uint16_t* src, int64_t n);
+void DecodeScaleBF16(float* dst, const uint16_t* src, int64_t n,
+                     double post);
+// int8 blockwise codec: the wire image is a sequence of
+// [f32 scale | kInt8CodecBlock int8 quants] records (the last record
+// holds the segment tail). Int8WireLen gives the image size for n
+// elems; encode/decode work on whole records, so chunk boundaries cut
+// at record multiples are self-contained (the striping contract).
+int64_t Int8WireLen(int64_t n);
+void EncodeInt8(uint8_t* dst, const float* src, int64_t n);
+// Decode the record span starting at wire offset `woff` (a record
+// boundary) covering `wlen` wire bytes of a segment of `seg_elems`
+// total elems, accumulating (dst[i] += scale * q) or assigning with
+// the folded postscale. `dst` is the SEGMENT element base.
+void DecodeAccumInt8Span(float* dst, const uint8_t* wire, int64_t woff,
+                         int64_t wlen, int64_t seg_elems);
+void DecodeScaleInt8Span(float* dst, const uint8_t* wire, int64_t woff,
+                         int64_t wlen, int64_t seg_elems, double post);
+
 // ---- ring segment-ownership rotation (ONE place, by design) ----------
 // Every ring reduce phase here walks the same rotation: at step s a rank
 // sends segment (rank - s + rot) mod N and receives segment
@@ -73,18 +116,37 @@ inline int RingOwnedSegment(int rank, int size, int rot = 0) {
   return ((rank + 1 + rot) % size + size) % size;
 }
 
-// Overlap worker: runs ReduceInto / bf16-decode tasks for one data
-// plane while the plane's single caller thread drives the next chunk's
-// DuplexTransfer. The worker never touches the transport, so the
-// wire.h single-caller-thread contract is preserved. Shared between a
-// root DataPlane and its Subset views (one thread per root plane).
-class ReduceWorker;
+// Overlap workers: run ReduceInto / bf16-decode tasks for one data
+// plane while the plane's transfer threads drive the next chunk's
+// DuplexTransfer. Workers never touch the transport. One worker PER
+// STRIPE CHANNEL (chunk i % K reduces on worker i % K), so reduction
+// parallelism scales with the stripe width; the pool is shared between
+// a root DataPlane and its Subset views, and worker threads start
+// lazily on first use. Channel I/O itself runs on transient per-call
+// threads (channel 0 on the caller thread), each owning its channel's
+// fds exclusively for the duration — the wire.h single-caller contract
+// holds per fd.
+class WorkerPool;
 
 class DataPlane {
  public:
   // peer_fds[r] = connected socket to rank r (-1 at index `rank`).
+  // This is stripe channel 0; AdoptExtraChannelFds installs channels
+  // 1..K-1.
   DataPlane(int rank, int size, std::vector<int> peer_fds);
   ~DataPlane();
+
+  // Install the extra stripe channels established at rendezvous:
+  // chan_fds[c][r] = the channel-(c+1) socket to rank r. Owned (and
+  // registered fd->rank/channel) exactly like the primary mesh. The
+  // plane stripes chunked transfers over min(WireChannels(),
+  // 1 + chan_fds.size()) channels — a plane without extra channels
+  // (selftests at K=1, simworld, external transport) is exactly the
+  // single-channel engine.
+  void AdoptExtraChannelFds(std::vector<std::vector<int>> chan_fds);
+
+  // Established stripe channels (sockets per neighbor pair).
+  int channels() const { return 1 + (int)extra_fds_.size(); }
 
   DataPlane(DataPlane&&) = default;
   DataPlane& operator=(DataPlane&&) = default;
@@ -192,29 +254,60 @@ class DataPlane {
 
   struct WireTally;  // per-collective wire/logical byte accumulator
 
-  // One reduce-scatter ring step: send `send_bytes` from `send_buf` while
-  // receiving `recv_count` elements and reducing them into `reduce_dst`,
-  // chunked with the reduce of chunk i-1 overlapped on the worker.
-  Status PipelinedReduceChunks(int send_fd, const uint8_t* send_buf,
-                               int64_t send_bytes, int recv_fd,
+  // Active stripe width for a chunked transfer on this plane:
+  // min(WireChannels(), channels()), forced to 1 on the external
+  // transport and on the bulk (chunk <= 0) path. Rank-uniform because
+  // every input is (knob rides the ResponseList; channels() comes from
+  // the shared env contract).
+  int ActiveStripe(int64_t chunk_bytes) const;
+
+  // Stripe plan of one hop. On a PAIRWISE hop (send peer == recv peer:
+  // the size-2 ring, alltoall partners) every socket would carry both
+  // directions at once, and a duplexed loopback/NIC stream runs far
+  // below two unidirectional ones — so the channel set is split by
+  // direction instead: logical lane i sends on physical channel
+  // 2i + tx_base and receives on 2i + rx_base, with the parity chosen
+  // by group-rank order (both ends derive opposite parities from the
+  // same comparison, so the schedules agree). Width halves (K/2);
+  // each socket runs one-way. Non-pairwise hops use lane i == channel
+  // i at full width.
+  struct HopStripe {
+    int width = 1;
+    bool paired = false;
+    int tx_base = 0, rx_base = 0;
+    int tx_chan(int i) const { return paired ? 2 * i + tx_base : i; }
+    int rx_chan(int i) const { return paired ? 2 * i + rx_base : i; }
+  };
+  HopStripe StripeFor(int send_peer, int recv_peer,
+                      int64_t chunk_bytes) const;
+
+  // One reduce-scatter ring step: send `send_bytes` from `send_buf` to
+  // peer `send_peer` (group index) while receiving `recv_count`
+  // elements from `recv_peer` and reducing them into `reduce_dst`,
+  // chunk-striped over the active channels with each chunk's reduce
+  // overlapped on its channel's worker.
+  Status PipelinedReduceChunks(int send_peer, const uint8_t* send_buf,
+                               int64_t send_bytes, int recv_peer,
                                uint8_t* reduce_dst, int64_t recv_count,
                                DataType dt, ReduceOp op, int64_t chunk_bytes,
                                WireTally* tally);
 
   // Plain chunked duplex (no reduction): allgather phases, alltoall.
-  Status ChunkedDuplex(int send_fd, const uint8_t* send_buf, int64_t send_bytes,
-                       int recv_fd, uint8_t* recv_buf, int64_t recv_bytes,
+  // Peers are group indices (fds resolved per channel).
+  Status ChunkedDuplex(int send_peer, const uint8_t* send_buf,
+                       int64_t send_bytes, int recv_peer,
+                       uint8_t* recv_buf, int64_t recv_bytes,
                        int64_t chunk_bytes, WireTally* tally);
 
-  // fp32 allreduce with bf16 wire encoding: reduce-scatter accumulates
-  // in f32 from per-hop bf16 partials; allgather ships the finalized
-  // (bf16-rounded) segments compressed. `postscale` folds into the
-  // final decode.
+  // fp32 allreduce with a narrow wire codec (1 = bf16, 2 = int8
+  // blockwise-scaled): reduce-scatter accumulates in f32 from per-hop
+  // narrow partials; allgather ships the finalized (codec-rounded)
+  // segments compressed. `postscale` folds into the final decode.
   Status CompressedRingAllreduce(float* base,
                                  const std::vector<int64_t>& seg_count,
                                  const std::vector<int64_t>& seg_off,
                                  double postscale, int64_t chunk_bytes,
-                                 WireTally* tally);
+                                 int codec, WireTally* tally);
 
   // fp32 reduce-scatter with bf16 wire encoding: the N-1 reduce steps of
   // CompressedRingAllreduce, run at the reduce-scatter rotation (rot=-1,
@@ -225,32 +318,46 @@ class DataPlane {
   Status CompressedRingReduceScatter(float* base,
                                      const std::vector<int64_t>& seg_count,
                                      const std::vector<int64_t>& seg_off,
-                                     int64_t chunk_bytes, WireTally* tally);
+                                     int64_t chunk_bytes, int codec,
+                                     WireTally* tally);
 
   // Shared N-1-step compressed reduce phase at rotation `rot` (see
-  // RingSendSegment): bf16 per hop, f32 accumulate, decode overlapped
-  // on the worker. Both compressed engines slice through here.
+  // RingSendSegment): narrow codec per hop, f32 accumulate, decode
+  // overlapped on the per-channel workers. Both compressed engines
+  // slice through here.
   Status CompressedReducePhase(float* base,
                                const std::vector<int64_t>& seg_count,
                                const std::vector<int64_t>& seg_off,
-                               int64_t chunk_elems, int rot,
+                               int64_t chunk_elems, int rot, int codec,
                                WireTally* tally);
 
   int rank_;
   int size_;
-  std::vector<int> peer_fds_;
+  std::vector<int> peer_fds_;          // stripe channel 0
+  // Channels 1..K-1: extra_fds_[c-1][r] = channel-c socket to group
+  // member r. Subset views remap every channel like peer_fds_.
+  std::vector<std::vector<int>> extra_fds_;
   std::vector<int32_t> global_ranks_;  // group index -> global rank
   bool owns_fds_ = true;
   int wire_plane_ = 0;              // 0 intra/flat, 1 cross-slice
   bool force_compression_ = false;  // per-plane bf16-on-wire override
   std::vector<uint8_t> scratch_;        // bulk-path recv segment
   std::vector<uint8_t> chunk_scratch_;  // 2 chunks (double-buffered recv)
-  std::vector<uint8_t> comp_send_scratch_;  // bf16-encoded send chunk
-  std::vector<uint8_t> comp_plane_;  // bf16 allgather plane (count*2 bytes)
-  std::shared_ptr<ReduceWorker> worker_;
+  std::vector<uint8_t> comp_send_scratch_;  // encoded send segment
+  std::vector<uint8_t> comp_plane_;  // encoded allgather plane
+  std::shared_ptr<WorkerPool> workers_;
 
-  int right_fd() const { return peer_fds_[(rank_ + 1) % size_]; }
-  int left_fd() const { return peer_fds_[(rank_ - 1 + size_) % size_]; }
+  int peer_fd(int channel, int peer) const {
+    return channel == 0 ? peer_fds_[peer] : extra_fds_[channel - 1][peer];
+  }
+  int right_peer() const { return (rank_ + 1) % size_; }
+  int left_peer() const { return (rank_ - 1 + size_) % size_; }
+  int right_fd(int channel = 0) const {
+    return peer_fd(channel, right_peer());
+  }
+  int left_fd(int channel = 0) const {
+    return peer_fd(channel, left_peer());
+  }
 };
 
 }  // namespace hvdtpu

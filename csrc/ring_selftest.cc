@@ -19,6 +19,7 @@
 #include <algorithm>
 #include <cmath>
 #include <cstring>
+#include <limits>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -148,6 +149,42 @@ bool BuildMesh(int ranks, std::vector<std::vector<int>>* fds) {
   return true;
 }
 
+// K socketpair meshes (stripe channels): meshes[c][rank] is one rank's
+// fd row for channel c. False on failure (everything built so far is
+// closed).
+bool BuildChannelMeshes(int ranks, int channels,
+                        std::vector<std::vector<std::vector<int>>>* m) {
+  m->resize(channels);
+  for (int c = 0; c < channels; c++) {
+    if (!BuildMesh(ranks, &(*m)[c])) {
+      for (int p = 0; p < c; p++) {
+        for (auto& row : (*m)[p]) {
+          for (int fd : row) TcpClose(fd);
+        }
+      }
+      return false;
+    }
+  }
+  return true;
+}
+
+// Hand rank r its fd rows: channel 0 into the DataPlane ctor, channels
+// 1.. via AdoptExtraChannelFds — exactly how the controller wires the
+// production mesh.
+DataPlane MakePlane(int r, int ranks,
+                    std::vector<std::vector<std::vector<int>>>& meshes) {
+  DataPlane dp(r, ranks, std::move(meshes[0][r]));
+  if (meshes.size() > 1) {
+    std::vector<std::vector<int>> extra;
+    extra.reserve(meshes.size() - 1);
+    for (size_t c = 1; c < meshes.size(); c++) {
+      extra.push_back(std::move(meshes[c][r]));
+    }
+    dp.AdoptExtraChannelFds(std::move(extra));
+  }
+  return dp;
+}
+
 }  // namespace
 }  // namespace hvdtpu
 
@@ -156,7 +193,12 @@ using namespace hvdtpu;
 extern "C" {
 
 // Run one in-process allreduce over `ranks` socketpair-connected data
-// planes with explicit knobs. Returns 0 on success; negative codes:
+// planes with explicit knobs. `channels` = stripe sockets per pair
+// (HOROVOD_WIRE_CHANNELS; <= 1 is the single-channel engine) —
+// striped runs must land on the SAME bits as K=1, because the chunk
+// schedule only changes which socket carries a chunk, never the
+// reduce order. `compression`: 0 none, 1 bf16, 2 int8 blockwise.
+// Returns 0 on success; negative codes:
 //   -1 bad arguments      -2 socketpair() failed
 //   -3 a rank's Allreduce returned an error status
 //   -4 uncompressed result not bit-identical to the ring-order reference
@@ -166,27 +208,33 @@ extern "C" {
 // always writes 0.0.
 int hvdtpu_ring_selftest(int ranks, int64_t count, int dtype, int reduce_op,
                          int64_t chunk_bytes, int compression,
-                         double postscale, double* max_abs_err_out) {
+                         double postscale, int channels,
+                         double* max_abs_err_out) {
   if (max_abs_err_out != nullptr) *max_abs_err_out = 0.0;
-  if (ranks < 1 || ranks > 64 || count < 0 || dtype < 0 || dtype > 9) {
+  if (ranks < 1 || ranks > 64 || count < 0 || dtype < 0 || dtype > 9 ||
+      channels > kMaxWireChannels) {
     return -1;
   }
+  if (channels < 1) channels = 1;
   DataType dt = (DataType)dtype;
   ReduceOp op = (ReduceOp)reduce_op;
   const int64_t elem = DataTypeSize(dt);
 
   std::lock_guard<std::mutex> lock(g_selftest_mutex);
   const int64_t saved_chunk = RingChunkBytes();
-  const bool saved_comp = WireCompression();
+  const int saved_comp = WireCodec();
+  const int64_t saved_chan = WireChannels();
   SetRingChunkBytes(chunk_bytes);
-  SetWireCompression(compression != 0);
+  SetWireCodec(compression);
+  SetWireChannels(channels);
 
-  // Full socketpair mesh (the ring only uses neighbors, but Subset and
-  // future paths index arbitrary peers).
-  std::vector<std::vector<int>> fds;
-  if (!BuildMesh(ranks, &fds)) {
+  // Full socketpair mesh per channel (the ring only uses neighbors,
+  // but Subset and future paths index arbitrary peers).
+  std::vector<std::vector<std::vector<int>>> meshes;
+  if (!BuildChannelMeshes(ranks, channels, &meshes)) {
     SetRingChunkBytes(saved_chunk);
-    SetWireCompression(saved_comp);
+    SetWireCodec(saved_comp);
+    SetWireChannels(saved_chan);
     return -2;
   }
 
@@ -203,13 +251,13 @@ int hvdtpu_ring_selftest(int ranks, int64_t count, int dtype, int reduce_op,
   std::vector<std::vector<uint8_t>> results = inputs;  // reduced in place
   std::vector<Status> statuses(ranks);
   {
-    // Each plane owns its fd row and its own overlap worker; threads
+    // Each plane owns its fd rows and its own worker pool; threads
     // join (and workers drain) before the results are inspected.
     std::vector<std::thread> threads;
     threads.reserve(ranks);
     for (int r = 0; r < ranks; r++) {
       threads.emplace_back([&, r] {
-        DataPlane dp(r, ranks, std::move(fds[r]));
+        DataPlane dp = MakePlane(r, ranks, meshes);
         statuses[r] =
             dp.Allreduce(results[r].data(), count, dt, op, postscale);
       });
@@ -217,7 +265,8 @@ int hvdtpu_ring_selftest(int ranks, int64_t count, int dtype, int reduce_op,
     for (auto& t : threads) t.join();
   }
   SetRingChunkBytes(saved_chunk);
-  SetWireCompression(saved_comp);
+  SetWireCodec(saved_comp);
+  SetWireChannels(saved_chan);
 
   for (int r = 0; r < ranks; r++) {
     if (!statuses[r].ok()) {
@@ -269,27 +318,33 @@ int hvdtpu_ring_selftest(int ranks, int64_t count, int dtype, int reduce_op,
 int hvdtpu_hier_selftest(int ranks, int local_size, int64_t count,
                          int dtype, int reduce_op, int64_t chunk_bytes,
                          int compression, int exact_fill,
-                         double postscale, double* max_abs_err_out) {
+                         double postscale, int channels,
+                         double* max_abs_err_out) {
   if (max_abs_err_out != nullptr) *max_abs_err_out = 0.0;
   if (ranks < 1 || ranks > 64 || count < 0 || dtype < 0 || dtype > 9 ||
-      local_size < 1 || ranks % local_size != 0) {
+      local_size < 1 || ranks % local_size != 0 ||
+      channels > kMaxWireChannels) {
     return -1;
   }
+  if (channels < 1) channels = 1;
   DataType dt = (DataType)dtype;
   ReduceOp op = (ReduceOp)reduce_op;
   const int64_t elem = DataTypeSize(dt);
 
   std::lock_guard<std::mutex> lock(g_selftest_mutex);
   const int64_t saved_chunk = RingChunkBytes();
-  const bool saved_comp = WireCompression();
+  const int saved_comp = WireCodec();
+  const int64_t saved_chan = WireChannels();
   SetRingChunkBytes(chunk_bytes);
-  SetWireCompression(compression == 1);
+  SetWireCodec(compression == 1 ? 1 : 0);
+  SetWireChannels(channels);
   const bool compress_cross = compression == 2;
 
-  std::vector<std::vector<int>> fds;
-  if (!BuildMesh(ranks, &fds)) {
+  std::vector<std::vector<std::vector<int>>> meshes;
+  if (!BuildChannelMeshes(ranks, channels, &meshes)) {
     SetRingChunkBytes(saved_chunk);
-    SetWireCompression(saved_comp);
+    SetWireCodec(saved_comp);
+    SetWireChannels(saved_chan);
     return -2;
   }
 
@@ -313,7 +368,7 @@ int hvdtpu_hier_selftest(int ranks, int local_size, int64_t count,
     threads.reserve(ranks);
     for (int r = 0; r < ranks; r++) {
       threads.emplace_back([&, r] {
-        DataPlane dp(r, ranks, std::move(fds[r]));
+        DataPlane dp = MakePlane(r, ranks, meshes);
         statuses[r] = dp.HierarchicalAllreduce(
             results[r].data(), count, dt, op, local_size, postscale,
             compress_cross);
@@ -322,7 +377,8 @@ int hvdtpu_hier_selftest(int ranks, int local_size, int64_t count,
     for (auto& t : threads) t.join();
   }
   SetRingChunkBytes(saved_chunk);
-  SetWireCompression(saved_comp);
+  SetWireCodec(saved_comp);
+  SetWireChannels(saved_chan);
 
   for (int r = 0; r < ranks; r++) {
     if (!statuses[r].ok()) return -3;
@@ -345,6 +401,144 @@ int hvdtpu_hier_selftest(int ranks, int local_size, int64_t count,
     }
   }
   if (max_abs_err_out != nullptr) *max_abs_err_out = max_err;
+  return rc;
+}
+
+// int8 codec roundtrip (encode -> wire image -> decode-with-postscale)
+// over a caller buffer, for the Python-side numerics pins the striped
+// matrix can't reach (NaN poison, scale/2 bounds): returns the wire
+// image length, or -1 on bad args. `out` receives the decoded segment.
+int64_t hvdtpu_int8_roundtrip(const float* src, int64_t n, float* out,
+                              double postscale) {
+  if (src == nullptr || out == nullptr || n < 0) return -1;
+  const int64_t wlen = Int8WireLen(n);
+  std::vector<uint8_t> wire((size_t)wlen);
+  EncodeInt8(wire.data(), src, n);
+  DecodeScaleInt8Span(out, wire.data(), 0, wlen, n, postscale);
+  return wlen;
+}
+
+// Pin the explicit-SIMD kernels (csrc/simd.h) BIT-IDENTICAL to the
+// scalar reference paths across unaligned start offsets and tail
+// lengths, including non-finite values through the bf16 codec. Runs
+// each kernel twice — HOROVOD_SIMD on, then forced scalar — over the
+// same bytes and memcmps. Returns 0, or a negative code naming the
+// first divergent kernel:
+//   -2 ReduceInto f32 SUM        -3 ReduceInto bf16 SUM
+//   -4 EncodeBF16                -5 DecodeAccumBF16
+//   -6 DecodeScaleBF16           -7 ScaleBuffer f32
+int hvdtpu_simd_selftest() {
+  std::lock_guard<std::mutex> lock(g_selftest_mutex);
+  const bool saved = SimdEnabled();
+  const int64_t lens[] = {0, 1, 7, 8, 9, 15, 16, 17, 31, 64, 1000, 1025};
+  int rc = 0;
+  // Base buffers with deterministic fills plus specials the codec
+  // rounding must preserve (signed zero, inf, NaN, denormal).
+  const int64_t kMax = 1025 + 16;
+  std::vector<float> fa(kMax), fb(kMax);
+  std::vector<uint16_t> ha(kMax), hb(kMax);
+  for (int64_t i = 0; i < kMax; i++) {
+    fa[i] = (float)FillValue(0, i);
+    fb[i] = (float)FillValue(1, i);
+    ha[i] = FloatToBF16Bits((float)FillValue(2, i));
+    hb[i] = FloatToBF16Bits((float)FillValue(3, i));
+  }
+  const float specials[] = {0.0f, -0.0f, 1e30f, -1e30f,
+                            std::numeric_limits<float>::infinity(),
+                            -std::numeric_limits<float>::infinity(),
+                            std::numeric_limits<float>::quiet_NaN(),
+                            1e-42f};
+  for (size_t i = 0; i < sizeof(specials) / sizeof(specials[0]); i++) {
+    fa[7 + 13 * i] = specials[i];
+    fb[11 + 17 * i] = specials[i];
+  }
+  for (int64_t n : lens) {
+    for (int64_t off = 0; off < 9 && rc == 0; off++) {
+      // (1) ReduceInto f32 SUM.
+      {
+        std::vector<float> d1(fa.begin() + off, fa.begin() + off + n);
+        std::vector<float> d2 = d1;
+        SetSimdEnabled(true);
+        ReduceInto(d1.data(), fb.data() + off, n,
+                   DataType::HVDTPU_FLOAT32, ReduceOp::SUM);
+        SetSimdEnabled(false);
+        ReduceInto(d2.data(), fb.data() + off, n,
+                   DataType::HVDTPU_FLOAT32, ReduceOp::SUM);
+        if (n && std::memcmp(d1.data(), d2.data(), (size_t)n * 4)) {
+          rc = -2;
+          break;
+        }
+      }
+      // (2) ReduceInto bf16 SUM.
+      {
+        std::vector<uint16_t> d1(ha.begin() + off, ha.begin() + off + n);
+        std::vector<uint16_t> d2 = d1;
+        SetSimdEnabled(true);
+        ReduceInto(d1.data(), hb.data() + off, n,
+                   DataType::HVDTPU_BFLOAT16, ReduceOp::SUM);
+        SetSimdEnabled(false);
+        ReduceInto(d2.data(), hb.data() + off, n,
+                   DataType::HVDTPU_BFLOAT16, ReduceOp::SUM);
+        if (n && std::memcmp(d1.data(), d2.data(), (size_t)n * 2)) {
+          rc = -3;
+          break;
+        }
+      }
+      // (3) EncodeBF16 (specials included: NaN quieting, inf carry).
+      {
+        std::vector<uint16_t> e1(n ? n : 1), e2(n ? n : 1);
+        SetSimdEnabled(true);
+        EncodeBF16(e1.data(), fa.data() + off, n);
+        SetSimdEnabled(false);
+        EncodeBF16(e2.data(), fa.data() + off, n);
+        if (n && std::memcmp(e1.data(), e2.data(), (size_t)n * 2)) {
+          rc = -4;
+          break;
+        }
+      }
+      // (4) DecodeAccumBF16.
+      {
+        std::vector<float> d1(fa.begin() + off, fa.begin() + off + n);
+        std::vector<float> d2 = d1;
+        SetSimdEnabled(true);
+        DecodeAccumBF16(d1.data(), ha.data() + off, n);
+        SetSimdEnabled(false);
+        DecodeAccumBF16(d2.data(), ha.data() + off, n);
+        if (n && std::memcmp(d1.data(), d2.data(), (size_t)n * 4)) {
+          rc = -5;
+          break;
+        }
+      }
+      // (5) DecodeScaleBF16, identity and folded postscale.
+      for (double post : {1.0, 0.25, 1.0 / 3.0}) {
+        std::vector<float> d1(n ? n : 1), d2(n ? n : 1);
+        SetSimdEnabled(true);
+        DecodeScaleBF16(d1.data(), ha.data() + off, n, post);
+        SetSimdEnabled(false);
+        DecodeScaleBF16(d2.data(), ha.data() + off, n, post);
+        if (n && std::memcmp(d1.data(), d2.data(), (size_t)n * 4)) {
+          rc = -6;
+          break;
+        }
+      }
+      if (rc != 0) break;
+      // (6) ScaleBuffer f32 (the double-multiply rounding contract).
+      {
+        std::vector<float> d1(fa.begin() + off, fa.begin() + off + n);
+        std::vector<float> d2 = d1;
+        SetSimdEnabled(true);
+        ScaleBuffer(d1.data(), n, DataType::HVDTPU_FLOAT32, 0.3);
+        SetSimdEnabled(false);
+        ScaleBuffer(d2.data(), n, DataType::HVDTPU_FLOAT32, 0.3);
+        if (n && std::memcmp(d1.data(), d2.data(), (size_t)n * 4)) {
+          rc = -7;
+          break;
+        }
+      }
+    }
+    if (rc != 0) break;
+  }
+  SetSimdEnabled(saved);
   return rc;
 }
 

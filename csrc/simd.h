@@ -1,0 +1,174 @@
+// Explicit SIMD paths for the host data plane's hot loops: f32/bf16
+// accumulation (ReduceInto) and the bf16 wire codec. Built on GCC/Clang
+// portable vector extensions — the compiler lowers 8-lane ops to
+// whatever the target offers (AVX2 on x86-64, paired NEON on aarch64,
+// synthesized scalar otherwise), and every lane op is the IEEE scalar
+// op, so results are BIT-IDENTICAL to the scalar reference loops on
+// every target (pinned by hvdtpu_simd_selftest across unaligned
+// offsets and tail lengths). Loads/stores go through memcpy into
+// vector temporaries, which lowers to unaligned vector moves —
+// alignment-safe by construction; tails run the scalar reference.
+//
+// HOROVOD_SIMD=0 (or SetSimdEnabled(false)) forces the scalar paths at
+// runtime — the fallback the bit-identity pins compare against, and
+// the escape hatch if a target's vector lowering ever misbehaves.
+//
+// Reference analog: none upstream — horovod's CPU reductions lean on
+// MPI; NCCL's reduce kernels are the spiritual ancestor (vectorized
+// elementwise reduce folded into the transport pipeline).
+
+#ifndef HVDTPU_SIMD_H
+#define HVDTPU_SIMD_H
+
+#include <cstdint>
+#include <cstring>
+
+#include "half.h"
+
+namespace hvdtpu {
+
+// Runtime SIMD toggle (HOROVOD_SIMD, default on) — ring_ops.cc owns
+// the atomic; declared here so the kernels and their call sites share
+// one switch.
+bool SimdEnabled();
+void SetSimdEnabled(bool on);
+
+// GCC warns that passing 32-byte vectors by value has a different ABI
+// with/without AVX (-Wpsabi). Every vector-typed function here is
+// inline and internal to one TU — no cross-TU vector ABI exists to
+// break — so the warning is noise by construction. It fires at the
+// INSTANTIATION site (end of the including TU), so the suppression is
+// deliberately not push/pop'd: it must cover the whole TU.
+#pragma GCC diagnostic ignored "-Wpsabi"
+
+namespace simd {
+
+constexpr int kLanes = 8;
+
+typedef float Vf32 __attribute__((vector_size(32)));
+typedef uint32_t Vu32 __attribute__((vector_size(32)));
+typedef uint16_t Vu16 __attribute__((vector_size(16)));
+typedef double Vf64 __attribute__((vector_size(64)));  // 8 x f64
+
+inline Vf32 LoadF32(const float* p) {
+  Vf32 v;
+  std::memcpy(&v, p, sizeof(v));
+  return v;
+}
+inline void StoreF32(float* p, Vf32 v) { std::memcpy(p, &v, sizeof(v)); }
+inline Vu32 LoadU32(const void* p) {
+  Vu32 v;
+  std::memcpy(&v, p, sizeof(v));
+  return v;
+}
+inline Vu16 LoadU16(const uint16_t* p) {
+  Vu16 v;
+  std::memcpy(&v, p, sizeof(v));
+  return v;
+}
+inline void StoreU16(uint16_t* p, Vu16 v) {
+  std::memcpy(p, &v, sizeof(v));
+}
+
+// f32 bit pattern -> bf16 bits, 8 lanes: the exact FloatToBF16Bits
+// sequence (quiet-NaN force, else round-to-nearest-even via the
+// +0x7FFF+lsb carry trick) applied per lane.
+inline Vu16 Bf16FromF32Bits(Vu32 f) {
+  Vu32 is_nan = (Vu32)((f & 0x7F800000u) == 0x7F800000u) &
+                (Vu32)((f & 0x007FFFFFu) != 0u);
+  Vu32 lsb = (f >> 16) & 1u;
+  Vu32 rounded = (f + 0x7FFFu + lsb) >> 16;
+  Vu32 nan_bits = (f >> 16) | 0x40u;
+  Vu32 r = (is_nan & nan_bits) | (~is_nan & rounded);
+  return __builtin_convertvector(r, Vu16);
+}
+
+// bf16 bits -> f32, 8 lanes (exact: left shift into the exponent).
+inline Vf32 F32FromBf16Bits(Vu16 h) {
+  Vu32 w = __builtin_convertvector(h, Vu32) << 16;
+  return (Vf32)w;
+}
+
+// dst[i] += src[i], f32. Per-lane IEEE add == the scalar loop.
+inline void AddF32(float* dst, const float* src, int64_t n) {
+  int64_t i = 0;
+  for (; i + kLanes <= n; i += kLanes) {
+    StoreF32(dst + i, LoadF32(dst + i) + LoadF32(src + i));
+  }
+  for (; i < n; i++) dst[i] = dst[i] + src[i];
+}
+
+// bf16 SUM: widen both sides to f32, add, re-encode — the
+// ReduceHalfLike<FloatToBF16Bits, BF16BitsToFloat> SUM sequence.
+inline void ReduceSumBF16(uint16_t* dst, const uint16_t* src, int64_t n) {
+  int64_t i = 0;
+  for (; i + kLanes <= n; i += kLanes) {
+    Vf32 a = F32FromBf16Bits(LoadU16(dst + i));
+    Vf32 b = F32FromBf16Bits(LoadU16(src + i));
+    Vf32 r = a + b;
+    StoreU16(dst + i, Bf16FromF32Bits((Vu32)r));
+  }
+  for (; i < n; i++) {
+    dst[i] = FloatToBF16Bits(BF16BitsToFloat(dst[i]) +
+                             BF16BitsToFloat(src[i]));
+  }
+}
+
+// bf16 wire encode (EncodeBF16's loop).
+inline void EncodeBF16(uint16_t* dst, const float* src, int64_t n) {
+  int64_t i = 0;
+  for (; i + kLanes <= n; i += kLanes) {
+    StoreU16(dst + i, Bf16FromF32Bits(LoadU32(src + i)));
+  }
+  for (; i < n; i++) dst[i] = FloatToBF16Bits(src[i]);
+}
+
+// bf16 wire decode + f32 accumulate (DecodeAccumBF16's loop).
+inline void DecodeAccumBF16(float* dst, const uint16_t* src, int64_t n) {
+  int64_t i = 0;
+  for (; i + kLanes <= n; i += kLanes) {
+    StoreF32(dst + i, LoadF32(dst + i) + F32FromBf16Bits(LoadU16(src + i)));
+  }
+  for (; i < n; i++) dst[i] += BF16BitsToFloat(src[i]);
+}
+
+// bf16 wire decode with folded postscale: the post != 1.0 lane math is
+// (float)((double)x * post) exactly like the scalar reference — widen
+// to f64, multiply once, narrow once.
+inline void DecodeScaleBF16(float* dst, const uint16_t* src, int64_t n,
+                            double post) {
+  int64_t i = 0;
+  if (post == 1.0) {
+    for (; i + kLanes <= n; i += kLanes) {
+      StoreF32(dst + i, F32FromBf16Bits(LoadU16(src + i)));
+    }
+    for (; i < n; i++) dst[i] = BF16BitsToFloat(src[i]);
+    return;
+  }
+  for (; i + kLanes <= n; i += kLanes) {
+    Vf64 d = __builtin_convertvector(F32FromBf16Bits(LoadU16(src + i)),
+                                     Vf64);
+    d = d * post;
+    StoreF32(dst + i, __builtin_convertvector(d, Vf32));
+  }
+  for (; i < n; i++) {
+    dst[i] = (float)((double)BF16BitsToFloat(src[i]) * post);
+  }
+}
+
+// f32 in-place scale (ScaleBuffer's f32 case: double multiply, one
+// f32 rounding per element).
+inline void ScaleF32(float* p, int64_t n, double factor) {
+  int64_t i = 0;
+  for (; i + kLanes <= n; i += kLanes) {
+    Vf64 d = __builtin_convertvector(LoadF32(p + i), Vf64);
+    d = d * factor;
+    StoreF32(p + i, __builtin_convertvector(d, Vf32));
+  }
+  for (; i < n; i++) p[i] = (float)(p[i] * factor);
+}
+
+}  // namespace simd
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_SIMD_H

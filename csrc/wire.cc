@@ -42,12 +42,21 @@ std::atomic<int64_t> g_wire_retry_attempts{-2};  // -2 = uninitialized
 std::atomic<int64_t> g_wire_retry_backoff_ms{-2};
 std::atomic<int> g_wire_crc{-1};  // -1 = uninitialized
 
+// Active stripe width (wire.h). -1 = not yet initialized from
+// HOROVOD_WIRE_CHANNELS; the established socket count is resolved
+// separately (WireChannelsEnv) so a tuned-down active width can never
+// shrink what a re-formation provisions.
+std::atomic<int64_t> g_wire_channels{-1};
+
 // Chaos: flip one bit of the next CRC-framed outgoing data chunk
-// (ArmWireFlip). Relaxed atomics: armed by the background thread that
-// also runs the transfers.
+// (ArmWireFlip). Relaxed atomics: armed by the background thread; with
+// striping the frames are built by per-channel transfer threads, so
+// the optional channel filter is what keeps the skip count
+// deterministic (channel-blind counting would race across stripes).
 std::atomic<int64_t> g_flip_bit{-1};
 std::atomic<bool> g_flip_persistent{false};
 std::atomic<int64_t> g_flip_skip{0};
+std::atomic<int64_t> g_flip_channel{-1};
 
 int64_t EnvInt64OrDefault(const char* name, int64_t dflt) {
   const char* env = std::getenv(name);
@@ -57,11 +66,16 @@ int64_t EnvInt64OrDefault(const char* name, int64_t dflt) {
   return end != env ? parsed : dflt;
 }
 
-// fd -> global rank, for peer attribution in timeout/EOF statuses.
-// Registered by the controller (control fds) and the root data plane;
-// small and cold (touched at plane setup and on failure paths only).
+// fd -> (global rank, stripe channel), for peer attribution in
+// timeout/EOF statuses and channel-targeted chaos. Registered by the
+// controller (control fds) and the root data plane; small and cold
+// (touched at plane setup and on failure paths only).
 std::mutex g_fd_rank_mutex;
-std::unordered_map<int, int> g_fd_ranks;
+struct FdInfo {
+  int rank = -1;
+  int channel = 0;
+};
+std::unordered_map<int, FdInfo> g_fd_ranks;
 
 // External-transport failures name the peer directly from the fd
 // encoding: a callback error means that peer's mailbox is gone.
@@ -231,6 +245,34 @@ void SetWireRetryBackoffMs(int64_t ms) {
                                 std::memory_order_relaxed);
 }
 
+int WireChannelsEnv() {
+  // Process-lifetime: the established socket count must be the same at
+  // init and every reinit, whatever the tuner did to the active width
+  // in between.
+  static const int k = [] {
+    int64_t v = EnvInt64OrDefault("HOROVOD_WIRE_CHANNELS", 1);
+    if (v < 1) v = 1;
+    if (v > kMaxWireChannels) v = kMaxWireChannels;
+    return (int)v;
+  }();
+  return k;
+}
+
+int64_t WireChannels() {
+  int64_t v = g_wire_channels.load(std::memory_order_relaxed);
+  if (v == -1) {
+    v = WireChannelsEnv();
+    g_wire_channels.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+void SetWireChannels(int64_t k) {
+  if (k < 1) k = 1;
+  if (k > kMaxWireChannels) k = kMaxWireChannels;
+  g_wire_channels.store(k, std::memory_order_relaxed);
+}
+
 bool WireCrc() {
   int v = g_wire_crc.load(std::memory_order_relaxed);
   if (v == -1) {
@@ -267,16 +309,18 @@ uint32_t Crc32c(const void* data, size_t len) {
   return crc ^ 0xFFFFFFFFu;
 }
 
-void ArmWireFlip(int64_t bit, bool persistent, int64_t skip) {
+void ArmWireFlip(int64_t bit, bool persistent, int64_t skip,
+                 int64_t channel) {
   g_flip_persistent.store(persistent, std::memory_order_relaxed);
   g_flip_skip.store(skip, std::memory_order_relaxed);
+  g_flip_channel.store(channel, std::memory_order_relaxed);
   g_flip_bit.store(bit, std::memory_order_relaxed);
 }
 
-void RegisterFdRank(int fd, int rank) {
+void RegisterFdRank(int fd, int rank, int channel) {
   if (fd < 0) return;  // external fds self-encode their peer
   std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
-  g_fd_ranks[fd] = rank;
+  g_fd_ranks[fd] = {rank, channel};
 }
 
 void UnregisterFdRank(int fd) {
@@ -290,14 +334,25 @@ int FdRank(int fd) {
   if (fd < 0) return -1;
   std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
   auto it = g_fd_ranks.find(fd);
-  return it == g_fd_ranks.end() ? -1 : it->second;
+  return it == g_fd_ranks.end() ? -1 : it->second.rank;
 }
 
-std::vector<int> RegisteredFds() {
+int FdChannel(int fd) {
+  if (fd < 0) return 0;  // external transport never stripes
+  std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
+  auto it = g_fd_ranks.find(fd);
+  return it == g_fd_ranks.end() ? 0 : it->second.channel;
+}
+
+std::vector<int> RegisteredFds(int channel) {
   std::lock_guard<std::mutex> lk(g_fd_rank_mutex);
   std::vector<int> fds;
   fds.reserve(g_fd_ranks.size());
-  for (auto& kv : g_fd_ranks) fds.push_back(kv.first);
+  for (auto& kv : g_fd_ranks) {
+    if (channel < 0 || kv.second.channel == channel) {
+      fds.push_back(kv.first);
+    }
+  }
   return fds;
 }
 
@@ -580,13 +635,26 @@ struct CrcIncoming {
   uint8_t* pay_dst = nullptr;
 };
 
+// Chunks of one channel's stripe subsequence: ceil over the global
+// chunk count of the indices congruent to `channel` mod `stripe_k`.
+size_t StripeChunkCount(size_t nchunks, int stripe_k, int channel) {
+  if ((size_t)channel >= nchunks) return 0;
+  return (nchunks - (size_t)channel + (size_t)stripe_k - 1) /
+         (size_t)stripe_k;
+}
+
 Status DuplexCrcTransfer(
     int send_fd, const uint8_t* send_buf, size_t send_len, int recv_fd,
-    uint8_t* recv_buf, size_t recv_len, size_t chunk,
+    uint8_t* recv_buf, size_t recv_len, size_t chunk, int stripe_k,
+    int channel,
     const std::function<void(size_t off, size_t len)>& on_chunk) {
   if (chunk == 0) chunk = std::max(send_len, recv_len);
+  // Chunk indices are GLOBAL; this call owns those congruent to
+  // `channel` mod `stripe_k` of both directions (everything at K=1).
   const size_t ns = send_len ? (send_len + chunk - 1) / chunk : 0;
   const size_t nr = recv_len ? (recv_len + chunk - 1) / chunk : 0;
+  const size_t ns_mine = StripeChunkCount(ns, stripe_k, channel);
+  const size_t nr_mine = StripeChunkCount(nr, stripe_k, channel);
 
   struct Slot {
     int fd = -1;
@@ -603,21 +671,22 @@ Status DuplexCrcTransfer(
     slots[nslots].fd = fd;
     return &slots[nslots++];
   };
-  Slot* ssend = ns > 0 ? slot_for(send_fd) : nullptr;
+  Slot* ssend = ns_mine > 0 ? slot_for(send_fd) : nullptr;
   if (ssend != nullptr) ssend->send_role = true;
-  Slot* srecv = nr > 0 ? slot_for(recv_fd) : nullptr;
+  Slot* srecv = nr_mine > 0 ? slot_for(recv_fd) : nullptr;
   if (srecv != nullptr) srecv->recv_role = true;
   if (nslots == 0) return Status::OK();
 
+  // Indexed by GLOBAL chunk idx; only this channel's entries move.
   std::vector<uint8_t> verified(nr, 0);
   std::vector<int64_t> failures(nr, 0);
   size_t n_verified = 0;
-  bool peer_done = ns == 0;  // nothing sent -> no ack expected
+  bool peer_done = ns_mine == 0;  // nothing sent -> no ack expected
   const int64_t max_fails = 1 + WireRetryAttempts();
   Metrics& m = GlobalMetrics();
 
   if (ssend != nullptr) {
-    for (size_t i = 0; i < ns; i++) {
+    for (size_t i = (size_t)channel; i < ns; i += (size_t)stripe_k) {
       ssend->out.q.push_back({kCrcData, (uint32_t)i});
     }
   }
@@ -647,6 +716,9 @@ Status DuplexCrcTransfer(
       const uint8_t* pay = send_buf + (size_t)f.idx * chunk;
       uint32_t crc = Crc32c(pay, len);
       int64_t bit = g_flip_bit.load(std::memory_order_relaxed);
+      const int64_t flip_chan =
+          g_flip_channel.load(std::memory_order_relaxed);
+      if (flip_chan >= 0 && flip_chan != channel) bit = -1;
       if (bit >= 0 && len > 0) {
         if (g_flip_skip.load(std::memory_order_relaxed) > 0) {
           g_flip_skip.fetch_sub(1, std::memory_order_relaxed);
@@ -724,7 +796,7 @@ Status DuplexCrcTransfer(
   // are met), and draining them here would corrupt that call's frames.
   auto slot_satisfied = [&](Slot* s) {
     return (!s->send_role || peer_done) &&
-           (!s->recv_role || n_verified >= nr);
+           (!s->recv_role || n_verified >= nr_mine);
   };
 
   // Dispatch complete frames until the socket would block or the slot
@@ -787,11 +859,13 @@ Status DuplexCrcTransfer(
         if (blocked) return true;
         in.idx = LoadLE32(in.hdr);
         if (in.type == kCrcNak) {
-          if (ssend == nullptr || (size_t)in.idx >= ns) {
+          if (ssend == nullptr || (size_t)in.idx >= ns ||
+              in.idx % (uint32_t)stripe_k != (uint32_t)channel) {
             *st = Status::Error("crc duplex: NAK for chunk " +
                                 std::to_string(in.idx) +
                                 " of a " + std::to_string(ns) +
-                                "-chunk transfer");
+                                "-chunk transfer (channel " +
+                                std::to_string(channel) + ")");
             return false;
           }
           ssend->out.q.push_back({kCrcData, in.idx});
@@ -800,7 +874,8 @@ Status DuplexCrcTransfer(
           in.stage = 0;
           continue;
         }
-        if (!s->recv_role || (size_t)in.idx >= nr) {
+        if (!s->recv_role || (size_t)in.idx >= nr ||
+            in.idx % (uint32_t)stripe_k != (uint32_t)channel) {
           *st = Status::Error("crc duplex: data chunk " +
                               std::to_string(in.idx) +
                               " outside the expected " +
@@ -844,10 +919,13 @@ Status DuplexCrcTransfer(
             GlobalEvents().Record(EventType::kWireHeal);
           }
           GlobalEvents().Record(EventType::kWireChunk, EventWirePlane(),
-                                1, (int64_t)in.idx * (int64_t)chunk,
+                                (int32_t)((channel << 1) | 1),
+                                (int64_t)in.idx * (int64_t)chunk,
                                 (int64_t)in.pay_len);
           if (on_chunk) on_chunk((size_t)in.idx * chunk, in.pay_len);
-          if (n_verified == nr) srecv->out.q.push_back({kCrcDone, 0});
+          if (n_verified == nr_mine) {
+            srecv->out.q.push_back({kCrcDone, 0});
+          }
         }
         continue;
       }
@@ -878,9 +956,10 @@ Status DuplexCrcTransfer(
   const int64_t timeout_ms = WireTimeoutMs();
   Status st = Status::OK();
   while (true) {
-    const bool send_side_done = ns == 0 || peer_done;
+    const bool send_side_done = ns_mine == 0 || peer_done;
     const bool recv_side_done =
-        nr == 0 || (n_verified == nr && srecv->out.done_flushed);
+        nr_mine == 0 ||
+        (n_verified == nr_mine && srecv->out.done_flushed);
     if (send_side_done && recv_side_done) return Status::OK();
     pollfd fds[2];
     Slot* by[2];
@@ -889,7 +968,7 @@ Status DuplexCrcTransfer(
       Slot& s = slots[i];
       short ev = 0;
       if (s.out.active || !s.out.q.empty()) ev |= POLLOUT;
-      if ((s.recv_role && n_verified < nr) ||
+      if ((s.recv_role && n_verified < nr_mine) ||
           (s.send_role && !peer_done)) {
         ev |= POLLIN;
       }
@@ -908,8 +987,9 @@ Status DuplexCrcTransfer(
       return Status::Error(std::string("poll failed: ") + strerror(errno));
     }
     if (rc == 0) {
-      return PeerTimeout(nr > 0 && n_verified < nr ? recv_fd : send_fd,
-                         "crc duplex transfer", timeout_ms);
+      return PeerTimeout(
+          nr_mine > 0 && n_verified < nr_mine ? recv_fd : send_fd,
+          "crc duplex transfer", timeout_ms);
     }
     for (int i = 0; i < n; i++) {
       if (fds[i].revents & (POLLOUT | POLLERR)) {
@@ -943,8 +1023,48 @@ Status DuplexTransferChunked(
     int send_fd, const void* send_buf, size_t send_len, int recv_fd,
     void* recv_buf, size_t recv_len, size_t chunk,
     const std::function<void(size_t off, size_t len)>& on_chunk) {
+  return DuplexTransferStriped(send_fd, send_buf, send_len, recv_fd,
+                               recv_buf, recv_len, chunk, 1, 0, on_chunk);
+}
+
+namespace {
+// Walks one channel's chunk subsequence of one direction: global chunk
+// indices congruent to `channel` mod `stripe_k`, in index order. Both
+// ends derive the identical schedule from (len, chunk, K), so the
+// channel's byte stream needs no extra framing — the K=1 walk is
+// byte-for-byte the legacy contiguous stream.
+struct StripeCursor {
+  size_t total, chunk, nchunks;
+  size_t k, idx;   // stride and current global chunk index
+  size_t done = 0; // bytes complete of the current chunk
+  StripeCursor(size_t total, size_t chunk, int stripe_k, int channel)
+      : total(total), chunk(chunk),
+        nchunks(total ? (total + chunk - 1) / chunk : 0),
+        k((size_t)stripe_k), idx((size_t)channel) {}
+  bool finished() const { return idx >= nchunks; }
+  size_t off() const { return idx * chunk; }
+  size_t len() const { return std::min(chunk, total - off()); }
+  size_t remaining() const { return len() - done; }
+  // Advance past `n` more bytes of the current chunk; returns true
+  // when that completed the chunk (cursor moved to the next one).
+  bool Advance(size_t n) {
+    done += n;
+    if (done < len()) return false;
+    done = 0;
+    idx += k;
+    return true;
+  }
+};
+}  // namespace
+
+Status DuplexTransferStriped(
+    int send_fd, const void* send_buf, size_t send_len, int recv_fd,
+    void* recv_buf, size_t recv_len, size_t chunk, int stripe_k,
+    int channel,
+    const std::function<void(size_t off, size_t len)>& on_chunk) {
   if (IsExtFd(send_fd) || IsExtFd(recv_fd)) {
-    // Message transports frame per send: chunk boundaries there are the
+    // Message transports frame per send and never stripe (the data
+    // plane forces K=1 on them): chunk boundaries there are the
     // CALLER's business (equal-length paired messages); this fallback
     // keeps the entry safe if one slips through.
     Status s =
@@ -958,23 +1078,28 @@ Status DuplexTransferChunked(
     // (wire.h). Chunk 0 degrades to one whole-segment frame.
     return DuplexCrcTransfer(send_fd, (const uint8_t*)send_buf, send_len,
                              recv_fd, (uint8_t*)recv_buf, recv_len, chunk,
-                             on_chunk);
+                             stripe_k, channel, on_chunk);
   }
-  ScopedNonblock nb(send_fd, recv_fd);
-  const int64_t timeout_ms = WireTimeoutMs();
+  if (chunk == 0) chunk = std::max(send_len, recv_len);
+  if (chunk == 0) return Status::OK();
   const char* sp = (const char*)send_buf;
   char* rp = (char*)recv_buf;
-  size_t sent = 0, recvd = 0, fired = 0;
-  while (sent < send_len || recvd < recv_len) {
+  StripeCursor snd(send_len, chunk, stripe_k, channel);
+  StripeCursor rcv(recv_len, chunk, stripe_k, channel);
+  if (snd.finished() && rcv.finished()) return Status::OK();
+  ScopedNonblock nb(snd.finished() ? -1 : send_fd,
+                    rcv.finished() ? -1 : recv_fd);
+  const int64_t timeout_ms = WireTimeoutMs();
+  while (!snd.finished() || !rcv.finished()) {
     pollfd fds[2];
     int n = 0;
     int send_idx = -1, recv_idx = -1;
-    if (sent < send_len) {
+    if (!snd.finished()) {
       fds[n].fd = send_fd;
       fds[n].events = POLLOUT;
       send_idx = n++;
     }
-    if (recvd < recv_len) {
+    if (!rcv.finished()) {
       fds[n].fd = recv_fd;
       fds[n].events = POLLIN;
       recv_idx = n++;
@@ -991,33 +1116,39 @@ Status DuplexTransferChunked(
                          "duplex transfer", timeout_ms);
     }
     if (send_idx >= 0 && (fds[send_idx].revents & (POLLOUT | POLLERR))) {
-      ssize_t k = send(send_fd, sp + sent, send_len - sent, MSG_NOSIGNAL);
-      if (k < 0 && errno != EINTR && errno != EAGAIN) {
-        return PeerIoError(send_fd, "duplex send");
+      // Stream until the socket would block: successive chunks of this
+      // channel are sent back to back (at K=1 that is the legacy
+      // contiguous byte stream).
+      while (!snd.finished()) {
+        ssize_t k = send(send_fd, sp + snd.off() + snd.done,
+                         snd.remaining(), MSG_NOSIGNAL);
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          return PeerIoError(send_fd, "duplex send");
+        }
+        snd.Advance((size_t)k);
       }
-      if (k > 0) sent += (size_t)k;
     }
     if (recv_idx >= 0 && (fds[recv_idx].revents & (POLLIN | POLLHUP))) {
-      ssize_t k = recv(recv_fd, rp + recvd, recv_len - recvd, 0);
-      if (k == 0) return PeerClosed(recv_fd);
-      if (k < 0 && errno != EINTR && errno != EAGAIN) {
-        return PeerIoError(recv_fd, "duplex recv");
-      }
-      if (k > 0) recvd += (size_t)k;
-      if (chunk > 0 && on_chunk) {
-        while (recvd - fired >= chunk) {
+      while (!rcv.finished()) {
+        const size_t coff = rcv.off(), clen = rcv.len();
+        ssize_t k = recv(recv_fd, rp + coff + rcv.done, rcv.remaining(),
+                         0);
+        if (k == 0) return PeerClosed(recv_fd);
+        if (k < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          return PeerIoError(recv_fd, "duplex recv");
+        }
+        if (rcv.Advance((size_t)k) && on_chunk) {
           GlobalEvents().Record(EventType::kWireChunk, EventWirePlane(),
-                                0, (int64_t)fired, (int64_t)chunk);
-          on_chunk(fired, chunk);
-          fired += chunk;
+                                (int32_t)(channel << 1), (int64_t)coff,
+                                (int64_t)clen);
+          on_chunk(coff, clen);
         }
       }
     }
-  }
-  if (on_chunk && recvd > fired) {
-    GlobalEvents().Record(EventType::kWireChunk, EventWirePlane(), 0,
-                          (int64_t)fired, (int64_t)(recvd - fired));
-    on_chunk(fired, recvd - fired);
   }
   return Status::OK();
 }

@@ -69,6 +69,32 @@ void SetWireRetryAttempts(int64_t n);
 int64_t WireRetryBackoffMs();
 void SetWireRetryBackoffMs(int64_t ms);
 
+// ---- multi-channel striping (HOROVOD_WIRE_CHANNELS) ------------------
+// The data plane establishes K parallel sockets per neighbor pair at
+// rendezvous (the channel id rides the data-plane hello, epoch-fenced
+// like everything else) and stripes every chunked ring step across
+// them: chunk i of a segment rides channel i % K, each channel's byte
+// stream is framed independently (CRC mode included — per-channel
+// [D1|idx|crc|payload]/NAK streams, acks on each channel's own reverse
+// direction), and one ReduceWorker per channel keeps reduction
+// parallelism matched to the stripe width. K is rank-uniform by
+// contract (the stripe split IS the wire framing, like the chunk
+// knob). Two values, deliberately distinct:
+//   WireChannelsEnv()  — sockets ESTABLISHED per pair, read from the
+//                        env once per process (rendezvous and every
+//                        reinit rebuild this many; the autotuner can
+//                        never ask a re-formation for sockets the env
+//                        did not provision);
+//   WireChannels()     — the ACTIVE stripe width, autotunable at
+//                        runtime (rides the ResponseList like the
+//                        chunk knob), clamped to the established count
+//                        at use sites.
+// External (message) transports do not stripe (K is forced to 1).
+constexpr int kMaxWireChannels = 8;
+int WireChannelsEnv();
+int64_t WireChannels();
+void SetWireChannels(int64_t k);
+
 // ---- wire integrity (HOROVOD_WIRE_CRC) -------------------------------
 // When on, every DuplexTransfer/DuplexTransferChunked over TCP frames
 // its payload as typed per-chunk messages carrying a CRC32C, and the
@@ -85,26 +111,37 @@ bool WireCrc();
 void SetWireCrc(bool on);
 uint32_t Crc32c(const void* data, size_t len);
 
-// Chaos hook (HOROVOD_FAULT_INJECT=rank:op:flip:bit[:skip]): flip
-// `bit` (modulo the frame's payload bits) in a CRC-framed data chunk
-// this process sends, AFTER its CRC is computed — wire corruption the
-// receiver must catch. `skip` lets that many data frames pass first,
-// so a specific hop of a multi-phase collective (e.g. the bf16
-// cross-plane chunk of a hierarchical allreduce) can be targeted
-// deterministically. bit >= 0 is one-shot; persistent=true re-flips
-// every subsequent frame (including resends), forcing NAK-retry
-// exhaustion so the escalation path is testable.
-void ArmWireFlip(int64_t bit, bool persistent, int64_t skip = 0);
+// Chaos hook (HOROVOD_FAULT_INJECT=rank:op:flip:bit[:skip[:chan]]):
+// flip `bit` (modulo the frame's payload bits) in a CRC-framed data
+// chunk this process sends, AFTER its CRC is computed — wire
+// corruption the receiver must catch. `skip` lets that many data
+// frames pass first, so a specific hop of a multi-phase collective
+// (e.g. the bf16 cross-plane chunk of a hierarchical allreduce) can be
+// targeted deterministically. `channel` >= 0 restricts BOTH the flip
+// and the skip count to frames sent on that stripe channel — with K>1
+// the channels stream concurrently, so a channel-blind skip counter
+// would race; the filter is what makes "fault exactly one channel,
+// the other K-1 must not wedge" a deterministic chaos case. bit >= 0
+// is one-shot; persistent=true re-flips every subsequent frame
+// (including resends), forcing NAK-retry exhaustion so the escalation
+// path is testable.
+void ArmWireFlip(int64_t bit, bool persistent, int64_t skip = 0,
+                 int64_t channel = -1);
 
 // Peer attribution: planes register which GLOBAL rank sits behind each
-// connected fd so timeout/EOF statuses can name the casualty. External
-// (message-transport) fds encode the peer directly and need no entry.
-void RegisterFdRank(int fd, int rank);
+// connected fd so timeout/EOF statuses can name the casualty, plus the
+// stripe channel the fd carries (0 for control fds and the primary
+// data mesh). External (message-transport) fds encode the peer
+// directly and need no entry.
+void RegisterFdRank(int fd, int rank, int channel = 0);
 void UnregisterFdRank(int fd);  // TcpClose calls this itself
 int FdRank(int fd);             // -1 when unknown
+int FdChannel(int fd);          // 0 when unknown
 // Every currently registered peer fd (control + data planes) — the
 // chaos "reset" action shuts them all down to emulate NIC death.
-std::vector<int> RegisteredFds();
+// channel >= 0 filters to that stripe channel's fds (reset:<chan>
+// emulates ONE dead NIC queue while the other stripes stay up).
+std::vector<int> RegisteredFds(int channel = -1);
 
 // Exact-length send/recv, deadline-bound (see above). timeout_ms:
 // kWireTimeoutGlobal = the knob, <= 0 = block forever, else explicit.
@@ -139,6 +176,24 @@ Status DuplexTransferChunked(
     int send_fd, const void* send_buf, size_t send_len, int recv_fd,
     void* recv_buf, size_t recv_len, size_t chunk,
     const std::function<void(size_t off, size_t len)>& on_chunk);
+
+// One channel's share of a `stripe_k`-way striped transfer: of the
+// ceil(len / chunk) chunks of each direction, this call moves exactly
+// those with index % stripe_k == channel, streaming them in index
+// order over ONE socket pair (the channel's). Offsets/lengths handed
+// to `on_chunk` are GLOBAL (positions in recv_buf), so K concurrent
+// calls — one per channel, each on its own thread owning its own fds —
+// reassemble the full segment with no cross-channel coordination: the
+// chunk schedule is derived identically at both ends, which makes the
+// per-channel byte streams self-framing exactly like the K=1 stream.
+// Under HOROVOD_WIRE_CRC each channel carries its own typed frame
+// stream (data idx are global; NAKs/done ride this channel's reverse
+// direction). A channel with no chunks in either direction returns
+// OK immediately. DuplexTransferChunked == stripe_k 1, channel 0.
+Status DuplexTransferStriped(
+    int send_fd, const void* send_buf, size_t send_len, int recv_fd,
+    void* recv_buf, size_t recv_len, size_t chunk, int stripe_k,
+    int channel, const std::function<void(size_t off, size_t len)>& on_chunk);
 
 // Best local IP for peers to reach us (first non-loopback, else 127.0.0.1).
 std::string LocalAddress();

@@ -161,6 +161,19 @@ def load_library():
         lib.hvdtpu_set_ring_chunk_bytes.argtypes = [i64]
         lib.hvdtpu_wire_compression.restype = i32
         lib.hvdtpu_set_wire_compression.argtypes = [i32]
+        lib.hvdtpu_wire_codec.restype = i32
+        lib.hvdtpu_set_wire_codec.argtypes = [i32]
+        lib.hvdtpu_wire_channels.restype = i64
+        lib.hvdtpu_set_wire_channels.argtypes = [i64]
+        lib.hvdtpu_wire_channels_established.restype = i32
+        lib.hvdtpu_wire_channels_established.argtypes = []
+        lib.hvdtpu_simd_enabled.restype = i32
+        lib.hvdtpu_simd_enabled.argtypes = []
+        lib.hvdtpu_set_simd_enabled.argtypes = [i32]
+        lib.hvdtpu_simd_selftest.restype = i32
+        lib.hvdtpu_simd_selftest.argtypes = []
+        lib.hvdtpu_int8_roundtrip.restype = i64
+        lib.hvdtpu_int8_roundtrip.argtypes = [p, i64, p, dbl]
         lib.hvdtpu_wire_timeout_ms.restype = i64
         lib.hvdtpu_wire_timeout_ms.argtypes = []
         lib.hvdtpu_set_wire_timeout_ms.restype = None
@@ -190,11 +203,11 @@ def load_library():
         lib.hvdtpu_set_fault_inject.argtypes = [i32, i64]
         lib.hvdtpu_ring_selftest.restype = i32
         lib.hvdtpu_ring_selftest.argtypes = [
-            i32, i64, i32, i32, i64, i32, dbl,
+            i32, i64, i32, i32, i64, i32, dbl, i32,
             ctypes.POINTER(ctypes.c_double)]
         lib.hvdtpu_hier_selftest.restype = i32
         lib.hvdtpu_hier_selftest.argtypes = [
-            i32, i32, i64, i32, i32, i64, i32, i32, dbl,
+            i32, i32, i64, i32, i32, i64, i32, i32, dbl, i32,
             ctypes.POINTER(ctypes.c_double)]
         lib.hvdtpu_cross_plane.restype = i32
         lib.hvdtpu_cross_plane.argtypes = []
@@ -519,6 +532,49 @@ class HorovodBasics:
         chunk knob; numerics contract in ``docs/wire.md``)."""
         self.lib.hvdtpu_set_wire_compression(1 if on else 0)
 
+    def wire_codec(self):
+        """Wire codec mode behind the compression knob: 0 off, 1 bf16
+        (``HOROVOD_WIRE_COMPRESSION=1``/``bf16``), 2 int8
+        blockwise-scaled (``int8`` — one f32 scale per 256 elems, f32
+        accumulate; the EQuARX recipe). See ``docs/wire.md``."""
+        return int(self.lib.hvdtpu_wire_codec())
+
+    def set_wire_codec(self, mode):
+        """Select the wire codec (rank-uniform, like the chunk knob)."""
+        self.lib.hvdtpu_set_wire_codec(int(mode))
+
+    def wire_channels(self):
+        """Active stripe width of the multi-channel wire transport
+        (``HOROVOD_WIRE_CHANNELS``): chunk i of every ring step rides
+        channel ``i % K`` over K parallel sockets per neighbor. See
+        ``docs/wire.md``."""
+        return int(self.lib.hvdtpu_wire_channels())
+
+    def set_wire_channels(self, k):
+        """Set the active stripe width (rank-uniform — the stripe
+        schedule is the wire framing; clamped to the established
+        socket count at use sites)."""
+        self.lib.hvdtpu_set_wire_channels(int(k))
+
+    def wire_channels_established(self):
+        """Stripe sockets established per neighbor pair this
+        generation (env-derived at rendezvous; 1 before init)."""
+        return int(self.lib.hvdtpu_wire_channels_established())
+
+    def simd_enabled(self):
+        """Whether the explicit-SIMD reduce/codec paths are active
+        (``HOROVOD_SIMD``; bit-identical to scalar by contract)."""
+        return bool(self.lib.hvdtpu_simd_enabled())
+
+    def set_simd_enabled(self, on):
+        self.lib.hvdtpu_set_simd_enabled(1 if on else 0)
+
+    def simd_selftest(self):
+        """Pin the SIMD kernels bit-identical to the scalar reference
+        across unaligned offsets/tail lengths (0 = pass; negative
+        names the divergent kernel — csrc/ring_selftest.cc)."""
+        return int(self.lib.hvdtpu_simd_selftest())
+
     def wire_timeout_ms(self):
         """Wire progress deadline (``HOROVOD_WIRE_TIMEOUT_MS``): a peer
         making no wire progress for this long is declared failed with a
@@ -657,16 +713,19 @@ class HorovodBasics:
                                                  int(size), int(rot))
 
     def ring_selftest(self, ranks, count, dtype=6, op=1, chunk_bytes=None,
-                      compression=False, postscale=1.0):
+                      compression=False, postscale=1.0, channels=1):
         """In-process loopback proof of the ring engine (no init needed).
 
         Runs one allreduce over ``ranks`` socketpair-connected data
         planes with explicit knobs and checks against a bulk ring-order
-        reference (``csrc/ring_selftest.cc``). Returns ``(rc,
-        max_abs_err)``: rc 0 = pass; uncompressed passes are
-        bit-identical (err 0.0), compressed passes report the bf16
-        wire-rounding error for the caller to bound. ``dtype``/``op``
-        take the core enums (6 = float32, 1 = SUM).
+        reference (``csrc/ring_selftest.cc``). ``channels`` = stripe
+        sockets per neighbor pair (``HOROVOD_WIRE_CHANNELS``);
+        ``compression`` accepts False/0, True/1 (bf16) or 2 (int8
+        blockwise). Returns ``(rc, max_abs_err)``: rc 0 = pass;
+        uncompressed passes — striped or not — are bit-identical
+        (err 0.0), compressed passes report the wire-rounding error
+        for the caller to bound. ``dtype``/``op`` take the core enums
+        (6 = float32, 1 = SUM).
         """
         import ctypes as _ct
 
@@ -675,7 +734,8 @@ class HorovodBasics:
         err = _ct.c_double()
         rc = self.lib.hvdtpu_ring_selftest(
             int(ranks), int(count), int(dtype), int(op), int(chunk_bytes),
-            1 if compression else 0, float(postscale), _ct.byref(err))
+            int(compression), float(postscale), int(channels),
+            _ct.byref(err))
         return rc, err.value
 
     #: HOROVOD_CROSS_PLANE mode names in core enum order.
@@ -709,16 +769,18 @@ class HorovodBasics:
 
     def hier_selftest(self, ranks, local_size, count, dtype=6, op=1,
                       chunk_bytes=None, compression=0, exact_fill=True,
-                      postscale=1.0):
+                      postscale=1.0, channels=1):
         """In-process loopback proof of the hierarchical cross-plane
         allreduce at an emulated ``ranks/local_size`` slices x
         ``local_size`` ranks topology (no init needed).
 
         ``compression``: 0 = none, 1 = every hop, 2 = the inter-slice
-        hop only. With ``exact_fill`` (small integers — exact in f32
-        and bf16) an uncompressed pass must be BIT-IDENTICAL to the
-        flat ring reference. Returns ``(rc, max_abs_err)``; rc 0 =
-        pass, -4 = bit-exactness violated, -5 = ranks disagree.
+        hop only. ``channels`` = stripe sockets per pair (every plane
+        of the decomposition stripes). With ``exact_fill`` (small
+        integers — exact in f32 and bf16) an uncompressed pass must be
+        BIT-IDENTICAL to the flat ring reference. Returns
+        ``(rc, max_abs_err)``; rc 0 = pass, -4 = bit-exactness
+        violated, -5 = ranks disagree.
         """
         import ctypes as _ct
 
@@ -728,7 +790,7 @@ class HorovodBasics:
         rc = self.lib.hvdtpu_hier_selftest(
             int(ranks), int(local_size), int(count), int(dtype), int(op),
             int(chunk_bytes), int(compression), 1 if exact_fill else 0,
-            float(postscale), _ct.byref(err))
+            float(postscale), int(channels), _ct.byref(err))
         return rc, err.value
 
     def response_cache_stats(self):

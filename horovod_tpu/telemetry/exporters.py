@@ -65,6 +65,16 @@ def _flatten_prom(snap, rank):
     for field in ("compression_ratio", "cross_compression_ratio"):
         lines.append(f'hvdtpu_wire_{field}{{{label}}} '
                      f'{wire.get(field, 1.0)}')
+    # Per-stripe-channel wire counters (HOROVOD_WIRE_CHANNELS,
+    # docs/wire.md): the buckets sum exactly to tx/rx_bytes, so a
+    # dead or slow stripe alerts as imbalance instead of averaging
+    # away under the totals.
+    for chan in wire.get("channels", []):
+        clabel = f'channel="{chan.get("channel", 0)}",{label}'
+        lines.append(f'hvdtpu_wire_channel_tx_bytes_total{{{clabel}}} '
+                     f'{chan.get("tx_bytes", 0)}')
+        lines.append(f'hvdtpu_wire_channel_rx_bytes_total{{{clabel}}} '
+                     f'{chan.get("rx_bytes", 0)}')
     # Step-anatomy overlap ledger (docs/metrics.md): exposed vs hidden
     # wire time per plane — the overlap-efficiency trend perfwatch and
     # the fusion-work acceptance criterion watch.

@@ -40,6 +40,11 @@ import sys
 ROW_IDENTITY_FIELDS = ("metric", "config", "name", "schedule", "bench",
                        "ranks", "bytes", "payload_bytes", "bucket_bytes",
                        "V", "accum", "dtype", "op",
+                       # Multi-channel wire rows (ring_busbw striped
+                       # lanes): the stripe width identifies a series —
+                       # a K=1 and a K=4 row must never cross-join into
+                       # one EWMA baseline.
+                       "channels",
                        # Serving rows (serving_latency): the offered
                        # load and KV block geometry identify a series —
                        # interleaving different traces or block sizes
@@ -59,6 +64,9 @@ DEFAULT_WATCH = {
     "step_time_ms": "up",
     "ms_per_step": "up",
     "busbw_gbps": "down",
+    # Transport-time bus bandwidth of the same rows (the striping
+    # acceptance number — busbw minus the fixed API-path overhead).
+    "wire_gbps": "down",
     "overlap_efficiency": "down",
     "mfu": "down",
     # Serving rows (bench.py --serving / serving_latency family):

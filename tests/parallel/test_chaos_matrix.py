@@ -349,6 +349,108 @@ def test_persistent_corruption_escalates_typed_wire_corruption():
     assert results == {0: "ok", 1: "ok"}
 
 
+# ---- striped transport chaos: fault ONE channel, the rest stay up ----
+
+_STRIPE_K = 4
+_STRIPE_LANE = 1  # the targeted stripe lane (chunk idx % width == 1)
+
+
+def _flip_one_channel_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.wire_crc()
+    assert b.wire_channels_established() == _STRIPE_K
+    if rank == 1:
+        # flip:<bit>:<skip>:<chan> — corrupt the FIRST data frame rank
+        # 1 sends on stripe lane 1 only. The channel filter is what
+        # makes the skip count deterministic under striping (lanes
+        # stream concurrently; a lane-blind counter would race).
+        b.set_fault_inject_spec(f"1:{_FLIP_AT_OP}:flip:77:0:{_STRIPE_LANE}")
+    inputs = [_rank_input(r, _COUNT) for r in range(size)]
+    ref = _ring_reference(inputs)
+    for i in range(3):
+        out = ops.allreduce_async(inputs[rank], f"op.{i}").synchronize()
+        # The corrupted lane healed via NAK/resend while the other
+        # lanes streamed on: result still bit-exact, nothing wedged.
+        assert np.array_equal(out.view(np.uint32), ref.view(np.uint32)), i
+    el = b.metrics_snapshot()["elastic"]
+    assert el["faults_detected"] == 0, el
+    assert b.epoch() == 0
+    bad_chunks = [e["chunk"] for e in b.events(512)
+                  if e["type"] == "crc_error"]
+    b.shutdown()
+    return {"crc_errors": el["crc_errors"], "heals": el["heals"],
+            "bad_chunks": bad_chunks}
+
+
+def test_flip_on_one_stripe_channel_heals_without_wedging_others():
+    """A mid-transfer CRC fault on ONE stripe channel NAK-heals while
+    the other K-1 channels keep streaming — the striped satellite of
+    the r14 acceptance. The corrupt chunk's index must map to the
+    targeted lane (chunk idx % width == lane), pinning that the chaos
+    grammar's channel selector actually lands where it says."""
+    results = run_chaos(
+        _flip_one_channel_worker, 2, victims=set(), expect_sigkill=False,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": "5000",
+             "HOROVOD_WIRE_CRC": "1",
+             "HOROVOD_WIRE_RETRY_ATTEMPTS": "2",
+             "HOROVOD_WIRE_CHANNELS": str(_STRIPE_K),
+             "HOROVOD_RING_CHUNK_BYTES": "1024"})
+    assert sum(r["crc_errors"] for r in results.values()) >= 1, results
+    assert sum(r["heals"] for r in results.values()) >= 1, results
+    # At size 2 with K=4 the paired plan runs width K/2 = 2: the
+    # receiver (rank 0) verified the corrupt chunk on the targeted
+    # lane — its GLOBAL chunk index is congruent to the lane mod width.
+    bad = [c for r in results.values() for c in r["bad_chunks"]]
+    assert bad, results
+    assert all(c % (_STRIPE_K // 2) == _STRIPE_LANE for c in bad), bad
+
+
+def _reset_one_channel_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.wire_channels_established() == _STRIPE_K
+    x = _rank_input(rank, _COUNT)
+    ops.allreduce_async(x, "warm").synchronize()
+    if rank == 1:
+        # reset:<chan>: abort only stripe channel 1's sockets — the
+        # dead-NIC-queue shape. The peer sees EOF on that channel's fd
+        # mid-transfer and must surface the typed r12 fault promptly
+        # (certain attribution), not hang on the surviving channels.
+        b.set_fault_inject_spec("1:2:reset:1")
+    try:
+        for i in range(3):
+            ops.allreduce_async(x, f"op.{i}").synchronize()
+        status = "no-error"
+    except HorovodInternalError as e:
+        status = "typed"
+        if rank == 0:
+            fault = b.last_fault()
+            assert fault is not None and 1 in fault["ranks"], fault
+    b.shutdown()
+    return status
+
+
+def test_reset_of_one_stripe_channel_escalates_typed_fault():
+    """Killing ONE stripe channel's sockets mid-run escalates through
+    the typed r12 fault path within the wire deadline — the other K-1
+    live channels must not mask a dead stripe into a silent hang."""
+    results = run_chaos(
+        _reset_one_channel_worker, 2, victims=set(), expect_sigkill=False,
+        env={"HOROVOD_WIRE_TIMEOUT_MS": str(_TIMEOUT_MS),
+             "HOROVOD_WIRE_CHANNELS": str(_STRIPE_K),
+             "HOROVOD_RING_CHUNK_BYTES": "1024"},
+        timeout=60)
+    # The EOF lands on whoever is mid-transfer against the reset
+    # channel; at minimum ONE rank must have surfaced the typed error.
+    assert "typed" in results.values(), results
+
+
 # ---- (2) SIGKILL + parole rejoin: N-1 -> N regrow, pinned trajectory -
 
 _TRAIN_STEPS = 8

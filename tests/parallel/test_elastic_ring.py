@@ -213,6 +213,58 @@ def test_sigkilled_rank_typed_error_and_bitexact_reform():
     assert results == {0: "ok", 1: "ok"}
 
 
+def _kill_reform_striped_worker(rank, size):
+    from horovod_tpu.common import basics, eager_ops as ops
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    b = basics.HorovodBasics()
+    b.init()
+    assert b.wire_channels_established() == 4
+    victim = _KILL_VICTIM
+    inputs = [_rank_input(r, _COUNT) for r in range(size)]
+    for i in range(_KILL_AT_OP):
+        ops.allreduce_async(inputs[rank], f"warm.{i}").synchronize()
+    try:
+        ops.allreduce_async(inputs[rank], "boom").synchronize()
+        return "boom-did-not-fail"
+    except HorovodInternalError:
+        pass
+    survivors = [r for r in range(size) if r != victim]
+    b.reinit(survivors, 1)
+    # The re-formed ring rebuilt ALL K sockets per survivor pair: the
+    # established count survives the epoch bump, and a striped
+    # allreduce over the new mesh is bit-identical to the fresh-(N-1)
+    # numpy replay (striping never changes the reduce order).
+    assert b.wire_channels_established() == 4
+    assert b.wire_channels() == 4
+    sub_inputs = [inputs[r] for r in survivors]
+    out = ops.allreduce_async(inputs[rank], "reformed").synchronize()
+    ref = _ring_reference(sub_inputs)
+    assert np.array_equal(out.view(np.uint32), ref.view(np.uint32))
+    # Striped traffic flowed on the regrown mesh: more than one channel
+    # bucket moved bytes (the N-1=2 world is pairwise, so the paired
+    # plan spreads directions over the stripe set).
+    chans = b.metrics_snapshot()["wire"]["channels"]
+    assert len(chans) > 1, chans
+    assert sum(c["tx_bytes"] + c["rx_bytes"] for c in chans[1:]) > 0, chans
+    b.shutdown()
+    return "ok"
+
+
+def test_reinit_rebuilds_all_stripe_channels():
+    """Elastic re-formation under HOROVOD_WIRE_CHANNELS=4: reinit must
+    rebuild all K sockets per survivor pair (the channel id rides the
+    re-rendezvous hello at the bumped epoch) and the striped ring on
+    the regrown mesh stays bit-exact."""
+    results = run_chaos(
+        _kill_reform_striped_worker, 3, victims={_KILL_VICTIM},
+        env={"HOROVOD_WIRE_TIMEOUT_MS": str(_TIMEOUT_MS),
+             "HOROVOD_WIRE_CHANNELS": "4",
+             "HOROVOD_RING_CHUNK_BYTES": "1024",
+             "HOROVOD_FAULT_INJECT": f"{_KILL_VICTIM}:{_KILL_AT_OP}"})
+    assert results == {0: "ok", 1: "ok"}
+
+
 # ---- silent stall (SIGSTOP): deadline attribution, no EOF to lean on --
 
 

@@ -125,6 +125,143 @@ def test_knob_surface_roundtrip(b):
         b.set_wire_compression(old_comp)
 
 
+# ---- multi-channel striping (HOROVOD_WIRE_CHANNELS, docs/wire.md) ----
+
+
+@pytest.mark.parametrize("channels", [2, 4])
+@pytest.mark.parametrize("ranks", [2, 4, 5])
+def test_striped_bit_identical_to_k1(b, channels, ranks):
+    """K > 1 moves chunks over parallel sockets but never changes the
+    reduce order — every striped uncompressed run must land on the
+    SAME bits as the K=1 ring-order reference, across ragged counts
+    (empty channels included) and dtypes. N=2 exercises the paired
+    plan (direction-split sockets at K=4, shared-socket duplex lanes
+    at K=2)."""
+    for count in _ragged_counts(ranks):
+        for chunk in (64, 4096):
+            rc, err = b.ring_selftest(ranks, count, dtype=F32, op=SUM,
+                                      chunk_bytes=chunk,
+                                      channels=channels)
+            assert rc == 0, (ranks, count, chunk, channels, rc)
+            assert err == 0.0, (ranks, count, chunk, channels, err)
+    for dtype in (BF16, I32, F64):
+        rc, err = b.ring_selftest(ranks, 4099, dtype=dtype, op=SUM,
+                                  chunk_bytes=256, channels=channels)
+        assert rc == 0 and err == 0.0, (dtype, channels, rc, err)
+
+
+def test_striped_large_payload_and_compression(b):
+    # Multi-chunk striped payload, uncompressed: bit-identical.
+    rc, err = b.ring_selftest(4, 300001, dtype=F32, op=SUM,
+                              chunk_bytes=4096, channels=4)
+    assert rc == 0 and err == 0.0
+    # bf16 codec striped: same error contract as K=1.
+    rc, err = b.ring_selftest(4, 100003, dtype=F32, op=SUM,
+                              chunk_bytes=4096, compression=1, channels=4)
+    assert rc == 0
+    assert 0 < err <= _bound(4)
+
+
+def test_int8_codec_bounds_and_channel_invariance(b):
+    """The int8 blockwise-scaled codec (HOROVOD_WIRE_COMPRESSION=int8,
+    the EQuARX stretch): per-block f32 scales, f32 accumulate. Error
+    stays inside the coarse-quantization envelope, results are
+    rank-consistent (selftest rc 0 enforces bitwise agreement), and
+    the error is IDENTICAL at K=1 and K=4 — striping only moves
+    chunks, the quantization schedule never changes."""
+    errs = {}
+    for channels in (1, 4):
+        rc, err = b.ring_selftest(4, 100003, dtype=F32, op=SUM,
+                                  chunk_bytes=4096, compression=2,
+                                  channels=channels)
+        assert rc == 0, (channels, rc)
+        # inputs in [-2, 2]: per-hop quant error <= amax/254 per
+        # element, <= N hops + the final rounding.
+        assert 0 < err <= 4 * 4 * 2 ** -6, (channels, err)
+        errs[channels] = err
+    assert errs[1] == errs[4], errs
+    # Ineligible dtypes/ops bypass the codec bit-identically.
+    rc, err = b.ring_selftest(4, 1000, dtype=I32, op=SUM,
+                              chunk_bytes=128, compression=2)
+    assert rc == 0 and err == 0.0
+
+
+def test_int8_codec_roundtrip_bounds_and_nan_poison(b):
+    """Direct codec pins via the hvdtpu_int8_roundtrip entry: per-block
+    scale/2 quantization bound, folded postscale, and the NaN contract
+    — a non-finite input must poison its WHOLE block to NaN (a NaN
+    gradient quantizing to a clean-looking number would dodge every
+    divergence tripwire) while other blocks decode exactly."""
+    import ctypes
+    import numpy as np
+
+    def roundtrip(src, post=1.0):
+        out = np.empty_like(src)
+        wlen = b.lib.hvdtpu_int8_roundtrip(
+            src.ctypes.data_as(ctypes.c_void_p), src.size,
+            out.ctypes.data_as(ctypes.c_void_p), float(post))
+        assert wlen == 4 * ((src.size + 255) // 256) + src.size
+        return out
+
+    rng = np.random.default_rng(7)
+    x = (rng.standard_normal(1000) * 2).astype(np.float32)
+    y = roundtrip(x)
+    # per-block bound: |x - dq| <= scale/2 = amax/254 per block
+    for blk in range(0, 1000, 256):
+        seg = x[blk:blk + 256]
+        bound = np.abs(seg).max() / 254 + 1e-7
+        assert np.abs(seg - y[blk:blk + 256]).max() <= bound, blk
+    # folded postscale matches scale-after-decode rounding envelope
+    y4 = roundtrip(x, post=0.25)
+    assert np.allclose(y4, y * 0.25, rtol=0, atol=1e-6)
+    # NaN poison: block 1 (elems 256..511) carries one NaN -> the whole
+    # block decodes NaN; neighboring blocks are untouched.
+    z = x.copy()
+    z[300] = np.nan
+    out = roundtrip(z)
+    assert np.isnan(out[256:512]).all()
+    assert np.array_equal(out[:256], y[:256])
+    assert np.array_equal(out[512:], y[512:])
+    # inf poisons too (clamping it to 127*scale would hide divergence)
+    z2 = x.copy()
+    z2[10] = np.inf
+    out2 = roundtrip(z2)
+    assert np.isnan(out2[:256]).all()
+
+
+def test_simd_kernels_bit_identical_to_scalar(b):
+    """The explicit-SIMD ReduceInto / bf16 codec paths (csrc/simd.h)
+    must match the scalar reference BIT-FOR-BIT across unaligned
+    start offsets and tail lengths, non-finite values included — the
+    in-core sweep returns a negative code naming the first divergent
+    kernel."""
+    assert b.simd_selftest() == 0
+
+
+def test_stripe_and_simd_knob_roundtrips(b):
+    saved_chan = b.wire_channels()
+    saved_simd = b.simd_enabled()
+    saved_codec = b.wire_codec()
+    try:
+        b.set_wire_channels(4)
+        assert b.wire_channels() == 4
+        b.set_wire_channels(999)  # clamped to the stripe cap
+        assert b.wire_channels() == 8
+        b.set_simd_enabled(False)
+        assert b.simd_enabled() is False
+        b.set_simd_enabled(True)
+        assert b.simd_enabled() is True
+        b.set_wire_codec(2)
+        assert b.wire_codec() == 2
+        assert b.wire_compression() is True  # codec != 0
+        b.set_wire_codec(0)
+        assert b.wire_compression() is False
+    finally:
+        b.set_wire_channels(saved_chan)
+        b.set_simd_enabled(saved_simd)
+        b.set_wire_codec(saved_codec)
+
+
 @pytest.mark.parametrize("ranks", [2, 4])
 def test_crc_framing_is_bit_identical(b, ranks):
     """HOROVOD_WIRE_CRC reframes every duplex as typed CRC32C chunk
@@ -142,10 +279,20 @@ def test_crc_framing_is_bit_identical(b, ranks):
         rc, err = b.ring_selftest(ranks, 4096, dtype=F32, op=SUM,
                                   chunk_bytes=1024, compression=True)
         assert rc == 0 and err <= _bound(ranks), (rc, err)
+        # Striped CRC: per-channel [D1|idx|crc|payload]/NAK streams
+        # (incl. the N=2 shared-socket demux at K=2's duplex lanes).
+        for channels in (2, 4):
+            rc, err = b.ring_selftest(ranks, 5000, dtype=F32, op=SUM,
+                                      chunk_bytes=1024,
+                                      channels=channels)
+            assert rc == 0 and err == 0.0, (ranks, channels, rc, err)
         # Hierarchical decomposition under CRC: cross-plane hops framed
-        # too (2 slices x 2 ranks needs 4).
+        # too (2 slices x 2 ranks needs 4), striped included.
         if ranks == 4:
             rc, err = b.hier_selftest(4, 2, 2048, chunk_bytes=512)
+            assert rc == 0 and err == 0.0, (rc, err)
+            rc, err = b.hier_selftest(4, 2, 2048, chunk_bytes=512,
+                                      channels=4)
             assert rc == 0 and err == 0.0, (rc, err)
     finally:
         b.set_wire_crc(saved)

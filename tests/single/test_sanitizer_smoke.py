@@ -72,15 +72,17 @@ _DRIVER = textwrap.dedent("""
 
     def ring_hammer(tid):
         # The chunked/compressed ring engine under TSan: each selftest
-        # spins up 4 in-process rank planes, each with its own caller
-        # thread + overlap worker (csrc/ring_selftest.cc), alternating
-        # bf16-compressed and exact passes — concurrent with the
-        # metrics-snapshot churner reading the wire counters the
-        # engine's tally writes.
+        # spins up 4 in-process rank planes, each with its own transfer
+        # legs + worker pool (csrc/ring_selftest.cc), alternating
+        # bf16-compressed and exact passes and cycling the stripe width
+        # (K=4 adds per-channel transfer threads + per-channel reduce
+        # workers) — concurrent with the metrics-snapshot churner
+        # reading the wire counters the engine's tally writes.
         for i in range(6):
             rc, _err = b.ring_selftest(4, 20000, dtype=6, op=1,
                                        chunk_bytes=2048,
-                                       compression=(i % 2 == 1))
+                                       compression=(i % 2 == 1),
+                                       channels=(4 if i % 3 == 2 else 1))
             assert rc == 0, (tid, i, rc)
 
     c = threading.Thread(target=churner)
