@@ -24,7 +24,7 @@ ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 .PHONY: core tf clean test test-quick test-flaky lint lint-csrc \
   core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
   reshard-smoke chaos-smoke obs-smoke scale-smoke perf-smoke \
-  serve-smoke wire-smoke
+  serve-smoke wire-smoke fusion-smoke
 
 core: $(OUT)
 
@@ -168,6 +168,16 @@ obs-smoke: core
 # (docs/metrics.md; horovod_tpu/telemetry/perf_smoke.py; ~20 s).
 perf-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.perf_smoke
+
+# Jit-lane fusion smoke: hvdlint C7 gate (interleaving statically
+# verified on the fused step, fires on a bunched fixture), then 2 real
+# ranks run hvd.make_fused_train_step under a StepTimer — asserts the
+# overlap-ledger invariant (exposed + hidden == total per plane, with
+# hidden > 0: wire drained while segments dispatched) and that
+# HOROVOD_JIT_FUSION flips the schedule with BIT-identical loss/params
+# (docs/fusion.md; horovod_tpu/jax/fusion_smoke.py; ~40 s).
+fusion-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.jax.fusion_smoke
 
 # Large-world smoke: one 64-rank simulated world (thread-per-rank over
 # socketpairs, csrc/simworld.cc) runs a negotiation + allreduce round

@@ -1116,6 +1116,147 @@ def _zero_sweep_rows(ranks=4, steps=5):
     return rows
 
 
+# Child body for one jit_fusion rank: the host-lane fused train step
+# (hvd.make_fused_train_step — segmented backward jits, per-bucket
+# reduce-scatters fired at segment boundaries, allgathers deferred
+# into the next step) vs the bulk-synchronous unfused schedule the
+# HOROVOD_JIT_FUSION=0 escape hatch restores. A StepTimer brackets
+# every step so the core's overlap ledger attributes exposed/hidden
+# wire time per plane (docs/metrics.md), and each rank dumps its event
+# ring for the parent's critical-path attribution.
+_FUSION_CHILD = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, os.environ["HVDTPU_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu.jax as hvd
+from horovod_tpu.parallel.zero import zero_bucket_layout
+from horovod_tpu.telemetry import critpath
+from horovod_tpu.telemetry.step_timer import StepTimer
+
+knobs = json.loads(os.environ["FUSION_KNOBS"])
+steps, width, depth = knobs["steps"], knobs["width"], knobs["depth"]
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+key = jax.random.PRNGKey(0)
+params = {}
+for i in range(depth):
+    key, k = jax.random.split(key)
+    params[f"w{i}"] = (jax.random.normal(k, (width, width))
+                       / np.sqrt(width)).astype(jnp.float32)
+
+def loss_fn(p, batch):
+    h = batch["x"]
+    for i in range(depth):
+        h = jnp.tanh(h @ p[f"w{i}"])
+    return jnp.mean((h - batch["y"]) ** 2)
+
+batch = {"x": jax.random.normal(jax.random.PRNGKey(1),
+                                (knobs["batch"], width)),
+         "y": jax.random.normal(jax.random.PRNGKey(2),
+                                (knobs["batch"], width))}
+n_buckets = len(zero_bucket_layout(list(params.values()), size,
+                                   knobs["bucket_bytes"]).buckets)
+# The knob under test rides in via HOROVOD_JIT_FUSION (the env
+# escape hatch itself, not set_jit_fusion — the bench exercises the
+# operator-facing path).
+init, step, finish = hvd.make_fused_train_step(
+    loss_fn, 1e-3, bucket_bytes=knobs["bucket_bytes"])
+carry = init(params)
+timer = StepTimer()
+try:
+    loss, carry = step(carry, batch)  # warm: compiles every segment
+    for _ in range(steps):
+        timer.start_step()
+        loss, carry = step(carry, batch)
+        timer.end_step(loss)
+    _, carry = finish(carry)
+    ov = timer.overlap_summary() or {}
+    intra = ov.get("intra", {})
+    row = {
+        "step_s": round(timer.mean_step_s(), 6),
+        "n_buckets": n_buckets,
+        "overlap_efficiency": round(ov.get("overlap_efficiency", 0.0),
+                                    4),
+        "mean_exposed_wire_ms": round(
+            intra.get("mean_exposed_wire_ms", 0.0), 3),
+        "mean_hidden_wire_ms": round(
+            intra.get("mean_hidden_wire_ms", 0.0), 3),
+        "mean_total_wire_ms": round(
+            intra.get("mean_total_wire_ms", 0.0), 3),
+    }
+    dump = os.environ.get("FUSION_DUMP_DIR")
+    if dump:
+        critpath.write_event_dump(
+            os.path.join(dump, f"blackbox-rank{rank}.jsonl"),
+            rank, size, hvd.events())
+finally:
+    hvd.shutdown()
+if rank == 0:
+    print("JIT_FUSION_ROW " + json.dumps(row), flush=True)
+"""
+
+
+def _fusion_rows(ranks=2, steps=6):
+    """The jit-lane compute/collective fusion rows (`jit_fusion`):
+    the fused host-lane step (per-bucket reduce-scatters interleaved
+    with the segmented backward, allgathers hidden under the next
+    step's forward) vs the unfused bulk-synchronous schedule
+    (`HOROVOD_JIT_FUSION=0`), 2 CPU loopback ranks. The headline
+    column is the overlap ledger's ``overlap_efficiency`` — ~0 was
+    the whole jit lane's value before the fusion work (every byte
+    moved while the host sat between programs); perfwatch watches it
+    (down = regression) like any other bench series. Each config also
+    runs the critical-path attribution over the ranks' event dumps
+    (`report.py --critical-path` on the same files): the acceptance
+    signal is the blocking phase moving OFF wire on the fused config
+    (docs/fusion.md)."""
+    import shutil
+    import tempfile
+
+    from horovod_tpu.telemetry import critpath
+
+    # Wire-heavy geometry on purpose (18 MB of params, small batch):
+    # the schedule contrast shows in the ledger — bulk-synchronous
+    # exposes ~20 ms of wire per step here, the fused schedule ~4 ms.
+    # step_s is NOT the signal on this substrate: the loopback "wire"
+    # is the same cores as the compute, so hidden wire doesn't come
+    # free the way an independently-draining NIC/ICI makes it on TPU.
+    payload = {"steps": steps, "width": 768, "depth": 8, "batch": 4,
+               "bucket_bytes": 512 * 1024}
+    rows = []
+    for name, knob in (("unfused", "0"), ("fused", "1")):
+        row = {"metric": "jit_fusion", "config": name, "ranks": ranks,
+               "bucket_bytes": payload["bucket_bytes"],
+               "unit": "host-lane fused train step over TCP loopback; "
+                       "overlap_efficiency = hidden/total wire time "
+                       "from the step-window overlap ledger; "
+                       "blocking_phase from the cross-rank "
+                       "critical-path attribution"}
+        dump = tempfile.mkdtemp(prefix=f"hvd-fusion-{name}-")
+        try:
+            row.update(_run_loopback_ranks(
+                _FUSION_CHILD, "JIT_FUSION_ROW", ranks,
+                {"JAX_PLATFORMS": "cpu",
+                 "HOROVOD_JIT_FUSION": knob,
+                 "FUSION_DUMP_DIR": dump,
+                 "FUSION_KNOBS": json.dumps(payload)}))
+            analysis = critpath.critical_path(dump)
+            pc = analysis.get("phase_counts", {})
+            if pc:
+                row["blocking_phase"] = max(pc, key=pc.get)
+                row["phase_counts"] = pc
+        except Exception as e:  # noqa: BLE001 — a failed config yields
+            # an error row; the other config still measures.
+            row["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            shutil.rmtree(dump, ignore_errors=True)
+        rows.append(row)
+    return rows
+
+
 def _sweep_points(batch):
     """The --sweep point table: (name, config, run_spmd kwargs)."""
     import dataclasses
@@ -1408,6 +1549,14 @@ def main():
         for row in _zero_sweep_rows():
             emit(row)
         return
+    if "--fusion" in argv:
+        # Standalone jit-lane fusion rows (CPU loopback subprocesses;
+        # any box): fused vs unfused host-lane train step,
+        # overlap_efficiency + critical-path blocking phase
+        # (docs/fusion.md).
+        for row in _fusion_rows():
+            emit(row)
+        return
     if "--quick" in argv:
         if jax.devices()[0].platform == "cpu":
             emit(_smoke_row())
@@ -1437,6 +1586,8 @@ def main():
         for row in _bubble_rows():
             emit(row)
         for row in _zero_sweep_rows():
+            emit(row)
+        for row in _fusion_rows():
             emit(row)
         if _probe_platform() == "cpu":
             print("--sweep: no accelerator; emitted the schedule-"

@@ -434,22 +434,46 @@ void OverlapLedger::StepBegin(int64_t ts_us) {
   open_ = true;
   begin_us_ = ts_us;
   for (auto& s : spans_) s.clear();
+  waits_.clear();
 }
 
 int64_t OverlapLedger::StepEnd(int64_t ts_us) {
   std::lock_guard<std::mutex> lk(mu_);
   if (!open_) return -1;
   open_ = false;
+  // The union of API-thread wait intervals, clipped to the window —
+  // shared across both planes (a blocked thread is blocked regardless
+  // of which plane's bytes are moving). Wire time under this union is
+  // `exposed`; the remainder ran while the host kept computing.
+  std::vector<std::pair<int64_t, int64_t>> wait_union;
+  wait_union.reserve(waits_.size());
+  for (auto& [a, b] : waits_) {
+    int64_t lo = a < begin_us_ ? begin_us_ : a;
+    int64_t hi = b > ts_us ? ts_us : b;
+    if (hi > lo) wait_union.emplace_back(lo, hi);
+  }
+  std::sort(wait_union.begin(), wait_union.end());
+  size_t w = 0;
+  for (size_t i = 1; i < wait_union.size(); i++) {
+    if (wait_union[i].first <= wait_union[w].second) {
+      if (wait_union[i].second > wait_union[w].second)
+        wait_union[w].second = wait_union[i].second;
+    } else {
+      wait_union[++w] = wait_union[i];
+    }
+  }
+  if (!wait_union.empty()) wait_union.resize(w + 1);
+  waits_.clear();
   for (int p = 0; p < 2; p++) {
     auto& spans = spans_[p];
     int64_t total = 0, exposed = 0;
-    // Clip to the window, then union by a sorted sweep. total and
-    // exposed come from the SAME clipped set, so exposed + hidden ==
-    // total is exact by construction (the reconciliation contract).
-    // Time clipped OFF (a span straddling the step boundary, or a
-    // racing span entirely outside) books as unattributed — every
-    // span microsecond lands somewhere, so the ledger stays
-    // reconcilable against the wire_us histogram.
+    // Clip to the window. total and exposed come from the SAME
+    // clipped set, so exposed + hidden == total is exact by
+    // construction (the reconciliation contract). Time clipped OFF
+    // (a span straddling the step boundary, or a racing span entirely
+    // outside) books as unattributed — every span microsecond lands
+    // somewhere, so the ledger stays reconcilable against the wire_us
+    // histogram.
     std::vector<std::pair<int64_t, int64_t>> clipped;
     clipped.reserve(spans.size());
     for (auto& [a, b] : spans) {
@@ -463,21 +487,19 @@ int64_t OverlapLedger::StepEnd(int64_t ts_us) {
       total += hi - lo;
       unattributed_us_ += (b - a) - (hi - lo);  // the clipped-off part
     }
+    // exposed = measure of (clipped spans) ∩ (wait union): both lists
+    // are sorted and disjoint-merged, one linear two-pointer sweep.
     std::sort(clipped.begin(), clipped.end());
-    int64_t cur_lo = 0, cur_hi = -1;
+    size_t wi = 0;
     for (auto& [lo, hi] : clipped) {
-      if (cur_hi < 0) {
-        cur_lo = lo;
-        cur_hi = hi;
-      } else if (lo <= cur_hi) {  // overlapping or abutting: extend
-        if (hi > cur_hi) cur_hi = hi;
-      } else {
-        exposed += cur_hi - cur_lo;
-        cur_lo = lo;
-        cur_hi = hi;
+      while (wi < wait_union.size() && wait_union[wi].second <= lo) wi++;
+      for (size_t j = wi; j < wait_union.size(); j++) {
+        int64_t olo = lo > wait_union[j].first ? lo : wait_union[j].first;
+        int64_t ohi = hi < wait_union[j].second ? hi : wait_union[j].second;
+        if (olo >= hi) break;
+        if (ohi > olo) exposed += ohi - olo;
       }
     }
-    if (cur_hi >= 0) exposed += cur_hi - cur_lo;
     PlaneLedger& pl = planes_[p];
     pl.last_total_us = total;
     pl.last_exposed_us = exposed;
@@ -512,6 +534,18 @@ void OverlapLedger::AddSpan(int plane, int64_t start_us, int64_t end_us) {
   spans_[plane].emplace_back(start_us, end_us);
 }
 
+void OverlapLedger::AddWait(int64_t start_us, int64_t end_us) {
+  if (end_us <= start_us) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  // Waits outside any window are dropped, not unattributed: they are
+  // host time, not wire time — nothing to reconcile. Same cap story
+  // as AddSpan; a dropped wait under-reports exposure, never breaks
+  // exposed + hidden == total.
+  if (!open_ || end_us <= begin_us_) return;
+  if (waits_.size() >= (size_t)kMaxSpansPerPlane) return;
+  waits_.emplace_back(start_us, end_us);
+}
+
 void OverlapLedger::Reset() {
   std::lock_guard<std::mutex> lk(mu_);
   open_ = false;
@@ -519,6 +553,7 @@ void OverlapLedger::Reset() {
   steps_ = 0;
   unattributed_us_ = 0;
   for (auto& s : spans_) s.clear();
+  waits_.clear();
   for (auto& p : planes_) p = PlaneLedger();
 }
 

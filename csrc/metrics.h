@@ -222,11 +222,22 @@ Metrics& GlobalMetrics();
 // (0 intra/flat, 1 cross-slice), per step:
 //
 //   total    = sum of wire-span durations (the serial wire cost)
-//   exposed  = measure of their interval UNION (wall time the step
-//              actually spent with >= 1 transfer in flight)
-//   hidden   = total - exposed (wire time that ran concurrently with
-//              other wire traffic: pipelined chunks, overlapped
-//              buckets, simultaneous planes — the overlap win)
+//   exposed  = the part of each wire span that ran while an API
+//              thread sat BLOCKED on the core (inside hvdtpu_wait —
+//              the host had nothing better to do than watch the wire)
+//   hidden   = total - exposed (wire time that ran while the host
+//              kept computing/dispatching — the compute/collective
+//              overlap win the jit-lane fusion work exists to move;
+//              docs/fusion.md)
+//
+// The single background execution thread runs collectives strictly
+// sequentially, so wire spans themselves never overlap in wall time —
+// which is why the pre-fusion definition (union overlap among wire
+// spans) read hidden == 0 on every real run. Exposure is therefore
+// measured against the WAIT spans hvdtpu_wait records: a bulk-
+// synchronous step (issue everything, then synchronize) exposes its
+// whole wire total; a fused step whose collectives drain while the
+// host dispatches the next compute segment hides it.
 //
 // exposed + hidden == total EXACTLY by construction (both are computed
 // from the same clipped interval set) — the reconciliation the
@@ -234,9 +245,10 @@ Metrics& GlobalMetrics();
 // overlap_efficiency = hidden / total (0 with no wire traffic).
 //
 // Concurrency: spans arrive from the background loop / reduce-worker
-// threads (WireTally destructors), step marks from whichever API
-// thread drives the loop — one small mutex; every call is O(spans in
-// the open step) at worst, and the hot path (AddSpan) is O(1).
+// threads (WireTally destructors), waits from blocking API threads,
+// step marks from whichever API thread drives the loop — one small
+// mutex; every call is O(spans in the open step) at worst, and the
+// hot paths (AddSpan/AddWait) are O(1).
 class OverlapLedger {
  public:
   void StepBegin(int64_t ts_us);
@@ -247,6 +259,11 @@ class OverlapLedger {
   // One completed wire span. Outside any step window the duration is
   // booked as `unattributed` (still reconcilable against wire_us).
   void AddSpan(int plane, int64_t start_us, int64_t end_us);
+  // One completed API-thread blocking interval (hvdtpu_wait entry ->
+  // return). Wire time under the union of these is `exposed`; waits
+  // are not wire time themselves, so outside-window waits are simply
+  // dropped (no unattributed contract to keep).
+  void AddWait(int64_t start_us, int64_t end_us);
   void Reset();
   // The "overlap" object embedded in the snapshot's wire section:
   // {"steps":..,"unattributed_us":..,"exposed_wire_ms":..,
@@ -272,6 +289,7 @@ class OverlapLedger {
   int64_t steps_ = 0;           // completed step windows
   int64_t unattributed_us_ = 0;  // span time outside any step window
   std::vector<std::pair<int64_t, int64_t>> spans_[2];  // open step
+  std::vector<std::pair<int64_t, int64_t>> waits_;     // open step
   PlaneLedger planes_[2];
 };
 

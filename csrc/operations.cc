@@ -2330,7 +2330,15 @@ int hvdtpu_poll(int handle) {
 int hvdtpu_wait(int handle) {
   CHECK_INIT(-1)
   Status s;
-  if (!g_state->handles.Wait(handle, &s)) return -1;
+  // The blocking interval feeds the overlap ledger's exposure math
+  // (metrics.h): wire time under an API-thread wait is `exposed`,
+  // wire that drained while the host kept computing is `hidden` —
+  // the number the jit-lane fusion schedule exists to move
+  // (docs/fusion.md).
+  int64_t t0 = MetricsNowUs();
+  bool found = g_state->handles.Wait(handle, &s);
+  GlobalLedger().AddWait(t0, MetricsNowUs());
+  if (!found) return -1;
   return s.ok() ? 0 : -(int)s.type();
 }
 

@@ -1,4 +1,4 @@
-"""The hvdlint check catalog (C1-C5) over an extracted signature.
+"""The hvdlint check catalog (C1-C7) over an extracted signature.
 
 Each check is a pure function ``(extraction, context) -> [Diagnostic]``;
 :func:`run_all` applies every shipped check. See docs/analysis.md for
@@ -256,6 +256,64 @@ def check_shard_collective_pairing(ex, ctx):
     return out
 
 
+#: C7's tail window: the check fires only when EVERY scatter-family
+#: collective issues after this fraction of the program's flops is
+#: already behind it — i.e. nothing is left to overlap with.
+_C7_TAIL_FRACTION = 0.10
+
+
+def check_collective_interleaving(ex, ctx):
+    """C7: scatter-family collectives bunched after the compute tail.
+
+    The fused jit-lane step only earns its keep when the per-bucket
+    reduce-scatters issue WHILE backward compute remains — interleaved,
+    XLA's async pipelining hides their wire time under the flops that
+    follow; bunched after the last dot_general, every byte is exposed
+    on the critical path (the eager lane's overlap ledger measures the
+    same thing dynamically; C7 is its static twin over the jaxpr).
+
+    Walks the extraction's compute/collective profile and fires when
+    the program (a) does real arithmetic, (b) issues two or more
+    scatter-family collectives — one bucket has nothing to interleave
+    with — and (c) EVERY one of them sits after at least
+    ``1 - _C7_TAIL_FRACTION`` of the total flop mass. Quiet by
+    construction on the eager lane (collectives live outside the jaxpr,
+    so the profile has no ``coll`` events), on the unfused shard apply
+    (its first reduce-scatter leads the program: flops-before = 0), and
+    on pure-wire programs like ``hier_allreduce`` (no flop mass).
+    """
+    profile = getattr(ex, "profile", ())
+    flops_total = sum(ev[1] for ev in profile if ev[0] == "flops")
+    if flops_total <= 0:
+        return []
+    scatters = []
+    flops_before = 0
+    for ev in profile:
+        if ev[0] == "flops":
+            flops_before += ev[1]
+        elif ev[1] in _SCATTER_PRIMS:
+            scatters.append((flops_before, ev))
+    if len(scatters) < 2:
+        return []
+    threshold = (1.0 - _C7_TAIL_FRACTION) * flops_total
+    if any(before < threshold for before, _ in scatters):
+        return []
+    first_before, (_, prim, axes, path, source) = scatters[0]
+    pct = 100.0 * first_before / flops_total
+    return [D.make(
+        "C7", path,
+        f"{len(scatters)} {prim} collective(s) over axis {list(axes)} "
+        f"are bunched at the program tail: the first issues only after "
+        f"{pct:.0f}% of the flops, so no remaining compute can hide "
+        "their wire time — the reduce-scatters serialize onto the "
+        "critical path",
+        hint="emit each bucket's reduce-scatter as its gradients become "
+             "ready (parallel.fusion.interleave_collectives reorders "
+             "the fused step's jaxpr to do this; HOROVOD_JIT_FUSION=0 "
+             "deliberately restores the bunched split step)",
+        source=source)]
+
+
 ALL_CHECKS = (
     check_collective_divergence,
     check_axis_validity,
@@ -263,6 +321,7 @@ ALL_CHECKS = (
     check_donation_hazards,
     check_schedule_conformance,
     check_shard_collective_pairing,
+    check_collective_interleaving,
 )
 
 

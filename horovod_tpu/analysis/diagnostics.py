@@ -25,6 +25,12 @@ check ids are stable API (tests assert them, allowlists name them):
   allgather on the same axis (the ZeRO apply invariant: scatter grads,
   update shards, gather params — docs/zero.md); unpaired scatters
   leave state silently sharded under replicated-semantics consumers.
+- **C7** collective interleaving — every scatter-family collective in
+  a compute-bearing program sits after the flop tail (bunched after
+  the backward instead of interleaved with it), so no remaining
+  compute can hide the wire time (docs/fusion.md: the static twin of
+  the eager lane's overlap ledger; ``parallel.fusion``'s reorder pass
+  is the fix, ``HOROVOD_JIT_FUSION=0`` the deliberate opt-out).
 """
 
 import dataclasses
@@ -40,6 +46,7 @@ SEVERITIES = {
     "C4": ERROR,
     "C5": ERROR,
     "C6": ERROR,
+    "C7": ERROR,
 }
 
 
@@ -53,7 +60,7 @@ class Diagnostic:
     available.
     """
 
-    id: str              # "C1".."C6"
+    id: str              # "C1".."C7"
     severity: str        # ERROR or WARNING
     path: str            # structural jaxpr path
     message: str         # what is wrong

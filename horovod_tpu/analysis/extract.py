@@ -15,7 +15,12 @@ so the checks can reason per path. Alongside, it tracks:
   sub-fp32 dtype, and whether its result is immediately cast back down
   (the deliberate f32-accumulate roundtrip) — check C3's raw material;
 - **donation sites**: every ``pjit`` equation carrying donated invars,
-  with its body jaxpr — check C4's raw material.
+  with its body jaxpr — check C4's raw material;
+- **compute/collective profile**: a flattened program-order event list
+  interleaving flop mass with collective issue points, so check C7 can
+  tell a schedule that hides reduce-scatter wire time under remaining
+  backward compute from one that bunches every scatter after the last
+  flop — check C7's raw material.
 
 Nothing here needs ``jax.shard_map``: programs are traced by the caller
 with ``jax.make_jaxpr(fn, axis_env=...)``, which binds collective axis
@@ -107,6 +112,10 @@ class Extraction:
     signature: tuple       # nested Collective/Loop/Branches nodes
     donation_sites: list
     axis_names_seen: set   # every axis name any collective referenced
+    #: program-order event list for C7: ``("flops", weight)`` runs
+    #: (consecutive compute merged) interleaved with
+    #: ``("coll", prim, axes, path, source)`` issue points.
+    profile: tuple = ()
 
 
 def _source_of(eqn):
@@ -385,13 +394,114 @@ def _size(aval):
     return n
 
 
+#: elementwise / reduction primitives whose flop weight is their output
+#: element count. Deliberately coarse: C7 reasons about WHERE the
+#: arithmetic mass sits relative to the collectives, not about absolute
+#: flop counts, so one-flop-per-output-element is plenty.
+_FLOP_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "max", "min", "pow", "integer_pow",
+    "exp", "log", "log1p", "tanh", "logistic", "erf", "rsqrt", "sqrt",
+    "neg", "abs", "sign", "select_n", "clamp",
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "cumsum",
+})
+
+
+def _flop_weight(eqn):
+    """Static flop estimate for one equation (0 = not compute).
+
+    ``dot_general`` counts ``2 * out_elems * K`` (one multiply-add per
+    contracted element); conv counts ``2 * out_elems`` per-position;
+    the elementwise/reduction allowlist counts one flop per output
+    element. Movement, layout, and control-flow primitives weigh zero —
+    the profile measures where the arithmetic sits, not how many bytes
+    shuffle around it.
+    """
+    name = eqn.primitive.name
+    out = 0
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        if hasattr(aval, "shape"):
+            out += _size(aval)
+    if name == "dot_general":
+        (lhs_contract, _), _ = eqn.params["dimension_numbers"]
+        lhs = _aval(eqn.invars[0])
+        k = 1
+        for d in lhs_contract:
+            k *= int(lhs.shape[d])
+        return 2 * out * max(1, k)
+    if name == "conv_general_dilated":
+        return 2 * out
+    if name in _FLOP_ELEMENTWISE:
+        return out
+    return 0
+
+
+def build_profile(closed_jaxpr, path=""):
+    """Flatten a jaxpr into C7's program-order compute/collective
+    profile: ``("flops", weight)`` events (consecutive compute merged)
+    interleaved with ``("coll", prim, axes, path, source)`` issue
+    points. Control flow mirrors :func:`linearize`: scan bodies repeat
+    by their static trip count, while loops expand once, cond takes the
+    first branch (a diverging branch is C1's to reject), and every
+    body-carrying primitive (pjit / remat2 / custom-vjp) inlines."""
+    jaxpr = _closed(closed_jaxpr)
+    out = []
+
+    def emit_flops(n):
+        if n <= 0:
+            return
+        if out and out[-1][0] == "flops":
+            out[-1] = ("flops", out[-1][1] + n)
+        else:
+            out.append(("flops", n))
+
+    def emit_all(events, repeat=1):
+        for _ in range(repeat):
+            for ev in events:
+                if ev[0] == "flops":
+                    emit_flops(ev[1])
+                else:
+                    out.append(ev)
+
+    def sub(label):
+        return f"{path}/{label}" if path else label
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim in COLLECTIVE_PRIMS:
+            out.append(("coll", prim, _axis_names(eqn),
+                        path or "<top>", _source_of(eqn)))
+        elif prim == "scan":
+            body = build_profile(eqn.params["jaxpr"], sub("scan"))
+            emit_all(body, repeat=int(eqn.params.get("length") or 1))
+        elif prim == "while":
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                emit_all(build_profile(eqn.params[key], sub("while")))
+        elif prim == "cond":
+            branches = eqn.params["branches"]
+            if branches:
+                emit_all(build_profile(branches[0], sub("cond")))
+        else:
+            bodies = _Walker._sub_jaxprs(eqn)
+            if bodies:
+                label = (f"{prim}:{eqn.params['name']}"
+                         if prim == "pjit" and "name" in eqn.params
+                         else prim)
+                for s in bodies:
+                    emit_all(build_profile(s, sub(label)))
+            else:
+                emit_flops(_flop_weight(eqn))
+    return tuple(out)
+
+
 def extract(closed_jaxpr):
     """Walk a ClosedJaxpr and return its :class:`Extraction`."""
     w = _Walker()
     jaxpr = _closed(closed_jaxpr)
     sig, _ = w.walk(closed_jaxpr, [False] * len(jaxpr.invars))
     return Extraction(signature=sig, donation_sites=w.donation_sites,
-                      axis_names_seen=w.axis_names_seen)
+                      axis_names_seen=w.axis_names_seen,
+                      profile=build_profile(closed_jaxpr))
 
 
 def linearize(nodes, _depth=0):

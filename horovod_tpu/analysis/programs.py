@@ -175,6 +175,7 @@ def _zero_shard_apply(config):
     all_gather stay visible to the walker, so C2 (axis validity), C3
     (width), and C6 (every reduce-scatter pairs with an allgather on
     the same axis) run against the program the TPU lanes execute."""
+    from horovod_tpu.parallel.ops import predicted_zero_collectives
     from horovod_tpu.parallel.precision import fused_adam
     from horovod_tpu.parallel.zero import (
         ZeroAdamState,
@@ -197,7 +198,9 @@ def _zero_shard_apply(config):
         count=jax.ShapeDtypeStruct((1,), jnp.int32),
         mu=shard, nu=shard)
     return LintSpec(fn=inner, args=(flat, flat, opt),
-                    axis_env=[("data", _ZERO_SHARDS)])
+                    axis_env=[("data", _ZERO_SHARDS)],
+                    expect_collectives=predicted_zero_collectives(
+                        len(layout.buckets), "data"))
 
 
 _HIER_INTRA, _HIER_INTER = 2, 2
@@ -233,6 +236,7 @@ def _zero_shard_apply_hier(config):
     reduce-scatter paired with a same-axis allgather (the interleaved
     cross-plane psum sits between, which order-based counting
     tolerates), and C2 validates both axes."""
+    from horovod_tpu.parallel.ops import predicted_zero_collectives
     from horovod_tpu.parallel.precision import fused_adam
     from horovod_tpu.parallel.zero import (
         ZeroAdamState,
@@ -257,7 +261,50 @@ def _zero_shard_apply_hier(config):
         mu=shard, nu=shard)
     return LintSpec(fn=inner, args=(flat, flat, opt),
                     axis_env=[("data", _ZERO_SHARDS),
-                              ("cross", _HIER_INTER)])
+                              ("cross", _HIER_INTER)],
+                    expect_collectives=predicted_zero_collectives(
+                        len(layout.buckets), "data", inter_axis="cross"))
+
+
+def _zero_fused_step(config):
+    """The fused one-program ZeRO-1 step AFTER
+    ``parallel.fusion.interleave_collectives`` reschedules it: the
+    per-member grad+apply program is traced once with ``axis_env`` (so
+    the per-bucket reduce-scatter / all-gather chains stay visible),
+    reordered, then replayed through ``jaxpr_as_fun`` — the lint walker
+    sees exactly the equation order the jit lane hands XLA. C7 proves
+    the scatters sit interleaved with the backward dot_generals rather
+    than bunched at the tail, and C6 still pairs every scatter with its
+    same-axis allgather."""
+    from horovod_tpu.parallel.fusion import (
+        _jcore,
+        fused_zero_inner,
+        interleave_collectives,
+    )
+    from horovod_tpu.parallel.precision import fused_adam
+    from horovod_tpu.parallel.zero import (
+        _optimizer_hyper,
+        zero_bucket_layout,
+        zero_state_init,
+    )
+
+    cfg = _config(config)
+    params = _abstract_params(cfg)
+    leaves, treedef = jax.tree.flatten(params)
+    # Small buckets so the tiny config splits into MANY of them — C7's
+    # interleaving verdict is only meaningful with multiple scatters
+    # (one bucket has nothing to interleave with and gates the check).
+    layout = zero_bucket_layout(leaves, _ZERO_SHARDS, 1 << 15)
+    hyper = _optimizer_hyper(fused_adam(1e-3))
+    _, opt = jax.eval_shape(
+        lambda p: zero_state_init(hyper, layout, p, _ZERO_SHARDS),
+        params)
+    inner, example, _, env = fused_zero_inner(
+        _loss_fn(cfg, None), params, _abstract_batch(), opt, hyper,
+        layout, treedef, "data", _ZERO_SHARDS)
+    closed = jax.make_jaxpr(inner, axis_env=env)(*example)
+    fn = _jcore.jaxpr_as_fun(interleave_collectives(closed))
+    return LintSpec(fn=fn, args=tuple(example), axis_env=env)
 
 
 def _redistribute_to_replicated(config):
@@ -334,6 +381,7 @@ _REGISTRY = {
     "llama_train_step_split_zero1": _split_zero,
     "zero1_shard_apply": _zero_shard_apply,
     "zero1_shard_apply_hier": _zero_shard_apply_hier,
+    "zero1_fused_step": _zero_fused_step,
     "hier_allreduce": _hier_allreduce,
     "redistribute_to_replicated": _redistribute_to_replicated,
     "pipeline_gpipe":

@@ -86,6 +86,7 @@ from horovod_tpu.jax.optimizer import (  # noqa: F401
     DistributedGradientTransformation,
     DistributedOptimizer,
     allreduce_gradients,
+    make_fused_train_step,
 )
 
 # Resharding engine (docs/redistribute.md): hvd.redistribute moves a

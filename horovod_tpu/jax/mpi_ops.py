@@ -71,7 +71,16 @@ def _maybe_enable_xla_data_plane():
                       f"collectives: {e}")
 
 
-def init():
+def init(jit_fusion=None):
+    """Initialize the runtime. ``jit_fusion`` (tri-state) overrides the
+    ``HOROVOD_JIT_FUSION`` env knob for jit-lane compute/collective
+    fusion (docs/fusion.md): ``False`` restores the unfused split-step
+    schedule, ``True`` forces fusion on, ``None`` (default) follows the
+    environment."""
+    if jit_fusion is not None:
+        from horovod_tpu.parallel import fusion as _fusion
+
+        _fusion.set_jit_fusion(jit_fusion)
     _elastic_init_mod.init()
     _maybe_enable_xla_data_plane()
 

@@ -24,7 +24,12 @@ from horovod_tpu.jax.compression import Compression
 # "apply k returned" to "apply k+1 returned" = one full train step
 # (grad compute + allreduce + update). Defers to an explicit scope: a
 # StepTimer that opened a step the optimizer did not is driving the
-# marks, and a second driver would fragment its windows.
+# marks, and a second driver would fragment its windows. Deference is
+# decided by the window OWNER, not the step id: core step ids restart
+# after metrics_reset(), so an id-only comparison can mistake a
+# StepTimer window that reused our last id for our own stale window
+# and steal it mid-step (the overlap ledger then folds one step's wire
+# spans into two half-windows and the attribution is garbage).
 _last_boundary_id = None
 
 
@@ -33,10 +38,12 @@ def _mark_optimizer_step():
     try:
         from horovod_tpu.telemetry import core as _tcore
 
+        if _tcore.window_owner() not in (None, "optimizer"):
+            return  # an explicit scope (StepTimer) owns the window
         open_id = _tcore.step_id()
         if open_id >= 0 and open_id != _last_boundary_id:
-            return  # an explicit scope (StepTimer) owns the window
-        _last_boundary_id = _tcore.step_mark(True)
+            return  # an undeclared driver opened it — leave it alone
+        _last_boundary_id = _tcore.step_mark(True, owner="optimizer")
     except Exception:  # noqa: BLE001 — telemetry must never take the
         pass           # training step down
 
@@ -308,3 +315,201 @@ def _zero_fused_adam(learning_rate, b1, b2, eps, op, compression,
                           hyper={"kind": "adam", "zero1": True,
                                  "learning_rate": learning_rate,
                                  "b1": b1, "b2": b2, "eps": eps})
+
+
+def make_fused_train_step(loss_fn, learning_rate, b1=0.9, b2=0.999,
+                          eps=1e-8, op=mpi_ops.Average,
+                          compression=Compression.none,
+                          bucket_bytes=None):
+    """The host-lane fused ZeRO-1 train step: per-bucket reduce-scatter
+    interleaved with the jitted backward (docs/fusion.md).
+
+    The backward is traced once and SPLIT at bucket-readiness
+    boundaries (``parallel.fusion.grad_bucket_cuts`` /
+    ``segment_closed_jaxpr``): the step loop runs the compute segments
+    back-to-back and, at each boundary, fires the eager reduce-scatter
+    for every gradient bucket that segment completed — so the wire
+    drains bucket k while segments k+1.. are still computing, exactly
+    the eager lane's overlap recipe applied to a jitted backward. Each
+    bucket's shard-adam and param allgather then pipeline as in
+    ``DistributedFusedAdam(zero=True)``, but the allgathers'
+    SYNCHRONIZATION is deferred into the NEXT step: ``step`` returns
+    with the gathers still in flight (carried as ``pending``), and the
+    next call drains them right before the forward needs the updated
+    params — the up-phase wire overlaps the inter-step host work and
+    shows up as hidden time in the next step's overlap window.
+
+    ``HOROVOD_JIT_FUSION=0`` (or ``hvd.init(jit_fusion=False)``)
+    switches the SAME step to the unfused schedule — monolithic grad
+    program, bulk-synchronous reduce-scatter / update / allgather
+    phases, params materialized before ``step`` returns. Both lanes run
+    identical collectives with identical operands in the same per-axis
+    order, so the knob changes the schedule, never the math: loss
+    trajectories are bit-identical (tests/parallel/test_fusion.py).
+
+    Returns ``(init, step, finish)``::
+
+        init(params)          -> carry
+        step(carry, batch)    -> (loss, carry)     # params may lag one
+        finish(carry)         -> (params, carry)   # drain in-flight AG
+
+    ``finish`` must be called before reading params (checkpoint, eval)
+    in the fused schedule; it is a no-op when nothing is pending.
+    """
+    from horovod_tpu.parallel import fusion
+    from horovod_tpu.parallel.precision import (
+        _adam_leaf,
+        _bias_corrections,
+    )
+    from horovod_tpu.parallel.zero import (
+        DEFAULT_BUCKET_BYTES,
+        zero_bucket_layout,
+    )
+
+    bucket_bytes = bucket_bytes or DEFAULT_BUCKET_BYTES
+    progs = {}  # (treedef, batch structure) -> traced/segmented lane
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def shard_adam(p_shard, g_shard, mu, nu, count):
+        bc1, bc2 = _bias_corrections(count, b1, b2)
+        return _adam_leaf(p_shard, g_shard, mu, nu, learning_rate, b1,
+                          b2, eps, bc1, bc2, p_shard.dtype)
+
+    def _lane(p_leaves, treedef, b_leaves, btree):
+        key = (treedef, btree,
+               tuple((l.shape, jnp.dtype(l.dtype).name)
+                     for l in (*p_leaves, *b_leaves)))
+        if key in progs:
+            return progs[key]
+        layout = zero_bucket_layout(p_leaves, mpi_ops.size(),
+                                    bucket_bytes)
+        n_p = len(p_leaves)
+
+        def flat_grad(*flat):
+            p = jax.tree.unflatten(treedef, flat[:n_p])
+            d = jax.tree.unflatten(btree, flat[n_p:])
+            loss, grads = jax.value_and_grad(loss_fn)(p, d)
+            return (loss, *treedef.flatten_up_to(grads))
+
+        closed = jax.make_jaxpr(flat_grad)(*p_leaves, *b_leaves)
+        cuts, ready = fusion.grad_bucket_cuts(closed, layout)
+        prog = fusion.segment_closed_jaxpr(closed, cuts)
+        # boundary k fires after segment k (prefix length bounds[k+1]):
+        # bucket b joins the FIRST boundary whose prefix covers its
+        # last producing equation.
+        bounds = [0, *cuts, len(closed.jaxpr.eqns)]
+        at_boundary = [[] for _ in range(len(bounds) - 1)]
+        for bi, r in enumerate(ready):
+            k = next(k for k in range(len(bounds) - 1)
+                     if bounds[k + 1] >= r)
+            at_boundary[k].append(bi)
+        issue_order = sorted(range(len(layout.buckets)),
+                             key=ready.__getitem__)
+        grad_vars = closed.jaxpr.outvars[1:]
+        # One packer jit per bucket: same dynamic_update_slice chain as
+        # BucketLayout.pack, over just that bucket's leaves — shared by
+        # both schedules so the wire sees identical operands.
+        packers = []
+        for b in layout.buckets:
+            def pack(*leaves, _b=b):
+                flat = jnp.zeros((_b.padded,), _b.dtype)
+                for leaf, off in zip(leaves, _b.offsets):
+                    flat = jax.lax.dynamic_update_slice(
+                        flat, leaf.reshape(-1).astype(_b.dtype), (off,))
+                return flat
+            packers.append(jax.jit(pack))
+        monolithic = jax.jit(flat_grad)
+        lane = (layout, prog, at_boundary, issue_order, grad_vars,
+                packers, monolithic)
+        progs[key] = lane
+        return lane
+
+    def init(params):
+        leaves, _ = jax.tree.flatten(params)
+        layout = zero_bucket_layout(leaves, mpi_ops.size(),
+                                    bucket_bytes)
+        n = layout.n_shards
+        shard = lambda b: jnp.zeros(  # noqa: E731
+            (b.shard_elems(n),), b.dtype)
+        state = {"count": jnp.zeros((), jnp.int32),
+                 "mu": [shard(b) for b in layout.buckets],
+                 "nu": [shard(b) for b in layout.buckets]}
+        return (params, state, None)
+
+    def _drain(params, pending):
+        """Resolve the previous step's in-flight allgathers into the
+        updated params (no-op when nothing is pending)."""
+        if pending is None:
+            return params
+        handles, ctxs, layout, treedef = pending
+        new_flat = [compression.decompress(h.synchronize(), ctx)
+                    for h, ctx in zip(handles, ctxs)]
+        return jax.tree.unflatten(treedef, layout.unpack(new_flat))
+
+    def _leaf_val(env, v):
+        return v.val if isinstance(v, fusion._jcore.Literal) else env[v]
+
+    def step(carry, batch):
+        params, state, pending = carry
+        params = _drain(params, pending)
+        fused = fusion.jit_fusion_enabled()
+        rank = mpi_ops.rank()
+        p_leaves, treedef = jax.tree.flatten(params)
+        b_leaves, btree = jax.tree.flatten(batch)
+        (layout, prog, at_boundary, issue_order, grad_vars, packers,
+         monolithic) = _lane(p_leaves, treedef, b_leaves, btree)
+        count = state["count"] + 1
+        rs = {}
+        if fused:
+            def on_boundary(k, env):
+                # Fire the reduce-scatter of every bucket this segment
+                # finished; the remaining segments compute over it.
+                for bi in at_boundary[k]:
+                    b = layout.buckets[bi]
+                    flat = packers[bi](*(
+                        _leaf_val(env, grad_vars[li]) for li in b.indices))
+                    rs[bi] = mpi_ops.reducescatter_async(
+                        flat, name=f"fusion.rs.{bi}", op=op)
+            outs, _ = prog.run(*p_leaves, *b_leaves,
+                               on_boundary=on_boundary)
+            loss = outs[0]
+        else:
+            outs = monolithic(*p_leaves, *b_leaves)
+            loss, g_leaves = outs[0], list(outs[1:])
+            # Unfused: bulk-synchronous phase — every scatter drained
+            # before any update runs (the pre-fusion split schedule).
+            for bi, b in enumerate(layout.buckets):
+                flat = packers[bi](*(g_leaves[li] for li in b.indices))
+                rs[bi] = mpi_ops.reducescatter_async(
+                    flat, name=f"fusion.rs.{bi}", op=op)
+            rs = {bi: h.synchronize() for bi, h in rs.items()}
+        new_mu = list(state["mu"])
+        new_nu = list(state["nu"])
+        ag, ctxs = [None] * len(layout.buckets), [None] * len(
+            layout.buckets)
+        for bi in issue_order:
+            g_shard = rs[bi].synchronize() if fused else rs[bi]
+            p_shard = layout.pack_shard(p_leaves, bi, rank)
+            p2, mu2, nu2 = shard_adam(p_shard, g_shard, new_mu[bi],
+                                      new_nu[bi], count)
+            new_mu[bi], new_nu[bi] = mu2, nu2
+            c, ctx = compression.compress(p2)
+            ctxs[bi] = ctx
+            ag[bi] = mpi_ops.allgather_async(c, name=f"fusion.ag.{bi}")
+        state = {"count": count, "mu": new_mu, "nu": new_nu}
+        pending = (ag, ctxs, layout, treedef)
+        if not fused:
+            # Unfused: params materialize before the step returns.
+            params = _drain(params, pending)
+            pending = None
+        _mark_optimizer_step()
+        return loss, (params, state, pending)
+
+    def finish(carry):
+        """Drain any in-flight allgathers; returns
+        ``(params, carry)`` with the carry safe to keep stepping."""
+        params, state, pending = carry
+        params = _drain(params, pending)
+        return params, (params, state, None)
+
+    return init, step, finish

@@ -64,6 +64,27 @@ def predicted_hier_collectives(intra_axis, inter_axis):
             ("all_gather", (intra_axis,))]
 
 
+def predicted_zero_collectives(n_buckets, axis, inter_axis=None):
+    """The host-side collective prediction for the ZeRO-1 shard apply
+    (``parallel.zero.build_zero_apply_inner``): per bucket, a
+    reduce-scatter over the zero ``axis``, the optional 1/N cross-plane
+    psum over ``inter_axis``, and the allgather of the updated shard
+    back over ``axis``. Fed to hvdlint's C5 so the bucketed schedule
+    and the traced program can never silently diverge — and, because
+    the fused jit-lane step reorders exactly these collectives
+    (``parallel.fusion.interleave_collectives`` preserves the per-axis
+    relative order C6 counts but not this bucket-serial sequence), it
+    documents the UNFUSED contract the ``HOROVOD_JIT_FUSION=0`` escape
+    hatch restores."""
+    out = []
+    for _ in range(int(n_buckets)):
+        out.append(("psum_scatter", (axis,)))
+        if inter_axis is not None:
+            out.append(("psum", (inter_axis,)))
+        out.append(("all_gather", (axis,)))
+    return out
+
+
 def pbroadcast(x, axis_name, root=0):
     """Broadcast root's shard to all members of the axis.
 

@@ -92,6 +92,94 @@ def _register_split_flops(timer, programs):
         timer.add_flops_from_compiled(compiled, calls=calls)
 
 
+def _wrap_step_telemetry(inner_step, telemetry, flops_programs):
+    """The StepTimer wrapper both step layouts share: first call
+    registers per-step FLOPs from compiled cost analysis (best-effort),
+    every call brackets the step with ``start_step``/``end_step``. Lives
+    entirely OUTSIDE the jitted programs — traced jaxprs are identical
+    with and without it."""
+    flops_pending = [telemetry.flops_per_step is None]
+
+    def step(carry, batch):
+        if flops_pending[0]:
+            flops_pending[0] = False
+            try:
+                _register_split_flops(telemetry,
+                                      flops_programs(carry, batch))
+            except Exception:  # noqa: BLE001 — cost analysis is
+                pass           # best-effort (backend-dependent)
+        telemetry.start_step()
+        out = inner_step(carry, batch)
+        telemetry.end_step(out)
+        return out
+
+    return step
+
+
+def _make_fused_zero_train_step(loss_fn, optimizer, zero, *, n, jk,
+                                telemetry):
+    """The fused (one-program) ZeRO-1 step layout (docs/fusion.md).
+
+    ``n == 1``: the whole step — value_and_grad + bucket pack + the
+    per-bucket RS/adam/AG pipeline — is one jit whose collective chains
+    :func:`~horovod_tpu.parallel.fusion.interleave_collectives`
+    rescheduled under the backward. ``n > 1``: microbatches ``0..n-2``
+    run the plain grad/accumulate programs (no collectives to fuse);
+    the LAST microbatch, which owns the collective phase, runs fused
+    with the accumulator folded in. The carry is identical to the
+    unfused zero layout (``zero_state_init``), so the
+    ``HOROVOD_JIT_FUSION`` knob flips without state conversion.
+    """
+    from horovod_tpu.parallel.fusion import make_fused_zero_programs
+
+    progs = make_fused_zero_programs(loss_fn, optimizer, zero,
+                                     microbatches=n, jit_kwargs=jk)
+
+    if n == 1:
+        def step(carry, batch):
+            params, opt = carry
+            loss, params, opt = progs.call(params, batch, opt)
+            return loss, (params, opt)
+    else:
+        def scaled_loss(p, d):
+            return loss_fn(p, d) / n
+
+        grad_first = jax.jit(
+            lambda p, d: jax.value_and_grad(scaled_loss)(p, d), **jk)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2), **jk)
+        def grad_acc(params, loss_acc, acc, d):
+            loss, g = jax.value_and_grad(scaled_loss)(params, d)
+            return loss_acc + loss, jax.tree.map(jnp.add, acc, g)
+
+        def step(carry, batch):
+            params, opt = carry
+            mbs = _split_microbatches(batch, n)
+            loss, grads = grad_first(params, mbs[0])
+            for mb in mbs[1:-1]:
+                loss, grads = grad_acc(params, loss, grads, mb)
+            loss, params, opt = progs.call_final(params, loss, grads,
+                                                 mbs[-1], opt)
+            return loss, (params, opt)
+
+    if telemetry is not None:
+        def _flops_programs(carry, batch):
+            params, opt = carry
+            if n == 1:
+                fused = progs.get(params, batch, opt, False)
+                return [(fused, (params, batch, opt), 1)]
+            mbs = _split_microbatches(batch, n)
+            l_abs, g_abs = jax.eval_shape(grad_first, params, mbs[0])
+            fused = progs.get(params, mbs[-1], opt, True)
+            return [(grad_first, (params, mbs[0]), 1),
+                    (grad_acc, (params, l_abs, g_abs, mbs[0]), n - 2),
+                    (fused, (params, l_abs, g_abs, mbs[-1], opt), 1)]
+
+        step = _wrap_step_telemetry(step, telemetry, _flops_programs)
+
+    return TrainStep(init=progs.init, step=step)
+
+
 def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
                           jit_kwargs=None, telemetry=None, zero=None):
     """Build the split-program step for ``loss_fn(params, batch)``.
@@ -146,6 +234,20 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
     # The buffers are dead the moment apply returns either way.
     zero_init = None
     if zero is not None:
+        from horovod_tpu.parallel import fusion as _fusion
+
+        if _fusion.jit_fusion_enabled():
+            # Jit-lane compute/collective fusion (docs/fusion.md): the
+            # grad program that owns the collective phase and the ZeRO
+            # apply become ONE program whose per-bucket reduce-scatter
+            # -> shard-adam -> all-gather chains are rescheduled to
+            # interleave with the remaining backward compute
+            # (hvdlint C7 verifies the ordering statically). Same math,
+            # same carry, different schedule — HOROVOD_JIT_FUSION=0
+            # restores the unfused two-program layout below.
+            return _make_fused_zero_train_step(
+                loss_fn, optimizer, zero, n=n, jk=jk,
+                telemetry=telemetry)
         from horovod_tpu.parallel.zero import make_zero_apply
 
         apply_fn, zero_init = make_zero_apply(optimizer, zero,
@@ -208,9 +310,6 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
             return loss, (params, opt)
 
     if telemetry is not None:
-        inner_step = step
-        flops_pending = [telemetry.flops_per_step is None]
-
         def _flops_programs(carry, batch):
             params, opt = carry
             if n == 1:
@@ -223,18 +322,7 @@ def make_split_train_step(loss_fn, optimizer, *, microbatches=1,
                     (grad_acc, (params, l_abs, g_abs, mb0), n - 1),
                     (apply_fn, (g_abs, params, opt), 1)]
 
-        def step(carry, batch):  # noqa: F811 — deliberate shadowing
-            if flops_pending[0]:
-                flops_pending[0] = False
-                try:
-                    _register_split_flops(telemetry,
-                                          _flops_programs(carry, batch))
-                except Exception:  # noqa: BLE001 — cost analysis is
-                    pass           # best-effort (backend-dependent)
-            telemetry.start_step()
-            out = inner_step(carry, batch)
-            telemetry.end_step(out)
-            return out
+        step = _wrap_step_telemetry(step, telemetry, _flops_programs)
 
     def init(params):
         if zero_init is not None:

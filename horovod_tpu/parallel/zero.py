@@ -424,6 +424,35 @@ def build_zero_apply_inner(hyper, layout, axis, size, inter_axis=None,
     return inner
 
 
+def zero_state_init(hyper, layout, params, size):
+    """Build the ZeRO-1 carry ``(params, opt)`` for a bucket layout:
+    optimizer state laid out so every leaf's leading dim splits
+    ``size``-fold over the zero axis (``ZeroAdamState`` /
+    ``ZeroMasterAdamState`` docstrings). Shared by the unfused apply
+    (:func:`make_zero_apply`) and the fused one-program step
+    (``parallel.fusion.make_fused_zero_programs``) — the SAME carry, so
+    the ``HOROVOD_JIT_FUSION`` knob can flip without converting
+    state."""
+    master = hyper["kind"] == "master_adam"
+    flat = layout.pack(jax.tree.leaves(params))
+    count = jnp.zeros((size,), jnp.int32)
+    if master:
+        m_dtype = hyper.get("master_dtype", jnp.float32)
+        master_flat = tuple(jnp.array(f, m_dtype) for f in flat)
+        opt = ZeroMasterAdamState(
+            count=count, master=master_flat,
+            mu=tuple(jnp.zeros_like(m) for m in master_flat),
+            nu=tuple(jnp.zeros_like(m) for m in master_flat))
+        params = jax.tree.map(
+            lambda p: p.astype(hyper["compute_dtype"]), params)
+    else:
+        opt = ZeroAdamState(
+            count=count,
+            mu=tuple(jnp.zeros_like(f) for f in flat),
+            nu=tuple(jnp.zeros_like(f) for f in flat))
+    return params, opt
+
+
 def make_zero_apply(optimizer, zero, jit_kwargs=None):
     """Build the ZeRO apply for ``make_split_train_step``.
 
@@ -435,7 +464,6 @@ def make_zero_apply(optimizer, zero, jit_kwargs=None):
     """
     hyper = _optimizer_hyper(optimizer)
     size = zero.resolved_size()
-    master = hyper["kind"] == "master_adam"
     jk = dict(jit_kwargs or {})
     cache = {}  # treedef -> (layout, jitted apply)
 
@@ -469,23 +497,7 @@ def make_zero_apply(optimizer, zero, jit_kwargs=None):
 
     def init(params):
         layout, _, _ = _programs(params)
-        flat = layout.pack(jax.tree.leaves(params))
-        count = jnp.zeros((size,), jnp.int32)
-        if master:
-            m_dtype = hyper.get("master_dtype", jnp.float32)
-            master_flat = tuple(jnp.array(f, m_dtype) for f in flat)
-            opt = ZeroMasterAdamState(
-                count=count, master=master_flat,
-                mu=tuple(jnp.zeros_like(m) for m in master_flat),
-                nu=tuple(jnp.zeros_like(m) for m in master_flat))
-            params = jax.tree.map(
-                lambda p: p.astype(hyper["compute_dtype"]), params)
-        else:
-            opt = ZeroAdamState(
-                count=count,
-                mu=tuple(jnp.zeros_like(f) for f in flat),
-                nu=tuple(jnp.zeros_like(f) for f in flat))
-        return params, opt
+        return zero_state_init(hyper, layout, params, size)
 
     def apply_fn(grads, params, opt):
         _, _, fn = _programs(params)

@@ -24,8 +24,12 @@ def snapshot():
 
 
 def metrics_reset():
-    """Zero the registry (tests / interactive sessions only)."""
+    """Zero the registry (tests / interactive sessions only). Also
+    forgets the open window's owner: the core discards the window
+    itself, so a stale owner would wrongly block the next driver."""
+    global _window_owner
     _basics.metrics_reset()
+    _window_owner = None
 
 
 def wire_bytes(snap=None):
@@ -64,17 +68,44 @@ def wire_plane_bytes(snap=None):
             w.get("tx_logical_bytes", 0) - cross_l, cross, cross_l)
 
 
-def step_mark(begin=True):
+#: who opened the currently-open step window (None = no window, or a
+#: legacy caller that did not declare itself). Owner strings in use:
+#: "StepTimer" (explicit scope) and "optimizer" (the fused optimizer's
+#: implicit boundary). Core step ids RESTART after metrics_reset(), so
+#: id comparison alone cannot tell "my window" from "someone else's
+#: window that reused my id" — the owner can.
+_window_owner = None
+
+
+def step_mark(begin=True, owner=None):
     """Mark a step boundary (see ``HorovodBasics.step_mark``); returns
     the step id. The StepTimer calls this at its own boundaries so the
     core-side overlap ledger and the Python wall clock scope the same
-    window."""
-    return _basics.step_mark(begin)
+    window.
+
+    ``owner`` names the driver opening the window; it is recorded
+    python-side (:func:`window_owner`) so the two step-scoping drivers
+    — an explicit StepTimer scope and the fused optimizer's implicit
+    boundary — can detect each other and keep ONE owner per window
+    instead of silently fragmenting the overlap ledger's attribution.
+    A ``begin=False`` close always clears the owner.
+    """
+    global _window_owner
+    sid = _basics.step_mark(begin)
+    _window_owner = owner if begin else None
+    return sid
 
 
 def step_id():
     """The currently open step id, or -1."""
     return _basics.step_id()
+
+
+def window_owner():
+    """Who opened the currently-open step window (``step_mark``'s
+    ``owner``), or None when no window is open / the opener did not
+    declare itself."""
+    return _window_owner
 
 
 def wire_overlap(snap=None):

@@ -162,7 +162,7 @@ class StepTimer:
         # docs/metrics.md "Step anatomy") AFTER the byte snapshot so
         # the window brackets exactly what this step moves.
         try:
-            self._step_id = _core.step_mark(True)
+            self._step_id = _core.step_mark(True, owner="StepTimer")
         except Exception:  # noqa: BLE001 — core not built/loaded
             self._step_id = None
         self._t0 = time.perf_counter()
@@ -183,6 +183,22 @@ class StepTimer:
         # step's wire spans on kStepEnd, so the read below sees this
         # step's union accounting in wire.overlap.*.last_*.
         if self._step_id is not None:
+            # One owner per window: if another driver re-opened the
+            # window mid-step (the fused optimizer's implicit boundary
+            # racing this explicit scope), the ledger attribution below
+            # would be a half-window masquerading as the full step —
+            # refuse loudly instead of recording garbage.
+            owner = _core.window_owner()
+            if owner != "StepTimer":
+                self._step_id = None
+                self._t0 = None
+                raise RuntimeError(
+                    "StepTimer.end_step(): the step window this timer "
+                    f"opened is now owned by {owner!r} — two step "
+                    "drivers are marking boundaries in the same "
+                    "iteration; scope the step with ONE of the "
+                    "explicit StepTimer or the fused optimizer's "
+                    "implicit boundary (docs/metrics.md)")
             try:
                 _core.step_mark(False)
             except Exception:  # noqa: BLE001
@@ -333,13 +349,13 @@ class StepTimer:
         (docs/metrics.md "Overlap ledger"): ``{plane:
         {mean_exposed_wire_ms, mean_hidden_wire_ms,
         mean_total_wire_ms, overlap_efficiency}}`` plus a combined
-        ``overlap_efficiency`` across planes. ``exposed`` is wall time
-        inside the step with >= 1 transfer in flight (the interval
-        union of wire spans); ``hidden = total - exposed`` is wire
-        time that ran concurrently with other wire traffic — the
-        pipelining/overlap win the jit-lane fusion work must move
-        (ROADMAP item 3). exposed + hidden == total exactly, per step,
-        by construction. The ``mean_`` prefix is deliberate: the
+        ``overlap_efficiency`` across planes. ``exposed`` is wire time
+        that ran while an API thread sat blocked in ``synchronize``
+        (the host had nothing to do but watch the wire); ``hidden =
+        total - exposed`` is wire time that drained while the host
+        kept computing or dispatching — the compute/collective overlap
+        win the jit-lane fusion schedule moves (docs/fusion.md).
+        exposed + hidden == total exactly, per step, by construction. The ``mean_`` prefix is deliberate: the
         snapshot's ``wire.overlap`` and ``/healthz`` expose CUMULATIVE
         ``exposed_wire_ms`` totals under the unprefixed names — the
         two shapes must not share a key. ``None`` until a step

@@ -1,0 +1,138 @@
+"""The HOROVOD_JIT_FUSION knob is a SCHEDULE knob, never a numerics
+knob (docs/fusion.md): fused and unfused lanes must produce
+bit-identical loss trajectories and parameters.
+
+Two lanes, both pinned:
+
+- the jit lane — ``make_split_train_step(zero=...)`` one-program fused
+  step (``parallel.fusion.make_fused_zero_programs``, reordered jaxpr)
+  vs the unfused split step, under the vmap(axis_name) emulation;
+- the host lane — ``hvd.make_fused_train_step`` over real OS ranks on
+  the loopback ring: segmented backward + interleaved eager
+  reduce-scatters + next-step-deferred allgathers vs the
+  bulk-synchronous schedule.
+"""
+
+import numpy as np
+import pytest
+
+from tests.utils_mp import run_ranks
+
+pytestmark = pytest.mark.quick
+
+_SHAPES = {"w1": (16, 32), "w2": (32, 16), "b2": (16,), "w3": (16, 4)}
+
+
+def _bits(a):
+    return np.asarray(a).view(np.uint32)
+
+
+def _mlp_setup():
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, batch):
+        h = jnp.tanh(batch["x"] @ params["w1"])
+        h = jnp.tanh(h @ params["w2"] + params["b2"])
+        logits = h @ params["w3"]
+        return jnp.mean((logits - batch["y"]) ** 2)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), len(_SHAPES))
+    params = {name: (jnp.zeros(shape) if len(shape) == 1 else
+                     jax.random.normal(k, shape) * 0.1)
+              for k, (name, shape) in zip(keys, _SHAPES.items())}
+    batch = {"x": jax.random.normal(jax.random.PRNGKey(7), (8, 16)),
+             "y": jax.random.normal(jax.random.PRNGKey(8), (8, 4))}
+    return loss_fn, params, batch
+
+
+def _worker_host_lane(rank, size):
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.parallel import fusion
+
+    hvd.init()
+    try:
+        loss_fn, params, batch = _mlp_setup()
+        copy = lambda t: jax.tree.map(jnp.array, t)  # noqa: E731
+        steps = 4
+        init, step, finish = hvd.make_fused_train_step(
+            loss_fn, 1e-2, bucket_bytes=2048)
+
+        def run(fused):
+            fusion.set_jit_fusion(fused)
+            carry = init(copy(params))
+            losses = []
+            for i in range(steps):
+                loss, carry = step(carry, batch)
+                losses.append(np.asarray(loss))
+                # Fused: params lag one step (allgathers in flight);
+                # unfused: materialized before step returns.
+                assert (carry[2] is not None) == fused
+            p, carry = finish(carry)
+            assert carry[2] is None
+            return losses, p
+
+        losses_f, params_f = run(True)
+        losses_u, params_u = run(False)
+        for lf, lu in zip(losses_f, losses_u):
+            assert np.array_equal(_bits(lf), _bits(lu)), (lf, lu)
+        for k in params:
+            assert np.array_equal(_bits(params_f[k]),
+                                  _bits(params_u[k])), k
+        return [float(x) for x in losses_f]
+    finally:
+        from horovod_tpu.parallel.fusion import set_jit_fusion
+
+        set_jit_fusion(None)
+        hvd.shutdown()
+
+
+def test_host_lane_fused_matches_unfused_bitwise():
+    results = run_ranks(_worker_host_lane, 2, timeout=240)
+    # Replicated params + identical batches: every rank must see the
+    # identical trajectory.
+    assert all(r == results[0] for r in results)
+
+
+@pytest.mark.parametrize("microbatches", [1, 2])
+def test_jit_lane_fused_matches_unfused_bitwise(microbatches):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.parallel import fusion
+    from horovod_tpu.parallel.precision import fused_adam
+    from horovod_tpu.parallel.train_step import make_split_train_step
+    from horovod_tpu.parallel.zero import ZeroConfig
+
+    loss_fn, params, batch = _mlp_setup()
+    copy = lambda t: jax.tree.map(jnp.array, t)  # noqa: E731
+    zero = ZeroConfig(size=4, bucket_bytes=1024)
+
+    def run(fused):
+        fusion.set_jit_fusion(fused)
+        try:
+            ts = make_split_train_step(loss_fn, fused_adam(1e-2),
+                                       zero=zero,
+                                       microbatches=microbatches)
+            carry = ts.init(copy(params))
+            losses = []
+            for _ in range(4):
+                loss, carry = ts.step(carry, batch)
+                losses.append(np.asarray(loss))
+            return losses, carry[0]
+        finally:
+            fusion.set_jit_fusion(None)
+
+    losses_f, params_f = run(True)
+    losses_u, params_u = run(False)
+    for lf, lu in zip(losses_f, losses_u):
+        assert np.array_equal(_bits(lf), _bits(lu)), (lf, lu)
+    for leaf_f, leaf_u in zip(jax.tree.leaves(params_f),
+                              jax.tree.leaves(params_u)):
+        assert np.array_equal(_bits(leaf_f), _bits(leaf_u))
+    # The knob actually changed the traced schedule: the fused lane is
+    # ONE program whose jaxpr carries the reduce-scatters interleaved.
+    assert losses_f[0] == losses_u[0]

@@ -216,6 +216,95 @@ def test_c6_counts_through_loops():
     assert "only 1 subsequent allgather(s)" in diags[0].message
 
 
+def _bunched(x, w):
+    """Backward-shaped fixture: ALL the arithmetic, then every bucket's
+    reduce-scatter bunched at the tail — the pre-fusion split-step
+    schedule C7 exists to reject."""
+    a = x @ w
+    b = jnp.tanh(a) @ w
+    s1 = lax.psum_scatter(a.reshape(-1), "data", scatter_dimension=0,
+                          tiled=True)
+    s2 = lax.psum_scatter(b.reshape(-1), "data", scatter_dimension=0,
+                          tiled=True)
+    ga = lax.all_gather(s1, "data", axis=0, tiled=True)
+    gb = lax.all_gather(s2, "data", axis=0, tiled=True)
+    return ga, gb
+
+
+def test_c7_tail_bunched_scatters_fire():
+    x, w = jnp.ones((8, 8)), jnp.ones((8, 8))
+    diags = analysis.lint(_bunched, (x, w), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C7"]
+    assert diags[0].severity == analysis.ERROR
+    assert "bunched" in diags[0].message
+    assert "HOROVOD_JIT_FUSION" in diags[0].hint
+
+
+def test_c7_quiet_on_interleaved_schedule():
+    """The SAME collectives interleaved with the compute — each
+    scatter issued the moment its operand is ready — must pass.
+    ``parallel.fusion.interleave_collectives`` produces exactly this
+    shape from the bunched one (pinned end-to-end by the registered
+    ``zero1_fused_step`` program staying clean)."""
+    def interleaved(x, w):
+        a = x @ w
+        s1 = lax.psum_scatter(a.reshape(-1), "data",
+                              scatter_dimension=0, tiled=True)
+        b = jnp.tanh(a) @ w
+        s2 = lax.psum_scatter(b.reshape(-1), "data",
+                              scatter_dimension=0, tiled=True)
+        ga = lax.all_gather(s1, "data", axis=0, tiled=True)
+        gb = lax.all_gather(s2, "data", axis=0, tiled=True)
+        return ga, gb
+
+    x, w = jnp.ones((8, 8)), jnp.ones((8, 8))
+    assert analysis.lint(interleaved, (x, w), axis_env=_ENV) == []
+
+
+def test_c7_quiet_on_reorder_pass_output():
+    """Feeding the bunched fixture through the actual fusion pass must
+    flip its verdict: the reordered jaxpr replayed via jaxpr_as_fun
+    lints clean while the original fires.  Operands are 16x16 (> the
+    pass's 64-element hoist threshold) so the dots count as real
+    compute to weave the scatters into."""
+    from horovod_tpu.parallel.fusion import (
+        _jcore,
+        interleave_collectives,
+    )
+
+    x, w = jnp.ones((16, 16)), jnp.ones((16, 16))
+    closed = jax.make_jaxpr(_bunched, axis_env=[("data", 2)])(x, w)
+    fixed = _jcore.jaxpr_as_fun(interleave_collectives(closed))
+    assert analysis.lint(fixed, (x, w), axis_env=_ENV) == []
+
+
+def test_c7_quiet_on_eager_lane_and_single_bucket():
+    """No collectives in the jaxpr (the eager lane moves bytes outside
+    jit) -> quiet; a single scatter (one bucket cannot interleave with
+    itself) -> quiet; a pure-wire program (no flop mass) -> quiet."""
+    def eager_shaped(x, w):
+        return jnp.tanh(x @ w) @ w
+
+    def single(x, w):
+        a = jnp.tanh(x @ w) @ w
+        s = lax.psum_scatter(a.reshape(-1), "data",
+                             scatter_dimension=0, tiled=True)
+        return lax.all_gather(s, "data", axis=0, tiled=True)
+
+    def pure_wire(x):
+        s1 = lax.psum_scatter(x, "data", scatter_dimension=0,
+                              tiled=True)
+        g1 = lax.all_gather(s1, "data", axis=0, tiled=True)
+        s2 = lax.psum_scatter(g1, "data", scatter_dimension=0,
+                              tiled=True)
+        return lax.all_gather(s2, "data", axis=0, tiled=True)
+
+    x, w = jnp.ones((8, 8)), jnp.ones((8, 8))
+    assert analysis.lint(eager_shaped, (x, w), axis_env=_ENV) == []
+    assert analysis.lint(single, (x, w), axis_env=_ENV) == []
+    assert analysis.lint(pure_wire, (jnp.ones(8),), axis_env=_ENV) == []
+
+
 def test_allowlist_suppresses_by_id_and_path():
     def prog(x):
         return lax.psum(x.astype(jnp.float32), "data")

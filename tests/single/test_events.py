@@ -124,8 +124,10 @@ def test_step_marks_scope_ledger_and_events(hvd_core):
         p = ov1[plane]
         # The reconciliation contract: exact, not approximate.
         assert p["exposed_us"] + p["hidden_us"] == p["total_us"], ov1
-    # The selftest's 4 concurrent planes overlap each other: wire time
-    # was hidden, and the first window booked it all (intra plane).
+    # The selftest's wire never blocks an API thread in hvdtpu_wait
+    # (it runs inline in the selftest call), so every span is hidden
+    # under host activity; the first window booked it all (intra
+    # plane).
     intra = {k: ov1["intra"][k] - ov0["intra"][k]
              for k in ("total_us", "hidden_us", "exposed_us")}
     assert intra["total_us"] > 0 and intra["hidden_us"] > 0, ov1

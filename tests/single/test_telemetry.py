@@ -454,7 +454,14 @@ def test_step_timer_overlap_summary(monkeypatch):
                  }},
     }
     monkeypatch.setattr(tcore, "snapshot", lambda: snap)
-    monkeypatch.setattr(tcore, "step_mark", lambda begin=True: 1)
+
+    def fake_mark(begin=True, owner=None):
+        # Mirror the real step_mark's owner bookkeeping: end_step
+        # asserts the window is still the timer's before closing it.
+        tcore._window_owner = owner if begin else None
+        return 1
+
+    monkeypatch.setattr(tcore, "step_mark", fake_mark)
     timer = telemetry.StepTimer(block=False)
     for _ in range(2):
         timer.start_step()
@@ -470,6 +477,101 @@ def test_step_timer_overlap_summary(monkeypatch):
     # Combined: hidden 7ms of total 12ms.
     assert ov["overlap_efficiency"] == pytest.approx(7 / 12)
     assert timer.summary()["overlap"] is not None
+
+
+class _FakeBasics:
+    """Just enough of HorovodBasics' step-window surface to replay the
+    id-reuse collision python-side: ids restart after metrics_reset,
+    exactly like the core registry."""
+
+    def __init__(self):
+        self.next_id = 0
+        self.open = -1
+
+    def step_mark(self, begin=True):
+        if begin:
+            self.open = self.next_id
+            self.next_id += 1
+            return self.open
+        sid, self.open = self.open, -1
+        return sid
+
+    def step_id(self):
+        return self.open
+
+    def metrics_reset(self):
+        self.next_id = 0
+        self.open = -1
+
+
+def test_step_window_single_owner_after_id_reuse(monkeypatch):
+    """Regression: an explicit StepTimer scope and the fused
+    optimizer's implicit boundary in the same iteration must keep ONE
+    owner per window. Core step ids restart after metrics_reset(), so
+    the optimizer's remembered boundary id can collide with a
+    StepTimer-opened window — the id-only deference check then stole
+    the window mid-step, splitting the step's overlap ledger across
+    two half-windows."""
+    from horovod_tpu.jax import optimizer as hvd_opt
+    from horovod_tpu.telemetry import core as tcore
+
+    monkeypatch.setattr(tcore, "_basics", _FakeBasics())
+    monkeypatch.setattr(tcore, "_window_owner", None)
+    monkeypatch.setattr(hvd_opt, "_last_boundary_id", None)
+
+    # Implicit lane first: the optimizer marks a boundary (window 0)
+    # and remembers its id.
+    hvd_opt._mark_optimizer_step()
+    assert tcore.step_id() == 0
+    assert tcore.window_owner() == "optimizer"
+    assert hvd_opt._last_boundary_id == 0
+
+    # A registry reset (bench phase change, test isolation) restarts
+    # the core's ids...
+    tcore.metrics_reset()
+    assert tcore.window_owner() is None
+
+    # ...so the next explicit scope REUSES id 0.
+    timer = telemetry.StepTimer(block=False)
+    timer.start_step()
+    assert tcore.step_id() == 0  # collides with the remembered id
+
+    # The optimizer's implicit boundary inside the timed iteration must
+    # defer to the explicit scope despite the id collision.
+    hvd_opt._mark_optimizer_step()
+    assert tcore.step_id() == 0
+    assert tcore.window_owner() == "StepTimer"
+
+    # The timer closes its own window cleanly.
+    timer.end_step()
+    assert tcore.step_id() == -1
+    assert tcore.window_owner() is None
+
+    # Implicit lane still drives the marks when no explicit scope is
+    # active.
+    hvd_opt._mark_optimizer_step()
+    assert tcore.window_owner() == "optimizer"
+
+
+def test_step_timer_refuses_stolen_window(monkeypatch):
+    """A window re-opened by another driver mid-step fails loudly at
+    end_step instead of booking a fragmented half-window."""
+    from horovod_tpu.telemetry import core as tcore
+
+    monkeypatch.setattr(tcore, "_basics", _FakeBasics())
+    monkeypatch.setattr(tcore, "_window_owner", None)
+
+    timer = telemetry.StepTimer(block=False)
+    timer.start_step()
+    # Rogue second driver closes and re-opens the window mid-step.
+    tcore.step_mark(False)
+    tcore.step_mark(True, owner="optimizer")
+    with pytest.raises(RuntimeError, match="owned by 'optimizer'"):
+        timer.end_step()
+    # The timer reset its scope: the next start/end pair is usable.
+    tcore.step_mark(False)
+    timer.start_step()
+    timer.end_step()
 
 
 # ---- cross-rank trace merge -------------------------------------------
