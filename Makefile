@@ -22,6 +22,7 @@ TSAN_OUT := horovod_tpu/lib/libhvdtpu_core_tsan.so
 ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
 
 .PHONY: core tf clean test test-quick test-flaky lint lint-csrc \
+  model-check \
   core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
   reshard-smoke chaos-smoke obs-smoke scale-smoke perf-smoke \
   serve-smoke wire-smoke fusion-smoke
@@ -58,12 +59,20 @@ lint-csrc:
 	  $(SRC) $(LDFLAGS) -o /dev/null
 	@echo "lint-csrc: clean ($(words $(SRC)) files, -Werror -Wall -Wextra)"
 
+# hvdcheck: exhaustive protocol model checking (elastic / wire /
+# serving control planes) + the seeded-mutant suite + the csrc<->Python
+# ABI drift guards. Pure Python, no jax, sub-second — see
+# docs/analysis.md ("hvdcheck").
+model-check:
+	$(PYTHON) -m horovod_tpu.analysis.model --all
+
 # Python lint: ruff (when installed — the driver container does not
 # ship it; config lives in pyproject.toml) + an hvdlint static-analysis
-# pass over every shipped program (see docs/analysis.md).
-lint:
+# pass over every shipped program + the hvdcheck protocol/ABI gate
+# (see docs/analysis.md).
+lint: model-check
 	@if command -v ruff >/dev/null 2>&1; then \
-	  ruff check horovod_tpu/parallel horovod_tpu/analysis bench.py; \
+	  ruff check horovod_tpu bench.py; \
 	else \
 	  echo "lint: ruff not installed; skipping style pass"; \
 	fi

@@ -7,7 +7,7 @@ response-cache consistency checks, csrc/controller.cc); a TPU-native
 rebuild can catch the whole class BEFORE launch by analyzing the jitted
 program. This package lowers any function the repo jits to a
 ClosedJaxpr, walks every sub-jaxpr, extracts the ordered collective
-signature per control-flow path, and runs the C1-C5 check catalog over
+signature per control-flow path, and runs the C1-C8 check catalog over
 it — see docs/analysis.md.
 
 Library entry point::
@@ -17,6 +17,17 @@ Library entry point::
     assert not analysis.errors(diags)
 
 CLI: ``python -m horovod_tpu.analysis.lint --all``.
+
+Two further static gates live here (both jax-free):
+
+- :mod:`horovod_tpu.analysis.model` — **hvdcheck**, exhaustive
+  protocol model checking for the elastic/wire/serving control planes
+  plus the csrc<->Python ABI drift guards
+  (``python -m horovod_tpu.analysis.model --all`` / ``make
+  model-check``).
+- :func:`validate_chaos_spec` — the strict ``HOROVOD_FAULT_INJECT``
+  grammar parse (``analysis/chaos.py``), so CI rejects malformed
+  chaos specs that would silently stay disarmed.
 """
 
 from horovod_tpu.analysis.diagnostics import (  # noqa: F401
@@ -26,6 +37,11 @@ from horovod_tpu.analysis.diagnostics import (  # noqa: F401
     Diagnostic,
     errors,
     filter_allowed,
+)
+from horovod_tpu.analysis.chaos import (  # noqa: F401
+    ChaosSpecError,
+    FaultSpec,
+    validate_chaos_spec,
 )
 from horovod_tpu.analysis.extract import (  # noqa: F401
     Branches,

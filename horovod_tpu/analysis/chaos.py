@@ -1,0 +1,197 @@
+"""Static validation of the ``HOROVOD_FAULT_INJECT`` chaos grammar.
+
+The C side (``ParseFaultSpec``, csrc/operations.cc) is deliberately
+strict: ANY malformed spec keeps the trigger disarmed, because a
+lenient parse that read garbage as ``0:0`` would kill rank 0 at its
+first collective. Strictness has a flip side — a typo'd spec in a CI
+chaos job silently tests *nothing*. This module mirrors the grammar
+decision-for-decision so test authors and CI can reject a bad spec
+*before* launching a multi-rank job:
+
+    from horovod_tpu import analysis
+    analysis.validate_chaos_spec("1:5:flip:17:2:0")   # -> FaultSpec
+    analysis.validate_chaos_spec("1:5:flip:17:")      # ChaosSpecError
+
+or from the shell::
+
+    python -m horovod_tpu.analysis.model --chaos-spec "1:5:flip:17:2:0"
+
+Grammar (docs/elastic.md, docs/wire.md)::
+
+    <rank>:<op>[:<action>[:<param>[:<skip>[:<chan>]]]]
+
+    kill                 hard-exit at collective #op (no param)
+    stop:<ms>            freeze ms > 0 (the stalled-not-dead shape)
+    reset[:<chan>]       RST peer sockets; optional single stripe chan
+    flip:<bit>           corrupt one wire frame (negative = persistent)
+    flip:<bit>:<skip>    ... after skipping <skip> data frames
+    flip:<bit>:<skip>:<chan>  ... counting only on one stripe channel
+    delay:<ms>           inject a straggler stall ms > 0
+
+The numeric fields follow C ``strtoll`` base-10: optional leading
+whitespace and sign, full consume required. One deliberate divergence:
+values that overflow int64 are *rejected* here (the C parse clamps to
+``LLONG_MAX`` and arms with a garbage value — strictly worse for CI).
+
+Every constant below is pinned against the C sources by the ABI drift
+guards (``analysis.model.abi``), so the two parsers cannot silently
+diverge.
+"""
+
+import dataclasses
+import re
+
+# Order is ABI: index i is csrc/operations.cc FaultAction value i
+# (kFaultKill=0 .. kFaultDelay=4). Pinned by analysis.model.abi.
+ACTIONS = ("kill", "stop", "reset", "flip", "delay")
+
+# csrc/wire.h kMaxWireChannels (ABI-guarded).
+MAX_WIRE_CHANNELS = 8
+
+# flip's packed param layout (csrc/operations.cc kFlipSkipShift /
+# kFlipChanShift): low 20 bits = bit index, bits 20..43 = frames to
+# skip before flipping, bits 44+ = (stripe channel + 1), 0 = no filter.
+FLIP_SKIP_SHIFT = 20
+FLIP_CHAN_SHIFT = 44
+FLIP_BIT_MASK = (1 << FLIP_SKIP_SHIFT) - 1
+FLIP_SKIP_MASK = (1 << (FLIP_CHAN_SHIFT - FLIP_SKIP_SHIFT)) - 1
+
+_INT64_MAX = (1 << 63) - 1
+
+# strtoll base 10 with mandatory full consume: optional leading
+# whitespace, optional sign, digits, nothing after.
+_INT_RE = re.compile(r"[ \t\n\v\f\r]*[+-]?[0-9]+\Z")
+
+
+class ChaosSpecError(ValueError):
+    """A HOROVOD_FAULT_INJECT spec the C parser would leave disarmed."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """A validated fault-inject spec, fields as the C core decodes them.
+
+    ``param`` carries the same packed value ``ParseFaultSpec`` would
+    produce (for ``flip`` the bit/skip/chan fields are packed; the
+    ``flip_*`` properties unpack them again).
+    """
+
+    rank: int
+    op: int
+    action: str
+    param: int
+
+    @property
+    def flip_bit(self):
+        if self.action != "flip":
+            return None
+        return self.param if self.param < 0 else self.param & FLIP_BIT_MASK
+
+    @property
+    def flip_skip(self):
+        if self.action != "flip" or self.param < 0:
+            return None
+        return (self.param >> FLIP_SKIP_SHIFT) & FLIP_SKIP_MASK
+
+    @property
+    def flip_channel(self):
+        """Stripe channel filter, or None when unfiltered."""
+        if self.action != "flip" or self.param < 0:
+            return None
+        chan = self.param >> FLIP_CHAN_SHIFT
+        return chan - 1 if chan > 0 else None
+
+
+def _parse_i64(field, text):
+    if not text:
+        raise ChaosSpecError(f"{field}: empty numeric field")
+    if not _INT_RE.match(text):
+        raise ChaosSpecError(
+            f"{field}: {text!r} is not a base-10 integer "
+            "(strtoll full-consume)")
+    v = int(text)
+    if not -_INT64_MAX - 1 <= v <= _INT64_MAX:
+        raise ChaosSpecError(f"{field}: {text!r} overflows int64")
+    return v
+
+
+def validate_chaos_spec(spec):
+    """Validate a ``HOROVOD_FAULT_INJECT`` spec string.
+
+    Returns a :class:`FaultSpec` on success; raises
+    :class:`ChaosSpecError` (a ``ValueError``) with the reason the C
+    parser would reject it — i.e. the reason the trigger would silently
+    stay disarmed — on failure. Accept/reject agrees with
+    ``ParseFaultSpec`` for every int64-representable spec (pinned by
+    the cross-validation test in tests/single/test_analysis_model.py).
+    """
+    if not isinstance(spec, str):
+        raise ChaosSpecError(f"spec must be a string, got {type(spec)!r}")
+    parts = spec.split(":")
+    if len(parts) < 2 or len(parts) > 6:
+        raise ChaosSpecError(
+            f"expected <rank>:<op>[:<action>[:<param>[:<skip>[:<chan>]]]], "
+            f"got {len(parts)} field(s)")
+    rank = _parse_i64("rank", parts[0])
+    if rank < 0:
+        raise ChaosSpecError(f"rank: must be >= 0, got {rank}")
+    op = _parse_i64("op", parts[1])
+    if op < 0:
+        raise ChaosSpecError(f"op: must be >= 0, got {op}")
+    if len(parts) >= 5 and parts[2] != "flip":
+        raise ChaosSpecError(
+            f"only flip takes <skip>/<chan> fields, not {parts[2]!r}")
+
+    action = "kill"
+    param = 0
+    has_param = len(parts) >= 4
+    if len(parts) >= 3:
+        action = parts[2]
+        if action == "kill":
+            if has_param:
+                raise ChaosSpecError("kill takes no param")
+        elif action in ("stop", "delay"):
+            param = _parse_i64("ms", parts[3]) if has_param else None
+            if param is None or param <= 0:
+                raise ChaosSpecError(f"{action} requires a positive ms param")
+        elif action == "reset":
+            param = -1
+            if has_param:
+                param = _parse_i64("chan", parts[3])
+                if not 0 <= param < MAX_WIRE_CHANNELS:
+                    raise ChaosSpecError(
+                        f"reset channel must be in [0, {MAX_WIRE_CHANNELS}), "
+                        f"got {param}")
+        elif action == "flip":
+            if not has_param:
+                raise ChaosSpecError("flip requires a bit index")
+            param = _parse_i64("bit", parts[3])
+            # A non-negative bit must fit the packed low field even
+            # WITHOUT a skip (negative = persistent |bit|, never
+            # packed).
+            if param > FLIP_BIT_MASK:
+                raise ChaosSpecError(
+                    f"flip bit must be <= {FLIP_BIT_MASK}, got {param}")
+            if len(parts) >= 5:
+                if param < 0:
+                    raise ChaosSpecError(
+                        "persistent (negative-bit) flip cannot take "
+                        "<skip>/<chan> — one-shot only")
+                skip = _parse_i64("skip", parts[4])
+                if not 0 <= skip <= FLIP_SKIP_MASK:
+                    raise ChaosSpecError(
+                        f"flip skip must be in [0, {FLIP_SKIP_MASK}], "
+                        f"got {skip}")
+                param |= skip << FLIP_SKIP_SHIFT
+                if len(parts) == 6:
+                    chan = _parse_i64("chan", parts[5])
+                    if not 0 <= chan < MAX_WIRE_CHANNELS:
+                        raise ChaosSpecError(
+                            f"flip channel must be in "
+                            f"[0, {MAX_WIRE_CHANNELS}), got {chan}")
+                    param |= (chan + 1) << FLIP_CHAN_SHIFT
+        else:
+            raise ChaosSpecError(
+                f"unknown action {action!r} "
+                f"(expected one of {', '.join(ACTIONS)})")
+    return FaultSpec(rank=rank, op=op, action=action, param=param)

@@ -1,4 +1,4 @@
-"""The hvdlint check catalog (C1-C7) over an extracted signature.
+"""The hvdlint check catalog (C1-C8) over an extracted signature.
 
 Each check is a pure function ``(extraction, context) -> [Diagnostic]``;
 :func:`run_all` applies every shipped check. See docs/analysis.md for
@@ -11,6 +11,7 @@ from horovod_tpu.analysis import diagnostics as D
 from horovod_tpu.analysis.extract import (
     Branches,
     Collective,
+    Loop,
     iter_nodes,
     linearize,
 )
@@ -314,6 +315,41 @@ def check_collective_interleaving(ex, ctx):
         source=source)]
 
 
+def check_rank_dependent_trip_count(ex, ctx):
+    """C8: collectives inside a loop whose trip count is rank-tainted.
+
+    C1 catches collective sequences that diverge across *branches*; a
+    ``while_loop`` whose cond derives (transitively, through the
+    carry) from ``lax.axis_index`` diverges across *iteration counts*
+    — rank A runs the body k times, rank B k+1 times, so B's last
+    collective rendezvouses with nothing and every rank deadlocks.
+    extract.py's while walker runs the same carry-taint fixpoint scan
+    uses and records cond-output taint as ``Loop.trip_rank_dependent``
+    (scans have a static trip count and are always quiet).
+    """
+    out = []
+    for node in iter_nodes(ex.signature):
+        if not isinstance(node, Loop) or not node.trip_rank_dependent:
+            continue
+        colls = [c for c in iter_nodes(node.body)
+                 if isinstance(c, Collective)]
+        if not colls:
+            continue
+        prims = sorted({c.prim for c in colls})
+        out.append(D.make(
+            "C8", node.path,
+            f"{len(colls)} collective(s) ({', '.join(prims)}) inside a "
+            "while_loop whose trip count derives from lax.axis_index — "
+            "ranks run different iteration counts, so the extra "
+            "iterations' collectives rendezvous with nothing: "
+            "guaranteed deadlock",
+            hint="make the trip count rank-invariant (psum/pmax the "
+                 "bound before the loop), or hoist the collective out "
+                 "of the loop and mask per-iteration contributions",
+            source=node.source or colls[0].source))
+    return out
+
+
 ALL_CHECKS = (
     check_collective_divergence,
     check_axis_validity,
@@ -322,6 +358,7 @@ ALL_CHECKS = (
     check_schedule_conformance,
     check_shard_collective_pairing,
     check_collective_interleaving,
+    check_rank_dependent_trip_count,
 )
 
 

@@ -31,6 +31,11 @@ check ids are stable API (tests assert them, allowlists name them):
   compute can hide the wire time (docs/fusion.md: the static twin of
   the eager lane's overlap ledger; ``parallel.fusion``'s reorder pass
   is the fix, ``HOROVOD_JIT_FUSION=0`` the deliberate opt-out).
+- **C8** rank-divergent trip count — a collective inside a
+  ``while_loop`` whose cond derives (transitively, through the carry)
+  from ``lax.axis_index``: ranks run different iteration counts, so
+  the extra iterations' collectives rendezvous with nothing — the
+  cross-iteration deadlock C1's per-branch analysis cannot see.
 """
 
 import dataclasses
@@ -47,6 +52,7 @@ SEVERITIES = {
     "C5": ERROR,
     "C6": ERROR,
     "C7": ERROR,
+    "C8": ERROR,
 }
 
 
@@ -60,7 +66,7 @@ class Diagnostic:
     available.
     """
 
-    id: str              # "C1".."C7"
+    id: str              # "C1".."C8"
     severity: str        # ERROR or WARNING
     path: str            # structural jaxpr path
     message: str         # what is wrong

@@ -75,12 +75,19 @@ class Collective:
 @dataclasses.dataclass(frozen=True)
 class Loop:
     """A scan/while body; its signature repeats ``length`` times
-    (``None`` when the trip count is not static — while loops)."""
+    (``None`` when the trip count is not static — while loops).
+
+    ``trip_rank_dependent`` marks a while loop whose cond output is
+    (transitively) derived from ``lax.axis_index``: ranks run
+    DIFFERENT iteration counts, so any collective in the body
+    rendezvouses across mismatched iterations (C8). Scans always have
+    a static trip count and stay False."""
 
     body: tuple            # tuple of signature nodes
     length: "int | None"
     path: str
     source: str
+    trip_rank_dependent: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -325,22 +332,49 @@ class _Walker:
     def _while(self, eqn, in_t, path):
         p = eqn.params
         sub_path = f"{path}/while" if path else "while"
+        n_carry = len(_closed(p["body_jaxpr"]).outvars)
+        taints = list(in_t)
+        n_donations = len(self.donation_sites)
+        trip_rank_dep = False
         out = []
         body_out_t = None
-        for key in ("cond_jaxpr", "body_jaxpr"):
-            body = p[key]
-            n_in = len(_closed(body).invars)
-            taints = (in_t[-n_in:] if len(in_t) >= n_in
-                      else [any(in_t)] * n_in)
-            nodes, o_t = self.walk(body, taints, sub_path)
-            out.extend(nodes)
-            if key == "body_jaxpr":
-                # While outputs are the carry, which the body re-emits.
-                body_out_t = o_t
+        # Fixpoint over the carry (mirrors _scan): a tainted carry
+        # output taints the next iteration's carry input — and,
+        # through the cond, possibly the trip count itself.
+        for _ in range(3):
+            # Re-walks during the taint fixpoint must not duplicate
+            # recorded donation sites.
+            del self.donation_sites[n_donations:]
+            out = []
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                body = p[key]
+                n_in = len(_closed(body).invars)
+                sub_t = (taints[-n_in:] if len(taints) >= n_in
+                         else [any(taints)] * n_in)
+                nodes, o_t = self.walk(body, sub_t, sub_path)
+                out.extend(nodes)
+                if key == "cond_jaxpr":
+                    # The cond's output IS the loop predicate: taint
+                    # here means the trip count diverges by rank (C8).
+                    trip_rank_dep = trip_rank_dep or any(o_t)
+                else:
+                    # While outputs are the carry, which the body
+                    # re-emits.
+                    body_out_t = o_t
+            changed = False
+            if len(taints) >= n_carry and len(body_out_t) == n_carry:
+                base = len(taints) - n_carry
+                for i, t in enumerate(body_out_t):
+                    if t and not taints[base + i]:
+                        taints[base + i] = True
+                        changed = True
+            if not changed:
+                break
         if not out:
             return [], body_out_t
         return [Loop(body=tuple(out), length=None, path=sub_path,
-                     source=_source_of(eqn))], body_out_t
+                     source=_source_of(eqn),
+                     trip_rank_dependent=trip_rank_dep)], body_out_t
 
     def _cond(self, eqn, in_t, path):
         branches = eqn.params["branches"]

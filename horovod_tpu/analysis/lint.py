@@ -39,7 +39,7 @@ def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m horovod_tpu.analysis.lint",
         description="hvdlint: static SPMD collective-consistency "
-                    "analyzer (checks C1-C7; see docs/analysis.md)")
+                    "analyzer (checks C1-C8; see docs/analysis.md)")
     p.add_argument("--program", action="append", default=[],
                    help="registered program name (repeatable); see "
                         "--list")

@@ -1,0 +1,90 @@
+"""hvdcheck — exhaustive protocol model checking for the control planes.
+
+hvdlint (checks C1–C8) statically covers the jaxpr/SPMD lane; this
+package covers the other place the hard bugs live: the distributed
+control protocols. Each protocol family ships as a small transition
+system over hashable states, and :mod:`checker` explores EVERY
+interleaving of a bounded configuration — local steps, message
+orderings, and injected faults — checking safety invariants, deadlock
+freedom, and done-reachability, with the shortest counterexample
+interleaving printed on failure.
+
+Families (one module each, real protocol + seeded mutants):
+
+- :mod:`elastic` — epoch-fenced re-formation, fault attribution, the
+  keep-old-sockets-open rule, the parole door.
+- :mod:`wire` — striped CRC/NAK/DONE chunk streams, verify-before-
+  reduce, NAK-exhaustion escalation, reader-stops-at-slot-satisfied.
+- :mod:`serving` — the control-round allgather, two-stage outboxes,
+  cancel-before-adopt, evict/requeue, fault re-alignment.
+
+The **seeded mutants** re-introduce this repo's historical protocol
+bugs (the same discipline test_analysis_lint.py applies to C1–C8):
+``make model-check`` fails unless hvdcheck both passes every real
+model AND catches every mutant with a counterexample trace.
+
+:mod:`abi` adds the drift guards pinning the Python twin tables
+(reqtrace phases, basics knob/phase/mode tables, chaos-grammar
+constants, the models' vocabularies) bit-for-bit against the C
+sources.
+
+Entry points: ``python -m horovod_tpu.analysis.model --all`` /
+``make model-check``; docs/analysis.md ("hvdcheck") is the manual.
+"""
+
+from horovod_tpu.analysis.model.checker import (  # noqa: F401
+    CheckResult, Violation, check, format_trace, replay)
+from horovod_tpu.analysis.model.elastic import ElasticModel
+from horovod_tpu.analysis.model.serving import ServingModel
+from horovod_tpu.analysis.model.wire import WireModel
+
+# Bounded wire configs: A exercises striping + NAK + escalation, B the
+# back-to-back-transfer slot handoff (the r14 window).
+_WIRE_A = dict(n_chunks=3, channels=2, transfers=1, corrupts=2, retries=0)
+_WIRE_B = dict(n_chunks=2, channels=1, transfers=2, corrupts=0)
+
+
+def real_models():
+    """The bounded real-protocol instances ``--all`` checks."""
+    return [
+        ElasticModel(n_ranks=3, kills=1, knocks=1),
+        WireModel(**_WIRE_A),
+        WireModel(**_WIRE_B),
+        ServingModel(n_decode=2, n_requests=2, kills=1, rejects=1),
+    ]
+
+
+# name -> (model factory, the historical bug it re-introduces). Every
+# entry must be CAUGHT (checker returns a violation) for model-check
+# to pass.
+MUTANTS = {
+    "elastic.parole_refreeze": (
+        lambda: ElasticModel(mutation="parole_refreeze"),
+        "r14: release() popped the frozen snapshot; a survivor polling "
+        "after release re-froze an empty pending set -> split-brain "
+        "world size"),
+    "elastic.early_socket_close": (
+        lambda: ElasticModel(mutation="early_socket_close"),
+        "r12: survivor tore down old-ring sockets at its own commit; "
+        "a slower survivor's probe read false EOF -> live rank marked "
+        "certain-dead"),
+    "wire.reduce_before_verify": (
+        lambda: WireModel(**_WIRE_A, mutation="reduce_before_verify"),
+        "wire contract: payload handed to ReduceInto before its CRC "
+        "verified -> corrupt data in the accumulator"),
+    "wire.read_past_slot": (
+        lambda: WireModel(**_WIRE_B, mutation="read_past_slot"),
+        "r14: reader kept draining after its slot was satisfied; the "
+        "next transfer's first frame was misfiled as a duplicate -> "
+        "transfer never completes"),
+    "serving.retire_on_send": (
+        lambda: ServingModel(mutation="retire_on_send"),
+        "r18: done outbox drained when the round's payload was built, "
+        "not when delivery was proven; a mid-allgather fault lost the "
+        "only copy of a completion"),
+    "serving.cancel_after_adopt": (
+        lambda: ServingModel(mutation="cancel_after_adopt"),
+        "r18: cancels applied after payload adoption; a same-round "
+        "cancel+reassign dropped the fresh copy instead of the stale "
+        "one"),
+}

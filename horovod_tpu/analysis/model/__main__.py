@@ -1,0 +1,129 @@
+"""CLI for hvdcheck: ``python -m horovod_tpu.analysis.model``.
+
+``--all`` (what ``make model-check`` runs) checks every bounded real
+model, proves every seeded mutant is caught with a counterexample
+interleaving, and runs the ABI drift guards. Individual pieces:
+``--model elastic|wire|serving``, ``--mutants``, ``--abi``,
+``--chaos-spec SPEC``, ``--list``.
+"""
+
+import argparse
+import sys
+import time
+
+from horovod_tpu.analysis import chaos
+from horovod_tpu.analysis import model as hvdcheck
+from horovod_tpu.analysis.model import abi
+
+
+def _family(name):
+    return name.name.split("(", 1)[0]
+
+
+def _check_real(models):
+    failed = 0
+    for m in models:
+        t0 = time.monotonic()
+        res = hvdcheck.check(m)
+        dt = time.monotonic() - t0
+        print(f"{res.format()}  [{dt:.2f}s]")
+        if not res.ok:
+            failed += 1
+    return failed
+
+
+def _check_mutants():
+    failed = 0
+    for name, (factory, history) in hvdcheck.MUTANTS.items():
+        model = factory()
+        res = hvdcheck.check(model)
+        if res.ok:
+            print(f"mutant {name}: NOT CAUGHT -- the checker no longer "
+                  f"detects this historical bug ({history})")
+            failed += 1
+        else:
+            v = res.violation
+            print(f"mutant {name}: caught ({v.kind}) -- {history}")
+            print(f"  {v.message}")
+            print(hvdcheck.format_trace(v.trace))
+    return failed
+
+
+def _check_abi():
+    errs = abi.check_abi()
+    if errs:
+        for e in errs:
+            print(f"ABI drift: {e}")
+        return len(errs)
+    print("ABI drift guards: all Python twins pinned to csrc -- OK")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis.model",
+        description="hvdcheck: exhaustive protocol model checking "
+                    "(docs/analysis.md)")
+    p.add_argument("--all", action="store_true",
+                   help="real models + seeded mutants + ABI guards "
+                        "(what `make model-check` runs)")
+    p.add_argument("--model", metavar="FAMILY",
+                   help="check one family's real model(s): "
+                        "elastic | wire | serving")
+    p.add_argument("--mutants", action="store_true",
+                   help="prove every seeded historical bug is caught")
+    p.add_argument("--abi", action="store_true",
+                   help="run the ABI drift guards only")
+    p.add_argument("--chaos-spec", metavar="SPEC",
+                   help="validate a HOROVOD_FAULT_INJECT spec and exit")
+    p.add_argument("--list", action="store_true",
+                   help="list models and seeded mutants")
+    args = p.parse_args(argv)
+
+    if args.chaos_spec is not None:
+        try:
+            spec = chaos.validate_chaos_spec(args.chaos_spec)
+        except chaos.ChaosSpecError as e:
+            print(f"chaos-spec: REJECTED (would stay disarmed): {e}")
+            return 1
+        extra = ""
+        if spec.action == "flip" and spec.flip_bit is not None:
+            extra = (f" bit={spec.flip_bit} skip={spec.flip_skip}"
+                     f" chan={spec.flip_channel}")
+        print(f"chaos-spec: ok -- rank={spec.rank} op={spec.op} "
+              f"action={spec.action} param={spec.param}{extra}")
+        return 0
+
+    if args.list:
+        for m in hvdcheck.real_models():
+            print(f"model   {m.name}")
+        for name, (_, history) in hvdcheck.MUTANTS.items():
+            print(f"mutant  {name}: {history}")
+        return 0
+
+    if not (args.all or args.model or args.mutants or args.abi):
+        p.print_help()
+        return 2
+
+    failed = 0
+    t0 = time.monotonic()
+    if args.all or args.model:
+        models = hvdcheck.real_models()
+        if args.model:
+            models = [m for m in models if _family(m) == args.model]
+            if not models:
+                print(f"unknown model family {args.model!r} "
+                      f"(expected elastic | wire | serving)")
+                return 2
+        failed += _check_real(models)
+    if args.all or args.mutants:
+        failed += _check_mutants()
+    if args.all or args.abi:
+        failed += _check_abi()
+    status = "FAIL" if failed else "OK"
+    print(f"hvdcheck: {status} [{time.monotonic() - t0:.2f}s]")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

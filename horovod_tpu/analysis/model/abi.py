@@ -1,0 +1,227 @@
+"""ABI drift guards: pin the Python twins against the C sources.
+
+Several tables cross the C/Python boundary *by index*, with no runtime
+negotiation — the event vocabulary (``csrc/events.h`` ``EventType``
+and its ``kEventSpecs`` name/arg table), the serving-request lifecycle
+(``RequestPhase`` / ``kRequestPhaseNames`` mirrored by
+``telemetry.reqtrace.REQUEST_PHASES``), the control-plane phase table
+(``metrics.h`` ``ControlPhase`` / ``HorovodBasics.CONTROL_PHASES``),
+the autotuner knob ids (``EventKnob`` / ``kKnobNames`` / the
+``ResponseList`` knob fields and their serialization order), the
+cross-plane mode names, and the chaos-grammar constants mirrored by
+``analysis.chaos``. A silent edit on either side of any of them is a
+wire-format or telemetry corruption that no unit test of one side can
+see.
+
+This module scrapes the C sources with regexes (:func:`scrape_all`)
+and verifies every pinned relationship (:func:`verify`) — including
+the relationships into hvdcheck's own model vocabulary, so the model
+checker's specs cannot drift from the runtime they describe either.
+``verify`` takes the scraped tables as a plain dict precisely so the
+test suite can mutate one entry and prove the guard trips
+(tests/single/test_analysis_model.py round-trips every table).
+"""
+
+import os
+import re
+
+from horovod_tpu.analysis import chaos
+
+# -- Python-side twin tables pinned here (the models' grammars) ---------
+
+# EventKnob id i <-> kKnobNames[i] <-> the rank-uniform ResponseList
+# field the coordinator syncs for it (message.h order == serialization
+# order == this order). kKnobCycleTimeMs deliberately maps to
+# "cycle_time_us": event args are integral, so the event value is in
+# microseconds while the message field stays a double in ms.
+KNOB_TABLE = (
+    ("fusion_bytes", "fusion_threshold_bytes"),
+    ("cycle_time_us", "cycle_time_ms"),
+    ("ring_chunk", "ring_chunk_bytes"),
+    ("wire_compression", "wire_compression"),
+    ("hier_split", "hier_split"),
+    ("wire_channels", "wire_channels"),
+)
+
+# The post-mortem merge tags every timeline entry with its source rank
+# under this key; no event arg may shadow it (csrc/events.cc NB).
+RESERVED_ARG = "rank"
+
+
+def _repo_root():
+    here = os.path.dirname(os.path.abspath(__file__))  # .../analysis/model
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def _read(root, name):
+    with open(os.path.join(root, "csrc", name), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _snake(camel):
+    return re.sub(r"(?<!^)(?=[A-Z0-9])", "_", camel).lower()
+
+
+def _enum_members(text, enum_re, stop=None):
+    m = re.search(enum_re + r"\s*\{(.*?)\};", text, re.S)
+    if not m:
+        return []
+    body = re.sub(r"//[^\n]*", "", m.group(1))
+    names = re.findall(r"\b(k\w+)\b\s*(?:=\s*[\w:]+)?\s*(?:,|$)", body)
+    if stop and stop in names:
+        names = names[:names.index(stop)]
+    return names
+
+
+def _strings(text, anchor):
+    m = re.search(re.escape(anchor) + r"[^{]*\{(.*?)\};", text, re.S)
+    if not m:
+        return []
+    body = re.sub(r"//[^\n]*", "", m.group(1))
+    return re.findall(r'"([^"]*)"', body)
+
+
+def scrape_all(root=None):
+    """Scrape every ABI-bearing table out of the C sources."""
+    root = root or _repo_root()
+    events_h = _read(root, "events.h")
+    events_cc = _read(root, "events.cc")
+    metrics_h = _read(root, "metrics.h")
+    message_h = _read(root, "message.h")
+    message_cc = _read(root, "message.cc")
+    operations_cc = _read(root, "operations.cc")
+    wire_h = _read(root, "wire.h")
+    common_h = _read(root, "common.h")
+
+    t = {}
+    t["event_types"] = _enum_members(
+        events_h, r"enum class EventType : int32_t", stop="kTypeCount")
+    specs_m = re.search(r"kEventSpecs\[[^\]]*\]\s*=\s*\{(.*?)\n\};",
+                        events_cc, re.S)
+    specs_body = re.sub(r"//[^\n]*", "", specs_m.group(1)) if specs_m else ""
+    t["event_specs"] = re.findall(
+        r'\{\s*"([^"]*)"\s*,\s*"([^"]*)"\s*,\s*"([^"]*)"\s*,'
+        r'\s*"([^"]*)"\s*,\s*"([^"]*)"\s*\}', specs_body)
+    t["request_phase_enum"] = _enum_members(
+        events_h, r"enum RequestPhase : int32_t", stop="kReqPhaseCount")
+    t["request_phase_names"] = _strings(events_cc, "kRequestPhaseNames")
+    t["knob_enum"] = _enum_members(events_h, r"enum EventKnob : int32_t")
+    t["knob_names"] = _strings(events_cc, "kKnobNames")
+    t["control_phase_enum"] = _enum_members(
+        metrics_h, r"enum ControlPhase : int32_t", stop="kPhaseCount")
+    t["cross_plane_modes"] = _strings(common_h, "CrossPlaneModeNames")
+
+    struct_m = re.search(r"struct ResponseList\s*\{(.*?)\n\};",
+                         message_h, re.S)
+    struct_body = struct_m.group(1) if struct_m else ""
+    t["response_fields"] = re.findall(
+        r"^\s*(?:std::vector<[^>]+>|std::string|int64_t|int32_t|double|"
+        r"bool)\s+(\w+)\s*(?:=[^;]*)?;", struct_body, re.M)
+    ser_m = re.search(
+        r"std::string SerializeResponseList\((.*?)\n\}", message_cc, re.S)
+    t["response_serial_order"] = re.findall(
+        r"list\.(\w+)\)", ser_m.group(1)) if ser_m else []
+
+    t["fault_actions"] = _enum_members(
+        operations_cc, r"enum FaultAction : int32_t")
+    shift = dict(re.findall(
+        r"constexpr int (kFlip\w+Shift) = (\d+);", operations_cc))
+    t["flip_skip_shift"] = int(shift.get("kFlipSkipShift", -1))
+    t["flip_chan_shift"] = int(shift.get("kFlipChanShift", -1))
+    chan_m = re.search(r"constexpr int kMaxWireChannels = (\d+);", wire_h)
+    t["max_wire_channels"] = int(chan_m.group(1)) if chan_m else -1
+    return t
+
+
+def verify(t):
+    """Check every pinned C<->Python relationship; returns failures."""
+    # The Python twins (imported lazily so a scrape-only caller works
+    # even if the package half is being refactored).
+    from horovod_tpu.common.basics import HorovodBasics
+    from horovod_tpu.telemetry import reqtrace
+
+    errs = []
+
+    def expect(cond, msg):
+        if not cond:
+            errs.append(msg)
+
+    # -- event vocabulary ------------------------------------------------
+    expect(len(t["event_types"]) >= 22,
+           f"EventType scrape too small: {t['event_types']}")
+    derived = tuple(_snake(n[1:]) for n in t["event_types"])
+    spec_names = tuple(s[0] for s in t["event_specs"])
+    expect(derived == spec_names,
+           f"EventType enum vs kEventSpecs name drift: "
+           f"{derived} != {spec_names}")
+    for s in t["event_specs"]:
+        expect(RESERVED_ARG not in s[1:],
+               f"event {s[0]!r} uses reserved arg name {RESERVED_ARG!r} "
+               f"(the post-mortem merge owns that key)")
+
+    # -- serving-request lifecycle ---------------------------------------
+    phases = tuple(t["request_phase_names"])
+    derived = tuple(_snake(n[len("kReq"):]) for n in t["request_phase_enum"])
+    expect(derived == phases,
+           f"RequestPhase enum vs kRequestPhaseNames drift: "
+           f"{derived} != {phases}")
+    expect(tuple(reqtrace.REQUEST_PHASES) == phases,
+           f"reqtrace.REQUEST_PHASES {tuple(reqtrace.REQUEST_PHASES)} != "
+           f"csrc kRequestPhaseNames {phases}")
+    expect(phases and reqtrace.TERMINAL_PHASE == phases[-1],
+           "reqtrace.TERMINAL_PHASE is not the last RequestPhase")
+
+    # -- control-plane phases --------------------------------------------
+    derived = tuple(_snake(n[len("kPhase"):])
+                    for n in t["control_phase_enum"])
+    expect(tuple(HorovodBasics.CONTROL_PHASES) == derived,
+           f"HorovodBasics.CONTROL_PHASES "
+           f"{tuple(HorovodBasics.CONTROL_PHASES)} != metrics.h "
+           f"ControlPhase {derived}")
+
+    # -- cross-plane modes -----------------------------------------------
+    expect(tuple(HorovodBasics.CROSS_PLANE_MODES)
+           == tuple(t["cross_plane_modes"]),
+           f"HorovodBasics.CROSS_PLANE_MODES != common.h "
+           f"CrossPlaneModeNames {t['cross_plane_modes']}")
+
+    # -- autotuner knobs: enum <-> names <-> message fields <-> wire ----
+    expect(tuple(t["knob_names"]) == tuple(k for k, _ in KNOB_TABLE),
+           f"kKnobNames {t['knob_names']} != pinned KNOB_TABLE")
+    expect(len(t["knob_enum"]) == len(KNOB_TABLE),
+           f"EventKnob has {len(t['knob_enum'])} members, KNOB_TABLE "
+           f"pins {len(KNOB_TABLE)}")
+    fields = t["response_fields"]
+    knob_fields = [f for _, f in KNOB_TABLE]
+    expect(all(f in fields for f in knob_fields),
+           f"ResponseList is missing knob field(s): "
+           f"{[f for f in knob_fields if f not in fields]}")
+    present = [f for f in fields if f in knob_fields]
+    expect(present == knob_fields,
+           f"ResponseList declares knob fields as {present}, KNOB_TABLE "
+           f"pins {knob_fields} (order is the knob-id ABI)")
+    ser = [f for f in t["response_serial_order"] if f in knob_fields]
+    expect(ser == knob_fields,
+           f"SerializeResponseList writes knobs as {ser}, expected "
+           f"{knob_fields} (field order IS the wire format)")
+
+    # -- chaos grammar ---------------------------------------------------
+    derived = tuple(_snake(n[len("kFault"):]) for n in t["fault_actions"])
+    expect(tuple(chaos.ACTIONS) == derived,
+           f"chaos.ACTIONS {chaos.ACTIONS} != operations.cc FaultAction "
+           f"{derived}")
+    expect(chaos.FLIP_SKIP_SHIFT == t["flip_skip_shift"],
+           f"chaos.FLIP_SKIP_SHIFT {chaos.FLIP_SKIP_SHIFT} != "
+           f"kFlipSkipShift {t['flip_skip_shift']}")
+    expect(chaos.FLIP_CHAN_SHIFT == t["flip_chan_shift"],
+           f"chaos.FLIP_CHAN_SHIFT {chaos.FLIP_CHAN_SHIFT} != "
+           f"kFlipChanShift {t['flip_chan_shift']}")
+    expect(chaos.MAX_WIRE_CHANNELS == t["max_wire_channels"],
+           f"chaos.MAX_WIRE_CHANNELS {chaos.MAX_WIRE_CHANNELS} != "
+           f"wire.h kMaxWireChannels {t['max_wire_channels']}")
+    return errs
+
+
+def check_abi(root=None):
+    """Scrape the tree and verify; returns a list of drift messages."""
+    return verify(scrape_all(root))

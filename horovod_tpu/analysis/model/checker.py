@@ -1,0 +1,185 @@
+"""hvdcheck's explicit-state engine: exhaustive BFS over interleavings.
+
+A *model* is a small transition system over hashable states (nested
+tuples / NamedTuples). The engine enumerates EVERY reachable state of
+a bounded configuration — every interleaving of local steps, message
+deliveries, and injected faults — and checks three properties:
+
+safety
+    ``model.invariant(state)`` returns a violation message (or None)
+    for each reachable state. One violated state = one counterexample.
+deadlock-freedom
+    a reachable state with no enabled actions that is not ``done`` is
+    a deadlock (the distributed system is wedged: e.g. a receiver
+    waiting on a frame the sender already consumed).
+liveness (reform/done reachability)
+    every reachable state must be able to reach a ``done`` state.
+    Computed by reverse reachability over the explored graph: any
+    reachable state outside the backward-closure of the done set is a
+    livelock — the execution can still take steps forever, but
+    completion has become unreachable (e.g. a completion report
+    drained from its outbox before delivery can never be re-sent).
+
+Counterexamples are the point. Every violation carries the exact
+interleaving that produced it — the shortest one, since the search is
+breadth-first — as a list of action labels, printable with
+:func:`format_trace` as the numbered schedule a human (or the next
+protocol PR's author) can replay against the real code.
+
+Model protocol (duck-typed)::
+
+    model.name        -> str
+    model.initial()   -> iterable of initial states
+    model.actions(s)  -> iterable of (label, next_state)
+    model.invariant(s)-> None | violation message
+    model.done(s)     -> bool
+
+Determinism matters: ``actions`` must be a pure function of the state
+(all nondeterminism — scheduling, faults, message orderings — is
+expressed as multiple actions), which is what makes the search
+exhaustive and the traces replayable (see :func:`replay`).
+"""
+
+import dataclasses
+from collections import deque
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    kind: str          # "invariant" | "deadlock" | "livelock"
+    message: str
+    trace: tuple       # action labels, initial state -> violating state
+
+    def format(self):
+        return (f"{self.kind}: {self.message}\n"
+                + format_trace(self.trace))
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckResult:
+    model: str
+    ok: bool
+    states: int
+    transitions: int
+    violation: object = None   # Violation | None
+
+    def format(self):
+        head = (f"{self.model}: {self.states} states, "
+                f"{self.transitions} transitions")
+        if self.ok:
+            return f"{head} -- OK"
+        return f"{head} -- FAIL\n{self.violation.format()}"
+
+
+def format_trace(trace):
+    """Render a counterexample as a numbered interleaving."""
+    if not trace:
+        return "  (violated in an initial state)"
+    width = len(str(len(trace)))
+    return "\n".join(f"  #{i + 1:<{width}} {label}"
+                     for i, label in enumerate(trace))
+
+
+def _trace_to(state, parents):
+    labels = []
+    while True:
+        entry = parents[state]
+        if entry is None:
+            break
+        state, label = entry
+        labels.append(label)
+    labels.reverse()
+    return tuple(labels)
+
+
+def check(model, max_states=2_000_000):
+    """Exhaustively check ``model``; returns a :class:`CheckResult`.
+
+    Raises ``RuntimeError`` if the reachable space exceeds
+    ``max_states`` — bounded configs are part of a model's contract
+    (ISSUE: keep ``make model-check`` in the seconds).
+    """
+    parents = {}     # state -> None | (pred_state, label)
+    edges = {}       # state -> tuple of successor states
+    queue = deque()
+    n_transitions = 0
+
+    def fail(kind, message, state):
+        return CheckResult(
+            model=model.name, ok=False, states=len(parents),
+            transitions=n_transitions,
+            violation=Violation(kind=kind, message=message,
+                                trace=_trace_to(state, parents)))
+
+    for s0 in model.initial():
+        if s0 not in parents:
+            parents[s0] = None
+            queue.append(s0)
+
+    while queue:
+        state = queue.popleft()
+        msg = model.invariant(state)
+        if msg:
+            return fail("invariant", msg, state)
+        succs = []
+        for label, nxt in model.actions(state):
+            n_transitions += 1
+            succs.append(nxt)
+            if nxt not in parents:
+                if len(parents) >= max_states:
+                    raise RuntimeError(
+                        f"{model.name}: state space exceeds "
+                        f"{max_states} states -- tighten the config")
+                parents[nxt] = (state, label)
+                queue.append(nxt)
+        edges[state] = tuple(succs)
+        if not succs and not model.done(state):
+            return fail(
+                "deadlock",
+                "no enabled actions in a non-terminal state", state)
+
+    # Liveness: reverse reachability from the done set.
+    rev = {s: [] for s in parents}
+    for state, succs in edges.items():
+        for nxt in succs:
+            rev[nxt].append(state)
+    can_finish = set()
+    stack = [s for s in parents if model.done(s)]
+    can_finish.update(stack)
+    while stack:
+        for pred in rev[stack.pop()]:
+            if pred not in can_finish:
+                can_finish.add(pred)
+                stack.append(pred)
+    for state in parents:
+        if state not in can_finish:
+            return fail(
+                "livelock",
+                "completion is unreachable from this state "
+                "(no continuation reaches a done state)", state)
+
+    return CheckResult(model=model.name, ok=True, states=len(parents),
+                       transitions=n_transitions)
+
+
+def replay(model, trace):
+    """Re-execute a counterexample trace label-by-label.
+
+    Returns the state reached. Raises ``AssertionError`` if any label
+    is not enabled where the trace claims it is — the test suite uses
+    this to prove printed counterexamples are real executions, not
+    artifacts of the search.
+    """
+    states = list(model.initial())
+    assert states, f"{model.name}: no initial states"
+    state = states[0]
+    for step, wanted in enumerate(trace):
+        for label, nxt in model.actions(state):
+            if label == wanted:
+                state = nxt
+                break
+        else:
+            raise AssertionError(
+                f"{model.name}: step #{step + 1} {wanted!r} "
+                f"not enabled in replayed state")
+    return state

@@ -1,0 +1,287 @@
+"""hvdcheck model: epoch-fenced elastic re-formation + the parole door.
+
+Abstracts the driverless recovery path of ``common/elastic.py`` /
+docs/elastic.md down to the decisions that have actually gone wrong:
+
+- fault attribution: a dead peer is discovered as *certain* (EOF/RST)
+  or *suspected* (timeout); the MSG_PEEK probe sweep must converge
+  every survivor on the SAME dead set before re-forming.
+- the keep-old-sockets-open ordering rule (r12): survivors keep the
+  OLD ring's sockets open until the new ring is up, because a probe
+  hitting an already-torn-down socket reads as EOF — certain death —
+  for a rank that is alive and mid-reinit.
+- the parole door (r14): joiners knock while an epoch is running;
+  ``freeze(epoch)`` snapshots the pending set ONCE per epoch and the
+  frozen entries are never popped, so a survivor that polls AFTER
+  rank 0 released the assignments still sees the same joiner count.
+
+Per-rank lifecycle: ``run -> probe -> freeze -> run@epoch+1``. Every
+ordering of probes, polls, the coordinator's release, commits and
+socket teardown across ranks is explored, with the fault and the
+joiner knock injectable at every point.
+
+Safety invariants:
+
+- attribution: no live rank ever holds a live peer in its dead set.
+- agreement: any two live ranks running the same epoch agree on the
+  membership (world set + admitted-joiner count) of that epoch.
+
+Liveness: every execution can reach a state where all live ranks run
+the same epoch with equal membership.
+
+Seeded mutants (the historical bugs, re-introduced):
+
+- ``parole_refreeze`` (r14): ``release`` pops the frozen snapshot, so
+  a survivor polling after release re-freezes the (now empty) pending
+  set and commits a smaller world — split-brain on world size.
+- ``early_socket_close`` (r12): a survivor tears down old sockets as
+  soon as IT commits instead of waiting for the ring to be up; a
+  slower survivor's probe then reads false EOF and excludes a live
+  rank from its membership.
+"""
+
+from typing import NamedTuple
+
+from horovod_tpu.analysis.model import checker
+
+# Per-rank phases, in lifecycle order.
+RUN, PROBE, FREEZE = "run", "probe", "freeze"
+
+CERTAIN, SUSPECTED = "certain", "suspected"
+
+
+class Rank(NamedTuple):
+    alive: bool
+    phase: str            # RUN | PROBE | FREEZE
+    epoch: int
+    dead: frozenset       # this rank's converged-so-far dead set
+    probed: frozenset     # peers already probed this recovery
+    joiners: int          # admitted joiner count (-1 = not yet polled)
+    members: frozenset    # membership committed for `epoch`
+    old_open: bool        # old ring's sockets still open
+
+
+class Door(NamedTuple):
+    pending: int          # joiners knocking, not yet frozen
+    frozen: int           # snapshot for the recovery epoch (-1 = none)
+    released: bool        # rank 0 released the assignments
+
+
+class State(NamedTuple):
+    ranks: tuple          # tuple of Rank
+    door: Door
+    kills: int            # remaining fault budget
+    knocks: int           # remaining joiner-arrival budget
+
+
+class ElasticModel:
+    """Bounded elastic re-formation instance.
+
+    ``mutation`` is None for the real protocol, or one of
+    ``"parole_refreeze"`` / ``"early_socket_close"``.
+    """
+
+    def __init__(self, n_ranks=3, kills=1, knocks=1, mutation=None):
+        assert mutation in (None, "parole_refreeze", "early_socket_close")
+        self.n = n_ranks
+        self.mutation = mutation
+        self._kills = kills
+        self._knocks = knocks
+        self.name = f"elastic(n={n_ranks},kills={kills},knocks={knocks}" + (
+            f",mutant={mutation})" if mutation else ")")
+
+    # -- state helpers ---------------------------------------------------
+
+    def initial(self):
+        full = frozenset(range(self.n))
+        rank = Rank(alive=True, phase=RUN, epoch=0, dead=frozenset(),
+                    probed=frozenset(), joiners=0, members=full,
+                    old_open=True)
+        yield State(ranks=(rank,) * self.n,
+                    door=Door(pending=0, frozen=-1, released=False),
+                    kills=self._kills, knocks=self._knocks)
+
+    def _set(self, st, i, **kw):
+        ranks = list(st.ranks)
+        ranks[i] = ranks[i]._replace(**kw)
+        return st._replace(ranks=tuple(ranks))
+
+    def _truly_dead(self, st):
+        return frozenset(i for i, r in enumerate(st.ranks) if not r.alive)
+
+    # -- transitions -----------------------------------------------------
+
+    def actions(self, st):
+        out = []
+        dead = self._truly_dead(st)
+        new_epoch = 1  # one fault budget => at most one recovery epoch
+
+        # Environment: a joiner knocks at the door.
+        if st.knocks > 0:
+            out.append((
+                "env: joiner knocks at the parole door",
+                st._replace(knocks=st.knocks - 1,
+                            door=st.door._replace(
+                                pending=st.door.pending + 1))))
+
+        # Environment: kill a non-coordinator rank (rank 0 survives;
+        # elastic.py's driverless path requires the coordinator).
+        if st.kills > 0:
+            for i in range(1, self.n):
+                if st.ranks[i].alive:
+                    out.append((
+                        f"env: rank{i} dies (SIGKILL)",
+                        self._set(st, i, alive=False)._replace(
+                            kills=st.kills - 1)))
+
+        for i, r in enumerate(st.ranks):
+            if not r.alive:
+                continue
+
+            # run@0 -> probe: notice a fault. EOF/RST gives a CERTAIN
+            # first attribution; a timeout gives SUSPECTED — either
+            # way the probe sweep must confirm every peer.
+            if r.phase == RUN and r.epoch == 0 and dead:
+                j = min(dead)
+                out.append((
+                    f"rank{i}: detects fault on rank{j} via EOF (certain)",
+                    self._set(st, i, phase=PROBE, dead=frozenset([j]),
+                              probed=frozenset([j]))))
+                out.append((
+                    f"rank{i}: detects fault on rank{j} via timeout "
+                    f"(suspected)",
+                    self._set(st, i, phase=PROBE)))
+
+            # probe sweep: MSG_PEEK each unprobed peer, one action per
+            # peer so every probe ordering interleaves with every
+            # other rank's progress.
+            if r.phase == PROBE:
+                unprobed = [j for j in range(self.n)
+                            if j != i and j not in r.probed]
+                for j in unprobed:
+                    if j in dead:
+                        out.append((
+                            f"rank{i}: probe rank{j} -> EOF, certain-dead",
+                            self._set(st, i, dead=r.dead | {j},
+                                      probed=r.probed | {j})))
+                    elif not st.ranks[j].old_open:
+                        # The r12 bug window: peer is alive but its OLD
+                        # sockets are gone, so the probe reads EOF.
+                        # Unreachable in the real model (teardown waits
+                        # for the ring to be up, i.e. everyone past
+                        # probing).
+                        out.append((
+                            f"rank{i}: probe rank{j} -> EOF on torn-down "
+                            f"socket, FALSELY certain-dead",
+                            self._set(st, i, dead=r.dead | {j},
+                                      probed=r.probed | {j})))
+                    else:
+                        out.append((
+                            f"rank{i}: probe rank{j} -> alive "
+                            f"(old socket open)",
+                            self._set(st, i, probed=r.probed | {j})))
+                if not unprobed:
+                    # joiners=-1 flags "door not yet polled for the
+                    # recovery epoch".
+                    out.append((
+                        f"rank{i}: probe sweep converged "
+                        f"(dead={sorted(r.dead)})",
+                        self._set(st, i, phase=FREEZE, joiners=-1)))
+
+            # freeze: poll the parole door (_ParoleDoor.freeze). The
+            # snapshot happens once per epoch; later polls must read
+            # the SAME count — unless the refreeze mutant popped it.
+            if r.phase == FREEZE and r.joiners < 0:
+                door = st.door
+                if door.frozen < 0:
+                    door = door._replace(frozen=door.pending, pending=0)
+                out.append((
+                    f"rank{i}: polls parole door -> {door.frozen} "
+                    f"joiner(s) frozen for epoch {new_epoch}",
+                    self._set(st, i, joiners=door.frozen)._replace(
+                        door=door)))
+
+            # freeze -> run@new: commit the re-formed ring. Membership
+            # = surviving old ranks per MY dead set, plus MY frozen
+            # joiner count. The early-close mutant tears down the old
+            # sockets here, at its own commit.
+            if r.phase == FREEZE and r.joiners >= 0:
+                members = frozenset(range(self.n)) - r.dead
+                nxt = self._set(
+                    st, i, phase=RUN, epoch=new_epoch, members=members,
+                    old_open=(self.mutation != "early_socket_close"))
+                out.append((
+                    f"rank{i}: commits epoch {new_epoch} "
+                    f"(members={sorted(members)}, joiners={r.joiners})",
+                    nxt))
+
+            # coordinator releases the door assignments after ITS
+            # reinit. Real _ParoleDoor.release keeps the frozen
+            # snapshot forever; the refreeze mutant pops it, so the
+            # next poll re-freezes whatever is pending now.
+            if (i == 0 and r.phase == RUN and r.epoch == new_epoch
+                    and st.door.frozen >= 0 and not st.door.released):
+                door = st.door._replace(released=True)
+                if self.mutation == "parole_refreeze":
+                    door = door._replace(frozen=-1)
+                out.append((
+                    "rank0: releases parole assignments "
+                    + ("and POPS the frozen snapshot"
+                       if self.mutation == "parole_refreeze"
+                       else "(frozen snapshot retained)"),
+                    st._replace(door=door)))
+
+            # new ring up -> tear down the OLD ring's sockets. Real
+            # rule (r12): only once every survivor in my membership
+            # has committed the new epoch.
+            if (r.phase == RUN and r.epoch == new_epoch and r.old_open
+                    and self.mutation != "early_socket_close"):
+                ring_up = all(
+                    st.ranks[j].phase == RUN
+                    and st.ranks[j].epoch == new_epoch
+                    for j in r.members if st.ranks[j].alive)
+                if ring_up:
+                    out.append((
+                        f"rank{i}: new ring up -> closes old sockets",
+                        self._set(st, i, old_open=False)))
+
+        return out
+
+    # -- properties ------------------------------------------------------
+
+    def invariant(self, st):
+        dead = self._truly_dead(st)
+        live = [(i, r) for i, r in enumerate(st.ranks) if r.alive]
+        for i, r in live:
+            wrong = r.dead - dead
+            if wrong:
+                j = min(wrong)
+                return (f"attribution: rank{i} holds LIVE rank{j} in its "
+                        f"dead set (false EOF from a torn-down socket)")
+        for i, ri in live:
+            for j, rj in live:
+                if j <= i or ri.phase != RUN or rj.phase != RUN:
+                    continue
+                if ri.epoch != rj.epoch:
+                    continue
+                if ri.members != rj.members or ri.joiners != rj.joiners:
+                    return (
+                        f"agreement: rank{i} and rank{j} both run epoch "
+                        f"{ri.epoch} with different membership "
+                        f"(rank{i}: {sorted(ri.members)}+{ri.joiners} "
+                        f"joiners, rank{j}: {sorted(rj.members)}"
+                        f"+{rj.joiners} joiners) -- split-brain")
+        return None
+
+    def done(self, st):
+        live = [r for r in st.ranks if r.alive]
+        if any(r.phase != RUN for r in live):
+            return False
+        epochs = {r.epoch for r in live}
+        if len(epochs) != 1:
+            return False
+        if len({(r.members, r.joiners) for r in live}) != 1:
+            return False
+        # A knocked joiner may legitimately wait for the next epoch,
+        # but a fault must not strand mid-recovery state.
+        return True

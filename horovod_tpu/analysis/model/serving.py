@@ -1,0 +1,259 @@
+"""hvdcheck model: the serving control round and its fault contract.
+
+Abstracts ``serving/service.py``'s per-round pickled control allgather
+(rank 0 frontend + decode ranks) to the decisions hardened in r18:
+
+- two-stage outboxes: a decode rank re-sends its completion/ack
+  buffers EVERY round; a buffer entry moves ``sent -> inflight`` when
+  a round's allgather succeeds and is *retired* only by the NEXT
+  successful round (which proves the frontend processed it). The
+  frontend deduplicates, so re-sends are free — but draining a buffer
+  before delivery is proven loses the only copy.
+- cancel-before-adopt: a recovery can cancel a rid's possibly-admitted
+  survivor copy AND re-assign the same rid in one control message;
+  the decode rank must apply cancels BEFORE adopting this round's
+  payload, so the stale copy dies and the fresh one lives.
+- fault re-alignment: on a failed round nothing that was in flight is
+  confirmed; recovery requeues every assignment that is unacked or
+  whose rank died, cancels possibly-admitted survivor copies, resets
+  the round counter, and keeps every outbox intact.
+- evict/requeue: a decode rank may reject an assignment (pool full);
+  the frontend re-queues the rid at the head of the pending line.
+
+One round is one atomic transition (that is what an allgather is);
+every interleaving of local decode completions, per-round assignment
+targets, accept/reject choices and mid-round faults is explored.
+
+Safety invariants: every request completes at most once on the
+scoreboard; an *acked* assignment on a live rank is always backed by
+a copy of the request somewhere on that rank (adopted, done-outbox or
+inflight) — the no-lost-completion property. Liveness: every
+execution can still reach all-requests-completed.
+
+Seeded mutants (both r18 bugs):
+
+- ``retire_on_send``: outboxes are drained when a round's payload is
+  built instead of when delivery is proven; a round that faults
+  mid-allgather loses the completion forever.
+- ``cancel_after_adopt``: cancels are applied after payload adoption;
+  a same-round cancel+reassign kills the fresh copy instead of the
+  stale one.
+"""
+
+from typing import NamedTuple
+
+
+class Decode(NamedTuple):
+    rank: int
+    adopted: frozenset    # admitted, not yet finished
+    outbox: frozenset     # finished rids, re-sent until retired
+    acks: frozenset       # admission acks, re-sent until delivered
+    inflight: frozenset   # outbox entries sent on the last ok round
+
+
+class State(NamedTuple):
+    pending: tuple        # frontend's pending line (head = next)
+    assigned: tuple       # sorted ((rid, rank, acked), ...)
+    completed: frozenset  # the scoreboard
+    compl_count: tuple    # per rid: scoreboard commits (exactly-once)
+    cancel_out: frozenset  # cancels riding the next control round
+    decodes: tuple        # sorted Decode per LIVE decode rank
+    kills: int
+    rejects: int
+
+
+class ServingModel:
+    """Bounded serving-round instance.
+
+    ``mutation`` is None for the real protocol, or one of
+    ``"retire_on_send"`` / ``"cancel_after_adopt"``.
+    """
+
+    def __init__(self, n_decode=2, n_requests=2, kills=1, rejects=1,
+                 mutation=None):
+        assert mutation in (None, "retire_on_send", "cancel_after_adopt")
+        self.n_decode = n_decode
+        self.n_requests = n_requests
+        self.mutation = mutation
+        self._kills = kills
+        self._rejects = rejects
+        self.name = (f"serving(decode={n_decode},requests={n_requests},"
+                     f"kills={kills},rejects={rejects}"
+                     + (f",mutant={mutation})" if mutation else ")"))
+
+    def initial(self):
+        yield State(
+            pending=tuple(range(self.n_requests)),
+            assigned=(), completed=frozenset(),
+            compl_count=(0,) * self.n_requests,
+            cancel_out=frozenset(),
+            decodes=tuple(
+                Decode(rank=d, adopted=frozenset(), outbox=frozenset(),
+                       acks=frozenset(), inflight=frozenset())
+                for d in range(1, self.n_decode + 1)),
+            kills=self._kills, rejects=self._rejects)
+
+    # -- transitions -----------------------------------------------------
+
+    def actions(self, st):
+        out = []
+
+        # Local decode progress: finish an adopted request -> the
+        # completion report enters the done outbox.
+        for i, dec in enumerate(st.decodes):
+            for rid in sorted(dec.adopted):
+                decs = list(st.decodes)
+                decs[i] = dec._replace(adopted=dec.adopted - {rid},
+                                       outbox=dec.outbox | {rid})
+                out.append((
+                    f"decode{dec.rank}: finishes rid{rid} -> done outbox",
+                    st._replace(decodes=tuple(decs))))
+
+        # A successful control round, one branch per assignment choice.
+        if st.pending and st.decodes:
+            for dec in st.decodes:
+                out.append(self._round_ok(st, target=dec.rank,
+                                          reject=False))
+                if st.rejects > 0:
+                    out.append(self._round_ok(st, target=dec.rank,
+                                              reject=True))
+        else:
+            out.append(self._round_ok(st, target=None, reject=False))
+
+        # A round that faults mid-allgather: one decode rank dies, the
+        # collective aborts, nobody's payload is delivered. (Rank 0
+        # must survive -- service.py raises otherwise -- and at least
+        # one decode rank must remain for the service to mean
+        # anything, so the bounded config faults only when >= 2 decode
+        # ranks are up.)
+        if st.kills > 0 and len(st.decodes) >= 2:
+            for victim in st.decodes:
+                out.append(self._round_fault(st, victim.rank))
+
+        return out
+
+    def _round_ok(self, st, target, reject):
+        # -- build the frontend's control payload
+        cancels = st.cancel_out
+        assign_rid = st.pending[0] if target is not None else None
+
+        # -- frontend processes the gathered decode reports (it built
+        # its ctl first, so this round's assignment is visible to the
+        # stale-ack path, exactly as in service.py).
+        assigned = {rid: (rank, acked) for rid, rank, acked in st.assigned}
+        pending = list(st.pending)
+        if assign_rid is not None and not reject:
+            assigned[assign_rid] = (target, False)
+            pending.pop(0)
+        completed = set(st.completed)
+        counts = list(st.compl_count)
+        new_cancels = set()
+        for dec in st.decodes:
+            for rid in sorted(dec.acks):
+                if rid in assigned and assigned[rid][0] == dec.rank:
+                    assigned[rid] = (dec.rank, True)
+            for rid in sorted(dec.outbox):
+                if rid in completed:
+                    continue   # idempotent: first completion wins
+                completed.add(rid)
+                counts[rid] = min(counts[rid] + 1, 2)
+                if rid in assigned:
+                    rank, _ = assigned.pop(rid)
+                    if rank != dec.rank:
+                        # duplicate guard: cancel the assigned copy
+                        new_cancels.add(rid)
+                if rid in pending:
+                    pending.remove(rid)
+
+        # -- decode ranks: retire, apply cancels, adopt.
+        decs = []
+        for dec in st.decodes:
+            sent = dec.outbox
+            if self.mutation == "retire_on_send":
+                outbox = frozenset()          # drained at send time
+                inflight = frozenset()
+            else:
+                # two-stage: retire what the frontend provably
+                # processed (last round's inflight), promote this
+                # round's send.
+                outbox = sent - dec.inflight
+                inflight = sent
+            adopted = dec.adopted
+            adopts = frozenset(
+                [assign_rid] if (assign_rid is not None and not reject
+                                 and target == dec.rank) else [])
+            if self.mutation == "cancel_after_adopt":
+                adopted = (adopted | adopts) - cancels
+            else:
+                adopted = (adopted - cancels) | adopts
+            decs.append(dec._replace(
+                adopted=adopted, outbox=outbox, inflight=inflight,
+                acks=adopts))   # delivered acks cleared; fresh ack staged
+        label = "round: ctl allgather ok"
+        if assign_rid is not None:
+            label += (f"; rid{assign_rid} -> decode{target}"
+                      + (" REJECTED (pool full), stays at head"
+                         if reject else ""))
+        if cancels:
+            label += f"; cancels={sorted(cancels)}"
+        return label, st._replace(
+            pending=tuple(pending),
+            assigned=tuple(sorted((rid, rk, ack)
+                           for rid, (rk, ack) in assigned.items())),
+            completed=frozenset(completed), compl_count=tuple(counts),
+            cancel_out=frozenset(new_cancels),
+            decodes=tuple(decs),
+            rejects=st.rejects - (1 if reject else 0))
+
+    def _round_fault(self, st, victim):
+        survivors = []
+        for dec in st.decodes:
+            if dec.rank == victim:
+                continue
+            outbox = (frozenset() if self.mutation == "retire_on_send"
+                      else dec.outbox)   # real: nothing confirmed, keep
+            survivors.append(dec._replace(outbox=outbox,
+                                          inflight=frozenset()))
+        alive = {d.rank for d in survivors}
+        # frontend recovery: requeue anything unacked or on the dead
+        # rank; cancel possibly-admitted survivor copies.
+        assigned = []
+        requeue = []
+        cancels = set(st.cancel_out)
+        for rid, rank, acked in st.assigned:
+            if rank not in alive or not acked:
+                requeue.append(rid)
+                if rank in alive:
+                    cancels.add(rid)
+            else:
+                assigned.append((rid, rank, acked))
+        pending = tuple(sorted(requeue)) + st.pending
+        return (f"round: decode{victim} dies mid-allgather -> recovery "
+                f"(requeue={sorted(requeue)})",
+                st._replace(pending=pending, assigned=tuple(assigned),
+                            cancel_out=frozenset(cancels),
+                            decodes=tuple(survivors),
+                            kills=st.kills - 1))
+
+    # -- properties ------------------------------------------------------
+
+    def invariant(self, st):
+        for rid, n in enumerate(st.compl_count):
+            if n > 1:
+                return (f"exactly-once: rid{rid} committed to the "
+                        f"scoreboard {n} times")
+        for rid, rank, acked in st.assigned:
+            if not acked:
+                continue
+            dec = next((d for d in st.decodes if d.rank == rank), None)
+            if dec is None:
+                continue   # dead rank: recovery will requeue
+            if rid not in dec.adopted | dec.outbox | dec.inflight:
+                return (f"no-lost-completion: rid{rid} is acked on live "
+                        f"decode{rank} but no copy exists there "
+                        f"(not adopted, not in the done outbox, not "
+                        f"inflight) -- it can never complete")
+        return None
+
+    def done(self, st):
+        return len(st.completed) == self.n_requests

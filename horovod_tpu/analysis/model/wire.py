@@ -1,0 +1,251 @@
+"""hvdcheck model: the striped wire framing protocol (CRC/NAK/DONE).
+
+Abstracts one sender->receiver link of the host ring (csrc/wire.cc,
+docs/wire.md) to the decisions that carry the protocol's invariants:
+
+- framing: each stripe channel is a self-framing stream of
+  ``D1|idx|crc|payload`` data frames closed by a ``5E`` DONE marker;
+  chunk ``i`` of a transfer rides channel ``i % K`` with its GLOBAL
+  index (a lane-mismatched idx is a protocol error, r20).
+- CRC verify-before-reduce: a chunk is handed to ReduceInto ONLY
+  after its CRC verifies; a bad frame costs a NAK and an idempotent
+  resend (the heal ladder's backoff is timing, not ordering, so the
+  NAK/resend cycle models it), and the same chunk failing more than
+  ``retries + 1`` times escalates to a typed WireCorruption — a
+  legitimate terminal, never a hang.
+- the reader-stops-at-slot-satisfied rule (r14): back-to-back
+  transfers share the sockets with no ack gap, so once a slot has
+  every chunk verified and the DONE marker on every channel, the
+  reader must STOP — the next bytes in the stream belong to the next
+  transfer, and reading them here misfiles them as duplicates of the
+  already-verified chunks.
+
+Safety invariants: no chunk reduced before its CRC verified; no chunk
+reduced twice; no lane-mismatched frame accepted. Liveness: every
+execution reaches all-transfers-complete or a typed escalation.
+
+Seeded mutants:
+
+- ``reduce_before_verify``: the receiver reduces a frame's payload on
+  receipt and only then checks the CRC — one bit-flip and corrupt
+  data is already in the accumulator.
+- ``read_past_slot`` (r14): the reader keeps draining the stream
+  after its slot is satisfied; the next transfer's first frame is
+  consumed and discarded as a duplicate, and that transfer can then
+  never complete — the checker reports the deadlock.
+"""
+
+from typing import NamedTuple
+
+DATA, DONE = "data", "done"
+
+
+class Frame(NamedTuple):
+    transfer: int   # ground truth; the receiver must NOT look at this
+    kind: str       # DATA | DONE
+    idx: int        # global chunk index within the transfer
+    good: bool      # CRC will verify
+
+
+class State(NamedTuple):
+    sent: tuple        # per channel: pointer into the send schedule
+    fifo: tuple        # per channel: tuple of in-flight Frames
+    naks: frozenset    # (transfer, idx) awaiting idempotent resend
+    slot: int          # receiver's current transfer slot
+    verified: frozenset  # idx verified in the current slot
+    done_seen: frozenset  # channels whose DONE arrived, current slot
+    fails: tuple       # per idx: CRC failures in the current slot
+    reduced: tuple     # per transfer: per idx: times handed to reduce
+    corrupts: int      # remaining bit-flip budget
+    escalated: bool    # typed WireCorruption raised (terminal)
+    protocol_error: str
+
+
+class WireModel:
+    """Bounded striped-transfer instance.
+
+    ``mutation`` is None for the real protocol, or one of
+    ``"reduce_before_verify"`` / ``"read_past_slot"``.
+    """
+
+    def __init__(self, n_chunks=2, channels=2, transfers=1, corrupts=1,
+                 retries=0, mutation=None):
+        assert mutation in (None, "reduce_before_verify", "read_past_slot")
+        self.n_chunks = n_chunks
+        self.channels = channels
+        self.transfers = transfers
+        self.retries = retries
+        self.mutation = mutation
+        self._corrupts = corrupts
+        # Per-channel send schedule: each transfer's chunks striped
+        # idx % K, each channel's stream closed by that transfer's
+        # DONE. The sender does NOT wait for any receiver ack between
+        # transfers — that gap is exactly the r14 bug window.
+        self.sched = [[] for _ in range(channels)]
+        for t in range(transfers):
+            for idx in range(n_chunks):
+                self.sched[idx % channels].append((t, DATA, idx))
+            for c in range(channels):
+                self.sched[c].append((t, DONE, 0))
+        self.name = (f"wire(chunks={n_chunks},chans={channels},"
+                     f"transfers={transfers},corrupts={corrupts}"
+                     + (f",mutant={mutation})" if mutation else ")"))
+
+    def initial(self):
+        yield State(
+            sent=(0,) * self.channels,
+            fifo=((),) * self.channels,
+            naks=frozenset(), slot=0, verified=frozenset(),
+            done_seen=frozenset(), fails=(0,) * self.n_chunks,
+            reduced=((0,) * self.n_chunks,) * self.transfers,
+            corrupts=self._corrupts, escalated=False, protocol_error="")
+
+    # -- helpers ---------------------------------------------------------
+
+    def _satisfied(self, st):
+        return (len(st.verified) == self.n_chunks
+                and len(st.done_seen) == self.channels)
+
+    def _push(self, st, c, frame):
+        fifo = list(st.fifo)
+        fifo[c] = fifo[c] + (frame,)
+        return st._replace(fifo=tuple(fifo))
+
+    def _reduce(self, st, idx):
+        reduced = [list(row) for row in st.reduced]
+        reduced[st.slot][idx] = min(reduced[st.slot][idx] + 1, 2)
+        return st._replace(reduced=tuple(tuple(r) for r in reduced))
+
+    # -- transitions -----------------------------------------------------
+
+    def actions(self, st):
+        if st.escalated or st.protocol_error:
+            return []   # connection torn down: typed error, not a hang
+        out = []
+
+        # Sender: next scheduled frame, any channel interleaving.
+        for c in range(self.channels):
+            if st.sent[c] < len(self.sched[c]):
+                t, kind, idx = self.sched[c][st.sent[c]]
+                sent = list(st.sent)
+                sent[c] += 1
+                nxt = self._push(st._replace(sent=tuple(sent)), c,
+                                 Frame(t, kind, idx, True))
+                label = (f"sender: transfer{t} chunk{idx} -> chan{c}"
+                         if kind == DATA else
+                         f"sender: transfer{t} DONE -> chan{c}")
+                out.append((label, nxt))
+
+        # Sender: idempotent NAK resend.
+        for t, idx in sorted(st.naks):
+            c = idx % self.channels
+            nxt = self._push(st._replace(naks=st.naks - {(t, idx)}), c,
+                             Frame(t, DATA, idx, True))
+            out.append((f"sender: NAK resend transfer{t} chunk{idx} "
+                        f"-> chan{c}", nxt))
+
+        # Environment: flip a bit in any in-flight data frame.
+        if st.corrupts > 0:
+            for c in range(self.channels):
+                for pos, f in enumerate(st.fifo[c]):
+                    if f.kind == DATA and f.good:
+                        fifo = list(st.fifo)
+                        fifo[c] = (fifo[c][:pos]
+                                   + (f._replace(good=False),)
+                                   + fifo[c][pos + 1:])
+                        out.append((
+                            f"env: bit-flip chan{c} pos{pos} "
+                            f"(transfer{f.transfer} chunk{f.idx})",
+                            st._replace(fifo=tuple(fifo),
+                                        corrupts=st.corrupts - 1)))
+
+        # Receiver: pop the head of a channel's stream. The real
+        # reader STOPS once the slot is satisfied; the read_past_slot
+        # mutant keeps draining.
+        may_read = (not self._satisfied(st)
+                    or self.mutation == "read_past_slot")
+        if may_read:
+            for c in range(self.channels):
+                if st.fifo[c]:
+                    out.append(self._pop(st, c))
+
+        # Receiver: slot satisfied -> stop reading, open the next
+        # slot. The bytes still in the streams belong to it.
+        if self._satisfied(st) and st.slot < self.transfers - 1:
+            out.append((
+                f"receiver: slot{st.slot} satisfied -> stop reading, "
+                f"open slot{st.slot + 1}",
+                st._replace(slot=st.slot + 1, verified=frozenset(),
+                            done_seen=frozenset(),
+                            fails=(0,) * self.n_chunks)))
+        return out
+
+    def _pop(self, st, c):
+        frame = st.fifo[c][0]
+        fifo = list(st.fifo)
+        fifo[c] = fifo[c][1:]
+        st = st._replace(fifo=tuple(fifo))
+        past = " (slot already satisfied)" if self._satisfied(st) else ""
+
+        if frame.kind == DONE:
+            return (f"receiver: chan{c} DONE marker{past}",
+                    st._replace(done_seen=st.done_seen | {c}))
+
+        if frame.idx % self.channels != c:
+            return (f"receiver: chan{c} frame idx{frame.idx} "
+                    f"LANE MISMATCH",
+                    st._replace(protocol_error=(
+                        f"chunk{frame.idx} arrived on chan{c}, expected "
+                        f"chan{frame.idx % self.channels}")))
+
+        if frame.idx in st.verified:
+            # Idempotent-dup path. When the frame actually belongs to
+            # the NEXT transfer (read past a satisfied slot) this
+            # discard is the r14 data loss.
+            stale = (" of NEXT transfer" if frame.transfer != st.slot
+                     else "")
+            return (f"receiver: chan{c} chunk{frame.idx}{stale} already "
+                    f"verified -> discarded as duplicate{past}", st)
+
+        if self.mutation == "reduce_before_verify":
+            st = self._reduce(st, frame.idx)   # BEFORE the CRC check
+
+        if frame.good:
+            st = st._replace(verified=st.verified | {frame.idx})
+            if self.mutation != "reduce_before_verify":
+                st = self._reduce(st, frame.idx)
+            return (f"receiver: chan{c} chunk{frame.idx} CRC ok -> "
+                    f"verified + reduced{past}", st)
+
+        fails = list(st.fails)
+        fails[frame.idx] += 1
+        st = st._replace(fails=tuple(fails))
+        if fails[frame.idx] > self.retries + 1:
+            return (f"receiver: chan{c} chunk{frame.idx} CRC fail "
+                    f"#{fails[frame.idx]} -> retries exhausted, raise "
+                    f"WireCorruption", st._replace(escalated=True))
+        return (f"receiver: chan{c} chunk{frame.idx} CRC fail "
+                f"#{fails[frame.idx]} -> NAK",
+                st._replace(naks=st.naks | {(st.slot, frame.idx)}))
+
+    # -- properties ------------------------------------------------------
+
+    def invariant(self, st):
+        if st.protocol_error:
+            return f"lane discipline: {st.protocol_error}"
+        for t, row in enumerate(st.reduced):
+            for idx, n in enumerate(row):
+                if n > 1:
+                    return (f"exactly-once: transfer{t} chunk{idx} "
+                            f"reduced {n} times")
+        for idx, n in enumerate(st.reduced[st.slot]):
+            if n > 0 and idx not in st.verified:
+                return (f"verify-before-reduce: transfer{st.slot} "
+                        f"chunk{idx} was handed to ReduceInto without "
+                        f"a verified CRC")
+        return None
+
+    def done(self, st):
+        if st.escalated:
+            return True
+        return (st.slot == self.transfers - 1 and self._satisfied(st))

@@ -369,6 +369,25 @@ def _pipeline(config, schedule):
                     axis_env=[("pipe", _S)], expect_collectives=expect)
 
 
+def _ring_attention(config):
+    """The sequence-parallel exact-attention ring
+    (``parallel.ring_attention``, XLA blockwise path): n-1 ppermute
+    hops of the K/V shards around the ``sp`` axis with a
+    rank-dependent causal mask per step. Exercises the walkers C8
+    leans on — rank-tainted VALUES (``lax.axis_index`` feeds the mask)
+    inside rank-INVARIANT control flow must stay quiet."""
+    del config
+    from horovod_tpu.parallel.ring_attention import ring_attention
+
+    def fn(q, k, v):
+        return ring_attention(q, k, v, "sp", causal=True, use_flash=False)
+
+    # GQA geometry: 4 query heads over 2 KV heads, bf16 activations.
+    q = jax.ShapeDtypeStruct((2, 8, 4, 8), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((2, 8, 2, 8), jnp.bfloat16)
+    return LintSpec(fn=fn, args=(q, kv, kv), axis_env=[("sp", 2)])
+
+
 _REGISTRY = {
     "llama_train_step": _monolithic,
     "llama_train_step_split":
@@ -390,6 +409,7 @@ _REGISTRY = {
         functools.partial(_pipeline, schedule="1f1b"),
     "pipeline_interleaved_1f1b":
         functools.partial(_pipeline, schedule="interleaved_1f1b"),
+    "ring_attention_sp": _ring_attention,
 }
 
 

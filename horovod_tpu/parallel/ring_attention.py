@@ -21,6 +21,14 @@ from jax.sharding import PartitionSpec as P
 _NEG_BIG = -1e30  # finite stand-in for -inf: keeps exp() NaN-free
 
 
+def _axis_size(axis_name):
+    # lax.axis_size only exists in newer jax; psum(1) is the portable
+    # spelling (constant-folded to the bound axis size at trace time).
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def _repeat_kv(x, n_rep):
     """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] for grouped-query attention."""
     if n_rep == 1:
@@ -93,7 +101,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal):
     """
     from horovod_tpu.ops.flash_attention import flash_attention_chunk
 
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -135,7 +143,7 @@ def ring_attention(q, k, v, axis_name, causal=True, use_flash=None):
         use_flash = jax.devices()[0].platform in ("tpu", "axon")
     if use_flash:
         return _ring_attention_flash(q, k, v, axis_name, causal)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_rep = q.shape[2] // k.shape[2]
     k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)

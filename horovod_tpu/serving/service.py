@@ -488,7 +488,10 @@ class ServingLoop:
         # Cancels apply BEFORE payload adoption: they target copies
         # admitted in EARLIER rounds, and a rid that is cancelled and
         # reassigned in one control message must drop the stale copy
-        # while keeping this round's fresh adoption.
+        # while keeping this round's fresh adoption. The reversed
+        # ordering is the seeded serving.cancel_after_adopt mutant in
+        # analysis/model/serving.py — hvdcheck finds the lost-request
+        # interleaving in 3 steps.
         if rank in decode_ranks:
             for rid in front.get("cancel", ()):
                 self.engine.scheduler.drop(rid)
@@ -531,7 +534,10 @@ class ServingLoop:
 
     def _done_out(self):
         """Move fresh completions into the outbox and return the WHOLE
-        outbox — items re-send every round until retired."""
+        outbox — items re-send every round until retired. Draining at
+        send instead is the seeded serving.retire_on_send mutant in
+        analysis/model/serving.py: a fault mid-round then loses the
+        completion forever (no-lost-completion invariant)."""
         for rid, seq in list(self.engine.scheduler.completed.items()):
             self._done_outbox[int(rid)] = seq.tokens.tolist()
             del self.engine.scheduler.completed[rid]

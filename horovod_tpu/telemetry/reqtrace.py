@@ -12,7 +12,10 @@ microsecond of a request's wall time lands in exactly one phase, the
 same exact-reconciliation standard as the r17 overlap ledger).
 
 Phases (``REQUEST_PHASES`` — index-ABI with the C table, pinned in
-tests/single/test_reqtrace.py)::
+tests/single/test_reqtrace.py and by the hvdcheck ABI drift guard,
+which scrapes csrc/events.h + kRequestPhaseNames and requires this
+tuple to match bit-for-bit: analysis/model/abi.py,
+``make model-check``)::
 
     queued           admitted to the frontend's pending line
     prefill          prefill compute running for this request

@@ -305,6 +305,76 @@ def test_c7_quiet_on_eager_lane_and_single_bucket():
     assert analysis.lint(pure_wire, (jnp.ones(8),), axis_env=_ENV) == []
 
 
+def test_c8_collective_in_rank_dependent_while_fires():
+    """A psum inside a while_loop whose trip count derives from
+    lax.axis_index is a GUARANTEED deadlock: ranks exit the loop after
+    different iteration counts, so collective call counts diverge."""
+    def prog(x):
+        def cond(c):
+            i, _ = c
+            return i < lax.axis_index("data") + 1
+
+        def body(c):
+            i, y = c
+            return i + 1, lax.psum(y, "data")
+
+        _, out = lax.while_loop(cond, body, (jnp.int32(0), x))
+        return out
+
+    diags = analysis.lint(prog, (jnp.ones(4),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C8"]
+    assert diags[0].severity == analysis.ERROR
+    assert "while" in diags[0].path
+    assert "axis_index" in diags[0].message
+    assert "psum" in diags[0].message
+
+
+def test_c8_taint_reaches_trip_count_through_carry():
+    """fori_loop with an axis_index-derived upper bound: the taint
+    rides the loop carry into the cond, not the cond closure — the
+    fixpoint over carried values must still mark the trip count."""
+    def prog(x):
+        n = lax.axis_index("data") + 1
+        return lax.fori_loop(0, n,
+                             lambda _, y: lax.psum(y, "data"), x)
+
+    diags = analysis.lint(prog, (jnp.ones(4),), axis_env=_ENV)
+    assert [d.id for d in diags] == ["C8"]
+
+
+def test_c8_quiet_fixtures():
+    """Static-bound while with a collective: fine. Rank-dependent trip
+    count WITHOUT collectives in the body: fine (pure local compute may
+    legally diverge). Collective inside scan: trip count is static by
+    construction — never C8."""
+    def static_while(x):
+        def cond(c):
+            i, _ = c
+            return i < 3
+
+        def body(c):
+            i, y = c
+            return i + 1, lax.psum(y, "data")
+
+        _, out = lax.while_loop(cond, body, (jnp.int32(0), x))
+        return out
+
+    def tainted_no_collective(x):
+        n = lax.axis_index("data") + 1
+        return lax.fori_loop(0, n, lambda _, y: y * 2.0, x)
+
+    def collective_scan(x):
+        def step(c, _):
+            return lax.psum(c, "data"), None
+        out, _ = lax.scan(step, x, jnp.arange(3))
+        return out
+
+    x = jnp.ones(4)
+    assert analysis.lint(static_while, (x,), axis_env=_ENV) == []
+    assert analysis.lint(tainted_no_collective, (x,), axis_env=_ENV) == []
+    assert analysis.lint(collective_scan, (x,), axis_env=_ENV) == []
+
+
 def test_allowlist_suppresses_by_id_and_path():
     def prog(x):
         return lax.psum(x.astype(jnp.float32), "data")
