@@ -25,7 +25,7 @@ ASAN_OUT := horovod_tpu/lib/libhvdtpu_core_asan.so
   model-check \
   core-tsan core-asan metrics-smoke zero-smoke elastic-smoke \
   reshard-smoke chaos-smoke obs-smoke scale-smoke perf-smoke \
-  serve-smoke wire-smoke fusion-smoke
+  serve-smoke wire-smoke fusion-smoke fleet-obs-smoke
 
 core: $(OUT)
 
@@ -167,6 +167,18 @@ chaos-smoke: core
 # horovod_tpu/telemetry/obs_smoke.py; ~20 s).
 obs-smoke: core
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.obs_smoke
+
+# Fleet-observatory smoke: 2 real ranks run step-marked train loops;
+# an injected stop:<ms> stall on rank 1 heals in place through the
+# retry ladder while the driver polls the live /fleet aggregation on
+# rank 0 — every rank's rank-seconds buckets must sum to its window to
+# the microsecond (unattributed < 1%), rank 1's SLO check must breach
+# stall_ms naming phase "stall" and record the typed slo_breach event,
+# and report.py --fleet over the black-box dumps must surface the same
+# verdict post-mortem (docs/fleet.md;
+# horovod_tpu/telemetry/fleet_smoke.py; ~25 s).
+fleet-obs-smoke: core
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.telemetry.fleet_smoke
 
 # Step-anatomy smoke: 2 real ranks run an eager loop under a StepTimer
 # (step windows + overlap ledger) with a chaos delay:<ms> straggler

@@ -1257,6 +1257,63 @@ def _fusion_rows(ranks=2, steps=6):
     return rows
 
 
+def _fleet_util_rows(world_sizes=(64, 256), steps=8):
+    """The fleet rank-seconds aggregation rows (`fleet_utilization`,
+    docs/fleet.md; no accelerator needed): synthesize a simworld fleet
+    with one straggler plus the full r23 evidence surface (wait blocks,
+    serving request lifecycles, a recorded SLO breach), run the
+    post-mortem fleet analysis over every rank's dump, and emit one row
+    per world size. Watched columns: ``utilization`` (down =
+    regression), ``unattributed_share`` (the ledger losing evidence),
+    ``breaches`` (count growing), and ``analyze_s`` — the aggregation
+    itself must stay interactive at 256 ranks (< 2 s acceptance bar)."""
+    import shutil
+    import tempfile
+
+    from horovod_tpu.simworld import harness
+    from horovod_tpu.telemetry import fleet
+
+    rows = []
+    for ranks in world_sizes:
+        row = {"metric": "fleet_utilization", "config": "simworld",
+               "ranks": ranks, "steps": steps,
+               "unit": "rank-seconds ledger over synthesized per-rank "
+                       "dumps (one straggler, fused-lane waits, one "
+                       "serving request per step, one recorded "
+                       "breach); utilization = attributed useful share "
+                       "of every rank's window"}
+        out = tempfile.mkdtemp(prefix=f"hvd-fleet-{ranks}-")
+        try:
+            harness.write_sim_step_dumps(
+                out, ranks=ranks, steps=steps, slow_rank=ranks // 3,
+                waits=True, serving=True,
+                breach={"objective": 4, "rank": ranks // 3,
+                        "value": 750, "phase": 6,
+                        "objective_name": "stall_ms",
+                        "phase_name": "stall"})
+            t0 = time.perf_counter()
+            analysis = fleet.analyze(out)
+            dt = time.perf_counter() - t0
+            f = analysis["fleet"]
+            total_us = f["window_us"]
+            row.update({
+                "utilization": f["utilization"],
+                "unattributed_share": round(
+                    f["rank_seconds"]["unattributed"] * 1e6
+                    / total_us, 6) if total_us else 0.0,
+                "breaches": len(analysis["slo"]["breach_events"]),
+                "worst_rank": f["worst_rank"],
+                "analyze_s": round(dt, 4),
+            })
+        except Exception as e:  # noqa: BLE001 — a failed size yields
+            # an error row; the other sizes still measure.
+            row["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            shutil.rmtree(out, ignore_errors=True)
+        rows.append(row)
+    return rows
+
+
 def _sweep_points(batch):
     """The --sweep point table: (name, config, run_spmd kwargs)."""
     import dataclasses
@@ -1547,6 +1604,14 @@ def main():
     if "--zero-sweep" in argv:
         # Standalone ZeRO grid (CPU loopback subprocesses; any box).
         for row in _zero_sweep_rows():
+            emit(row)
+        return
+    if "--fleet-util" in argv:
+        # Standalone fleet rank-seconds aggregation rows (no
+        # accelerator needed): simworld synthesized dumps at 64 and
+        # 256 ranks through the post-mortem fleet analysis
+        # (docs/fleet.md).
+        for row in _fleet_util_rows():
             emit(row)
         return
     if "--fusion" in argv:

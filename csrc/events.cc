@@ -53,6 +53,14 @@ const EventSpec kEventSpecs[(int)EventType::kTypeCount] = {
     // Serving-request lifecycle transition (docs/serving.md): rid in c
     // (an int64 request id), phase-specific aux in d.
     {"request", "phase", "", "rid", "aux"},
+    // One hvdtpu_wait block, stamped at its END (wire_span convention):
+    // ts_us - dur_us opens the interval. int64 c — long stalls overflow
+    // an int32 microsecond arg in ~36 minutes.
+    {"wait", "", "", "dur_us", ""},
+    // SLO breach (docs/fleet.md): breach_rank names the breaching rank
+    // ("rank" itself is reserved for the post-mortem merge), phase the
+    // dominant rank-seconds bucket. Decoded names appended below.
+    {"slo_breach", "objective", "breach_rank", "value", "phase"},
 };
 
 // Order is ABI with RequestPhase (events.h) and mirrored by
@@ -66,6 +74,21 @@ const char* kKnobNames[] = {"fusion_bytes", "cycle_time_us", "ring_chunk",
                             "wire_compression", "hier_split",
                             "wire_channels"};
 
+// Order is ABI with SloObjective (events.h) and mirrored by
+// telemetry.slo.OBJECTIVES (analysis/model/abi.py pins both sides).
+const char* kSloObjectiveNames[kSloObjectiveCount] = {
+    "serving_p99_ms", "step_time_ewma_ms", "overlap_efficiency",
+    "queued_idle_share", "stall_ms",
+};
+
+// Rank-seconds ledger buckets (docs/fleet.md), mirrored by
+// telemetry.fleet.BUCKETS — the kSloBreach dominant-phase vocabulary.
+const char* kRankBucketNames[] = {
+    "compute",        "exposed_wire",  "negotiation",
+    "serving_prefill", "serving_decode", "serving_queued",
+    "stall",          "idle",          "unattributed",
+};
+
 thread_local int t_event_plane = 0;
 
 }  // namespace
@@ -73,6 +96,17 @@ thread_local int t_event_plane = 0;
 const char* RequestPhaseName(int phase) {
   if (phase < 0 || phase >= kReqPhaseCount) return "unknown";
   return kRequestPhaseNames[phase];
+}
+
+const char* SloObjectiveName(int objective) {
+  if (objective < 0 || objective >= kSloObjectiveCount) return "unknown";
+  return kSloObjectiveNames[objective];
+}
+
+const char* RankBucketName(int bucket) {
+  constexpr int n = sizeof(kRankBucketNames) / sizeof(kRankBucketNames[0]);
+  if (bucket < 0 || bucket >= n) return "unknown";
+  return kRankBucketNames[bucket];
 }
 
 const char* EventTypeName(EventType t) {
@@ -216,6 +250,15 @@ std::string EventJson(const EventRecord& e) {
   if (e.type == EventType::kRequest) {
     out += ",\"phase_name\":\"";
     out += RequestPhaseName(e.a);
+    out += "\"";
+  }
+  // SLO breach: decode both vocabulary ids (objective table and the
+  // rank-seconds bucket table) — consumers read names, never indices.
+  if (e.type == EventType::kSloBreach) {
+    out += ",\"objective_name\":\"";
+    out += SloObjectiveName(e.a);
+    out += "\",\"phase_name\":\"";
+    out += RankBucketName((int)e.d);
     out += "\"";
   }
   out += "}";

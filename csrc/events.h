@@ -59,6 +59,13 @@ enum class EventType : int32_t {
   kRequest,             // a=RequestPhase, c=rid, d=aux (phase-specific:
                         // tokens/bytes) — serving-lane lifecycle
                         // transition (hvdtpu_record_request)
+  kWait,                // c=dur_us — one hvdtpu_wait block, stamped at
+                        // its END like wire_span; the fused-lane truth
+                        // for exposed wire (telemetry/critpath.py)
+  kSloBreach,           // a=SloObjective, b=breaching rank, c=observed
+                        // value (integral: ms, us, or permille per
+                        // objective), d=dominant rank-seconds bucket
+                        // (kRankBucketNames) — hvdtpu_record_slo
   kTypeCount
 };
 
@@ -81,6 +88,26 @@ enum RequestPhase : int32_t {
 };
 
 const char* RequestPhaseName(int phase);
+
+// SLO objective ids for kSloBreach (docs/fleet.md): the declarative
+// SLO engine (telemetry/slo.py) evaluates these by name and records
+// breaches by id — index-ABI with kSloObjectiveNames (events.cc),
+// mirrored by telemetry.slo.OBJECTIVES (pinned in analysis/model/abi).
+enum SloObjective : int32_t {
+  kSloServingP99 = 0,     // "serving_p99_ms" (value: ms)
+  kSloStepTimeEwma,       // "step_time_ewma_ms" drift (value: permille
+                          // of the engine's own baseline)
+  kSloOverlapEfficiency,  // "overlap_efficiency" (value: permille)
+  kSloQueuedIdleShare,    // "queued_idle_share" (value: permille)
+  kSloStallMs,            // "stall_ms" (value: ms)
+  kSloObjectiveCount
+};
+
+const char* SloObjectiveName(int objective);
+
+// Rank-seconds ledger bucket ids for kSloBreach's dominant-phase arg —
+// index-ABI with telemetry.fleet.BUCKETS (same abi.py pin).
+const char* RankBucketName(int bucket);
 
 // Knob ids for kKnobAdopt (autotuner moves + worker lockstep adoption).
 enum EventKnob : int32_t {

@@ -263,6 +263,12 @@ void Metrics::Reset() {
   wire_cross_rx_logical_bytes.store(0);
   for (auto& c : wire_chan_tx_bytes) c.store(0);
   for (auto& c : wire_chan_rx_bytes) c.store(0);
+  wire_syscalls_tx.store(0);
+  wire_syscalls_rx.store(0);
+  wire_cross_syscalls_tx.store(0);
+  wire_cross_syscalls_rx.store(0);
+  for (auto& c : wire_chan_syscalls_tx) c.store(0);
+  for (auto& c : wire_chan_syscalls_rx) c.store(0);
   std::lock_guard<std::mutex> lk(straggler_mutex_);
   straggler_counts_.clear();
 }
@@ -368,6 +374,43 @@ std::string Metrics::SnapshotJson(const RuntimeInfo& info) const {
                  std::memory_order_relaxed));
     }
     out += "],";
+  }
+  {
+    // Transport syscall budget (docs/wire.md "Syscall budget"): calls
+    // ISSUED (EAGAIN spins included) — the io_uring baseline (ROADMAP
+    // item 3). Same conventions as the byte buckets: cross is the
+    // plane-1 slice, channels sum exactly to the totals.
+    int64_t stx = wire_syscalls_tx.load(std::memory_order_relaxed);
+    int64_t srx = wire_syscalls_rx.load(std::memory_order_relaxed);
+    int64_t cstx =
+        wire_cross_syscalls_tx.load(std::memory_order_relaxed);
+    int64_t csrx =
+        wire_cross_syscalls_rx.load(std::memory_order_relaxed);
+    double gb = (double)(wtx + wrx) / 1e9;
+    Append(out, "\"syscalls\":{\"tx_calls\":%lld,\"rx_calls\":%lld,"
+                "\"cross_tx_calls\":%lld,\"cross_rx_calls\":%lld,"
+                "\"per_gb\":%.3f,",
+           (long long)stx, (long long)srx, (long long)cstx,
+           (long long)csrx,
+           gb > 0 ? (double)(stx + srx) / gb : 0.0);
+    int hi = 0;
+    for (int c = 1; c < kWireChannelSlots; c++) {
+      if (wire_chan_syscalls_tx[c].load(std::memory_order_relaxed) ||
+          wire_chan_syscalls_rx[c].load(std::memory_order_relaxed)) {
+        hi = c;
+      }
+    }
+    out += "\"channels\":[";
+    for (int c = 0; c <= hi; c++) {
+      Append(out, "%s{\"channel\":%d,\"tx_calls\":%lld,"
+                  "\"rx_calls\":%lld}",
+             c ? "," : "", c,
+             (long long)wire_chan_syscalls_tx[c].load(
+                 std::memory_order_relaxed),
+             (long long)wire_chan_syscalls_rx[c].load(
+                 std::memory_order_relaxed));
+    }
+    out += "]},";
   }
   // Step-anatomy overlap ledger (docs/metrics.md): how much of the
   // wire time above was hidden under concurrent wire activity, per

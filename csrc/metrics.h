@@ -172,6 +172,33 @@ class Metrics {
   std::atomic<int64_t> wire_chan_tx_bytes[kWireChannelSlots] = {};
   std::atomic<int64_t> wire_chan_rx_bytes[kWireChannelSlots] = {};
 
+  // Transport syscall accounting (docs/wire.md "Syscall budget"): one
+  // increment per send()/recv() INVOCATION — including short writes,
+  // EAGAIN spins, and CRC control frames — because the number ROADMAP
+  // item 3 (io_uring kernel-bypass) must beat is calls issued, not
+  // calls that moved payload. Same slicing conventions as the byte
+  // counters: cross is the plane-1 slice of the totals, per-channel
+  // buckets sum exactly to them (unstriped paths book channel 0).
+  std::atomic<int64_t> wire_syscalls_tx{0};
+  std::atomic<int64_t> wire_syscalls_rx{0};
+  std::atomic<int64_t> wire_cross_syscalls_tx{0};
+  std::atomic<int64_t> wire_cross_syscalls_rx{0};
+  std::atomic<int64_t> wire_chan_syscalls_tx[kWireChannelSlots] = {};
+  std::atomic<int64_t> wire_chan_syscalls_rx[kWireChannelSlots] = {};
+
+  // Hot-path inline: one relaxed fetch_add per counter touched.
+  void AccountWireSyscall(int plane, int channel, bool tx) {
+    auto& total = tx ? wire_syscalls_tx : wire_syscalls_rx;
+    total.fetch_add(1, std::memory_order_relaxed);
+    if (plane == 1) {
+      auto& cross = tx ? wire_cross_syscalls_tx : wire_cross_syscalls_rx;
+      cross.fetch_add(1, std::memory_order_relaxed);
+    }
+    if (channel < 0 || channel >= kWireChannelSlots) channel = 0;
+    auto* chan = tx ? wire_chan_syscalls_tx : wire_chan_syscalls_rx;
+    chan[channel].fetch_add(1, std::memory_order_relaxed);
+  }
+
   void AccountWire(int plane, int64_t tx, int64_t rx, int64_t tx_logical,
                    int64_t rx_logical);
   void AccountWireChannels(const int64_t* tx, const int64_t* rx);

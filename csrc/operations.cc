@@ -2337,7 +2337,16 @@ int hvdtpu_wait(int handle) {
   // (docs/fusion.md).
   int64_t t0 = MetricsNowUs();
   bool found = g_state->handles.Wait(handle, &s);
-  GlobalLedger().AddWait(t0, MetricsNowUs());
+  int64_t t1 = MetricsNowUs();
+  GlobalLedger().AddWait(t0, t1);
+  // The same interval as a typed ring event (stamped at its END, the
+  // wire_span convention) so black-box dumps carry the wait blocks the
+  // live ledger computed exposure from: offline critpath rebuilds
+  // `exposed = wire ∩ waits` instead of misreading fused lanes as
+  // compute-bound (docs/metrics.md "Step anatomy").
+  if (t1 > t0) {
+    GlobalEvents().Record(EventType::kWait, 0, 0, t1 - t0);
+  }
   if (!found) return -1;
   return s.ok() ? 0 : -(int)s.type();
 }
@@ -2618,6 +2627,18 @@ void hvdtpu_record_phase(int phase, int64_t dur_us) {
 // every Record; valid before init like the ring itself.
 void hvdtpu_record_request(int phase, int64_t rid, int64_t aux) {
   GlobalEvents().Record(EventType::kRequest, phase, 0, rid, aux);
+}
+
+// Record one SLO breach (SloObjective, events.h) from the Python SLO
+// engine (telemetry/slo.py): breach_rank names the breaching rank,
+// value the observed measurement (integral — ms or permille per
+// objective), bucket the dominant rank-seconds ledger bucket
+// (kRankBucketNames). Lands in the ring → black-box dumps → the
+// post-mortem fold (docs/fleet.md). Valid before init.
+void hvdtpu_record_slo(int objective, int breach_rank, int64_t value,
+                       int64_t bucket) {
+  GlobalEvents().Record(EventType::kSloBreach, objective, breach_rank,
+                        value, bucket);
 }
 
 // Live pending-tensor gauge: collectives enqueued by API threads that

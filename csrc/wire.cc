@@ -81,6 +81,9 @@ std::unordered_map<int, FdInfo> g_fd_ranks;
 // encoding: a callback error means that peer's mailbox is gone.
 Status ExtSend(int fd, const void* buf, size_t len) {
   if (!g_ext_send) return Status::Error("external transport not set");
+  // External transports never stripe (the data plane forces K=1):
+  // their calls book channel 0, same as every unstriped TCP path.
+  GlobalMetrics().AccountWireSyscall(EventWirePlane(), 0, /*tx=*/true);
   int rc = g_ext_send(ExtFdPeer(fd), ExtFdTag(fd), buf, (long long)len);
   if (rc != 0) {
     return Status::PeerFailure(
@@ -97,6 +100,7 @@ Status ExtSend(int fd, const void* buf, size_t len) {
 // as one message; ring chunks pair SendAll/RecvAll of equal size).
 Status ExtRecvExact(int fd, void* buf, size_t len) {
   if (!g_ext_recv) return Status::Error("external transport not set");
+  GlobalMetrics().AccountWireSyscall(EventWirePlane(), 0, /*tx=*/false);
   long long got = g_ext_recv(ExtFdPeer(fd), ExtFdTag(fd), buf,
                              (long long)len);
   if (got < 0) {
@@ -451,6 +455,10 @@ Status SendAll(int fd, const void* buf, size_t len, int64_t timeout_ms) {
   timeout_ms = ResolveTimeout(timeout_ms);
   const char* p = (const char*)buf;
   while (len > 0) {
+    // One per INVOCATION (short writes and would-blocks included) —
+    // the syscall budget counts calls issued, not calls that moved
+    // payload (docs/wire.md "Syscall budget").
+    GlobalMetrics().AccountWireSyscall(EventWirePlane(), 0, /*tx=*/true);
     ssize_t n = send(fd, p, len, MSG_NOSIGNAL | MSG_DONTWAIT);
     if (n < 0) {
       if (errno == EINTR) continue;
@@ -480,6 +488,8 @@ Status RecvAll(int fd, void* buf, size_t len, int64_t timeout_ms) {
   timeout_ms = ResolveTimeout(timeout_ms);
   char* p = (char*)buf;
   while (len > 0) {
+    GlobalMetrics().AccountWireSyscall(EventWirePlane(), 0,
+                                       /*tx=*/false);
     ssize_t n = recv(fd, p, len, MSG_DONTWAIT);
     if (n < 0) {
       if (errno == EINTR) continue;
@@ -520,7 +530,10 @@ Status RecvFrame(int fd, std::string* payload, int64_t timeout_ms) {
   if (IsExtFd(fd)) {
     if (!g_ext_recv) return Status::Error("external transport not set");
     // Two-phase: probe the next message's length (cap 0 holds it on
-    // the Python side), then copy it out.
+    // the Python side), then copy it out. The probe is a transport
+    // call too — it lands on the syscall budget like any other.
+    GlobalMetrics().AccountWireSyscall(EventWirePlane(), 0,
+                                       /*tx=*/false);
     long long len = g_ext_recv(ExtFdPeer(fd), ExtFdTag(fd), nullptr, 0);
     if (len < 0) {
       return Status::PeerFailure(
@@ -755,6 +768,7 @@ Status DuplexCrcTransfer(
       }
       bool blocked = false;
       while (s->out.hdr_sent < s->out.hdr_len) {
+        m.AccountWireSyscall(EventWirePlane(), channel, /*tx=*/true);
         ssize_t k = send(s->fd, s->out.hdr + s->out.hdr_sent,
                          s->out.hdr_len - s->out.hdr_sent, MSG_NOSIGNAL);
         if (k < 0) {
@@ -770,6 +784,7 @@ Status DuplexCrcTransfer(
       }
       if (blocked) return true;
       while (s->out.pay_sent < s->out.pay_len) {
+        m.AccountWireSyscall(EventWirePlane(), channel, /*tx=*/true);
         ssize_t k = send(s->fd, s->out.pay + s->out.pay_sent,
                          s->out.pay_len - s->out.pay_sent, MSG_NOSIGNAL);
         if (k < 0) {
@@ -807,6 +822,7 @@ Status DuplexCrcTransfer(
       CrcIncoming& in = s->in;
       if (in.stage == 0) {
         uint8_t t = 0;
+        m.AccountWireSyscall(EventWirePlane(), channel, /*tx=*/false);
         ssize_t k = recv(s->fd, &t, 1, MSG_DONTWAIT);
         if (k == 0) {
           *st = PeerClosed(s->fd);
@@ -839,6 +855,7 @@ Status DuplexCrcTransfer(
       if (in.stage == 1) {
         bool blocked = false;
         while (in.hdr_got < in.hdr_need) {
+          m.AccountWireSyscall(EventWirePlane(), channel, /*tx=*/false);
           ssize_t k = recv(s->fd, in.hdr + in.hdr_got,
                            in.hdr_need - in.hdr_got, MSG_DONTWAIT);
           if (k == 0) {
@@ -890,6 +907,7 @@ Status DuplexCrcTransfer(
       }
       bool blocked = false;
       while (in.pay_got < in.pay_len) {
+        m.AccountWireSyscall(EventWirePlane(), channel, /*tx=*/false);
         ssize_t k = recv(s->fd, in.pay_dst + in.pay_got,
                          in.pay_len - in.pay_got, MSG_DONTWAIT);
         if (k == 0) {
@@ -1120,6 +1138,8 @@ Status DuplexTransferStriped(
       // channel are sent back to back (at K=1 that is the legacy
       // contiguous byte stream).
       while (!snd.finished()) {
+        GlobalMetrics().AccountWireSyscall(EventWirePlane(), channel,
+                                           /*tx=*/true);
         ssize_t k = send(send_fd, sp + snd.off() + snd.done,
                          snd.remaining(), MSG_NOSIGNAL);
         if (k < 0) {
@@ -1133,6 +1153,8 @@ Status DuplexTransferStriped(
     if (recv_idx >= 0 && (fds[recv_idx].revents & (POLLIN | POLLHUP))) {
       while (!rcv.finished()) {
         const size_t coff = rcv.off(), clen = rcv.len();
+        GlobalMetrics().AccountWireSyscall(EventWirePlane(), channel,
+                                           /*tx=*/false);
         ssize_t k = recv(recv_fd, rp + coff + rcv.done, rcv.remaining(),
                          0);
         if (k == 0) return PeerClosed(recv_fd);

@@ -107,6 +107,10 @@ def scrape_all(root=None):
     t["request_phase_names"] = _strings(events_cc, "kRequestPhaseNames")
     t["knob_enum"] = _enum_members(events_h, r"enum EventKnob : int32_t")
     t["knob_names"] = _strings(events_cc, "kKnobNames")
+    t["slo_objective_enum"] = _enum_members(
+        events_h, r"enum SloObjective : int32_t", stop="kSloObjectiveCount")
+    t["slo_objective_names"] = _strings(events_cc, "kSloObjectiveNames")
+    t["rank_bucket_names"] = _strings(events_cc, "kRankBucketNames")
     t["control_phase_enum"] = _enum_members(
         metrics_h, r"enum ControlPhase : int32_t", stop="kPhaseCount")
     t["cross_plane_modes"] = _strings(common_h, "CrossPlaneModeNames")
@@ -170,6 +174,19 @@ def verify(t):
            f"csrc kRequestPhaseNames {phases}")
     expect(phases and reqtrace.TERMINAL_PHASE == phases[-1],
            "reqtrace.TERMINAL_PHASE is not the last RequestPhase")
+
+    # -- SLO objectives + rank-seconds buckets (docs/fleet.md) -----------
+    from horovod_tpu.telemetry import fleet, slo
+
+    expect(len(t["slo_objective_enum"]) == len(t["slo_objective_names"]),
+           f"SloObjective has {len(t['slo_objective_enum'])} members, "
+           f"kSloObjectiveNames {len(t['slo_objective_names'])}")
+    expect(tuple(slo.OBJECTIVES) == tuple(t["slo_objective_names"]),
+           f"slo.OBJECTIVES {tuple(slo.OBJECTIVES)} != csrc "
+           f"kSloObjectiveNames {tuple(t['slo_objective_names'])}")
+    expect(tuple(fleet.BUCKETS) == tuple(t["rank_bucket_names"]),
+           f"fleet.BUCKETS {tuple(fleet.BUCKETS)} != csrc "
+           f"kRankBucketNames {tuple(t['rank_bucket_names'])}")
 
     # -- control-plane phases --------------------------------------------
     derived = tuple(_snake(n[len("kPhase"):])

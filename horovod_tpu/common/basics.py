@@ -130,6 +130,8 @@ def load_library():
         lib.hvdtpu_record_phase.argtypes = [i32, i64]
         lib.hvdtpu_record_request.restype = None
         lib.hvdtpu_record_request.argtypes = [i32, i64, i64]
+        lib.hvdtpu_record_slo.restype = None
+        lib.hvdtpu_record_slo.argtypes = [i32, i32, i64, i64]
         lib.hvdtpu_step_mark.restype = i64
         lib.hvdtpu_step_mark.argtypes = [i32]
         lib.hvdtpu_step_id.restype = i64
@@ -410,6 +412,20 @@ class HorovodBasics:
         record_request` (which also keeps the live in-flight table the
         ``/requests`` debug endpoint serves). Valid before ``init()``."""
         self.lib.hvdtpu_record_request(int(phase), int(rid), int(aux))
+
+    def record_slo(self, objective, breach_rank, value, bucket=-1):
+        """Record one SLO breach (``slo_breach`` event, csrc/events.h
+        SloObjective): ``objective`` is an index into
+        :data:`horovod_tpu.telemetry.slo.OBJECTIVES` (which mirrors the
+        C table), ``breach_rank`` the breaching rank, ``value`` the
+        observed measurement (integral — ms or permille per objective),
+        ``bucket`` the dominant rank-seconds ledger bucket (an index
+        into :data:`horovod_tpu.telemetry.fleet.BUCKETS`, -1 unknown).
+        The SLO engine calls this through
+        :meth:`telemetry.slo.SloEngine.record`. Valid before
+        ``init()``."""
+        self.lib.hvdtpu_record_slo(int(objective), int(breach_rank),
+                                   int(value), int(bucket))
 
     def step_mark(self, begin=True):
         """Mark a training-step boundary for the step-anatomy layer

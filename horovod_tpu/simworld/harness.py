@@ -89,7 +89,8 @@ def scaling_profile(world_sizes=DEFAULT_WORLD_SIZES,
 
 
 def write_sim_step_dumps(out_dir, ranks, steps, slow_rank, step_ms=120,
-                         wire_ms=15, slow_ms=60, epoch=0, skew_us=900):
+                         wire_ms=15, slow_ms=60, epoch=0, skew_us=900,
+                         waits=False, serving=False, breach=None):
     """Synthesize per-rank STEP-ANATOMY dumps for the critical-path
     merge at fleet scale (the step-window twin of
     :func:`write_sim_dumps`): every rank records the same
@@ -101,6 +102,20 @@ def write_sim_step_dumps(out_dir, ranks, steps, slow_rank, step_ms=120,
     ``slow_rank`` with phase ``compute`` on EVERY step
     (tests/single/test_critpath.py pins this at 64 ranks; r16 gotcha 1
     applies — the in-process simworld cannot emit real per-rank files).
+
+    The r23 fleet lane (docs/fleet.md) rides on three opt-in knobs,
+    defaulted off so the critpath geometry above is untouched:
+
+    - ``waits=True`` pairs each wire span with a ``wait`` block ending
+      at the same instant but HALF the duration — exposed wire on the
+      fused lane is ``spans ∩ waits``, so the rank-seconds ledger must
+      book exactly half of each span as ``exposed_wire``;
+    - ``serving=True`` runs one request per step through
+      queued -> prefill -> decode_active -> done at fixed fractions of
+      the window (10%/30%/80%), exercising the serving buckets;
+    - ``breach={"objective": ..., "rank": ..., "value": ...,
+      "phase": ...}`` records one ``slo_breach`` event on rank 0 (ids
+      per the pinned tables — the live observatory's footprint).
 
     Returns the list of dump paths."""
     os.makedirs(out_dir, exist_ok=True)
@@ -119,28 +134,51 @@ def write_sim_step_dumps(out_dir, ranks, steps, slow_rank, step_ms=120,
         }
         lines = [json.dumps(header)]
         seq = 0
+
+        def emit(ts, typ, **fields):
+            nonlocal seq
+            row = {"seq": seq, "ts_us": ts, "type": typ}
+            row.update(fields)
+            lines.append(json.dumps(row))
+            seq += 1
+
         for k in range(1, steps + 1):
             begin = steady0 + (k - 1) * total_us
             end = begin + total_us
-            lines.append(json.dumps({
-                "seq": seq, "ts_us": begin, "type": "step_begin",
-                "step": k}))
-            seq += 1
+            emit(begin, "step_begin", step=k)
+            if serving:
+                # One request per step, rid = step: enters queued early,
+                # prefills, decodes, and completes inside the window.
+                rid = k
+                emit(begin + total_us // 10, "request", phase=0,
+                     rid=rid, aux=0, phase_name="queued")
+                emit(begin + (3 * total_us) // 10, "request", phase=1,
+                     rid=rid, aux=0, phase_name="prefill")
+                emit(begin + (8 * total_us) // 10, "request", phase=4,
+                     rid=rid, aux=0, phase_name="decode_active")
+                emit(end - 500, "request", phase=7, rid=rid, aux=0,
+                     phase_name="done")
             # The slow rank computes for most of the window and runs a
             # short span at the end; everyone else finishes local work
             # quickly and their span blocks until the slow rank's data
             # arrives (span stamped at its END with dur_us).
             dur = wire_us if rank == slow_rank else \
                 total_us - wire_us - 2000
-            lines.append(json.dumps({
-                "seq": seq, "ts_us": end - 1000, "type": "wire_span",
-                "plane": 0, "dur_us": dur, "tx_bytes": 1 << 20,
-                "rx_bytes": 1 << 20}))
-            seq += 1
-            lines.append(json.dumps({
-                "seq": seq, "ts_us": end, "type": "step_end",
-                "step": k, "dur_us": total_us}))
-            seq += 1
+            emit(end - 1000, "wire_span", plane=0, dur_us=dur,
+                 tx_bytes=1 << 20, rx_bytes=1 << 20)
+            if waits:
+                # Fused-lane evidence: the API thread only BLOCKED for
+                # the back half of the span.
+                emit(end - 1000, "wait", dur_us=dur // 2)
+            emit(end, "step_end", step=k, dur_us=total_us)
+        if breach is not None and rank == 0:
+            emit(steady0 + steps * total_us, "slo_breach",
+                 objective=int(breach.get("objective", 0)),
+                 breach_rank=int(breach.get("rank", 0)),
+                 value=int(breach.get("value", 0)),
+                 phase=int(breach.get("phase", 0)),
+                 objective_name=breach.get("objective_name", ""),
+                 phase_name=breach.get("phase_name", ""))
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
         paths.append(path)

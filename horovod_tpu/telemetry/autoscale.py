@@ -70,6 +70,35 @@ def read_serving_signals():
     return dict(SERVING_SIGNAL_DEFAULTS)
 
 
+def read_fleet_signals():
+    """The fleet observatory's signal dict, or zeros. Same lazy
+    ``sys.modules`` discipline as :func:`read_serving_signals`: a
+    process that never served ``/fleet`` never imports the fleet
+    module, and a live observatory is read from its LAST poll — an
+    observation must never trigger a fleet-wide HTTP sweep."""
+    import sys
+
+    out = {"slo_breaches": 0, "fleet_utilization": 0.0,
+           "rank_seconds_unattributed_share": 0.0}
+    fleet = sys.modules.get("horovod_tpu.telemetry.fleet")
+    if fleet is None or fleet._observatory is None:
+        return out
+    try:
+        obs = fleet._observatory
+        out["slo_breaches"] = len(obs.engine.breaches)
+        view = getattr(obs, "last_view", None)
+        if view:
+            out["fleet_utilization"] = view["fleet"]["utilization"]
+            total_s = view["fleet"]["window_us"] / 1e6
+            if total_s > 0:
+                out["rank_seconds_unattributed_share"] = round(
+                    view["fleet"]["rank_seconds"]["unattributed"]
+                    / total_s, 6)
+    except Exception:  # noqa: BLE001 — signals must come back anyway
+        pass
+    return out
+
+
 @dataclass
 class Signals:
     """One autoscaler observation (field meanings in docs/scale.md)."""
@@ -111,6 +140,18 @@ class Signals:
     recomputed_prefill_tokens: int = 0
     useful_tokens: int = 0
     eviction_amplification: float = 0.0
+    # r23 fleet/SLO additions (same back-compat discipline; decision-
+    # invariant today): the fleet observatory's view — cumulative SLO
+    # breaches it has evaluated, breaches since the last observation,
+    # the fleet-wide utilization from its last poll (0 = no fleet view
+    # in this process), and the share of this fleet's rank-seconds the
+    # ledger could not attribute (docs/fleet.md) — so a future policy
+    # can scale on "the fleet is breaching/idle", not just local queue
+    # pressure.
+    slo_breaches: int = 0
+    slo_breach_rate: float = 0.0
+    fleet_utilization: float = 0.0
+    rank_seconds_unattributed_share: float = 0.0
 
 
 @dataclass
@@ -240,10 +281,14 @@ def collect_signals(basics=None, t=None):
     elastic = snap.get("elastic", {})
     straggler = snap.get("straggler", {})
     global _last_counters
+    fleet = read_fleet_signals()
     faults = int(elastic.get("faults_detected", 0))
     heals = int(elastic.get("heals", 0))
-    prev = _last_counters or {"faults": faults, "heals": heals}
-    _last_counters = {"faults": faults, "heals": heals}
+    breaches = int(fleet["slo_breaches"])
+    prev = _last_counters or {"faults": faults, "heals": heals,
+                              "breaches": breaches}
+    _last_counters = {"faults": faults, "heals": heals,
+                      "breaches": breaches}
     pending = 0
     try:
         from horovod_tpu.common import elastic as hvd_elastic
@@ -286,6 +331,11 @@ def collect_signals(basics=None, t=None):
         useful_tokens=int(serving.get("useful_tokens", 0)),
         eviction_amplification=float(
             serving.get("eviction_amplification", 0.0)),
+        slo_breaches=breaches,
+        slo_breach_rate=float(breaches - prev.get("breaches", breaches)),
+        fleet_utilization=float(fleet["fleet_utilization"]),
+        rank_seconds_unattributed_share=float(
+            fleet["rank_seconds_unattributed_share"]),
     )
 
 

@@ -48,10 +48,12 @@ from collections import defaultdict
 
 from horovod_tpu.telemetry import postmortem
 
-# kInject "action" values (csrc/operations.cc FaultAction) — only the
-# straggler delay contributes a stall interval; the others either kill
-# the process or are instantaneous.
-_INJECT_DELAY = 4
+# kInject "action" values (csrc/operations.cc FaultAction) that sleep
+# between the inject event and the next runtime activity — the gap is
+# stall evidence. stop (1) SIGSTOPs the whole process for its param;
+# delay (4) sleeps the background loop. The others either kill the
+# process or are instantaneous.
+_INJECT_GAP_ACTIONS = (1, 4)
 
 
 def union_measure(intervals, lo=None, hi=None):
@@ -106,12 +108,40 @@ def step_windows(dump):
     return windows
 
 
+def intersect_intervals(a, b):
+    """Pairwise intersection of two ``[start, end)`` interval lists —
+    the offline twin of the overlap ledger's exposure clip (wire time
+    under the union of API-thread waits)."""
+    a = sorted((lo, hi) for lo, hi in a if hi > lo)
+    b = sorted((lo, hi) for lo, hi in b if hi > lo)
+    out = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            out.append((lo, hi))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
 def phase_intervals(dump):
     """Wall-axis intervals per phase (``wire``/``negotiation``/
-    ``stall``) for one rank's dump; ``compute`` is derived later as the
-    per-window remainder."""
+    ``stall``, plus the raw ``wait`` blocks) for one rank's dump;
+    ``compute`` is derived later as the per-window remainder.
+
+    When the dump carries ``wait`` events (hvdtpu_wait blocks, r23),
+    ``wire`` is the ledger's *exposed* measure — wire spans clipped to
+    the union of the waits — so a fused lane whose transfers drained
+    under host compute attributes as compute-bound, exactly like the
+    live overlap ledger. Dumps without wait events (pre-r23, or
+    synthesized) keep the raw span union."""
     hdr = dump["header"]
-    out = {"wire": [], "negotiation": [], "stall": []}
+    out = {"wire": [], "negotiation": [], "stall": [], "wait": []}
+    spans = []
     nego_begin = None
     prev_wall = None
     pending_delay = None
@@ -122,17 +152,21 @@ def phase_intervals(dump):
             # An injected straggler delay sleeps between the inject
             # event and whatever the loop does next: that gap IS the
             # stall (the chaos lane's ground truth, docs/elastic.md).
-            # A wire_span is stamped at its END — close the gap at the
-            # span's START so the stall does not swallow wire time.
+            # A wire_span (and a wait) is stamped at its END — close
+            # the gap at the interval's START so the stall does not
+            # swallow wire time.
             end = wall
-            if typ == "wire_span":
+            if typ in ("wire_span", "wait"):
                 end = wall - int(ev.get("dur_us", 0))
             if end > pending_delay:
                 out["stall"].append((pending_delay, end))
             pending_delay = None
         if typ == "wire_span":
             dur = int(ev.get("dur_us", 0))
-            out["wire"].append((wall - dur, wall))
+            spans.append((wall - dur, wall))
+        elif typ == "wait":
+            dur = int(ev.get("dur_us", 0))
+            out["wait"].append((wall - dur, wall))
         elif typ == "negotiate_begin":
             nego_begin = wall
         elif typ == "negotiate_end":
@@ -145,11 +179,13 @@ def phase_intervals(dump):
         elif typ == "retry_window":
             out["stall"].append(
                 (wall - int(ev.get("window_ms", 0)) * 1000, wall))
-        elif typ == "inject" and ev.get("action") == _INJECT_DELAY:
+        elif typ == "inject" and ev.get("action") in _INJECT_GAP_ACTIONS:
             pending_delay = wall
         prev_wall = wall
     if pending_delay is not None and prev_wall is not None:
         out["stall"].append((pending_delay, prev_wall))
+    out["wire"] = (intersect_intervals(spans, out["wait"])
+                   if out["wait"] else spans)
     return out
 
 

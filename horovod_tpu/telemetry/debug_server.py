@@ -91,6 +91,17 @@ def _healthz(basics):
         out.update(read_serving_signals())
     except Exception:  # noqa: BLE001 — health must answer anyway
         pass
+    # Fleet/SLO fields (docs/fleet.md): the observatory's verdicts —
+    # cumulative breaches, last fleet utilization, unattributed
+    # rank-seconds share. Zeros when no observatory is live in this
+    # process (same pinned-field-set discipline as the serving
+    # sentinels above).
+    try:
+        from horovod_tpu.telemetry.autoscale import read_fleet_signals
+
+        out.update(read_fleet_signals())
+    except Exception:  # noqa: BLE001 — health must answer anyway
+        pass
     try:
         snap = basics.metrics_snapshot()
         out["elastic"] = {
@@ -182,11 +193,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, json.dumps(reqtrace.live_requests(n)))
             elif url.path == "/stacks":
                 self._reply(200, _stacks(), ctype="text/plain")
+            elif url.path == "/fleet":
+                # Live fleet aggregation (docs/fleet.md): polls every
+                # rank's debug server into one per-rank utilization /
+                # SLO view. Answered from whichever rank the operator
+                # asked (the observatory is lazy per process); the
+                # server is threaded, so polling our own /healthz and
+                # /events from inside this handler cannot deadlock.
+                from horovod_tpu.telemetry import fleet
+
+                obs = fleet.maybe_observatory(self.basics)
+                self._reply(200, json.dumps(obs.fleet_json()))
             else:
                 self._reply(404, json.dumps({
                     "error": f"unknown path {url.path}",
                     "endpoints": ["/healthz", "/metrics", "/events",
-                                  "/requests", "/stacks"]}))
+                                  "/requests", "/stacks", "/fleet"]}))
         except Exception as e:  # noqa: BLE001 — a broken endpoint must
             # not kill the server thread (introspection of a sick
             # process is exactly when internals throw)

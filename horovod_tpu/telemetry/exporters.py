@@ -75,6 +75,26 @@ def _flatten_prom(snap, rank):
                      f'{chan.get("tx_bytes", 0)}')
         lines.append(f'hvdtpu_wire_channel_rx_bytes_total{{{clabel}}} '
                      f'{chan.get("rx_bytes", 0)}')
+    # Syscall accounting (docs/wire.md "Syscall budget"): send/recv
+    # INVOCATIONS per plane/channel plus calls-per-GB — the io_uring
+    # baseline (ROADMAP item 3). One increment per call issued, EAGAIN
+    # spins included, so a stall that burns syscalls without moving
+    # payload shows up here first.
+    sc = wire.get("syscalls", {})
+    for field, direction in (("tx_calls", "tx"), ("rx_calls", "rx")):
+        lines.append(f'hvdtpu_wire_syscalls_total{{direction='
+                     f'"{direction}",{label}}} {sc.get(field, 0)}')
+        lines.append(f'hvdtpu_wire_cross_syscalls_total{{direction='
+                     f'"{direction}",{label}}} '
+                     f'{sc.get("cross_" + field, 0)}')
+    lines.append(f'hvdtpu_wire_syscalls_per_gb{{{label}}} '
+                 f'{sc.get("per_gb", 0.0)}')
+    for chan in sc.get("channels", []):
+        clabel = f'channel="{chan.get("channel", 0)}",{label}'
+        for field, direction in (("tx_calls", "tx"), ("rx_calls", "rx")):
+            lines.append(f'hvdtpu_wire_channel_syscalls_total{{'
+                         f'direction="{direction}",{clabel}}} '
+                         f'{chan.get(field, 0)}')
     # Step-anatomy overlap ledger (docs/metrics.md): exposed vs hidden
     # wire time per plane — the overlap-efficiency trend perfwatch and
     # the fusion-work acceptance criterion watch.

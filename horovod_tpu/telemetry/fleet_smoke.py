@@ -1,0 +1,232 @@
+"""Two-process fleet-observatory smoke: ``make fleet-obs-smoke``.
+
+The full r23 fleet stack, one command, no accelerator: 2 real ranks
+over the eager host ring, step-marked train loops, a chaos-injected
+``stop:<ms>`` stall on rank 1 that HEALS in place through the retry
+ladder (the test_observability recipe — timeout 600 ms x 6 attempts,
+400 ms backoff), while the driver polls the live ``/fleet`` endpoint
+mid-run. Asserts:
+
+1. **live fleet aggregation mid-run** — ``/fleet`` on rank 0 answers
+   while both ranks are training, with a ledger row per rank;
+2. **exact reconciliation** — every rank's rank-seconds buckets sum to
+   its window TO THE MICROSECOND, with ``unattributed`` under 1%
+   (the r17 standard applied fleet-wide);
+3. **SLO attribution** — rank 1's own SLO check over its own ledger
+   books the SIGSTOP gap to ``stall``, breaches ``stall_ms < 500``,
+   and records a typed ``slo_breach`` ring event naming rank 1 with
+   phase ``stall``, which the post-run ``report.py --fleet`` over the
+   black-box dumps surfaces again — live verdict and post-mortem
+   verdict from one evidence trail.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+STALL_MS = 2500
+WARMUP_STEPS = 3
+SMOKE_SLO = ("stall_ms < 500",)
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def worker(tmpdir):
+    import numpy as np
+
+    from horovod_tpu.common import eager_ops
+    from horovod_tpu.common.basics import HorovodBasics
+    from horovod_tpu.telemetry import fleet, slo
+
+    b = HorovodBasics()
+    b.init()
+    rank, size = b.rank(), b.size()
+    if rank == 1:
+        # Fires on the op AFTER the warmup steps (one op per step);
+        # heals in place via the retry ladder (env set by the driver).
+        b.set_fault_inject_spec(f"1:{WARMUP_STEPS}:stop:{STALL_MS}")
+    x = np.full(2048, float(rank + 1), np.float32)
+
+    def step(i, name):
+        b.step_mark(True)
+        out = eager_ops.allreduce_async(x, name).synchronize()
+        assert out[0] == 3.0, out[0]
+        b.step_mark(False)
+
+    for i in range(WARMUP_STEPS):
+        step(i, f"warm.{i}")
+    # Handshake: both ranks up with debug servers answering; the driver
+    # polls /fleet live, then says go. The wait sits BETWEEN step
+    # windows, so the ledger books it as idle, not unattributed.
+    with open(os.path.join(tmpdir, f"ready.{rank}"), "w") as f:
+        f.write("ready")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(os.path.join(tmpdir, "go")):
+        assert time.monotonic() < deadline, "driver never said go"
+        time.sleep(0.05)
+    # The stall step: rank 1 SIGSTOPs mid-op and resumes; rank 0 rides
+    # the retry ladder until the transfer completes. Nobody faults.
+    step(WARMUP_STEPS, "stall")
+    step(WARMUP_STEPS + 1, "post")
+
+    # Local ledger + SLO check over this rank's OWN ring: per-rank
+    # evaluation makes breach attribution exact by construction.
+    events = b.events(8192)
+    ledger = fleet.ledger_from_events(events, rank=rank)
+    buckets = ledger["buckets"]
+    assert sum(buckets.values()) == ledger["window_us"], \
+        f"rank {rank}: buckets do not reconcile: {ledger}"
+    assert buckets["unattributed"] < 0.01 * ledger["window_us"], \
+        f"rank {rank}: unattributed {buckets['unattributed']} us " \
+        f"of {ledger['window_us']}: {buckets}"
+    engine = slo.SloEngine(SMOKE_SLO)
+    breaches = engine.evaluate(
+        {rank: fleet.ledger_signals(ledger)},
+        {rank: fleet.dominant_phase(ledger)})
+    if rank == 1:
+        assert breaches, f"rank 1 saw no stall_ms breach: {ledger}"
+        assert breaches[0].phase == "stall", breaches
+    engine.record(b, breaches)
+
+    # One live dump per rank: the post-mortem side of the same trail.
+    from horovod_tpu.telemetry import critpath
+
+    dump_dir = os.environ["HVDTPU_FLEET_DUMPS"]
+    os.makedirs(dump_dir, exist_ok=True)
+    critpath.write_event_dump(
+        os.path.join(dump_dir, f"blackbox-rank{rank}.jsonl"),
+        rank, size, b.events(8192))
+    time.sleep(0.5)  # r12 ordering: sockets stay open for the peer
+    b.shutdown()
+    print(f"FLEET_SMOKE_OK rank={rank} "
+          f"window_us={ledger['window_us']} "
+          f"stall_us={buckets['stall']} "
+          f"unattributed_us={buckets['unattributed']} "
+          f"breaches={len(breaches)}")
+    return 0
+
+
+def _get_json(url, timeout=20):
+    return json.loads(urllib.request.urlopen(url, timeout=timeout).read())
+
+
+def main():
+    if "--worker" in sys.argv:
+        return worker(os.environ["HVDTPU_SMOKE_TMP"])
+
+    from horovod_tpu.telemetry import fleet
+
+    size = 2
+    port = _free_port()
+    dbg_port = _free_port()
+    with tempfile.TemporaryDirectory() as tmpdir:
+        dump_dir = os.path.join(tmpdir, "dumps")
+        procs = []
+        for rank in range(size):
+            env = dict(os.environ,
+                       HOROVOD_RANK=str(rank), HOROVOD_SIZE=str(size),
+                       HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                       HOROVOD_CONTROLLER_PORT=str(port),
+                       # The heal recipe: the stall outlasts one
+                       # timeout but not the ladder — the world
+                       # survives and the ledger books the gap.
+                       HOROVOD_WIRE_TIMEOUT_MS="600",
+                       HOROVOD_WIRE_RETRY_ATTEMPTS="6",
+                       HOROVOD_WIRE_RETRY_BACKOFF_MS="400",
+                       HOROVOD_DEBUG_PORT=str(dbg_port),
+                       HVDTPU_FLEET_DUMPS=dump_dir,
+                       HVDTPU_SMOKE_TMP=tmpdir,
+                       JAX_PLATFORMS="cpu")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "horovod_tpu.telemetry.fleet_smoke", "--worker"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+
+        # Phase 1: both ranks warmed up -> /fleet on rank 0 aggregates
+        # the LIVE fleet (rank 0 polls both debug servers, itself
+        # included — the server is threaded).
+        deadline = time.monotonic() + 60
+        while not all(os.path.exists(os.path.join(tmpdir, f"ready.{r}"))
+                      for r in range(size)):
+            if time.monotonic() > deadline:
+                for p in procs:
+                    p.kill()
+                print("fleet-obs-smoke: FAILED (workers never ready)")
+                return 1
+            time.sleep(0.05)
+        view = _get_json(f"http://127.0.0.1:{dbg_port}/fleet")
+        assert view["size"] == size and view["reachable"] == size, view
+        for r in range(size):
+            entry = view["ranks"][str(r)]
+            ledger = entry["ledger"]
+            assert sum(ledger["buckets"].values()) \
+                == ledger["window_us"], entry
+        assert view["fleet"]["window_us"] > 0, view
+        print(f"fleet-obs-smoke: /fleet live mid-run — {size}/{size} "
+              f"ranks reachable, fleet utilization "
+              f"{view['fleet']['utilization']:.1%}")
+
+        # Phase 2: release the stall step and let the workers finish
+        # their own reconciliation + SLO assertions.
+        with open(os.path.join(tmpdir, "go"), "w") as f:
+            f.write("go")
+        failed = False
+        for rank, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out = "TIMEOUT"
+            ok = p.returncode == 0 and "FLEET_SMOKE_OK" in out
+            print(out.strip())
+            if not ok:
+                print(f"rank {rank} FAILED (rc={p.returncode})")
+                failed = True
+        if failed:
+            return 1
+
+        # Phase 3: post-mortem over the same evidence — the recorded
+        # breach event must name rank 1 with phase stall, and every
+        # rank's buckets must reconcile exactly in the offline ledger
+        # too.
+        analysis = fleet.analyze(dump_dir, objectives=SMOKE_SLO)
+        for r, ledger in analysis["per_rank"].items():
+            assert sum(ledger["buckets"].values()) \
+                == ledger["window_us"], (r, ledger)
+            assert ledger["buckets"]["unattributed"] \
+                < 0.01 * ledger["window_us"], (r, ledger["buckets"])
+        recorded = [b for b in analysis["slo"]["breach_events"]
+                    if b["objective"] == "stall_ms"]
+        assert any(b["breach_rank"] == 1 and b["phase"] == "stall"
+                   for b in recorded), analysis["slo"]
+        print(fleet.format_fleet(analysis))
+
+        # And the CLI renders the same verdict (report.py --fleet).
+        cli = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.telemetry.report",
+             "--fleet", "--slo", "stall_ms < 500", dump_dir],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert cli.returncode == 0, cli.stderr[-500:]
+        assert "breach [stall_ms] rank 1" in cli.stdout, cli.stdout
+        print(f"fleet-obs-smoke: OK (live /fleet + worker-side "
+              f"reconciliation + post-mortem breach attribution all "
+              f"agree: rank 1, phase stall)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,14 @@ DEFAULT_WATCH = {
     # serving_trace_overhead lane): the flight recorder / request
     # tracing getting more expensive IS a perf regression.
     "overhead_pct": "up",
+    # Fleet rank-seconds rows (bench.py --fleet-util, docs/fleet.md):
+    # utilization falling, the unattributed share growing, breaches
+    # appearing, or the aggregation itself slowing down at fleet scale
+    # are each regressions in their own right.
+    "utilization": "down",
+    "unattributed_share": "up",
+    "breaches": "up",
+    "analyze_s": "up",
 }
 
 

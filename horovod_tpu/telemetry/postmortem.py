@@ -39,6 +39,32 @@ PROGRESS_TYPES = ("wire_chunk", "wire_span", "response_launch",
                   "negotiate_end")
 
 
+def _fold_slo_breaches(timeline):
+    """SLO breach events out of a merged timeline, folded ONCE per
+    (source rank, ring seq): a process re-dumps its ring tail on every
+    fault, so the same recorded breach can reach the merge several
+    times — the verdict list must not multiply with the fault count
+    (docs/fleet.md)."""
+    seen = set()
+    out = []
+    for e in timeline:
+        if e.get("type") != "slo_breach":
+            continue
+        key = (e.get("rank"), e.get("seq"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append({
+            "source_rank": e.get("rank"),
+            "objective": e.get("objective_name"),
+            "breach_rank": e.get("breach_rank"),
+            "value": e.get("value"),
+            "phase": e.get("phase_name"),
+            "t_ms": e.get("t_ms"),
+        })
+    return out
+
+
 def default_blackbox_dir():
     """Where the core dumps land when HOROVOD_BLACKBOX_DIR is unset
     (must mirror DumpBlackBox in csrc/operations.cc)."""
@@ -200,6 +226,7 @@ def merge_post_mortem(paths_or_dir, dump_index=-1):
         "first_stalled_rank": first_stalled,
         "per_rank": per_rank,
         "timeline": timeline,
+        "slo_breaches": _fold_slo_breaches(timeline),
     }
 
 
@@ -407,11 +434,20 @@ def merge_post_mortem_streaming(paths_or_dir, dump_index=-1, tail=512):
     window = deque(maxlen=max(int(tail), 1))
     total = 0
     t0 = None
+    # SLO breaches are collected DURING the pass, not from the bounded
+    # tail window — a breach early in a long run is exactly the entry
+    # the post-mortem must not age out (folding in _fold_slo_breaches).
+    breach_rows = []
     for wall, rank, ev in merged:
         total += 1
         if t0 is None:
             t0 = wall
         window.append((wall, rank, ev))
+        if ev.get("type") == "slo_breach":
+            row = dict(ev)
+            row["rank"] = rank
+            row["t_ms"] = round((wall - t0) / 1000.0, 3)
+            breach_rows.append(row)
         if ev.get("type") not in PROGRESS_TYPES:
             continue
         if cutoff is not None and wall > cutoff:
@@ -441,6 +477,7 @@ def merge_post_mortem_streaming(paths_or_dir, dump_index=-1, tail=512):
         "per_rank": per_rank,
         "timeline": timeline,
         "timeline_total": total,
+        "slo_breaches": _fold_slo_breaches(breach_rows),
     }
 
 
@@ -465,6 +502,11 @@ def format_post_mortem(analysis, tail=40):
             f"{d['events']} events, fault kind={fault.get('kind')} "
             f"certain={fault.get('certain')} ranks={fault.get('ranks')} "
             f"last progress {d.get('last_progress_ms', '-')} ms")
+    for b in analysis.get("slo_breaches", []):
+        lines.append(f"  slo breach [{b['objective']}] rank "
+                     f"{b['breach_rank']} value={b['value']} "
+                     f"phase={b['phase']} at {b['t_ms']} ms "
+                     f"(recorded by rank {b['source_rank']})")
     total = analysis.get("timeline_total", len(analysis["timeline"]))
     lines.append(f"causal timeline (last {tail} of {total} events):")
     for e in analysis["timeline"][-tail:]:

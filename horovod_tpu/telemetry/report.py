@@ -344,6 +344,20 @@ def main(argv=None):
                          "rank and phase (compute/negotiation/wire/"
                          "stall) that bounded it; -o writes the "
                          "analysis as JSON")
+    ap.add_argument("--fleet", action="store_true",
+                    help="positional args are per-rank event dumps (or "
+                         "their directory): decompose every rank's "
+                         "wall time into the rank-seconds buckets "
+                         "(docs/fleet.md), render the fleet "
+                         "utilization table with worst-rank "
+                         "attribution, and report SLO breaches — both "
+                         "breach events recorded in the dumps and a "
+                         "re-evaluation of the ledger signals; -o "
+                         "writes the analysis as JSON")
+    ap.add_argument("--slo", default=None,
+                    help="with --fleet: ';'-separated SLO objectives "
+                         "to evaluate instead of the defaults (e.g. "
+                         "'stall_ms < 500; serving_p99_ms < 250')")
     args = ap.parse_args(argv)
 
     if args.requests:
@@ -366,6 +380,18 @@ def main(argv=None):
 
         analysis = critpath.critical_path(args.timelines)
         print(critpath.format_critical_path(analysis))
+        if args.output != "merged_timeline.json":
+            with open(args.output, "w") as f:
+                json.dump(analysis, f, indent=2)
+            print(f"wrote {args.output}")
+        return 0
+
+    if args.fleet:
+        from horovod_tpu.telemetry import fleet
+
+        analysis = fleet.analyze(args.timelines,
+                                 objectives=args.slo)
+        print(fleet.format_fleet(analysis))
         if args.output != "merged_timeline.json":
             with open(args.output, "w") as f:
                 json.dump(analysis, f, indent=2)

@@ -270,15 +270,17 @@ def test_healthz_and_stacks_respond_while_peer_sigstopped(tmp_path):
     # endpoint serves everything the scaling policy consumes — field
     # set PINNED here (r17 adds the overlap-ledger pair, r18 the
     # serving quartet, r19 the rolling-latency trio + eviction
-    # amplification; autoscale Signals defaults keep older payloads
-    # constructing).
+    # amplification, r23 the fleet/SLO trio; autoscale Signals
+    # defaults keep older payloads constructing).
     for key in ("queue_depth", "straggler_skew_ms", "step_time_ewma_ms",
                 "pending_rejoiners", "debug_port", "overlap_efficiency",
                 "exposed_wire_ms", "serving_queue_depth",
                 "inflight_sequences", "kv_blocks_free",
                 "kv_blocks_total", "serving_p50_ms", "serving_p99_ms",
                 "requests_served", "recomputed_prefill_tokens",
-                "useful_tokens", "eviction_amplification"):
+                "useful_tokens", "eviction_amplification",
+                "slo_breaches", "fleet_utilization",
+                "rank_seconds_unattributed_share"):
         assert key in health, (key, sorted(health))
     # No serving loop in this process: the sentinel defaults, not a
     # phantom empty pool.
@@ -286,6 +288,9 @@ def test_healthz_and_stacks_respond_while_peer_sigstopped(tmp_path):
     assert health["kv_blocks_total"] == -1, health
     assert health["requests_served"] == 0, health
     assert health["eviction_amplification"] == 0.0, health
+    # No observatory live in this process either: the fleet zeros.
+    assert health["slo_breaches"] == 0, health
+    assert health["fleet_utilization"] == 0.0, health
     # /requests answers on a non-serving rank too: an empty in-flight
     # table, not an error (docs/serving.md).
     assert isinstance(polled.get("requests"), bytes), polled

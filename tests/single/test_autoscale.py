@@ -51,6 +51,24 @@ def test_signals_backward_compatible_with_pre_r17_field_set():
     assert a.decide(s) == b.decide(rich)
 
 
+def test_signals_fleet_slo_fields_default_and_decision_invariant():
+    """The r23 fleet/SLO fields (docs/fleet.md) follow the same
+    back-compat discipline: pre-fleet observation sources construct
+    Signals unchanged, and a fully-populated fleet view carries no
+    decision weight yet — the policy must decide identically with and
+    without it."""
+    s = Signals(t=0.0, world_size=4)
+    assert s.slo_breaches == 0
+    assert s.slo_breach_rate == 0.0
+    assert s.fleet_utilization == 0.0
+    assert s.rank_seconds_unattributed_share == 0.0
+    rich = Signals(t=0.0, world_size=4, slo_breaches=7,
+                   slo_breach_rate=2.0, fleet_utilization=0.42,
+                   rank_seconds_unattributed_share=0.03)
+    a, b = _policy(), _policy()
+    assert a.decide(s) == b.decide(rich)
+
+
 def test_ramp_scales_up_after_streak_then_cools_down():
     p = _policy()
     trace = [_sig(t, queue=20) for t in range(8)]

@@ -327,6 +327,15 @@ def test_prom_flattening_covers_fully_populated_snapshot():
                  "cross_tx_logical_bytes": 500,
                  "cross_rx_logical_bytes": 500,
                  "cross_compression_ratio": 0.5,
+                 "syscalls": {"tx_calls": 40, "rx_calls": 50,
+                              "cross_tx_calls": 10,
+                              "cross_rx_calls": 12,
+                              "per_gb": 45000.0,
+                              "channels": [
+                                  {"channel": 0, "tx_calls": 30,
+                                   "rx_calls": 38},
+                                  {"channel": 1, "tx_calls": 10,
+                                   "rx_calls": 12}]},
                  "overlap": {"steps": 7, "unattributed_us": 11,
                              "exposed_wire_ms": 5.0,
                              "hidden_wire_ms": 15.0,
@@ -379,6 +388,17 @@ def test_prom_flattening_covers_fully_populated_snapshot():
         'hvdtpu_overlap_total_us_total{plane="intra",rank="2"} 20000',
         'hvdtpu_overlap_plane_efficiency{plane="intra",rank="2"} 0.75',
         'hvdtpu_overlap_plane_efficiency{plane="cross",rank="2"} 0.0',
+        # r23 syscall accounting (docs/wire.md "Syscall budget"): the
+        # io_uring baseline — calls per plane/channel + calls-per-GB.
+        'hvdtpu_wire_syscalls_total{direction="tx",rank="2"} 40',
+        'hvdtpu_wire_syscalls_total{direction="rx",rank="2"} 50',
+        'hvdtpu_wire_cross_syscalls_total{direction="tx",rank="2"} 10',
+        'hvdtpu_wire_cross_syscalls_total{direction="rx",rank="2"} 12',
+        'hvdtpu_wire_syscalls_per_gb{rank="2"} 45000.0',
+        'hvdtpu_wire_channel_syscalls_total{direction="tx",'
+        'channel="1",rank="2"} 10',
+        'hvdtpu_wire_channel_syscalls_total{direction="rx",'
+        'channel="0",rank="2"} 38',
     ]
     for line in expected:
         assert line in text, f"missing exporter row: {line}"
